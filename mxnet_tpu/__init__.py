@@ -1,0 +1,31 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+User-facing API mirrors the reference's Python surface (``mx.nd``,
+``mx.autograd``, ``mx.gluon``, ``mx.kv``, ``mx.io``, ``mx.metric``,
+``mx.optimizer``, ``ctx=mx.tpu()``); internals are idiomatic XLA —
+see SURVEY.md §7 for the design stance.
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+import jax as _jax_config_only
+
+# MXNet supports int64/float64 tensors; JAX demotes them unless x64 is on.
+# Weak-type promotion keeps float32 as the working default (MXNet rule).
+_jax_config_only.config.update("jax_enable_x64", True)
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import context
+
+__all__ = ["nd", "ndarray", "autograd", "random", "context",
+           "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "Context", "MXNetError", "engine"]

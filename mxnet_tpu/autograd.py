@@ -1,0 +1,314 @@
+"""Imperative autograd: record / pause scopes, tape, backward.
+
+Capability parity: reference ``python/mxnet/autograd.py`` +
+``src/imperative/imperative.cc`` (``RecordOp``, ``Backward``) — SURVEY.md
+§3.2.  TPU-native design: instead of building an nnvm gradient graph and
+re-executing it through an engine, each recorded op captures its
+``jax.vjp`` closure at forward time (residuals live on device); ``backward``
+walks the tape in reverse topological order composing those closures.  Leaf
+semantics (``attach_grad``, ``grad_req`` write/add/null) match the reference.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad",
+           "set_recording", "set_training", "Function"]
+
+_state = threading.local()
+
+
+def _rec() -> bool:
+    return getattr(_state, "recording", False)
+
+
+def _trn() -> bool:
+    return getattr(_state, "training", False)
+
+
+def is_recording() -> bool:
+    return _rec()
+
+
+def is_training() -> bool:
+    return _trn()
+
+
+def set_recording(is_recording: bool) -> bool:
+    prev = _rec()
+    _state.recording = is_recording
+    return prev
+
+
+def set_training(train_mode: bool) -> bool:
+    prev = _trn()
+    _state.training = train_mode
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        if self._recording is not None:
+            self._prev_rec = set_recording(self._recording)
+        if self._training is not None:
+            self._prev_trn = set_training(self._training)
+        return self
+
+    def __exit__(self, *exc):
+        if self._recording is not None:
+            set_recording(self._prev_rec)
+        if self._training is not None:
+            set_training(self._prev_trn)
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """``with autograd.record():`` — turn on recording (and train mode)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class _Node:
+    """One recorded op: holds the vjp closure and graph structure."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_extra", "outputs", "out_avals")
+
+    def __init__(self, vjp_fn, inputs, n_extra, out_avals):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # NDArray refs (graph edges)
+        self.n_extra = n_extra        # trailing scalar-attr arrays
+        self.outputs = []             # filled by invoke's _wrap_outputs
+        self.out_avals = out_avals
+
+
+def _record_op(op, kwargs, all_arrays, inputs):
+    """Called from ndarray.invoke while recording. Runs forward via jax.vjp
+    (one pass; residuals retained on device) and returns (node, outputs)."""
+    import jax
+    import functools
+    bound = functools.partial(op.fcompute, **kwargs) if kwargs \
+        else op.fcompute
+    outputs_data, vjp_fn = jax.vjp(bound, *all_arrays)
+    if isinstance(outputs_data, tuple):
+        avals = [o.aval for o in outputs_data]
+    else:
+        avals = [outputs_data.aval]
+    node = _Node(vjp_fn, list(inputs), len(all_arrays) - len(inputs), avals)
+    return node, outputs_data
+
+
+def _toposort(heads) -> List[_Node]:
+    """Iterative post-order DFS — deep tapes (unrolled RNNs) must not hit
+    Python's recursion limit."""
+    order: List[_Node] = []
+    seen = set()
+    stack = [(h._ag_node, False) for h in heads if h._ag_node is not None]
+    while stack:
+        node, expanded = stack.pop()
+        if node is None:
+            continue
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = inp._ag_node
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order
+
+
+def _is_float0(x):
+    import jax
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _run_backward(heads, head_grads, retain_graph=False):
+    """Core reverse pass. Returns {id(leaf NDArray): jax grad array}."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    order = _toposort(heads)
+    out_cots = {}   # id(node) -> [cotangent per output]
+    leaf_grads = {}  # id(ndarray) -> (ndarray, jax array sum)
+
+    def add_head(arr, cot):
+        node = arr._ag_node
+        if node is not None:
+            slots = out_cots.setdefault(id(node), [None] * len(node.out_avals))
+            i = arr._ag_out_idx
+            slots[i] = cot if slots[i] is None else slots[i] + cot
+        elif arr._grad is not None and arr.grad_req != "null":
+            k = id(arr)
+            if k in leaf_grads:
+                leaf_grads[k] = (arr, leaf_grads[k][1] + cot)
+            else:
+                leaf_grads[k] = (arr, cot)
+
+    for h, hg in zip(heads, head_grads):
+        cot = hg if hg is not None else jnp.ones(h.shape, h.dtype)
+        if isinstance(cot, NDArray):
+            cot = cot._data
+        add_head(h, cot)
+
+    for node in reversed(order):
+        slots = out_cots.pop(id(node), None)
+        if slots is None:
+            continue
+        cots = [s if s is not None else jnp.zeros(a.shape, a.dtype)
+                for s, a in zip(slots, node.out_avals)]
+        primal_out = tuple(cots) if len(node.out_avals) > 1 else cots[0]
+        in_cots = node.vjp_fn(primal_out)
+        for inp, cot in zip(node.inputs, in_cots):
+            if _is_float0(cot):
+                continue
+            add_head(inp, cot)
+        if not retain_graph:
+            node.vjp_fn = None
+    if not retain_graph:
+        for node in order:
+            for o in node.outputs:
+                o._ag_node = None
+    return leaf_grads
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Parity: ``autograd.backward(heads, head_grads)``.
+
+    Accumulates into ``leaf.grad`` honouring grad_req ('write' overwrites,
+    'add' accumulates, 'null' skips).
+    """
+    from .ndarray.ndarray import NDArray
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    leaf_grads = _run_backward(heads, head_grads, retain_graph)
+    for _, (arr, g) in leaf_grads.items():
+        if arr.grad_req == "add":
+            arr._grad._set_data(arr._grad._data + g)
+        else:
+            arr._grad._set_data(g.astype(arr._grad.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: ``autograd.grad`` — returns grads instead of writing .grad."""
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order grad "
+                                  "through autograd.grad) lands with the "
+                                  "higher-order-grad milestone")
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    variables = variables if isinstance(variables, (list, tuple)) \
+        else [variables]
+    for v in variables:
+        if v._grad is None:
+            v.attach_grad()
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    retain = bool(retain_graph) if retain_graph is not None else False
+    leaf_grads = _run_backward(heads, head_grads, retain)
+    outs = []
+    for v in variables:
+        if id(v) in leaf_grads:
+            outs.append(NDArray(leaf_grads[id(v)][1], ctx=v._ctx))
+        else:
+            outs.append(NDArray(jnp.zeros(v.shape, v.dtype), ctx=v._ctx))
+    return outs
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    variables = variables if isinstance(variables, (list, tuple)) \
+        else [variables]
+    gradients = gradients if isinstance(gradients, (list, tuple)) \
+        else [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v.grad_req = r
+
+
+class Function:
+    """Customizable differentiable function (parity: autograd.Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        if not is_recording():
+            return outputs
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+
+        fn = self
+
+        class _FnNode(_Node):
+            __slots__ = ()
+
+            def __init__(self, inputs, out_avals):
+                super().__init__(None, inputs, 0, out_avals)
+
+        node = _FnNode(list(inputs), [o._data.aval for o in outs])
+
+        def vjp_fn(cots):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            with pause():
+                grads = fn.backward(*[NDArray(c) for c in cots])
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            return tuple(g._data for g in grads)
+
+        node.vjp_fn = vjp_fn
+        node.outputs = list(outs)
+        for i, o in enumerate(outs):
+            o._ag_node = node
+            o._ag_out_idx = i
+        return outputs

@@ -1,0 +1,142 @@
+"""Dispatch engine: the TPU-native stand-in for the threaded dependency engine.
+
+Capability parity: reference ``src/engine/`` (ThreadedEnginePerDevice,
+NaiveEngine, ``WaitForVar/WaitForAll``) — see SURVEY.md §2.1.  The reference
+builds its own var-based dataflow scheduler because CUDA needs one; XLA/PJRT
+already executes asynchronously with per-buffer dataflow ordering, so the
+TPU-native engine is a thin layer that:
+
+  * compiles each (op, static-attrs) pair once via ``jax.jit`` and caches the
+    executable — the "one-op jit" (SURVEY.md §7 P1);
+  * preserves the user-visible async semantics: ops return immediately,
+    ``wait_to_read()`` / ``asnumpy()`` are the sync points, and runtime errors
+    teleport to the next sync point (PJRT does this natively);
+  * offers the NaiveEngine equivalent (``MXNET_ENGINE_TYPE=NaiveEngine`` or
+    ``MXTPU_ENGINE_TYPE=NaiveEngine``): block after every op, for debugging
+    and determinism, matching the reference's env-var swap.
+
+``waitall`` tracks live output buffers in a weak set, mirroring
+``Engine::WaitForAll``.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, Tuple
+
+__all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size"]
+
+_lock = threading.Lock()
+_jit_cache: Dict[Tuple, Callable] = {}
+# weak set of in-flight jax arrays for waitall()
+_live = weakref.WeakSet()
+
+
+def is_naive() -> bool:
+    return (os.environ.get("MXTPU_ENGINE_TYPE",
+                           os.environ.get("MXNET_ENGINE_TYPE", ""))
+            == "NaiveEngine")
+
+
+def _freeze(v: Any):
+    if isinstance(v, (list,)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def get_compiled(name: str, fcompute: Callable, attrs: dict) -> Callable:
+    """Return the jitted executable for (op, attrs); compile-once semantics.
+
+    This is the moral equivalent of the reference's per-op FCompute lookup +
+    engine push: jax.jit re-traces per input shape/dtype/device, which plays
+    the role of the per-(shape,dtype,ctx) plan cache in CachedOp.
+    """
+    key = (name, _freeze(attrs))
+    fn = _jit_cache.get(key)
+    if fn is None:
+        with _lock:
+            fn = _jit_cache.get(key)
+            if fn is None:
+                bound = functools.partial(fcompute, **attrs) if attrs else fcompute
+                fn = __import__("jax").jit(bound)
+                _jit_cache[key] = fn
+    return fn
+
+
+def track(arr):
+    """Register an output buffer so waitall() can find it."""
+    try:
+        _live.add(arr)
+    except TypeError:
+        pass
+    return arr
+
+
+def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays):
+    """Execute an op through the compile cache. Returns jax array(s)."""
+    fn = get_compiled(name, fcompute, attrs)
+    out = fn(*arrays)
+    if is_naive():
+        import jax
+        jax.block_until_ready(out)
+    if isinstance(out, tuple):
+        for o in out:
+            track(o)
+    else:
+        track(out)
+    return out
+
+
+def waitall():
+    """Block until every tracked in-flight buffer is ready.
+
+    Parity: ``mx.nd.waitall()`` → ``Engine::WaitForAll``.
+    """
+    import jax
+    for arr in list(_live):
+        try:
+            jax.block_until_ready(arr)
+        except Exception:
+            # teleported async error: surface it, like WaitForAll would
+            raise
+
+
+def cache_size() -> int:
+    return len(_jit_cache)
+
+
+def clear_cache():
+    with _lock:
+        _jit_cache.clear()
+
+
+_bulk_size = 0
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity shim for ``mx.engine.set_bulk_size``.
+
+    XLA fuses whole graphs at the hybridize/CachedOp seam, so imperative
+    bulking is a no-op; the knob is kept so user code runs unchanged.
+    """
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+class bulk:
+    """Parity context manager ``with mx.engine.bulk(n):`` — no-op on XLA."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)

@@ -1,0 +1,121 @@
+"""``mx.nd`` namespace: NDArray + every registered operator as a function.
+
+Capability parity: reference ``python/mxnet/ndarray/`` — the reference
+codegens ``gen_op`` stubs at import from the C op registry
+(``_init_op_module``); here we generate wrappers from the Python op registry
+the same way.  Convention mirrored from the reference: tensor arguments are
+the leading positional args (NDArrays), operator attributes follow
+positionally (in declaration order) or as keywords; every op accepts
+``out=``.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import get_op, list_ops, OpDef
+from .ndarray import (NDArray, invoke, array, empty, zeros, ones, full,
+                      arange, eye, concatenate, save, load, waitall,
+                      moveaxis)
+
+_mod = sys.modules[__name__]
+
+
+def _make_wrapper(opname: str, op: OpDef):
+    ordered_attrs = tuple(op.scalar_attrs) + tuple(op.attr_names)
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        ctx = kwargs.pop("ctx", None)
+        kwargs.pop("name", None)
+        inputs = []
+        attr_pos = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            else:
+                attr_pos.append(a)
+        for name, val in zip(ordered_attrs, attr_pos):
+            if name in kwargs:
+                raise TypeError(f"{opname}: got multiple values for {name}")
+            kwargs[name] = val
+        if len(attr_pos) > len(ordered_attrs):
+            raise TypeError(f"{opname}: too many positional arguments")
+        return invoke(op, inputs, out=out, ctx=ctx, **kwargs)
+
+    fn.__name__ = opname
+    fn.__qualname__ = opname
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _generate(target_mod):
+    for opname in list_ops():
+        if opname in _CUSTOM:
+            setattr(target_mod, opname, _CUSTOM[opname])
+            continue
+        op = get_op(opname)
+        setattr(target_mod, opname, _make_wrapper(opname, op))
+
+
+# ---------------------------------------------------------------------------
+# ops that need frontend logic (RNG keys, aux-state mutation, mode flags)
+# ---------------------------------------------------------------------------
+
+
+def Dropout(data, p=0.5, mode="training", axes=(), **kwargs):
+    """Parity: nd.Dropout. RNG key threaded from mx.random's state."""
+    from .. import autograd
+    from .. import random as _rnd
+    training = autograd.is_training() or mode == "always"
+    if not training or p <= 0.0:
+        return invoke(get_op("identity"), [data])
+    key = _rnd._next_key_nd(data.context)
+    return invoke(get_op("Dropout"), [data, key], p=p, mode=mode,
+                  axes=tuple(axes), training=True)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, **kwargs):
+    """Parity: nd.BatchNorm incl. aux-state (moving stats) update."""
+    from .. import autograd
+    training = autograd.is_training() and not use_global_stats
+    outs = invoke(get_op("BatchNorm"),
+                  [data, gamma, beta, moving_mean, moving_var],
+                  eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                  use_global_stats=use_global_stats,
+                  output_mean_var=output_mean_var, axis=axis,
+                  training=training)
+    out, batch_mean, batch_var = outs
+    if training:
+        # aux-state update, outside the tape (reference updates aux arrays
+        # without recording them)
+        m = momentum
+        moving_mean._set_data(m * moving_mean._data
+                              + (1.0 - m) * batch_mean._data)
+        moving_var._set_data(m * moving_var._data
+                             + (1.0 - m) * batch_var._data)
+    if output_mean_var:
+        return out, batch_mean, batch_var
+    return out
+
+
+def RNN(*args, **kwargs):
+    raise NotImplementedError(
+        "nd.RNN: use mx.gluon.rnn layers (scan-lowered); the packed-weight "
+        "fused op surface lands with the RNN milestone")
+
+
+_CUSTOM = {"Dropout": Dropout, "BatchNorm": BatchNorm, "RNN": RNN}
+
+_generate(_mod)
+
+from . import random  # noqa: E402  (nd.random namespace)
+from . import sparse  # noqa: E402  (stype facade)
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "eye", "concatenate", "save", "load", "waitall", "invoke",
+           "random", "sparse", "moveaxis"]

@@ -1,0 +1,742 @@
+"""NDArray: imperative, mutable, device-resident n-dimensional array.
+
+Capability parity: reference ``src/ndarray/ndarray.cc`` +
+``include/mxnet/ndarray.h`` + ``python/mxnet/ndarray/ndarray.py``
+(SURVEY.md §2.1, §2.5).  TPU-native design (SURVEY.md §7 hard-part 1):
+
+* The reference's ref-counted ``Chunk`` (storage handle + engine var) becomes
+  a *versioned buffer slot*: mutation = functional update producing a new
+  ``jax.Array`` swapped into the slot with a version bump.  PJRT's async
+  runtime provides the dataflow ordering the threaded engine provided; the
+  version counter reproduces the observable ordering for *views*.
+* Views (``x[1:3]``, ``x[0]``) share the base slot: reads re-slice lazily
+  against the base's current version; writes scatter into the base.  This
+  reproduces MXNet's view-write-through semantics without shared memory.
+* ``wait_to_read()``/``asnumpy()`` are the sync points; async runtime errors
+  surface there (exception teleporting — PJRT native behaviour).
+* In-place mutation while ``autograd.record()`` is active raises, exactly as
+  the reference does.
+"""
+from __future__ import annotations
+
+import builtins
+import json
+import struct
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base import MXNetError, numeric_types
+from ..context import Context, current_context
+from .. import engine
+from ..ops.registry import OpDef, get_op
+
+__all__ = ["NDArray", "invoke", "array", "empty", "zeros", "ones", "full",
+           "arange", "eye", "concatenate", "save", "load", "waitall",
+           "moveaxis"]
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# NDArray
+# ---------------------------------------------------------------------------
+
+
+class NDArray:
+    """Mutable device array.
+
+    Non-view arrays own a buffer slot (``_buf`` + ``_version``); views hold a
+    reference to their base plus a basic-indexing key.
+    """
+
+    __slots__ = ("_buf", "_version", "_ctx", "_base", "_index",
+                 "_cached_view", "_cached_ver",
+                 "grad_req", "_grad", "_ag_node", "_ag_out_idx",
+                 "_deferred_init", "__weakref__")
+
+    # make NumPy defer to NDArray.__radd__ etc.
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Optional[Context] = None,
+                 _base: "NDArray" = None, _index=None):
+        self._base = _base
+        self._index = _index
+        self._cached_view = None
+        self._cached_ver = -1
+        self.grad_req = "null"
+        self._grad = None
+        self._ag_node = None
+        self._ag_out_idx = 0
+        self._deferred_init = None
+        if _base is not None:
+            self._buf = None
+            self._version = 0
+            self._ctx = _base._ctx
+        else:
+            self._buf = data
+            self._version = 0
+            self._ctx = ctx if ctx is not None else current_context()
+
+    # -- buffer access ----------------------------------------------------
+    @property
+    def _data(self):
+        """Current jax.Array value (lazily re-sliced for views)."""
+        if self._base is not None:
+            base = self._base
+            if self._cached_ver != base._root_version():
+                self._cached_view = base._data[self._index]
+                self._cached_ver = base._root_version()
+            return self._cached_view
+        return self._buf
+
+    def _root_version(self):
+        return (self._base._root_version() if self._base is not None
+                else self._version)
+
+    def _set_data(self, new):
+        """Mutate: swap buffer (or scatter through the view chain)."""
+        if self._base is not None:
+            base_val = self._base._data
+            self._base._set_data(base_val.at[self._index].set(new))
+        else:
+            self._buf = new
+            self._version += 1
+            engine.track(new)
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return invoke(get_op("transpose"), [self])
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception as e:  # async error teleports here
+            raise
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self.asscalar())
+
+    # -- sync points ------------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        """Copy to host; THE sync point (parity: WaitToRead + copy)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        _jax().block_until_ready(self._data)
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    # -- jax interop (TPU-native extension) -------------------------------
+    @property
+    def jax(self):
+        """The underlying ``jax.Array`` (read-only snapshot)."""
+        return self._data
+
+    @classmethod
+    def from_jax(cls, arr, ctx: Optional[Context] = None) -> "NDArray":
+        return cls(arr, ctx=ctx)
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- dtype / device movement -----------------------------------------
+    def astype(self, dtype, copy=True):
+        if np.dtype(dtype) == self.dtype and not copy:
+            return self
+        return invoke(get_op("cast"), [self], dtype=np.dtype(dtype).name)
+
+    def copy(self) -> "NDArray":
+        return self.copyto(self._ctx)
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, NDArray):
+            if other is self:
+                raise MXNetError("copyto: source and target are the same")
+            moved = _jax().device_put(self._data, other._ctx.device)
+            other._set_data(moved.astype(other.dtype))
+            return other
+        assert isinstance(other, Context)
+        return NDArray(_jax().device_put(self._data, other.device), ctx=other)
+
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    as_in_ctx = as_in_context
+
+    def detach(self) -> "NDArray":
+        # share the buffer slot (reference detach shares the chunk): a
+        # whole-array view, so later mutations of the base stay visible
+        return NDArray(None, _base=self, _index=())
+
+    # -- shape sugar ------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.pop("shape", shape)
+        return invoke(get_op("reshape"), [self], shape=tuple(shape), **kwargs)
+
+    def flatten(self):
+        return invoke(get_op("flatten"), [self])
+
+    def expand_dims(self, axis):
+        return invoke(get_op("expand_dims"), [self], axis=axis)
+
+    def squeeze(self, axis=None):
+        return invoke(get_op("squeeze"), [self], axis=axis)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke(get_op("transpose"), [self], axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return invoke(get_op("swapaxes"), [self], dim1=dim1, dim2=dim2)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke(get_op("split"), [self], num_outputs=num_outputs,
+                      axis=axis, squeeze_axis=squeeze_axis)
+
+    def slice_axis(self, axis, begin, end):
+        return invoke(get_op("slice_axis"), [self], axis=axis, begin=begin,
+                      end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke(get_op("take"), [self, _coerce(indices, self)],
+                      axis=axis, mode=mode)
+
+    def tile(self, reps):
+        return invoke(get_op("tile"), [self], reps=tuple(reps))
+
+    def broadcast_to(self, shape):
+        return invoke(get_op("broadcast_to"), [self], shape=tuple(shape))
+
+    def broadcast_like(self, other):
+        return invoke(get_op("broadcast_like"), [self, other])
+
+    # -- reductions sugar -------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return invoke(get_op("sum"), [self], axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke(get_op("mean"), [self], axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return invoke(get_op("max"), [self], axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return invoke(get_op("min"), [self], axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke(get_op("prod"), [self], axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return invoke(get_op("argmax"), [self], axis=axis)
+
+    def argmin(self, axis=None):
+        return invoke(get_op("argmin"), [self], axis=axis)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke(get_op("norm"), [self], ord=ord, axis=axis,
+                      keepdims=keepdims)
+
+    def abs(self):
+        return invoke(get_op("abs"), [self])
+
+    def sqrt(self):
+        return invoke(get_op("sqrt"), [self])
+
+    def square(self):
+        return invoke(get_op("square"), [self])
+
+    def exp(self):
+        return invoke(get_op("exp"), [self])
+
+    def log(self):
+        return invoke(get_op("log"), [self])
+
+    def clip(self, a_min, a_max):
+        return invoke(get_op("clip"), [self], a_min=a_min, a_max=a_max)
+
+    def sigmoid(self):
+        return invoke(get_op("sigmoid"), [self])
+
+    def tanh(self):
+        return invoke(get_op("tanh"), [self])
+
+    def relu(self):
+        return invoke(get_op("relu"), [self])
+
+    def softmax(self, axis=-1):
+        return invoke(get_op("softmax"), [self], axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke(get_op("dot"), [self, other], transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+    def zeros_like(self):
+        return invoke(get_op("zeros_like"), [self])
+
+    def ones_like(self):
+        return invoke(get_op("ones_like"), [self])
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return invoke(get_op("one_hot"), [self], depth=depth,
+                      on_value=on_value, off_value=off_value, dtype=dtype)
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer; marks this array as an autograd leaf.
+
+        Parity: ``NDArray.attach_grad`` / ``MXAutogradMarkVariables``.
+        """
+        from .. import autograd
+        self.grad_req = grad_req
+        self._grad = NDArray(_jnp().zeros(self.shape, self.dtype),
+                             ctx=self._ctx)
+        self._grad._buf = _jax().device_put(self._grad._buf,
+                                            self._ctx.device)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- indexing ---------------------------------------------------------
+    def _norm_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype("int32")
+        if isinstance(key, tuple):
+            return tuple(k._data.astype("int32") if isinstance(k, NDArray)
+                         else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._norm_key(key)
+        if isinstance(key, (int, np.integer, builtins.slice)) or (
+                isinstance(key, tuple)
+                and all(isinstance(k, (int, np.integer, builtins.slice))
+                        for k in key)):
+            # basic indexing → view sharing this buffer slot
+            return NDArray(None, _base=self, _index=key)
+        # advanced indexing → copy (same as reference)
+        out = self._data[key]
+        return NDArray(out, ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        from .. import autograd
+        if autograd.is_recording():
+            raise MXNetError(
+                "In-place assignment is not supported inside "
+                "autograd.record() — parity with reference semantics.")
+        key = self._norm_key(key)
+        jnp = _jnp()
+        if isinstance(value, NDArray):
+            val = value._data
+        elif isinstance(value, numeric_types):
+            self._set_data(self._data.at[key].set(
+                np.asarray(value).astype(self.dtype)))
+            return
+        else:
+            val = jnp.asarray(value, dtype=self.dtype)
+        self._set_data(self._data.at[key].set(val.astype(self.dtype)))
+
+    # -- arithmetic operators --------------------------------------------
+    def _binary(self, other, opname, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(get_op(opname), [a, b])
+        if isinstance(other, numeric_types):
+            return invoke(get_op(scalar_op), [self], scalar=other)
+        if isinstance(other, np.ndarray):
+            o = array(other, ctx=self._ctx, dtype=other.dtype)
+            a, b = (o, self) if reverse else (self, o)
+            return invoke(get_op(opname), [a, b])
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, numeric_types):
+            return invoke(get_op("_rminus_scalar"), [self], scalar=o)
+        return self._binary(o, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, numeric_types):
+            return invoke(get_op("_rdiv_scalar"), [self], scalar=o)
+        return self._binary(o, "broadcast_div", "_div_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        if isinstance(o, numeric_types):
+            return invoke(get_op("_rmod_scalar"), [self], scalar=o)
+        return self._binary(o, "broadcast_mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        if isinstance(o, numeric_types):
+            return invoke(get_op("_rpower_scalar"), [self], scalar=o)
+        return NotImplemented
+
+    def __neg__(self):
+        return invoke(get_op("negative"), [self])
+
+    def __abs__(self):
+        return invoke(get_op("abs"), [self])
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal",
+                            "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal",
+                            "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    def _inplace(self, other, opname, scalar_op):
+        from .. import autograd
+        if autograd.is_recording():
+            raise MXNetError("In-place operations are not supported when "
+                             "recording with autograd.")
+        res = self._binary(other, opname, scalar_op)
+        self._set_data(res._data.astype(self.dtype))
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, "broadcast_add", "_plus_scalar")
+
+    def __isub__(self, o):
+        return self._inplace(o, "broadcast_sub", "_minus_scalar")
+
+    def __imul__(self, o):
+        return self._inplace(o, "broadcast_mul", "_mul_scalar")
+
+    def __itruediv__(self, o):
+        return self._inplace(o, "broadcast_div", "_div_scalar")
+
+
+# ---------------------------------------------------------------------------
+# imperative invoke — the MXImperativeInvokeEx equivalent
+# ---------------------------------------------------------------------------
+
+
+def _coerce(x, like: NDArray) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    return array(np.asarray(x), ctx=like._ctx)
+
+
+def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
+           ctx: Optional[Context] = None, **kwargs):
+    """Execute op imperatively: the hot path (SURVEY.md §3.1).
+
+    Python → compile-cache lookup → PJRT async execute → NDArray handle(s)
+    returned immediately; sync happens at wait_to_read/asnumpy.
+    """
+    from .. import autograd
+
+    if inputs:
+        ctx = inputs[0]._ctx
+        arrays = [i._data for i in inputs]
+    else:
+        ctx = ctx or current_context()
+        arrays = []
+
+    # dynamic scalar attrs ride as 0-d input arrays (no recompile on change)
+    scalar_vals = []
+    if op.scalar_attrs:
+        ref = op.scalar_ref_input
+        ref_dtype = (inputs[ref].dtype if ref is not None and inputs
+                     else np.dtype("float32"))
+        sdt = ref_dtype if ref_dtype.name in _FLOAT_DTYPES \
+            else np.dtype("float32")
+        for sname in op.scalar_attrs:
+            if sname in kwargs:
+                v = kwargs.pop(sname)
+                if isinstance(v, NDArray):
+                    scalar_vals.append(v._data)
+                else:
+                    dt = sdt
+                    if isinstance(v, (int, np.integer)) and \
+                            not isinstance(v, (bool, np.bool_)) and \
+                            ref_dtype.kind in "iu":
+                        dt = ref_dtype
+                    scalar_vals.append(np.asarray(v, dtype=dt))
+
+    all_arrays = arrays + scalar_vals
+    jax = _jax()
+
+    if autograd.is_recording():
+        if out is not None:
+            raise MXNetError("`out` is not supported when recording "
+                             "with autograd.")
+        node, outputs_data = autograd._record_op(op, kwargs, all_arrays,
+                                                 inputs)
+        return _wrap_outputs(op, outputs_data, ctx, node)
+
+    if op.wrap_ctx or not inputs:
+        with jax.default_device(ctx.device):
+            outputs_data = engine.invoke_compiled(op.name, op.fcompute,
+                                                  kwargs, *all_arrays)
+    else:
+        outputs_data = engine.invoke_compiled(op.name, op.fcompute, kwargs,
+                                              *all_arrays)
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        data = outputs_data if isinstance(outputs_data, tuple) \
+            else (outputs_data,)
+        for o, d in zip(outs, data):
+            o._set_data(d.astype(o.dtype) if o.dtype != d.dtype else d)
+        return out
+    return _wrap_outputs(op, outputs_data, ctx, None)
+
+
+def _wrap_outputs(op: OpDef, outputs_data, ctx, node):
+    if isinstance(outputs_data, tuple) and op.num_outputs != 1:
+        outs = []
+        for i, d in enumerate(outputs_data):
+            o = NDArray(d, ctx=ctx)
+            if node is not None:
+                o._ag_node = node
+                o._ag_out_idx = i
+            outs.append(o)
+        if node is not None:
+            node.outputs = [o for o in outs]
+        return outs
+    o = NDArray(outputs_data, ctx=ctx)
+    if node is not None:
+        o._ag_node = node
+        o._ag_out_idx = 0
+        node.outputs = [o]
+    return o
+
+
+# ---------------------------------------------------------------------------
+# creation / io
+# ---------------------------------------------------------------------------
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from array-like (parity: mx.nd.array)."""
+    ctx = ctx or current_context()
+    was_ndarray = isinstance(source, (np.ndarray, NDArray))
+    if isinstance(source, NDArray):
+        src = source.asnumpy()
+    else:
+        src = np.asarray(source)
+    if dtype is None:
+        if not was_ndarray:
+            # python lists/scalars default to float32 (MXNet rule)
+            dtype = "float32"
+        elif src.dtype == np.float64:
+            dtype = "float32"
+        else:
+            dtype = src.dtype
+    arr = _jax().device_put(np.asarray(src, dtype=dtype), ctx.device)
+    return NDArray(arr, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape)
+    return invoke(get_op("_zeros"), [], ctx=ctx, shape=shape,
+                  dtype=np.dtype(dtype).name)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape)
+    return invoke(get_op("_ones"), [], ctx=ctx, shape=shape,
+                  dtype=np.dtype(dtype).name)
+
+
+def full(shape, val, ctx=None, dtype="float32") -> NDArray:
+    shape = (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape)
+    return invoke(get_op("_full"), [], ctx=ctx, shape=shape, value=float(val),
+                  dtype=np.dtype(dtype).name)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None,
+           dtype="float32") -> NDArray:
+    return invoke(get_op("_arange"), [], ctx=ctx, start=start, stop=stop,
+                  step=step, repeat=repeat, dtype=np.dtype(dtype).name)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32") -> NDArray:
+    return invoke(get_op("_eye"), [], ctx=ctx, N=N, M=M, k=k,
+                  dtype=np.dtype(dtype).name)
+
+
+def moveaxis(data, source, destination):
+    axes = list(range(data.ndim))
+    axes.remove(source % data.ndim)
+    axes.insert(destination % data.ndim, source % data.ndim)
+    return data.transpose(tuple(axes))
+
+
+def concatenate(arrays, axis=0):
+    return invoke(get_op("concat"), list(arrays), dim=axis)
+
+
+def waitall():
+    engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# serialization — parity with mx.nd.save/load (reference ndarray.cc
+# Save/Load, dmlc::Stream).  Binary layout: magic, count, names, then per
+# array: dtype/shape header + raw bytes (little-endian), so files round-trip
+# across sessions without pickle.
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"MXTPU001"
+
+
+def save(fname: str, data):
+    if isinstance(data, NDArray):
+        pairs = [("", data)]
+    elif isinstance(data, dict):
+        pairs = list(data.items())
+    else:
+        pairs = [("", d) for d in data]
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<q", len(pairs)))
+        for name, arr in pairs:
+            a = arr.asnumpy()
+            nb = name.encode()
+            hdr = json.dumps({"dtype": a.dtype.name,
+                              "shape": list(a.shape)}).encode()
+            f.write(struct.pack("<q", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<q", len(hdr)))
+            f.write(hdr)
+            raw = np.ascontiguousarray(a).tobytes()
+            f.write(struct.pack("<q", len(raw)))
+            f.write(raw)
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not an NDArray file")
+        n = struct.unpack("<q", f.read(8))[0]
+        named = {}
+        unnamed = []
+        any_named = False
+        for _ in range(n):
+            ln = struct.unpack("<q", f.read(8))[0]
+            name = f.read(ln).decode()
+            lh = struct.unpack("<q", f.read(8))[0]
+            hdr = json.loads(f.read(lh).decode())
+            lr = struct.unpack("<q", f.read(8))[0]
+            raw = f.read(lr)
+            a = np.frombuffer(raw, dtype=hdr["dtype"]).reshape(hdr["shape"])
+            nd = array(a, dtype=a.dtype)
+            if name:
+                any_named = True
+                named[name] = nd
+            else:
+                unnamed.append(nd)
+    return named if any_named else unnamed

@@ -1,0 +1,9 @@
+"""``mx.nd.random`` namespace (parity: python/mxnet/ndarray/random.py).
+
+Same entry points as ``mx.random``, re-exported under nd.
+"""
+from ..random import (uniform, normal, randn, randint, exponential, gamma,
+                      poisson, multinomial, shuffle, bernoulli)
+
+__all__ = ["uniform", "normal", "randn", "randint", "exponential", "gamma",
+           "poisson", "multinomial", "shuffle", "bernoulli"]

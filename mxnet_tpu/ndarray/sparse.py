@@ -1,0 +1,100 @@
+"""Sparse NDArray facade (parity: python/mxnet/ndarray/sparse.py).
+
+Capability note (SURVEY.md §7 P6): the reference supports ``row_sparse`` and
+``csr`` storage types end-to-end.  TPU/XLA has no sparse buffer type, so this
+facade keeps the *API* (stype metadata, ``tostype``, ``row_sparse_array``,
+``csr_matrix``) over dense device buffers with an explicit documented perf
+caveat — numerics are identical, memory is dense.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "zeros"]
+
+
+class _SparseFacade(NDArray):
+    __slots__ = ()
+    _stype = "default"
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, ctx=self._ctx)
+        return _make(stype, self._data, self._ctx)
+
+
+class CSRNDArray(_SparseFacade):
+    __slots__ = ()
+    _stype = "csr"
+
+    @property
+    def indices(self):
+        a = self.asnumpy()
+        return array(np.nonzero(a)[1].astype("int64"), ctx=self._ctx,
+                     dtype="int64")
+
+    @property
+    def data(self):
+        a = self.asnumpy()
+        return array(a[a != 0], ctx=self._ctx)
+
+
+class RowSparseNDArray(_SparseFacade):
+    __slots__ = ()
+    _stype = "row_sparse"
+
+    @property
+    def indices(self):
+        a = self.asnumpy()
+        nz = np.nonzero(np.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
+        return array(nz.astype("int64"), ctx=self._ctx, dtype="int64")
+
+
+def _make(stype, data, ctx):
+    cls = {"csr": CSRNDArray, "row_sparse": RowSparseNDArray}[stype]
+    out = cls(data, ctx=ctx)
+    return out
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype="float32"):
+    if isinstance(arg1, (list, np.ndarray, NDArray)):
+        base = array(arg1, ctx=ctx, dtype=dtype)
+        return _make("csr", base._data, base._ctx)
+    data, indices, indptr = arg1
+    dense = np.zeros(shape, dtype=dtype)
+    indptr = np.asarray(indptr, dtype="int64")
+    indices = np.asarray(indices, dtype="int64")
+    vals = np.asarray(data, dtype=dtype)
+    for row in range(shape[0]):
+        for j in range(indptr[row], indptr[row + 1]):
+            dense[row, indices[j]] = vals[j]
+    base = array(dense, ctx=ctx, dtype=dtype)
+    return _make("csr", base._data, base._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
+    if isinstance(arg1, (list, np.ndarray, NDArray)) and shape is None:
+        base = array(arg1, ctx=ctx, dtype=dtype)
+        return _make("row_sparse", base._data, base._ctx)
+    data, indices = arg1
+    dense = np.zeros(shape, dtype=dtype)
+    data = np.asarray(data, dtype=dtype)
+    for k, row in enumerate(np.asarray(indices, dtype="int64")):
+        dense[row] = data[k]
+    base = array(dense, ctx=ctx, dtype=dtype)
+    return _make("row_sparse", base._data, base._ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    from .ndarray import zeros as _dense_zeros
+    base = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "default":
+        return base
+    return _make(stype, base._data, base._ctx)

@@ -1,0 +1,11 @@
+"""Operator registry package (nnvm-registry equivalent, SURVEY.md §2.2).
+
+Importing this package registers every operator.  New operator modules must
+be imported here to appear in the ``mx.nd`` / ``mx.sym`` namespaces.
+"""
+from . import registry
+from .registry import register, get_op, list_ops, alias
+from . import tensor  # noqa: F401  (registers tensor ops)
+from . import nn      # noqa: F401  (registers NN ops)
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,434 @@
+"""Neural-network operators.
+
+Capability parity: reference ``src/operator/nn/`` (convolution, pooling,
+fully_connected, activation, batch_norm, layer_norm, dropout, softmax,
+deconvolution, ...) — SURVEY.md §2.2.  The reference keeps a generic mshadow
+implementation plus cuDNN/oneDNN fast paths per op; here each op is one pure
+JAX function and XLA supplies the fast path (MXU matmuls/convs, fused
+elementwise).  Layout is MXNet's NCHW/OIHW API-side; XLA is free to relayout
+internally for the MXU.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# fully connected / dense — reference fully_connected.cc
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", num_inputs=None)
+def fully_connected(data, weight, *rest, num_hidden=0, no_bias=False,
+                    flatten=True):
+    """y = x @ W.T + b.  weight shape (num_hidden, in_units)."""
+    if flatten and data.ndim > 2:
+        data = jnp.reshape(data, (data.shape[0], -1))
+    out = jnp.matmul(data, weight.T)
+    if not no_bias:
+        out = out + rest[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# activations — reference activation.cc, leaky_relu.cc
+# ---------------------------------------------------------------------------
+
+
+@register("Activation")
+def activation(data, *, act_type="relu"):
+    fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+           "softsign": jax.nn.soft_sign, "log_sigmoid": jax.nn.log_sigmoid,
+           "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x))}
+    return fns[act_type](data)
+
+
+@register("LeakyReLU", num_inputs=None)
+def leaky_relu(data, *rest, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data > 0, data, a * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "prelu":
+        gamma = rest[0]
+        g = jnp.reshape(gamma, (1, -1) + (1,) * (data.ndim - 2)) \
+            if data.ndim > 1 and gamma.size > 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "rrelu":
+        # eval-mode rrelu uses the mean slope (train-mode randomness is
+        # handled by the Dropout-style keyed variant upstream in gluon)
+        slope_m = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, slope_m * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("gelu_tanh")
+def gelu_tanh(data):
+    return jax.nn.gelu(data, approximate=True)
+
+
+@register("silu")
+def silu(data):
+    return jax.nn.silu(data)
+
+
+# ---------------------------------------------------------------------------
+# softmax family — reference softmax.cc, softmax_output.cc
+# ---------------------------------------------------------------------------
+
+
+@register("softmax", num_inputs=None)
+def softmax(data, *rest, axis=-1, temperature=None, use_length=False):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    if use_length and rest:
+        length = rest[0].astype("int32")
+        steps = jnp.arange(data.shape[axis])
+        shape = [1] * data.ndim
+        shape[axis] = data.shape[axis]
+        mask = jnp.reshape(steps, shape) < jnp.expand_dims(length, axis)
+        data = jnp.where(mask, data, -jnp.inf)
+        out = jax.nn.softmax(data, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, *, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmin")
+def softmin(data, *, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1) \
+        .reshape(data.shape)
+
+
+@register("SoftmaxOutput", num_inputs=2)
+def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                   use_ignore=False, multi_output=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    """Legacy fused softmax+CE-grad op: forward emits softmax probabilities.
+
+    The custom gradient (prob - one_hot(label), the reference's backward) is
+    wired by the frontend via a custom-vjp wrapper in gluon/loss paths; the
+    imperative forward here matches the reference's forward contract.
+    """
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+@register("softmax_cross_entropy", num_inputs=2)
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lbl = label.astype("int32")
+    picked = jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# ---------------------------------------------------------------------------
+# convolution — reference convolution.cc / deconvolution.cc
+# ---------------------------------------------------------------------------
+
+
+def _conv_dims(nd_spatial: int):
+    if nd_spatial == 1:
+        return ("NCH", "OIH", "NCH")
+    if nd_spatial == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution", num_inputs=None)
+def convolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                layout=None, workspace=0, cudnn_tune=None,
+                cudnn_off=False):
+    k = len(kernel)
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(k))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
+    if not no_bias:
+        bias = rest[0]
+        out = out + jnp.reshape(bias, (1, -1) + (1,) * k)
+    return out
+
+
+@register("Deconvolution", num_inputs=None)
+def deconvolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), num_filter=0, num_group=1, no_bias=True,
+                  layout=None, target_shape=(), workspace=0,
+                  cudnn_tune=None, cudnn_off=False):
+    k = len(kernel)
+    stride = tuple(stride) if stride else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    # transposed conv == gradient of conv w.r.t. input
+    if num_group != 1:
+        raise NotImplementedError("grouped Deconvolution")
+    # weight layout in MXNet deconv: (C_in, num_filter, *kernel)
+    out = lax.conv_transpose(
+        data, jnp.swapaxes(weight, 0, 1),
+        strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dims(k), transpose_kernel=True)
+    if not no_bias and rest:
+        out = out + jnp.reshape(rest[0], (1, -1) + (1,) * k)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pooling — reference pooling.cc
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling")
+def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
+            stride=(), pad=(), pooling_convention="valid",
+            count_include_pad=True, cudnn_off=False, layout=None):
+    nd_sp = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    k = tuple(kernel)
+    stride = tuple(stride) if stride else (1,) * nd_sp
+    pad = tuple(pad) if pad else (0,) * nd_sp
+    window = (1, 1) + k
+    strides = (1, 1) + stride
+    sp_pads = [(p, p) for p in pad]
+    if pooling_convention == "full":
+        # ceil-based output size: widen right padding so the last window fits
+        for i in range(nd_sp):
+            x = data.shape[2 + i]
+            out_full = -(-(x + 2 * pad[i] - k[i]) // stride[i]) + 1
+            need = (out_full - 1) * stride[i] + k[i] - (x + 2 * pad[i])
+            if need > 0:
+                lo, hi = sp_pads[i]
+                sp_pads[i] = (lo, hi + need)
+    elif pooling_convention == "same":
+        for i in range(nd_sp):
+            x = data.shape[2 + i]
+            out_same = -(-x // stride[i])
+            need = max((out_same - 1) * stride[i] + k[i] - x, 0)
+            sp_pads[i] = (need // 2, need - need // 2)
+    pads = ((0, 0), (0, 0)) + tuple(sp_pads)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / float(jnp.prod(jnp.asarray(k)))
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(data) ** 2, 0.0, lax.add, window,
+                              strides, pads)
+        return jnp.sqrt(s)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# normalization — reference batch_norm.cc, layer_norm.cc, l2_normalization.cc
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", num_inputs=5, num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               training=False):
+    """Returns (out, batch_mean, batch_var).
+
+    Aux-state (moving mean/var) mutation is done by the caller (gluon layer /
+    nd wrapper) exactly like the reference's aux-array update; the op itself
+    stays pure.  `training` is threaded in by the frontend from
+    autograd.is_training().
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+    else:
+        mean, var = moving_mean, moving_var
+    out = (data - mean.reshape(bshape)) * lax.rsqrt(
+        var.reshape(bshape) + eps) * g.reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_inputs=3)
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("RMSNorm", num_inputs=2)
+def rms_norm(data, gamma, *, axis=-1, eps=1e-6):
+    """TPU-era extension (no reference ancestor; needed for Llama-family)."""
+    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    return data * lax.rsqrt(ms + eps) * gamma
+
+
+@register("InstanceNorm", num_inputs=3)
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / nrm
+
+
+# ---------------------------------------------------------------------------
+# dropout — reference dropout.cc; RNG key threaded by the frontend
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", num_inputs=2)
+def dropout(data, key, *, p=0.5, mode="training", axes=(), training=False):
+    if not training or p <= 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = jax.random.bernoulli(
+        jax.random.wrap_key_data(key), 1.0 - p, shape)
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros((), data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# embedding-adjacent / misc nn
+# ---------------------------------------------------------------------------
+
+
+@register("UpSampling", num_inputs=None)
+def upsampling(data, *rest, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=0):
+    if sample_type != "nearest":
+        raise NotImplementedError("bilinear UpSampling lands with the "
+                                  "vision-ops milestone")
+    out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return out
+
+
+@register("BilinearResize2D")
+def bilinear_resize_2d(data, *, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    th = height if height else int(h * scale_height)
+    tw = width if width else int(w * scale_width)
+    return jax.image.resize(data, (n, c, th, tw), method="linear")
+
+
+@register("RNN", num_inputs=None, num_outputs=-1)
+def rnn_fused(data, params, state, *rest, state_size=0, num_layers=1,
+              mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+              projection_size=None, use_sequence_length=False,
+              lstm_state_clip_min=None, lstm_state_clip_max=None,
+              lstm_state_clip_nan=False):
+    """Fused multi-layer RNN (reference src/operator/rnn.cc).
+
+    Implemented as lax.scan over time with per-layer cells; weights arrive
+    packed in `params` using the reference's packed layout.  See
+    mxnet_tpu/gluon/rnn for the layer that packs/unpacks.
+    """
+    raise NotImplementedError("fused RNN op is provided via gluon.rnn "
+                              "layers (scan-based); direct nd.RNN lands "
+                              "with the RNN milestone")
+
+
+@register("BlockGrad")
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+alias("stop_gradient", "BlockGrad")
+
+
+@register("MakeLoss")
+def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null"):
+    return data
+
+
+@register("identity")
+def identity(data):
+    return data
+
+
+@register("amp_cast")
+def amp_cast(data, *, dtype="float16"):
+    return data.astype(dtype)
+
+
+@register("amp_multicast", num_inputs=None, num_outputs=-1)
+def amp_multicast(*data, num_outputs=1, cast_narrow=False):
+    dtypes = [d.dtype for d in data]
+    widest = jnp.result_type(*dtypes) if not cast_narrow else \
+        sorted(dtypes, key=lambda d: jnp.dtype(d).itemsize)[0]
+    return tuple(d.astype(widest) for d in data)
+
+
+@register("all_finite", num_inputs=None)
+def all_finite(*arrays, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.astype("float32")
+
+
+alias("multi_all_finite", "all_finite")

@@ -1,0 +1,210 @@
+"""Optimizer update operators.
+
+Capability parity: reference ``src/operator/optimizer_op*`` (SGD/momentum,
+NAG, Adam, RMSProp, FTRL, Signum, LAMB, multi-precision ``mp_*`` variants,
+fused multi-tensor updates) — SURVEY.md §2.2.  As in the reference, the
+optimizer math executes as device-side ops — the Python optimizer classes
+only pick ops and schedule hyper-parameters.  Learning rate and weight decay
+ride as dynamic 0-d arrays (no recompilation when a scheduler changes them).
+
+All ops are pure: they RETURN the updated tensors; the frontend writes them
+back via ``out=`` (buffer swap), which is the TPU-native equivalent of the
+reference's in-place kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd=None, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd is not None:
+        g = g + wd * weight
+    return g
+
+
+@register("sgd_update", num_inputs=2, scalar_attrs=("lr", "wd"))
+def sgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def nag_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("mp_sgd_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd, *, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad.astype("float32"), rescale_grad, clip_gradient,
+                   wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4, scalar_attrs=("lr", "wd"),
+          num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, *, momentum=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep_grad(grad.astype("float32"), rescale_grad, clip_gradient,
+                   wd, weight32)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("adam_update", num_inputs=4, scalar_attrs=("lr", "wd"),
+          num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, wd, *, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w, new_mean, new_var
+
+
+@register("adamw_update", num_inputs=4,
+          scalar_attrs=("lr", "eta", "wd"), num_outputs=3)
+def adamw_update(weight, grad, mean, var, lr, eta, wd, *, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                        + wd * weight)
+    return w, new_mean, new_var
+
+
+@register("rmsprop_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, wd, *, gamma1=0.95, epsilon=1e-8,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1.0 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update", num_inputs=5, scalar_attrs=("lr", "wd"),
+          num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_acc, delta, lr, wd, *, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = gamma1 * n + (1.0 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_acc + (1.0 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_inputs=4, scalar_attrs=("lr", "wd"),
+          num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr, wd, *, lamda1=0.01, beta=1.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register("signsgd_update", num_inputs=2, scalar_attrs=("lr", "wd"))
+def signsgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * jnp.sign(g)
+
+
+@register("signum_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def signum_update(weight, grad, mom, lr, wd, *, momentum=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - (1.0 - momentum) * g
+    w = weight + lr * jnp.sign(new_mom)
+    if wd_lh > 0:
+        w = w - lr * wd_lh * weight
+    return w, new_mom
+
+
+@register("adagrad_update", num_inputs=3, scalar_attrs=("lr", "wd"),
+          num_outputs=2)
+def adagrad_update(weight, grad, history, lr, wd, *, epsilon=1e-7,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_h = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+@register("adadelta_update", num_inputs=4, scalar_attrs=("wd",),
+          num_outputs=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, wd, *, rho=0.9,
+                    epsilon=1e-5, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_acc_g = rho * acc_g + (1.0 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1.0 - rho) * jnp.square(delta)
+    return weight - delta, new_acc_g, new_acc_delta
+
+
+@register("lamb_update_phase1", num_inputs=4,
+          scalar_attrs=("wd",), num_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, wd, *, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1.0 - beta1 ** t)
+        v = v / (1.0 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2", num_inputs=4, scalar_attrs=("lr",))
+def lamb_update_phase2(weight, g_update, r1, r2, lr, *,
+                       lower_bound=-1.0, upper_bound=-1.0):
+    r1c = jnp.where(r1 == 0.0, jnp.ones_like(r1), r1)
+    r2c = jnp.where(r2 == 0.0, jnp.ones_like(r2), r2)
+    trust = jnp.where((r1 > 0.0) & (r2 > 0.0), r1c / r2c,
+                      jnp.ones_like(r1))
+    if lower_bound > 0:
+        trust = jnp.maximum(trust, lower_bound)
+    if upper_bound > 0:
+        trust = jnp.minimum(trust, upper_bound)
+    return weight - lr * trust * g_update

@@ -1,0 +1,95 @@
+"""Random sampling operators.
+
+Capability parity: reference ``src/operator/random/`` (sample_op uniform /
+normal / gamma / exponential / poisson / negative_binomial / multinomial,
+shuffle) + the counter-based parallel PRNG in
+``include/mxnet/random_generator.h`` — SURVEY.md §2.2.
+
+TPU-native design: JAX threefry keys ARE the counter-based parallel RNG the
+reference hand-built.  Every sampling op takes an explicit key array as its
+first input; the frontend (``mxnet_tpu.random``) owns a per-context key that
+``mx.random.seed`` resets — reproducing the reference's per-device seeded
+generators with pure functions underneath.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _k(key):
+    return jax.random.wrap_key_data(key)
+
+
+@register("_random_uniform", num_inputs=1, scalar_attrs=("low", "high"),
+          scalar_ref_input=None)
+def _random_uniform(key, low, high, *, shape=(), dtype="float32"):
+    return jax.random.uniform(_k(key), shape, dtype=dtype,
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", num_inputs=1, scalar_attrs=("loc", "scale"),
+          scalar_ref_input=None)
+def _random_normal(key, loc, scale, *, shape=(), dtype="float32"):
+    return jax.random.normal(_k(key), shape, dtype=dtype) * scale + loc
+
+
+@register("_random_gamma", num_inputs=1, scalar_attrs=("alpha", "beta"),
+          scalar_ref_input=None)
+def _random_gamma(key, alpha, beta, *, shape=(), dtype="float32"):
+    return jax.random.gamma(_k(key), alpha, shape, dtype=dtype) * beta
+
+
+@register("_random_exponential", num_inputs=1, scalar_attrs=("lam",), scalar_ref_input=None)
+def _random_exponential(key, lam, *, shape=(), dtype="float32"):
+    return jax.random.exponential(_k(key), shape, dtype=dtype) / lam
+
+
+@register("_random_poisson", num_inputs=1, scalar_attrs=("lam",), scalar_ref_input=None)
+def _random_poisson(key, lam, *, shape=(), dtype="float32"):
+    return jax.random.poisson(_k(key), lam, shape).astype(dtype)
+
+
+@register("_random_randint", num_inputs=1)
+def _random_randint(key, *, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(_k(key), shape, low, high, dtype=dtype)
+
+
+@register("_random_bernoulli", num_inputs=1, scalar_attrs=("prob",), scalar_ref_input=None)
+def _random_bernoulli(key, prob, *, shape=(), dtype="float32"):
+    return jax.random.bernoulli(_k(key), prob, shape).astype(dtype)
+
+
+@register("_sample_multinomial", num_inputs=2)
+def _sample_multinomial(key, data, *, shape=(), get_prob=False,
+                        dtype="int32"):
+    """Categorical sampling over the trailing axis of `data` (probs)."""
+    logits = jnp.log(jnp.clip(data, 1e-30, None))
+    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    sample_shape = tuple(shape) if shape else ()
+    if data.ndim == 1:
+        out = jax.random.categorical(_k(key), logits, shape=sample_shape)
+    else:
+        out = jax.random.categorical(_k(key), logits,
+                                     shape=sample_shape + data.shape[:-1],
+                                     axis=-1)
+        if sample_shape:
+            out = jnp.moveaxis(out, 0, -1)
+    return out.astype(dtype)
+
+
+@register("_shuffle", num_inputs=2)
+def _shuffle(key, data):
+    return jax.random.permutation(_k(key), data, axis=0)
+
+
+@register("_sample_unique_zipfian", num_inputs=1)
+def _sample_unique_zipfian(key, *, range_max=1, shape=()):
+    # approximate: log-uniform sampling without dedup guarantee
+    u = jax.random.uniform(_k(key), shape)
+    out = jnp.exp(u * jnp.log(float(range_max))).astype("int64") - 1
+    return jnp.clip(out, 0, range_max - 1)

@@ -1,0 +1,102 @@
+"""Operator registry: the nnvm op-registry equivalent.
+
+Capability parity: reference nnvm ``Op`` registry + ``NNVM_REGISTER_OP``
+attrs (``FCompute``/``FGradient``/``FInferShape``...) — SURVEY.md §2.1/§2.2.
+TPU-native design: an op is a *pure JAX function* ``fcompute(*arrays,
+**attrs)``.  Shape/dtype inference falls out of ``jax.eval_shape`` (symbolic
+mode) or eager dispatch (imperative mode); gradients fall out of ``jax.vjp``;
+kernel selection/fusion belongs to XLA.  Hand-written attrs the reference
+needed per-op (inplace options, resource requests, storage type dispatch)
+have no TPU analog and are deliberately absent.
+
+Every op registered here is exposed in BOTH ``mx.nd.*`` and ``mx.sym.*``
+namespaces (generated in ``mxnet_tpu.ndarray`` / ``mxnet_tpu.symbol``), the
+way the reference codegens ``gen_op`` stubs from the C registry.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+
+
+class OpDef:
+    """One operator.
+
+    Attributes:
+      name: canonical op name (MXNet spelling, e.g. ``broadcast_add``).
+      fcompute: pure function ``(*jax_arrays, **attrs) -> array | tuple``.
+      num_inputs: fixed arity or None for variadic (e.g. ``concat``).
+      num_outputs: number of outputs (>=2 means fcompute returns a tuple).
+      scalar_attrs: names of attrs that hold *dynamic* numeric values; the
+        frontend passes them as 0-d device arrays appended to inputs so that
+        changing them (e.g. learning rate) does NOT recompile.  fcompute
+        receives them as trailing positional arrays.
+      scalar_ref_input: index of the tensor input whose dtype anchors
+        integer scalar attrs (e.g. `int_array + 1` stays int); None means
+        "no tensor input is a dtype anchor" (RNG ops, whose first input is
+        the uint32 key) — scalars are then float32.
+      wrap_ctx: init-style op with no tensor inputs (zeros/ones/...);
+        frontend must supply ctx/dtype.
+    """
+
+    __slots__ = ("name", "fcompute", "num_inputs", "num_outputs",
+                 "scalar_attrs", "wrap_ctx", "doc", "attr_names",
+                 "scalar_ref_input")
+
+    def __init__(self, name: str, fcompute: Callable,
+                 num_inputs: Optional[int], num_outputs: int,
+                 scalar_attrs: Sequence[str], wrap_ctx: bool,
+                 scalar_ref_input: Optional[int] = 0):
+        self.name = name
+        self.fcompute = fcompute
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.scalar_attrs = tuple(scalar_attrs)
+        self.scalar_ref_input = scalar_ref_input
+        self.wrap_ctx = wrap_ctx
+        self.doc = fcompute.__doc__ or ""
+        try:
+            sig = inspect.signature(fcompute)
+            self.attr_names = tuple(
+                p.name for p in sig.parameters.values()
+                if p.kind == p.KEYWORD_ONLY)
+        except (TypeError, ValueError):
+            self.attr_names = ()
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_ALIASES: Dict[str, str] = {}
+
+
+def register(name: str, num_inputs: Optional[int] = 1, num_outputs: int = 1,
+             scalar_attrs: Sequence[str] = (), wrap_ctx: bool = False,
+             scalar_ref_input: Optional[int] = 0):
+    """Decorator: register ``fcompute`` as operator ``name``."""
+
+    def deco(fn: Callable) -> Callable:
+        if name in _REGISTRY:
+            raise ValueError(f"op {name!r} registered twice")
+        _REGISTRY[name] = OpDef(name, fn, num_inputs, num_outputs,
+                                scalar_attrs, wrap_ctx, scalar_ref_input)
+        return fn
+
+    return deco
+
+
+def alias(new_name: str, existing: str):
+    """Register a second public name for an existing op (e.g. relu)."""
+    _ALIASES[new_name] = existing
+
+
+def get_op(name: str) -> OpDef:
+    name = _ALIASES.get(name, name)
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(set(_REGISTRY) | set(_ALIASES))

@@ -1,0 +1,138 @@
+"""Global RNG state: ``mx.random.seed`` and sampling entry points.
+
+Capability parity: reference ``python/mxnet/random.py`` + the per-device
+parallel PRNG (``include/mxnet/random_generator.h``).  A threefry key is
+kept per context; each sampling call splits it — the functional analog of
+the reference's per-device counter-based generators, with identical
+user-visible semantics (``mx.random.seed(s)`` makes runs reproducible,
+optionally per-context via ``ctx=``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .context import Context, current_context
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
+           "gamma", "poisson", "multinomial", "shuffle", "bernoulli"]
+
+_keys = {}
+_DEFAULT_SEED = 0
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def seed(seed_state: int, ctx: Optional[Context] = None):
+    """Reset the RNG. ``ctx=None`` reseeds every context (parity: 'all')."""
+    global _keys
+    if ctx is None or ctx == "all":
+        _keys = {"__seed__": int(seed_state)}
+    else:
+        _keys[Context(ctx.device_type, ctx.device_id)] = \
+            _jax().random.key(int(seed_state))
+
+
+def _next_key(ctx: Context):
+    jax = _jax()
+    base_seed = _keys.get("__seed__", _DEFAULT_SEED)
+    k = _keys.get(ctx)
+    if k is None:
+        # derive per-context stream: fold device id into the seed
+        k = jax.random.fold_in(jax.random.key(base_seed),
+                               ctx.device_id + 997 * ctx.device_typeid)
+    k, sub = jax.random.split(k)
+    _keys[ctx] = k
+    return sub
+
+
+def _next_key_nd(ctx: Context):
+    """Key as a raw-data NDArray on ctx (ops re-wrap via wrap_key_data)."""
+    from .ndarray.ndarray import NDArray
+    jax = _jax()
+    sub = _next_key(ctx)
+    raw = jax.random.key_data(sub)
+    return NDArray(jax.device_put(raw, ctx.device), ctx=ctx)
+
+
+def _sample(opname, ctx, out, shape, dtype, extra_inputs=(), **attrs):
+    from .ndarray.ndarray import invoke
+    from .ops.registry import get_op
+    if out is not None:
+        ctx = out.context
+        shape = shape if shape is not None else out.shape
+        dtype = dtype or out.dtype.name
+    ctx = ctx or current_context()
+    shape = () if shape is None else (
+        (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape))
+    key = _next_key_nd(ctx)
+    return invoke(get_op(opname), [key, *extra_inputs], out=out,
+                  shape=shape, dtype=np.dtype(dtype or "float32").name,
+                  **attrs)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+            out=None):
+    return _sample("_random_uniform", ctx, out, shape, dtype,
+                   low=low, high=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+           out=None):
+    return _sample("_random_normal", ctx, out, shape, dtype,
+                   loc=loc, scale=scale)
+
+
+def randn(*shape, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    from .ndarray.ndarray import invoke
+    from .ops.registry import get_op
+    ctx = (out.context if out is not None else ctx) or current_context()
+    shp = () if shape is None else (
+        (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape))
+    key = _next_key_nd(ctx)
+    return invoke(get_op("_random_randint"), [key], out=out, low=int(low),
+                  high=int(high), shape=shp, dtype=np.dtype(dtype).name)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return _sample("_random_exponential", ctx, out, shape, dtype,
+                   lam=1.0 / scale)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+          out=None):
+    return _sample("_random_gamma", ctx, out, shape, dtype,
+                   alpha=alpha, beta=beta)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return _sample("_random_poisson", ctx, out, shape, dtype, lam=lam)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, out=None):
+    return _sample("_random_bernoulli", ctx, out, shape, dtype, prob=prob)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    from .ndarray.ndarray import invoke
+    from .ops.registry import get_op
+    ctx = data.context
+    key = _next_key_nd(ctx)
+    shp = (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape)
+    return invoke(get_op("_sample_multinomial"), [key, data], shape=shp,
+                  get_prob=get_prob, dtype=np.dtype(dtype).name)
+
+
+def shuffle(data, out=None):
+    from .ndarray.ndarray import invoke
+    from .ops.registry import get_op
+    key = _next_key_nd(data.context)
+    return invoke(get_op("_shuffle"), [key, data], out=out)

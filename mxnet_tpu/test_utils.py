@@ -1,0 +1,144 @@
+"""Test utilities (parity: python/mxnet/test_utils.py — SURVEY.md §2.5).
+
+Load-bearing for the whole suite, as in the reference: tolerance tables,
+``assert_almost_equal``, ``check_numeric_gradient`` (finite differences, the
+universal backward oracle), ``check_consistency`` (same op on two contexts),
+``default_context``, random array helpers.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "rand_ndarray", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency", "same"]
+
+_default = [None]
+
+# per-dtype (rtol, atol), mirroring the reference's tolerance table
+_TOLS = {
+    np.dtype("float16"): (1e-2, 1e-2),
+    np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.dtype("float16"):
+        (1e-2, 1e-2),
+    np.dtype("float32"): (1e-4, 1e-5),
+    np.dtype("float64"): (1e-6, 1e-7),
+}
+
+
+def default_context() -> Context:
+    return _default[0] if _default[0] is not None else current_context()
+
+
+def set_default_context(ctx: Context):
+    _default[0] = ctx
+
+
+def _tol(*dtypes):
+    rtol, atol = 0.0, 0.0
+    for d in dtypes:
+        r, a = _TOLS.get(np.dtype(d), (1e-4, 1e-5))
+        rtol, atol = max(rtol, r), max(atol, a)
+    return rtol, atol
+
+
+def _np(x):
+    from .ndarray.ndarray import NDArray
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def same(a, b):
+    return np.array_equal(_np(a), _np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _np(a), _np(b)
+    r, t = _tol(a.dtype, b.dtype)
+    return np.allclose(a, b, rtol=rtol or r, atol=atol or t)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _np(a).astype("f8"), _np(b).astype("f8")
+    r, t = _tol(_np(a).dtype, _np(b).dtype)
+    np.testing.assert_allclose(a_np, b_np, rtol=rtol if rtol is not None
+                               else r, atol=atol if atol is not None else t,
+                               err_msg=f"{names[0]} != {names[1]}")
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, ctx=None, dtype="float32", scale=1.0):
+    from .ndarray.ndarray import array
+    data = (np.random.uniform(-scale, scale, size=shape)).astype(dtype)
+    return array(data, ctx=ctx or default_context(), dtype=dtype)
+
+
+def check_numeric_gradient(f: Callable, inputs, grads=None, eps=1e-3,
+                           rtol=1e-2, atol=1e-3):
+    """Finite-difference check: f takes/returns NDArrays; scalar output.
+
+    Compares autograd gradients of ``sum(f(*inputs))`` against central
+    differences — the reference's universal backward oracle.
+    """
+    from . import autograd
+    from .ndarray.ndarray import array
+    from .ndarray import sum as nd_sum
+
+    inputs = list(inputs)
+    for x in inputs:
+        if x._grad is None:
+            x.attach_grad()
+    with autograd.record():
+        out = f(*inputs)
+        loss = nd_sum(out)
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        x_np = x.asnumpy().astype("f8")
+        num = np.zeros_like(x_np)
+        flat = x_np.reshape(-1)
+        num_flat = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            x[:] = array(x_np.astype(x.dtype.name))
+            fp = nd_sum(f(*inputs)).asscalar()
+            flat[i] = orig - eps
+            x[:] = array(x_np.astype(x.dtype.name))
+            fm = nd_sum(f(*inputs)).asscalar()
+            flat[i] = orig
+            x[:] = array(x_np.astype(x.dtype.name))
+            num_flat[i] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic[xi], num, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch on input {xi}")
+
+
+def check_consistency(f: Callable, inputs_np, ctx_list=None, rtol=None,
+                      atol=None):
+    """Run ``f`` on each context and require identical outputs.
+
+    Parity: the reference's ``check_consistency`` (CPU vs GPU vs fp16);
+    here: cpu vs tpu (or any ctx list).
+    """
+    from .ndarray.ndarray import array
+    ctx_list = ctx_list or [cpu(0)]
+    results = []
+    for ctx in ctx_list:
+        args = [array(a, ctx=ctx) for a in inputs_np]
+        out = f(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for got, ctx in zip(results[1:], ctx_list[1:]):
+        for r, g in zip(ref, got):
+            rt, at = _tol(r.dtype, g.dtype)
+            np.testing.assert_allclose(
+                g, r, rtol=rtol or rt, atol=atol or at,
+                err_msg=f"inconsistent result on {ctx}")
+    return results

@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Per SURVEY.md §4 (rebuild test plan): tests run on the CPU backend with 8
+virtual XLA host devices, so multi-device/collective logic is exercised
+without TPU hardware; a `tpu` marker gates tests that want the real chip.
+The env vars MUST be set before jax is first imported.
+"""
+import os
+
+# the axon image pins JAX_PLATFORMS=axon; tests force the CPU backend unless
+# explicitly opted onto the chip with MXTPU_TEST_ON_TPU=1
+if not os.environ.get("MXTPU_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Parity with the reference's @with_seed(): deterministic per test."""
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: needs the real TPU chip")
+    config.addinivalue_line("markers", "slow: long-running")

@@ -1,0 +1,217 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2 + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * np.exp(x.asnumpy()) + 1, rtol=1e-6)
+
+
+def test_backward_sum_head():
+    x = nd.array(np.random.rand(3, 4).astype("f4"))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.sum(x * 3)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * np.ones((3, 4)),
+                               rtol=1e-6)
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 400.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 4.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_two_leaves_shared_graph():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = a * b + a
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4.0])   # b + 1
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0])   # a
+
+
+def test_reuse_input_twice():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0])  # 2x + 2
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(2, 3).astype("f4")
+    b_np = np.random.rand(3, 4).astype("f4")
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        loss = nd.sum(nd.dot(a, b))
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((2, 4)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               a_np.T @ np.ones((2, 4)), rtol=1e-5)
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_inplace_raises_while_recording():
+    x = nd.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        with pytest.raises(mx.MXNetError):
+            x += 1
+        with pytest.raises(mx.MXNetError):
+            x[0] = 5.0
+
+
+def test_detach_cuts_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x
+    z.backward()
+    # dz/dx through detach-ed path only: z = const(6) * x
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 3) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = x * x * 2
+    g = autograd.grad(y, [x])[0]
+    np.testing.assert_allclose(g.asnumpy(), 4 * x.asnumpy())
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.arange(4).astype("f4").reshape(1, 4))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        loss = nd.sum(parts[0]) + 2 * nd.sum(parts[1])
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1, 1, 2, 2]])
+
+
+def test_softmax_grad_matches_numeric():
+    from mxnet_tpu.test_utils import check_numeric_gradient  # noqa: F401
+    x_np = np.random.rand(3, 5).astype("f4")
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.softmax(x, axis=-1)
+        loss = nd.sum(y * y)
+    loss.backward()
+    # numeric check
+    eps = 1e-3
+    g = np.zeros_like(x_np)
+    for i in range(x_np.shape[0]):
+        for j in range(x_np.shape[1]):
+            xp = x_np.copy(); xp[i, j] += eps
+            xm = x_np.copy(); xm[i, j] -= eps
+
+            def f(v):
+                e = np.exp(v - v.max(-1, keepdims=True))
+                s = e / e.sum(-1, keepdims=True)
+                return (s * s).sum()
+            g[i, j] = (f(xp) - f(xm)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), g, rtol=1e-2, atol=1e-3)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    y = nd.Dropout(x, p=0.5)          # not training → identity
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    with autograd.record():
+        z = nd.Dropout(x, p=0.5)
+    zn = z.asnumpy()
+    assert (zn == 0).any() and (zn == 2.0).any()

@@ -1,0 +1,275 @@
+"""NDArray semantics tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_create_dtypes():
+    a = nd.array(np.arange(6, dtype="int64").reshape(2, 3))
+    assert a.dtype == np.int64
+    b = nd.array([1.0, 2.0], dtype="float16")
+    assert b.dtype == np.float16
+    # float64 source defaults down to float32 (MXNet default-dtype rule)
+    c = nd.array(np.zeros(3, dtype="float64"))
+    assert c.dtype == np.float32
+
+
+def test_zeros_ones_full_arange_eye():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_array_equal(nd.full((2,), 7).asnumpy(), [7, 7])
+    np.testing.assert_allclose(nd.arange(0, 5, 2).asnumpy(), [0, 2, 4])
+    np.testing.assert_array_equal(nd.eye(3).asnumpy(), np.eye(3, dtype="f4"))
+
+
+def test_arithmetic_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 * a).asnumpy(), (a * 2).asnumpy())
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((a / b).asnumpy(), [[0.1, 0.1], [0.3, 0.2]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a == 2).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    orig = a
+    a += 1
+    assert a is orig
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_setitem_basic():
+    a = nd.zeros((3, 3))
+    a[1] = 5.0
+    a[0, 2] = 1.0
+    expected = np.zeros((3, 3), "f4")
+    expected[1] = 5
+    expected[0, 2] = 1
+    np.testing.assert_array_equal(a.asnumpy(), expected)
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+    a[0:2, 1] = nd.array([7.0, 8.0])
+    assert a.asnumpy()[0, 1] == 7 and a.asnumpy()[1, 1] == 8
+
+
+def test_view_write_through():
+    """x[1:3] is a view: writes through the view mutate the base (§7 hard-part 1)."""
+    x = nd.zeros((4, 2))
+    v = x[1:3]
+    assert v.shape == (2, 2)
+    v[:] = 3.0
+    assert x.asnumpy()[1:3].sum() == 12
+    # and base mutations are visible through the view
+    x[1] = 9.0
+    np.testing.assert_array_equal(v.asnumpy()[0], [9, 9])
+
+
+def test_view_of_view():
+    x = nd.arange(0, 12).reshape((3, 4))
+    v = x[1:3]
+    vv = v[0]
+    np.testing.assert_array_equal(vv.asnumpy(), [4, 5, 6, 7])
+    vv[:] = 0
+    assert x.asnumpy()[1].sum() == 0
+
+
+def test_advanced_indexing_is_copy():
+    x = nd.arange(0, 6).reshape((3, 2))
+    idx = nd.array([0, 2], dtype="int32")
+    y = x[idx]
+    np.testing.assert_array_equal(y.asnumpy(), [[0, 1], [4, 5]])
+
+
+def test_reshape_magic_codes():
+    x = nd.zeros((2, 3, 4))
+    assert x.reshape((-1,)).shape == (24,)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.reshape((-2,)).shape == (2, 3, 4)
+    assert x.reshape((-3, 4)).shape == (6, 4)
+    assert x.reshape((-4, 1, 2, 3, 4)).shape == (1, 2, 3, 4)
+
+
+def test_transpose_slice():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert x.T.shape == (4, 3, 2)
+    assert x.transpose((1, 0, 2)).shape == (3, 2, 4)
+    s = nd.slice_axis(x, axis=1, begin=1, end=3)
+    assert s.shape == (2, 2, 4)
+    sl = nd.slice(x, begin=(0, 1), end=(2, 3))
+    assert sl.shape == (2, 2, 4)
+
+
+def test_reductions():
+    x = nd.array(np.arange(6).reshape(2, 3).astype("f4"))
+    assert x.sum().asscalar() == 15
+    np.testing.assert_allclose(x.sum(axis=0).asnumpy(), [3, 5, 7])
+    np.testing.assert_allclose(x.mean(axis=1).asnumpy(), [1, 4])
+    np.testing.assert_allclose(
+        nd.sum(x, axis=1, exclude=True).asnumpy(), [3, 5, 7])
+    np.testing.assert_allclose(x.max().asscalar(), 5)
+    assert x.argmax(axis=1).dtype == np.float32
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype("f4"))
+    b = nd.array(np.random.rand(4, 5).astype("f4"))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    c = nd.dot(a, a, transpose_b=True)
+    np.testing.assert_allclose(c.asnumpy(), a.asnumpy() @ a.asnumpy().T,
+                               rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert isinstance(parts, list) and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_embedding_one_hot():
+    w = nd.array(np.arange(12).reshape(4, 3).astype("f4"))
+    idx = nd.array([1, 3], dtype="int32")
+    t = nd.take(w, idx)
+    np.testing.assert_array_equal(t.asnumpy(), w.asnumpy()[[1, 3]])
+    e = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_array_equal(e.asnumpy(), w.asnumpy()[[1, 3]])
+    oh = nd.one_hot(idx, 4)
+    np.testing.assert_array_equal(oh.asnumpy(),
+                                  np.eye(4, dtype="f4")[[1, 3]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(x, axis=-1)
+    np.testing.assert_allclose(s.asnumpy(), np.sort(x.asnumpy(), axis=-1))
+    a = nd.argsort(x, axis=-1)
+    assert a.dtype == np.float32
+
+
+def test_copyto_as_in_context():
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    b = a.as_in_context(mx.cpu(1))
+    assert b.context == mx.cpu(1)
+    np.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+    c = nd.zeros((2, 2), ctx=mx.cpu(0))
+    a.copyto(c)
+    np.testing.assert_array_equal(c.asnumpy(), np.ones((2, 2)))
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = nd.cast(a, dtype="float16")
+    assert c.dtype == np.float16
+
+
+def test_wait_and_waitall():
+    a = nd.ones((8, 8))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 2
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs.bin")
+    d = {"w": nd.array([[1.0, 2.0]]), "b": nd.arange(0, 4, dtype="int32")}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_array_equal(loaded["w"].asnumpy(), [[1, 2]])
+    assert loaded["b"].dtype == np.int32
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(f, lst)
+    l2 = nd.load(f)
+    assert isinstance(l2, list) and l2[0].shape == (2,)
+
+
+def test_scalar_ops_preserve_dtype():
+    a = nd.array([1, 2, 3], dtype="int32")
+    b = a + 1
+    assert b.dtype == np.int32
+    c = nd.array([1.0], dtype="float16") * 2
+    assert c.dtype == np.float16
+
+
+def test_elemwise_math():
+    x = np.random.rand(5).astype("f4") + 0.5
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.log(a).asnumpy(), np.log(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nd.clip(a, 0.6, 1.0).asnumpy(),
+                               np.clip(x, 0.6, 1.0))
+
+
+def test_where_tile_repeat_pad():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x, y = nd.ones((3,)), nd.zeros((3,))
+    np.testing.assert_array_equal(nd.where(cond, x, y).asnumpy(), [1, 0, 1])
+    np.testing.assert_array_equal(nd.tile(nd.array([1.0, 2.0]),
+                                          reps=(2,)).asnumpy(), [1, 2, 1, 2])
+    r = nd.repeat(nd.array([1.0, 2.0]), repeats=2)
+    np.testing.assert_array_equal(r.asnumpy(), [1, 1, 2, 2])
+    p = nd.pad(nd.ones((1, 1, 2, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert p.shape == (1, 1, 4, 4)
+
+
+def test_error_on_bad_shapes():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        nd.dot(a, b).wait_to_read()
+
+
+def test_bool_and_len():
+    a = nd.array([5.0])
+    assert bool(a)
+    with pytest.raises(ValueError):
+        bool(nd.ones((2,)))
+    assert len(nd.ones((3, 2))) == 3
+
+
+def test_context_repr_and_eq():
+    assert mx.cpu(0) == mx.cpu(0)
+    assert mx.cpu(0) != mx.cpu(1)
+    assert str(mx.tpu(0)) == "tpu(0)"
+    assert mx.num_gpus() == 0
+
+
+def test_nd_namespace_has_generated_ops():
+    for name in ["broadcast_add", "sum", "dot", "reshape", "relu",
+                 "FullyConnected", "Activation", "softmax", "sgd_update"]:
+        assert hasattr(nd, name), name
