@@ -1,0 +1,69 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Currently measures the BASELINE config #1 workload (Gluon MLP on MNIST-
+shaped data, hybridized training step throughput) on the default device.
+``vs_baseline`` is 1.0 by definition until reference numbers exist
+(BASELINE.md: "published": {} — no verifiable reference numbers).
+Larger configs (ResNet-50, BERT) take over as they land.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def bench_mlp_train(batch_size=512, steps=30, warmup=5):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    with ctx:
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(1024, activation="relu", in_units=784),
+                    nn.Dense(1024, activation="relu", in_units=1024),
+                    nn.Dense(10, in_units=1024))
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=None)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        x = mx.nd.array(np.random.rand(batch_size, 784).astype("f4"),
+                        ctx=ctx)
+        y = mx.nd.array(np.random.randint(0, 10, batch_size).astype("f4"),
+                        ctx=ctx)
+
+        def step():
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(batch_size)
+            return loss
+
+        for _ in range(warmup):
+            step()
+        mx.nd.waitall()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step()
+        loss.wait_to_read()
+        mx.nd.waitall()
+        dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def main():
+    sps = bench_mlp_train()
+    print(json.dumps({
+        "metric": "mlp_mnist_train_samples_per_sec",
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

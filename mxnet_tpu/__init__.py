@@ -25,7 +25,17 @@ from . import ndarray as nd
 from . import autograd
 from . import random
 from . import context
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import metric
+from . import io
+from . import gluon
+from . import test_utils
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
-           "num_gpus", "num_tpus", "Context", "MXNetError", "engine"]
+           "num_gpus", "num_tpus", "Context", "MXNetError", "engine",
+           "initializer", "init", "lr_scheduler", "optimizer", "gluon",
+           "metric", "io", "test_utils"]

@@ -1,0 +1,16 @@
+"""``mx.gluon``: imperative/hybridizable neural-network API.
+
+Capability parity: reference ``python/mxnet/gluon/`` — SURVEY.md §2.5.
+"""
+from .parameter import (Parameter, ParameterDict, Constant,
+                        DeferredInitializationError)
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import data
+
+__all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
+           "SymbolBlock", "CachedOp", "Trainer", "nn", "loss", "utils",
+           "data", "DeferredInitializationError"]

@@ -1,0 +1,635 @@
+"""Gluon Block / HybridBlock and the CachedOp (hybridize → XLA seam).
+
+Capability parity: reference ``python/mxnet/gluon/block.py`` +
+``src/imperative/cached_op.cc`` (SURVEY.md §2.1, §2.5, call stack §3.3).
+
+TPU-native design — THE seam (SURVEY.md §3.3): ``hybridize()`` does not
+build an nnvm graph; instead ``CachedOp`` traces the block's imperative
+forward (pure JAX ops under the hood) into one jitted executable, cached per
+(input shapes, dtypes, train-mode) exactly like the reference caches
+GraphInfo per (shape, dtype, ctx).  XLA then owns memory planning, fusion
+and layout — the jobs nnvm's PlanMemory/bulking did.
+
+Mechanics worth knowing:
+* Parameter/aux mutation inside the graph (BatchNorm moving stats) is
+  functionalized: the trace detects buffer-version bumps and returns the new
+  values as extra outputs, which ``CachedOp.__call__`` writes back after the
+  compiled call — reproducing the reference's aux-array update semantics.
+* RNG (Dropout) is threaded as a *base key input* + per-request ``fold_in``,
+  so each compiled call uses fresh masks without recompiling.
+* Under ``autograd.record()`` the whole cached op joins the tape as ONE node
+  via ``jax.vjp`` over the jitted function (compiled forward AND backward) —
+  the analog of ``CachedOp::Backward``'s cached gradient graph.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .parameter import (Parameter, ParameterDict, Constant,
+                        DeferredInitializationError)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Name manager: gives blocks unique prefixes (parity: _BlockScope)."""
+
+    _counters = {}
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_naming, "current", None)
+        if current is None:
+            if prefix is None:
+                count = _BlockScope._counters.setdefault(hint, 0)
+                prefix = f"{hint}{count}_"
+                _BlockScope._counters[hint] += 1
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.setdefault(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] += 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_naming, "current", None)
+        _naming.current = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _naming.current = self._old_scope
+
+
+class Block:
+    """Base class for all neural-network layers and models."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute magic: auto-register children & params -----------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise TypeError(
+                    f"Changing attribute type for {self.name!r} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute is not allowed. " \
+                "If you want to share parameters between blocks, please " \
+                "pass `params` at construction."
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            f"  ({key}): {_indent(repr(block), 2)}"
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr) \
+            if modstr else f"{self.__class__.__name__}()"
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All Parameters of this block and children (regex filterable)."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle._id] = hook
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle._id] = hook
+        return handle
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # -- (de)serialization -------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Save params keyed by attribute path (robust to prefix changes)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {}
+        seen = {}
+        for key, param in params.items():
+            if deduplicate and id(param) in seen:
+                continue
+            seen[id(param)] = key
+            arg_dict[key] = param._check_and_get(param._data, None)
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        # legacy fallback: file saved with full param names
+        if not any("." in k for k in loaded.keys()) and \
+                any("." in k for k in params.keys()):
+            by_name = {p.name: p for p in self.collect_params().values()}
+            for name, value in loaded.items():
+                if name in by_name:
+                    by_name[name]._load_init(value, ctx,
+                                             cast_dtype=cast_dtype)
+                elif not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter {name!r} loaded from file {filename!r} "
+                        "is not present in this Block")
+            return
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise MXNetError(
+                        f"Parameter {name!r} is missing in file "
+                        f"{filename!r}, which contains parameters: "
+                        f"{_brief_print_list(loaded.keys())}")
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter {name!r} loaded from file {filename!r} "
+                        "is not present in this Block")
+                continue
+            params[name]._load_init(loaded[name], ctx, cast_dtype=cast_dtype)
+
+    # -- call path ---------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        """Recursively activate hybridization on HybridBlock children."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a per-layer summary given sample inputs."""
+        summary = OrderedDict()
+        hooks = []
+
+        def _register(block):
+            def _hook(blk, _, outputs):
+                cname = blk.__class__.__name__
+                key = f"{cname}-{len(summary) + 1}"
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                summary[key] = (tuple(getattr(o, "shape", ()) for o in outs),
+                                sum(int(np.prod(p.shape))
+                                    for p in blk._reg_params.values()
+                                    if p.shape))
+            hooks.append(block.register_forward_hook(_hook))
+
+        self.apply(_register)
+        try:
+            self(*inputs)
+            print(f"{'Layer':<30}{'Output Shape':<30}{'Params':<15}")
+            print("-" * 75)
+            total = 0
+            for key, (shapes, nparams) in summary.items():
+                print(f"{key:<30}{str(shapes):<30}{nparams:<15}")
+                total += nparams
+            print("-" * 75)
+            print(f"Total params: {total}")
+        finally:
+            for h in hooks:
+                h.detach()
+
+
+class _HookHandle:
+    _counter = [0]
+
+    def __init__(self, hooks_dict):
+        self._hooks_dict = hooks_dict
+        _HookHandle._counter[0] += 1
+        self._id = _HookHandle._counter[0]
+
+    def detach(self):
+        self._hooks_dict.pop(self._id, None)
+
+
+def _indent(s, num):
+    lines = s.split("\n")
+    return ("\n" + " " * num).join(lines)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return ", ".join(map(repr, lst[:limit // 2])) + ", ..., " + \
+            ", ".join(map(repr, lst[-limit // 2:]))
+    return ", ".join(map(repr, lst))
+
+
+# ---------------------------------------------------------------------------
+# CachedOp
+# ---------------------------------------------------------------------------
+
+_trace_state = threading.local()
+
+
+def _is_tracing() -> bool:
+    return getattr(_trace_state, "active", False)
+
+
+class _CacheEntry:
+    __slots__ = ("jitted", "n_real_out", "mutated_idx", "out_is_list",
+                 "out_avals")
+
+    def __init__(self):
+        self.jitted = None
+        self.n_real_out = 0
+        self.mutated_idx = ()
+        self.out_is_list = False
+        self.out_avals = None
+
+
+class CachedOp:
+    """Compiled-executable cache for a HybridBlock (parity: CachedOp)."""
+
+    _uid = [0]
+
+    def __init__(self, block: "HybridBlock", static_alloc=False,
+                 static_shape=False):
+        self.block = block
+        self.static_alloc = static_alloc      # accepted for API parity;
+        self.static_shape = static_shape      # XLA always plans statically
+        self._entries = {}
+        self._param_list: Optional[List[Parameter]] = None
+        CachedOp._uid[0] += 1
+        self.name = f"cachedop_{block.name}_{CachedOp._uid[0]}"
+
+    def _collect_param_arrays(self, args):
+        """Stable ordered list of all param NDArrays (init if deferred)."""
+        if self._param_list is None:
+            params = list(self.block.collect_params().values())
+            if any(p._deferred_init for p in params):
+                # one imperative warm-up run resolves every deferred shape
+                from .. import autograd
+                with autograd.pause():
+                    self.block._call_unhybridized(*args)
+            self._param_list = params
+        return [p._check_and_get(p._data, None) for p in self._param_list]
+
+    def _get_entry(self, param_nds, args, training) -> _CacheEntry:
+        key = (tuple((a.shape, a.dtype.name) for a in args),
+               tuple((p.shape, p.dtype.name) for p in param_nds),
+               training)
+        entry = self._entries.get(key)
+        if entry is not None:
+            return entry
+        import jax
+        entry = _CacheEntry()
+        block = self.block
+        params = self._param_list
+        ctx = args[0].context if args else current_context()
+        n_params = len(params)
+        n_args = len(args)
+
+        def pure(*flat):
+            """Functionalized forward: (params…, inputs…, base_key) →
+            (outputs…, mutated-param-values…)."""
+            from .. import random as _rnd
+            param_vals = flat[:n_params]
+            input_vals = flat[n_params:n_params + n_args]
+            base_key_raw = flat[-1]
+            saved = [(p._data._buf, p._data._version) for p in params]
+            key_counter = [0]
+
+            def key_provider(_ctx):
+                k = jax.random.fold_in(
+                    jax.random.wrap_key_data(base_key_raw), key_counter[0])
+                key_counter[0] += 1
+                return NDArray(jax.random.key_data(k), ctx=ctx)
+
+            for p, v in zip(params, param_vals):
+                p._data._buf = v
+            shells = [NDArray(v, ctx=ctx) for v in input_vals]
+            _rnd._push_key_provider(key_provider)
+            prev_tracing = getattr(_trace_state, "active", False)
+            _trace_state.active = True
+            try:
+                outs = block._call_unhybridized(*shells)
+                out_is_list = isinstance(outs, (list, tuple))
+                outs_l = list(outs) if out_is_list else [outs]
+                out_data = tuple(o._data for o in outs_l)
+                mutated_idx = tuple(
+                    i for i, (p, s) in enumerate(zip(params, saved))
+                    if p._data._version != s[1])
+                mutated_vals = tuple(params[i]._data._buf
+                                     for i in mutated_idx)
+            finally:
+                _trace_state.active = prev_tracing
+                _rnd._pop_key_provider()
+                for p, (buf, ver) in zip(params, saved):
+                    p._data._buf = buf
+                    p._data._version = ver
+            entry.n_real_out = len(out_data)
+            entry.mutated_idx = mutated_idx
+            entry.out_is_list = out_is_list
+            return out_data + mutated_vals
+
+        from .. import autograd
+
+        def pure_in_mode(*flat):
+            prev = autograd.set_training(training)
+            try:
+                return pure(*flat)
+            finally:
+                autograd.set_training(prev)
+
+        entry.jitted = jax.jit(pure_in_mode)
+        self._entries[key] = entry
+        return entry
+
+    def __call__(self, *args):
+        from .. import autograd
+        from .. import random as _rnd
+        import jax
+
+        param_nds = self._collect_param_arrays(args)
+        training = autograd.is_training()
+        entry = self._get_entry(param_nds, args, training)
+        ctx = args[0].context if args else current_context()
+        base_key = _rnd._next_key_nd(ctx)
+
+        flat = [p._data for p in param_nds] + [a._data for a in args] \
+            + [base_key._data]
+
+        if autograd.is_recording():
+            out_all, vjp_fn = jax.vjp(entry.jitted, *flat)
+
+            def vjp_tuple(cots, _fn=vjp_fn):
+                # the traced fn always returns a tuple; the tape passes a
+                # bare cotangent when there is a single output slot
+                return _fn(cots if isinstance(cots, tuple) else (cots,))
+
+            node = autograd._Node(
+                vjp_tuple, list(param_nds) + list(args), 1,
+                [o.aval for o in out_all])
+        else:
+            out_all = entry.jitted(*flat)
+            node = None
+
+        real = out_all[:entry.n_real_out]
+        aux = out_all[entry.n_real_out:]
+        # write mutated params back (outside the tape, like aux updates)
+        for i, val in zip(entry.mutated_idx, aux):
+            self._param_list[i]._data._set_data(val)
+
+        outs = []
+        for i, o in enumerate(real):
+            o_nd = NDArray(o, ctx=ctx)
+            if node is not None:
+                o_nd._ag_node = node
+                o_nd._ag_out_idx = i
+            outs.append(o_nd)
+        if node is not None:
+            node.outputs = list(outs)
+        if entry.out_is_list:
+            return outs
+        return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+
+
+class HybridBlock(Block):
+    """Block that can be hybridized: traced once, compiled by XLA."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op: Optional[CachedOp] = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            if not isinstance(block, SymbolBlock):
+                # non-hybrid children make the parent fall back to
+                # imperative for itself but stay callable
+                pass
+        super().register_child(block, name)
+        if self._cached_op is not None:
+            self._clear_cached_op()
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    # -- shape inference for deferred params -------------------------------
+    def infer_shape(self, *args):
+        """Subclasses with deferred params override to set param shapes."""
+        raise MXNetError(
+            f"Cannot infer shapes of deferred-initialized parameters for "
+            f"{self.name!r}: layer does not implement infer_shape(). "
+            "Specify in_units/in_channels explicitly.")
+
+    def infer_type(self, *args):
+        pass
+
+    def _call_unhybridized(self, *args):
+        """Run hybrid_forward imperatively, resolving deferred init."""
+        try:
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, *args, **params)
+
+    def _deferred_infer_shape(self, *args):
+        self.infer_shape(*args)
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            if self._active and not _is_tracing():
+                if self._cached_op is None:
+                    self._cached_op = CachedOp(self, **{
+                        k: v for k, v in self._flags.items()
+                        if k in ("static_alloc", "static_shape")})
+                return self._cached_op(x, *args)
+            return self._call_unhybridized(x, *args)
+        # symbolic input (Symbol tracing) — delegated to hybrid_forward
+        from .. import symbol as sym_mod
+        params = {k: p.var() for k, p in self._reg_params.items()}
+        with _name_prefix(self.prefix):
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export compiled model (parity: HybridBlock.export).
+
+        Saves ``path-symbol.json`` (graph metadata) + params; full
+        StableHLO bundle lands with the symbol milestone.
+        """
+        params = {}
+        for name, param in self.collect_params().items():
+            params[name] = param._check_and_get(param._data, None)
+        nd.save(f"{path}-{epoch:04d}.params", params)
+
+
+class _name_prefix:
+    def __init__(self, prefix):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+class SymbolBlock(HybridBlock):
+    """Block wrapping a symbolic graph (parity: gluon.SymbolBlock).
+
+    Constructed from outputs/inputs Symbols; `imports` loads an exported
+    model.  Lands fully with the symbol milestone; parameter-only loading
+    works today.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise NotImplementedError(
+            "SymbolBlock.imports lands with the symbol milestone")
+
+    def forward(self, x, *args):
+        raise NotImplementedError(
+            "SymbolBlock.forward lands with the symbol milestone")

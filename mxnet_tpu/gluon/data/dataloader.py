@@ -1,0 +1,108 @@
+"""DataLoader.
+
+Capability parity: reference ``python/mxnet/gluon/data/dataloader.py``
+(SURVEY.md §2.4): batchify (default stack / user fn), samplers,
+``num_workers`` parallel loading, pin_memory surface.  TPU-native detail:
+worker parallelism uses a thread pool feeding host NumPy batches (the GIL
+is released inside NumPy/decoding), because device placement must stay on
+the main thread with PJRT; the reference's fork-based workers + shared-mem
+NDArray IPC exist to feed GPUs from Python, which XLA's async host→device
+copies already cover.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+from .dataset import Dataset
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    """Loads data from a Dataset and returns mini-batches."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=False,
+                 timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size,
+                last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn if batchify_fn is not None \
+            else default_batchify_fn
+        self._pool = ThreadPoolExecutor(self._num_workers) \
+            if self._num_workers > 0 else None
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        # pipelined: submit sample fetches ahead, assemble in order
+        def fetch(batch):
+            return self._batchify_fn([self._dataset[i] for i in batch])
+        batches = list(self._batch_sampler)
+        futures = []
+        depth = self._num_workers * 2
+        it = iter(batches)
+        for _ in range(min(depth, len(batches))):
+            futures.append(self._pool.submit(fetch, next(it)))
+        done = 0
+        while futures:
+            f = futures.pop(0)
+            try:
+                nxt = next(it)
+                futures.append(self._pool.submit(fetch, nxt))
+            except StopIteration:
+                pass
+            yield f.result(timeout=self._timeout)
+            done += 1
+
+    def __len__(self):
+        return len(self._batch_sampler)

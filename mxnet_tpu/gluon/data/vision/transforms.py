@@ -1,0 +1,197 @@
+"""Vision transforms.
+
+Capability parity: reference ``gluon/data/vision/transforms.py``
+(ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, Cast, Compose).  Transforms run per-sample
+on host (HWC uint8 → CHW float32), matching the reference's CPU augment
+stage that feeds the device pipeline (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast"]
+
+
+def _asnp(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (parity: ToTensor)."""
+
+    def forward(self, x):
+        img = _asnp(x).astype("float32") / 255.0
+        if img.ndim == 3:
+            img = img.transpose(2, 0, 1)
+        elif img.ndim == 4:
+            img = img.transpose(0, 3, 1, 2)
+        return nd.array(img)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype="float32")
+        self._std = np.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        img = _asnp(x).astype("float32")
+        mean = self._mean.reshape(-1, 1, 1)
+        std = self._std.reshape(-1, 1, 1)
+        return nd.array((img - mean) / std)
+
+
+def _resize_np(img, size, interp="linear"):
+    """Host bilinear/nearest resize of HWC image via jax.image."""
+    import jax
+    h, w = size[1], size[0]
+    out_shape = (h, w, img.shape[2]) if img.ndim == 3 else (h, w)
+    method = "linear" if interp != 0 else "nearest"
+    return np.asarray(jax.image.resize(
+        np.asarray(img, dtype="float32"), out_shape, method=method))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _asnp(x)
+        w, h = self._size
+        if self._keep:
+            # fit within the (w, h) box preserving aspect ratio
+            ih, iw = img.shape[:2]
+            scale = min(w / iw, h / ih)
+            h, w = max(int(ih * scale), 1), max(int(iw * scale), 1)
+        out = _resize_np(img, (w, h), self._interpolation)
+        return nd.array(out.astype("float32") if img.dtype != np.uint8
+                        else np.clip(out, 0, 255).astype("uint8"),
+                        dtype=("uint8" if img.dtype == np.uint8
+                               else "float32"))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _asnp(x)
+        w, h = self._size
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            img = _resize_np(img, (max(w, iw), max(h, ih)),
+                             self._interpolation)
+            ih, iw = img.shape[:2]
+        y0 = (ih - h) // 2
+        x0 = (iw - w) // 2
+        out = img[y0:y0 + h, x0:x0 + w]
+        return nd.array(out, dtype=str(np.asarray(out).dtype))
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, int):
+            size = (size, size)
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _asnp(x)
+        ih, iw = img.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= iw and h <= ih:
+                x0 = np.random.randint(0, iw - w + 1)
+                y0 = np.random.randint(0, ih - h + 1)
+                crop = img[y0:y0 + h, x0:x0 + w]
+                out = _resize_np(crop, self._size, self._interpolation)
+                return nd.array(np.clip(out, 0, 255).astype(img.dtype),
+                                dtype=str(img.dtype))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation).forward(
+            nd.array(img, dtype=str(img.dtype)))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            img = _asnp(x)
+            return nd.array(img[:, ::-1].copy(), dtype=str(img.dtype))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            img = _asnp(x)
+            return nd.array(img[::-1].copy(), dtype=str(img.dtype))
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def forward(self, x):
+        alpha = np.random.uniform(*self._args)
+        img = _asnp(x).astype("float32") * alpha
+        return nd.array(np.clip(img, 0, 255).astype("uint8"), dtype="uint8")
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def forward(self, x):
+        alpha = np.random.uniform(*self._args)
+        img = _asnp(x).astype("float32")
+        gray = img.mean()
+        img = gray + alpha * (img - gray)
+        return nd.array(np.clip(img, 0, 255).astype("uint8"), dtype="uint8")

@@ -1,0 +1,27 @@
+"""``mx.gluon.nn`` namespace (parity: python/mxnet/gluon/nn/)."""
+from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,
+                           BatchNorm, LayerNorm, InstanceNorm, Embedding,
+                           Flatten, Lambda, HybridLambda, HybridConcatenate,
+                           Concatenate, Identity)
+from .activations import (Activation, LeakyReLU, PReLU, ELU, SELU, Swish,
+                          GELU, SiLU)
+from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
+                          Conv2DTranspose, Conv3DTranspose,
+                          MaxPool1D, MaxPool2D, MaxPool3D,
+                          AvgPool1D, AvgPool2D, AvgPool3D,
+                          GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
+                          GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D,
+                          ReflectionPad2D)
+
+__all__ = [
+    "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+    "LayerNorm", "InstanceNorm", "Embedding", "Flatten", "Lambda",
+    "HybridLambda", "HybridConcatenate", "Concatenate", "Identity",
+    "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU",
+    "SiLU",
+    "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+    "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D",
+    "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
+    "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
+    "GlobalAvgPool3D", "ReflectionPad2D",
+]

@@ -1,0 +1,310 @@
+"""Convolution and pooling layers.
+
+Capability parity: reference ``python/mxnet/gluon/nn/conv_layers.py``
+(Conv1D/2D/3D, transposed variants, Max/Avg/Global pooling) — SURVEY.md
+§2.5.  Layout is MXNet's NCW/NCHW/NCDHW API-side; XLA relayouts for the MXU
+internally.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+
+def _to_tuple(val, n):
+    if isinstance(val, (int, np.integer)):
+        return (int(val),) * n
+    assert len(val) == n
+    return tuple(int(v) for v in val)
+
+
+class _Conv(HybridBlock):
+    """Shared conv machinery (parity: _Conv base in the reference)."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", op_name="Convolution",
+                 adj=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._channels = channels
+        self._in_channels = in_channels
+        ndim = len(kernel_size)
+        self._ndim = ndim
+        self._op_name = op_name
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "no_bias": not use_bias, "layout": layout}
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        with self.name_scope():
+            if op_name == "Convolution":
+                wshape = (channels, in_channels // groups
+                          if in_channels else 0) + kernel_size
+            else:  # Deconvolution: (in_channels, channels, *kernel)
+                wshape = (in_channels, channels // groups) + kernel_size
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                from .activations import Activation
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x):
+        in_c = x.shape[1]
+        groups = self._kwargs["num_group"]
+        if self._op_name == "Convolution":
+            self.weight.shape = (self._channels, in_c // groups) + \
+                self._kwargs["kernel"]
+        else:
+            self.weight.shape = (in_c, self._channels // groups) + \
+                self._kwargs["kernel"]
+        self._in_channels = in_c
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        if bias is None:
+            out = op(x, weight, **self._kwargs)
+        else:
+            out = op(x, weight, bias, **self._kwargs)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}({self._in_channels} -> "
+                f"{self._channels}, kernel_size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        assert layout == "NCW", "Only NCW layout is supported"
+        super().__init__(channels, _to_tuple(kernel_size, 1),
+                         _to_tuple(strides, 1), _to_tuple(padding, 1),
+                         _to_tuple(dilation, 1), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        assert layout in ("NCHW",), "Only NCHW layout is supported"
+        super().__init__(channels, _to_tuple(kernel_size, 2),
+                         _to_tuple(strides, 2), _to_tuple(padding, 2),
+                         _to_tuple(dilation, 2), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        assert layout == "NCDHW", "Only NCDHW layout is supported"
+        super().__init__(channels, _to_tuple(kernel_size, 3),
+                         _to_tuple(strides, 3), _to_tuple(padding, 3),
+                         _to_tuple(dilation, 3), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, prefix=prefix, params=params)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _to_tuple(kernel_size, 1),
+                         _to_tuple(strides, 1), _to_tuple(padding, 1),
+                         _to_tuple(dilation, 1), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_to_tuple(output_padding, 1), prefix=prefix,
+                         params=params)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1),
+                 padding=(0, 0), output_padding=(0, 0), dilation=(1, 1),
+                 groups=1, layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, _to_tuple(kernel_size, 2),
+                         _to_tuple(strides, 2), _to_tuple(padding, 2),
+                         _to_tuple(dilation, 2), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_to_tuple(output_padding, 2), prefix=prefix,
+                         params=params)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None,
+                 params=None):
+        super().__init__(channels, _to_tuple(kernel_size, 3),
+                         _to_tuple(strides, 3), _to_tuple(padding, 3),
+                         _to_tuple(dilation, 3), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, op_name="Deconvolution",
+                         adj=_to_tuple(output_padding, 3), prefix=prefix,
+                         params=params)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}(size={self._kwargs['kernel']}, "
+                f"stride={self._kwargs['stride']}, "
+                f"padding={self._kwargs['pad']})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(_to_tuple(pool_size, 1),
+                         None if strides is None else _to_tuple(strides, 1),
+                         _to_tuple(padding, 1), ceil_mode, False, "max",
+                         layout, prefix=prefix, params=params)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_to_tuple(pool_size, 2),
+                         None if strides is None else _to_tuple(strides, 2),
+                         _to_tuple(padding, 2), ceil_mode, False, "max",
+                         layout, prefix=prefix, params=params)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, prefix=None, params=None):
+        super().__init__(_to_tuple(pool_size, 3),
+                         None if strides is None else _to_tuple(strides, 3),
+                         _to_tuple(padding, 3), ceil_mode, False, "max",
+                         layout, prefix=prefix, params=params)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, prefix=None,
+                 params=None):
+        super().__init__(_to_tuple(pool_size, 1),
+                         None if strides is None else _to_tuple(strides, 1),
+                         _to_tuple(padding, 1), ceil_mode, False, "avg",
+                         layout, count_include_pad, prefix=prefix,
+                         params=params)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_to_tuple(pool_size, 2),
+                         None if strides is None else _to_tuple(strides, 2),
+                         _to_tuple(padding, 2), ceil_mode, False, "avg",
+                         layout, count_include_pad, prefix=prefix,
+                         params=params)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 prefix=None, params=None):
+        super().__init__(_to_tuple(pool_size, 3),
+                         None if strides is None else _to_tuple(strides, 3),
+                         _to_tuple(padding, 3), ceil_mode, False, "avg",
+                         layout, count_include_pad, prefix=prefix,
+                         params=params)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         layout, prefix=prefix, params=params)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__((1,), None, (0,), False, True, "avg", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         layout, prefix=prefix, params=params)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(padding, (int, np.integer)):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        assert len(padding) == 8
+        self._padding = tuple(padding)
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._padding)

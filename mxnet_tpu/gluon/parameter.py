@@ -1,0 +1,446 @@
+"""Gluon Parameter / ParameterDict.
+
+Capability parity: reference ``python/mxnet/gluon/parameter.py`` (SURVEY.md
+§2.5): deferred initialization (shape with 0s completed at first forward),
+``grad_req`` write/add/null, per-context replicas (``list_data``), lr_mult/
+wd_mult, Constant parameters, and the dict with prefix scoping + sharing.
+TPU-native detail: a "context replica" is just the one device buffer —
+multi-device data parallelism replicates via the sharded trainer/kvstore
+path (SURVEY.md §2.3) rather than per-GPU copies.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .. import initializer
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter's data is requested before shape is known."""
+
+
+class Parameter:
+    """A (potentially deferred-initialized) trainable tensor."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = None
+        self.grad_req = grad_req
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._ctx = None
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # -- grad_req ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), \
+            f"grad_req must be write/add/null, got {req}"
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data.grad_req = "null"
+                self._data._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            f"Expected shape {new_shape} is incompatible with given shape " \
+            f"{self._shape}."
+        self._shape = tuple(new_shape)
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize data & grad buffers (or defer if shape unknown)."""
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._shape is None or np.prod(self._shape) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter {self.name!r} because it has "
+                f"invalid shape: {self._shape}.")
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self._shape is not None and np.prod(self._shape) > 0, \
+            f"Cannot initialize Parameter {self.name!r} because it has " \
+            f"invalid shape: {self._shape}. Please specify in_units, " \
+            f"in_channels, etc for `Block`s."
+        if data is None:
+            host = np.zeros(self._shape, dtype=self.dtype)
+            chosen = init if init is not None else (
+                self.init if self.init is not None else default_init)
+            explicit = init is not None or self.init is not None
+            chosen = initializer.create(chosen) \
+                if not isinstance(chosen, initializer.Initializer) else chosen
+            if explicit:
+                # a per-parameter initializer bypasses name-pattern
+                # dispatch (bias→0 etc.) — the user's choice wins, matching
+                # the reference's InitDesc attrs['__init__'] path
+                chosen._init_weight(initializer.InitDesc(self.name), host)
+            else:
+                chosen(initializer.InitDesc(self.name), host)
+            data = nd.array(host, ctx=ctx[0], dtype=self.dtype)
+        self._ctx = ctx[0]
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._data.attach_grad(grad_req=self._grad_req)
+        self._grad = self._data._grad
+
+    def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source=""):
+        """Install loaded data (parity: Parameter._load_init)."""
+        if isinstance(data, np.ndarray):
+            data = nd.array(data, dtype=data.dtype)
+        if self._shape is not None and builtins_any(self._shape):
+            if tuple(s for s in self._shape) != data.shape and \
+                    0 not in self._shape:
+                raise MXNetError(
+                    f"Failed loading Parameter {self.name!r} from saved "
+                    f"params: shape incompatible expected {self._shape} "
+                    f"vs saved {data.shape}")
+        self._shape = data.shape
+        if cast_dtype and np.dtype(self.dtype) != data.dtype:
+            data = data.astype(self.dtype)
+        else:
+            self.dtype = data.dtype.name
+        ctx = ctx or (self._ctx if self._ctx is not None
+                      else current_context())
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._deferred_init = ()
+        self._ctx = ctx[0]
+        self._data = data.as_in_context(ctx[0])
+        if self._grad_req != "null":
+            self._init_grad()
+
+    # -- accessors ---------------------------------------------------------
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter {self.name!r} has not been initialized yet "
+                "because initialization was deferred. Actual initialization "
+                "happens during the first forward pass.")
+        raise MXNetError(
+            f"Parameter {self.name!r} has not been initialized. You should "
+            "initialize parameters with Block.initialize() before use.")
+
+    def data(self, ctx=None) -> NDArray:
+        d = self._check_and_get(self._data, ctx)
+        if ctx is not None and isinstance(ctx, Context) and ctx != d.context:
+            return d.as_in_context(ctx)
+        return d
+
+    def list_data(self) -> List[NDArray]:
+        return [self._check_and_get(self._data, None)]
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._data is not None and self._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter {self.name!r} "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError(f"Parameter {self.name!r} has not been "
+                             "initialized")
+        return [self._ctx]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad[:] = 0
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                f"Parameter {self.name!r} has not been initialized"
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        if isinstance(data, NDArray):
+            src = data
+        else:
+            src = nd.array(data, dtype=self.dtype)
+        # buffer swap preserves the autograd leaf & grad buffer
+        self._data._set_data(src._data.astype(self._data.dtype.name))
+
+    def reset_ctx(self, ctx):
+        ctx = [ctx] if isinstance(ctx, Context) else list(ctx)
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            self._ctx = ctx[0]
+            if self._grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise MXNetError(f"Cannot reset context for Parameter "
+                             f"{self.name!r} because it has not been "
+                             "initialized.")
+
+    def cast(self, dtype):
+        self.dtype = np.dtype(dtype).name
+        if self._data is None:
+            return
+        data = self._data.astype(dtype)
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def var(self):
+        """Symbol variable for this parameter (symbolic tracing)."""
+        if self._var is None:
+            from .. import symbol
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype)
+        return self._var
+
+
+def builtins_any(shape):
+    return shape is not None
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (parity: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(np.asarray(value), dtype=np.asarray(
+                np.asarray(value)).dtype if hasattr(value, "dtype")
+                else "float32")
+
+        class _CInit(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                arr[...] = value.asnumpy()
+
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype.name, init=_CInit(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Ordered prefix-scoped dict of Parameters (parity: ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict {self._prefix} (\n{s}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Get or create Parameter ``self.prefix + name``."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                existing = getattr(param, k, None)
+                if existing is None or v is None:
+                    if v is not None:
+                        setattr(param, k, v)
+                    continue
+                if k == "shape":
+                    # merge: 0 entries are wildcards; else must agree
+                    if len(v) == len(existing) and all(
+                            a == b or a == 0 or b == 0
+                            for a, b in zip(existing, v)):
+                        param._shape = tuple(
+                            a if a != 0 else b
+                            for a, b in zip(existing, v))
+                        continue
+                    raise AssertionError(
+                        f"Cannot retrieve Parameter {name!r} because "
+                        f"desired shape {v} conflicts with existing "
+                        f"shape {existing}.")
+                if k == "dtype":
+                    if np.dtype(v) == np.dtype(existing):
+                        continue
+                    raise AssertionError(
+                        f"Cannot retrieve Parameter {name!r} because "
+                        f"desired dtype {v} conflicts with existing "
+                        f"dtype {existing}.")
+                # other attrs (init, grad_req, ...): first definition wins
+                # only if identical; otherwise flag the conflict
+                if existing != v and k not in ("init",):
+                    raise AssertionError(
+                        f"Cannot retrieve Parameter {name!r} because "
+                        f"desired attribute {k}={v!r} conflicts with "
+                        f"existing {existing!r}.")
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(
+                    f"No constant named {name!r}. Please specify value if "
+                    "you want to create a new constant.")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"Cannot update self with other because "
+                                 f"they have different Parameters with the "
+                                 f"same name {k!r}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError(
+                    f"Prefix {strip_prefix!r} is to be striped before "
+                    f"saving, but Parameter {param.name!r} does not start "
+                    "with it.")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False):
+        arg_dict = nd.load(filename)
+        if restore_prefix:
+            arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(
+                        f"Parameter {name!r} is missing in file "
+                        f"{filename!r}")
+        for name, data in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter {name!r} loaded from file {filename!r} "
+                        "is not present in this ParameterDict")
+                continue
+            self[name]._load_init(data, ctx, cast_dtype=cast_dtype)

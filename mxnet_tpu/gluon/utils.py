@@ -1,0 +1,92 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py).
+
+``split_and_load`` is the reference's single-host data-parallel primitive;
+here contexts may be multiple XLA host devices (tests) or TPU chips.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch "
+            f"size that's a multiple of {num_slice} or set even_split=False")
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis, begin=begin,
+                                    end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch across contexts (parity: gluon.utils.split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the global 2-norm <= max_norm."""
+    def _norm(array):
+        x = array.reshape((-1,))
+        return nd.dot(x, x)
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = nd.add_n(*[_norm(a).as_in_context(ctx) for a in arrays])
+    total_norm = nd.sqrt(total_norm)
+    if check_isfinite:
+        val = float(total_norm.asscalar())
+        if not np.isfinite(val):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will "
+                            "be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    scale = nd.minimum(scale, nd.ones((1,), ctx=ctx))
+    for arr in arrays:
+        arr *= scale.as_in_context(arr.context)
+    if check_isfinite:
+        return val
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    raise MXNetError(
+        "download() requires network access, which this environment does "
+        "not provide (parity surface kept for API compatibility).")

@@ -1,0 +1,278 @@
+"""Weight initializers.
+
+Capability parity: reference ``python/mxnet/initializer.py`` (SURVEY.md
+§2.5): registry + string aliases, ``InitDesc`` name-pattern dispatch
+(arrays named ``*_bias`` get zeros, etc.), Xavier/MSRAPrelu/Orthogonal/
+Bilinear/LSTMBias and the basic constant/random families.  TPU-native
+detail: initializers fill host NumPy buffers which are then placed on the
+target device once — initialization is not a jit-traced op.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register", "create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """Class decorator: register under the lower-cased class name."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs) -> "Initializer":
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    name = str(init).lower()
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown initializer {init!r}; "
+                         f"choices: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (parity: InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer: callable on (name, np.ndarray-to-fill)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, name, arr):
+        """Fill ``arr`` (a host np.ndarray) for variable ``name``.
+
+        Name-pattern dispatch matches the reference: bias→0, gamma→1,
+        beta→0, running mean/var→0/1, weight→_init_weight.
+        """
+        if not isinstance(name, str):
+            name = str(name)
+        if name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            arr[...] = 0.0
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            arr[...] = 1.0
+        elif name.endswith("moving_inv_var"):
+            arr[...] = 0.0
+        elif name.endswith("moving_avg"):
+            arr[...] = 0.0
+        elif name.endswith("min") or name.endswith("max"):
+            arr[...] = 0.0
+        else:
+            self._init_weight(name, arr)
+        if self._verbose and self._print_func:
+            self._print_func(f"init {name}")
+
+    def _init_bias(self, name, arr):
+        arr[...] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[...] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[...] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError(
+            f"{self.__class__.__name__} must implement _init_weight")
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__
+                and self._kwargs == other._kwargs)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[...] = 0.0
+
+
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[...] = 1.0
+
+
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[...] = np.asarray(self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[...] = np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[...] = np.random.normal(0, self.sigma, arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[...] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot. factor_type in/out/avg; rnd_type uniform/gaussian."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier requires >=2D weight, got {shape} for {name}")
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[...] = np.random.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            arr[...] = np.random.normal(0, scale, shape)
+        else:
+            raise MXNetError(f"bad rnd_type {self.rnd_type}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1.0 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution upscaling layers)."""
+
+    def _init_weight(self, name, arr):
+        weight = np.zeros(arr.size, dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(arr.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[...] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, other gates 0 (fused-RNN layout)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[...] = 0.0
+        num_hidden = arr.shape[0] // 4
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+
+@register
+class Mixed(Initializer):
+    """Name-pattern → initializer dispatch (parity: mx.init.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers length mismatch")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"Parameter {name} did not match any pattern; add '.*' default")

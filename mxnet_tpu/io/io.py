@@ -1,0 +1,484 @@
+"""Legacy data iterators.
+
+Capability parity: reference ``python/mxnet/io/io.py`` + ``src/io/``
+(SURVEY.md §2.4): ``DataIter`` protocol (``provide_data/provide_label``
+descriptors consumed by ``Module.fit``), ``NDArrayIter`` (host arrays →
+batches, pad/roll-over/discard last-batch handling), ``ResizeIter``,
+``PrefetchingIter`` (threaded double-buffering, the dmlc ThreadedIter
+analog), ``CSVIter``, ``MNISTIter``, and ``ImageRecordIter`` over the
+recordio core.
+"""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name + shape (+dtype/layout) descriptor (parity: io.DataDesc)."""
+
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return (f"DataDesc[{self.name},{self.shape},{self.dtype},"
+                f"{self.layout}]")
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch: data/label lists + pad/index bookkeeping."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), \
+                f"Data must be list of NDArrays, got {type(data)}"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), \
+                "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return (f"{self.__class__.__name__}: data shapes: {data_shapes} "
+                f"label shapes: {label_shapes}")
+
+
+class DataIter:
+    """Base iterator (parity: mx.io.DataIter protocol)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, np.ndarray)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError(
+            "Input must be NDArray, numpy.ndarray, a list of them or dict "
+            "with them as values")
+    out = {}
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            out[k] = v.asnumpy()
+        else:
+            out[k] = np.asarray(v)
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """Iterate over host arrays (parity: mx.io.NDArrayIter incl.
+    shuffle, pad/discard/roll_over last-batch handling)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        self.num_source = len(self.data)
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype.name)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype.name)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        self.cursor = -self.batch_size
+        self._cache_data = None
+        self._cache_label = None
+
+    def reset(self):
+        if self.shuffle:
+            self._shuffle_data()
+        if self.last_batch_handle == "roll_over" and \
+                self._cache_data is not None:
+            # remainder cached from last epoch fills the head of the first
+            # batch; negative cursor marks how many cached rows lead it
+            cached = self._cache_data[0].shape[0]
+            self.cursor = -self.batch_size - cached
+        else:
+            self.cursor = -self.batch_size
+            self._cache_data = None
+            self._cache_label = None
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        data = self.getdata()
+        label = self.getlabel()
+        if self.cursor < 0 and self._cache_data is not None:
+            # roll_over: prepend last epoch's remainder
+            data = [nd.concatenate([c, d])
+                    for c, d in zip(self._cache_data, data)]
+            label = [nd.concatenate([c, l])
+                     for c, l in zip(self._cache_label, label)]
+            self._cache_data = None
+            self._cache_label = None
+        if data[0].shape[0] != self.batch_size:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle == "roll_over":
+                self._cache_data = data
+                self._cache_label = label
+                raise StopIteration
+            # pad
+            pad = self.batch_size - data[0].shape[0]
+            first_data = self._getdata(self.data, 0, pad)
+            first_label = self._getdata(self.label, 0, pad)
+            data = [nd.concatenate([d, f]) for d, f in zip(data, first_data)]
+            label = [nd.concatenate([l, f]) for l, f in
+                     zip(label, first_label)]
+            return DataBatch(data=data, label=label, pad=pad,
+                             index=None)
+        return DataBatch(data=data, label=label, pad=self.getpad(),
+                         index=None)
+
+    def _getdata(self, data_source, start=None, end=None):
+        if start is None and end is None:
+            raise ValueError("Should at least specify start or end")
+        start = start if start is not None else 0
+        if end is None:
+            end = data_source[0][1].shape[0] if data_source else 0
+        return [nd.array(x[1][start:end], dtype=x[1].dtype)
+                for x in data_source]
+
+    def getdata(self):
+        start = max(self.cursor, 0)
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self._getdata(self.data, start, end)
+
+    def getlabel(self):
+        start = max(self.cursor, 0)
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self._getdata(self.label, start, end)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def _shuffle_data(self):
+        np.random.shuffle(self.idx)
+        self.data = [(k, v[self.idx]) for k, v in self.data]
+        self.label = [(k, v[self.idx]) for k, v in self.label]
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate/loop) another iterator to `size` batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Threaded prefetch over one or more iterators.
+
+    Parity: ``mx.io.PrefetchingIter`` / dmlc ThreadedIter double-buffering
+    (SURVEY.md §2.4) — a worker thread per source keeps the next batch
+    ready while the device consumes the current one.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i],
+                             daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[
+            DataDesc(r[x.name], x.shape, x.dtype)
+            if isinstance(x, DataDesc) else DataDesc(*x)
+            for x in i.provide_data]
+            for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[
+            DataDesc(r[x.name], x.shape, x.dtype)
+            if isinstance(x, DataDesc) else DataDesc(*x)
+            for x in i.provide_label]
+            for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iters"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Number of entry mismatches between iters"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad,
+            self.next_batch[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (parity: mx.io.CSVIter, host-parsed)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype="float32")
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype="float32")
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        super().__init__(data, label, batch_size,
+                         last_batch_handle="roll_over" if round_batch
+                         else "discard")
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (parity: mx.io.MNISTIter)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=0, **kwargs):
+        import gzip
+        import struct
+
+        def _read_idx(path, is_image):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                if is_image:
+                    _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                    arr = np.frombuffer(f.read(), dtype=np.uint8)
+                    return arr.reshape(num, rows, cols)
+                _, num = struct.unpack(">II", f.read(8))
+                return np.frombuffer(f.read(), dtype=np.uint8)
+
+        images = _read_idx(image, True).astype("float32") / 255.0
+        labels = _read_idx(label, False).astype("float32")
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images[:, None, :, :]
+        super().__init__(images, labels, batch_size, shuffle=shuffle)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO-backed image iterator: lands fully with the recordio
+    milestone (SURVEY.md §2.4 ImageRecordIter); the class is the parity
+    surface."""
+
+    def __init__(self, **kwargs):
+        raise NotImplementedError(
+            "ImageRecordIter lands with the recordio milestone; use "
+            "NDArrayIter or gluon.data.DataLoader meanwhile")

@@ -109,7 +109,33 @@ def RNN(*args, **kwargs):
         "fused op surface lands with the RNN milestone")
 
 
-_CUSTOM = {"Dropout": Dropout, "BatchNorm": BatchNorm, "RNN": RNN}
+def maximum(lhs, rhs, out=None):
+    """Parity: nd.maximum — scalar or array operands."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke(get_op("broadcast_maximum"), [lhs, rhs], out=out)
+    if isinstance(lhs, NDArray):
+        return invoke(get_op("_maximum_scalar"), [lhs], scalar=rhs, out=out)
+    if isinstance(rhs, NDArray):
+        return invoke(get_op("_maximum_scalar"), [rhs], scalar=lhs, out=out)
+    return builtins_max(lhs, rhs)
+
+
+def minimum(lhs, rhs, out=None):
+    """Parity: nd.minimum — scalar or array operands."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke(get_op("broadcast_minimum"), [lhs, rhs], out=out)
+    if isinstance(lhs, NDArray):
+        return invoke(get_op("_minimum_scalar"), [lhs], scalar=rhs, out=out)
+    if isinstance(rhs, NDArray):
+        return invoke(get_op("_minimum_scalar"), [rhs], scalar=lhs, out=out)
+    return builtins_min(lhs, rhs)
+
+
+builtins_max = max
+builtins_min = min
+
+_CUSTOM = {"Dropout": Dropout, "BatchNorm": BatchNorm, "RNN": RNN,
+           "maximum": maximum, "minimum": minimum}
 
 _generate(_mod)
 

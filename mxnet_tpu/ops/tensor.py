@@ -646,3 +646,33 @@ def sequence_reverse(data, *rest, use_sequence_length=False, axis=0):
                         steps)
     batch = jnp.arange(data.shape[1])[None, :]
     return data[rev_idx, batch]
+
+
+# ---------------------------------------------------------------------------
+# variadic sum — reference src/operator/tensor/elemwise_sum.cc
+# ---------------------------------------------------------------------------
+
+
+@register("add_n", num_inputs=None)
+def add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+alias("ElementWiseSum", "add_n")
+
+
+@register("square_sum")
+def square_sum(data, *, axis=None, keepdims=False, exclude=False):
+    return _reduce(lambda d, axis, keepdims: jnp.sum(jnp.square(d),
+                                                     axis=axis,
+                                                     keepdims=keepdims),
+                   data, axis=axis, keepdims=keepdims, exclude=exclude)
+
+
+@register("log_sum_exp")
+def log_sum_exp(data, *, axis=None, keepdims=False):
+    axes = None if axis is None else _norm_axis(axis, data.ndim)
+    return jax.nn.logsumexp(data, axis=axes, keepdims=keepdims)

@@ -1,0 +1,8 @@
+"""``mx.optimizer`` namespace (parity: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta,
+                        RMSProp, Ftrl, SignSGD, Signum, LAMB, Test,
+                        create, register, get_updater, Updater)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "SignSGD", "Signum", "LAMB", "Test",
+           "create", "register", "get_updater", "Updater"]

@@ -1,0 +1,582 @@
+"""Optimizer frontends.
+
+Capability parity: reference ``python/mxnet/optimizer/optimizer.py``
+(SURVEY.md §2.5): registry + ``create``, per-param lr_mult/wd_mult (set
+explicitly or via ``param_dict``), update-count tracking, multi-precision
+master weights, and the ``Updater`` closure consumed by KVStore server-side
+updates.  As in the reference, the math itself runs as device-side update
+ops (``mxnet_tpu/ops/optimizer_ops.py``); lr/wd ride as dynamic 0-d arrays
+so schedulers never trigger recompilation.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "SignSGD", "Signum", "LAMB", "Test",
+           "create", "register", "get_updater", "Updater"]
+
+
+class Optimizer:
+    """Base optimizer."""
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError(f"Cannot find optimizer {name!r}")
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- lr/wd bookkeeping -------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        lr = self.learning_rate
+        lrs = [lr] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy, self.create_state(
+                index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype("float32")
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight_master_copy.copyto(weight)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _clip(self):
+        return -1.0 if self.clip_gradient is None else float(
+            self.clip_gradient)
+
+    def __getstate__(self):
+        # param_dict holds live device Parameters (unpicklable and
+        # rebindable on load) — Trainer restores it after unpickling
+        state = self.__dict__.copy()
+        state["param_dict"] = {}
+        return state
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(learning_rate={self.lr})"
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _zeros_like(weight, dtype=None):
+    return nd.zeros(weight.shape, ctx=weight.context,
+                    dtype=dtype or weight.dtype.name)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference SGD)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                              momentum=self.momentum,
+                              rescale_grad=self.rescale_grad,
+                              clip_gradient=self._clip(),
+                              out=[weight, state])
+        else:
+            nd.sgd_update(weight, grad, lr=lr, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self._clip(), out=weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            weight32 = state[0] if isinstance(state, tuple) else state
+            mom = state[1] if isinstance(state, tuple) else None
+            if self.momentum != 0.0 and mom is None:
+                mom = _zeros_like(weight32)
+            if self.momentum != 0.0:
+                nd.mp_sgd_mom_update(weight, grad, mom, weight32, lr=lr,
+                                     wd=wd, momentum=self.momentum,
+                                     rescale_grad=self.rescale_grad,
+                                     clip_gradient=self._clip(),
+                                     out=[weight, mom, weight32])
+            else:
+                nd.mp_sgd_update(weight, grad, weight32, lr=lr, wd=wd,
+                                 rescale_grad=self.rescale_grad,
+                                 clip_gradient=self._clip(),
+                                 out=[weight, weight32])
+        else:
+            self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            weight32 = weight.astype("float32")
+            mom = _zeros_like(weight32) if self.momentum != 0.0 else None
+            return (weight32, mom)
+        return self.create_state(index, weight)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nd.nag_mom_update(weight, grad, state, lr=lr, wd=wd,
+                          momentum=self.momentum,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self._clip(), out=[weight, state])
+
+
+@register
+class Adam(Optimizer):
+    """Adam (bias correction applied on lr, matching the reference)."""
+
+    def __init__(self, learning_rate=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=0.001 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))  # mean, var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=self._clip(),
+                       out=[weight, mean, var])
+
+
+@register
+class AdamW(Optimizer):
+    """AdamW: decoupled weight decay (reference contrib adamw_update)."""
+
+    def __init__(self, learning_rate=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=0.001 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adamw_update(weight, grad, mean, var, lr=lr, eta=1.0, wd=wd,
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self._clip(),
+                        out=[weight, mean, var])
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nd.adagrad_update(weight, grad, state, lr=lr, wd=wd,
+                          epsilon=self.float_stable_eps,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self._clip(), out=[weight, state])
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        nd.adadelta_update(weight, grad, acc_g, acc_delta, wd=wd,
+                           rho=self.rho, epsilon=self.epsilon,
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self._clip(),
+                           out=[weight, acc_g, acc_delta])
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True uses Alex Graves' variant (reference)."""
+
+    def __init__(self, learning_rate=None, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=0.001 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))  # n, g, delta
+        return _zeros_like(weight)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        cw = -1.0 if self.clip_weights is None else float(self.clip_weights)
+        if not self.centered:
+            nd.rmsprop_update(weight, grad, state, lr=lr, wd=wd,
+                              gamma1=self.gamma1, epsilon=self.epsilon,
+                              rescale_grad=self.rescale_grad,
+                              clip_gradient=self._clip(), clip_weights=cw,
+                              out=[weight, state])
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta, lr=lr, wd=wd,
+                                  gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon,
+                                  rescale_grad=self.rescale_grad,
+                                  clip_gradient=self._clip(),
+                                  clip_weights=cw,
+                                  out=[weight, n, g, delta])
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=None, beta=1.0, **kwargs):
+        super().__init__(learning_rate=0.1 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
+                       beta=self.beta, rescale_grad=self.rescale_grad,
+                       clip_gradient=self._clip(), out=[weight, z, n])
+
+
+@register
+class SignSGD(Optimizer):
+    def __init__(self, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=0.01 if learning_rate is None
+                         else learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nd.signsgd_update(weight, grad, lr=lr, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self._clip(), out=weight)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=None, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=0.01 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nd.signum_update(weight, grad, state, lr=lr, wd=wd,
+                         momentum=self.momentum, wd_lh=self.wd_lh,
+                         rescale_grad=self.rescale_grad,
+                         clip_gradient=self._clip(), out=[weight, state])
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB (layer-wise adaptive moments for large-batch training)."""
+
+    def __init__(self, learning_rate=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=0.001 if learning_rate is None
+                         else learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = nd.lamb_update_phase1(weight, grad, mean, var, wd=wd,
+                                  beta1=self.beta1, beta2=self.beta2,
+                                  epsilon=self.epsilon, t=t,
+                                  bias_correction=self.bias_correction,
+                                  rescale_grad=self.rescale_grad,
+                                  clip_gradient=self._clip())
+        g_update, new_mean, new_var = g
+        new_mean.copyto(mean)
+        new_var.copyto(var)
+        r1 = weight.norm()
+        r2 = g_update.norm()
+        lb = -1.0 if self.lower_bound is None else float(self.lower_bound)
+        ub = -1.0 if self.upper_bound is None else float(self.upper_bound)
+        nd.lamb_update_phase2(weight, g_update, r1, r2, lr=lr,
+                              lower_bound=lb, upper_bound=ub, out=weight)
+
+
+@register
+class Test(Optimizer):
+    """Reference's Test optimizer: w -= lr * (grad*rescale + wd*w)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nd.sgd_update(weight, grad, lr=lr, wd=wd,
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=self._clip(), out=weight)
+
+
+class Updater:
+    """Closure applying optimizer updates; the kvstore updater (parity:
+    ``mxnet.optimizer.Updater`` / server-side ApplyUpdates)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        indices = index if isinstance(index, (list, tuple)) else [index]
+        grads = grad if isinstance(grad, (list, tuple)) else [grad]
+        weights = weight if isinstance(weight, (list, tuple)) else [weight]
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: _states_to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple) and len(loaded) == 2 and \
+                isinstance(loaded[1], Optimizer):
+            states, self.optimizer = loaded
+        else:
+            states = loaded
+        self.states = {k: _states_from_np(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def _states_to_np(state):
+    from ..ndarray.ndarray import NDArray
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return ("nd", state.asnumpy())
+    if isinstance(state, (list, tuple)):
+        return ("tuple", [_states_to_np(s) for s in state])
+    return ("raw", state)
+
+
+def _states_from_np(state):
+    if state is None:
+        return None
+    kind, val = state
+    if kind == "nd":
+        return nd.array(val, dtype=val.dtype)
+    if kind == "tuple":
+        return tuple(_states_from_np(s) for s in val)
+    return val
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -21,6 +21,25 @@ __all__ = ["seed", "uniform", "normal", "randn", "randint", "exponential",
 _keys = {}
 _DEFAULT_SEED = 0
 
+# CachedOp tracing hook: while a hybridized graph is being traced, RNG keys
+# must be *inputs* to the graph (a constant key would freeze every dropout
+# mask).  CachedOp pushes a provider that derives per-request keys from a
+# traced base key; `_next_key_nd` consults it first.
+import threading as _threading
+
+_key_provider = _threading.local()
+
+
+def _push_key_provider(fn):
+    stack = getattr(_key_provider, "stack", None)
+    if stack is None:
+        stack = _key_provider.stack = []
+    stack.append(fn)
+
+
+def _pop_key_provider():
+    _key_provider.stack.pop()
+
 
 def _jax():
     import jax
@@ -53,6 +72,9 @@ def _next_key(ctx: Context):
 def _next_key_nd(ctx: Context):
     """Key as a raw-data NDArray on ctx (ops re-wrap via wrap_key_data)."""
     from .ndarray.ndarray import NDArray
+    stack = getattr(_key_provider, "stack", None)
+    if stack:
+        return stack[-1](ctx)
     jax = _jax()
     sub = _next_key(ctx)
     raw = jax.random.key_data(sub)

@@ -1,0 +1,354 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests.
+
+Mirrors the reference's tests/python/unittest/test_gluon.py strategy:
+NumPy oracles for layer math, deferred-init behavior, hybridize
+consistency (imperative vs compiled must agree), save/load round-trips.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu(0))
+    assert p.name == "weight"
+    assert p.shape == (10, 10)
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_deferred():
+    p = gluon.Parameter("weight", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (4, 7)
+
+
+def test_paramdict(tmp_path):
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu(0))
+    f = str(tmp_path / "test_paramdict.params")
+    params.save(f)
+    params.load(f, mx.cpu(0))
+
+
+def test_paramdict_conflicts():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 0), dtype="float32")
+    # wildcard merge OK
+    p = params.get("weight", shape=(10, 5))
+    assert p.shape == (10, 5)
+    with pytest.raises(AssertionError):
+        params.get("weight", shape=(10, 7))
+    with pytest.raises(AssertionError):
+        params.get("weight", dtype="float16")
+
+
+def test_explicit_initializers_win():
+    net = nn.Dense(3, in_units=2, bias_initializer="ones")
+    net.initialize()
+    assert_almost_equal(net.bias.data().asnumpy(), np.ones(3))
+    bn = nn.BatchNorm(in_channels=4,
+                      gamma_initializer=mx.init.Constant(0.5))
+    bn.initialize()
+    assert_almost_equal(bn.gamma.data().asnumpy(), np.full(4, 0.5))
+
+
+def test_dense():
+    net = nn.Dense(5, in_units=3, use_bias=True)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(8, 3))
+    y = net(x)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    expected = x.asnumpy() @ w.T + b
+    assert_almost_equal(y.asnumpy(), expected)
+
+
+def test_dense_deferred_and_flatten():
+    net = nn.Dense(5)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 3, 2))
+    y = net(x)  # flatten=True: in_units inferred as 6
+    assert net.weight.shape == (5, 6)
+    assert y.shape == (4, 5)
+
+    net2 = nn.Dense(5, flatten=False)
+    net2.initialize()
+    y2 = net2(x)
+    assert net2.weight.shape == (5, 2)
+    assert y2.shape == (4, 3, 5)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sliced = net[1:]
+    assert len(sliced) == 2
+
+
+def test_hybridize_consistency():
+    """Compiled path must match imperative path exactly-ish."""
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"),
+                nn.Dense(8, activation="tanh"),
+                nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(5, 12))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    assert_almost_equal(y_imp, y_hyb)
+
+
+def test_hybridize_grad_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 6))
+
+    def grads():
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        return {name: p.grad().asnumpy().copy()
+                for name, p in net.collect_params().items()}
+
+    g_imp = grads()
+    net.hybridize()
+    g_hyb = grads()
+    for k in g_imp:
+        assert_almost_equal(g_imp[k], g_hyb[k])
+
+
+def test_batchnorm_running_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 3, 2, 2) * 5 + 2)
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # moving mean moved
+    # eval mode uses running stats, output differs from train mode
+    y_eval = net(x)
+    assert y_eval.shape == x.shape
+
+
+def test_batchnorm_numerics():
+    net = nn.BatchNorm(in_channels=4, momentum=0.9, epsilon=1e-5)
+    net.initialize()
+    x_np = np.random.rand(8, 4, 3, 3).astype("float32")
+    x = mx.nd.array(x_np)
+    with autograd.record():
+        y = net(x)
+    mean = x_np.mean(axis=(0, 2, 3), keepdims=True)
+    var = x_np.var(axis=(0, 2, 3), keepdims=True)
+    expected = (x_np - mean) / np.sqrt(var + 1e-5)
+    assert_almost_equal(y.asnumpy(), expected, rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8))
+    y = net(x)
+    assert y.shape == (2, 8, 8, 8)
+    # deferred in_channels
+    net2 = nn.Conv2D(4, kernel_size=3)
+    net2.initialize()
+    y2 = net2(x)
+    assert net2.weight.shape == (4, 3, 3, 3)
+    assert y2.shape == (2, 4, 6, 6)
+
+
+def test_conv_pool_hybrid():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Conv2D(16, 3, padding=1),
+                nn.GlobalAvgPool2D(),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 16, 16))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    assert y_hyb.shape == (2, 10)
+    assert_almost_equal(y_imp, y_hyb)
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = mx.nd.array(np.array([1, 2, 3]))
+    y = net(idx)
+    assert y.shape == (3, 4)
+    w = net.weight.data().asnumpy()
+    assert_almost_equal(y.asnumpy(), w[[1, 2, 3]])
+
+
+def test_dropout_train_vs_eval():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = mx.nd.ones((100, 100))
+    y_eval = net(x)
+    assert_almost_equal(y_eval.asnumpy(), x.asnumpy())
+    with autograd.record():
+        y_train = net(x)
+    frac_zero = (y_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_layernorm():
+    net = nn.LayerNorm(in_channels=8)
+    net.initialize()
+    x_np = np.random.rand(4, 8).astype("float32")
+    y = net(mx.nd.array(x_np)).asnumpy()
+    mean = x_np.mean(-1, keepdims=True)
+    var = x_np.var(-1, keepdims=True)
+    assert_almost_equal(y, (x_np - mean) / np.sqrt(var + 1e-5),
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_block_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(3, in_units=8))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 4))
+    y1 = net(x).asnumpy()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(3, in_units=8))
+    net2.load_parameters(f)
+    y2 = net2(x).asnumpy()
+    assert_almost_equal(y1, y2)
+
+
+def test_trainer_sgd_momentum():
+    """Trainer+SGD must match a NumPy reference updater."""
+    net = nn.Dense(3, in_units=4, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=None)
+    x = mx.nd.ones((2, 4))
+    w0 = net.weight.data().asnumpy().copy()
+    mom = np.zeros_like(w0)
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+            loss = y.sum()
+        loss.backward()
+        g = net.weight.grad().asnumpy() / 2.0
+        mom = 0.9 * mom - 0.1 * g
+        w0 = w0 + mom
+        trainer.step(2)
+    assert_almost_equal(net.weight.data().asnumpy(), w0, rtol=1e-5)
+
+
+def test_trainer_learning_rate():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, kvstore=None)
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore=None)
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_constant_parameter():
+    const = gluon.Constant("const", mx.nd.array([[1.0, 2.0]]))
+    const.initialize()
+    assert const.grad_req == "null"
+    assert_almost_equal(const.data().asnumpy(), np.array([[1.0, 2.0]]))
+
+
+def test_share_parameters():
+    d1 = nn.Dense(4, in_units=4)
+    d2 = nn.Dense(4, in_units=4, params=d1.params)
+    d1.initialize()
+    x = mx.nd.array(np.random.rand(2, 4))
+    assert_almost_equal(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_lambda_blocks():
+    net = nn.HybridLambda(lambda F, x: F.relu(x))
+    x = mx.nd.array(np.array([-1.0, 2.0]))
+    assert_almost_equal(net(x).asnumpy(), np.array([0.0, 2.0]))
+    net2 = nn.Lambda("relu")
+    assert_almost_equal(net2(x).asnumpy(), np.array([0.0, 2.0]))
+
+
+def test_activations_layers():
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.5, 2.0], dtype="float32"))
+    for layer, ref in [
+            (nn.LeakyReLU(0.1), lambda v: np.where(v > 0, v, 0.1 * v)),
+            (nn.ELU(1.0), lambda v: np.where(v > 0, v, np.exp(v) - 1)),
+            (nn.SiLU(), lambda v: v / (1 + np.exp(-v)))]:
+        layer.initialize()
+        assert_almost_equal(layer(x).asnumpy(), ref(x.asnumpy()),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_split_and_load():
+    data = mx.nd.arange(0, 16).reshape((8, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 2)
+    assert_almost_equal(np.concatenate([p.asnumpy() for p in parts]),
+                        data.asnumpy())
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((3,)) * 3, mx.nd.ones((4,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert total <= 1.01
+
+
+def test_summary(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=8), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.summary(mx.nd.ones((1, 8)))
+    out = capsys.readouterr().out
+    assert "Total params" in out

@@ -1,0 +1,296 @@
+"""Metric / loss / io tests (reference test_metric.py, test_loss.py,
+test_io.py strategies: NumPy oracles)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert m.get() == ("accuracy", 2.0 / 3)
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    name, val = m.get()
+    assert val == 1.0
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([1.0, 2.0, 3.0])
+    label = mx.nd.array([1.5, 2.0, 2.5])
+    for name, expected in [("mse", np.mean([0.25, 0, 0.25])),
+                           ("mae", np.mean([0.5, 0, 0.5])),
+                           ("rmse", np.sqrt(np.mean([0.25, 0, 0.25])))]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expected) < 1e-6
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["accuracy", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.np(lambda label, pred: float(np.sum(label == label)))
+    assert isinstance(m2, mx.metric.CustomMetric)
+
+
+def test_metric_reset_and_nan():
+    m = mx.metric.Accuracy()
+    assert np.isnan(m.get()[1])
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_l2_loss():
+    loss = gluon.loss.L2Loss()
+    pred = mx.nd.array([[1.0, 2.0]])
+    label = mx.nd.array([[1.5, 1.0]])
+    out = loss(pred, label).asnumpy()
+    assert_almost_equal(out, np.array([0.5 * (0.25 + 1.0) / 2]))
+
+
+def test_l1_loss():
+    loss = gluon.loss.L1Loss()
+    pred = mx.nd.array([[1.0, 2.0]])
+    label = mx.nd.array([[1.5, 1.0]])
+    assert_almost_equal(loss(pred, label).asnumpy(),
+                        np.array([(0.5 + 1.0) / 2]))
+
+
+def test_softmax_ce_loss_sparse():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    out = loss(pred, label).asnumpy()
+    logp = pred.asnumpy() - np.log(
+        np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    expected = -np.array([logp[0, 2], logp[1, 0]])
+    assert_almost_equal(out, expected, rtol=1e-4)
+
+
+def test_softmax_ce_loss_dense():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    pred = mx.nd.array([[1.0, 2.0, 3.0]])
+    label = mx.nd.array([[0.0, 0.0, 1.0]])
+    out = loss(pred, label).asnumpy()
+    logp = pred.asnumpy() - np.log(np.exp(pred.asnumpy()).sum())
+    assert_almost_equal(out, -np.array([logp[0, 2]]), rtol=1e-4)
+
+
+def test_sigmoid_bce():
+    loss = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    pred = mx.nd.array([[0.5, -0.5]])
+    label = mx.nd.array([[1.0, 0.0]])
+    p = 1 / (1 + np.exp(-pred.asnumpy()))
+    expected = -(label.asnumpy() * np.log(p)
+                 + (1 - label.asnumpy()) * np.log(1 - p)).mean(-1)
+    assert_almost_equal(loss(pred, label).asnumpy(), expected, rtol=1e-4)
+
+
+def test_huber_hinge():
+    pred = mx.nd.array([[0.1, 2.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    out = gluon.loss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    expected = np.mean([0.5 * 0.01, 2.0 - 0.5])
+    assert_almost_equal(out, np.array([expected]), rtol=1e-4)
+
+    out = gluon.loss.HingeLoss()(mx.nd.array([[0.5]]),
+                                 mx.nd.array([[1.0]])).asnumpy()
+    assert_almost_equal(out, np.array([0.5]), rtol=1e-5)
+
+
+def test_kl_div():
+    loss = gluon.loss.KLDivLoss(from_logits=False)
+    pred = mx.nd.array([[1.0, 2.0]])
+    label = mx.nd.array([[0.3, 0.7]])
+    logp = pred.asnumpy() - np.log(np.exp(pred.asnumpy()).sum())
+    expected = (label.asnumpy() * (np.log(label.asnumpy() + 1e-12)
+                                   - logp)).mean(-1)
+    assert_almost_equal(loss(pred, label).asnumpy(), expected, rtol=1e-4)
+
+
+def test_loss_backward():
+    loss = gluon.loss.L2Loss()
+    pred = mx.nd.array([[1.0, 2.0]])
+    pred.attach_grad()
+    label = mx.nd.array([[0.0, 0.0]])
+    with autograd.record():
+        L = loss(pred, label)
+    L.backward()
+    assert_almost_equal(pred.grad.asnumpy(), pred.asnumpy() / 2)
+
+
+# -- io ---------------------------------------------------------------------
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype("f4")
+    label = np.arange(10).astype("f4")
+    it = mx.io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(14).reshape(7, 2).astype("f4")
+    it = mx.io.NDArrayIter(data, None, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 3
+    assert batches[1].data[0].shape == (5, 2)
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(14).reshape(7, 2).astype("f4")
+    it = mx.io.NDArrayIter(data, None, batch_size=5,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(20).reshape(10, 2).astype("f4")
+    label = np.arange(10).astype("f4")
+    it = mx.io.NDArrayIter(data, label, batch_size=10, shuffle=True)
+    batch = next(iter(it))
+    d, l = batch.data[0].asnumpy(), batch.label[0].asnumpy()
+    # shuffled consistently: data row i pairs with label i
+    assert (d[:, 0] // 2 == l).all()
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), dtype="f4")
+    base = mx.io.NDArrayIter(data, None, batch_size=5)
+    it = mx.io.ResizeIter(base, 5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(10, 4).astype("f4")
+    base = mx.io.NDArrayIter(data, None, batch_size=5)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_dataloader_and_dataset():
+    X = np.random.rand(20, 3).astype("f4")
+    y = np.arange(20).astype("f4")
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 5
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    assert_almost_equal(xb.asnumpy(), X[:4])
+
+
+def test_dataloader_workers():
+    X = np.random.rand(32, 2).astype("f4")
+    ds = gluon.data.ArrayDataset(X)
+    loader = gluon.data.DataLoader(ds, batch_size=8, num_workers=2)
+    total = sum(b.shape[0] for b in loader)
+    assert total == 32
+
+
+def test_dataset_transform_shard():
+    ds = gluon.data.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: x * 2)
+    assert doubled[3] == 6
+    shard = ds.shard(3, 0)
+    assert len(shard) == 4  # 10 = 4+3+3
+
+
+def test_batch_sampler():
+    s = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3,
+                                last_batch="keep")
+    assert [len(b) for b in s] == [3, 3, 1]
+    s = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3,
+                                last_batch="discard")
+    assert [len(b) for b in s] == [3, 3]
+
+
+def test_synthetic_mnist_dataset():
+    from mxnet_tpu.gluon.data.vision import MNIST, transforms
+    ds = MNIST(train=True, synthetic=16)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    tds = ds.transform_first(transforms.ToTensor())
+    img2, _ = tds[0]
+    assert img2.shape == (1, 28, 28)
+    assert float(img2.asnumpy().max()) <= 1.0
+
+
+def test_transforms_compose():
+    from mxnet_tpu.gluon.data.vision import transforms
+    t = transforms.Compose([transforms.Resize(14), transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.5)])
+    img = mx.nd.array(np.random.randint(0, 255, (28, 28, 3)), dtype="uint8")
+    out = t(img)
+    assert out.shape == (3, 14, 14)
+
+
+def test_ndarray_iter_roll_over():
+    data = np.arange(10).reshape(5, 2).astype("f4")
+    it = mx.io.NDArrayIter(data, None, batch_size=2,
+                           last_batch_handle="roll_over")
+    ep1 = [b.data[0].asnumpy() for b in it]
+    assert len(ep1) == 2  # remainder of 1 sample cached
+    it.reset()
+    ep2 = [b.data[0].asnumpy() for b in it]
+    # first batch of epoch 2 = cached row 4 + row 0
+    assert_almost_equal(ep2[0], np.array([[8, 9], [0, 1]], dtype="f4"))
+    assert_almost_equal(ep2[1], np.array([[2, 3], [4, 5]], dtype="f4"))
+
+
+def test_metric_str_and_reset_local():
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([1, 0])], [mx.nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    assert "accuracy" in str(m)
+    f1 = mx.metric.F1(average="micro")
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    f1.update([label], [pred])
+    f1.reset_local()
+    f1.update([label], [pred])
+    assert f1.num_inst == 2
+
+
+def test_resize_keep_ratio():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array(np.random.randint(0, 255, (200, 400, 3)),
+                      dtype="uint8")
+    out = transforms.Resize((100, 50), keep_ratio=True)(img)
+    assert out.shape[0] <= 50 and out.shape[1] <= 100

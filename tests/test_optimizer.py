@@ -1,0 +1,160 @@
+"""Optimizer tests vs slow NumPy reference updaters (reference
+test_optimizer.py strategy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, w_np, g_fn, n=4):
+    w = mx.nd.array(w_np.copy())
+    state = opt.create_state(0, w)
+    for t in range(n):
+        g = mx.nd.array(g_fn(t))
+        opt.update(0, w, g, state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    np.random.seed(0)
+    w0 = np.random.rand(5, 4).astype("float32")
+    grads = [np.random.rand(5, 4).astype("float32") for _ in range(4)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    got = _run_steps(opt, w0, lambda t: grads[t])
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for g in grads:
+        gg = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    np.random.seed(1)
+    w0 = np.random.rand(6,).astype("float32")
+    grads = [np.random.rand(6,).astype("float32") for _ in range(5)]
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    got = _run_steps(opt, w0, lambda t: grads[t], n=5)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    np.random.seed(2)
+    w0 = np.random.rand(4,).astype("float32")
+    grads = [np.random.rand(4,).astype("float32") for _ in range(3)]
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.95)
+    got = _run_steps(opt, w0, lambda t: grads[t], n=3)
+    w = w0.copy()
+    n_state = np.zeros_like(w)
+    for g in grads:
+        n_state = 0.95 * n_state + 0.05 * g * g
+        w = w - 0.01 * g / np.sqrt(n_state + 1e-8)
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    w0 = np.ones(3, dtype="float32")
+    g = np.array([0.5, 1.0, 2.0], dtype="float32")
+    opt = mx.optimizer.AdaGrad(learning_rate=0.1)
+    got = _run_steps(opt, w0, lambda t: g, n=2)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for _ in range(2):
+        h += g * g
+        w = w - 0.1 * g / (np.sqrt(h) + 1e-7)
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_signum():
+    w0 = np.array([1.0, -1.0], dtype="float32")
+    g = np.array([0.3, -0.7], dtype="float32")
+    opt = mx.optimizer.Signum(learning_rate=0.1, momentum=0.0)
+    got = _run_steps(opt, w0, lambda t: g, n=1)
+    assert_almost_equal(got, w0 - 0.1 * np.sign(g), rtol=1e-6)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(2, dtype="float32")
+    g = np.array([10.0, -10.0], dtype="float32")
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0)
+    got = _run_steps(opt, w0, lambda t: g, n=1)
+    assert_almost_equal(got, np.array([-1.0, 1.0]), rtol=1e-6)
+
+
+def test_create_and_registry():
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    assert isinstance(opt, mx.optimizer.Adam)
+    assert opt.learning_rate == 0.1
+    with pytest.raises(mx.MXNetError):
+        mx.optimizer.create("doesnotexist")
+
+
+def test_lr_mult_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "a_weight", 1: "b_weight"})
+    opt.set_lr_mult({"a_weight": 0.5})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    opt.set_wd_mult({"b_weight": 2.0})
+    assert opt._get_wd(1) == 0.0  # wd=0 base
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=1.0)
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                                 base_lr=1.0)
+    assert sched(3) == 1.0
+    assert abs(sched(7) - 0.1) < 1e-9
+    assert abs(sched(12) - 0.01) < 1e-9
+
+
+def test_lr_scheduler_warmup_cosine():
+    sched = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                            final_lr=0.0, warmup_steps=10,
+                                            warmup_begin_lr=0.0)
+    assert sched(0) == 0.0
+    assert sched(5) == 0.5
+    assert abs(sched(10) - 1.0) < 1e-9
+    assert sched(100) < 1e-9
+
+
+def test_optimizer_in_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.9, base_lr=1.0)
+    opt = mx.optimizer.SGD(lr_scheduler=sched, learning_rate=1.0)
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    state = opt.create_state(0, w)
+    for _ in range(3):
+        opt.update(0, w, g, state)
+    # lr decayed without recompiling (dynamic scalar path)
+    assert opt.learning_rate < 1.0
+
+
+def test_multi_precision_sgd():
+    w16 = mx.nd.array(np.ones(4), dtype="float16")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    state = opt.create_state_multi_precision(0, w16)
+    assert state[0].dtype == np.float32
+    g = mx.nd.array(np.ones(4), dtype="float16")
+    opt.update_multi_precision(0, w16, g, state)
+    assert w16.dtype == np.float16
+    assert_almost_equal(w16.asnumpy().astype("f4"),
+                        np.full(4, 0.9, dtype="f4"), rtol=1e-2)
