@@ -33,9 +33,12 @@ from . import metric
 from . import io
 from . import gluon
 from . import test_utils
+from . import kvstore
+from . import kvstore as kv
+from . import parallel
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
            "num_gpus", "num_tpus", "Context", "MXNetError", "engine",
            "initializer", "init", "lr_scheduler", "optimizer", "gluon",
-           "metric", "io", "test_utils"]
+           "metric", "io", "test_utils", "kvstore", "kv", "parallel"]

@@ -375,7 +375,7 @@ class CachedOp:
         self.name = f"cachedop_{block.name}_{CachedOp._uid[0]}"
 
     def _collect_param_arrays(self, args):
-        """Stable ordered list of all param NDArrays (init if deferred)."""
+        """Stable ordered list of param NDArray replicas for the call ctx."""
         if self._param_list is None:
             params = list(self.block.collect_params().values())
             if any(p._deferred_init for p in params):
@@ -384,12 +384,20 @@ class CachedOp:
                 with autograd.pause():
                     self.block._call_unhybridized(*args)
             self._param_list = params
-        return [p._check_and_get(p._data, None) for p in self._param_list]
+        ctx = args[0].context if args else None
+        out = []
+        for p in self._param_list:
+            d = p._check_and_get(p._data, None)
+            if ctx is not None and ctx != d.context:
+                d = p.data(ctx)
+            out.append(d)
+        return out
 
     def _get_entry(self, param_nds, args, training) -> _CacheEntry:
+        ctx = args[0].context if args else current_context()
         key = (tuple((a.shape, a.dtype.name) for a in args),
                tuple((p.shape, p.dtype.name) for p in param_nds),
-               training)
+               training, ctx)
         entry = self._entries.get(key)
         if entry is not None:
             return entry
@@ -397,18 +405,21 @@ class CachedOp:
         entry = _CacheEntry()
         block = self.block
         params = self._param_list
-        ctx = args[0].context if args else current_context()
-        n_params = len(params)
+        n_params = len(param_nds)
         n_args = len(args)
 
         def pure(*flat):
             """Functionalized forward: (params…, inputs…, base_key) →
             (outputs…, mutated-param-values…)."""
             from .. import random as _rnd
+            # resolve the per-context replica NDArrays at trace time from
+            # the Parameter objects, so the closure never pins stale
+            # buffers across load_parameters/reset_ctx
+            reps = [p.data(ctx) for p in params]
             param_vals = flat[:n_params]
             input_vals = flat[n_params:n_params + n_args]
             base_key_raw = flat[-1]
-            saved = [(p._data._buf, p._data._version) for p in params]
+            saved = [(r._buf, r._version) for r in reps]
             key_counter = [0]
 
             def key_provider(_ctx):
@@ -417,8 +428,8 @@ class CachedOp:
                 key_counter[0] += 1
                 return NDArray(jax.random.key_data(k), ctx=ctx)
 
-            for p, v in zip(params, param_vals):
-                p._data._buf = v
+            for r, v in zip(reps, param_vals):
+                r._buf = v
             shells = [NDArray(v, ctx=ctx) for v in input_vals]
             _rnd._push_key_provider(key_provider)
             prev_tracing = getattr(_trace_state, "active", False)
@@ -429,16 +440,16 @@ class CachedOp:
                 outs_l = list(outs) if out_is_list else [outs]
                 out_data = tuple(o._data for o in outs_l)
                 mutated_idx = tuple(
-                    i for i, (p, s) in enumerate(zip(params, saved))
-                    if p._data._version != s[1])
-                mutated_vals = tuple(params[i]._data._buf
+                    i for i, (r, s) in enumerate(zip(reps, saved))
+                    if r._version != s[1])
+                mutated_vals = tuple(reps[i]._buf
                                      for i in mutated_idx)
             finally:
                 _trace_state.active = prev_tracing
                 _rnd._pop_key_provider()
-                for p, (buf, ver) in zip(params, saved):
-                    p._data._buf = buf
-                    p._data._version = ver
+                for r, (buf, ver) in zip(reps, saved):
+                    r._buf = buf
+                    r._version = ver
             entry.n_real_out = len(out_data)
             entry.mutated_idx = mutated_idx
             entry.out_is_list = out_is_list
@@ -488,9 +499,10 @@ class CachedOp:
 
         real = out_all[:entry.n_real_out]
         aux = out_all[entry.n_real_out:]
-        # write mutated params back (outside the tape, like aux updates)
+        # write mutated params back (outside the tape, like aux updates) —
+        # into the per-context replicas used for this call
         for i, val in zip(entry.mutated_idx, aux):
-            self._param_list[i]._data._set_data(val)
+            param_nds[i]._set_data(val)
 
         outs = []
         for i, o in enumerate(real):
@@ -559,13 +571,15 @@ class HybridBlock(Block):
 
     def _call_unhybridized(self, *args):
         """Run hybrid_forward imperatively, resolving deferred init."""
+        ctx = args[0].context if args and isinstance(args[0], NDArray) \
+            else None
         try:
-            params = {k: p.data() for k, p in self._reg_params.items()}
+            params = {k: p.data(ctx) for k, p in self._reg_params.items()}
         except DeferredInitializationError:
             self._deferred_infer_shape(*args)
             for p in self._reg_params.values():
                 p._finish_deferred_init()
-            params = {k: p.data() for k, p in self._reg_params.items()}
+            params = {k: p.data(ctx) for k, p in self._reg_params.items()}
         return self.hybrid_forward(nd, *args, **params)
 
     def _deferred_infer_shape(self, *args):

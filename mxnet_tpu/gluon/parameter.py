@@ -35,6 +35,11 @@ class Parameter:
         self._var = None
         self._data: Optional[NDArray] = None
         self._grad: Optional[NDArray] = None
+        # extra per-context replicas beyond the primary (_data); keyed by
+        # Context.  Single-device training never populates this — the common
+        # path stays replica-free.  Multi-device DP (ctx=[...]) stores one
+        # replica per context and the kvstore reduces grads across them.
+        self._replicas = OrderedDict()
         self._deferred_init = ()
         self.name = name
         self._shape = tuple(shape) if shape is not None else None
@@ -72,9 +77,9 @@ class Parameter:
         self._grad_req = req
         if req == "null":
             self._grad = None
-            if self._data is not None:
-                self._data.grad_req = "null"
-                self._data._grad = None
+            for arr in self._all_replicas():
+                arr.grad_req = "null"
+                arr._grad = None
         elif self._data is not None:
             self._init_grad()
 
@@ -139,13 +144,27 @@ class Parameter:
             else:
                 chosen(initializer.InitDesc(self.name), host)
             data = nd.array(host, ctx=ctx[0], dtype=self.dtype)
+        else:
+            # deferred set_data payload may live on another device
+            data = data.as_in_context(ctx[0])
         self._ctx = ctx[0]
         self._data = data
+        self._replicas = OrderedDict()
+        for c in ctx[1:]:
+            self._replicas[c] = data.as_in_context(c)
         if self._grad_req != "null":
             self._init_grad()
 
+    def _all_replicas(self):
+        out = []
+        if self._data is not None:
+            out.append(self._data)
+        out.extend(self._replicas.values())
+        return out
+
     def _init_grad(self):
-        self._data.attach_grad(grad_req=self._grad_req)
+        for arr in self._all_replicas():
+            arr.attach_grad(grad_req=self._grad_req)
         self._grad = self._data._grad
 
     def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source=""):
@@ -171,6 +190,9 @@ class Parameter:
         self._deferred_init = ()
         self._ctx = ctx[0]
         self._data = data.as_in_context(ctx[0])
+        self._replicas = OrderedDict()
+        for c in ctx[1:]:
+            self._replicas[c] = self._data.as_in_context(c)
         if self._grad_req != "null":
             self._init_grad()
 
@@ -190,21 +212,36 @@ class Parameter:
     def data(self, ctx=None) -> NDArray:
         d = self._check_and_get(self._data, ctx)
         if ctx is not None and isinstance(ctx, Context) and ctx != d.context:
-            return d.as_in_context(ctx)
+            rep = self._replicas.get(ctx)
+            if rep is not None:
+                return rep
+            raise MXNetError(
+                f"Parameter {self.name!r} was not initialized on context "
+                f"{ctx}. It was only initialized on {self.list_ctx()}.")
         return d
 
     def list_data(self) -> List[NDArray]:
-        return [self._check_and_get(self._data, None)]
+        self._check_and_get(self._data, None)
+        return self._all_replicas()
 
     def grad(self, ctx=None) -> NDArray:
         if self._data is not None and self._grad is None:
             raise MXNetError(
                 f"Cannot get gradient array for Parameter {self.name!r} "
                 "because grad_req='null'")
-        return self._check_and_get(self._grad, ctx)
+        g = self._check_and_get(self._grad, ctx)
+        if ctx is not None and isinstance(ctx, Context) and ctx != self._ctx:
+            rep = self._replicas.get(ctx)
+            if rep is None:
+                raise MXNetError(
+                    f"Parameter {self.name!r} was not initialized on "
+                    f"context {ctx}.")
+            return rep._grad
+        return g
 
     def list_grad(self) -> List[NDArray]:
-        return [self.grad()]
+        self.grad()
+        return [arr._grad for arr in self._all_replicas()]
 
     def list_ctx(self):
         if self._data is None:
@@ -212,12 +249,14 @@ class Parameter:
                 return self._deferred_init[1]
             raise MXNetError(f"Parameter {self.name!r} has not been "
                              "initialized")
-        return [self._ctx]
+        return [self._ctx] + list(self._replicas.keys())
 
     def zero_grad(self):
         if self._grad is None:
             return
-        self._grad[:] = 0
+        for arr in self._all_replicas():
+            if arr._grad is not None:
+                arr._grad[:] = 0
 
     def set_data(self, data):
         self.shape = data.shape
@@ -232,12 +271,16 @@ class Parameter:
             src = nd.array(data, dtype=self.dtype)
         # buffer swap preserves the autograd leaf & grad buffer
         self._data._set_data(src._data.astype(self._data.dtype.name))
+        for rep in self._replicas.values():
+            src.copyto(rep)
 
     def reset_ctx(self, ctx):
         ctx = [ctx] if isinstance(ctx, Context) else list(ctx)
         if self._data is not None:
             self._data = self._data.as_in_context(ctx[0])
             self._ctx = ctx[0]
+            self._replicas = OrderedDict(
+                (c, self._data.as_in_context(c)) for c in ctx[1:])
             if self._grad_req != "null":
                 self._init_grad()
         elif self._deferred_init:
@@ -254,6 +297,8 @@ class Parameter:
             return
         data = self._data.astype(dtype)
         self._data = data
+        self._replicas = OrderedDict(
+            (c, data.as_in_context(c)) for c in self._replicas)
         if self._grad_req != "null":
             self._init_grad()
 

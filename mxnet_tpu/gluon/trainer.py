@@ -61,7 +61,29 @@ class Trainer:
         else:
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
-        self._updaters = [opt.get_updater(self._optimizer)]
+        # one updater per device context: each replica applies the same
+        # reduced gradient, so the per-device optimizer states stay in sync
+        # (parity: Trainer._updaters, one per context)
+        contexts = self._check_contexts()
+        self._contexts = contexts
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in contexts]
+
+    def _check_contexts(self):
+        # raises for fully-uninitialized params (parity: Trainer requires
+        # initialize() before construction; deferred init returns its ctx
+        # list, which is final)
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            if contexts is not None and contexts != ctx:
+                raise ValueError(
+                    "All Parameters must be initialized on the same "
+                    f"set of contexts, but Parameter {param.name!r} is "
+                    f"initialized on {ctx} while previous Parameters "
+                    f"are initialized on {contexts}.")
+            contexts = ctx
+        return contexts if contexts is not None else [None]
 
     def _init_kvstore(self):
         config = self._kvstore_params

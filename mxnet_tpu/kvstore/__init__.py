@@ -1,0 +1,30 @@
+"""KVStore: the push/pull parameter interface.
+
+Capability parity: reference ``include/mxnet/kvstore.h`` +
+``src/kvstore/`` + ``python/mxnet/kvstore/`` (SURVEY.md §2.3): a key→value
+store of NDArrays with ``init/push/pull``, gradient aggregation across
+device replicas, an optional server-side optimizer (``set_optimizer`` +
+``update_on_kvstore``), and 2-bit gradient compression with error
+feedback.
+
+TPU-native design: there are no server processes and no NCCL — aggregation
+is an XLA ``add_n`` on the root device (single host) or a ``psum`` over the
+device mesh (``dist_tpu_sync``, SURVEY.md §5 "Distributed communication
+backend").  The mode names map as:
+
+==================  =====================================================
+reference mode      rebuild behaviour
+==================  =====================================================
+``local``           reduce on the first context's device
+``device``          reduce on the first context's device (XLA fuses the
+                    tree; there is no PCIe topology to plan around)
+``nccl``            alias of ``device`` — ICI plays NCCL's role
+``dist_sync`` /     psum over the current ``mx.parallel`` mesh; rank =
+``dist_tpu_sync``   ``jax.process_index()``; optimizer runs on-chip
+``dist_async``      intentionally dropped (async PS is an anti-pattern on
+                    TPU) — raises with an explanatory error
+==================  =====================================================
+"""
+from .kvstore import KVStore, KVStoreTPUSync, create
+
+__all__ = ["KVStore", "KVStoreTPUSync", "create"]

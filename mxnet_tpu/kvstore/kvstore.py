@@ -1,0 +1,246 @@
+"""KVStore implementations (see package docstring for the mode mapping).
+
+Reference call sites this mirrors: ``python/mxnet/kvstore/kvstore.py``
+(user API), ``src/kvstore/kvstore_local.h`` (aggregation + updater),
+``src/kvstore/comm.h`` (device reduce/broadcast), ``src/kvstore/
+kvstore_dist.h`` (multi-worker sync semantics) — SURVEY.md §2.3, §3.4.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .compression import GradientCompression
+
+__all__ = ["KVStore", "KVStoreTPUSync", "create"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _key_list(key):
+    if isinstance(key, (list, tuple)):
+        return list(key), True
+    return [key], False
+
+
+class KVStore:
+    """Single-process store: ``local`` / ``device`` / ``nccl`` modes.
+
+    Aggregation = XLA ``add_n`` on the first context's device; broadcast =
+    ``device_put`` back to each replica.  XLA's compiler replaces the
+    reference's hand-built PCIe reduce trees (``comm_tree.h``).
+    """
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression: Optional[GradientCompression] = None
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    @property
+    def is_distributed(self) -> bool:
+        return False
+
+    # -- init -------------------------------------------------------------
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        values = _as_list(value)
+        if len(keys) != len(values):
+            raise MXNetError("init: number of keys != number of values")
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k in self._store:
+                raise MXNetError(f"init() called twice for key {k!r}")
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = v0.copy()
+
+    # -- push/pull --------------------------------------------------------
+    def _merge(self, k, values: List[NDArray]) -> NDArray:
+        root_ctx = self._store[k].context
+        vals = [v.as_in_context(root_ctx) for v in values]
+        if self._compression is not None:
+            vals = [NDArray(self._compression.compress(f"{k}:{i}", v._data),
+                            ctx=root_ctx) for i, v in enumerate(vals)]
+        if len(vals) == 1:
+            return vals[0]
+        return nd.add_n(*vals)
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        values = _as_list(value)
+        if len(keys) == 1 and len(values) > 1 and \
+                not isinstance(values[0], (list, tuple)):
+            values = [values]
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"push() on uninitialized key {k!r}")
+            merged = self._merge(k, _as_list(v))
+            if self._updater is not None:
+                # server-side update: updater mutates the stored weights
+                self._updater(int(k) if k.isdigit() else k, merged,
+                              self._store[k])
+            else:
+                # default updater is ASSIGN (kvstore_local.h)
+                self._store[k]._set_data(
+                    merged._data.astype(self._store[k].dtype.name))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("pull: `out` is required")
+        keys, _ = _key_list(key)
+        outs = _as_list(out)
+        if len(keys) == 1 and len(outs) > 1 and \
+                not isinstance(outs[0], (list, tuple)):
+            outs = [outs]
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"pull() on uninitialized key {k!r}")
+            src = self._store[k]
+            for dst in _as_list(o):
+                src.copyto(dst)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority=priority)
+        self.pull(key, out=out if out is not None else value,
+                  priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense-backed facade: pulls rows selected by row_ids."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull: `out` and `row_ids` required")
+        keys, _ = _key_list(key)
+        outs = _as_list(out)
+        ids = _as_list(row_ids)
+        for k, o, rid in zip(keys, outs, ids * (len(keys) // len(ids) or 1)):
+            k = str(k)
+            src = self._store[k]
+            for dst in _as_list(o):
+                taken = nd.take(src, rid.as_in_context(src.context), axis=0)
+                scattered = nd.zeros(src.shape, ctx=dst.context,
+                                     dtype=src.dtype.name)
+                scattered[rid.as_in_context(dst.context)] = \
+                    taken.as_in_context(dst.context)
+                scattered.copyto(dst)
+
+    # -- optimizer --------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = GradientCompression(compression_params)
+
+    @property
+    def gradient_compression(self):
+        return self._compression
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer has been set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer has been set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        nd.waitall()
+
+
+class KVStoreTPUSync(KVStore):
+    """``dist_sync`` / ``dist_tpu_sync``: synchronous data parallelism.
+
+    The reference runs ps-lite server processes that aggregate worker
+    pushes over ZeroMQ (``kvstore_dist_server.h``).  On TPU there are no
+    servers: every host process enters the same SPMD program; cross-process
+    aggregation is an allreduce over DCN/ICI via the JAX runtime.  Within a
+    process, device replicas reduce exactly like ``local``.
+
+    ``rank``/``num_workers`` map to ``jax.process_index()/process_count()``
+    — the rendezvous that ps-lite's scheduler performed is the PJRT
+    distributed runtime's job (``jax.distributed.initialize``).
+    """
+
+    def __init__(self, kv_type="dist_tpu_sync"):
+        super().__init__(kv_type)
+        import jax
+        self._jax = jax
+
+    @property
+    def rank(self) -> int:
+        return self._jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return self._jax.process_count()
+
+    @property
+    def is_distributed(self) -> bool:
+        return True
+
+    def _merge(self, k, values):
+        merged = super()._merge(k, values)
+        if self.num_workers > 1:
+            # cross-host allreduce over DCN: allgather + sum is the
+            # portable spelling; on a pod slice XLA lowers it to ICI
+            # collectives
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(merged._data)
+            merged = NDArray(gathered.sum(axis=0), ctx=merged.context)
+        return merged
+
+    def _barrier(self):
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"kvstore_{self._type}")
+        nd.waitall()
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (parity: ``mx.kv.create``)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_sync_device", "dist_tpu_sync", "dist"):
+        return KVStoreTPUSync(name)
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async is intentionally not provided: asynchronous "
+            "parameter-server updates are an anti-pattern on TPU meshes "
+            "(documented capability gap, SURVEY.md §2.3). Use "
+            "'dist_tpu_sync'.")
+    raise MXNetError(f"unknown KVStore type {name!r}")

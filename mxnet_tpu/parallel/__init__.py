@@ -1,0 +1,29 @@
+"""TPU-native parallelism: device meshes, collectives, sharded training.
+
+This package is the rebuild's answer to the reference's entire distributed
+stack (SURVEY.md §2.3): kvstore device comm (``comm.h``/``comm_tree.h``),
+NCCL (``kvstore_nccl.h``), ps-lite parameter servers (``kvstore_dist*.h``)
+and the manual model-parallel ``ctx_group`` mechanism — all replaced by one
+idiom: lay the devices out in a named :class:`jax.sharding.Mesh`, annotate
+array shardings, and let XLA insert the collectives over ICI/DCN.
+
+Public surface:
+
+* :func:`make_mesh` / :func:`set_mesh` / :func:`current_mesh` — mesh
+  lifecycle.  Axis names are free-form; the conventional ones are ``dp``
+  (data), ``tp`` (tensor), ``pp`` (pipeline), ``sp`` (sequence/context),
+  ``ep`` (expert).
+* :mod:`~mxnet_tpu.parallel.collectives` — ``psum``/``all_gather``/
+  ``ppermute``/``all_to_all`` wrappers for use inside ``shard_map``-ped
+  code (Pallas ring kernels use the same axis names).
+* :class:`DataParallelTrainer` — one-jit SPMD training step over a mesh:
+  batch sharded on ``dp``, params replicated (or TP-sharded via a rule),
+  optimizer running on-chip.  This is the TPU-native fast path that the
+  kvstore facade's push/pull semantics compile down to.
+"""
+from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
+from . import collectives
+from .trainer import DataParallelTrainer
+
+__all__ = ["make_mesh", "set_mesh", "current_mesh", "mesh_shape",
+           "collectives", "DataParallelTrainer"]

@@ -1,0 +1,58 @@
+"""Device-mesh lifecycle.
+
+The reference's notion of "which devices participate and how" was spread
+over kvstore types, ``DMLC_NUM_WORKER`` env vars and comm-tree topology
+scans; here it is one object: a named ``jax.sharding.Mesh``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+
+_state = threading.local()
+
+
+def make_mesh(axes: Dict[str, int], devices=None):
+    """Create a named device mesh.
+
+    ``axes`` maps axis name → size, e.g. ``{"dp": 4, "tp": 2}``.  The
+    product must not exceed the available device count.  ``devices``
+    defaults to ``jax.devices()`` (all chips across all hosts in a
+    multi-host run, matching SPMD single-program semantics).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise MXNetError(
+            f"mesh {axes} needs {n} devices but only {len(devices)} "
+            "are available")
+    dev_array = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(dev_array, axis_names=tuple(axes.keys()))
+
+
+def set_mesh(mesh) -> None:
+    """Install ``mesh`` as the process-wide default (None clears)."""
+    _state.mesh = mesh
+
+
+def current_mesh():
+    """The default mesh, or a fresh 1-axis ``{"dp": all}`` mesh."""
+    mesh = getattr(_state, "mesh", None)
+    if mesh is None:
+        import jax
+        mesh = make_mesh({"dp": len(jax.devices())})
+        _state.mesh = mesh
+    return mesh
+
+
+def mesh_shape(mesh=None) -> Dict[str, int]:
+    mesh = mesh if mesh is not None else current_mesh()
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

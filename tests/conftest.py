@@ -16,6 +16,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+if not os.environ.get("MXTPU_TEST_ON_TPU"):
+    # the axon plugin re-registers itself into jax_platforms on import,
+    # overriding the env var — pin the config before any backend init
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,189 @@
+"""KVStore tests.
+
+Mirrors the reference's ``tests/python/unittest/test_kvstore.py`` and the
+nightly ``dist_sync_kvstore.py`` assertions (SURVEY.md §4): push known
+constants from each "device", assert pulled aggregate; updater semantics;
+gradient compression snap-to-threshold numerics; multi-device DP training
+end-to-end over 8 virtual devices.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+SHAPE = (4, 4)
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    a = nd.zeros(SHAPE)
+    kv.pull(3, out=a)
+    np.testing.assert_allclose(a.asnumpy(), 1.0)
+    kv.push(3, nd.ones(SHAPE) * 8)
+    kv.pull(3, out=a)
+    np.testing.assert_allclose(a.asnumpy(), 8.0)
+
+
+def test_list_kv_pairs():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [nd.ones(SHAPE)] * len(keys))
+    kv.push(keys, [nd.ones(SHAPE) * 4] * len(keys))
+    outs = [nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 4.0)
+
+
+def test_aggregation():
+    """Push one value per device: pulled value == sum (comm.h reduce)."""
+    devs = [mx.cpu(i) for i in range(4)]
+    kv = mx.kv.create("device")
+    kv.init("a", nd.zeros(SHAPE))
+    vals = [nd.ones(SHAPE, ctx=d) * (i + 1) for i, d in enumerate(devs)]
+    kv.push("a", vals)
+    outs = [nd.zeros(SHAPE, ctx=d) for d in devs]
+    kv.pull("a", out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 1 + 2 + 3 + 4)
+
+
+def test_updater():
+    """Custom updater runs server-side (kvstore_local.h ApplyUpdates)."""
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones(SHAPE))
+
+    def update(key, grad, weight):
+        weight += grad * 2
+
+    kv._set_updater(update)
+    kv.push("w", nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1 + 2)
+
+
+def test_set_optimizer():
+    kv = mx.kv.create("local")
+    kv.init("0", nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push("0", nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull("0", out=out)
+    # w - lr*g = 1 - 0.1 (wd = 0 default)
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+
+
+def test_gradient_compression():
+    """2-bit: pushed grads snap to ±threshold/0 with residual carry."""
+    kv = mx.kv.create("local")
+    kv.init("g", nd.zeros((3,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push("g", nd.array([0.7, -0.9, 0.2]))
+    out = nd.zeros((3,))
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0])
+    # residual [0.2, -0.4, 0.2] carries into the next push
+    kv.push("g", nd.array([0.2, -0.2, 0.2]))
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.0, -0.5, 0.0], atol=1e-7)
+
+
+def test_dist_tpu_sync_single_process():
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.is_distributed
+    kv.init("x", nd.ones((2, 2)))
+    kv.push("x", [nd.ones((2, 2)), nd.ones((2, 2))])
+    out = nd.zeros((2, 2))
+    kv.pull("x", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_dist_async_is_documented_gap():
+    with pytest.raises(mx.MXNetError, match="dist_tpu_sync"):
+        mx.kv.create("dist_async")
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = nd.array(np.arange(12).reshape(4, 3))
+    kv.init("rs", w)
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull("rs", out=out, row_ids=nd.array([1, 3]))
+    expect = np.zeros((4, 3))
+    expect[1] = np.arange(3, 6)
+    expect[3] = np.arange(9, 12)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+# ---------------------------------------------------------------------------
+# multi-device data-parallel training through Trainer + kvstore
+# ---------------------------------------------------------------------------
+
+
+def test_multi_context_parameter():
+    devs = [mx.cpu(i) for i in range(2)]
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=3)
+    net.initialize(ctx=devs)
+    p = list(net.collect_params().values())[0]
+    assert p.list_ctx() == devs
+    assert len(p.list_data()) == 2
+    np.testing.assert_allclose(p.list_data()[0].asnumpy(),
+                               p.list_data()[1].asnumpy())
+    # forward picks the right replica per input context
+    for d in devs:
+        x = nd.ones((2, 3), ctx=d)
+        y = net(x)
+        assert y.context == d
+
+
+def test_data_parallel_training_loop():
+    """split_and_load + per-ctx fwd/bwd + Trainer.step allreduce ==
+    single-device training on the concatenated batch (Module-style DP,
+    SURVEY.md §2.3 checklist row 1)."""
+    from mxnet_tpu.gluon import nn, Trainer, utils
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    def build(ctx_list):
+        np.random.seed(42)
+        net = nn.Dense(1, in_units=2)
+        net.initialize(mx.init.Xavier(), ctx=ctx_list)
+        return net
+
+    x = np.random.rand(8, 2).astype("float32")
+    y = (x.sum(1, keepdims=True) * 2).astype("float32")
+    loss_fn = L2Loss()
+
+    def train(net, ctx_list, steps=3):
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore="device")
+        for _ in range(steps):
+            xs = utils.split_and_load(nd.array(x), ctx_list)
+            ys = utils.split_and_load(nd.array(y), ctx_list)
+            with mx.autograd.record():
+                losses = [loss_fn(net(xi), yi) for xi, yi in zip(xs, ys)]
+            for l in losses:
+                l.backward()
+            trainer.step(batch_size=8)
+        p = list(net.collect_params().values())[0]
+        return p.data().asnumpy()
+
+    w_single = train(build([mx.cpu(0)]), [mx.cpu(0)])
+    w_multi = train(build([mx.cpu(i) for i in range(4)]),
+                    [mx.cpu(i) for i in range(4)])
+    np.testing.assert_allclose(w_single, w_multi, rtol=1e-5, atol=1e-6)
+
+
+def test_allreduce_collective():
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh({"dp": 8})
+    vals = [nd.full((2, 2), i, ctx=mx.cpu(0)) for i in range(8)]
+    out = parallel.collectives.allreduce(vals, axis="dp", mesh=mesh)
+    for o in out:
+        np.testing.assert_allclose(o.asnumpy(), sum(range(8)))
