@@ -36,9 +36,14 @@ from . import test_utils
 from . import kvstore
 from . import kvstore as kv
 from . import parallel
+from . import symbol
+from . import symbol as sym
+from . import module
+from . import module as mod
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
            "num_gpus", "num_tpus", "Context", "MXNetError", "engine",
            "initializer", "init", "lr_scheduler", "optimizer", "gluon",
-           "metric", "io", "test_utils", "kvstore", "kv", "parallel"]
+           "metric", "io", "test_utils", "kvstore", "kv", "parallel",
+           "symbol", "sym", "module", "mod"]

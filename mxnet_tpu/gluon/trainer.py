@@ -156,20 +156,35 @@ class Trainer:
             if self._kvstore is not None and self._update_on_kvstore:
                 self._kvstore.pull(str(i), param.list_data(), priority=-i)
                 continue
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
+            for dev_id, (upd, arr, grad) in enumerate(
+                    zip(self._updaters, param.list_data(),
+                        param.list_grad())):
+                # per-device update counts (parity: _set_current_context)
+                # — each replica applies the same reduced grad once, so
+                # Adam's t advances once per step, not once per device
+                self._optimizer._set_current_context(dev_id)
                 upd(i, grad, arr)
 
     def save_states(self, fname):
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            # the real states live in the kvstore's updater
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+            self._optimizer.param_dict = {
+                i: param for i, param in enumerate(self._params)}
+            return
         with open(fname, "rb") as f:
             states = f.read()
         for updater in self._updaters:

@@ -136,7 +136,13 @@ class KVStore:
         keys, _ = _key_list(key)
         outs = _as_list(out)
         ids = _as_list(row_ids)
-        for k, o, rid in zip(keys, outs, ids * (len(keys) // len(ids) or 1)):
+        if len(ids) == 1:
+            ids = ids * len(keys)  # one row_ids broadcast to all keys
+        elif len(ids) != len(keys):
+            raise MXNetError(
+                f"row_sparse_pull: {len(keys)} keys but {len(ids)} "
+                "row_ids lists (must match or be a single list)")
+        for k, o, rid in zip(keys, outs, ids):
             k = str(k)
             src = self._store[k]
             for dst in _as_list(o):

@@ -1,0 +1,11 @@
+"""``mx.mod``: the legacy symbolic training API (SURVEY.md §2.5).
+
+``Module`` binds a Symbol to contexts and trains with ``fit()``;
+``BucketingModule`` adds per-sequence-length executor sets with shared
+parameters.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

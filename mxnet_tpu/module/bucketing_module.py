@@ -1,0 +1,135 @@
+"""BucketingModule (parity: ``python/mxnet/module/bucketing_module.py``).
+
+Variable-length sequence training: one executor set per bucket (sequence
+length), parameters shared across buckets.  On TPU this is exactly the
+right shape-bucketing mitigation for XLA's static shapes (SURVEY.md §7
+hard-part 2) — each bucket compiles once and is reused.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._bind_args = None
+
+    @property
+    def symbol(self):
+        assert self._curr_module is not None
+        return self._curr_module.symbol
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    def _gen_module(self, bucket_key):
+        if bucket_key in self._buckets:
+            return self._buckets[bucket_key]
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        mod = Module(symbol, data_names=data_names,
+                     label_names=label_names, logger=self.logger,
+                     context=self._context,
+                     fixed_param_names=self._fixed_param_names)
+        self._buckets[bucket_key] = mod
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self._bind_args = dict(for_training=for_training,
+                               inputs_need_grad=inputs_need_grad,
+                               grad_req=grad_req)
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, **self._bind_args)
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        assert self.binded, "call bind before switching buckets"
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, **self._bind_args)
+            if self.params_initialized:
+                arg_p, aux_p = self.get_params()
+                mod.set_params(arg_p, aux_p)
+                if self._curr_module.optimizer_initialized:
+                    mod._optimizer = self._curr_module._optimizer
+                    mod._updaters = self._curr_module._updaters
+                    mod._kvstore = self._curr_module._kvstore
+                    mod.optimizer_initialized = True
+        elif self.params_initialized:
+            # sync shared params into the bucket being activated
+            arg_p, aux_p = self.get_params()
+            mod.set_params(arg_p, aux_p)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        assert self.binded
+        if self.params_initialized and not kwargs.get("force_init"):
+            return
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, **kwargs):
+        self._curr_module.set_params(arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is not None and key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def forward_backward(self, data_batch):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is not None and key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward_backward(data_batch)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        for mod in self._buckets.values():
+            mod.install_monitor(monitor)

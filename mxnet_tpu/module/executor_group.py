@@ -1,0 +1,157 @@
+"""DataParallelExecutorGroup (parity:
+``python/mxnet/module/executor_group.py`` — SURVEY.md §2.3 checklist row 1,
+§3.4): one Executor per device, batch split along axis 0, gradients
+reduced by the caller (Module.update → kvstore).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from ..gluon.utils import split_data
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad=False,
+                 fixed_param_names=None, grad_req="write"):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.param_names = list(param_names)
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        self.data_names = [d[0] for d in data_shapes]
+        self.label_names = [l[0] for l in (label_shapes or [])]
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        n = len(self.contexts)
+        self.batch_size = data_shapes[0][1][0]
+        if self.batch_size % n != 0:
+            raise MXNetError(
+                f"batch size {self.batch_size} is not divisible by the "
+                f"number of contexts {n}")
+        self._slice = self.batch_size // n
+
+        # per-context shapes: batch axis sliced for data/label
+        def _sliced(shapes):
+            out = []
+            for name, shape in shapes:
+                out.append((name, (self._slice,) + tuple(shape[1:])))
+            return out
+
+        shape_kwargs = {}
+        for name, shape in _sliced(data_shapes) + _sliced(
+                label_shapes or []):
+            shape_kwargs[name] = shape
+
+        # infer remaining (param) shapes once
+        arg_shapes, _, aux_shapes = symbol.infer_shape_partial(
+            **shape_kwargs)
+        if arg_shapes is None:
+            arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        full_shapes = dict(shape_kwargs)
+        for name, shape in zip(self.arg_names, arg_shapes):
+            full_shapes.setdefault(name, shape)
+
+        req = {}
+        for name in self.arg_names:
+            if name in self.data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self.label_names:
+                req[name] = "null"
+            elif name in self.fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req
+
+        self.execs = []
+        for ctx in self.contexts:
+            args = {name: nd.zeros(full_shapes[name], ctx=ctx)
+                    for name in self.arg_names}
+            aux = {name: nd.zeros(shape, ctx=ctx)
+                   for name, shape in zip(self.aux_names, aux_shapes)}
+            grads = {name: nd.zeros(full_shapes[name], ctx=ctx)
+                     for name in self.arg_names if req[name] != "null"}
+            self.execs.append(symbol.bind(ctx, args, args_grad=grads,
+                                          grad_req=req, aux_states=aux))
+
+    # -- parameter plumbing ----------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy exec0's weights into the given dicts (reference merges
+        across devices; replicas are kept in sync by update())."""
+        for name in self.param_names:
+            arg_params[name] = self.execs[0].arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = self.execs[0].aux_dict[name].copy()
+
+    # -- execution --------------------------------------------------------
+    def _load_batch(self, data_batch):
+        n = len(self.contexts)
+        data = data_batch.data
+        label = data_batch.label if data_batch.label is not None else []
+        for names, arrays in ((self.data_names, data),
+                              (self.label_names, label)):
+            for name, arr in zip(names, arrays):
+                if not isinstance(arr, NDArray):
+                    arr = nd.array(arr)
+                slices = split_data(arr, n) if n > 1 else [arr]
+                for ex, s in zip(self.execs, slices):
+                    dst = ex.arg_dict[name]
+                    dst._set_data(
+                        s.as_in_context(dst.context)._data.astype(
+                            dst.dtype.name))
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        self._load_batch(data_batch)
+        for ex in self.execs:
+            ex.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        for ex in self.execs:
+            ex.backward(out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused fwd+bwd: ONE XLA program per device (the fit hot path)."""
+        self._load_batch(data_batch)
+        for ex in self.execs:
+            ex.forward_backward()
+
+    def get_outputs(self, merge_multi_context=True):
+        if len(self.execs) == 1:
+            return list(self.execs[0].outputs)
+        if not merge_multi_context:
+            return [[ex.outputs[i] for ex in self.execs]
+                    for i in range(len(self.execs[0].outputs))]
+        return [nd.concatenate([ex.outputs[i] for ex in self.execs],
+                               axis=0)
+                for i in range(len(self.execs[0].outputs))]
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind was not called with inputs_need_grad")
+        grads = [[ex.grad_dict[name] for ex in self.execs]
+                 for name in self.data_names]
+        if merge_multi_context:
+            return [nd.concatenate(g, axis=0) if len(g) > 1 else g[0]
+                    for g in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        outs = self.get_outputs()
+        labels_nd = [l if isinstance(l, NDArray) else nd.array(l)
+                     for l in (labels or [])]
+        eval_metric.update(labels_nd, outs)

@@ -1,0 +1,332 @@
+"""Module: symbol-based training (parity:
+``python/mxnet/module/module.py`` — SURVEY.md §2.5, §3.4).
+
+Intermediate-level API over a bound Symbol: one executor per context,
+kvstore-reduced gradients, checkpointing.  Hot path per step =
+len(contexts) fused XLA programs + one kvstore reduce (the reference ran
+per-node engine ops + NCCL/PS traffic here).
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        self._context = [context] if isinstance(context, Context) \
+            else list(context)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._exec_group = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updaters = None
+        self._preload_opt_states = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.execs[0].outputs
+        if outs:
+            return list(zip(self._output_names,
+                            [o.shape for o in outs]))
+        # before first forward: infer
+        shape_kwargs = {n: s for n, s in
+                        self._data_shapes + (self._label_shapes or [])}
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        return list(zip(self._output_names, out_shapes))
+
+    # -- bind / params ----------------------------------------------------
+    @staticmethod
+    def _norm_shapes(shapes):
+        if shapes is None:
+            return None
+        out = []
+        for s in shapes:
+            if isinstance(s, tuple) and len(s) == 2 and \
+                    isinstance(s[0], str):
+                out.append((s[0], tuple(s[1])))
+            else:  # DataDesc namedtuple
+                out.append((s.name, tuple(s.shape)))
+        return out
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self._data_shapes = self._norm_shapes(data_shapes)
+        self._label_shapes = self._norm_shapes(label_shapes)
+        self.for_training = for_training
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._data_shapes,
+            self._label_shapes, self._param_names, for_training,
+            inputs_need_grad=inputs_need_grad,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg_p, aux_p = shared_module.get_params()
+            self.set_params(arg_p, aux_p)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        from .. import initializer as init_mod
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+
+        ex0 = self._exec_group.execs[0]
+        self._arg_params = OrderedDict()
+        self._aux_params = OrderedDict()
+        for name in self._param_names:
+            shape = ex0.arg_dict[name].shape
+            host = np.zeros(shape, dtype="float32")
+            if arg_params is not None and name in arg_params:
+                host = arg_params[name].asnumpy() \
+                    if isinstance(arg_params[name], NDArray) \
+                    else np.asarray(arg_params[name])
+            elif allow_missing or arg_params is None:
+                from ..initializer import InitDesc, create as init_create
+                ini = initializer if not isinstance(initializer, str) \
+                    else init_create(initializer)
+                ini(InitDesc(name), host)
+            else:
+                raise MXNetError(f"missing arg_params entry {name!r}")
+            self._arg_params[name] = nd.array(host)
+        for name in self._aux_names:
+            shape = ex0.aux_dict[name].shape
+            host = np.zeros(shape, dtype="float32")
+            if aux_params is not None and name in aux_params:
+                host = aux_params[name].asnumpy() \
+                    if isinstance(aux_params[name], NDArray) \
+                    else np.asarray(aux_params[name])
+            elif "var" in name or "variance" in name:
+                host = np.ones(shape, dtype="float32")
+            self._aux_params[name] = nd.array(host)
+
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            for name in self._param_names:
+                if name not in (arg_params or {}):
+                    raise MXNetError(f"missing parameter {name!r}")
+        if self._arg_params is None:
+            self._arg_params = OrderedDict()
+            self._aux_params = OrderedDict()
+        for name, v in (arg_params or {}).items():
+            if name not in self._param_names and not allow_extra:
+                raise MXNetError(f"unknown parameter {name!r}")
+            if name in self._param_names:
+                self._arg_params[name] = v if isinstance(v, NDArray) \
+                    else nd.array(v)
+        for name, v in (aux_params or {}).items():
+            if name not in self._aux_names and not allow_extra:
+                raise MXNetError(f"unknown aux state {name!r}")
+            if name in self._aux_names:
+                self._aux_params[name] = v if isinstance(v, NDArray) \
+                    else nd.array(v)
+        if self.binded:
+            self._exec_group.set_params(self._arg_params, self._aux_params,
+                                        allow_extra=True)
+        self.params_initialized = True
+
+    # -- optimizer / update ----------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        from .. import kvstore as kvs_mod
+        if kvstore is None:
+            self._kvstore = None
+        else:
+            self._kvstore = kvs_mod.create(kvstore) \
+                if isinstance(kvstore, str) else kvstore
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params) \
+                if not isinstance(optimizer_params, dict) \
+                else dict(optimizer_params)
+            # parity: Module defaults rescale_grad to 1/batch_size (the
+            # head ops emit per-example grads summed over the batch)
+            batch_size = self._exec_group.batch_size
+            if self._kvstore is not None and \
+                    getattr(self._kvstore, "is_distributed", False):
+                batch_size *= self._kvstore.num_workers
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        if self._kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                self._kvstore.init(
+                    str(i), self._exec_group.execs[0].arg_dict[name])
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._context]
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+        self.optimizer_initialized = True
+
+    def update(self):
+        """kvstore-reduce grads, then per-device optimizer update."""
+        assert self.optimizer_initialized
+        group = self._exec_group
+        for i, name in enumerate(self._param_names):
+            grads = [ex.grad_dict.get(name) for ex in group.execs]
+            if grads[0] is None:
+                continue
+            if self._kvstore is not None and len(grads) > 1:
+                self._kvstore.push(str(i), grads, priority=-i)
+                self._kvstore.pull(str(i), grads, priority=-i)
+            elif len(grads) > 1:
+                merged = nd.add_n(*[g.as_in_context(grads[0].context)
+                                    for g in grads])
+                for g in grads:
+                    merged.copyto(g)
+            for dev_id, (updater, ex, g) in enumerate(
+                    zip(self._updaters, group.execs, grads)):
+                self._optimizer._set_current_context(dev_id)
+                updater(i, g, ex.arg_dict[name])
+
+    # -- execution --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads)
+
+    def forward_backward(self, data_batch):
+        self._exec_group.forward_backward(data_batch)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        for ex in self._exec_group.execs:
+            monitor.install(ex)
+
+    # -- checkpointing ----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg_p, aux_p = self.get_params()
+        payload = {f"arg:{k}": v for k, v in arg_p.items()}
+        payload.update({f"aux:{k}": v for k, v in aux_p.items()})
+        nd.save(f"{prefix}-{epoch:04d}.params", payload)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from .. import symbol as sym_mod
+        symbol = sym_mod.load(f"{prefix}-symbol.json")
+        saved = nd.load(f"{prefix}-{epoch:04d}.params")
+        arg_params = {k[4:]: v for k, v in saved.items()
+                      if k.startswith("arg:")}
+        aux_params = {k[4:]: v for k, v in saved.items()
+                      if k.startswith("aux:")}
+        mod = Module(symbol, **kwargs)
+        mod._preload_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        # params installed at init_params time (parity: Module.load)
+        orig_init = mod.init_params
+
+        def init_with_loaded(initializer=None, arg_params=None,
+                             aux_params=None, allow_missing=False,
+                             force_init=False, allow_extra=False):
+            orig_init(initializer=initializer,
+                      arg_params=arg_params or mod._preload_params[0],
+                      aux_params=aux_params or mod._preload_params[1],
+                      allow_missing=allow_missing, force_init=force_init,
+                      allow_extra=allow_extra)
+
+        mod.init_params = init_with_loaded
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            states = f.read()
+        for u in self._updaters:
+            u.set_states(states)

@@ -14,6 +14,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register, alias
@@ -130,15 +131,52 @@ def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
                    use_ignore=False, multi_output=False,
                    preserve_shape=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0):
-    """Legacy fused softmax+CE-grad op: forward emits softmax probabilities.
+    """Legacy fused softmax+CE-grad op (reference softmax_output.cc).
 
-    The custom gradient (prob - one_hot(label), the reference's backward) is
-    wired by the frontend via a custom-vjp wrapper in gluon/loss paths; the
-    imperative forward here matches the reference's forward contract.
+    Forward emits softmax probabilities; the BACKWARD is the implicit
+    cross-entropy gradient ``(prob - one_hot(label)) * grad_scale`` — NOT
+    the softmax Jacobian — wired via jax.custom_vjp so Module/Executor
+    training loops behave exactly like the reference (loss comes for free
+    from the head op, no explicit loss node).
     """
-    if multi_output:
-        return jax.nn.softmax(data, axis=1)
-    return jax.nn.softmax(data, axis=-1)
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _f(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def _fwd(d, l):
+        prob = jax.nn.softmax(d, axis=axis)
+        return prob, (prob, l)
+
+    def _bwd(res, g):
+        prob, l = res
+        k = prob.shape[axis]
+        li = l.astype("int32")
+        onehot = jax.nn.one_hot(li, k, axis=axis, dtype=prob.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / k
+        grad = prob - onehot
+        if use_ignore:
+            mask = (li != int(ignore_label)).astype(prob.dtype)
+            grad = grad * jnp.expand_dims(mask, axis=axis)
+        scale = grad_scale
+        if normalization == "batch":
+            grad = grad / prob.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                nvalid = jnp.maximum(
+                    (li != int(ignore_label)).sum().astype(prob.dtype), 1.0)
+            else:
+                nvalid = float(np.prod(l.shape))
+            grad = grad / nvalid
+        grad = grad * scale
+        if out_grad:
+            grad = grad * g
+        return grad, jnp.zeros_like(l)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
 
 
 @register("softmax_cross_entropy", num_inputs=2)

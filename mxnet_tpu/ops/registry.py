@@ -43,7 +43,7 @@ class OpDef:
 
     __slots__ = ("name", "fcompute", "num_inputs", "num_outputs",
                  "scalar_attrs", "wrap_ctx", "doc", "attr_names",
-                 "scalar_ref_input")
+                 "scalar_ref_input", "input_names")
 
     def __init__(self, name: str, fcompute: Callable,
                  num_inputs: Optional[int], num_outputs: int,
@@ -62,8 +62,19 @@ class OpDef:
             self.attr_names = tuple(
                 p.name for p in sig.parameters.values()
                 if p.kind == p.KEYWORD_ONLY)
+            # positional params = tensor-input names (then scalar attrs);
+            # used by the symbol frontend to map named inputs (data=...,
+            # weight=...) to positions, the way the reference's op
+            # signatures do
+            pos = [p.name for p in sig.parameters.values()
+                   if p.kind in (p.POSITIONAL_ONLY,
+                                 p.POSITIONAL_OR_KEYWORD)]
+            n_scal = len(self.scalar_attrs)
+            self.input_names = tuple(pos[:len(pos) - n_scal]) \
+                if n_scal else tuple(pos)
         except (TypeError, ValueError):
             self.attr_names = ()
+            self.input_names = ()
 
 
 _REGISTRY: Dict[str, OpDef] = {}

@@ -151,6 +151,7 @@ class DataParallelTrainer:
                 self.block._call_unhybridized(*args)
         self._params = params
         self._trainable = [p.grad_req != "null" for p in params]
+        self._tr_idx = [i for i, t in enumerate(self._trainable) if t]
         self._states = [
             self.optimizer.create_state(i, p.data())
             if self._trainable[i] else None
@@ -188,6 +189,7 @@ class DataParallelTrainer:
         n_args = len(args)
         ctx = args[0].context
         param_nds = [p.data() for p in params]
+        tr_idx = self._tr_idx
         mutated_idx: List[int] = []
 
         def traced(param_vals, input_vals, label_val, key_raw):
@@ -204,10 +206,18 @@ class DataParallelTrainer:
             block_mod._trace_state.active = True
             _rnd._push_key_provider(key_provider)
             try:
-                def loss_of(pvals):
+                # differentiate only trainable params — frozen weights /
+                # BN running stats ride along as closed-over constants,
+                # so no dead gradient buffers are materialized
+                tr_set = set(tr_idx)
+
+                def loss_of(tvals):
                     vers = []
-                    for r, v in zip(param_nds, pvals):
-                        r._buf = v
+                    for j, i in enumerate(tr_idx):
+                        param_nds[i]._buf = tvals[j]
+                    for i, r in enumerate(param_nds):
+                        if i not in tr_set:
+                            r._buf = param_vals[i]
                         vers.append(r._version)
                     shells = [NDArray(v, ctx=ctx) for v in input_vals]
                     out = block._call_unhybridized(*shells)
@@ -219,8 +229,9 @@ class DataParallelTrainer:
                     aux = tuple(param_nds[i]._buf for i in mutated_idx)
                     return jnp.mean(l._data), aux
 
+                tvals = tuple(param_vals[i] for i in tr_idx)
                 (loss, aux), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(param_vals)
+                    loss_of, has_aux=True)(tvals)
             finally:
                 block_mod._trace_state.active = prev_tracing
                 _rnd._pop_key_provider()
@@ -239,37 +250,37 @@ class DataParallelTrainer:
 
     # -- phase B: fused multi-tensor optimizer ---------------------------
     def _build_fused_update(self):
+        """One multi-tensor program updating every trainable param
+        (reference ``multi_sgd_update`` generalized); all lists aligned
+        with ``self._tr_idx``."""
         import jax
 
         rule = self._rule
         opt = self.optimizer
-        params, states = self._params, self._states
-        trainable = self._trainable
         n_scalars = len(rule.scalars(opt, 0, 1))
 
-        def update_all(param_vals, state_vals, grad_vals, scalar_vals):
-            new_params, new_states = list(param_vals), list(state_vals)
-            for i in range(len(param_vals)):
-                if not trainable[i]:
-                    continue
-                scal = tuple(scalar_vals[i * n_scalars + j]
-                             for j in range(n_scalars))
-                st = state_vals[i]
-                res = rule.apply(opt, param_vals[i], grad_vals[i], st,
+        def update_all(tparam_vals, tstate_vals, grad_vals, scalar_vals):
+            new_params, new_states = [], []
+            for j in range(len(tparam_vals)):
+                scal = tuple(scalar_vals[j * n_scalars + k]
+                             for k in range(n_scalars))
+                st = tstate_vals[j]
+                res = rule.apply(opt, tparam_vals[j], grad_vals[j], st,
                                  *scal)
                 if isinstance(res, tuple) and isinstance(res[1], tuple):
                     w, new_st = res
                 else:
                     w, new_st = res[0], tuple(res[1:])
-                new_params[i] = w
-                new_states[i] = new_st if new_st else st
+                new_params.append(w)
+                new_states.append(new_st if new_st else st)
             return tuple(new_params), tuple(new_states)
 
         self._fused_update = jax.jit(update_all, donate_argnums=(0, 1))
 
     def _state_vals(self):
         out = []
-        for s in self._states:
+        for i in self._tr_idx:
+            s = self._states[i]
             if s is None:
                 out.append(())
             elif isinstance(s, tuple):
@@ -279,7 +290,8 @@ class DataParallelTrainer:
         return tuple(out)
 
     def _write_states(self, new_state_vals):
-        for s, vals in zip(self._states, new_state_vals):
+        for i, vals in zip(self._tr_idx, new_state_vals):
+            s = self._states[i]
             if s is None or not vals:
                 continue
             if isinstance(s, tuple):
@@ -331,35 +343,29 @@ class DataParallelTrainer:
 
         opt = self.optimizer
         if self._rule is not None:
-            for i, t in enumerate(self._trainable):
-                if t:
-                    opt._update_count(i)
+            for i in self._tr_idx:
+                opt._update_count(i)
             if self._fused_update is None:
                 self._build_fused_update()
             scalar_vals = []
-            for i, p in enumerate(self._params):
-                if not self._trainable[i]:
-                    scalar_vals.extend(
-                        [np.float32(0)] * len(self._rule.scalars(opt, 0, 1)))
-                    continue
+            for i in self._tr_idx:
                 t = opt._index_update_count[i]
                 scalar_vals.extend(
                     np.asarray(s, dtype=np.float32)
                     for s in self._rule.scalars(opt, i, t))
             new_params, new_states = self._fused_update(
-                tuple(p.data()._data for p in self._params),
+                tuple(self._params[i].data()._data for i in self._tr_idx),
                 self._state_vals(),
                 grads, tuple(scalar_vals))
-            for p, v in zip(self._params, new_params):
-                p.data()._set_data(v)
+            for i, v in zip(self._tr_idx, new_params):
+                self._params[i].data()._set_data(v)
             self._write_states(new_states)
         else:
             # generic fallback: eager fused per-param update ops (still
             # device-side; lr rides as a dynamic scalar, no recompiles;
             # update() does its own _update_count bookkeeping)
-            for i, p in enumerate(self._params):
-                if not self._trainable[i]:
-                    continue
-                g = NDArray(grads[i], ctx=p.data().context)
+            for j, i in enumerate(self._tr_idx):
+                p = self._params[i]
+                g = NDArray(grads[j], ctx=p.data().context)
                 opt.update(i, p.data(), g, self._states[i])
         return NDArray(loss, ctx=args[0].context)

@@ -1,0 +1,129 @@
+"""``mx.sym`` namespace: Symbol + every registered operator as a function.
+
+Capability parity: reference ``python/mxnet/symbol/`` (generated op stubs
+over the C registry).  Wrappers mirror the nd namespace's convention —
+symbol inputs lead (positional or as ``data=``/named kwargs), attrs follow
+— and additionally accept ``name=`` for explicit node naming, exactly like
+the reference.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import get_op, list_ops, OpDef
+from .symbol import (Symbol, Executor, var, Variable, Group, load,
+                     load_json, _invoke, _AUX_INPUTS)
+
+_mod = sys.modules[__name__]
+
+
+def _make_wrapper(opname: str, op: OpDef):
+    ordered_attrs = tuple(op.scalar_attrs) + tuple(op.attr_names)
+
+    input_names = op.input_names
+
+    def fn(*args, name=None, **kwargs):
+        inputs = []
+        attr_pos = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            else:
+                attr_pos.append(a)
+        # symbol inputs may also arrive as keywords (data=..., weight=...);
+        # map them to their declared positions, remaining order-stable for
+        # names the op signature doesn't declare (variadic ops)
+        named = {}
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                named[k] = kwargs.pop(k)
+        if named:
+            for iname in input_names[len(inputs):]:
+                if iname in named:
+                    inputs.append(named.pop(iname))
+            inputs.extend(named.values())
+        for aname, val in zip(ordered_attrs, attr_pos):
+            if aname in kwargs:
+                raise TypeError(f"{opname}: got multiple values for "
+                                f"{aname}")
+            kwargs[aname] = val
+        return _invoke(opname, inputs, kwargs, name=name,
+                       aux_positions=_AUX_INPUTS.get(opname))
+
+    fn.__name__ = opname
+    fn.__qualname__ = opname
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _generate(target_mod):
+    for opname in list_ops():
+        if opname in _CUSTOM:
+            setattr(target_mod, opname, _CUSTOM[opname])
+            continue
+        op = get_op(opname)
+        setattr(target_mod, opname, _make_wrapper(opname, op))
+
+
+# ---------------------------------------------------------------------------
+# ops that need frontend glue in the nd namespace keep the same names here;
+# graph evaluation dispatches to the nd wrappers, so the node just records
+# the call (see symbol._eval_graph)
+# ---------------------------------------------------------------------------
+
+
+def Dropout(data, p=0.5, mode="training", axes=(), name=None, **kwargs):
+    return _invoke("Dropout", [data],
+                   {"p": p, "mode": mode, "axes": tuple(axes)}, name=name)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, name=None, **kwargs):
+    return _invoke(
+        "BatchNorm", [data, gamma, beta, moving_mean, moving_var],
+        {"eps": eps, "momentum": momentum, "fix_gamma": fix_gamma,
+         "use_global_stats": use_global_stats,
+         "output_mean_var": output_mean_var, "axis": axis},
+        name=name, aux_positions=(3, 4),
+        num_outputs=3 if output_mean_var else 1)
+
+
+def maximum(lhs, rhs, name=None):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke("broadcast_maximum", [lhs, rhs], {}, name=name)
+    if isinstance(lhs, Symbol):
+        return _invoke("_maximum_scalar", [lhs], {"scalar": rhs}, name=name)
+    return _invoke("_maximum_scalar", [rhs], {"scalar": lhs}, name=name)
+
+
+def minimum(lhs, rhs, name=None):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _invoke("broadcast_minimum", [lhs, rhs], {}, name=name)
+    if isinstance(lhs, Symbol):
+        return _invoke("_minimum_scalar", [lhs], {"scalar": rhs}, name=name)
+    return _invoke("_minimum_scalar", [rhs], {"scalar": lhs}, name=name)
+
+
+def RNN(*args, **kwargs):
+    raise NotImplementedError(
+        "sym.RNN: use mx.gluon.rnn layers (scan-lowered)")
+
+
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    return _invoke("_zeros", [], {"shape": tuple(shape), "dtype": dtype},
+                   name=name)
+
+
+def ones(shape, dtype="float32", name=None, **kwargs):
+    return _invoke("_ones", [], {"shape": tuple(shape), "dtype": dtype},
+                   name=name)
+
+
+_CUSTOM = {"Dropout": Dropout, "BatchNorm": BatchNorm, "RNN": RNN,
+           "maximum": maximum, "minimum": minimum}
+
+_generate(_mod)
+
+__all__ = ["Symbol", "Executor", "var", "Variable", "Group", "load",
+           "load_json"]

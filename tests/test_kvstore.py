@@ -180,6 +180,37 @@ def test_data_parallel_training_loop():
     np.testing.assert_allclose(w_single, w_multi, rtol=1e-5, atol=1e-6)
 
 
+def test_data_parallel_adam_update_counts():
+    """Adam's bias-correction step count t must advance once per step,
+    not once per device replica (regression: per-device update counts)."""
+    from mxnet_tpu.gluon import nn, Trainer, utils
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    def run(ctx_list, steps=4):
+        np.random.seed(5)
+        net = nn.Dense(2, in_units=3)
+        net.initialize(mx.init.Xavier(), ctx=ctx_list)
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 0.1}, kvstore="device")
+        x = np.random.rand(8, 3).astype("float32")
+        y = np.random.rand(8, 2).astype("float32")
+        loss_fn = L2Loss()
+        for _ in range(steps):
+            xs = utils.split_and_load(nd.array(x), ctx_list)
+            ys = utils.split_and_load(nd.array(y), ctx_list)
+            with mx.autograd.record():
+                ls = [loss_fn(net(a), b) for a, b in zip(xs, ys)]
+            for l in ls:
+                l.backward()
+            tr.step(batch_size=8)
+        p = list(net.collect_params().values())[0]
+        return p.data().asnumpy()
+
+    w1 = run([mx.cpu(0)])
+    w2 = run([mx.cpu(0), mx.cpu(1)])
+    np.testing.assert_allclose(w1, w2, rtol=1e-5, atol=1e-6)
+
+
 def test_allreduce_collective():
     from mxnet_tpu import parallel
     mesh = parallel.make_mesh({"dp": 8})

@@ -1,0 +1,210 @@
+"""Symbol + Module tests (mirrors reference test_symbol.py /
+test_module.py patterns — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+
+
+def _mlp_sym(hidden=16, classes=4):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc1_weight"),
+                             sym.var("fc1_bias"), num_hidden=hidden,
+                             name="fc1")
+    net = sym.relu(net, name="relu1")
+    net = sym.FullyConnected(net, sym.var("fc2_weight"),
+                             sym.var("fc2_bias"), num_hidden=classes,
+                             name="fc2")
+    return sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+
+
+class TestSymbol:
+    def test_compose_and_introspection(self):
+        out = _mlp_sym()
+        assert out.list_arguments() == [
+            "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+            "softmax_label"]
+        assert out.list_outputs() == ["softmax_output"]
+        internals = out.get_internals()
+        assert "fc1_output" in internals.list_outputs()
+
+    def test_infer_shape(self):
+        out = _mlp_sym()
+        arg_shapes, out_shapes, _ = out.infer_shape(
+            data=(8, 10), softmax_label=(8,), fc1_weight=(16, 10),
+            fc1_bias=(16,), fc2_weight=(4, 16), fc2_bias=(4,))
+        assert out_shapes == [(8, 4)]
+
+    def test_arithmetic_and_eval(self):
+        a = sym.var("a")
+        b = sym.var("b")
+        c = 2.0 * a + b ** 2
+        res = c.eval(ctx=mx.cpu(), a=nd.array([1.0, 2.0]),
+                     b=nd.array([3.0, 4.0]))
+        np.testing.assert_allclose(res[0].asnumpy(), [11.0, 20.0])
+
+    def test_grouping_and_slicing(self):
+        a = sym.var("a")
+        s1 = sym.relu(a, name="r1")
+        s2 = sym.sigmoid(a, name="s2")
+        g = sym.Group([s1, s2])
+        assert len(g) == 2
+        assert g[0].list_outputs() == ["r1_output"]
+        assert g["s2_output"].list_outputs() == ["s2_output"]
+
+    def test_json_roundtrip_and_exec(self):
+        out = _mlp_sym()
+        out2 = sym.load_json(out.tojson())
+        assert out2.list_arguments() == out.list_arguments()
+        shapes = dict(data=(2, 10), softmax_label=(2,),
+                      fc1_weight=(16, 10), fc1_bias=(16,),
+                      fc2_weight=(4, 16), fc2_bias=(4,))
+        ex = out2.simple_bind(ctx=mx.cpu(), **shapes)
+        ex.forward(data=nd.ones((2, 10)))
+        assert ex.outputs[0].shape == (2, 4)
+
+    def test_compose_symbol_into_symbol(self):
+        a = sym.var("x")
+        inner = sym.relu(sym.var("y"))
+        composed = inner(y=sym.sigmoid(a))
+        res = composed.eval(ctx=mx.cpu(), x=nd.array([-10.0, 10.0]))
+        np.testing.assert_allclose(res[0].asnumpy(), [0.0, 1.0],
+                                   atol=1e-4)
+
+    def test_executor_backward_softmax_head(self):
+        out = _mlp_sym()
+        shapes = dict(data=(4, 10), softmax_label=(4,),
+                      fc1_weight=(16, 10), fc1_bias=(16,),
+                      fc2_weight=(4, 16), fc2_bias=(4,))
+        ex = out.simple_bind(ctx=mx.cpu(), **shapes)
+        rng = np.random.RandomState(0)
+        for n, a in ex.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                a[:] = nd.array(rng.randn(*a.shape).astype("f") * 0.1)
+        x = rng.rand(4, 10).astype("f")
+        y = np.array([0, 1, 2, 3], dtype="f")
+        ex.forward(is_train=True, data=nd.array(x),
+                   softmax_label=nd.array(y))
+        ex.backward()
+        # SoftmaxOutput's implicit CE gradient: dL/dlogits = p - onehot;
+        # fc2_bias grad = column-sum of that
+        p = ex.outputs[0].asnumpy()
+        expect = (p - np.eye(4)[y.astype(int)]).sum(axis=0)
+        np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                                   expect, rtol=1e-5, atol=1e-6)
+
+
+class TestModule:
+    def _train_data(self, n=64, dim=10, classes=4, batch=16, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.rand(n, dim).astype("float32")
+        w = rng.randn(dim, classes)
+        y = np.argmax(x @ w, axis=1).astype("float32")
+        return NDArrayIter(x, y, batch_size=batch, shuffle=False,
+                           label_name="softmax_label")
+
+    def test_fit_and_score(self):
+        train = self._train_data()
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                            label_names=("softmax_label",))
+        mod.fit(train, num_epoch=40, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                initializer=mx.init.Xavier(),
+                eval_metric="acc", kvstore=None)
+        train.reset()
+        score = mod.score(train, "acc")
+        assert score[0][1] > 0.9, f"fit failed to learn: {score}"
+
+    def test_predict_shapes(self):
+        train = self._train_data()
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=train.provide_data,
+                 label_shapes=train.provide_label)
+        mod.init_params(mx.init.Xavier())
+        out = mod.predict(train)
+        assert out.shape == (64, 4)
+
+    def test_multi_device_module(self):
+        """2-context data parallelism matches single-context (kvstore
+        reduce keeps replicas identical)."""
+        def run(ctxs, seed=3):
+            train = self._train_data(seed=1)
+            mod = mx.mod.Module(_mlp_sym(), context=ctxs,
+                                label_names=("softmax_label",))
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            np.random.seed(seed)
+            mod.init_params(mx.init.Xavier())
+            mod.init_optimizer(kvstore="device", optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1})
+            for _ in range(2):
+                train.reset()
+                for batch in train:
+                    mod.forward_backward(batch)
+                    mod.update()
+            arg, _ = mod.get_params()
+            return {k: v.asnumpy() for k, v in arg.items()}
+
+        w1 = run(mx.cpu(0))
+        w2 = run([mx.cpu(0), mx.cpu(1)])
+        for k in w1:
+            np.testing.assert_allclose(w1[k], w2[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+
+    def test_save_load_checkpoint(self, tmp_path):
+        train = self._train_data()
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                            label_names=("softmax_label",))
+        mod.bind(data_shapes=train.provide_data,
+                 label_shapes=train.provide_label)
+        mod.init_params(mx.init.Xavier())
+        prefix = str(tmp_path / "model")
+        mod.save_checkpoint(prefix, 3)
+        mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu(),
+                                  label_names=("softmax_label",))
+        mod2.bind(data_shapes=train.provide_data,
+                  label_shapes=train.provide_label)
+        mod2.init_params()
+        a1, _ = mod.get_params()
+        a2, _ = mod2.get_params()
+        for k in a1:
+            np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+class TestBucketingModule:
+    def test_buckets_share_params(self):
+        def sym_gen(seq_len):
+            data = sym.var("data")
+            # pool over the variable-length axis FIRST so fc weights have
+            # the same shape in every bucket (shared params)
+            pooled = sym.mean(data, axis=1, keepdims=True, name="pool")
+            net = sym.FullyConnected(pooled, sym.var("fc_weight"),
+                                     sym.var("fc_bias"), num_hidden=8,
+                                     name="fc")
+            out = sym.SoftmaxOutput(net, sym.var("softmax_label"),
+                                    name="softmax")
+            return out, ("data",), ("softmax_label",)
+
+        from mxnet_tpu.io import DataBatch
+        bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                    context=mx.cpu())
+        bm.bind(data_shapes=[("data", (4, 10))],
+                label_shapes=[("softmax_label", (4,))])
+        bm.init_params(initializer=mx.init.Xavier())
+        for seq_len in (10, 6, 10, 6):
+            batch = DataBatch(
+                data=[nd.ones((4, seq_len))],
+                label=[nd.zeros((4,))], bucket_key=seq_len,
+                provide_data=[("data", (4, seq_len))],
+                provide_label=[("softmax_label", (4,))])
+            bm.forward(batch, is_train=False)
+            out = bm.get_outputs()[0]
+            assert out.shape == (4, 8)
+        # both buckets exist, sharing weights
+        assert set(bm._buckets.keys()) == {10, 6}
+        w10 = bm._buckets[10]._exec_group.execs[0].arg_dict["fc_weight"]
+        w6 = bm._buckets[6]._exec_group.execs[0].arg_dict["fc_weight"]
+        np.testing.assert_allclose(w10.asnumpy(), w6.asnumpy())
