@@ -10,6 +10,14 @@ see SURVEY.md §7 for the design stance.
 """
 __version__ = "0.1.0"
 
+import sys as _sys
+
+# deep trace stacks (custom_vjp → jit → pallas_call) exceed CPython's
+# default 1000-frame limit; the reference's Python frontend does the same
+# for deep graphs
+if _sys.getrecursionlimit() < 3000:
+    _sys.setrecursionlimit(3000)
+
 import jax as _jax_config_only
 
 # MXNet supports int64/float64 tensors; JAX demotes them unless x64 is on.
@@ -40,10 +48,24 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import recordio
+from . import image
+from . import models
+from . import profiler
+from . import monitor
+from . import runtime
+from . import envs
+from . import callback
+from . import checkpoint
+from . import checkpoint as model  # mx.model.save_checkpoint parity
+from . import operator
+from . import contrib
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
            "num_gpus", "num_tpus", "Context", "MXNetError", "engine",
            "initializer", "init", "lr_scheduler", "optimizer", "gluon",
            "metric", "io", "test_utils", "kvstore", "kv", "parallel",
-           "symbol", "sym", "module", "mod"]
+           "symbol", "sym", "module", "mod", "recordio", "image",
+           "models", "profiler", "monitor", "runtime", "envs",
+           "callback", "checkpoint", "model", "operator", "contrib"]

@@ -1,0 +1,257 @@
+"""ctypes bindings for the native runtime ``libmxtpu.so``.
+
+Capability parity: the reference's ``python/mxnet/base.py`` ctypes layer
+over ``libmxnet.so`` (SURVEY.md §2.5 "FFI base").  The library is built
+from ``src/`` (``make -C src``); when absent (fresh checkout without a
+toolchain) everything degrades to the pure-Python paths — feature-gated
+exactly like the reference's optional components.
+
+Surfaces bound here:
+
+* ``NativeEngine``   — threaded var-based dependency engine (host-side
+  scheduling: data pipeline, IO, callbacks).
+* ``NativeStorage``  — pooled host allocator with stats.
+* ``NativeRecordIO`` — fast record framing (used by mxnet_tpu.recordio
+  when available).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, List, Optional
+
+__all__ = ["lib", "available", "NativeEngine", "NativeStorage",
+           "NativeRecordIO", "build"]
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu.so")
+lib = None
+
+
+def _try_load():
+    global lib
+    if lib is not None:
+        return lib
+    if os.path.exists(_LIB_PATH):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+        except OSError:
+            lib = None
+    return lib
+
+
+def build():
+    """Compile src/ → mxnet_tpu/lib/libmxtpu.so (needs g++)."""
+    import subprocess
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+    subprocess.run(["make", "-C", src], check=True)
+    return _try_load() is not None
+
+
+def available() -> bool:
+    return _try_load() is not None
+
+
+_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _declare(L):
+    L.MXTPUEngineCreate.restype = ctypes.c_void_p
+    L.MXTPUEngineCreate.argtypes = [ctypes.c_int]
+    L.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+    L.MXTPUEngineNewVar.restype = ctypes.c_uint64
+    L.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
+    L.MXTPUEnginePush.restype = ctypes.c_uint64
+    L.MXTPUEnginePush.argtypes = [
+        ctypes.c_void_p, _CB, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    L.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    L.MXTPUEngineWaitForAll.argtypes = [ctypes.c_void_p]
+    L.MXTPUEngineVarVersion.restype = ctypes.c_uint64
+    L.MXTPUEngineVarVersion.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+
+    L.MXTPUStorageCreate.restype = ctypes.c_void_p
+    L.MXTPUStorageCreate.argtypes = [ctypes.c_int]
+    L.MXTPUStorageFree.argtypes = [ctypes.c_void_p]
+    L.MXTPUStorageAlloc.restype = ctypes.c_void_p
+    L.MXTPUStorageAlloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    L.MXTPUStorageDealloc.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    L.MXTPUStorageReleaseAll.argtypes = [ctypes.c_void_p]
+    for f in ("MXTPUStorageUsedBytes", "MXTPUStoragePoolBytes",
+              "MXTPUStorageTotalAllocs"):
+        getattr(L, f).restype = ctypes.c_uint64
+        getattr(L, f).argtypes = [ctypes.c_void_p]
+
+    L.MXTPURecordIOCreate.restype = ctypes.c_void_p
+    L.MXTPURecordIOCreate.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    L.MXTPURecordIOFree.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOTell.restype = ctypes.c_int64
+    L.MXTPURecordIOTell.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOSeek.restype = ctypes.c_int
+    L.MXTPURecordIOSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    L.MXTPURecordIOWrite.restype = ctypes.c_int
+    L.MXTPURecordIOWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    L.MXTPURecordIORead.restype = ctypes.c_int64
+    L.MXTPURecordIORead.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+
+    L.MXTPUGetLastError.restype = ctypes.c_char_p
+    L.MXTPUGetVersion.restype = ctypes.c_int
+    L.MXTPUHasFeature.restype = ctypes.c_int
+    L.MXTPUHasFeature.argtypes = [ctypes.c_char_p]
+
+
+class NativeEngine:
+    """Var-based dependency engine (parity: Engine::Get() semantics).
+
+    ``push(fn, read_vars, write_vars)`` schedules ``fn`` on the worker
+    pool once every var grants access (readers share, writers exclusive,
+    FIFO per var) — the reference's exact dataflow rule.
+    """
+
+    def __init__(self, num_workers=4):
+        L = _try_load()
+        if L is None:
+            raise RuntimeError("libmxtpu.so not built; run "
+                               "mxnet_tpu._native.build()")
+        self._lib = L
+        self._h = L.MXTPUEngineCreate(num_workers)
+        # keep callbacks alive until executed
+        self._cbs = {}
+        self._cb_lock = threading.Lock()
+        self._next = 0
+
+    def new_var(self) -> int:
+        return self._lib.MXTPUEngineNewVar(self._h)
+
+    def push(self, fn: Callable[[], None], read_vars: List[int] = (),
+             write_vars: List[int] = ()):
+        with self._cb_lock:
+            token = self._next
+            self._next += 1
+
+        def trampoline(_ctx, _token=token):
+            try:
+                fn()
+            finally:
+                with self._cb_lock:
+                    self._cbs.pop(_token, None)
+
+        cb = _CB(trampoline)
+        with self._cb_lock:
+            self._cbs[token] = cb
+        r = (ctypes.c_uint64 * len(read_vars))(*read_vars)
+        w = (ctypes.c_uint64 * len(write_vars))(*write_vars)
+        return self._lib.MXTPUEnginePush(self._h, cb, None, r,
+                                         len(read_vars), w,
+                                         len(write_vars))
+
+    def wait_for_var(self, var: int):
+        self._lib.MXTPUEngineWaitForVar(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.MXTPUEngineWaitForAll(self._h)
+
+    def var_version(self, var: int) -> int:
+        return self._lib.MXTPUEngineVarVersion(self._h, var)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPUEngineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeStorage:
+    """Pooled host allocator (parity: Storage::Get()->Alloc/Free)."""
+
+    def __init__(self, pooled=True):
+        L = _try_load()
+        if L is None:
+            raise RuntimeError("libmxtpu.so not built")
+        self._lib = L
+        self._h = L.MXTPUStorageCreate(1 if pooled else 0)
+
+    def alloc(self, size: int) -> int:
+        return self._lib.MXTPUStorageAlloc(self._h, size)
+
+    def free(self, ptr: int):
+        self._lib.MXTPUStorageDealloc(self._h, ptr)
+
+    def release_all(self):
+        self._lib.MXTPUStorageReleaseAll(self._h)
+
+    @property
+    def used_bytes(self):
+        return self._lib.MXTPUStorageUsedBytes(self._h)
+
+    @property
+    def pool_bytes(self):
+        return self._lib.MXTPUStoragePoolBytes(self._h)
+
+    @property
+    def total_allocs(self):
+        return self._lib.MXTPUStorageTotalAllocs(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPUStorageFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordIO:
+    """Fast recordio framing (same byte format as mxnet_tpu.recordio)."""
+
+    def __init__(self, path: str, writable: bool):
+        L = _try_load()
+        if L is None:
+            raise RuntimeError("libmxtpu.so not built")
+        self._lib = L
+        self._h = L.MXTPURecordIOCreate(path.encode(), 1 if writable
+                                        else 0)
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def tell(self) -> int:
+        return self._lib.MXTPURecordIOTell(self._h)
+
+    def seek(self, pos: int):
+        if self._lib.MXTPURecordIOSeek(self._h, pos) != 0:
+            raise IOError("seek failed")
+
+    def write(self, data: bytes):
+        if self._lib.MXTPURecordIOWrite(self._h, data, len(data)) != 0:
+            raise IOError("write failed")
+
+    def read(self) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.MXTPURecordIORead(self._h, ctypes.byref(out))
+        if n < 0:
+            return None
+        return ctypes.string_at(out, n)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPURecordIOFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

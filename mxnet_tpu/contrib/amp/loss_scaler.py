@@ -1,0 +1,35 @@
+"""Dynamic loss scaler (parity: ``contrib/amp/loss_scaler.py``):
+doubles every ``scale_window`` clean steps, halves on overflow; the
+``all_finite`` check runs on-device as one fused op."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any grad is non-finite; updates the dynamic scale."""
+        if not params:
+            return False
+        finite = nd.all_finite(*params)
+        is_overflow = not bool(finite.asscalar())
+        if is_overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor,
+                                  1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale *
+                                      self._scale_factor, 2.0 ** 24)
+                self._unskipped = 0
+        return is_overflow

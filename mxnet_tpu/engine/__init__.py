@@ -76,10 +76,20 @@ def track(arr):
     return arr
 
 
+# profiler interception point — the reference wires its profiler inside
+# ThreadedEngine::ExecuteOprBlock (SURVEY.md §5 Tracing); ours wraps the
+# dispatch here.  None when profiling is off (zero overhead).
+_profiler_hook = None
+
+
 def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays):
     """Execute an op through the compile cache. Returns jax array(s)."""
     fn = get_compiled(name, fcompute, attrs)
-    out = fn(*arrays)
+    hook = _profiler_hook
+    if hook is not None:
+        out = hook(name, fn, arrays)
+    else:
+        out = fn(*arrays)
     if is_naive():
         import jax
         jax.block_until_ready(out)

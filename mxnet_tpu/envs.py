@@ -1,0 +1,67 @@
+"""Environment-variable registry (parity: the reference's ``MXNET_*``
+env-var system, ``docs/.../env_var.md`` — SURVEY.md §5 "Config / flag
+system").
+
+One module declares every knob with type, default, and doc; reads go
+through :func:`get` so the supported surface is greppable.  The matching
+``MXNET_*`` spelling is honoured as a fallback where the reference had
+the same knob.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, NamedTuple
+
+__all__ = ["get", "registry", "EnvVar"]
+
+
+class EnvVar(NamedTuple):
+    name: str
+    type: type
+    default: Any
+    doc: str
+    mxnet_alias: str = ""
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _reg(name, typ, default, doc, mxnet_alias=""):
+    _REGISTRY[name] = EnvVar(name, typ, default, doc, mxnet_alias)
+
+
+_reg("MXTPU_ENGINE_TYPE", str, "",
+     "Set to 'NaiveEngine' for synchronous per-op execution "
+     "(debugging/determinism).", "MXNET_ENGINE_TYPE")
+_reg("MXTPU_TEST_ON_TPU", bool, False,
+     "Run the test suite against the real TPU chip instead of the "
+     "8-device CPU mesh.")
+_reg("MXTPU_DISABLE_FLASH", bool, False,
+     "Disable the Pallas flash-attention kernel (use the XLA SDPA "
+     "path everywhere).")
+_reg("MXTPU_PROFILE_SYNC", bool, False,
+     "Profiler blocks on each op for accurate per-op device time "
+     "(slower; like the reference's synchronous profiling mode).")
+_reg("MXTPU_SEED", int, 0,
+     "Global RNG seed override applied at import.", "MXNET_SEED")
+_reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
+     "Accepted for parity; XLA fuses whole graphs at the hybridize "
+     "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")
+
+
+def registry():
+    """All declared env vars (name → EnvVar)."""
+    return dict(_REGISTRY)
+
+
+def get(name: str):
+    """Read an env var through the registry (with MXNET_* fallback)."""
+    var = _REGISTRY[name]
+    raw = os.environ.get(var.name)
+    if raw is None and var.mxnet_alias:
+        raw = os.environ.get(var.mxnet_alias)
+    if raw is None:
+        return var.default
+    if var.type is bool:
+        return raw not in ("", "0", "false", "False")
+    return var.type(raw)

@@ -10,6 +10,8 @@ from . import nn
 from . import loss
 from . import utils
 from . import data
+from . import rnn
+from . import model_zoo
 
 __all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
            "SymbolBlock", "CachedOp", "Trainer", "nn", "loss", "utils",

@@ -359,6 +359,62 @@ class _CacheEntry:
         self.out_avals = None
 
 
+def _flatten_args(args):
+    """Flatten nested (list/tuple of) NDArray args into leaves + treedef
+    (cells pass state lists; attention passes mask tuples).  numpy arrays
+    become NDArray leaves (data, not compile-time constants); other
+    non-array values are static and keyed by repr — like jit static args,
+    a changing static value recompiles."""
+    import numpy as _np
+    from .. import ndarray as _nd
+    leaves = []
+
+    def go(x):
+        if isinstance(x, _np.ndarray):
+            x = _nd.array(x, dtype=x.dtype)
+        if isinstance(x, NDArray):
+            leaves.append(x)
+            return ("L", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return ("l" if isinstance(x, list) else "t",
+                    tuple(go(y) for y in x))
+        return ("C", x)  # static constant (None, scalars, strings)
+
+    tree = tuple(go(a) for a in args)
+    return leaves, tree
+
+
+def _tree_cache_key(tree):
+    """Hashable form of a treedef (constants may be unhashable)."""
+
+    def go(t):
+        tag = t[0]
+        if tag in ("l", "t"):
+            return (tag, tuple(go(y) for y in t[1]))
+        if tag == "C":
+            try:
+                hash(t[1])
+                return ("C", t[1])
+            except TypeError:
+                return ("C", repr(t[1]))
+        return t
+
+    return tuple(go(t) for t in tree)
+
+
+def _unflatten_args(tree, leaves):
+    def go(t):
+        tag = t[0]
+        if tag == "L":
+            return leaves[t[1]]
+        if tag == "C":
+            return t[1]
+        seq = [go(y) for y in t[1]]
+        return seq if tag == "l" else tuple(seq)
+
+    return [go(t) for t in tree]
+
+
 class CachedOp:
     """Compiled-executable cache for a HybridBlock (parity: CachedOp)."""
 
@@ -374,7 +430,7 @@ class CachedOp:
         CachedOp._uid[0] += 1
         self.name = f"cachedop_{block.name}_{CachedOp._uid[0]}"
 
-    def _collect_param_arrays(self, args):
+    def _collect_param_arrays(self, leaves, call_args):
         """Stable ordered list of param NDArray replicas for the call ctx."""
         if self._param_list is None:
             params = list(self.block.collect_params().values())
@@ -382,9 +438,9 @@ class CachedOp:
                 # one imperative warm-up run resolves every deferred shape
                 from .. import autograd
                 with autograd.pause():
-                    self.block._call_unhybridized(*args)
+                    self.block._call_unhybridized(*call_args)
             self._param_list = params
-        ctx = args[0].context if args else None
+        ctx = leaves[0].context if leaves else None
         out = []
         for p in self._param_list:
             d = p._check_and_get(p._data, None)
@@ -393,9 +449,10 @@ class CachedOp:
             out.append(d)
         return out
 
-    def _get_entry(self, param_nds, args, training) -> _CacheEntry:
-        ctx = args[0].context if args else current_context()
-        key = (tuple((a.shape, a.dtype.name) for a in args),
+    def _get_entry(self, param_nds, leaves, tree, ctx,
+                   training) -> _CacheEntry:
+        key = (tuple((a.shape, a.dtype.name) for a in leaves),
+               _tree_cache_key(tree),
                tuple((p.shape, p.dtype.name) for p in param_nds),
                training, ctx)
         entry = self._entries.get(key)
@@ -406,7 +463,7 @@ class CachedOp:
         block = self.block
         params = self._param_list
         n_params = len(param_nds)
-        n_args = len(args)
+        n_args = len(leaves)
 
         def pure(*flat):
             """Functionalized forward: (params…, inputs…, base_key) →
@@ -431,11 +488,12 @@ class CachedOp:
             for r, v in zip(reps, param_vals):
                 r._buf = v
             shells = [NDArray(v, ctx=ctx) for v in input_vals]
+            call_args = _unflatten_args(tree, shells)
             _rnd._push_key_provider(key_provider)
             prev_tracing = getattr(_trace_state, "active", False)
             _trace_state.active = True
             try:
-                outs = block._call_unhybridized(*shells)
+                outs = block._call_unhybridized(*call_args)
                 out_is_list = isinstance(outs, (list, tuple))
                 outs_l = list(outs) if out_is_list else [outs]
                 out_data = tuple(o._data for o in outs_l)
@@ -473,13 +531,14 @@ class CachedOp:
         from .. import random as _rnd
         import jax
 
-        param_nds = self._collect_param_arrays(args)
+        leaves, tree = _flatten_args(args)
+        param_nds = self._collect_param_arrays(leaves, args)
         training = autograd.is_training()
-        entry = self._get_entry(param_nds, args, training)
-        ctx = args[0].context if args else current_context()
+        ctx = leaves[0].context if leaves else current_context()
+        entry = self._get_entry(param_nds, leaves, tree, ctx, training)
         base_key = _rnd._next_key_nd(ctx)
 
-        flat = [p._data for p in param_nds] + [a._data for a in args] \
+        flat = [p._data for p in param_nds] + [a._data for a in leaves] \
             + [base_key._data]
 
         if autograd.is_recording():
@@ -491,7 +550,7 @@ class CachedOp:
                 return _fn(cots if isinstance(cots, tuple) else (cots,))
 
             node = autograd._Node(
-                vjp_tuple, list(param_nds) + list(args), 1,
+                vjp_tuple, list(param_nds) + list(leaves), 1,
                 [o.aval for o in out_all])
         else:
             out_all = entry.jitted(*flat)
@@ -587,6 +646,13 @@ class HybridBlock(Block):
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
+            # record which positions carry arrays (None/other stays
+            # literal at export time)
+            self._in_sig = tuple(
+                isinstance(a, NDArray) or (
+                    isinstance(a, (list, tuple)) and
+                    any(isinstance(e, NDArray) for e in a))
+                for a in (x,) + args)
             if self._active and not _is_tracing():
                 if self._cached_op is None:
                     self._cached_op = CachedOp(self, **{
@@ -603,16 +669,37 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
-        """Export compiled model (parity: HybridBlock.export).
-
-        Saves ``path-symbol.json`` (graph metadata) + params; full
-        StableHLO bundle lands with the symbol milestone.
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export (parity: HybridBlock.export): writes
+        ``path-symbol.json`` (the traced graph — load with
+        ``SymbolBlock.imports`` or ``mx.sym.load``, no model code needed)
+        and ``path-%04d.params`` (``arg:``/``aux:``-prefixed arrays, the
+        reference's checkpoint layout shared with Module).
         """
-        params = {}
+        from .. import symbol as sym_mod
+        sig = getattr(self, "_in_sig", None)
+        if sig is None:
+            raise MXNetError(
+                "export() needs the input signature: run the block on "
+                "real data once before exporting (parity: the reference "
+                "exports the cached graph)")
+        n_arrays = sum(sig)
+        in_names = ["data"] if n_arrays == 1 else \
+            [f"data{i}" for i in range(n_arrays)]
+        it = iter(in_names)
+        call_args = [sym_mod.var(next(it)) if is_arr else None
+                     for is_arr in sig]
+        out = self(*call_args)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        aux_names = set(out.list_auxiliary_states())
+        payload = {}
         for name, param in self.collect_params().items():
-            params[name] = param._check_and_get(param._data, None)
-        nd.save(f"{path}-{epoch:04d}.params", params)
+            arr = param._check_and_get(param._data, None)
+            tag = "aux:" if name in aux_names else "arg:"
+            payload[tag + name] = arr
+        nd.save(f"{path}-{epoch:04d}.params", payload)
 
 
 class _name_prefix:
@@ -629,21 +716,78 @@ class _name_prefix:
 class SymbolBlock(HybridBlock):
     """Block wrapping a symbolic graph (parity: gluon.SymbolBlock).
 
-    Constructed from outputs/inputs Symbols; `imports` loads an exported
-    model.  Lands fully with the symbol milestone; parameter-only loading
-    works today.
+    Runs an exported model without its Python model code: the graph
+    executes through a cached whole-graph Executor (one XLA program), the
+    same seam ``HybridBlock.hybridize`` uses.
     """
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
-        self._inputs = inputs
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = list(inputs)
+        self._sym_outputs = outputs
+        self._sym_inputs = [i.name for i in inputs]
+        input_set = set(self._sym_inputs)
+        self._aux_names = outputs.list_auxiliary_states()
+        for name in outputs.list_arguments():
+            if name not in input_set:
+                self.params.get(name, allow_deferred_init=True)
+        for name in self._aux_names:
+            self.params.get(name, grad_req="null",
+                            allow_deferred_init=True)
+        self._executors = {}  # (shapes, dtypes) → Executor
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "SymbolBlock.imports lands with the symbol milestone")
+        """Load an exported model (parity: SymbolBlock.imports)."""
+        from .. import symbol as sym_mod
+        from ..context import current_context
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            saved = nd.load(param_file)
+            arg_params = {}
+            for k, v in saved.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                arg_params[name] = v
+            for name, param in block.collect_params().items():
+                if name in arg_params:
+                    param._load_init(arg_params[name], ctx)
+                else:
+                    raise MXNetError(
+                        f"Parameter {name!r} missing in {param_file!r}")
+        return block
 
     def forward(self, x, *args):
-        raise NotImplementedError(
-            "SymbolBlock.forward lands with the symbol milestone")
+        from ..context import current_context
+        inputs = [x] + list(args)
+        if len(inputs) != len(self._sym_inputs):
+            raise MXNetError(
+                f"SymbolBlock expects {len(self._sym_inputs)} inputs "
+                f"({self._sym_inputs}), got {len(inputs)}")
+        key = tuple((i.shape, i.dtype.name) for i in inputs)
+        executor = self._executors.get(key)
+        if executor is None:
+            ctx = x.context
+            arg_dict = {}
+            for n, i in zip(self._sym_inputs, inputs):
+                arg_dict[n] = nd.zeros(i.shape, ctx=ctx,
+                                       dtype=i.dtype.name)
+            aux_dict = {}
+            for name, p in self.collect_params().items():
+                if name in self._aux_names:
+                    aux_dict[name] = p.data()
+                else:
+                    arg_dict[name] = p.data()
+            executor = self._sym_outputs.bind(
+                ctx, arg_dict, grad_req="null", aux_states=aux_dict)
+            self._executors[key] = executor
+        kwargs = {n: i for n, i in zip(self._sym_inputs, inputs)}
+        outs = executor.forward(is_train=False, **kwargs)
+        return outs[0] if len(outs) == 1 else list(outs)

@@ -1,0 +1,5 @@
+"""``mx.gluon.contrib``: transformer blocks and other staging-ground
+layers (SURVEY.md §2.2 contrib)."""
+from . import nn
+
+__all__ = ["nn"]

@@ -1,0 +1,142 @@
+"""Transformer building blocks (capability target: GluonNLP's
+``gluonnlp.model.transformer``/BERT blocks — SURVEY.md §2.6 "External
+zoos" and §5 "Long-context").
+
+Built on the fused ``dot_product_attention`` op (Pallas flash path on
+TPU): one op per attention instead of the reference's interleaved-matmul
+chains.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head self/cross attention (units == num_heads * head_dim)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by num_heads "
+                             f"{num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        with self.name_scope():
+            self.query_proj = nn.Dense(units, flatten=False,
+                                       use_bias=use_bias, prefix="query_")
+            self.key_proj = nn.Dense(units, flatten=False,
+                                     use_bias=use_bias, prefix="key_")
+            self.value_proj = nn.Dense(units, flatten=False,
+                                       use_bias=use_bias, prefix="value_")
+            self.out_proj = nn.Dense(units, flatten=False,
+                                     use_bias=use_bias, prefix="out_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, query, key=None, value=None, mask=None):
+        if key is None:
+            key = query
+        if value is None:
+            value = key
+        b, s_q = query.shape[0], query.shape[1]
+        s_k = key.shape[1]
+        h = self._num_heads
+        d = self._units // h
+        q = self.query_proj(query).reshape((b, s_q, h, d))
+        k = self.key_proj(key).reshape((b, s_k, h, d))
+        v = self.value_proj(value).reshape((b, s_k, h, d))
+        if mask is not None:
+            out = F.dot_product_attention(q, k, v, mask, use_mask=True)
+        else:
+            out = F.dot_product_attention(q, k, v)
+        out = out.reshape((b, s_q, self._units))
+        out = self.out_proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False,
+                                  prefix="ffn1_")
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+        self._activation = activation
+
+    def hybrid_forward(self, F, x):
+        h = self.ffn_1(x)
+        if self._activation == "gelu":
+            h = F.LeakyReLU(h, act_type="gelu")
+        else:
+            h = F.Activation(h, act_type=self._activation)
+        h = self.ffn_2(h)
+        if self.drop is not None:
+            h = self.drop(h)
+        return h
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre/post-LN encoder layer (BERT uses post-LN, the default)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="gelu", pre_norm=False, **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout)
+            self.ffn = PositionwiseFFN(units, hidden_size,
+                                       dropout=dropout,
+                                       activation=activation)
+            self.layer_norm_att = nn.LayerNorm(in_channels=units)
+            self.layer_norm_ffn = nn.LayerNorm(in_channels=units)
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask=None):
+        # Block.__call__ is positional: (query, key, value, mask)
+        if self._pre_norm:
+            att = self.attention(self.layer_norm_att(x), None, None, mask)
+            x = x + att
+            out = self.ffn(self.layer_norm_ffn(x))
+            return x + out
+        att = self.attention(x, None, None, mask)
+        if self.drop is not None:
+            att = self.drop(att)
+        x = self.layer_norm_att(x + att)
+        out = self.ffn(x)
+        return self.layer_norm_ffn(x + out)
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, units, hidden_size, num_layers, num_heads,
+                 dropout=0.0, activation="gelu", pre_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout=dropout,
+                    activation=activation, pre_norm=pre_norm,
+                    prefix=f"layer{i}_")
+                self.register_child(cell, f"layer{i}")
+                self.layers.append(cell)
+
+    def hybrid_forward(self, F, x, mask=None):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x

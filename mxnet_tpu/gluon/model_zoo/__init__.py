@@ -1,0 +1,5 @@
+"""``mx.gluon.model_zoo`` (SURVEY.md §2.6)."""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

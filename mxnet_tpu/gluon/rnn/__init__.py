@@ -1,0 +1,12 @@
+"""``mx.gluon.rnn``: recurrent cells and fused layers (SURVEY.md §2.2
+RNN ops, §2.5 Gluon core)."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell,
+                       HybridSequentialRNNCell, DropoutCell, ResidualCell,
+                       BidirectionalCell, ZoneoutCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ResidualCell", "BidirectionalCell",
+           "ZoneoutCell", "RNN", "LSTM", "GRU"]

@@ -1,0 +1,516 @@
+"""Recurrent cells (parity: ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Cell-level API: explicit per-step state, ``unroll`` for fixed-length
+static unrolling (hybridizable — the unrolled graph fuses under XLA), and
+modifier/composite cells.  Gate orders match the reference: LSTM
+``[i, f, c, o]``, GRU ``[r, z, n]``.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn.activations import Activation
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ResidualCell", "BidirectionalCell",
+           "ZoneoutCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to a list of per-step arrays (or merged tensor)."""
+    axis = layout.find("T")
+    if isinstance(inputs, (list, tuple)):
+        in_list = list(inputs)
+        if length is not None and len(in_list) != length:
+            raise MXNetError(f"unroll: expected {length} steps, got "
+                             f"{len(in_list)}")
+        return in_list, axis
+    if axis != 0:
+        inputs = inputs.swapaxes(0, axis)
+    steps = inputs.shape[0]
+    if length is not None and steps != length:
+        raise MXNetError(f"unroll: expected length {length}, data has "
+                         f"{steps}")
+    return [inputs[i] for i in range(steps)], axis
+
+
+def _merge_outputs(outputs, axis):
+    stacked = nd.stack(*outputs, axis=0)
+    if axis != 0:
+        stacked = stacked.swapaxes(0, axis)
+    return stacked
+
+
+class RecurrentCell(HybridBlock):
+    """Base class for rnn cells."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called "\
+            "directly. Call the modifier cell instead."
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **dict(info, **kwargs)))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Static unroll: a Python loop the compiler fuses (parity:
+        RecurrentCell.unroll)."""
+        self.reset()
+        in_list, axis = _format_sequence(length, inputs, layout, False)
+        batch_size = in_list[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size,
+                                           ctx=in_list[0].context)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(in_list[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack(*outputs, axis=0)
+            masked = nd.SequenceMask(stacked, valid_length,
+                                     use_sequence_length=True)
+            outputs = [masked[i] for i in range(length)]
+        if merge_outputs:
+            return _merge_outputs(outputs, axis), states
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+    def _alias(self):
+        return "rnn"
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell: h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_shape(self, inputs, states):
+        self.i2h_weight.shape = (self._hidden_size, inputs.shape[-1])
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+    def _deferred_infer_shape(self, inputs, states):
+        self.infer_shape(inputs, states)
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell; gates ordered [i, f, c, o] (reference order)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, activation="tanh",
+                 recurrent_activation="sigmoid", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_shape(self, inputs, states):
+        self.i2h_weight.shape = (4 * self._hidden_size, inputs.shape[-1])
+
+    def _deferred_infer_shape(self, inputs, states):
+        self.infer_shape(inputs, states)
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.Activation(slices[0],
+                               act_type=self._recurrent_activation)
+        forget_gate = F.Activation(slices[1],
+                                   act_type=self._recurrent_activation)
+        in_transform = F.Activation(slices[2], act_type=self._activation)
+        out_gate = F.Activation(slices[3],
+                                act_type=self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.Activation(next_c,
+                                         act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell; gates ordered [r, z, n] (reference order)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def infer_shape(self, inputs, states):
+        self.i2h_weight.shape = (3 * self._hidden_size, inputs.shape[-1])
+
+    def _deferred_infer_shape(self, inputs, states):
+        self.infer_shape(inputs, states)
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        new = F.tanh(i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * new + update * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells sequentially (parity: SequentialRNNCell)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def __len__(self):
+        return len(self._children)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        in_list, axis = _format_sequence(length, inputs, layout, False)
+        batch_size = in_list[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size,
+                                           ctx=in_list[0].context)
+        p = 0
+        next_states = []
+        cells = list(self._children.values())
+        for i, cell in enumerate(cells):
+            n = len(cell.state_info())
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < len(cells) - 1
+                else merge_outputs, valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class ModifierCell(HybridRecurrentCell):
+    def __init__(self, base_cell):
+        assert not base_cell._modified
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+        self.register_child(base_cell)
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs) \
+            if func is not None else self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Applies dropout on input (parity: DropoutCell)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ResidualCell(ModifierCell):
+    """Adds residual connection around the base cell."""
+
+    def __call__(self, inputs, states):
+        self.base_cell._modified = False
+        output, states = self.base_cell(inputs, states)
+        self.base_cell._modified = True
+        return output + inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        self.base_cell._modified = True
+        in_list, axis = _format_sequence(length, inputs, layout, False)
+        outputs = [o + x for o, x in zip(outputs, in_list)]
+        if merge_outputs:
+            return _merge_outputs(outputs, axis), states
+        return outputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (parity: ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell)
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+        cell = self.base_cell
+        cell._modified = False
+        next_output, next_states = cell(inputs, states)
+        cell._modified = True
+        if not autograd.is_training():
+            return next_output, next_states
+
+        def mask(p, like):
+            return nd.random.uniform(0, 1, shape=like.shape,
+                                     ctx=like.context) < p
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = nd.zeros(next_output.shape,
+                                   ctx=next_output.context)
+        out = nd.where(mask(self.zoneout_outputs, next_output),
+                       prev_output, next_output) \
+            if self.zoneout_outputs > 0 else next_output
+        new_states = [nd.where(mask(self.zoneout_states, ns), os, ns)
+                      if self.zoneout_states > 0 else ns
+                      for ns, os in zip(next_states, states)]
+        self._prev_output = out
+        return out, new_states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Runs l_cell forward and r_cell backward over the sequence."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped — use "
+                        "unroll()")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        in_list, axis = _format_sequence(length, inputs, layout, False)
+        batch_size = in_list[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size,
+                                           ctx=in_list[0].context)
+        cells = list(self._children.values())
+        l_cell, r_cell = cells[0], cells[1]
+        n_l = len(l_cell.state_info())
+
+        def _reverse(seq_list):
+            """Reverse the time axis; with valid_length, reverse only the
+            valid prefix per sequence (parity: SequenceReverse with
+            sequence_length) so the backward cell starts on real data,
+            not padding."""
+            if valid_length is None:
+                return list(reversed(seq_list))
+            vl = valid_length if isinstance(valid_length, nd.NDArray) \
+                else nd.array(valid_length)
+            stacked = nd.stack(*seq_list, axis=0)
+            rev = nd.SequenceReverse(stacked, vl,
+                                     use_sequence_length=True)
+            return [rev[i] for i in range(len(seq_list))]
+
+        l_outputs, l_states = l_cell.unroll(
+            length, in_list, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, _reverse(in_list),
+            begin_state=begin_state[n_l:], layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        r_outputs = _reverse(r_outputs)
+        outputs = [nd.concat(l, r, dim=1)
+                   for l, r in zip(l_outputs, r_outputs)]
+        if merge_outputs:
+            return _merge_outputs(outputs, axis), l_states + r_states
+        return outputs, l_states + r_states

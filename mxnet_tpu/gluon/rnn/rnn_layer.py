@@ -1,0 +1,230 @@
+"""Fused recurrent layers RNN/LSTM/GRU (parity:
+``python/mxnet/gluon/rnn/rnn_layer.py`` over the cuDNN-fused ``src/
+operator/rnn*`` — SURVEY.md §2.2 "RNN ops").
+
+TPU-native design: the input projection ``x·W_i2hᵀ`` for ALL timesteps is
+ONE large matmul (MXU-shaped), then only the recurrent half scans via
+``lax.scan`` (contrib.foreach).  This is the same split the cuDNN fused
+kernels use, expressed in the compiler's vocabulary instead of a
+hand-fused kernel.  Multi-layer and bidirectional stack/concat exactly
+like the reference; param names (``l0_i2h_weight``, ``r0_h2h_bias``…)
+match so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, gates, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be TNC or NTC"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = gates
+
+        ng, ni, nh = gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in (["l", "r"] if bidirectional else ["l"]):
+                    self._register_param(f"{j}{i}_i2h_weight",
+                                         (ng * nh, ni))
+                    self._register_param(f"{j}{i}_h2h_weight",
+                                         (ng * nh, nh))
+                    self._register_param(f"{j}{i}_i2h_bias", (ng * nh,))
+                    self._register_param(f"{j}{i}_h2h_bias", (ng * nh,))
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape):
+        p = self.params.get(name, shape=shape, allow_deferred_init=True)
+        setattr(self, name, p)  # __setattr__ registers into _reg_params
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **dict(info, **kwargs)))
+        return states
+
+    def infer_shape(self, inputs, *args):
+        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def _deferred_infer_shape(self, *args):
+        self.infer_shape(*args)
+
+    def __call__(self, inputs, states=None):
+        return super().__call__(inputs, states)
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        explicit_states = states is not None
+        x = inputs
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)  # internal compute is time-major
+        batch_size = x.shape[1]
+        if states is None:
+            states = self.begin_state(batch_size, ctx=inputs.context)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+
+        outputs, out_states = self._forward_kernel(F, x, list(states),
+                                                   params)
+        if self._layout == "NTC":
+            outputs = outputs.swapaxes(0, 1)
+        if explicit_states:
+            return outputs, out_states
+        return outputs
+
+    # per-subclass: single-direction scan over one layer
+    def _layer_scan(self, F, proj, h2h_weight, h2h_bias, init_states):
+        raise NotImplementedError
+
+    def _forward_kernel(self, F, x, states, params):
+        """states: list of (num_layers*dir, N, H) arrays."""
+        ns = len(self.state_info())
+        layer_in = x
+        out_state_slices = [[] for _ in range(ns)]
+        for i in range(self._num_layers):
+            dir_outs = []
+            for d, j in enumerate(["l", "r"][:self._dir]):
+                w_i2h = params[f"{j}{i}_i2h_weight"]
+                w_h2h = params[f"{j}{i}_h2h_weight"]
+                b_i2h = params[f"{j}{i}_i2h_bias"]
+                b_h2h = params[f"{j}{i}_h2h_bias"]
+                seq = layer_in if d == 0 else F.reverse(layer_in, axis=0)
+                # ONE big input projection across all timesteps (MXU)
+                T, N = seq.shape[0], seq.shape[1]
+                flat = seq.reshape((T * N, -1))
+                proj = F.FullyConnected(
+                    flat, w_i2h, b_i2h,
+                    num_hidden=self._gates * self._hidden_size)
+                proj = proj.reshape((T, N,
+                                     self._gates * self._hidden_size))
+                idx = i * self._dir + d
+                init = [s[idx] for s in states]
+                outs, finals = self._layer_scan(F, proj, w_h2h, b_h2h,
+                                                init)
+                if d == 1:
+                    outs = F.reverse(outs, axis=0)
+                dir_outs.append(outs)
+                for k, fs in enumerate(finals):
+                    out_state_slices[k].append(fs)
+            layer_out = dir_outs[0] if self._dir == 1 else \
+                F.concat(dir_outs[0], dir_outs[1], dim=2)
+            if self._dropout and i < self._num_layers - 1:
+                layer_out = F.Dropout(layer_out, p=self._dropout)
+            layer_in = layer_out
+        out_states = [F.stack(*slices, axis=0)
+                      for slices in out_state_slices]
+        return layer_in, out_states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (parity: gluon.rnn.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        self._activation = activation
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=1, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+    def _layer_scan(self, F, proj, w_h2h, b_h2h, init):
+        act = self._activation
+        nh = self._hidden_size
+
+        def body(xt, h):
+            h_new = F.Activation(
+                xt + F.FullyConnected(h, w_h2h, b_h2h, num_hidden=nh),
+                act_type=act)
+            return h_new, h_new
+
+        outs, final_h = F.contrib.foreach(body, proj, init[0])
+        return outs, [final_h]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (parity: gluon.rnn.LSTM); states [h, c]."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=4, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+    def _layer_scan(self, F, proj, w_h2h, b_h2h, init):
+        nh = self._hidden_size
+
+        def body(xt, hc):
+            h, c = hc
+            gates = xt + F.FullyConnected(h, w_h2h, b_h2h,
+                                          num_hidden=4 * nh)
+            ig, fg, cg, og = F.split(gates, num_outputs=4, axis=1)
+            i_t = F.sigmoid(ig)
+            f_t = F.sigmoid(fg)
+            c_t = f_t * c + i_t * F.tanh(cg)
+            h_t = F.sigmoid(og) * F.tanh(c_t)
+            return h_t, [h_t, c_t]
+
+        outs, finals = F.contrib.foreach(body, proj, init)
+        return outs, finals
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (parity: gluon.rnn.GRU); gate order [r, z, n]."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, gates=3, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+    def _layer_scan(self, F, proj, w_h2h, b_h2h, init):
+        nh = self._hidden_size
+
+        def body(xt, h):
+            h2h = F.FullyConnected(h, w_h2h, b_h2h, num_hidden=3 * nh)
+            i_r, i_z, i_n = F.split(xt, num_outputs=3, axis=1)
+            h_r, h_z, h_n = F.split(h2h, num_outputs=3, axis=1)
+            r = F.sigmoid(i_r + h_r)
+            z = F.sigmoid(i_z + h_z)
+            n = F.tanh(i_n + r * h_n)
+            h_new = (1.0 - z) * n + z * h
+            return h_new, h_new
+
+        outs, final_h = F.contrib.foreach(body, proj, init[0])
+        return outs, [final_h]

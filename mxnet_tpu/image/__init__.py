@@ -1,0 +1,3 @@
+"""``mx.image`` (SURVEY.md §2.4): decode, augmenters, ImageIter."""
+from .image import *  # noqa: F401,F403
+from .image import __all__  # noqa: F401

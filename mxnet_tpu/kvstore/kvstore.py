@@ -19,6 +19,8 @@ __all__ = ["KVStore", "KVStoreTPUSync", "create"]
 
 
 def _as_list(x):
+    # list-returning variant (the shared base._as_list returns the
+    # original sequence; kvstore mutates its copies)
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 

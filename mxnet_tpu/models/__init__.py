@@ -1,0 +1,9 @@
+"""``mxnet_tpu.models``: model families beyond the in-repo gluon zoo
+(capability targets from SURVEY.md §2.6: GluonNLP BERT, GluonTS
+forecasters; Llama-family stretch)."""
+from . import bert
+from .bert import BERTModel, BERTForPretrain, bert_base, bert_small, \
+    bert_large, get_bert
+
+__all__ = ["bert", "BERTModel", "BERTForPretrain", "bert_base",
+           "bert_small", "bert_large", "get_bert"]

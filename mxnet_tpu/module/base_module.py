@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import time
 
-from ..base import MXNetError
+from ..base import MXNetError, _as_list
 from .. import metric as metric_mod
 from .. import io as io_mod
 
@@ -188,7 +188,3 @@ class BatchEndParam:
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals
-
-
-def _as_list(x):
-    return x if isinstance(x, (list, tuple)) else [x]

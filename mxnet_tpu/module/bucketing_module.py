@@ -66,6 +66,17 @@ class BucketingModule(BaseModule):
         self.binded = True
         self.for_training = for_training
 
+    def _share_optimizer(self, mod):
+        """Every bucket shares ONE optimizer/updaters/kvstore (params are
+        shared, so per-bucket update state must be too)."""
+        src = next((m for m in self._buckets.values()
+                    if m.optimizer_initialized), None)
+        if src is not None and not mod.optimizer_initialized:
+            mod._optimizer = src._optimizer
+            mod._updaters = src._updaters
+            mod._kvstore = src._kvstore
+            mod.optimizer_initialized = True
+
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         assert self.binded, "call bind before switching buckets"
         mod = self._gen_module(bucket_key)
@@ -74,15 +85,11 @@ class BucketingModule(BaseModule):
             if self.params_initialized:
                 arg_p, aux_p = self.get_params()
                 mod.set_params(arg_p, aux_p)
-                if self._curr_module.optimizer_initialized:
-                    mod._optimizer = self._curr_module._optimizer
-                    mod._updaters = self._curr_module._updaters
-                    mod._kvstore = self._curr_module._kvstore
-                    mod.optimizer_initialized = True
         elif self.params_initialized:
             # sync shared params into the bucket being activated
             arg_p, aux_p = self.get_params()
             mod.set_params(arg_p, aux_p)
+        self._share_optimizer(mod)
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
 
@@ -102,6 +109,9 @@ class BucketingModule(BaseModule):
 
     def init_optimizer(self, **kwargs):
         self._curr_module.init_optimizer(**kwargs)
+        for mod in self._buckets.values():
+            if mod.binded:
+                self._share_optimizer(mod)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):

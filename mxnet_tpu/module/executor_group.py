@@ -121,8 +121,21 @@ class DataParallelExecutorGroup:
             ex.forward(is_train=is_train)
 
     def backward(self, out_grads=None):
-        for ex in self.execs:
-            ex.backward(out_grads)
+        if out_grads is None:
+            for ex in self.execs:
+                ex.backward(None)
+            return
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        n = len(self.execs)
+        if n == 1:
+            self.execs[0].backward(out_grads)
+            return
+        # slice head gradients along the batch axis, one shard per device
+        sliced = [split_data(g, n) for g in out_grads]
+        for i, ex in enumerate(self.execs):
+            ex.backward([s[i].as_in_context(ex.arg_dict[
+                self.data_names[0]].context) for s in sliced])
 
     def forward_backward(self, data_batch):
         """Fused fwd+bwd: ONE XLA program per device (the fit hot path)."""

@@ -1,0 +1,70 @@
+"""Monitor: per-op output statistics without graph surgery.
+
+Capability parity: reference ``python/mxnet/monitor.py`` (SURVEY.md §5
+"Metrics / logging"): installs a stat callback on executors
+(``set_monitor_callback``), collects (batch, name, stat) triples between
+``tic()`` and ``toc()``, prints sorted.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func: Optional[Callable] = None,
+                 pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return nd.norm(x) / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Attach to an executor (parity: Monitor.install)."""
+        exe.set_monitor_callback(self._stat_helper)
+        self.exes.append(exe)
+
+    def _stat_helper(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, name, stat in queue:
+            if isinstance(stat, NDArray):
+                stat = stat.asnumpy()
+            res.append((n, name, stat))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, name, stat in res:
+            print(f"Batch: {n:7d} {name:30s} {stat}")
+        return res

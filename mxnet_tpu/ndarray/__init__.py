@@ -141,7 +141,8 @@ _generate(_mod)
 
 from . import random  # noqa: E402  (nd.random namespace)
 from . import sparse  # noqa: E402  (stype facade)
+from . import contrib  # noqa: E402  (control-flow ops)
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
            "eye", "concatenate", "save", "load", "waitall", "invoke",
-           "random", "sparse", "moveaxis"]
+           "random", "sparse", "contrib", "moveaxis"]

@@ -1,0 +1,343 @@
+"""Hybridizable control-flow ops: foreach / while_loop / cond.
+
+Capability parity: reference ``src/operator/control_flow.cc`` (SURVEY.md
+§2.2 "Control-flow ops") — higher-order ops taking Python bodies, making
+RNN-style loops graph-compilable.  TPU-native design: they lower DIRECTLY
+to ``lax.scan`` / masked-scan / ``lax.cond`` — the exact mapping SURVEY.md
+calls out — so a loop is one fused XLA region, not per-iteration dispatch.
+
+Two integration points make these behave like the reference's ops:
+
+* **Closure capture.** The reference cuts the body subgraph and collects
+  its free variables so gradients flow to parameters used inside a loop
+  body.  Here a capture scope (ndarray.invoke hook) detects every external
+  NDArray the body touches during a shape-only dry trace; those arrays
+  become explicit differentiable inputs via CachedOp-style buffer swap.
+* **Autograd.** Under ``autograd.record()`` the whole control-flow op is
+  ONE tape node whose vjp is ``jax.vjp`` of the lowered function —
+  gradients flow through scan/cond to data, states, and captured params.
+
+``while_loop`` lowers to a *masked* ``lax.scan`` over ``max_iterations``
+(once the predicate turns false, carries stop updating): reverse-mode
+differentiable and TPU-friendly, where ``lax.while_loop`` would forbid
+backward.  The reference also required ``max_iterations`` imperatively.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from . import ndarray as nd_core
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+class _CaptureScope:
+    """Records external NDArrays observed by invoke() during a dry trace."""
+
+    def __init__(self, internal):
+        self._internal = {id(x) for x in internal}
+        self.captured: List[NDArray] = []
+        self._captured_ids = set()
+
+    def observe(self, inputs):
+        for x in inputs:
+            # views capture their BASE: the buffer swap in _swap() writes
+            # `_buf`, which views read through `_base` — capturing the view
+            # itself would leave the base a constant and zero its grads
+            base = x
+            while base._base is not None:
+                base = base._base
+            if id(base) not in self._internal and \
+                    id(base) not in self._captured_ids:
+                self._captured_ids.add(id(base))
+                self.captured.append(base)
+
+    def mark_internal(self, arrays):
+        for a in arrays:
+            self._internal.add(id(a))
+
+
+def _detect_captures(run, shells):
+    """Dry-run `run` under jax.eval_shape with a capture scope active."""
+    import jax
+
+    scope = _CaptureScope(shells)
+
+    def dry(*vals):
+        for s, v in zip(shells, vals):
+            s._buf = v
+        outs = run()
+        return tuple(o._data for o in outs)
+
+    prev = nd_core._capture_scope
+    nd_core._capture_scope = scope
+    saved = [(s._buf, s._version) for s in shells]
+    try:
+        jax.eval_shape(dry, *[jax.ShapeDtypeStruct(s.shape, s.dtype)
+                              for s in shells])
+    finally:
+        nd_core._capture_scope = prev
+        for s, (buf, ver) in zip(shells, saved):
+            s._buf = buf
+            s._version = ver
+    return scope.captured
+
+
+def _dispatch(fn, explicit: Sequence[NDArray], captured: Sequence[NDArray],
+              ctx):
+    """Run `fn(*vals)` (pure) with autograd-tape integration."""
+    import jax
+    from .. import autograd
+    from .. import engine
+
+    arrays = [x._data for x in explicit] + [c._data for c in captured]
+    if autograd.is_recording():
+        outs_data, raw_vjp = jax.vjp(fn, *arrays)
+
+        def vjp_fn(cots, _fn=raw_vjp):
+            # fn always returns a tuple; the tape passes a bare cotangent
+            # for single-output nodes
+            return _fn(cots if isinstance(cots, tuple) else (cots,))
+
+        node = autograd._Node(vjp_fn, list(explicit) + list(captured), 0,
+                              [o.aval for o in outs_data])
+        outs = []
+        for i, d in enumerate(outs_data):
+            o = NDArray(d, ctx=ctx)
+            o._ag_node = node
+            o._ag_out_idx = i
+            outs.append(o)
+        node.outputs = list(outs)
+        return outs
+    outs_data = fn(*arrays)
+    for d in outs_data:
+        engine.track(d)
+    return [NDArray(d, ctx=ctx) for d in outs_data]
+
+
+def _swap(captured, vals):
+    saved = [(c._buf, c._version) for c in captured]
+    for c, v in zip(captured, vals):
+        c._buf = v
+        c._version += 1  # invalidate any view's cached slice
+    return saved
+
+
+def _restore(captured, saved):
+    for c, (buf, ver) in zip(captured, saved):
+        c._buf = buf
+        c._version = ver
+
+
+def foreach(body, data, init_states):
+    """Scan `body` over axis 0 of `data` (parity: mx.nd.contrib.foreach).
+
+    ``body(data_slice, states) -> (outputs, new_states)``.  Lowered to one
+    ``lax.scan``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    data_is_list = isinstance(data, (list, tuple))
+    data_list = list(data) if data_is_list else [data]
+    states_is_list = isinstance(init_states, (list, tuple))
+    states = list(init_states) if states_is_list else [init_states]
+    ctx = data_list[0].context
+    length = data_list[0].shape[0]
+    if length == 0:
+        raise MXNetError("foreach: zero-length data")
+
+    # shells the dry trace and the scan body will rebind per step
+    x_shells = [NDArray(d._data[0], ctx=ctx) for d in data_list]
+    s_shells = [NDArray(s._data, ctx=ctx) for s in states]
+
+    out_struct = {}
+
+    def run_body():
+        x_in = x_shells if data_is_list else x_shells[0]
+        s_in = list(s_shells) if states_is_list else s_shells[0]
+        outs, new_states = body(x_in, s_in)
+        outs_l = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        ns_l = list(new_states) if isinstance(new_states, (list, tuple)) \
+            else [new_states]
+        out_struct["n_out"] = len(outs_l)
+        out_struct["out_is_list"] = isinstance(outs, (list, tuple))
+        return outs_l + ns_l
+
+    captured = _detect_captures(run_body, x_shells + s_shells)
+    n_data, n_states = len(data_list), len(states)
+
+    def fn(*vals):
+        dvals = vals[:n_data]
+        svals = vals[n_data:n_data + n_states]
+        cvals = vals[n_data + n_states:]
+        saved = _swap(captured, cvals)
+        try:
+            def scan_body(carry, xs):
+                for sh, v in zip(x_shells, xs):
+                    sh._buf = v
+                    sh._version += 1
+                for sh, v in zip(s_shells, carry):
+                    sh._buf = v
+                    sh._version += 1
+                res = run_body()
+                outs = [r._data for r in res[:out_struct["n_out"]]]
+                new_carry = tuple(r._data
+                                  for r in res[out_struct["n_out"]:])
+                return new_carry, tuple(outs)
+
+            final_carry, ys = lax.scan(scan_body, tuple(svals),
+                                       tuple(dvals))
+        finally:
+            _restore(captured, saved)
+        return tuple(ys) + tuple(final_carry)
+
+    res = _dispatch(fn, data_list + states, captured, ctx)
+    n_out = out_struct["n_out"]
+    outs, final_states = res[:n_out], res[n_out:]
+    outs = outs if out_struct["out_is_list"] else outs[0]
+    final_states = list(final_states) if states_is_list else final_states[0]
+    return outs, final_states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Parity: mx.nd.contrib.while_loop.
+
+    ``cond(*loop_vars) -> scalar``; ``func(*loop_vars) -> (step_output,
+    new_loop_vars)``.  Returns ``(outputs, final_loop_vars)`` where outputs
+    are stacked over ``max_iterations`` steps (rows past the loop's actual
+    length hold the last computed values' padding, zeros — matching the
+    reference's "gaps filled with zeros" contract).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if max_iterations is None:
+        raise MXNetError("while_loop: max_iterations is required")
+    lv_is_list = isinstance(loop_vars, (list, tuple))
+    lvs = list(loop_vars) if lv_is_list else [loop_vars]
+    ctx = lvs[0].context
+
+    v_shells = [NDArray(v._data, ctx=ctx) for v in lvs]
+    out_struct = {}
+
+    def run_body():
+        res = func(*v_shells)
+        if not (isinstance(res, tuple) and len(res) == 2):
+            raise MXNetError("while_loop: func must return "
+                             "(step_output, new_loop_vars)")
+        step_out, new_vars = res
+        so_l = [] if step_out is None else (
+            list(step_out) if isinstance(step_out, (list, tuple))
+            else [step_out])
+        nv_l = list(new_vars) if isinstance(new_vars, (list, tuple)) \
+            else [new_vars]
+        out_struct["n_out"] = len(so_l)
+        out_struct["out_is_list"] = isinstance(step_out, (list, tuple))
+        return so_l + nv_l
+
+    def run_cond():
+        return [cond(*v_shells)]
+
+    captured = _detect_captures(run_body, v_shells)
+    cap_cond = _detect_captures(run_cond, v_shells)
+    for c in cap_cond:
+        if all(c is not k for k in captured):
+            captured.append(c)
+    n_vars = len(lvs)
+
+    def fn(*vals):
+        vvals = vals[:n_vars]
+        cvals = vals[n_vars:]
+        saved = _swap(captured, cvals)
+        try:
+            def scan_body(carry, _):
+                active, vs = carry
+                for sh, v in zip(v_shells, vs):
+                    sh._buf = v
+                    sh._version += 1
+                c = cond(*v_shells)._data.reshape(()) != 0
+                act = jnp.logical_and(active, c)
+                res = run_body()
+                n_out = out_struct["n_out"]
+                outs = tuple(
+                    jnp.where(act, r._data,
+                              jnp.zeros_like(r._data))
+                    for r in res[:n_out])
+                new_vs = tuple(
+                    jnp.where(act, r._data, v)
+                    for r, v in zip(res[n_out:], vs))
+                return (act, new_vs), outs
+
+            init = (jnp.asarray(True), tuple(vvals))
+            (active, final_vs), ys = lax.scan(
+                scan_body, init, None, length=max_iterations)
+        finally:
+            _restore(captured, saved)
+        return tuple(ys) + tuple(final_vs)
+
+    res = _dispatch(fn, lvs, captured, ctx)
+    n_out = out_struct["n_out"]
+    outs, final_vars = res[:n_out], res[n_out:]
+    outs = list(outs) if out_struct["out_is_list"] else \
+        (outs[0] if outs else [])
+    final_vars = list(final_vars) if lv_is_list else final_vars[0]
+    return outs, final_vars
+
+
+def cond(pred, then_func, else_func):
+    """Parity: mx.nd.contrib.cond — ``pred`` scalar NDArray (or callable
+    returning one); branch closures take no arguments."""
+    from jax import lax
+
+    if callable(pred):
+        pred_nd = pred()
+    else:
+        pred_nd = pred
+    if not isinstance(pred_nd, NDArray):
+        raise MXNetError("cond: pred must be (a callable returning) an "
+                         "NDArray scalar")
+    ctx = pred_nd.context
+
+    out_struct = {}
+
+    def run_then():
+        r = then_func()
+        l = list(r) if isinstance(r, (list, tuple)) else [r]
+        out_struct["n_out"] = len(l)
+        out_struct["out_is_list"] = isinstance(r, (list, tuple))
+        return l
+
+    def run_else():
+        r = else_func()
+        return list(r) if isinstance(r, (list, tuple)) else [r]
+
+    cap_then = _detect_captures(run_then, [])
+    cap_else = _detect_captures(run_else, [])
+    captured = list(cap_then)
+    for c in cap_else:
+        if all(c is not k for k in captured):
+            captured.append(c)
+
+    def fn(pred_val, *cvals):
+        saved = _swap(captured, cvals)
+        try:
+            def t_branch(_):
+                return tuple(r._data for r in run_then())
+
+            def e_branch(_):
+                return tuple(r._data for r in run_else())
+
+            outs = lax.cond(pred_val.reshape(()) != 0, t_branch, e_branch,
+                            operand=None)
+        finally:
+            _restore(captured, saved)
+        return outs
+
+    res = _dispatch(fn, [pred_nd], captured, ctx)
+    return res if out_struct["out_is_list"] else res[0]

@@ -523,6 +523,11 @@ def _coerce(x, like: NDArray) -> NDArray:
     return array(np.asarray(x), ctx=like._ctx)
 
 
+# active closure-capture scope (contrib control-flow ops detect which
+# external NDArrays a body closure touches — see ndarray/contrib.py)
+_capture_scope = None
+
+
 def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
            ctx: Optional[Context] = None, **kwargs):
     """Execute op imperatively: the hot path (SURVEY.md §3.1).
@@ -531,6 +536,9 @@ def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
     returned immediately; sync happens at wait_to_read/asnumpy.
     """
     from .. import autograd
+
+    if _capture_scope is not None:
+        _capture_scope.observe(inputs)
 
     if inputs:
         ctx = inputs[0]._ctx
@@ -600,12 +608,16 @@ def _wrap_outputs(op: OpDef, outputs_data, ctx, node):
             outs.append(o)
         if node is not None:
             node.outputs = [o for o in outs]
+        if _capture_scope is not None:
+            _capture_scope.mark_internal(outs)
         return outs
     o = NDArray(outputs_data, ctx=ctx)
     if node is not None:
         o._ag_node = node
         o._ag_out_idx = 0
         node.outputs = [o]
+    if _capture_scope is not None:
+        _capture_scope.mark_internal([o])
     return o
 
 

@@ -9,3 +9,4 @@ from . import tensor  # noqa: F401  (registers tensor ops)
 from . import nn      # noqa: F401  (registers NN ops)
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import attention  # noqa: F401  (fused SDPA + contrib transformer)

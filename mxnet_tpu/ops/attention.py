@@ -1,0 +1,92 @@
+"""Attention operators.
+
+Capability parity: reference ``src/operator/contrib/transformer*`` —
+interleaved-matmul self-attention helpers used by GluonNLP-era BERT
+(SURVEY.md §2.2 "Sequence/attention-adjacent ops", §5 "Long-context").
+TPU-native design: ONE fused scaled-dot-product-attention op instead of
+the reference's four interleaved-matmul micro-ops — XLA fuses the
+softmax(QKᵀ)V chain onto the MXU; on TPU a Pallas flash-attention kernel
+(ops/flash_attention.py) handles long sequences without materializing the
+S×S score matrix.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _sdpa_xla(q, k, v, mask, scale, causal):
+    """Reference XLA path: (B, S, H, D) layout."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(cm[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+
+
+@register("dot_product_attention", num_inputs=None)
+def dot_product_attention(query, key, value, *rest, num_heads=1,
+                          scale=None, causal=False, use_mask=False,
+                          flash=True):
+    """Fused multi-head SDPA.
+
+    Inputs are (batch, seq, num_heads, head_dim); optional boolean mask
+    (batch, 1|num_heads, seq_q, seq_k) as a 4th input when use_mask.
+    Returns (batch, seq, num_heads, head_dim).
+    """
+    mask = rest[0] if use_mask and rest else None
+    d = query.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    if flash and _flash_viable(query, key):
+        from .flash_attention import flash_attention
+        return flash_attention(query, key, value, mask=mask, scale=s,
+                               causal=causal)
+    return _sdpa_xla(query, key, value, mask, s, causal)
+
+
+def _flash_viable(q, k):
+    """Pallas kernel needs TPU + tile-aligned head_dim/seq."""
+    if os.environ.get("MXTPU_DISABLE_FLASH"):
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    d = q.shape[-1]
+    return d % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+
+
+@register("interleaved_matmul_selfatt_qk", num_inputs=1)
+def interleaved_matmul_selfatt_qk(queries_keys_values, *, heads=1):
+    """Reference contrib op (transformer.cc): input (S, B, 3*E) packed
+    QKV interleaved per head; returns (B*heads, S, S) scores."""
+    s, b, e3 = queries_keys_values.shape
+    e = e3 // 3
+    qkv = queries_keys_values.reshape(s, b, heads, 3, e // heads)
+    q = qkv[:, :, :, 0]
+    k = qkv[:, :, :, 1]
+    scores = jnp.einsum("sbhd,tbhd->bhst", q, k)
+    scale = 1.0 / np.sqrt(e // heads)
+    return (scores * scale).reshape(b * heads, s, s)
+
+
+@register("interleaved_matmul_selfatt_valatt", num_inputs=2)
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
+                                      heads=1):
+    s, b, e3 = queries_keys_values.shape
+    e = e3 // 3
+    qkv = queries_keys_values.reshape(s, b, heads, 3, e // heads)
+    v = qkv[:, :, :, 2]
+    att = attention.reshape(b, heads, s, s)
+    out = jnp.einsum("bhst,tbhd->sbhd", att, v)
+    return out.reshape(s, b, e)

@@ -1,0 +1,161 @@
+"""Pallas flash-attention kernel for TPU.
+
+Capability parity / perf: the reference leans on cuDNN fused attention
+(contrib transformer ops); the TPU equivalent is a Pallas kernel that
+streams K/V blocks through VMEM with an online-softmax accumulator, never
+materializing the (S,S) score matrix in HBM (SURVEY.md §5 "Long-context",
+pallas_guide.md tiling/grid sections).
+
+Forward is the Pallas kernel; backward recomputes attention with the XLA
+path under ``jax.custom_vjp`` (flash-bwd kernel is a later milestone —
+recompute costs one extra forward but keeps memory O(S) instead of O(S²)
+on the forward pass, which is where long-context runs die).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flash_attention"]
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale, causal, num_k_blocks):
+    """One (batch*head, q-block, k-block) grid step.
+
+    The k-block loop lives in the GRID (innermost dim, sequential on TPU)
+    with the online-softmax state in VMEM scratch persisting across
+    steps — the canonical Pallas flash layout, and it keeps every index
+    static (dynamic in-kernel slices mis-lower under jax_enable_x64).
+    """
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q = q_ref[...]  # (block_q, d)
+    k = k_ref[...]  # (block_k, d)
+    v = v_ref[...]
+    block_q, d = q.shape
+    block_k = k.shape[0]
+
+    s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, -1e30)
+
+    # m/l scratch is (block_q, 128): TPU vector stores need a full lane
+    # dim; value is replicated across lanes, column 0 is authoritative
+    m = m_scr[...][:, :1]
+    l = l_scr[...][:, :1]
+    acc = acc_scr[...]
+    m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    lanes = m_scr.shape[1]
+    m_scr[...] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
+    l_new = alpha * l + p.sum(axis=1, keepdims=True)
+    l_scr[...] = jnp.broadcast_to(l_new, (l_new.shape[0], lanes))
+    acc_scr[...] = alpha * acc + jnp.dot(
+        p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _done():
+        o_ref[...] = (acc_scr[...] / l_scr[...][:, :1]).astype(
+            o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal):
+    """q,k,v: (B, S, H, D) → out (B, S, H, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    # fold batch×head, make seq-major: (B*H, S, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+
+    num_k_blocks = s_k // _BLOCK_K
+    grid = (b * h, s_q // _BLOCK_Q, num_k_blocks)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               num_k_blocks=num_k_blocks)
+    # NOTE on index maps: with jax_enable_x64 a literal `0` in an index
+    # map becomes i64 and Mosaic rejects the mixed (i32, i64) signature;
+    # `i - i` keeps everything i32 regardless of the x64 flag.
+    zero = lambda i: i - i
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, _BLOCK_Q, d),
+                         lambda i, j, kb: (i, j, zero(i))),
+            pl.BlockSpec((None, _BLOCK_K, d),
+                         lambda i, j, kb: (i, kb, zero(i))),
+            pl.BlockSpec((None, _BLOCK_K, d),
+                         lambda i, j, kb: (i, kb, zero(i))),
+        ],
+        out_specs=pl.BlockSpec((None, _BLOCK_Q, d),
+                               lambda i, j, kb: (i, j, zero(i))),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
+        ],
+    )(qf, kf, vf)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, mask, scale, causal):
+    return _flash_fwd_pallas(q, k, v, scale, causal)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal):
+    return _flash_fwd_pallas(q, k, v, scale, causal), (q, k, v, mask)
+
+
+def _flash_bwd(scale, causal, res, g):
+    # recompute with the XLA path; its vjp gives exact gradients
+    q, k, v, mask = res
+    from .attention import _sdpa_xla
+
+    def f(q, k, v):
+        return _sdpa_xla(q, k, v, mask, scale, causal)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, scale=None, causal=False):
+    """Flash attention; (B, S, H, D) in/out.  Mask is handled by the XLA
+    fallback path (masked flash lands with the long-context milestone) —
+    callers pass mask=None on the flash path."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if mask is not None:
+        from .attention import _sdpa_xla
+        return _sdpa_xla(q, k, v, mask, scale, causal)
+    return _flash(q, k, v, None, float(scale), bool(causal))

@@ -46,7 +46,8 @@ def activation(data, *, act_type="relu"):
     fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
            "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
            "softsign": jax.nn.soft_sign, "log_sigmoid": jax.nn.log_sigmoid,
-           "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x))}
+           "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+           "relu6": lambda x: jnp.clip(x, 0.0, 6.0)}
     return fns[act_type](data)
 
 
@@ -289,7 +290,7 @@ def pooling(data, *, kernel=(), pool_type="max", global_pool=False,
         if pool_type == "sum":
             return s
         if count_include_pad:
-            return s / float(jnp.prod(jnp.asarray(k)))
+            return s / float(np.prod(k))
         ones = jnp.ones_like(data)
         cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / cnt

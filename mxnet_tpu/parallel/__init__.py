@@ -24,6 +24,8 @@ Public surface:
 from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
 from .trainer import DataParallelTrainer
+from .ring_attention import ring_attention, ring_attention_sharded
 
 __all__ = ["make_mesh", "set_mesh", "current_mesh", "mesh_shape",
-           "collectives", "DataParallelTrainer"]
+           "collectives", "DataParallelTrainer", "ring_attention",
+           "ring_attention_sharded"]

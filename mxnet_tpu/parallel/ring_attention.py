@@ -1,0 +1,124 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Capability: long-context scaling the reference never had (SURVEY.md §5
+"Long-context / sequence parallelism" — listed as a required first-class
+capability of the rebuild).  The sequence axis is sharded over the ``sp``
+mesh axis; each device holds its Q shard permanently and passes K/V
+shards around the ring with ``lax.ppermute`` (XLA lowers to ICI RDMA on a
+TPU torus — the same pattern as pallas_guide.md §18's ring collectives,
+expressed at the collective level so it is differentiable and testable on
+a CPU mesh).  Online-softmax accumulation keeps memory O(S/devices) per
+chip.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..base import MXNetError
+from .mesh import current_mesh
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _ring_attention_local(q, k, v, axis_name, scale, causal_offset=None):
+    """Per-shard body (runs inside shard_map).
+
+    q: (B, Sq_local, H, D); k/v: (B, Sk_local, H, D).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m = jnp.full(q.shape[:2] + (q.shape[2], 1), -jnp.inf, jnp.float32)
+    # running (B, Sq, H, 1) max / sum and (B, Sq, H, D) accumulator
+    l = jnp.zeros_like(m)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # K/V block currently held came from shard (my - i) mod n
+        src = (my - i) % n
+        s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                       k_cur.astype(jnp.float32)) * scale
+        if causal_offset is not None:
+            sq, sk = q.shape[1], k_cur.shape[1]
+            q_pos = my * sq + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, sk), 0)
+            k_pos = src * sk + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, sk), 1)
+            s = jnp.where((q_pos >= k_pos)[None, :, None, :], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        # rotate K/V to the next device; overlapped with next-step compute
+        # by XLA's async collectives
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    _, _, m, l, acc = _unrolled(step, n, (k, v, m, l, acc))
+    return (acc / l).astype(q.dtype)
+
+
+def _unrolled(step, n, carry):
+    # static unroll: n is the mesh-axis size (small); lets XLA overlap
+    # each step's ppermute with the previous step's einsum
+    for i in range(n):
+        carry = step(i, carry)
+    return carry
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", scale=None,
+                   causal=False):
+    """SPMD ring attention over sequence-sharded jax arrays.
+
+    q/k/v: (B, S_global, H, D) jax arrays (sharded or to-be-sharded along
+    the sequence dim over ``axis``).  Returns (B, S_global, H, D) with the
+    same sharding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise MXNetError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis!r} size {n}")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=axis,
+                scale=float(scale),
+                causal_offset=True if causal else None),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
+    return jax.jit(fn)(q, k, v)
+
+
+def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
+                           scale=None, causal=False):
+    """NDArray wrapper around :func:`ring_attention`."""
+    from ..ndarray.ndarray import NDArray
+    out = ring_attention(q_nd._data, k_nd._data, v_nd._data, mesh=mesh,
+                         axis=axis, scale=scale, causal=causal)
+    return NDArray(out, ctx=q_nd.context)

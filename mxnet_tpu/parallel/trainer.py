@@ -275,7 +275,15 @@ class DataParallelTrainer:
                 new_states.append(new_st if new_st else st)
             return tuple(new_params), tuple(new_states)
 
-        self._fused_update = jax.jit(update_all, donate_argnums=(0, 1))
+        # pin output shardings to the input param/state layouts so a
+        # TP-sharded forward can't silently re-shard weights between steps
+        param_shardings = tuple(
+            self._params[i].data()._data.sharding for i in self._tr_idx)
+        state_shardings = tuple(
+            tuple(v.sharding for v in vals) for vals in self._state_vals())
+        self._fused_update = jax.jit(
+            update_all, donate_argnums=(0, 1),
+            out_shardings=(param_shardings, state_shardings))
 
     def _state_vals(self):
         out = []

@@ -1,0 +1,192 @@
+"""Profiler (parity: ``python/mxnet/profiler.py`` over
+``src/profiler/profiler.cc`` — SURVEY.md §5 "Tracing / profiling").
+
+Two layers, mirroring the reference's engine-wired profiler:
+
+* **Op events** — the engine's dispatch path is intercepted
+  (``engine._profiler_hook``) while the profiler runs; each op records a
+  host-side span (dispatch → ready when ``MXTPU_PROFILE_SYNC=1``, else
+  async dispatch span).  ``dump()`` writes chrome://tracing JSON,
+  ``dumps()`` an aggregate table — the same artifacts the reference
+  produced.
+* **Device traces** — ``profile_device=True`` brackets the run with
+  ``jax.profiler`` (XPlane/TensorBoard), the TPU-native replacement for
+  the reference's device timelines.
+
+Custom scopes: ``Marker``, ``record_scope`` map to instant events /
+ranges, and also forward to ``jax.profiler.TraceAnnotation`` so they show
+up inside XPlane traces.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import List, Optional
+
+from .base import MXNetError
+from . import engine
+
+__all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
+           "dumps", "Marker", "record_scope"]
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_state = "stop"
+_paused = False
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "profile_device": False,
+    "aggregate_stats": False,
+    "device_logdir": "/tmp/mxtpu_xplane",
+}
+_device_trace_active = False
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def set_config(**kwargs):
+    """Configure (parity: profiler.set_config)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError(f"unknown profiler config keys {sorted(unknown)}")
+    _config.update(kwargs)
+
+
+def _hook(name, fn, arrays):
+    start = _now_us()
+    out = fn(*arrays)
+    if os.environ.get("MXTPU_PROFILE_SYNC"):
+        import jax
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass  # non-array outputs (vjp closures) can't be awaited
+    end = _now_us()
+    if not _paused:
+        with _lock:
+            _events.append({"name": name, "ph": "X", "ts": start,
+                            "dur": end - start, "pid": 0, "tid":
+                            threading.get_ident() % 100000,
+                            "cat": "operator"})
+    return out
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """'run' starts collection; 'stop' ends it (parity:
+    profiler.set_state)."""
+    global _state, _device_trace_active
+    if state_name not in ("run", "stop"):
+        raise MXNetError("state must be 'run' or 'stop'")
+    if state_name == "run" and _state != "run":
+        engine._profiler_hook = _hook
+        if _config["profile_device"]:
+            import jax
+            jax.profiler.start_trace(_config["device_logdir"])
+            _device_trace_active = True
+    elif state_name == "stop" and _state != "stop":
+        engine._profiler_hook = None
+        if _device_trace_active:
+            import jax
+            jax.profiler.stop_trace()
+            _device_trace_active = False
+    _state = state_name
+
+
+def state():
+    return _state
+
+
+def pause(profile_process="worker"):
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to the configured filename."""
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def dumps(reset=False, format_="table"):
+    """Aggregate per-op stats as a text table (parity: profiler.dumps)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    for e in events:
+        a = agg[e["name"]]
+        a[0] += 1
+        a[1] += e["dur"]
+        a[2] = min(a[2], e["dur"])
+        a[3] = max(a[3], e["dur"])
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
+             f"{'Max(us)':>12}{'Avg(us)':>12}"]
+    for name, (n, tot, mn, mx) in sorted(agg.items(),
+                                         key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{n:>8}{tot:>14.1f}{mn:>12.1f}"
+                     f"{mx:>12.1f}{tot / n:>12.1f}")
+    return "\n".join(lines)
+
+
+class Marker:
+    """Custom instant marker (parity: profiler.Marker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _state == "run" and not _paused:
+            with _lock:
+                _events.append({"name": self.name, "ph": "i",
+                                "ts": _now_us(), "pid": 0, "tid": 0,
+                                "s": "p", "cat": "marker"})
+
+
+class record_scope:
+    """``with profiler.record_scope('step'):`` — a named range, also
+    visible in XPlane traces."""
+
+    def __init__(self, name):
+        self.name = name
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._start = _now_us()
+        try:
+            import jax
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        if _state == "run" and not _paused:
+            with _lock:
+                _events.append({"name": self.name, "ph": "X",
+                                "ts": self._start,
+                                "dur": _now_us() - self._start,
+                                "pid": 0, "tid": 0, "cat": "scope"})

@@ -1,0 +1,280 @@
+"""RecordIO: magic-delimited binary record format + indexed variant.
+
+Capability parity: reference ``python/mxnet/recordio.py`` over dmlc-core's
+``recordio.h`` (SURVEY.md §2.4).  The BYTE FORMAT IS COMPATIBLE with the
+reference (same magic 0xced7230a, same u32 length/flag framing, 4-byte
+padding, same IRHeader struct), so ``.rec``/``.idx`` files pack with the
+reference's im2rec are readable here and vice versa.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_LMAX = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (parity: MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.writable = True
+        elif self.flag == "r":
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r}")
+        # native C++ framing core when libmxtpu is built (SURVEY.md §7:
+        # recordio is one of the components owed a native equivalent)
+        self.handle = None
+        self._nat = None
+        from . import _native
+        if _native.available():
+            try:
+                self._nat = _native.NativeRecordIO(self.uri,
+                                                   self.writable)
+            except IOError:
+                self._nat = None
+        if self._nat is None:
+            self.handle = open(self.uri,
+                               "wb" if self.writable else "rb")
+        self.is_open = True
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.is_open:
+            if self._nat is not None:
+                self._nat.close()
+                self._nat = None
+            if self.handle is not None:
+                self.handle.close()
+            self.is_open = False
+            self.pid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["_nat"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if not self.is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # DataLoader forks workers; handles must be reopened per process
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.close()
+                self.open()
+            else:
+                raise MXNetError("RecordIO handle used in a forked "
+                                 "process; call reset() first")
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        if self._nat is not None:
+            return self._nat.tell()
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        if not isinstance(buf, bytes):
+            buf = bytes(buf)
+        if self._nat is not None:
+            self._nat.write(buf)
+            return
+        # multi-chunk framing for records > 2^29 bytes (dmlc recordio.h)
+        nchunk = max(1, (len(buf) + _LMAX - 1) // _LMAX)
+        pos = 0
+        remaining = len(buf)
+        for i in range(nchunk):
+            size = min(remaining, _LMAX)
+            cflag = 0 if nchunk == 1 else (1 if i == 0 else
+                                           (2 if i == nchunk - 1 else 3))
+            lrec = (cflag << _LFLAG_BITS) | size
+            self.handle.write(struct.pack("<II", _MAGIC, lrec))
+            self.handle.write(buf[pos:pos + size])
+            pad = (4 - size % 4) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
+            pos += size
+            remaining -= size
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        if self._nat is not None:
+            return self._nat.read()
+        out = b""
+        while True:
+            hdr = self.handle.read(8)
+            if len(hdr) < 8:
+                return out if out else None
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _MAGIC:
+                raise MXNetError("invalid record: bad magic")
+            cflag = lrec >> _LFLAG_BITS
+            size = lrec & _LMAX
+            data = self.handle.read(size)
+            pad = (4 - size % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            out += data
+            if cflag in (0, 2):  # single chunk or last chunk
+                return out
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record file via a .idx sidecar (parity:
+    MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        if self._nat is not None:
+            self._nat.seek(self.idx[idx])
+        else:
+            self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class IRHeader:
+    """Image-record header (parity: IRHeader namedtuple + struct IRHeader).
+
+    flag, label (float or vector), id, id2 — struct layout ``IfQQ``.
+    """
+
+    _FMT = "IfQQ"
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+    def __eq__(self, other):
+        return tuple(self) == tuple(other)
+
+    def __repr__(self):
+        return (f"IRHeader(flag={self.flag}, label={self.label}, "
+                f"id={self.id}, id2={self.id2})")
+
+
+def pack(header, s: bytes) -> bytes:
+    """Pack header + raw bytes (parity: recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        hdr = struct.pack(IRHeader._FMT, 0, float(header.label),
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        hdr = struct.pack(IRHeader._FMT, label.size, 0.0, header.id,
+                          header.id2) + label.tobytes()
+    return hdr + s
+
+
+def unpack(s: bytes):
+    """Unpack into (IRHeader, payload bytes)."""
+    hsize = struct.calcsize(IRHeader._FMT)
+    flag, label, id_, id2 = struct.unpack(IRHeader._FMT, s[:hsize])
+    s = s[hsize:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Pack header + encoded image (parity: recordio.pack_img)."""
+    import cv2
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise MXNetError(f"failed to encode image as {img_fmt}")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack into (IRHeader, decoded BGR ndarray)."""
+    import cv2
+    header, payload = unpack(s)
+    img = cv2.imdecode(np.frombuffer(payload, dtype=np.uint8), iscolor)
+    return header, img

@@ -47,8 +47,7 @@ def _make_wrapper(opname: str, op: OpDef):
                 raise TypeError(f"{opname}: got multiple values for "
                                 f"{aname}")
             kwargs[aname] = val
-        return _invoke(opname, inputs, kwargs, name=name,
-                       aux_positions=_AUX_INPUTS.get(opname))
+        return _invoke(opname, inputs, kwargs, name=name)
 
     fn.__name__ = opname
     fn.__qualname__ = opname
@@ -85,8 +84,7 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
         {"eps": eps, "momentum": momentum, "fix_gamma": fix_gamma,
          "use_global_stats": use_global_stats,
          "output_mean_var": output_mean_var, "axis": axis},
-        name=name, aux_positions=(3, 4),
-        num_outputs=3 if output_mean_var else 1)
+        name=name, num_outputs=3 if output_mean_var else 1)
 
 
 def maximum(lhs, rhs, name=None):

@@ -57,7 +57,7 @@ _AUX_INPUTS = {"BatchNorm": (3, 4)}
 class _Node:
     """One graph node: an op application or a variable."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "is_aux",
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs",
                  "_user_attrs")
 
     def __init__(self, op: Optional[str], name: str, attrs: dict,
@@ -67,8 +67,27 @@ class _Node:
         self.attrs = attrs
         self.inputs = inputs
         self.num_outputs = num_outputs
-        self.is_aux = False
         self._user_attrs = {}
+
+
+def _aux_ids(heads: Sequence[_Node]):
+    """Ids of variable nodes consumed in auxiliary-state positions.
+
+    Aux-ness is a property of THIS graph's consuming edges — never a
+    mutation of the (possibly shared) variable node, so using the same
+    var in another graph keeps it an ordinary argument there.
+    """
+    out = set()
+    for node in _topo(heads):
+        positions = _AUX_INPUTS.get(node.op)
+        if not positions:
+            continue
+        for pos in positions:
+            if pos < len(node.inputs):
+                inp = node.inputs[pos][0]
+                if inp.op is None:
+                    out.add(id(inp))
+    return out
 
 
 def _topo(heads: Sequence[_Node]) -> List[_Node]:
@@ -143,8 +162,10 @@ class Symbol:
         return [n for n, _ in self._outputs]
 
     def list_arguments(self) -> List[str]:
-        return [n.name for n in _topo(self._head_nodes())
-                if n.op is None and not n.is_aux]
+        heads = self._head_nodes()
+        aux = _aux_ids(heads)
+        return [n.name for n in _topo(heads)
+                if n.op is None and id(n) not in aux]
 
     def list_outputs(self) -> List[str]:
         names = []
@@ -158,8 +179,10 @@ class Symbol:
         return names
 
     def list_auxiliary_states(self) -> List[str]:
-        return [n.name for n in _topo(self._head_nodes())
-                if n.op is None and n.is_aux]
+        heads = self._head_nodes()
+        aux = _aux_ids(heads)
+        return [n.name for n in _topo(heads)
+                if n.op is None and id(n) in aux]
 
     def list_inputs(self) -> List[str]:
         return [n.name for n in _topo(self._head_nodes()) if n.op is None]
@@ -215,7 +238,6 @@ class Symbol:
                 return node, -1
             nn = _Node(node.op, node.name, dict(node.attrs), new_inputs,
                        node.num_outputs)
-            nn.is_aux = node.is_aux
             nn._user_attrs = dict(node._user_attrs)
             memo[id(nn)] = nn
             memo[id(node)] = nn
@@ -339,7 +361,6 @@ class Symbol:
                 "name": n.name,
                 "attrs": {k: repr(v) for k, v in n.attrs.items()},
                 "inputs": [[idx[id(i)], oi, 0] for i, oi in n.inputs],
-                "is_aux": n.is_aux,
                 "num_outputs": n.num_outputs,
                 "user_attrs": {k: repr(v)
                                for k, v in n._user_attrs.items()},
@@ -746,23 +767,25 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None):
-        if out_grads is None:
-            if self._cached_grads is not None:
-                self._write_grads(self._cached_grads)
-                self._cached_grads = None
-                return
-            if self._saved_inputs is None:
-                raise MXNetError(
-                    "backward called before forward(is_train=True)")
+        if out_grads is None and self._cached_grads is not None:
+            self._write_grads(self._cached_grads)
+            self._cached_grads = None
+            return
         if self._saved_inputs is None:
-            raise MXNetError("backward called before forward(is_train=True)")
-        # explicit head gradients: re-run the fused program with them
+            raise MXNetError(
+                "backward called before forward(is_train=True)")
+        # re-run the fused program (explicit cotangents, or default ones
+        # when the cached grads were already consumed)
         fn, _ = self._get_compiled(True, with_grad=True)
         avals, xvals, keyraw = self._saved_inputs
-        if isinstance(out_grads, NDArray):
-            out_grads = [out_grads]
-        cots = tuple(g._data for g in out_grads)
+        if out_grads is None:
+            cots = None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data for g in out_grads)
         outs, new_aux, grads = fn(avals, xvals, keyraw, cots)
+        self._cached_grads = None
         self._write_grads(grads)
         return
 
@@ -864,8 +887,7 @@ def Group(symbols) -> Symbol:
     return Symbol(outs)
 
 
-def _invoke(opname, sym_inputs, attrs, name=None, aux_positions=None,
-            num_outputs=None):
+def _invoke(opname, sym_inputs, attrs, name=None, num_outputs=None):
     """Create an op node (shared by generated sym.* wrappers)."""
     nodes = []
     for s in sym_inputs:
@@ -883,8 +905,6 @@ def _invoke(opname, sym_inputs, attrs, name=None, aux_positions=None,
             num_outputs = 1
     name = name or _NAMES.get(opname.lstrip("_"))
     node = _Node(opname, name, dict(attrs), nodes, num_outputs)
-    for pos in (aux_positions or ()):
-        nodes[pos][0].is_aux = True
     return Symbol([(node, i) for i in range(num_outputs)]) \
         if num_outputs > 1 else Symbol([(node, 0)])
 
@@ -909,7 +929,6 @@ def load_json(json_str: str) -> Symbol:
         node = _Node(None if op == "null" else op, jn["name"], attrs,
                      [(nodes[i], oi) for i, oi, _ in jn["inputs"]],
                      jn.get("num_outputs", 1))
-        node.is_aux = jn.get("is_aux", False)
         for k, v in jn.get("user_attrs", {}).items():
             try:
                 node._user_attrs[k] = ast.literal_eval(v)

@@ -1,0 +1,36 @@
+// Flat C API surface: error handling + library info.
+//
+// Capability parity: reference src/c_api/c_api.cc (SURVEY.md §2.1
+// "C API"): a flat C ABI with a per-thread last-error ring
+// (MXGetLastError) so every binding — Python today, others later —
+// talks to one stable surface.  The per-subsystem entry points live in
+// engine.cc / storage.cc / recordio.cc; this file holds the shared
+// error plumbing and version/feature queries.
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+extern "C" {
+
+const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+
+void MXTPUSetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+}
+
+int MXTPUGetVersion() { return 100; }  // 0.1.0
+
+// feature bits for the native layer (Python-side features live in
+// mxnet_tpu.runtime)
+int MXTPUHasFeature(const char* name) {
+  if (std::strcmp(name, "ENGINE") == 0) return 1;
+  if (std::strcmp(name, "STORAGE_POOL") == 0) return 1;
+  if (std::strcmp(name, "RECORDIO") == 0) return 1;
+  return 0;
+}
+
+}  // extern "C"

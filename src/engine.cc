@@ -1,0 +1,254 @@
+// Threaded var-based dependency engine.
+//
+// Capability parity: reference src/engine/threaded_engine.{h,cc} +
+// threaded_engine_perdevice.cc (SURVEY.md §2.1 "Dependency engine"):
+// operations are pushed with read/write variable sets; an op becomes
+// runnable when every variable it touches reaches it in queue order
+// (many concurrent readers XOR one writer per var); a worker pool
+// executes runnable ops; WaitForVar/WaitForAll synchronize.
+//
+// TPU-native role: XLA/PJRT already order device-side work per buffer,
+// so this engine schedules HOST-side work — data-pipeline stages
+// (decode/augment), checkpoint IO, callback fan-out — with the same
+// observable semantics the reference's engine gave (test:
+// tests/cpp_native test via ctypes mirrors threaded_engine_test.cc's
+// ordering + stress cases).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+using OpFn = std::function<void()>;
+
+// One scheduling entry on a variable's FIFO: an op waiting to acquire
+// this var for read or write.
+struct VarBlock {
+  uint64_t op_id;
+  bool write;
+};
+
+struct Var {
+  std::deque<VarBlock> queue;   // pending acquisitions, FIFO
+  int active_readers = 0;
+  bool active_writer = false;
+  uint64_t version = 0;         // bumped on every completed write
+};
+
+struct Op {
+  OpFn fn;
+  std::vector<uint64_t> read_vars;
+  std::vector<uint64_t> write_vars;
+  std::atomic<int> missing{0};  // vars not yet granted
+};
+
+class ThreadedEngine {
+ public:
+  explicit ThreadedEngine(int num_workers) : stop_(false), pending_(0) {
+    if (num_workers <= 0) num_workers = 4;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~ThreadedEngine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  uint64_t NewVariable() {
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t id = next_var_id_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  uint64_t Push(OpFn fn, const std::vector<uint64_t>& reads,
+                const std::vector<uint64_t>& writes) {
+    auto op = std::make_shared<Op>();
+    op->fn = std::move(fn);
+    op->read_vars = reads;
+    op->write_vars = writes;
+
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t id = next_op_id_++;
+    ops_[id] = op;
+    pending_.fetch_add(1);
+    int missing = 0;
+    for (uint64_t v : reads) {
+      vars_[v].queue.push_back({id, false});
+      ++missing;
+    }
+    for (uint64_t v : writes) {
+      vars_[v].queue.push_back({id, true});
+      ++missing;
+    }
+    op->missing.store(missing);
+    if (missing == 0) {
+      ready_.push(id);
+      ready_cv_.notify_one();
+    } else {
+      for (uint64_t v : reads) TryGrant(v);
+      for (uint64_t v : writes) TryGrant(v);
+    }
+    return id;
+  }
+
+  void WaitForVar(uint64_t var) {
+    // push a no-op writer on the var and wait for it — exactly the
+    // reference's WaitForVar implementation strategy
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    Push([&] {
+      std::unique_lock<std::mutex> lk(m);
+      done = true;
+      cv.notify_all();
+    }, {var}, {});
+    std::unique_lock<std::mutex> lk(m);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    idle_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  uint64_t VarVersion(uint64_t var) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = vars_.find(var);
+    return it == vars_.end() ? 0 : it->second.version;
+  }
+
+ private:
+  // grant the head of var's queue if compatible; called with mu_ held
+  void TryGrant(uint64_t vid) {
+    auto& var = vars_[vid];
+    while (!var.queue.empty()) {
+      VarBlock& head = var.queue.front();
+      if (head.write) {
+        if (var.active_readers > 0 || var.active_writer) break;
+        var.active_writer = true;
+      } else {
+        if (var.active_writer) break;
+        ++var.active_readers;
+      }
+      uint64_t op_id = head.op_id;
+      bool was_write = head.write;
+      var.queue.pop_front();
+      auto it = ops_.find(op_id);
+      if (it != ops_.end()) {
+        if (it->second->missing.fetch_sub(1) == 1) {
+          ready_.push(op_id);
+          ready_cv_.notify_one();
+        }
+      }
+      // a granted writer blocks everything behind it until completion
+      if (was_write) break;
+    }
+  }
+
+  void OnComplete(uint64_t op_id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = ops_.find(op_id);
+    if (it == ops_.end()) return;
+    auto op = it->second;
+    for (uint64_t v : op->read_vars) {
+      auto& var = vars_[v];
+      --var.active_readers;
+      TryGrant(v);
+    }
+    for (uint64_t v : op->write_vars) {
+      auto& var = vars_[v];
+      var.active_writer = false;
+      ++var.version;
+      TryGrant(v);
+    }
+    ops_.erase(it);
+    if (pending_.fetch_sub(1) == 1) idle_cv_.notify_all();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      uint64_t op_id;
+      OpFn fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        ready_cv_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        op_id = ready_.front();
+        ready_.pop();
+        fn = ops_[op_id]->fn;
+      }
+      fn();
+      OnComplete(op_id);
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable ready_cv_;
+  std::condition_variable idle_cv_;
+  std::vector<std::thread> workers_;
+  std::unordered_map<uint64_t, Var> vars_;
+  std::unordered_map<uint64_t, std::shared_ptr<Op>> ops_;
+  std::queue<uint64_t> ready_;
+  uint64_t next_var_id_ = 1;
+  uint64_t next_op_id_ = 1;
+  bool stop_;
+  std::atomic<int> pending_;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// C ABI (consumed by mxnet_tpu/_native.py via ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+typedef void (*MXTPUOpCallback)(void* ctx);
+
+void* MXTPUEngineCreate(int num_workers) {
+  return new mxtpu::ThreadedEngine(num_workers);
+}
+
+void MXTPUEngineFree(void* engine) {
+  delete static_cast<mxtpu::ThreadedEngine*>(engine);
+}
+
+uint64_t MXTPUEngineNewVar(void* engine) {
+  return static_cast<mxtpu::ThreadedEngine*>(engine)->NewVariable();
+}
+
+uint64_t MXTPUEnginePush(void* engine, MXTPUOpCallback cb, void* cb_ctx,
+                         const uint64_t* read_vars, int n_reads,
+                         const uint64_t* write_vars, int n_writes) {
+  std::vector<uint64_t> reads(read_vars, read_vars + n_reads);
+  std::vector<uint64_t> writes(write_vars, write_vars + n_writes);
+  return static_cast<mxtpu::ThreadedEngine*>(engine)->Push(
+      [cb, cb_ctx] { cb(cb_ctx); }, reads, writes);
+}
+
+void MXTPUEngineWaitForVar(void* engine, uint64_t var) {
+  static_cast<mxtpu::ThreadedEngine*>(engine)->WaitForVar(var);
+}
+
+void MXTPUEngineWaitForAll(void* engine) {
+  static_cast<mxtpu::ThreadedEngine*>(engine)->WaitForAll();
+}
+
+uint64_t MXTPUEngineVarVersion(void* engine, uint64_t var) {
+  return static_cast<mxtpu::ThreadedEngine*>(engine)->VarVersion(var);
+}
+
+}  // extern "C"

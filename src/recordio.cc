@@ -1,0 +1,128 @@
+// RecordIO reader/writer core.
+//
+// Capability parity: reference dmlc-core recordio (SURVEY.md §2.4
+// "RecordIO"): magic 0xced7230a framing, 29-bit length + 3-bit
+// continuation flag, 4-byte padding — byte-identical to the Python
+// implementation in mxnet_tpu/recordio.py (which switches to this
+// native core when the library is built, removing Python byte-shuffling
+// from the data-pipeline hot path).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr int kLFlagBits = 29;
+constexpr uint32_t kLMax = (1u << kLFlagBits) - 1;
+
+class RecordIO {
+ public:
+  RecordIO(const char* path, bool writable)
+      : f_(std::fopen(path, writable ? "wb" : "rb")),
+        writable_(writable) {}
+
+  ~RecordIO() {
+    if (f_) std::fclose(f_);
+  }
+
+  bool ok() const { return f_ != nullptr; }
+
+  int64_t Tell() { return f_ ? std::ftell(f_) : -1; }
+
+  bool Seek(int64_t pos) {
+    return f_ && std::fseek(f_, static_cast<long>(pos), SEEK_SET) == 0;
+  }
+
+  bool Write(const uint8_t* data, uint64_t len) {
+    if (!f_ || !writable_) return false;
+    uint64_t nchunk = len == 0 ? 1 : (len + kLMax - 1) / kLMax;
+    uint64_t pos = 0, remaining = len;
+    for (uint64_t i = 0; i < nchunk; ++i) {
+      uint32_t size = static_cast<uint32_t>(
+          remaining < kLMax ? remaining : kLMax);
+      uint32_t cflag = nchunk == 1 ? 0
+                       : (i == 0 ? 1 : (i == nchunk - 1 ? 2 : 3));
+      uint32_t lrec = (cflag << kLFlagBits) | size;
+      if (std::fwrite(&kMagic, 4, 1, f_) != 1) return false;
+      if (std::fwrite(&lrec, 4, 1, f_) != 1) return false;
+      if (size && std::fwrite(data + pos, 1, size, f_) != size)
+        return false;
+      uint32_t pad = (4 - size % 4) % 4;
+      static const char zeros[4] = {0, 0, 0, 0};
+      if (pad && std::fwrite(zeros, 1, pad, f_) != pad) return false;
+      pos += size;
+      remaining -= size;
+    }
+    return true;
+  }
+
+  // reads the next (possibly multi-chunk) record into out; returns
+  // false at EOF or error
+  bool Read(std::string* out) {
+    if (!f_ || writable_) return false;
+    out->clear();
+    for (;;) {
+      uint32_t magic = 0, lrec = 0;
+      if (std::fread(&magic, 4, 1, f_) != 1) return !out->empty();
+      if (std::fread(&lrec, 4, 1, f_) != 1) return false;
+      if (magic != kMagic) return false;
+      uint32_t cflag = lrec >> kLFlagBits;
+      uint32_t size = lrec & kLMax;
+      size_t base = out->size();
+      out->resize(base + size);
+      if (size &&
+          std::fread(&(*out)[base], 1, size, f_) != size)
+        return false;
+      uint32_t pad = (4 - size % 4) % 4;
+      if (pad) std::fseek(f_, pad, SEEK_CUR);
+      if (cflag == 0 || cflag == 2) return true;
+    }
+  }
+
+ private:
+  FILE* f_;
+  bool writable_;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* MXTPURecordIOCreate(const char* path, int writable) {
+  auto* r = new mxtpu::RecordIO(path, writable != 0);
+  if (!r->ok()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void MXTPURecordIOFree(void* r) {
+  delete static_cast<mxtpu::RecordIO*>(r);
+}
+
+int64_t MXTPURecordIOTell(void* r) {
+  return static_cast<mxtpu::RecordIO*>(r)->Tell();
+}
+
+int MXTPURecordIOSeek(void* r, int64_t pos) {
+  return static_cast<mxtpu::RecordIO*>(r)->Seek(pos) ? 0 : -1;
+}
+
+int MXTPURecordIOWrite(void* r, const uint8_t* data, uint64_t len) {
+  return static_cast<mxtpu::RecordIO*>(r)->Write(data, len) ? 0 : -1;
+}
+
+// Reads next record. Returns size >=0 and sets *out to an internal
+// buffer valid until the next call; returns -1 at EOF/error.
+int64_t MXTPURecordIORead(void* r, const uint8_t** out) {
+  thread_local std::string buf;
+  if (!static_cast<mxtpu::RecordIO*>(r)->Read(&buf)) return -1;
+  *out = reinterpret_cast<const uint8_t*>(buf.data());
+  return static_cast<int64_t>(buf.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+// Pooled host storage manager.
+//
+// Capability parity: reference src/storage/storage.cc +
+// pooled_storage_manager.h (SURVEY.md §2.1 "Storage manager"):
+// round-up-to-power-of-two pooling with per-bucket free lists, stats,
+// and an env-style pool toggle.  TPU-native role: device memory belongs
+// to PJRT/XLA; this pool serves HOST staging buffers (data pipeline,
+// recordio scratch, checkpoint IO) where malloc/free churn is the
+// reference's same enemy.
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+class PooledStorage {
+ public:
+  explicit PooledStorage(bool pooled) : pooled_(pooled) {}
+
+  ~PooledStorage() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) std::free(p);
+  }
+
+  void* Alloc(size_t size) {
+    size_t bucket = RoundUp(size);
+    if (pooled_) {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = pool_.find(bucket);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        live_[p] = bucket;
+        pool_bytes_ -= bucket;
+        used_bytes_ += bucket;
+        return p;
+      }
+    }
+    void* p = std::malloc(bucket);
+    if (p == nullptr) return nullptr;
+    std::unique_lock<std::mutex> lk(mu_);
+    live_[p] = bucket;
+    used_bytes_ += bucket;
+    total_allocs_ += 1;
+    return p;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::unique_lock<std::mutex> lk(mu_);
+    auto it = live_.find(p);
+    if (it == live_.end()) return;
+    size_t bucket = it->second;
+    live_.erase(it);
+    used_bytes_ -= bucket;
+    if (pooled_) {
+      pool_[bucket].push_back(p);
+      pool_bytes_ += bucket;
+    } else {
+      std::free(p);
+    }
+  }
+
+  void ReleaseAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) std::free(p);
+    pool_.clear();
+    pool_bytes_ = 0;
+  }
+
+  uint64_t UsedBytes() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return used_bytes_;
+  }
+  uint64_t PoolBytes() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return pool_bytes_;
+  }
+  uint64_t TotalAllocs() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return total_allocs_;
+  }
+
+ private:
+  static size_t RoundUp(size_t size) {
+    size_t b = 64;  // cacheline floor
+    while (b < size) b <<= 1;
+    return b;
+  }
+
+  bool pooled_;
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> pool_;
+  std::unordered_map<void*, size_t> live_;
+  uint64_t used_bytes_ = 0;
+  uint64_t pool_bytes_ = 0;
+  uint64_t total_allocs_ = 0;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* MXTPUStorageCreate(int pooled) {
+  return new mxtpu::PooledStorage(pooled != 0);
+}
+
+void MXTPUStorageFree(void* s) {
+  delete static_cast<mxtpu::PooledStorage*>(s);
+}
+
+void* MXTPUStorageAlloc(void* s, uint64_t size) {
+  return static_cast<mxtpu::PooledStorage*>(s)->Alloc(size);
+}
+
+void MXTPUStorageDealloc(void* s, void* p) {
+  static_cast<mxtpu::PooledStorage*>(s)->Free(p);
+}
+
+void MXTPUStorageReleaseAll(void* s) {
+  static_cast<mxtpu::PooledStorage*>(s)->ReleaseAll();
+}
+
+uint64_t MXTPUStorageUsedBytes(void* s) {
+  return static_cast<mxtpu::PooledStorage*>(s)->UsedBytes();
+}
+
+uint64_t MXTPUStoragePoolBytes(void* s) {
+  return static_cast<mxtpu::PooledStorage*>(s)->PoolBytes();
+}
+
+uint64_t MXTPUStorageTotalAllocs(void* s) {
+  return static_cast<mxtpu::PooledStorage*>(s)->TotalAllocs();
+}
+
+}  // extern "C"

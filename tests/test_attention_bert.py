@@ -1,0 +1,187 @@
+"""Attention ops, transformer blocks, BERT, ring attention (SURVEY.md §5
+long-context capability + BASELINE config #3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon.contrib.nn import (MultiHeadAttention,
+                                        TransformerEncoderCell,
+                                        TransformerEncoder)
+from mxnet_tpu.models import bert_small, BERTForPretrain
+
+
+def _np_sdpa(q, k, v, scale, mask=None, causal=False):
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        cm = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        logits = np.where(cm[None, None], logits, -1e30)
+    if mask is not None:
+        logits = np.where(mask.astype(bool), logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class TestSDPA:
+    def test_forward_vs_numpy(self):
+        rng = np.random.RandomState(0)
+        q = rng.rand(2, 5, 3, 4).astype("f")
+        k = rng.rand(2, 7, 3, 4).astype("f")
+        v = rng.rand(2, 7, 3, 4).astype("f")
+        out = nd.dot_product_attention(nd.array(q), nd.array(k),
+                                       nd.array(v))
+        np.testing.assert_allclose(out.asnumpy(),
+                                   _np_sdpa(q, k, v, 0.5), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_causal(self):
+        rng = np.random.RandomState(1)
+        q = rng.rand(1, 6, 2, 4).astype("f")
+        out = nd.dot_product_attention(nd.array(q), nd.array(q),
+                                       nd.array(q), causal=True)
+        np.testing.assert_allclose(
+            out.asnumpy(), _np_sdpa(q, q, q, 0.5, causal=True),
+            rtol=1e-4, atol=1e-5)
+
+    def test_mask(self):
+        rng = np.random.RandomState(2)
+        q = rng.rand(2, 4, 2, 4).astype("f")
+        mask = (rng.rand(2, 1, 4, 4) > 0.3)
+        mask[..., 0] = True  # keep at least one key
+        out = nd.dot_product_attention(
+            nd.array(q), nd.array(q), nd.array(q),
+            nd.array(mask.astype("f")), use_mask=True)
+        np.testing.assert_allclose(
+            out.asnumpy(), _np_sdpa(q, q, q, 0.5, mask=mask),
+            rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows(self):
+        q = nd.array(np.random.rand(1, 4, 2, 4).astype("f"))
+        q.attach_grad()
+        with mx.autograd.record():
+            out = nd.dot_product_attention(q, q, q)
+            out.sum().backward()
+        assert np.abs(q.grad.asnumpy()).sum() > 0
+
+
+class TestTransformerBlocks:
+    def test_mha_shapes_and_hybridize(self):
+        np.random.seed(0)
+        mha = MultiHeadAttention(16, 4)
+        mha.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(2, 6, 16).astype("f"))
+        y1 = mha(x, None, None, None)
+        assert y1.shape == (2, 6, 16)
+        mha.hybridize()
+        y2 = mha(x, None, None, None)
+        np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_encoder_stack(self):
+        enc = TransformerEncoder(units=16, hidden_size=32, num_layers=2,
+                                 num_heads=4)
+        enc.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(2, 5, 16).astype("f"))
+        y = enc(x, None)
+        assert y.shape == (2, 5, 16)
+        assert np.isfinite(y.asnumpy()).all()
+
+
+class TestBERT:
+    def _batch(self, b=2, s=12, vocab=100, m=3):
+        rng = np.random.RandomState(0)
+        return (nd.array(rng.randint(0, vocab, (b, s)).astype("f")),
+                nd.array(rng.randint(0, 2, (b, s)).astype("f")),
+                nd.array(np.full((b,), s, "f")),
+                nd.array(rng.randint(0, s, (b, m)).astype("f")))
+
+    def test_bert_forward(self):
+        model = bert_small(vocab_size=100, max_length=32, dropout=0.0)
+        model.initialize(mx.init.Xavier())
+        tokens, types, vlen, _ = self._batch()
+        seq, pooled = model(tokens, types, vlen)
+        assert seq.shape == (2, 12, 256)
+        assert pooled.shape == (2, 256)
+
+    def test_bert_pretrain_step_trains(self):
+        from mxnet_tpu.gluon import Trainer
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+        np.random.seed(0)
+        model = BERTForPretrain(bert_small(vocab_size=100, max_length=32,
+                                           dropout=0.0,
+                                           num_layers=2))
+        model.initialize(mx.init.Xavier())
+        tr = Trainer(model.collect_params(), "adam",
+                     {"learning_rate": 1e-3}, kvstore=None)
+        loss_fn = SoftmaxCrossEntropyLoss()
+        tokens, types, vlen, positions = self._batch()
+        rng = np.random.RandomState(1)
+        mlm_labels = nd.array(rng.randint(0, 100, (2 * 3,)).astype("f"))
+        nsp_labels = nd.array(np.array([0, 1], "f"))
+        losses = []
+        for _ in range(8):
+            with mx.autograd.record():
+                mlm_scores, nsp_scores = model(tokens, types, vlen,
+                                               positions)
+                l = loss_fn(mlm_scores, mlm_labels).mean() + \
+                    loss_fn(nsp_scores, nsp_labels).mean()
+            l.backward()
+            tr.step(1)
+            losses.append(float(l.asnumpy()))
+        assert losses[-1] < losses[0], losses
+        # tied embedding got gradient contributions
+        w = model.bert.word_embed.weight
+        assert np.abs(w.grad().asnumpy()).sum() > 0
+
+    def test_bert_hybridize_matches(self):
+        model = bert_small(vocab_size=50, max_length=16, dropout=0.0,
+                           num_layers=1)
+        model.initialize(mx.init.Xavier())
+        tokens, types, vlen, _ = self._batch(vocab=50)
+        s1, p1 = model(tokens, types, vlen)
+        model.hybridize()
+        s2, p2 = model(tokens, types, vlen)
+        np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestRingAttention:
+    def test_matches_dense_attention(self):
+        """Ring attention over sp=4 == single-device SDPA."""
+        import jax.numpy as jnp
+        mesh = parallel.make_mesh({"sp": 4})
+        rng = np.random.RandomState(0)
+        q = rng.rand(2, 16, 2, 8).astype("f")
+        k = rng.rand(2, 16, 2, 8).astype("f")
+        v = rng.rand(2, 16, 2, 8).astype("f")
+        out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), mesh=mesh)
+        expect = _np_sdpa(q, k, v, 1.0 / np.sqrt(8))
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_causal_ring(self):
+        import jax.numpy as jnp
+        mesh = parallel.make_mesh({"sp": 4})
+        rng = np.random.RandomState(1)
+        q = rng.rand(1, 16, 2, 8).astype("f")
+        out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(q),
+                                      jnp.asarray(q), mesh=mesh,
+                                      causal=True)
+        expect = _np_sdpa(q, q, q, 1.0 / np.sqrt(8), causal=True)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+        mesh = parallel.make_mesh({"sp": 2})
+        q = jnp.asarray(np.random.rand(1, 8, 1, 4).astype("f"))
+
+        def loss(q):
+            return parallel.ring_attention(q, q, q, mesh=mesh).sum()
+
+        g = jax.grad(loss)(q)
+        assert np.abs(np.asarray(g)).sum() > 0

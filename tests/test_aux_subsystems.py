@@ -1,0 +1,164 @@
+"""Profiler, Monitor, runtime Features, env registry, callbacks,
+export/SymbolBlock.imports, checkpoint backends (SURVEY.md §5)."""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+from mxnet_tpu.gluon import nn
+
+
+class TestProfiler:
+    def test_op_events_and_dump(self, tmp_path):
+        fname = str(tmp_path / "profile.json")
+        profiler.set_config(filename=fname)
+        profiler.set_state("run")
+        a = nd.ones((8, 8))
+        b = nd.dot(a, a)
+        b.wait_to_read()
+        profiler.set_state("stop")
+        profiler.dump()
+        with open(fname) as f:
+            trace = json.load(f)
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "dot" in names
+        # no events recorded after stop
+        nd.dot(a, a).wait_to_read()
+        profiler.dump()
+        with open(fname) as f:
+            assert json.load(f)["traceEvents"] == []
+
+    def test_pause_resume_and_dumps(self):
+        profiler.set_state("run")
+        profiler.pause()
+        nd.ones((2, 2)).wait_to_read()
+        profiler.resume()
+        x = nd.ones((4, 4))
+        (x * 2).wait_to_read()
+        table = profiler.dumps(reset=True)
+        profiler.set_state("stop")
+        assert "Calls" in table
+
+    def test_scope_and_marker(self, tmp_path):
+        fname = str(tmp_path / "p.json")
+        profiler.set_config(filename=fname)
+        profiler.set_state("run")
+        with profiler.record_scope("my_step"):
+            nd.ones((2, 2)).wait_to_read()
+        profiler.Marker("hit").mark()
+        profiler.set_state("stop")
+        profiler.dump()
+        with open(fname) as f:
+            names = [e["name"] for e in json.load(f)["traceEvents"]]
+        assert "my_step" in names and "hit" in names
+
+
+class TestMonitor:
+    def test_monitor_on_executor(self):
+        from mxnet_tpu import sym
+        from mxnet_tpu.monitor import Monitor
+        data = sym.var("data")
+        out = sym.relu(sym.FullyConnected(
+            data, sym.var("w"), sym.var("b"), num_hidden=4, name="fc"))
+        ex = out.simple_bind(ctx=mx.cpu(), data=(2, 3), w=(4, 3), b=(4,))
+        mon = Monitor(interval=1)
+        mon.install(ex)
+        mon.tic()
+        ex.forward(data=nd.ones((2, 3)))
+        res = mon.toc()
+        assert res, "monitor collected no stats"
+        assert any("output" in name for _, name, _ in res)
+
+
+class TestRuntime:
+    def test_features(self):
+        feats = mx.runtime.Features()
+        assert feats.is_enabled("PJRT")
+        assert not feats.is_enabled("CUDA")
+        with pytest.raises(RuntimeError):
+            feats.is_enabled("NOPE")
+
+    def test_env_registry(self, monkeypatch):
+        from mxnet_tpu import envs
+        assert envs.get("MXTPU_ENGINE_TYPE") == ""
+        monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+        assert envs.get("MXTPU_ENGINE_TYPE") == "NaiveEngine"
+        assert "MXTPU_DISABLE_FLASH" in envs.registry()
+
+
+class TestExportImport:
+    def test_export_and_symbolblock_imports(self, tmp_path):
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="relu", in_units=4),
+                    nn.BatchNorm(axis=1),
+                    nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(2, 4).astype("f"))
+        with mx.autograd.predict_mode():
+            y_ref = net(x)
+        prefix = str(tmp_path / "mlp")
+        net.export(prefix, epoch=7)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0007.params")
+
+        from mxnet_tpu.gluon import SymbolBlock
+        net2 = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0007.params")
+        with mx.autograd.predict_mode():
+            y2 = net2(x)
+        np.testing.assert_allclose(y_ref.asnumpy(), y2.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_model_checkpoint_roundtrip(self, tmp_path):
+        from mxnet_tpu import sym
+        s = sym.relu(sym.var("x"))
+        arg = {"w": nd.ones((2, 2))}
+        aux = {"rm": nd.zeros((2,))}
+        prefix = str(tmp_path / "m")
+        mx.model.save_checkpoint(prefix, 3, s, arg, aux)
+        s2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+        assert s2.list_arguments() == ["x"]
+        np.testing.assert_allclose(arg2["w"].asnumpy(), 1.0)
+        np.testing.assert_allclose(aux2["rm"].asnumpy(), 0.0)
+
+
+class TestOrbax:
+    def test_orbax_roundtrip(self, tmp_path):
+        try:
+            ckpt = mx.checkpoint.OrbaxCheckpoint(str(tmp_path / "ck"))
+        except mx.MXNetError:
+            pytest.skip("orbax not available")
+        net = nn.Dense(4, in_units=3)
+        net.initialize()
+        params = {k: p.data() for k, p in net.collect_params().items()}
+        ckpt.save(0, params)
+        loaded = ckpt.load(0)
+        for k in params:
+            np.testing.assert_allclose(params[k].asnumpy(),
+                                       loaded[k].asnumpy())
+
+
+class TestCallbacks:
+    def test_speedometer_and_checkpoint(self, tmp_path, caplog):
+        from mxnet_tpu.callback import Speedometer, do_checkpoint
+
+        class P:
+            epoch = 0
+            nbatch = 50
+            eval_metric = None
+
+        sp = Speedometer(batch_size=32, frequent=50)
+        sp(P())  # init
+        P.nbatch = 100
+        with caplog.at_level(logging.INFO):
+            sp(P())
+
+        cb = do_checkpoint(str(tmp_path / "cp"))
+        cb(0, None, {"w": nd.ones((2,))}, {})
+        assert os.path.exists(str(tmp_path / "cp-0001.params"))

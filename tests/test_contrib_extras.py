@@ -1,0 +1,130 @@
+"""AMP, quantization, CustomOp tests (SURVEY.md §2.2/§2.5 contrib)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+
+class TestAMP:
+    def test_init_casts_matmul_inputs(self):
+        from mxnet_tpu.contrib import amp
+        try:
+            amp.init(target_dtype="bfloat16")
+            a = nd.ones((4, 4))
+            out = nd.dot(a, a)
+            assert out.dtype == np.dtype("bfloat16") or \
+                str(out.dtype) == "bfloat16"
+        finally:
+            amp._deinit()
+        # after deinit, fp32 again
+        out = nd.dot(nd.ones((2, 2)), nd.ones((2, 2)))
+        assert out.dtype == np.dtype("float32")
+
+    def test_loss_scaler_dynamics(self):
+        from mxnet_tpu.contrib.amp import LossScaler
+        s = LossScaler(init_scale=1024, scale_factor=2, scale_window=2)
+        good = [nd.ones((2,))]
+        bad = [nd.array([np.inf, 1.0])]
+        assert not s.has_overflow(good)
+        assert not s.has_overflow(good)
+        assert s.loss_scale == 2048  # doubled after window
+        assert s.has_overflow(bad)
+        assert s.loss_scale == 1024  # halved on overflow
+
+    def test_scale_loss_and_unscale(self):
+        from mxnet_tpu.contrib import amp
+        from mxnet_tpu.gluon import Trainer
+        try:
+            amp.init()
+            net = nn.Dense(2, in_units=3)
+            net.initialize()
+            tr = amp.init_trainer(Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.1},
+                                          kvstore=None))
+            x = nd.ones((2, 3))
+            with mx.autograd.record():
+                y = net(x).sum()
+                with amp.scale_loss(y, tr) as scaled:
+                    scaled.backward()
+            assert not amp.unscale(tr)
+        finally:
+            amp._deinit()
+
+    def test_convert_model(self):
+        from mxnet_tpu.contrib import amp
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        amp.convert_model(net, "bfloat16")
+        assert str(net.weight.dtype) == "bfloat16"
+
+
+class TestQuantization:
+    def test_quantize_dequantize_roundtrip(self):
+        from mxnet_tpu.contrib import quantization as q
+        a = nd.array(np.random.randn(16, 16).astype("f"))
+        qa, scale = q.quantize_array(a)
+        back = q.dequantize_array(qa, scale)
+        np.testing.assert_allclose(back.asnumpy(), a.asnumpy(),
+                                   atol=scale)
+
+    def test_calibration(self):
+        from mxnet_tpu.contrib import quantization as q
+        data = [nd.array(np.random.randn(64).astype("f"))
+                for _ in range(4)]
+        lo, hi = q.calib_minmax(data)
+        assert lo < 0 < hi
+        lo2, hi2 = q.calib_entropy(data)
+        assert hi2 > 0
+
+    def test_quantized_dense_close_to_fp32(self):
+        from mxnet_tpu.contrib import quantization as q
+        np.random.seed(0)
+        dense = nn.Dense(8, in_units=16)
+        dense.initialize(mx.init.Xavier())
+        layer_map = q.quantize_model(dense)
+        qd = layer_map[dense]
+        x = nd.array(np.random.rand(4, 16).astype("f"))
+        y_fp = dense(x).asnumpy()
+        y_q = qd(x).asnumpy()
+        # int8 error budget: ~1% of dynamic range
+        assert np.abs(y_fp - y_q).max() < 0.05 * np.abs(y_fp).max() + 0.05
+
+
+class TestCustomOp:
+    def test_custom_op_forward_backward(self):
+        @mx.operator.register("mysigmoid")
+        class SigmoidProp(mx.operator.CustomOpProp):
+            def infer_shape(self, in_shape):
+                return in_shape, [in_shape[0]], []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                class Sigmoid(mx.operator.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        x = in_data[0].asnumpy()
+                        self.y = 1 / (1 + np.exp(-x))
+                        self.assign(out_data[0], req[0],
+                                    nd.array(self.y))
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        g = out_grad[0].asnumpy()
+                        self.assign(in_grad[0], req[0],
+                                    nd.array(g * self.y * (1 - self.y)))
+                return Sigmoid()
+
+        x = nd.array(np.array([0.0, 1.0, -1.0], "f"))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = nd.Custom(x, op_type="mysigmoid")
+            y.sum().backward()
+        sig = 1 / (1 + np.exp(-x.asnumpy()))
+        np.testing.assert_allclose(y.asnumpy(), sig, rtol=1e-6)
+        np.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
+                                   rtol=1e-6)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(mx.MXNetError, match="not registered"):
+            nd.Custom(nd.ones((2,)), op_type="nope")

@@ -1,0 +1,182 @@
+"""Control-flow op tests (mirrors reference
+tests/python/unittest/test_contrib_control_flow.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import contrib
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(12).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, state):
+        new_s = state + x
+        return new_s, new_s
+
+    outs, final = contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1])
+
+
+def test_foreach_multiple_data_states():
+    a = nd.array(np.ones((3, 2)))
+    b = nd.array(np.full((3, 2), 2.0))
+    s1, s2 = nd.zeros((2,)), nd.ones((2,))
+
+    def body(xs, states):
+        x, y = xs
+        u, v = states
+        return [x + y, u * 2], [u + x, v + y]
+
+    outs, finals = contrib.foreach(body, [a, b], [s1, s2])
+    assert outs[0].shape == (3, 2) and outs[1].shape == (3, 2)
+    np.testing.assert_allclose(finals[0].asnumpy(), [3.0, 3.0])
+    np.testing.assert_allclose(finals[1].asnumpy(), [7.0, 7.0])
+
+
+def test_foreach_grad_through_captured_param():
+    """Gradients must flow to closure-captured arrays (the reference cuts
+    the subgraph and collects free variables)."""
+    w = nd.array([2.0, 3.0])
+    w.attach_grad()
+    data = nd.array(np.ones((4, 2)))
+    init = nd.zeros((2,))
+
+    def body(x, s):
+        new_s = s + x * w
+        return new_s, new_s
+
+    with mx.autograd.record():
+        outs, final = contrib.foreach(body, data, init)
+        loss = final.sum()
+    loss.backward()
+    # d(sum(4*w))/dw = 4
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0, 4.0])
+
+
+def test_foreach_grad_through_data_and_state():
+    data = nd.array(np.random.rand(5, 3).astype("f"))
+    data.attach_grad()
+    init = nd.zeros((3,))
+    with mx.autograd.record():
+        outs, final = contrib.foreach(
+            lambda x, s: (s + x * x, s + x * x), data, init)
+        loss = final.sum()
+    loss.backward()
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               2 * data.asnumpy(), rtol=1e-5)
+
+
+def test_foreach_grad_through_captured_view():
+    """Gradients flow to the BASE of a view captured by a body closure
+    (regression: capture scope must record bases, not views)."""
+    w = nd.array([[2.0, 3.0], [4.0, 5.0]])
+    w.attach_grad()
+    row = w[0]  # view
+    data = nd.array(np.ones((3, 2)))
+    init = nd.zeros((2,))
+
+    def body(x, s):
+        new_s = s + x * row
+        return new_s, new_s
+
+    with mx.autograd.record():
+        outs, final = contrib.foreach(body, data, init)
+        loss = final.sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               [[3.0, 3.0], [0.0, 0.0]])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i * 2, [i + 1, s + i]
+
+    outs, finals = contrib.while_loop(
+        cond_fn, func, [nd.array([0.0]), nd.array([0.0])],
+        max_iterations=8)
+    # i runs 0..4 → outputs 0,2,4,6,8 then zeros
+    np.testing.assert_allclose(
+        outs.asnumpy().ravel(), [0, 2, 4, 6, 8, 0, 0, 0])
+    np.testing.assert_allclose(finals[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(finals[1].asnumpy(), [0 + 1 + 2 + 3 + 4])
+
+
+def test_while_loop_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+
+    def cond_fn(i, s):
+        return i < 3
+
+    def func(i, s):
+        return None, [i + 1, s * x]
+
+    with mx.autograd.record():
+        outs, finals = contrib.while_loop(
+            cond_fn, func, [nd.zeros((1,)), nd.ones((1,))],
+            max_iterations=5)
+        loss = finals[1].sum()
+    loss.backward()
+    # s = x^3 → ds/dx = 3x^2 = 3
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0], rtol=1e-5)
+
+
+def test_while_loop_requires_max_iterations():
+    with pytest.raises(mx.MXNetError, match="max_iterations"):
+        contrib.while_loop(lambda i: i < 2, lambda i: (i, [i]),
+                           [nd.zeros((1,))])
+
+
+def test_cond():
+    a = nd.array([4.0])
+    b = nd.array([3.0])
+    out_t = contrib.cond(a > b, lambda: a * 2, lambda: b * 10)
+    np.testing.assert_allclose(out_t.asnumpy(), [8.0])
+    out_f = contrib.cond(a < b, lambda: a * 2, lambda: b * 10)
+    np.testing.assert_allclose(out_f.asnumpy(), [30.0])
+
+
+def test_cond_grad():
+    a = nd.array([2.0])
+    a.attach_grad()
+    with mx.autograd.record():
+        out = contrib.cond(a > 1, lambda: a * a, lambda: a * 3)
+        out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4.0])
+
+
+def test_foreach_in_hybridized_block():
+    """foreach inside a HybridBlock compiles under CachedOp."""
+    from mxnet_tpu.gluon import nn, HybridBlock
+
+    class ScanNet(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = nn.Dense(4, in_units=4, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            def body(xt, s):
+                h = self.proj(xt) + s
+                return h, h
+            outs, final = F.contrib.foreach(
+                body, x, F.zeros((x.shape[1], 4), ctx=x.context))
+            return outs
+
+    np.random.seed(0)
+    net = ScanNet()
+    net.initialize()
+    x = nd.array(np.random.rand(6, 2, 4).astype("f"))
+    y_imp = net(x)
+    net.hybridize()
+    y_hyb = net(x)
+    np.testing.assert_allclose(y_imp.asnumpy(), y_hyb.asnumpy(),
+                               rtol=1e-5, atol=1e-6)

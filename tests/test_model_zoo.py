@@ -1,0 +1,106 @@
+"""Model-zoo tests (mirrors reference test_gluon_model_zoo.py: build +
+forward each model, check output shape/finiteness).  Small inputs and a
+thumbnail subset keep CPU CI fast; full-size ImageNet shapes are covered
+for one representative per family."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision, get_model
+
+
+def _smoke(name, input_shape, classes=10, **kwargs):
+    np.random.seed(0)
+    net = get_model(name, classes=classes, **kwargs)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(*input_shape).astype("float32"))
+    with mx.autograd.predict_mode():
+        y = net(x)
+    assert y.shape == (input_shape[0], classes)
+    assert np.isfinite(y.asnumpy()).all()
+    return net
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet34_v1", "resnet50_v1",
+    "resnet18_v2", "resnet50_v2",
+])
+def test_resnets(name):
+    _smoke(name, (1, 3, 32, 32), thumbnail=True)
+
+
+def test_resnet_full_size_and_hybridize():
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(1, 3, 224, 224).astype("f"))
+    with mx.autograd.predict_mode():
+        y1 = net(x)
+        net.hybridize()
+        y2 = net(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_vgg():
+    _smoke("vgg11", (1, 3, 32, 32))
+
+
+def test_vgg_bn():
+    _smoke("vgg11_bn", (1, 3, 32, 32))
+
+
+def test_alexnet():
+    _smoke("alexnet", (1, 3, 224, 224))
+
+
+def test_squeezenet():
+    _smoke("squeezenet1.0", (1, 3, 224, 224))
+    _smoke("squeezenet1.1", (1, 3, 224, 224))
+
+
+def test_mobilenet():
+    _smoke("mobilenet0.25", (1, 3, 224, 224))
+
+
+def test_mobilenet_v2():
+    _smoke("mobilenetv2_0.25", (1, 3, 224, 224))
+
+
+def test_densenet():
+    _smoke("densenet121", (1, 3, 224, 224))
+
+
+@pytest.mark.slow
+def test_inception():
+    _smoke("inceptionv3", (1, 3, 299, 299))
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError, match="not supported"):
+        get_model("resnet999")
+
+
+def test_pretrained_is_documented_gap():
+    with pytest.raises(mx.MXNetError, match="network access"):
+        get_model("resnet18_v1", pretrained=True)
+
+
+def test_resnet_trains_one_step():
+    """ResNet-18 thumbnail takes an SGD step without NaNs (BN updates)."""
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    net = get_model("resnet18_v1", classes=4, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
+                 kvstore=None)
+    x = nd.array(np.random.rand(4, 3, 32, 32).astype("f"))
+    y = nd.array(np.array([0, 1, 2, 3], "f"))
+    loss_fn = SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        l = loss_fn(net(x), y).mean()
+    l.backward()
+    tr.step(1)
+    assert np.isfinite(float(l.asnumpy()))
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()

@@ -1,0 +1,163 @@
+"""RecordIO + image pipeline tests (mirrors reference test_recordio.py /
+test_image.py / test_io.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio, image
+from mxnet_tpu.io import ImageRecordIter
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record{i}".encode() * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record{i}".encode() * (i + 1)
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"record{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(10))
+    for i in (7, 3, 9, 0):
+        assert r.read_idx(i) == f"record{i}".encode()
+    r.close()
+
+
+def test_multichunk_record(tmp_path):
+    """Records spanning multiple chunks reassemble (dmlc framing)."""
+    path = str(tmp_path / "big.rec")
+    w = recordio.MXRecordIO(path, "w")
+    big = os.urandom(1024)
+    w.write(big)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == big
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 7
+    # vector label
+    hv = recordio.IRHeader(0, [1.0, 2.0, 3.0], 9, 0)
+    s = recordio.pack(hv, b"x")
+    h3, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h3.label, [1, 2, 3])
+
+
+def _make_rec(tmp_path, n=12, size=(24, 24)):
+    import cv2
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    return rec
+
+
+def test_pack_img_unpack_img(tmp_path):
+    import cv2
+    img = np.random.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          img_fmt=".png")
+    h, img2 = recordio.unpack_img(s)
+    np.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+def test_imdecode_and_resize():
+    import cv2
+    img = np.random.randint(0, 255, (10, 12, 3), dtype=np.uint8)
+    ret, buf = cv2.imencode(".png", img)
+    decoded = image.imdecode(buf.tobytes())
+    assert decoded.shape == (10, 12, 3)
+    # to_rgb: channels reversed vs BGR input
+    np.testing.assert_array_equal(decoded.asnumpy()[..., 0],
+                                  img[..., 2])
+    resized = image.imresize(decoded, 6, 5)
+    assert resized.shape == (5, 6, 3)
+
+
+def test_augmenters():
+    src = nd.array(np.random.randint(0, 255, (20, 20, 3)), dtype="uint8")
+    out, _ = image.center_crop(src, (8, 8))
+    assert out.shape == (8, 8, 3)
+    out, _ = image.random_crop(src, (8, 8))
+    assert out.shape == (8, 8, 3)
+    auglist = image.CreateAugmenter((3, 8, 8), rand_mirror=True,
+                                    mean=True, std=True)
+    img = src
+    for aug in auglist:
+        img = aug(img)
+    assert img.shape == (8, 8, 3)
+    assert img.dtype == np.dtype("float32")
+
+
+def test_image_iter(tmp_path):
+    rec = _make_rec(tmp_path)
+    it = image.ImageIter(4, (3, 16, 16), path_imgrec=rec, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    assert batches[0].label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter(tmp_path):
+    rec = _make_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                         batch_size=4, shuffle=False, mean_r=123,
+                         mean_g=117, mean_b=104)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        n += 1
+    assert n == 3
+
+
+def test_im2rec_tool(tmp_path):
+    """tools/im2rec.py --list then pack, then read back."""
+    import cv2
+    root = tmp_path / "images" / "cats"
+    root.mkdir(parents=True)
+    for i in range(4):
+        img = np.random.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        cv2.imwrite(str(root / f"img{i}.png"), img)
+    prefix = str(tmp_path / "ds")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, os.path.join(repo, "tools",
+                                                 "im2rec.py"),
+                    prefix, str(tmp_path / "images"), "--list",
+                    "--recursive"], check=True, env=env)
+    assert os.path.exists(prefix + ".lst")
+    subprocess.run([sys.executable, os.path.join(repo, "tools",
+                                                 "im2rec.py"),
+                    prefix, str(tmp_path / "images"), "--recursive"],
+                   check=True, env=env)
+    assert os.path.exists(prefix + ".rec")
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    header, img = recordio.unpack_img(r.read_idx(r.keys[0]))
+    assert img.shape == (16, 16, 3)

@@ -1,0 +1,218 @@
+"""gluon.rnn tests: cells vs numpy oracles, layers vs cell unrolls,
+bidirectional/multilayer shapes, hybridize equivalence (mirrors reference
+tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import rnn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_step(x, h, c, wi, wh, bi, bh):
+    gates = x @ wi.T + bi + h @ wh.T + bh
+    H = h.shape[1]
+    i = _sigmoid(gates[:, 0:H])
+    f = _sigmoid(gates[:, H:2 * H])
+    g = np.tanh(gates[:, 2 * H:3 * H])
+    o = _sigmoid(gates[:, 3 * H:4 * H])
+    c2 = f * c + i * g
+    h2 = o * np.tanh(c2)
+    return h2, c2
+
+
+def np_gru_step(x, h, wi, wh, bi, bh):
+    H = h.shape[1]
+    gi = x @ wi.T + bi
+    gh = h @ wh.T + bh
+    r = _sigmoid(gi[:, 0:H] + gh[:, 0:H])
+    z = _sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+    n = np.tanh(gi[:, 2 * H:3 * H] + r * gh[:, 2 * H:3 * H])
+    return (1 - z) * n + z * h
+
+
+def _get(cell, name):
+    return cell.collect_params()[cell.prefix + name].data().asnumpy()
+
+
+class TestCells:
+    def test_rnn_cell_forward(self):
+        cell = rnn.RNNCell(8, activation="tanh", input_size=5)
+        cell.initialize()
+        x = nd.array(np.random.rand(3, 5).astype("f"))
+        h0 = nd.zeros((3, 8))
+        out, [h] = cell(x, [h0])
+        wi, wh = _get(cell, "i2h_weight"), _get(cell, "h2h_weight")
+        bi, bh = _get(cell, "i2h_bias"), _get(cell, "h2h_bias")
+        expect = np.tanh(x.asnumpy() @ wi.T + bi + bh)
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_lstm_cell_vs_numpy(self):
+        np.random.seed(0)
+        cell = rnn.LSTMCell(4, input_size=3)
+        cell.initialize(mx.init.Xavier())
+        x = np.random.rand(2, 3).astype("f")
+        h = np.random.rand(2, 4).astype("f")
+        c = np.random.rand(2, 4).astype("f")
+        out, [h2, c2] = cell(nd.array(x), [nd.array(h), nd.array(c)])
+        eh, ec = np_lstm_step(x, h, c,
+                              _get(cell, "i2h_weight"),
+                              _get(cell, "h2h_weight"),
+                              _get(cell, "i2h_bias"),
+                              _get(cell, "h2h_bias"))
+        np.testing.assert_allclose(h2.asnumpy(), eh, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(c2.asnumpy(), ec, rtol=1e-5, atol=1e-6)
+
+    def test_gru_cell_vs_numpy(self):
+        np.random.seed(1)
+        cell = rnn.GRUCell(4, input_size=3)
+        cell.initialize(mx.init.Xavier())
+        x = np.random.rand(2, 3).astype("f")
+        h = np.random.rand(2, 4).astype("f")
+        out, [h2] = cell(nd.array(x), [nd.array(h)])
+        expect = np_gru_step(x, h,
+                             _get(cell, "i2h_weight"),
+                             _get(cell, "h2h_weight"),
+                             _get(cell, "i2h_bias"),
+                             _get(cell, "h2h_bias"))
+        np.testing.assert_allclose(h2.asnumpy(), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_unroll_and_merge(self):
+        cell = rnn.LSTMCell(6, input_size=4)
+        cell.initialize()
+        x = nd.array(np.random.rand(2, 5, 4).astype("f"))  # NTC
+        outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+        assert outs.shape == (2, 5, 6)
+        assert states[0].shape == (2, 6)
+
+    def test_sequential_stack(self):
+        stack = rnn.SequentialRNNCell()
+        stack.add(rnn.LSTMCell(6, input_size=4))
+        stack.add(rnn.LSTMCell(3, input_size=6))
+        stack.initialize()
+        x = nd.array(np.random.rand(2, 5, 4).astype("f"))
+        outs, states = stack.unroll(5, x, layout="NTC",
+                                    merge_outputs=True)
+        assert outs.shape == (2, 5, 3)
+        assert len(states) == 4
+
+    def test_residual_cell(self):
+        base = rnn.RNNCell(4, input_size=4)
+        cell = rnn.ResidualCell(base)
+        cell.initialize()
+        x = nd.array(np.random.rand(2, 3, 4).astype("f"))
+        outs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+        assert outs.shape == (2, 3, 4)
+
+    def test_bidirectional_cell(self):
+        cell = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                                     rnn.LSTMCell(4, input_size=3))
+        cell.initialize()
+        x = nd.array(np.random.rand(2, 5, 3).astype("f"))
+        outs, states = cell.unroll(5, x, layout="NTC",
+                                   merge_outputs=True)
+        assert outs.shape == (2, 5, 8)
+
+
+class TestLayers:
+    def test_lstm_layer_matches_cell_unroll(self):
+        """Fused scan layer == cell-level unroll with same weights."""
+        np.random.seed(2)
+        T, N, C, H = 6, 3, 5, 4
+        layer = rnn.LSTM(H, input_size=C)
+        layer.initialize(mx.init.Xavier())
+        x = np.random.rand(T, N, C).astype("f")
+        out = layer(nd.array(x))
+        assert out.shape == (T, N, H)
+
+        wi = _get_layer(layer, "l0_i2h_weight")
+        wh = _get_layer(layer, "l0_h2h_weight")
+        bi = _get_layer(layer, "l0_i2h_bias")
+        bh = _get_layer(layer, "l0_h2h_bias")
+        h = np.zeros((N, H), "f")
+        c = np.zeros((N, H), "f")
+        expect = []
+        for t in range(T):
+            h, c = np_lstm_step(x[t], h, c, wi, wh, bi, bh)
+            expect.append(h)
+        np.testing.assert_allclose(out.asnumpy(), np.stack(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_layer_with_states(self):
+        layer = rnn.LSTM(4, num_layers=2, input_size=5)
+        layer.initialize()
+        x = nd.array(np.random.rand(6, 3, 5).astype("f"))
+        h0 = layer.begin_state(batch_size=3)
+        out, [hn, cn] = layer(x, h0)
+        assert out.shape == (6, 3, 4)
+        assert hn.shape == (2, 3, 4) and cn.shape == (2, 3, 4)
+
+    def test_bidirectional_layer(self):
+        layer = rnn.GRU(4, num_layers=2, bidirectional=True, input_size=5)
+        layer.initialize()
+        x = nd.array(np.random.rand(6, 3, 5).astype("f"))
+        out, [hn] = layer(x, layer.begin_state(batch_size=3))
+        assert out.shape == (6, 3, 8)
+        assert hn.shape == (4, 3, 4)
+
+    def test_ntc_layout(self):
+        layer = rnn.RNN(4, layout="NTC", input_size=5)
+        layer.initialize()
+        x = nd.array(np.random.rand(3, 6, 5).astype("f"))
+        out = layer(x)
+        assert out.shape == (3, 6, 4)
+
+    def test_layer_hybridize_and_grad(self):
+        np.random.seed(4)
+        layer = rnn.LSTM(4, input_size=5)
+        layer.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(6, 2, 5).astype("f"))
+        y_imp = layer(x)
+        layer.hybridize()
+        y_hyb = layer(x)
+        np.testing.assert_allclose(y_imp.asnumpy(), y_hyb.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+        with mx.autograd.record():
+            out = layer(x)
+            loss = out.sum()
+        loss.backward()
+        w = layer.collect_params()[layer.prefix + "l0_i2h_weight"]
+        assert np.abs(w.grad().asnumpy()).sum() > 0
+
+    def test_layer_trains(self):
+        """An LSTM regressor learns a simple sum-over-time target."""
+        from mxnet_tpu.gluon import nn, Trainer, loss as gloss
+        np.random.seed(5)
+        net_lstm = rnn.LSTM(8, input_size=2)
+        dense = nn.Dense(1, in_units=8)
+        net_lstm.initialize(mx.init.Xavier())
+        dense.initialize(mx.init.Xavier())
+        params = list(net_lstm.collect_params().values()) + \
+            list(dense.collect_params().values())
+        tr = Trainer(params, "adam", {"learning_rate": 0.05},
+                     kvstore=None)
+        lfn = gloss.L2Loss()
+        x = np.random.rand(5, 16, 2).astype("f")
+        y = x.sum(axis=(0, 2), keepdims=False).reshape(16, 1)
+        first = last = None
+        for i in range(150):
+            with mx.autograd.record():
+                seq = net_lstm(nd.array(x))
+                pred = dense(seq[-1])
+                l = lfn(pred, nd.array(y)).mean()
+            l.backward()
+            tr.step(1)
+            v = float(l.asnumpy())
+            first = v if first is None else first
+            last = v
+        assert last < first * 0.15, (first, last)
+
+
+def _get_layer(layer, name):
+    return layer.collect_params()[layer.prefix + name].data().asnumpy()

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / .lst into RecordIO (parity:
+``tools/im2rec.py`` — SURVEY.md §2.6).
+
+Usage (same surface as the reference):
+  python tools/im2rec.py prefix root --list         # make prefix.lst
+  python tools/im2rec.py prefix root                # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def list_image(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]),) + (parts[-1],) + \
+                tuple(float(x) for x in parts[1:-1])
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = f"_{i}" if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def im2rec(args, path_lst):
+    import cv2
+    from mxnet_tpu import recordio
+
+    fname = os.path.basename(path_lst)
+    fname_rec = os.path.splitext(fname)[0]
+    out_prefix = os.path.join(args.working_dir or os.path.dirname(
+        path_lst), fname_rec)
+    record = recordio.MXIndexedRecordIO(out_prefix + ".idx",
+                                        out_prefix + ".rec", "w")
+    count = 0
+    for item in read_list(path_lst):
+        idx, fpath, label = item[0], item[1], item[2:]
+        fullpath = os.path.join(args.root, fpath)
+        header = recordio.IRHeader(
+            0, label[0] if len(label) == 1 else list(label), idx, 0)
+        if args.pass_through:
+            with open(fullpath, "rb") as f:
+                record.write_idx(idx, recordio.pack(header, f.read()))
+        else:
+            img = cv2.imread(fullpath, args.color)
+            if img is None:
+                print(f"imread failed for {fullpath}", file=sys.stderr)
+                continue
+            if args.resize:
+                h, w = img.shape[:2]
+                if h > w:
+                    img = cv2.resize(img, (args.resize,
+                                           h * args.resize // w))
+                else:
+                    img = cv2.resize(img, (w * args.resize // h,
+                                           args.resize))
+            record.write_idx(idx, recordio.pack_img(
+                header, img, quality=args.quality,
+                img_fmt=args.encoding))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    record.close()
+    print(f"wrote {count} records to {out_prefix}.rec")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO file")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", action="store_true",
+                        help="create list instead of record")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0)
+    parser.add_argument("--recursive", action="store_true")
+    parser.add_argument(
+        "--shuffle", default=True,
+        type=lambda s: s.lower() in ("1", "true", "yes"),
+        help="shuffle the list (pass False to keep order)")
+    parser.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg")
+    parser.add_argument("--color", type=int, default=1)
+    parser.add_argument("--working-dir", default=None)
+    args = parser.parse_args()
+
+    if args.list:
+        make_list(args)
+        return
+    files = [args.prefix + ".lst"] \
+        if os.path.isfile(args.prefix + ".lst") else \
+        [os.path.join(os.path.dirname(args.prefix), f)
+         for f in os.listdir(os.path.dirname(args.prefix) or ".")
+         if f.startswith(os.path.basename(args.prefix))
+         and f.endswith(".lst")]
+    for f in files:
+        im2rec(args, f)
+
+
+if __name__ == "__main__":
+    main()
