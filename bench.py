@@ -1,88 +1,186 @@
 """Benchmark harness: prints ONE JSON line for the driver.
 
 Headline metric (BASELINE.md north star): **BERT-base pretraining
-samples/sec/chip** — MLM+NSP step (batch 32, seq 128) through the fused
-SPMD trainer on a single-chip mesh, matmuls in bfloat16 via AMP (the
-MXU-native path).  ``vs_baseline`` stays 1.0: BASELINE.md records
-"published": {} — no verifiable reference numbers exist to compare
-against, so the series is self-relative across rounds.
+samples/sec/chip** — MLM+NSP step through the fused SPMD trainer on a
+single-chip mesh, matmuls in bfloat16 via AMP (the MXU-native path).
+``vs_baseline`` stays 1.0: BASELINE.md records "published": {} — no
+verifiable reference numbers exist, so the series is self-relative.
 
-Fallback: if the BERT config cannot run (e.g. device too small), the
-MLP config #1 bench reports instead, so the driver always gets a line.
+Hang-proofing (VERDICT r1 weak #1):
+- device acquisition happens in a SUBPROCESS with a hard deadline, so a
+  wedged PJRT plugin cannot stall the parent; on failure we pin the CPU
+  backend and report a ``degraded`` line instead of hanging;
+- a watchdog thread force-emits the best-so-far JSON line and exits if
+  the total budget is exceeded (compiles can wedge the main thread);
+- the cheap MLP bench runs FIRST so a number exists before anything
+  expensive is attempted, then bert_small, then bert_base (TPU only);
+- every exit path emits exactly one JSON line on stdout.
+
+Env knobs: MXTPU_BENCH_ACQUIRE_TIMEOUT (s, default 180),
+MXTPU_BENCH_BUDGET (s, default 900), MXTPU_BENCH_FORCE_CPU=1.
 """
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 import traceback
 
 import numpy as np
 
+# v5e (TPU v5 lite) peak bf16 matmul throughput, used for analytic MFU
+_V5E_PEAK_FLOPS = 197e12
 
-def bench_bert_pretrain(batch_size=32, seq_len=128, num_masked=20,
-                        steps=20, warmup=3):
+_state = {
+    "result": {
+        "metric": "none",
+        "value": 0.0,
+        "unit": "samples/sec",
+        "vs_baseline": 0.0,
+        "degraded": "no benchmark completed",
+    },
+    "emitted": False,
+}
+_lock = threading.Lock()
+
+
+def _log(msg):
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
+def _set_result(metric, value, unit="samples/sec", **extra):
+    with _lock:
+        _state["result"] = {
+            "metric": metric,
+            "value": round(float(value), 2),
+            "unit": unit,
+            "vs_baseline": 1.0,
+            **extra,
+        }
+
+
+def _emit_and_exit(code=0):
+    with _lock:
+        if not _state["emitted"]:
+            _state["emitted"] = True
+            print(json.dumps(_state["result"]), flush=True)
+    os._exit(code)
+
+
+def _watchdog(budget):
+    time.sleep(budget)
+    _log(f"WATCHDOG: budget {budget}s exceeded — emitting best-so-far")
+    _emit_and_exit(0)
+
+
+def probe_platform(timeout):
+    """Ask a subprocess which backend is reachable, with a hard deadline.
+
+    Returns 'tpu' or 'cpu'. A hang/crash in the PJRT plugin kills only
+    the child.
+    """
+    if os.environ.get("MXTPU_BENCH_FORCE_CPU"):
+        return "cpu"
+    code = ("import jax\n"
+            "d = jax.devices()\n"
+            "import jax.numpy as jnp\n"
+            "x = (jnp.ones((128, 128)) @ jnp.ones((128, 128)))"
+            ".block_until_ready()\n"
+            "print('PLATFORM:' + d[0].platform, flush=True)\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"device probe timed out after {timeout}s")
+        return "cpu"
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM:"):
+            plat = line.split(":", 1)[1].strip().lower()
+            _log(f"device probe: platform={plat}")
+            return "tpu" if plat not in ("cpu",) else "cpu"
+    _log(f"device probe failed (rc={out.returncode}): "
+         f"{out.stderr.strip()[-500:]}")
+    return "cpu"
+
+
+def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
+                        num_masked, steps, warmup, hidden, layers,
+                        heads):
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.contrib import amp
-    from mxnet_tpu.models import bert_base, bert_small, BERTForPretrain
+    from mxnet_tpu import models
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
 
     on_tpu = bool(mx.num_tpus())
     ctx = mx.tpu() if on_tpu else mx.cpu()
     amp.init(target_dtype="bfloat16")
+    try:
+        builder = getattr(models, builder_name)
+        model = models.BERTForPretrain(
+            builder(vocab_size=vocab, max_length=seq_len, dropout=0.1))
+        model.initialize(mx.init.Xavier(), ctx=ctx)
 
-    vocab = 30522
-    if not on_tpu:
-        # CPU smoke sizing so the fallback path terminates quickly;
-        # the TPU series always measures the full bert_base config
-        batch_size, seq_len, num_masked, steps, warmup = 4, 32, 4, 3, 1
-        vocab = 1000
-        def builder(**kw):
-            return bert_small(num_layers=2, **kw)
-    else:
-        builder = bert_base
-    model = BERTForPretrain(builder(vocab_size=vocab,
-                                    max_length=seq_len, dropout=0.1))
-    model.initialize(mx.init.Xavier(), ctx=ctx)
+        sce = SoftmaxCrossEntropyLoss()
+        b, m = batch_size, num_masked
 
-    sce = SoftmaxCrossEntropyLoss()
-    b, m = batch_size, num_masked
+        def loss_fn(outs, label):
+            mlm_scores, nsp_scores = outs
+            mlm_labels = label[:, :m].reshape((-1,))
+            nsp_labels = label[:, m]
+            return sce(mlm_scores, mlm_labels).mean() + \
+                sce(nsp_scores, nsp_labels).mean()
 
-    def loss_fn(outs, label):
-        mlm_scores, nsp_scores = outs
-        mlm_labels = label[:, :m].reshape((-1,))
-        nsp_labels = label[:, m]
-        return sce(mlm_scores, mlm_labels).mean() + \
-            sce(nsp_scores, nsp_labels).mean()
+        mesh = parallel.make_mesh({"dp": 1}, devices=[ctx.device])
+        dpt = parallel.DataParallelTrainer(model, loss_fn, "adam",
+                                           {"learning_rate": 1e-4},
+                                           mesh=mesh)
 
-    mesh = parallel.make_mesh({"dp": 1}, devices=[ctx.device])
-    dpt = parallel.DataParallelTrainer(model, loss_fn, "adam",
-                                       {"learning_rate": 1e-4},
-                                       mesh=mesh)
+        rng = np.random.RandomState(0)
+        tokens = nd.array(
+            rng.randint(0, vocab, (b, seq_len)).astype("f"), ctx=ctx)
+        types = nd.array(
+            rng.randint(0, 2, (b, seq_len)).astype("f"), ctx=ctx)
+        vlen = nd.array(np.full((b,), seq_len, "f"), ctx=ctx)
+        positions = nd.array(
+            rng.randint(0, seq_len, (b, m)).astype("f"), ctx=ctx)
+        label = nd.array(np.concatenate(
+            [rng.randint(0, vocab, (b, m)), rng.randint(0, 2, (b, 1))],
+            axis=1).astype("f"), ctx=ctx)
 
-    rng = np.random.RandomState(0)
-    tokens = nd.array(rng.randint(0, vocab, (b, seq_len)).astype("f"),
-                      ctx=ctx)
-    types = nd.array(rng.randint(0, 2, (b, seq_len)).astype("f"),
-                     ctx=ctx)
-    vlen = nd.array(np.full((b,), seq_len, "f"), ctx=ctx)
-    positions = nd.array(rng.randint(0, seq_len, (b, m)).astype("f"),
-                         ctx=ctx)
-    label = nd.array(np.concatenate(
-        [rng.randint(0, vocab, (b, m)), rng.randint(0, 2, (b, 1))],
-        axis=1).astype("f"), ctx=ctx)
+        data = (tokens, types, vlen, positions)
+        _log(f"{builder_name}: compiling + warmup ({warmup} steps)")
+        for _ in range(warmup):
+            loss = dpt.step(data, label)
+        loss.wait_to_read()
+        _log(f"{builder_name}: timing {steps} steps")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = dpt.step(data, label)
+        loss.wait_to_read()
+        dt = time.perf_counter() - t0
+        assert np.isfinite(float(loss.asnumpy()))
+    finally:
+        amp._deinit()
 
-    data = (tokens, types, vlen, positions)
-    for _ in range(warmup):
-        loss = dpt.step(data, label)
-    loss.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = dpt.step(data, label)
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
-    assert np.isfinite(float(loss.asnumpy()))
-    return batch_size * steps / dt
+    sps = batch_size * steps / dt
+    # analytic MFU: fwd+bwd ≈ 6 * non-embedding-params * tokens, plus
+    # attention 12 * L * H * S^2 per sample (fwd+bwd); embedding
+    # lookups are gathers, not matmuls, so exclude those tables
+    n_params = sum(
+        int(np.prod(p.shape))
+        for name, p in model.collect_params().items()
+        if "embed" not in name)
+    flops_per_sample = 6 * n_params * seq_len \
+        + 12 * layers * hidden * seq_len * seq_len
+    mfu = sps * flops_per_sample / _V5E_PEAK_FLOPS
+    return sps, mfu
 
 
 def bench_mlp_train(batch_size=512, steps=30, warmup=5):
@@ -105,8 +203,8 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
 
         x = mx.nd.array(np.random.rand(batch_size, 784).astype("f4"),
                         ctx=ctx)
-        y = mx.nd.array(np.random.randint(0, 10, batch_size).astype("f4"),
-                        ctx=ctx)
+        y = mx.nd.array(
+            np.random.randint(0, 10, batch_size).astype("f4"), ctx=ctx)
 
         def step():
             with autograd.record():
@@ -129,30 +227,72 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
 
 
 def main():
-    import mxnet_tpu as mx
-    on_tpu = bool(mx.num_tpus())
+    acquire_timeout = float(
+        os.environ.get("MXTPU_BENCH_ACQUIRE_TIMEOUT", "180"))
+    budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "900"))
+    threading.Thread(target=_watchdog, args=(budget,),
+                     daemon=True).start()
+
+    platform = probe_platform(acquire_timeout)
+    if platform == "cpu":
+        # pin before any jax/mxnet_tpu import so a wedged axon plugin
+        # can't stall the parent process too
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = platform == "tpu"
+
+    import mxnet_tpu as mx  # noqa: F401  (import after platform pin)
+
+    # stage 1: cheap MLP so a number always exists
     try:
-        sps = bench_bert_pretrain()
-        print(json.dumps({
-            "metric": "bert_base_pretrain_samples_per_sec_per_chip"
-                      if on_tpu else
-                      "bert_small_pretrain_samples_per_sec_cpu_smoke",
-            "value": round(sps, 2),
-            "unit": "samples/sec",
-            "vs_baseline": 1.0,
-        }))
-        return
+        _log("stage 1: MLP trainer bench")
+        sps = bench_mlp_train()
+        extra = {} if on_tpu else {
+            "degraded": "tpu unreachable; cpu backend"}
+        _set_result("mlp_mnist_train_samples_per_sec", sps, **extra)
+        _log(f"stage 1 done: {sps:.1f} samples/sec")
     except Exception:
         traceback.print_exc(file=sys.stderr)
-        from mxnet_tpu.contrib import amp
-        amp._deinit()  # don't let a failed bf16 attempt skew the fallback
-    sps = bench_mlp_train()
-    print(json.dumps({
-        "metric": "mlp_mnist_train_samples_per_sec",
-        "value": round(sps, 2),
-        "unit": "samples/sec",
-        "vs_baseline": 1.0,
-    }))
+
+    # stage 2: bert_small (tiny on cpu, real config on tpu)
+    try:
+        if on_tpu:
+            cfg = dict(builder_name="bert_small", vocab=30522,
+                       batch_size=32, seq_len=128, num_masked=20,
+                       steps=20, warmup=3, hidden=256, layers=4,
+                       heads=4)
+            metric = "bert_small_pretrain_samples_per_sec_per_chip"
+        else:
+            cfg = dict(builder_name="bert_small", vocab=1000,
+                       batch_size=4, seq_len=32, num_masked=4,
+                       steps=3, warmup=1, hidden=256, layers=4,
+                       heads=4)
+            metric = "bert_small_pretrain_samples_per_sec_cpu_smoke"
+        _log("stage 2: " + metric)
+        sps, mfu = bench_bert_pretrain(**cfg)
+        extra = {"mfu": round(mfu, 4)} if on_tpu else {
+            "degraded": "tpu unreachable; cpu backend"}
+        _set_result(metric, sps, **extra)
+        _log(f"stage 2 done: {sps:.1f} samples/sec")
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+
+    # stage 3: the headline — bert_base, TPU only
+    if on_tpu:
+        try:
+            _log("stage 3: bert_base pretrain bench")
+            sps, mfu = bench_bert_pretrain(
+                builder_name="bert_base", vocab=30522, batch_size=32,
+                seq_len=128, num_masked=20, steps=20, warmup=3,
+                hidden=768, layers=12, heads=12)
+            _set_result("bert_base_pretrain_samples_per_sec_per_chip",
+                        sps, mfu=round(mfu, 4))
+            _log(f"stage 3 done: {sps:.1f} samples/sec, mfu={mfu:.3f}")
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+
+    _emit_and_exit(0)
 
 
 if __name__ == "__main__":

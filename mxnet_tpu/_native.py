@@ -26,27 +26,71 @@ __all__ = ["lib", "available", "NativeEngine", "NativeStorage",
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu.so")
 lib = None
+_build_attempted = False
+
+
+def _src_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+
+
+def _stale() -> bool:
+    """True when the .so is missing or older than any src/*.cc."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    src = _src_dir()
+    try:
+        lib_m = os.path.getmtime(_LIB_PATH)
+        return any(os.path.getmtime(os.path.join(src, f)) > lib_m
+                   for f in os.listdir(src) if f.endswith(".cc"))
+    except OSError:
+        return False
 
 
 def _try_load():
-    global lib
+    global lib, _build_attempted
     if lib is not None:
         return lib
-    if os.path.exists(_LIB_PATH):
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            _declare(lib)
-        except OSError:
-            lib = None
+    # the binary is NOT committed (platform-specific); build it from
+    # src/ on first use and rebuild whenever the sources are newer.
+    # flock serializes concurrent builders (pytest-xdist, forked
+    # DataLoader workers) and keeps CDLL from seeing a half-written .so
+    lock_path = _LIB_PATH + ".lock"
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    try:
+        lock_f = open(lock_path, "w")
+        import fcntl
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+    except OSError:
+        lock_f = None
+    try:
+        if not _build_attempted and os.path.isdir(_src_dir()) \
+                and _stale():
+            _build_attempted = True
+            import subprocess
+            try:
+                subprocess.run(["make", "-C", _src_dir()],
+                               capture_output=True, timeout=300)
+            except Exception:
+                pass
+        if os.path.exists(_LIB_PATH):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+            except OSError:
+                lib = None
+    finally:
+        if lock_f is not None:
+            import fcntl
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+            lock_f.close()
     return lib
 
 
 def build():
     """Compile src/ → mxnet_tpu/lib/libmxtpu.so (needs g++)."""
     import subprocess
-    src = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "src")
-    subprocess.run(["make", "-C", src], check=True)
+    subprocess.run(["make", "-C", _src_dir()], check=True)
     return _try_load() is not None
 
 
@@ -241,8 +285,11 @@ class NativeRecordIO:
     def read(self) -> Optional[bytes]:
         out = ctypes.POINTER(ctypes.c_ubyte)()
         n = self._lib.MXTPURecordIORead(self._h, ctypes.byref(out))
+        if n == -1:
+            return None  # clean EOF
         if n < 0:
-            return None
+            from .base import MXNetError
+            raise MXNetError("invalid record: corrupt or truncated")
         return ctypes.string_at(out, n)
 
     def close(self):

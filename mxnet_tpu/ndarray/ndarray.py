@@ -695,10 +695,12 @@ def waitall():
 
 
 # ---------------------------------------------------------------------------
-# serialization — parity with mx.nd.save/load (reference ndarray.cc
-# Save/Load, dmlc::Stream).  Binary layout: magic, count, names, then per
-# array: dtype/shape header + raw bytes (little-endian), so files round-trip
-# across sessions without pickle.
+# serialization — API parity with mx.nd.save/load (reference ndarray.cc
+# Save/Load).  NOTE: only the API surface is compatible, NOT the file
+# format — this is a native MXTPU001 layout (magic, count, names, then
+# per array: dtype/shape header + raw little-endian bytes), not the
+# reference's dmlc::Stream NDArray serialization; reference-written
+# .params files cannot be loaded here and vice versa.
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXTPU001"

@@ -113,22 +113,37 @@ class MXRecordIO:
         if self._nat is not None:
             self._nat.write(buf)
             return
-        # multi-chunk framing for records > 2^29 bytes (dmlc recordio.h)
-        nchunk = max(1, (len(buf) + _LMAX - 1) // _LMAX)
-        pos = 0
-        remaining = len(buf)
-        for i in range(nchunk):
-            size = min(remaining, _LMAX)
-            cflag = 0 if nchunk == 1 else (1 if i == 0 else
-                                           (2 if i == nchunk - 1 else 3))
-            lrec = (cflag << _LFLAG_BITS) | size
+        # dmlc recordio.h framing: a record is split into chunks at every
+        # 4-byte-ALIGNED occurrence of the magic word inside the payload
+        # (the embedded magic bytes are consumed here and re-inserted by
+        # the reader); cflag 0=complete 1=start 2=middle 3=end.  Only the
+        # final chunk can be non-multiple-of-4, so only it is padded.
+        if len(buf) >= (1 << _LFLAG_BITS):
+            raise MXNetError("RecordIO only accepts records < 2^29 bytes")
+        magic_bytes = struct.pack("<I", _MAGIC)
+
+        def emit(cflag, chunk):
+            lrec = (cflag << _LFLAG_BITS) | len(chunk)
             self.handle.write(struct.pack("<II", _MAGIC, lrec))
-            self.handle.write(buf[pos:pos + size])
-            pad = (4 - size % 4) % 4
+            self.handle.write(chunk)
+            pad = (4 - len(chunk) % 4) % 4
             if pad:
                 self.handle.write(b"\x00" * pad)
-            pos += size
-            remaining -= size
+
+        nslice = 0
+        begin = 0
+        pos = 0
+        while True:
+            i = buf.find(magic_bytes, pos)
+            if i == -1:
+                break
+            if i % 4:
+                pos = i + 1  # unaligned hit: not a frame boundary
+                continue
+            emit(1 if nslice == 0 else 2, buf[begin:i])
+            begin = pos = i + 4
+            nslice += 1
+        emit(0 if nslice == 0 else 3, buf[begin:])
 
     def read(self):
         assert not self.writable
@@ -136,22 +151,29 @@ class MXRecordIO:
         if self._nat is not None:
             return self._nat.read()
         out = b""
+        first = True
+        magic_bytes = struct.pack("<I", _MAGIC)
         while True:
             hdr = self.handle.read(8)
+            if not hdr and first:
+                return None  # clean EOF
             if len(hdr) < 8:
-                return out if out else None
+                raise MXNetError("invalid record: truncated header")
             magic, lrec = struct.unpack("<II", hdr)
             if magic != _MAGIC:
                 raise MXNetError("invalid record: bad magic")
+            first = False
             cflag = lrec >> _LFLAG_BITS
             size = lrec & _LMAX
-            data = self.handle.read(size)
-            pad = (4 - size % 4) % 4
-            if pad:
-                self.handle.read(pad)
-            out += data
-            if cflag in (0, 2):  # single chunk or last chunk
+            upper = (size + 3) & ~3
+            data = self.handle.read(upper)
+            if len(data) < upper:
+                raise MXNetError("invalid record: truncated payload")
+            out += data[:size]
+            if cflag in (0, 3):  # complete record or end chunk
                 return out
+            # chunk boundary marks an embedded magic word: restore it
+            out += magic_bytes
 
 
 class MXIndexedRecordIO(MXRecordIO):

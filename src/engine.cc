@@ -13,6 +13,7 @@
 // observable semantics the reference's engine gave (test:
 // tests/cpp_native test via ctypes mirrors threaded_engine_test.cc's
 // ordering + stress cases).
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -58,6 +59,10 @@ class ThreadedEngine {
   }
 
   ~ThreadedEngine() {
+    // drain pending ops before joining workers (reference shutdown
+    // ordering): otherwise a thread parked in WaitForVar/WaitForAll on
+    // an abandoned op hangs forever
+    WaitForAll();
     {
       std::unique_lock<std::mutex> lk(mu_);
       stop_ = true;
@@ -73,8 +78,22 @@ class ThreadedEngine {
     return id;
   }
 
-  uint64_t Push(OpFn fn, const std::vector<uint64_t>& reads,
-                const std::vector<uint64_t>& writes) {
+  uint64_t Push(OpFn fn, const std::vector<uint64_t>& reads_in,
+                const std::vector<uint64_t>& writes_in) {
+    // the reference engine CHECKs const/mutable disjointness; we adopt
+    // its contract by deduplicating: a var appearing in both sets (or
+    // repeated) is treated as write-only, else the op's second entry on
+    // that var's queue would block behind its own first and deadlock
+    std::vector<uint64_t> writes;
+    for (uint64_t v : writes_in)
+      if (std::find(writes.begin(), writes.end(), v) == writes.end())
+        writes.push_back(v);
+    std::vector<uint64_t> reads;
+    for (uint64_t v : reads_in)
+      if (std::find(reads.begin(), reads.end(), v) == reads.end() &&
+          std::find(writes.begin(), writes.end(), v) == writes.end())
+        reads.push_back(v);
+
     auto op = std::make_shared<Op>();
     op->fn = std::move(fn);
     op->read_vars = reads;

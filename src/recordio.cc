@@ -36,53 +36,69 @@ class RecordIO {
     return f_ && std::fseek(f_, static_cast<long>(pos), SEEK_SET) == 0;
   }
 
+  // dmlc recordio.h framing: split the payload at every 4-byte-ALIGNED
+  // occurrence of the magic word (the embedded magic is consumed on
+  // write and re-inserted on read); cflag 0=complete 1=start 2=middle
+  // 3=end.  Intermediate chunks are multiples of 4; only the final
+  // chunk is padded.  Records must be < 2^29 bytes.
   bool Write(const uint8_t* data, uint64_t len) {
     if (!f_ || !writable_) return false;
-    uint64_t nchunk = len == 0 ? 1 : (len + kLMax - 1) / kLMax;
-    uint64_t pos = 0, remaining = len;
-    for (uint64_t i = 0; i < nchunk; ++i) {
-      uint32_t size = static_cast<uint32_t>(
-          remaining < kLMax ? remaining : kLMax);
-      uint32_t cflag = nchunk == 1 ? 0
-                       : (i == 0 ? 1 : (i == nchunk - 1 ? 2 : 3));
-      uint32_t lrec = (cflag << kLFlagBits) | size;
-      if (std::fwrite(&kMagic, 4, 1, f_) != 1) return false;
-      if (std::fwrite(&lrec, 4, 1, f_) != 1) return false;
-      if (size && std::fwrite(data + pos, 1, size, f_) != size)
-        return false;
-      uint32_t pad = (4 - size % 4) % 4;
-      static const char zeros[4] = {0, 0, 0, 0};
-      if (pad && std::fwrite(zeros, 1, pad, f_) != pad) return false;
-      pos += size;
-      remaining -= size;
+    if (len >= (1ull << kLFlagBits)) return false;
+    uint64_t begin = 0, nslice = 0;
+    uint32_t magic = kMagic;
+    for (uint64_t i = 0; i + 4 <= len; i += 4) {
+      if (std::memcmp(data + i, &magic, 4) == 0) {
+        if (!WriteChunk(nslice == 0 ? 1u : 2u, data + begin, i - begin))
+          return false;
+        begin = i + 4;
+        ++nslice;
+      }
     }
-    return true;
+    return WriteChunk(nslice == 0 ? 0u : 3u, data + begin, len - begin);
   }
 
-  // reads the next (possibly multi-chunk) record into out; returns
-  // false at EOF or error
-  bool Read(std::string* out) {
-    if (!f_ || writable_) return false;
+  // reads the next (possibly multi-chunk) record into out;
+  // returns 1 on success, 0 at clean EOF, -1 on corruption (truncated
+  // header/payload, bad magic) — same distinction as the pure-Python
+  // reader, which raises on corruption instead of reporting EOF
+  int Read(std::string* out) {
+    if (!f_ || writable_) return -1;
     out->clear();
+    bool first = true;
     for (;;) {
       uint32_t magic = 0, lrec = 0;
-      if (std::fread(&magic, 4, 1, f_) != 1) return !out->empty();
-      if (std::fread(&lrec, 4, 1, f_) != 1) return false;
-      if (magic != kMagic) return false;
+      if (std::fread(&magic, 4, 1, f_) != 1)
+        return first ? 0 : -1;  // EOF only legal at a record boundary
+      if (std::fread(&lrec, 4, 1, f_) != 1) return -1;
+      if (magic != kMagic) return -1;
+      first = false;
       uint32_t cflag = lrec >> kLFlagBits;
       uint32_t size = lrec & kLMax;
+      uint32_t upper = (size + 3u) & ~3u;
       size_t base = out->size();
+      out->resize(base + upper);
+      if (upper &&
+          std::fread(&(*out)[base], 1, upper, f_) != upper)
+        return -1;
       out->resize(base + size);
-      if (size &&
-          std::fread(&(*out)[base], 1, size, f_) != size)
-        return false;
-      uint32_t pad = (4 - size % 4) % 4;
-      if (pad) std::fseek(f_, pad, SEEK_CUR);
-      if (cflag == 0 || cflag == 2) return true;
+      if (cflag == 0 || cflag == 3) return 1;
+      // chunk boundary marks an embedded magic word: restore it
+      out->append(reinterpret_cast<const char*>(&kMagic), 4);
     }
   }
 
  private:
+  bool WriteChunk(uint32_t cflag, const uint8_t* data, uint64_t size) {
+    uint32_t lrec = (cflag << kLFlagBits) | static_cast<uint32_t>(size);
+    if (std::fwrite(&kMagic, 4, 1, f_) != 1) return false;
+    if (std::fwrite(&lrec, 4, 1, f_) != 1) return false;
+    if (size && std::fwrite(data, 1, size, f_) != size) return false;
+    uint32_t pad = (4 - size % 4) % 4;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad && std::fwrite(zeros, 1, pad, f_) != pad) return false;
+    return true;
+  }
+
   FILE* f_;
   bool writable_;
 };
@@ -117,10 +133,12 @@ int MXTPURecordIOWrite(void* r, const uint8_t* data, uint64_t len) {
 }
 
 // Reads next record. Returns size >=0 and sets *out to an internal
-// buffer valid until the next call; returns -1 at EOF/error.
+// buffer valid until the next call; returns -1 at clean EOF, -2 on
+// corruption.
 int64_t MXTPURecordIORead(void* r, const uint8_t** out) {
   thread_local std::string buf;
-  if (!static_cast<mxtpu::RecordIO*>(r)->Read(&buf)) return -1;
+  int rc = static_cast<mxtpu::RecordIO*>(r)->Read(&buf);
+  if (rc <= 0) return rc == 0 ? -1 : -2;
   *out = reinterpret_cast<const uint8_t*>(buf.data());
   return static_cast<int64_t>(buf.size());
 }
