@@ -1,0 +1,110 @@
+/*
+ * Flat C API for the mxnet_tpu runtime.
+ *
+ * Capability parity: reference include/mxnet/c_api.h (SURVEY.md §2.1
+ * "C API").  Conventions (same as the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *  - on failure, MXTPUGetLastError() returns a per-thread message;
+ *  - handles are opaque and must be released with the matching *Free;
+ *  - op params are passed as parallel string key/value arrays and
+ *    parsed by the runtime (the MXImperativeInvokeEx contract);
+ *  - dtype codes: 0=float32 1=float64 2=float16 3=uint8 4=int32
+ *    5=int8 6=int64 7=bool 12=bfloat16;
+ *  - ctx_type: 1=cpu 2=tpu (ctx_id = device ordinal).
+ *
+ * Complex aggregate arguments (shape dicts, infer-shape results) are
+ * marshalled as JSON strings — a deliberate flat-C simplification of
+ * the reference's many-pointer signatures.
+ *
+ * Lifetime of returned strings/string-lists (MXSymbolSaveToJSON,
+ * MXSymbolInferShape, MXSymbolList*, MXListOps): pointers live in a
+ * per-thread ring of 8 slots — valid until the 8th subsequent
+ * string-returning call on the same thread; copy out to keep longer.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+
+/* error ring / library info */
+const char* MXTPUGetLastError(void);
+void MXTPUSetLastError(const char* msg);
+int MXTPUGetVersion(void);
+int MXTPUHasFeature(const char* name);
+int MXTPUCAPIInit(void);
+
+/* NDArray */
+int MXNDArrayCreate(const int64_t* shape, int ndim, int dtype,
+                    int ctx_type, int ctx_id, NDArrayHandle* out);
+int MXNDArrayFromData(const int64_t* shape, int ndim, int dtype,
+                      int ctx_type, int ctx_id, const void* data,
+                      size_t nbytes, NDArrayHandle* out);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, size_t nbytes);
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArrayWaitAll(void);
+int MXNDArrayGetShape(NDArrayHandle h, int* out_ndim,
+                      int64_t* out_shape, int max_ndim);
+int MXNDArrayGetDType(NDArrayHandle h, int* out);
+int MXNDArrayCopy(NDArrayHandle h, NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle h);
+
+/* imperative ops */
+int MXImperativeInvoke(const char* op_name, NDArrayHandle* inputs,
+                       int num_inputs, int num_params,
+                       const char** keys, const char** vals,
+                       int* num_outputs, NDArrayHandle* outputs,
+                       int max_outputs);
+int MXListOps(int* count, const char*** out_names);
+int MXRandomSeed(int seed);
+
+/* Symbol */
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json);
+int MXSymbolCompose(const char* op_name, const char* name,
+                    SymbolHandle* in_syms, const char** in_names,
+                    int num_inputs, int num_params, const char** keys,
+                    const char** vals, SymbolHandle* out);
+int MXSymbolListArguments(SymbolHandle h, int* count,
+                          const char*** out);
+int MXSymbolListOutputs(SymbolHandle h, int* count, const char*** out);
+int MXSymbolInferShape(SymbolHandle h, const char* shapes_json,
+                       const char** out_json);
+int MXSymbolFree(SymbolHandle h);
+
+/* Executor */
+int MXExecutorSimpleBind(SymbolHandle h, const char* shapes_json,
+                         int ctx_type, int ctx_id, const char* grad_req,
+                         ExecutorHandle* out);
+int MXExecutorSetArg(ExecutorHandle h, const char* name,
+                     NDArrayHandle arr);
+int MXExecutorForward(ExecutorHandle h, int is_train, int* num_outputs,
+                      NDArrayHandle* outputs, int max_outputs);
+int MXExecutorBackward(ExecutorHandle h, NDArrayHandle* head_grads,
+                       int num);
+int MXExecutorGetGrad(ExecutorHandle h, const char* name,
+                      NDArrayHandle* out);
+int MXExecutorFree(ExecutorHandle h);
+
+/* KVStore */
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreInit(KVStoreHandle kv, int key, NDArrayHandle arr);
+int MXKVStorePush(KVStoreHandle kv, int key, NDArrayHandle arr);
+int MXKVStorePull(KVStoreHandle kv, int key, NDArrayHandle out_arr);
+int MXKVStoreFree(KVStoreHandle kv);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

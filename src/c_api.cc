@@ -1,36 +1,622 @@
-// Flat C API surface: error handling + library info.
+// Flat C API over the TPU-native runtime.
 //
-// Capability parity: reference src/c_api/c_api.cc (SURVEY.md §2.1
-// "C API"): a flat C ABI with a per-thread last-error ring
-// (MXGetLastError) so every binding — Python today, others later —
-// talks to one stable surface.  The per-subsystem entry points live in
-// engine.cc / storage.cc / recordio.cc; this file holds the shared
-// error plumbing and version/feature queries.
+// Capability parity: reference src/c_api/{c_api.cc, c_api_ndarray.cc,
+// c_api_symbolic.cc, c_api_executor.cc} + include/mxnet/c_api.h
+// (SURVEY.md §2.1 "C API"): a flat C ABI with a per-thread last-error
+// ring (MXTPUGetLastError), NDArray lifecycle, imperative op invoke by
+// name with STRING-valued params (the reference's MXImperativeInvokeEx
+// contract — values parsed framework-side), Symbol create/compose/
+// save/load/infer_shape, Executor bind/forward/backward, KVStore
+// init/push/pull.
+//
+// TPU-native design: the compute path is XLA (driven through JAX), so
+// this layer embeds CPython and fronts the same runtime the Python
+// frontend uses — opaque handles are owned PyObject*; every entry
+// point manages the GIL, so any FFI-capable language gets the full
+// framework (XLA compilation, async dispatch, autograd) through one
+// stable C surface.  A standalone C program links this library plus
+// libpython (see tests/c_smoke/).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
+#include <vector>
 
 namespace {
+
 thread_local std::string g_last_error;
+std::atomic<PyObject*> g_impl{nullptr};  // mxnet_tpu.c_api_impl module
+std::mutex g_init_mu;
+
+// thread-local stable storage for string-returning APIs: a small ring
+// so a handful of list/string results stay valid concurrently on one
+// thread (lifetime documented in include/mxtpu/c_api.h)
+constexpr int kStrRing = 8;
+struct StrSlot {
+  std::string str;
+  std::vector<std::string> store;
+  std::vector<const char*> ptrs;
+};
+thread_local StrSlot g_slots[kStrRing];
+thread_local int g_slot_idx = 0;
+
+StrSlot& NextSlot() {
+  g_slot_idx = (g_slot_idx + 1) % kStrRing;
+  return g_slots[g_slot_idx];
 }
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+// capture the live Python exception into the error ring; returns -1
+int CaptureErr() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type) {
+    PyObject* n = PyObject_GetAttrString(type, "__name__");
+    if (n) {
+      const char* c = PyUnicode_AsUTF8(n);
+      if (c) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  SetError(msg);
+  return -1;
+}
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// initialize the embedded interpreter (idempotent, thread-safe; also
+// works when the library is loaded INTO a running Python via ctypes)
+int EnsureInit() {
+  if (g_impl.load(std::memory_order_acquire)) return 0;
+  std::lock_guard<std::mutex> lk(g_init_mu);
+  if (g_impl.load(std::memory_order_acquire)) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // reads PYTHONPATH for the venv/site dirs
+    PyObject* m = PyImport_ImportModule("mxnet_tpu.c_api_impl");
+    if (!m) {
+      CaptureErr();
+      PyEval_SaveThread();
+      return -1;
+    }
+    g_impl.store(m, std::memory_order_release);
+    PyEval_SaveThread();  // release the GIL taken by Py_Initialize
+    return 0;
+  }
+  GIL gil;
+  PyObject* m = PyImport_ImportModule("mxnet_tpu.c_api_impl");
+  if (!m) return CaptureErr();
+  g_impl.store(m, std::memory_order_release);
+  return 0;
+}
+
+// call impl helper; returns new ref or nullptr (error captured)
+PyObject* CallImpl(const char* fn, PyObject* args /* stolen */) {
+  if (!args) {
+    CaptureErr();
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(
+      g_impl.load(std::memory_order_acquire), fn);
+  if (!f) {
+    Py_DECREF(args);
+    CaptureErr();
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  if (!r) CaptureErr();
+  return r;
+}
+
+PyObject* ShapeTuple(const int64_t* shape, int ndim) {
+  PyObject* t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(shape[i]));
+  return t;
+}
+
+PyObject* StrList(const char** strs, int n) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs[i]));
+  return l;
+}
+
+PyObject* HandleList(void** handles, int n) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+// unpack a Python list of objects into caller-provided handle slots
+int UnpackHandles(PyObject* list, int* num_out, void** out, int cap) {
+  if (!PyList_Check(list)) {
+    SetError("internal: expected list result");
+    return -1;
+  }
+  int n = static_cast<int>(PyList_GET_SIZE(list));
+  if (n > cap) {
+    SetError("output capacity too small");
+    return -1;
+  }
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(list, i);
+    Py_INCREF(o);
+    out[i] = o;
+  }
+  *num_out = n;
+  return 0;
+}
+
+int StoreStringList(PyObject* list, int* count, const char*** out) {
+  if (!PyList_Check(list)) {
+    SetError("internal: expected list result");
+    return -1;
+  }
+  int n = static_cast<int>(PyList_GET_SIZE(list));
+  StrSlot& slot = NextSlot();
+  slot.store.clear();
+  slot.ptrs.clear();
+  for (int i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(list, i));
+    if (!c) return CaptureErr();
+    slot.store.emplace_back(c);
+  }
+  for (auto& s : slot.store) slot.ptrs.push_back(s.c_str());
+  *count = n;
+  *out = slot.ptrs.data();
+  return 0;
+}
+
+int StoreString(PyObject* str, const char** out) {
+  const char* c = PyUnicode_AsUTF8(str);
+  if (!c) return CaptureErr();
+  StrSlot& slot = NextSlot();
+  slot.str = c;
+  *out = slot.str.c_str();
+  return 0;
+}
+
+}  // namespace
 
 extern "C" {
 
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+
+// ---- error ring / library info -------------------------------------------
+
 const char* MXTPUGetLastError() { return g_last_error.c_str(); }
 
-void MXTPUSetLastError(const char* msg) {
-  g_last_error = msg ? msg : "";
-}
+void MXTPUSetLastError(const char* msg) { SetError(msg ? msg : ""); }
 
-int MXTPUGetVersion() { return 100; }  // 0.1.0
+int MXTPUGetVersion() { return 200; }  // 0.2.0
 
-// feature bits for the native layer (Python-side features live in
-// mxnet_tpu.runtime)
 int MXTPUHasFeature(const char* name) {
   if (std::strcmp(name, "ENGINE") == 0) return 1;
   if (std::strcmp(name, "STORAGE_POOL") == 0) return 1;
   if (std::strcmp(name, "RECORDIO") == 0) return 1;
+  if (std::strcmp(name, "C_API") == 0) return 1;
   return 0;
 }
+
+// explicit runtime init (also lazily triggered by every entry point)
+int MXTPUCAPIInit() { return EnsureInit(); }
+
+// ---- generic handle free --------------------------------------------------
+
+static int FreeHandle(void* h) {
+  if (!h) return 0;
+  if (EnsureInit()) return -1;
+  GIL gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+// ---- NDArray --------------------------------------------------------------
+
+int MXNDArrayCreate(const int64_t* shape, int ndim, int dtype,
+                    int ctx_type, int ctx_id, NDArrayHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_create",
+                         Py_BuildValue("(Niii)", ShapeTuple(shape, ndim),
+                                       dtype, ctx_type, ctx_id));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayFromData(const int64_t* shape, int ndim, int dtype,
+                      int ctx_type, int ctx_id, const void* data,
+                      size_t nbytes, NDArrayHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "ndarray_from_bytes",
+      Py_BuildValue("(Niy#ii)", ShapeTuple(shape, ndim), dtype,
+                    static_cast<const char*>(data),
+                    static_cast<Py_ssize_t>(nbytes), ctx_type, ctx_id));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, size_t nbytes) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_to_bytes",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return CaptureErr();
+  }
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(r);
+    SetError("size mismatch: array has " + std::to_string(len) +
+             " bytes, caller expects " + std::to_string(nbytes));
+    return -1;
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle h) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_wait",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("waitall", PyTuple_New(0));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle h, int* out_ndim,
+                      int64_t* out_shape, int max_ndim) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_shape",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  if (!PyList_Check(r)) {
+    Py_DECREF(r);
+    SetError("internal: expected list result");
+    return -1;
+  }
+  int n = static_cast<int>(PyList_GET_SIZE(r));
+  if (n > max_ndim) {
+    Py_DECREF(r);
+    SetError("shape capacity too small: array has " +
+             std::to_string(n) + " dims, caller provided " +
+             std::to_string(max_ndim));
+    return -1;
+  }
+  for (int i = 0; i < n; ++i)
+    out_shape[i] = PyLong_AsLongLong(PyList_GET_ITEM(r, i));
+  *out_ndim = n;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle h, int* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_dtype",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayCopy(NDArrayHandle h, NDArrayHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("ndarray_copy",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle h) { return FreeHandle(h); }
+
+// ---- imperative invoke ----------------------------------------------------
+
+int MXImperativeInvoke(const char* op_name, NDArrayHandle* inputs,
+                       int num_inputs, int num_params, const char** keys,
+                       const char** vals, int* num_outputs,
+                       NDArrayHandle* outputs, int max_outputs) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "imperative_invoke",
+      Py_BuildValue("(sNNN)", op_name, HandleList(inputs, num_inputs),
+                    StrList(keys, num_params),
+                    StrList(vals, num_params)));
+  if (!r) return -1;
+  int rc = UnpackHandles(r, num_outputs, outputs, max_outputs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXListOps(int* count, const char*** out_names) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("list_ops", PyTuple_New(0));
+  if (!r) return -1;
+  int rc = StoreStringList(r, count, out_names);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXRandomSeed(int seed) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("random_seed", Py_BuildValue("(i)", seed));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---- Symbol ---------------------------------------------------------------
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("symbol_create_variable",
+                         Py_BuildValue("(s)", name));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("symbol_from_json", Py_BuildValue("(s)", json));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("symbol_to_json",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  int rc = StoreString(r, out_json);
+  Py_DECREF(r);
+  return rc;
+}
+
+// compose a registered op symbolically; in_names[i] may name the kwarg
+// for in_syms[i] (pass NULL in_names for positional compose)
+int MXSymbolCompose(const char* op_name, const char* name,
+                    SymbolHandle* in_syms, const char** in_names,
+                    int num_inputs, int num_params, const char** keys,
+                    const char** vals, SymbolHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* names_list;
+  if (in_names) {
+    names_list = StrList(in_names, num_inputs);
+  } else {
+    names_list = PyList_New(0);
+  }
+  PyObject* r = CallImpl(
+      "symbol_invoke",
+      Py_BuildValue("(sNNsNN)", op_name, HandleList(in_syms, num_inputs),
+                    names_list, name ? name : "",
+                    StrList(keys, num_params),
+                    StrList(vals, num_params)));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle h, int* count, const char*** out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("symbol_list_arguments",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  int rc = StoreStringList(r, count, out);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXSymbolListOutputs(SymbolHandle h, int* count, const char*** out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("symbol_list_outputs",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  int rc = StoreStringList(r, count, out);
+  Py_DECREF(r);
+  return rc;
+}
+
+// shapes as JSON {"name": [dims...]}; result JSON with
+// arg_shapes/out_shapes/aux_shapes — flat-C marshalling of the
+// reference's MXSymbolInferShape
+int MXSymbolInferShape(SymbolHandle h, const char* shapes_json,
+                       const char** out_json) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "symbol_infer_shape_json",
+      Py_BuildValue("(Os)", static_cast<PyObject*>(h), shapes_json));
+  if (!r) return -1;
+  int rc = StoreString(r, out_json);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXSymbolFree(SymbolHandle h) { return FreeHandle(h); }
+
+// ---- Executor -------------------------------------------------------------
+
+int MXExecutorSimpleBind(SymbolHandle h, const char* shapes_json,
+                         int ctx_type, int ctx_id, const char* grad_req,
+                         ExecutorHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "executor_simple_bind_json",
+      Py_BuildValue("(Osiis)", static_cast<PyObject*>(h), shapes_json,
+                    ctx_type, ctx_id, grad_req));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXExecutorSetArg(ExecutorHandle h, const char* name,
+                     NDArrayHandle arr) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "executor_set_arg",
+      Py_BuildValue("(OsO)", static_cast<PyObject*>(h), name,
+                    static_cast<PyObject*>(arr)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle h, int is_train, int* num_outputs,
+                      NDArrayHandle* outputs, int max_outputs) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "executor_forward",
+      Py_BuildValue("(Oi)", static_cast<PyObject*>(h), is_train));
+  if (!r) return -1;
+  int rc = UnpackHandles(r, num_outputs, outputs, max_outputs);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXExecutorBackward(ExecutorHandle h, NDArrayHandle* head_grads,
+                       int num) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "executor_backward",
+      Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                    HandleList(head_grads, num)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorGetGrad(ExecutorHandle h, const char* name,
+                      NDArrayHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "executor_grad",
+      Py_BuildValue("(Os)", static_cast<PyObject*>(h), name));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle h) { return FreeHandle(h); }
+
+// ---- KVStore --------------------------------------------------------------
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("kvstore_create", Py_BuildValue("(s)", type));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle kv, int key, NDArrayHandle arr) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "kvstore_init",
+      Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                    static_cast<PyObject*>(arr)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle kv, int key, NDArrayHandle arr) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "kvstore_push",
+      Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                    static_cast<PyObject*>(arr)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle kv, int key, NDArrayHandle out_arr) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "kvstore_pull",
+      Py_BuildValue("(OiO)", static_cast<PyObject*>(kv), key,
+                    static_cast<PyObject*>(out_arr)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle kv) { return FreeHandle(kv); }
 
 }  // extern "C"

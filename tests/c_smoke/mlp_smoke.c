@@ -1,0 +1,204 @@
+/*
+ * C smoke test: drives an MLP forward (+ a symbolic executor with
+ * backward, and a KVStore round-trip) entirely through the flat C API
+ * — no Python code in this file.  Mirrors the reference's cpp-package
+ * examples / c_predict_api smoke coverage (SURVEY.md §2.6).
+ *
+ * Build/run: see tests/test_c_api.py (compiled with gcc, linked
+ * against libmxtpu.so + libpython).
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      fprintf(stderr, "FAIL %s:%d: %s — %s\n", __FILE__, __LINE__,   \
+              #cond, MXTPUGetLastError());                           \
+      exit(1);                                                       \
+    }                                                                \
+  } while (0)
+
+#define CPU 1
+
+static NDArrayHandle randn(int64_t r, int64_t c, unsigned* seed) {
+  size_t n = (size_t)(r * c);
+  float* buf = (float*)malloc(n * sizeof(float));
+  for (size_t i = 0; i < n; ++i)
+    buf[i] = ((float)rand_r(seed) / RAND_MAX - 0.5f) * 0.2f;
+  int64_t shape[2] = {r, c};
+  NDArrayHandle h;
+  CHECK(MXNDArrayFromData(shape, c > 0 ? 2 : 1, 0, CPU, 0, buf,
+                          n * sizeof(float), &h) == 0);
+  free(buf);
+  return h;
+}
+
+static void check_finite(NDArrayHandle h, size_t n) {
+  float* out = (float*)malloc(n * sizeof(float));
+  CHECK(MXNDArraySyncCopyToCPU(h, out, n * sizeof(float)) == 0);
+  for (size_t i = 0; i < n; ++i) CHECK(isfinite(out[i]));
+  free(out);
+}
+
+int main(void) {
+  CHECK(MXTPUCAPIInit() == 0);
+  CHECK(MXTPUGetVersion() >= 200);
+  CHECK(MXTPUHasFeature("C_API") == 1);
+  CHECK(MXRandomSeed(0) == 0);
+  printf("init OK\n");
+
+  /* ---- imperative MLP forward: x(4,16) -> fc(32) -> relu -> fc(10) */
+  unsigned seed = 42;
+  NDArrayHandle x = randn(4, 16, &seed);
+  NDArrayHandle w1 = randn(32, 16, &seed);
+  NDArrayHandle w2 = randn(10, 32, &seed);
+  int64_t bshape1[1] = {32}, bshape2[1] = {10};
+  NDArrayHandle b1, b2;
+  CHECK(MXNDArrayCreate(bshape1, 1, 0, CPU, 0, &b1) == 0);
+  CHECK(MXNDArrayCreate(bshape2, 1, 0, CPU, 0, &b2) == 0);
+
+  const char* k1[] = {"num_hidden"};
+  const char* v1[] = {"32"};
+  NDArrayHandle fc1_in[] = {x, w1, b1};
+  NDArrayHandle h1[4];
+  int n_out = 0;
+  CHECK(MXImperativeInvoke("FullyConnected", fc1_in, 3, 1, k1, v1,
+                           &n_out, h1, 4) == 0);
+  CHECK(n_out == 1);
+
+  const char* ka[] = {"act_type"};
+  const char* va[] = {"relu"};
+  NDArrayHandle act_in[] = {h1[0]};
+  NDArrayHandle h2[4];
+  CHECK(MXImperativeInvoke("Activation", act_in, 1, 1, ka, va, &n_out,
+                           h2, 4) == 0);
+
+  const char* k2[] = {"num_hidden"};
+  const char* v2[] = {"10"};
+  NDArrayHandle fc2_in[] = {h2[0], w2, b2};
+  NDArrayHandle out[4];
+  CHECK(MXImperativeInvoke("FullyConnected", fc2_in, 3, 1, k2, v2,
+                           &n_out, out, 4) == 0);
+  CHECK(MXNDArrayWaitToRead(out[0]) == 0);
+
+  int ndim = 0;
+  int64_t shp[8];
+  CHECK(MXNDArrayGetShape(out[0], &ndim, shp, 8) == 0);
+  CHECK(ndim == 2 && shp[0] == 4 && shp[1] == 10);
+  int dt = -1;
+  CHECK(MXNDArrayGetDType(out[0], &dt) == 0);
+  CHECK(dt == 0);
+  check_finite(out[0], 40);
+  printf("imperative MLP forward OK\n");
+
+  /* ---- error ring: bogus op must fail with a message */
+  NDArrayHandle dummy[1];
+  int n_dummy;
+  CHECK(MXImperativeInvoke("definitely_not_an_op", fc1_in, 1, 0, NULL,
+                           NULL, &n_dummy, dummy, 1) == -1);
+  CHECK(strlen(MXTPUGetLastError()) > 0);
+  printf("error ring OK (%.40s...)\n", MXTPUGetLastError());
+
+  /* ---- symbolic: compose, infer shape, bind, forward, backward */
+  SymbolHandle sdata, sw, sb;
+  CHECK(MXSymbolCreateVariable("data", &sdata) == 0);
+  CHECK(MXSymbolCreateVariable("fc_weight", &sw) == 0);
+  CHECK(MXSymbolCreateVariable("fc_bias", &sb) == 0);
+  SymbolHandle fc_in[] = {sdata, sw, sb};
+  const char* fc_names[] = {"data", "weight", "bias"};
+  const char* ks[] = {"num_hidden"};
+  const char* vs[] = {"8"};
+  SymbolHandle fc;
+  CHECK(MXSymbolCompose("FullyConnected", "fc", fc_in, fc_names, 3, 1,
+                        ks, vs, &fc) == 0);
+
+  int argc_ = 0;
+  const char** argv_ = NULL;
+  CHECK(MXSymbolListArguments(fc, &argc_, &argv_) == 0);
+  CHECK(argc_ == 3);
+
+  const char* ishape = NULL;
+  CHECK(MXSymbolInferShape(fc, "{\"data\": [4, 16]}", &ishape) == 0);
+  CHECK(strstr(ishape, "[4, 8]") != NULL ||
+        strstr(ishape, "[4,8]") != NULL);
+
+  /* JSON round-trip */
+  const char* js = NULL;
+  CHECK(MXSymbolSaveToJSON(fc, &js) == 0);
+  char* js_copy = strdup(js);
+  SymbolHandle fc2;
+  CHECK(MXSymbolCreateFromJSON(js_copy, &fc2) == 0);
+  free(js_copy);
+
+  ExecutorHandle ex;
+  CHECK(MXExecutorSimpleBind(
+            fc2,
+            "{\"data\": [4, 16], \"fc_weight\": [8, 16], "
+            "\"fc_bias\": [8]}",
+            CPU, 0, "write", &ex) == 0);
+  NDArrayHandle xin = randn(4, 16, &seed);
+  CHECK(MXExecutorSetArg(ex, "data", xin) == 0);
+  NDArrayHandle eouts[4];
+  CHECK(MXExecutorForward(ex, 1, &n_out, eouts, 4) == 0);
+  CHECK(n_out == 1);
+  CHECK(MXNDArrayGetShape(eouts[0], &ndim, shp, 8) == 0);
+  CHECK(ndim == 2 && shp[0] == 4 && shp[1] == 8);
+
+  int64_t gshape[2] = {4, 8};
+  float gones[32];
+  for (int i = 0; i < 32; ++i) gones[i] = 1.0f;
+  NDArrayHandle ghead;
+  CHECK(MXNDArrayFromData(gshape, 2, 0, CPU, 0, gones, sizeof(gones),
+                          &ghead) == 0);
+  NDArrayHandle heads[] = {ghead};
+  CHECK(MXExecutorBackward(ex, heads, 1) == 0);
+  NDArrayHandle wgrad;
+  CHECK(MXExecutorGetGrad(ex, "fc_weight", &wgrad) == 0);
+  CHECK(MXNDArrayGetShape(wgrad, &ndim, shp, 8) == 0);
+  CHECK(ndim == 2 && shp[0] == 8 && shp[1] == 16);
+  check_finite(wgrad, 128);
+  printf("symbolic bind/forward/backward OK\n");
+
+  /* ---- KVStore: init, push, pull */
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  int64_t kshape[2] = {2, 2};
+  float kinit[4] = {1, 1, 1, 1};
+  float kpush[4] = {3, 3, 3, 3};
+  NDArrayHandle a_init, a_push, a_pull;
+  CHECK(MXNDArrayFromData(kshape, 2, 0, CPU, 0, kinit, sizeof(kinit),
+                          &a_init) == 0);
+  CHECK(MXNDArrayFromData(kshape, 2, 0, CPU, 0, kpush, sizeof(kpush),
+                          &a_push) == 0);
+  CHECK(MXNDArrayCreate(kshape, 2, 0, CPU, 0, &a_pull) == 0);
+  CHECK(MXKVStoreInit(kv, 7, a_init) == 0);
+  CHECK(MXKVStorePush(kv, 7, a_push) == 0);
+  CHECK(MXKVStorePull(kv, 7, a_pull) == 0);
+  float pulled[4];
+  CHECK(MXNDArraySyncCopyToCPU(a_pull, pulled, sizeof(pulled)) == 0);
+  for (int i = 0; i < 4; ++i) CHECK(fabsf(pulled[i] - 3.0f) < 1e-5f);
+  printf("kvstore OK\n");
+
+  /* ---- cleanup */
+  CHECK(MXNDArrayWaitAll() == 0);
+  NDArrayHandle nds[] = {x,  w1, w2, b1,    b2,     h1[0], h2[0],
+                         out[0], xin, ghead, wgrad, eouts[0],
+                         a_init, a_push, a_pull};
+  for (size_t i = 0; i < sizeof(nds) / sizeof(nds[0]); ++i)
+    CHECK(MXNDArrayFree(nds[i]) == 0);
+  CHECK(MXSymbolFree(sdata) == 0);
+  CHECK(MXSymbolFree(sw) == 0);
+  CHECK(MXSymbolFree(sb) == 0);
+  CHECK(MXSymbolFree(fc) == 0);
+  CHECK(MXSymbolFree(fc2) == 0);
+  CHECK(MXExecutorFree(ex) == 0);
+  CHECK(MXKVStoreFree(kv) == 0);
+
+  printf("C SMOKE TEST PASSED\n");
+  return 0;
+}

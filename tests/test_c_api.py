@@ -1,0 +1,146 @@
+"""Flat C API tests (parity: reference src/c_api/* surface, SURVEY.md
+§2.1 "C API").
+
+Two layers of coverage:
+- in-process: load libmxtpu.so via ctypes INTO this Python and drive the
+  C ABI directly (handles, error ring, invoke-by-name);
+- out-of-process: compile tests/c_smoke/mlp_smoke.c with gcc and run it
+  as a standalone C program embedding the interpreter — the
+  "non-Python frontend" story, reference cpp-package/c_predict_api
+  analog.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(),
+    reason="libmxtpu.so not built (run make -C src)")
+
+
+def _lib():
+    L = _native.lib
+    L.MXTPUCAPIInit.restype = ctypes.c_int
+    L.MXNDArrayFromData.restype = ctypes.c_int
+    L.MXNDArrayFromData.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p)]
+    L.MXNDArraySyncCopyToCPU.restype = ctypes.c_int
+    L.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    L.MXImperativeInvoke.restype = ctypes.c_int
+    L.MXImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+    L.MXNDArrayFree.restype = ctypes.c_int
+    L.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    L.MXTPUGetLastError.restype = ctypes.c_char_p
+    L.MXListOps.restype = ctypes.c_int
+    L.MXListOps.argtypes = [ctypes.POINTER(ctypes.c_int),
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    return L
+
+
+def _from_np(L, a):
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+    h = ctypes.c_void_p()
+    rc = L.MXNDArrayFromData(shape, a.ndim, 0, 1, 0,
+                             a.ctypes.data_as(ctypes.c_void_p),
+                             a.nbytes, ctypes.byref(h))
+    assert rc == 0, L.MXTPUGetLastError()
+    return h
+
+
+class TestInProcessCAPI:
+    def test_invoke_dot_roundtrip(self):
+        L = _lib()
+        assert L.MXTPUCAPIInit() == 0
+        a = np.random.RandomState(0).rand(4, 8).astype("f")
+        b = np.random.RandomState(1).rand(8, 3).astype("f")
+        ha, hb = _from_np(L, a), _from_np(L, b)
+        ins = (ctypes.c_void_p * 2)(ha, hb)
+        outs = (ctypes.c_void_p * 4)()
+        n = ctypes.c_int()
+        rc = L.MXImperativeInvoke(b"dot", ins, 2, 0, None, None,
+                                  ctypes.byref(n), outs, 4)
+        assert rc == 0, L.MXTPUGetLastError()
+        assert n.value == 1
+        got = np.empty((4, 3), "f")
+        rc = L.MXNDArraySyncCopyToCPU(
+            outs[0], got.ctypes.data_as(ctypes.c_void_p), got.nbytes)
+        assert rc == 0, L.MXTPUGetLastError()
+        np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+        for h in (ha, hb, outs[0]):
+            assert L.MXNDArrayFree(h) == 0
+
+    def test_string_params_parsed(self):
+        L = _lib()
+        x = np.full((2, 3), -1.5, "f")
+        hx = _from_np(L, x)
+        ins = (ctypes.c_void_p * 1)(hx)
+        outs = (ctypes.c_void_p * 4)()
+        n = ctypes.c_int()
+        keys = (ctypes.c_char_p * 1)(b"act_type")
+        vals = (ctypes.c_char_p * 1)(b"relu")
+        rc = L.MXImperativeInvoke(b"Activation", ins, 1, 1, keys, vals,
+                                  ctypes.byref(n), outs, 4)
+        assert rc == 0, L.MXTPUGetLastError()
+        got = np.empty((2, 3), "f")
+        assert L.MXNDArraySyncCopyToCPU(
+            outs[0], got.ctypes.data_as(ctypes.c_void_p),
+            got.nbytes) == 0
+        np.testing.assert_allclose(got, 0.0)
+        L.MXNDArrayFree(hx)
+        L.MXNDArrayFree(outs[0])
+
+    def test_error_ring(self):
+        L = _lib()
+        outs = (ctypes.c_void_p * 1)()
+        n = ctypes.c_int()
+        rc = L.MXImperativeInvoke(b"no_such_op", None, 0, 0, None, None,
+                                  ctypes.byref(n), outs, 1)
+        assert rc == -1
+        assert b"no_such_op" in L.MXTPUGetLastError()
+
+    def test_list_ops(self):
+        L = _lib()
+        count = ctypes.c_int()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        assert L.MXListOps(ctypes.byref(count), ctypes.byref(names)) == 0
+        ops = {names[i] for i in range(count.value)}
+        assert count.value > 150
+        assert b"dot" in ops and b"FullyConnected" in ops
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+class TestStandaloneCProgram:
+    def test_mlp_smoke(self, tmp_path):
+        exe = str(tmp_path / "mlp_smoke")
+        subprocess.run(
+            ["gcc", "-O1", "-Wall", "-I", os.path.join(REPO, "include"),
+             "-o", exe, os.path.join(REPO, "tests/c_smoke/mlp_smoke.c"),
+             "-L", os.path.join(REPO, "mxnet_tpu/lib"), "-lmxtpu",
+             f"-Wl,-rpath,{os.path.join(REPO, 'mxnet_tpu/lib')}"],
+            check=True)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        site = os.path.dirname(os.path.dirname(np.__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO, site] + sys.path[1:])
+        out = subprocess.run([exe], env=env, capture_output=True,
+                             text=True, timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "C SMOKE TEST PASSED" in out.stdout
