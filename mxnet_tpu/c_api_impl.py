@@ -63,20 +63,27 @@ def _parse_param(v: str):
 
 # -- NDArray ----------------------------------------------------------------
 
-def ndarray_create(shape, dtype_code_, ctx_type, ctx_id):
+def _ctx(ctx_type: int, ctx_id: int):
+    """ctx codes (include/mxtpu/c_api.h): 1=cpu 2=tpu."""
     import mxnet_tpu as mx
+    if ctx_type == 1:
+        return mx.cpu(ctx_id)
+    if ctx_type == 2:
+        return mx.tpu(ctx_id)
+    raise ValueError(f"unknown ctx_type {ctx_type}")
+
+
+def ndarray_create(shape, dtype_code_, ctx_type, ctx_id):
     from mxnet_tpu import nd
-    ctx = (mx.tpu(ctx_id) if ctx_type == 2 else mx.cpu(ctx_id))
-    return nd.zeros(tuple(shape), ctx=ctx, dtype=_dtype_name(dtype_code_))
+    return nd.zeros(tuple(shape), ctx=_ctx(ctx_type, ctx_id),
+                    dtype=_dtype_name(dtype_code_))
 
 
 def ndarray_from_bytes(shape, dtype_code_, data: bytes, ctx_type, ctx_id):
-    import mxnet_tpu as mx
     from mxnet_tpu import nd
-    ctx = (mx.tpu(ctx_id) if ctx_type == 2 else mx.cpu(ctx_id))
     a = np.frombuffer(data, dtype=_dtype_name(dtype_code_)).reshape(
         tuple(shape)).copy()
-    return nd.array(a, ctx=ctx, dtype=a.dtype)
+    return nd.array(a, ctx=_ctx(ctx_type, ctx_id), dtype=a.dtype)
 
 
 def ndarray_to_bytes(arr) -> bytes:
@@ -182,10 +189,9 @@ def symbol_invoke(op_name: str, in_syms, in_names, name, keys, vals):
 
 def executor_simple_bind_json(s, shapes_json: str, ctx_type, ctx_id,
                               grad_req: str):
-    import mxnet_tpu as mx
-    ctx = (mx.tpu(ctx_id) if ctx_type == 2 else mx.cpu(ctx_id))
     shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
-    return s.simple_bind(ctx=ctx, grad_req=grad_req, **shapes)
+    return s.simple_bind(ctx=_ctx(ctx_type, ctx_id), grad_req=grad_req,
+                         **shapes)
 
 
 def executor_arg_dict(ex):

@@ -54,16 +54,20 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
 
 
 def _flash_viable(q, k):
-    """Pallas kernel needs TPU + tile-aligned head_dim/seq."""
+    """Pallas kernel needs TPU (or interpret mode) + 128-aligned seq
+    lens; head_dim only needs 8-alignment — the kernel zero-pads it to
+    the 128 lane width, so BERT's d=64 takes the flash path."""
     if os.environ.get("MXTPU_DISABLE_FLASH"):
         return False
-    try:
-        if jax.default_backend() != "tpu":
+    from . import flash_attention as fa
+    if not fa._INTERPRET:
+        try:
+            if jax.default_backend() != "tpu":
+                return False
+        except Exception:
             return False
-    except Exception:
-        return False
     d = q.shape[-1]
-    return d % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+    return d % 8 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
 
 
 @register("interleaved_matmul_selfatt_qk", num_inputs=1)

@@ -14,6 +14,7 @@ on the forward pass, which is where long-context runs die).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -23,10 +24,15 @@ __all__ = ["flash_attention"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
+_LANE = 128  # TPU lane width: head_dim is zero-padded up to this
+
+# interpret mode runs the kernel on the Pallas interpreter (any backend)
+# — used by the CPU test suite; toggled via tests or MXTPU_FLASH_INTERPRET
+_INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, num_k_blocks):
+                scale, causal, num_k_blocks, causal_offset):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block loop lives in the GRID (innermost dim, sequential on TPU)
@@ -54,11 +60,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
                 preferred_element_type=jnp.float32) * scale
     if causal:
+        # end-aligned like the XLA oracle's tril(k=s_k-s_q): query i may
+        # attend keys up to i + (s_k - s_q), so cross-attention with
+        # s_k != s_q masks identically on both paths
         q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, -1e30)
+        s = jnp.where(q_pos + np.int32(causal_offset) >= k_pos, s, -1e30)
 
     # m/l scratch is (block_q, 128): TPU vector stores need a full lane
     # dim; value is replicated across lanes, column 0 is authoritative
@@ -82,12 +91,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal):
-    """q,k,v: (B, S, H, D) → out (B, S, H, D)."""
+    """q,k,v: (B, S, H, D) → out (B, S, H, D).
+
+    head_dim < 128 (e.g. BERT's 64) is zero-padded up to the lane
+    width: QKᵀ contracts over D so zero columns don't change scores,
+    and PV leaves the padded output columns zero — sliced off at the
+    end.  XLA would pad the minor dim to 128 on the MXU anyway, so the
+    padding costs ~nothing on chip.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s_q, h, d = q.shape
+    b, s_q, h, d_orig = q.shape
     s_k = k.shape[1]
+    pad = (-d_orig) % _LANE
+    if pad:
+        widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    d = d_orig + pad
     # fold batch×head, make seq-major: (B*H, S, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
@@ -96,7 +119,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
     num_k_blocks = s_k // _BLOCK_K
     grid = (b * h, s_q // _BLOCK_Q, num_k_blocks)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               num_k_blocks=num_k_blocks)
+                               num_k_blocks=num_k_blocks,
+                               causal_offset=s_k - s_q)
     # NOTE on index maps: with jax_enable_x64 a literal `0` in an index
     # map becomes i64 and Mosaic rejects the mixed (i32, i64) signature;
     # `i - i` keeps everything i32 regardless of the x64 flag.
@@ -120,8 +144,12 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
             pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
             pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(qf, kf, vf)
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    if pad:
+        out = out[..., :d_orig]
+    return out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))

@@ -38,3 +38,13 @@ def _seeded():
 def pytest_configure(config):
     config.addinivalue_line("markers", "tpu: needs the real TPU chip")
     config.addinivalue_line("markers", "slow: long-running")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("MXTPU_TEST_ON_TPU"):
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="needs real TPU (set MXTPU_TEST_ON_TPU=1)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)
