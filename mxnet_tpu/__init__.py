@@ -43,6 +43,8 @@ from . import gluon
 from . import test_utils
 from . import kvstore
 from . import kvstore as kv
+from . import numpy as np  # noqa: shadow of builtin numpy is the parity point
+from . import numpy_extension as npx
 from . import parallel
 from . import symbol
 from . import symbol as sym

@@ -94,16 +94,27 @@ def predict_mode() -> _Scope:
 
 
 class _Node:
-    """One recorded op: holds the vjp closure and graph structure."""
+    """One recorded op: holds the vjp closure and graph structure.
 
-    __slots__ = ("vjp_fn", "inputs", "n_extra", "outputs", "out_avals")
+    ``fcompute`` (the kwargs-bound forward fn) + ``extras`` (trailing
+    scalar-attr arrays) make the node REPLAYABLE as a pure function —
+    the basis of ``create_graph=True`` higher-order gradients, which
+    rebuild the forward subgraph functionally and differentiate it
+    again (reference: test_higher_order_grad.py capability).
+    """
 
-    def __init__(self, vjp_fn, inputs, n_extra, out_avals):
+    __slots__ = ("vjp_fn", "inputs", "n_extra", "outputs", "out_avals",
+                 "fcompute", "extras")
+
+    def __init__(self, vjp_fn, inputs, n_extra, out_avals,
+                 fcompute=None, extras=()):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # NDArray refs (graph edges)
         self.n_extra = n_extra        # trailing scalar-attr arrays
         self.outputs = []             # filled by invoke's _wrap_outputs
         self.out_avals = out_avals
+        self.fcompute = fcompute      # kwargs-bound forward (replayable)
+        self.extras = extras          # the trailing scalar arrays
 
 
 def _record_op(op, kwargs, all_arrays, inputs):
@@ -124,7 +135,9 @@ def _record_op(op, kwargs, all_arrays, inputs):
         avals = [o.aval for o in outputs_data]
     else:
         avals = [outputs_data.aval]
-    node = _Node(vjp_fn, list(inputs), len(all_arrays) - len(inputs), avals)
+    n_in = len(inputs)
+    node = _Node(vjp_fn, list(inputs), len(all_arrays) - n_in, avals,
+                 fcompute=bound, extras=tuple(all_arrays[n_in:]))
     return node, outputs_data
 
 
@@ -224,23 +237,85 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             arr._grad._set_data(g.astype(arr._grad.dtype))
 
 
+def _grad_create_graph(heads, variables, head_grads):
+    """Higher-order gradients: replay the recorded subgraph as a pure
+    function of ``variables``, vjp it, and RECORD the grad computation
+    as a new tape node — so the returned grads are themselves
+    differentiable (to arbitrary order: the new node's ``fcompute`` is
+    the grad function, hence replayable again)."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    order = _toposort(heads)
+    for n in order:
+        if n.fcompute is None:
+            raise MXNetError("create_graph=True through a custom "
+                             "autograd.Function is not supported")
+    var_objs = list(variables)
+    head_cots = []
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            head_cots.append(jnp.ones(h.shape, h.dtype))
+        elif isinstance(hg, NDArray):
+            head_cots.append(hg._data)
+        else:
+            head_cots.append(hg)
+
+    def replay(var_vals):
+        env = {id(v): val for v, val in zip(var_objs, var_vals)}
+        for node in order:
+            args = [env.get(id(inp), inp._data) for inp in node.inputs]
+            args += list(node.extras)
+            out = node.fcompute(*args)
+            outs = out if isinstance(out, tuple) else (out,)
+            for o, val in zip(node.outputs, outs):
+                env[id(o)] = val
+        return tuple(env.get(id(h), h._data) for h in heads)
+
+    single = len(var_objs) == 1
+
+    def gradfn(*var_vals):
+        _, vjp = jax.vjp(lambda *vv: replay(list(vv)), *var_vals)
+        gs = vjp(tuple(head_cots))
+        gs = tuple(
+            jnp.zeros(v.shape, v.dtype) if _is_float0(g) else g
+            for g, v in zip(gs, var_objs))
+        # tape convention: single-output nodes carry a bare array
+        return gs[0] if single else gs
+
+    var_vals = [v._data for v in var_objs]
+    outputs_data, vjp_fn = jax.vjp(gradfn, *var_vals)
+    if single:
+        outputs_data = (outputs_data,)
+    node = _Node(vjp_fn, var_objs, 0,
+                 [o.aval for o in outputs_data],
+                 fcompute=gradfn, extras=())
+    outs = []
+    for i, (od, v) in enumerate(zip(outputs_data, var_objs)):
+        g_nd = NDArray(od, ctx=v._ctx)
+        g_nd._ag_node = node
+        g_nd._ag_out_idx = i
+        node.outputs.append(g_nd)
+        outs.append(g_nd)
+    return outs
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Parity: ``autograd.grad`` — returns grads instead of writing .grad."""
     from .ndarray.ndarray import NDArray
     import jax.numpy as jnp
-    if create_graph:
-        raise NotImplementedError("create_graph=True (higher-order grad "
-                                  "through autograd.grad) lands with the "
-                                  "higher-order-grad milestone")
     heads = heads if isinstance(heads, (list, tuple)) else [heads]
     variables = variables if isinstance(variables, (list, tuple)) \
         else [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads)
     for v in variables:
         if v._grad is None:
             v.attach_grad()
-    if head_grads is None:
-        head_grads = [None] * len(heads)
     retain = bool(retain_graph) if retain_graph is not None else False
     leaf_grads = _run_backward(heads, head_grads, retain)
     outs = []

@@ -169,8 +169,20 @@ class NDArray:
 
     # -- sync points ------------------------------------------------------
     def asnumpy(self) -> np.ndarray:
-        """Copy to host; THE sync point (parity: WaitToRead + copy)."""
-        return np.asarray(self._data)
+        """Copy to host; THE sync point (parity: WaitToRead + copy).
+
+        Async device-side failures (the op was dispatched long ago)
+        surface HERE as MXNetError — the reference engine's
+        exception-teleporting contract (test_exc_handling.py upstream).
+        """
+        try:
+            return np.asarray(self._data)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                f"async execution error surfaced at asnumpy(): {e}"
+            ) from e
 
     def asscalar(self):
         if self.size != 1:
@@ -181,7 +193,14 @@ class NDArray:
         return self.asscalar()
 
     def wait_to_read(self):
-        _jax().block_until_ready(self._data)
+        try:
+            _jax().block_until_ready(self._data)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError(
+                f"async execution error surfaced at wait_to_read(): {e}"
+            ) from e
 
     def wait_to_write(self):
         self.wait_to_read()

@@ -1,0 +1,300 @@
+"""``mx.np`` — NumPy-compatible namespace (SURVEY.md §2.5 "NDArray API":
+reference ``python/mxnet/numpy/`` + ``mx.np`` 1.6+).
+
+Semantics differences from ``mx.nd`` (deliberate, matching the
+reference's split):
+- NumPy dtype PROMOTION (int32+int64→int64, int/2.0→float) instead of
+  MXNet's float32-default rules — computed via ``np.result_type``;
+- ``array()`` preserves the input's dtype instead of defaulting to f32;
+- operators broadcast automatically (mx.nd needs broadcast_* in symbol
+  mode).
+
+Every function routes through the op registry/invoke seam, so autograd
+records and the per-op jit cache applies — same engine, different
+dtype rules (the reference reuses its engine the same way).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, invoke
+from ..ops.registry import OpDef
+
+__all__ = [
+    "array", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "eye", "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "mod", "power", "maximum", "minimum", "matmul",
+    "dot", "exp", "log", "log2", "log10", "sin", "cos", "tan", "tanh",
+    "sinh", "cosh", "arcsin", "arccos", "arctan", "sqrt", "cbrt",
+    "abs", "absolute", "negative", "sign", "floor", "ceil", "square",
+    "reciprocal", "expm1", "log1p", "sum", "mean", "max", "min",
+    "prod", "std", "var", "argmax", "argmin", "reshape", "transpose",
+    "expand_dims", "squeeze", "concatenate", "stack", "split", "where",
+    "clip", "equal", "not_equal", "less", "less_equal", "greater",
+    "greater_equal", "logical_and", "logical_or", "logical_not",
+    "tensordot", "einsum", "swapaxes", "moveaxis", "tile", "repeat",
+    "broadcast_to", "cumsum",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# dedicated OpDefs (NOT in the global registry: np semantics must not
+# leak into mx.nd/mx.sym name lookup); scalar_ref_input=None so invoke
+# never coerces our pre-promoted operands
+@functools.lru_cache(maxsize=None)
+def _opdef(name: str, n_inputs) -> OpDef:
+    fn = getattr(_jnp(), name)
+    return OpDef(f"_np_{name}", fn, n_inputs, 1, (), False, None)
+
+
+def _as_nd(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x.astype(dtype) if dtype is not None and \
+            _onp.dtype(x.dtype) != _onp.dtype(dtype) else x
+    a = _onp.asarray(x, dtype=dtype)
+    return NDArray.from_numpy(a) if hasattr(NDArray, "from_numpy") \
+        else _from_np(a)
+
+
+def _from_np(a):
+    from ..ndarray import ndarray as nd_mod
+    return nd_mod.array(a, dtype=a.dtype)
+
+
+def _promote(*xs):
+    """NumPy-rules common dtype across NDArray and python operands."""
+    parts = []
+    for x in xs:
+        if isinstance(x, NDArray):
+            parts.append(_onp.dtype(x.dtype))
+        else:
+            parts.append(x if _onp.isscalar(x) else _onp.asarray(x))
+    rt = _onp.result_type(*parts)
+    return [_as_nd(x, dtype=rt) for x in xs], rt
+
+
+def _unary(name):
+    def f(x, **kw):
+        x = _as_nd(x)
+        return invoke(_opdef(name, 1), [x], **kw)
+    f.__name__ = name
+    f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
+    return f
+
+
+def _unary_float(name):
+    """Unary transcendental: ints promote to float64 (NumPy rule)."""
+    def f(x, **kw):
+        x = _as_nd(x)
+        if _onp.dtype(x.dtype).kind in "iub":
+            x = x.astype("float64" if _np_x64() else "float32")
+        return invoke(_opdef(name, 1), [x], **kw)
+    f.__name__ = name
+    f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
+    return f
+
+
+def _np_x64():
+    import jax
+    return bool(jax.config.read("jax_enable_x64"))
+
+
+def _binary(name, promote=True):
+    def f(a, b, **kw):
+        if promote:
+            (a, b), _ = _promote(a, b)
+        else:
+            a, b = _as_nd(a), _as_nd(b)
+        return invoke(_opdef(name, 2), [a, b], **kw)
+    f.__name__ = name
+    f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_variadic(name: str) -> OpDef:
+    jf = getattr(_jnp(), name)
+
+    def fc(*arrays, **kw):
+        # jnp.concatenate/stack take ONE sequence argument
+        return jf(list(arrays), **kw)
+
+    return OpDef(f"_np_{name}", fc, None, 1, (), False, None)
+
+
+def _variadic(name):
+    def f(arrays, **kw):
+        arrays = [_as_nd(a) for a in arrays]
+        return invoke(_opdef_variadic(name), list(arrays), **kw)
+    f.__name__ = name
+    f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
+    return f
+
+
+# -- creation ---------------------------------------------------------------
+
+def array(obj, dtype=None, ctx=None):
+    """np.array parity: PRESERVES the input dtype (mx.nd defaults f32)."""
+    a = _onp.asarray(obj, dtype=dtype)
+    from ..ndarray import ndarray as nd_mod
+    return nd_mod.array(a, ctx=ctx, dtype=a.dtype)
+
+
+def zeros(shape, dtype="float32", ctx=None):
+    from ..ndarray import ndarray as nd_mod
+    return nd_mod.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def ones(shape, dtype="float32", ctx=None):
+    from ..ndarray import ndarray as nd_mod
+    return nd_mod.ones(shape, ctx=ctx, dtype=dtype)
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    if dtype is None:
+        dtype = _onp.result_type(fill_value)
+    return array(_onp.full(shape, fill_value, dtype=dtype), ctx=ctx)
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return array(_onp.arange(start, stop, step, dtype=dtype), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    return array(_onp.linspace(start, stop, num, endpoint=endpoint,
+                               dtype=dtype), ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return array(_onp.eye(N, M, k, dtype=dtype), ctx=ctx)
+
+
+# -- arithmetic (NumPy promotion) -------------------------------------------
+
+add = _binary("add")
+subtract = _binary("subtract")
+multiply = _binary("multiply")
+power = _binary("power")
+maximum = _binary("maximum")
+minimum = _binary("minimum")
+mod = _binary("mod")
+floor_divide = _binary("floor_divide")
+matmul = _binary("matmul", promote=False)
+dot = _binary("dot", promote=False)
+arctan2 = _binary("arctan2")
+hypot = _binary("hypot")
+
+
+def divide(a, b, **kw):
+    """NumPy true division: integer inputs produce float output."""
+    (a, b), rt = _promote(a, b)
+    if _onp.dtype(rt).kind in "iub":
+        ft = "float64" if _np_x64() else "float32"
+        a, b = a.astype(ft), b.astype(ft)
+    return invoke(_opdef("divide", 2), [a, b], **kw)
+
+
+true_divide = divide
+
+equal = _binary("equal")
+not_equal = _binary("not_equal")
+less = _binary("less")
+less_equal = _binary("less_equal")
+greater = _binary("greater")
+greater_equal = _binary("greater_equal")
+logical_and = _binary("logical_and")
+logical_or = _binary("logical_or")
+logical_not = _unary("logical_not")
+
+# -- elementwise ------------------------------------------------------------
+
+exp = _unary_float("exp")
+log = _unary_float("log")
+log2 = _unary_float("log2")
+log10 = _unary_float("log10")
+log1p = _unary_float("log1p")
+expm1 = _unary_float("expm1")
+sin = _unary_float("sin")
+cos = _unary_float("cos")
+tan = _unary_float("tan")
+tanh = _unary_float("tanh")
+sinh = _unary_float("sinh")
+cosh = _unary_float("cosh")
+arcsin = _unary_float("arcsin")
+arccos = _unary_float("arccos")
+arctan = _unary_float("arctan")
+sqrt = _unary_float("sqrt")
+cbrt = _unary_float("cbrt")
+reciprocal = _unary_float("reciprocal")
+abs = _unary("abs")
+absolute = abs
+negative = _unary("negative")
+sign = _unary("sign")
+floor = _unary("floor")
+ceil = _unary("ceil")
+square = _unary("square")
+
+# -- reductions -------------------------------------------------------------
+
+sum = _unary("sum")
+mean = _unary("mean")
+max = _unary("max")
+min = _unary("min")
+prod = _unary("prod")
+std = _unary("std")
+var = _unary("var")
+argmax = _unary("argmax")
+argmin = _unary("argmin")
+cumsum = _unary("cumsum")
+
+# -- shape ------------------------------------------------------------------
+
+reshape = _unary("reshape")
+transpose = _unary("transpose")
+expand_dims = _unary("expand_dims")
+squeeze = _unary("squeeze")
+swapaxes = _unary("swapaxes")
+moveaxis = _unary("moveaxis")
+tile = _unary("tile")
+repeat = _unary("repeat")
+broadcast_to = _unary("broadcast_to")
+clip = _unary("clip")
+
+concatenate = _variadic("concatenate")
+stack = _variadic("stack")
+
+
+def split(x, indices_or_sections, axis=0):
+    x = _as_nd(x)
+    jnp = _jnp()
+    parts = jnp.split(x._data, indices_or_sections, axis=axis)
+    return [NDArray(p, ctx=x._ctx) for p in parts]
+
+
+def where(cond, a, b):
+    cond = _as_nd(cond)
+    (a, b), _ = _promote(a, b)
+    return invoke(_opdef("where", 3), [cond, a, b])
+
+
+def tensordot(a, b, axes=2):
+    a, b = _as_nd(a), _as_nd(b)
+    return invoke(_opdef("tensordot", 2), [a, b], axes=axes)
+
+
+def einsum(subscripts, *operands):
+    ops = [_as_nd(o) for o in operands]
+    jnp = _jnp()
+    out = jnp.einsum(subscripts, *[o._data for o in ops])
+    return NDArray(out, ctx=ops[0]._ctx if ops else None)

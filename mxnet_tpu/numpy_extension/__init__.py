@@ -1,0 +1,65 @@
+"""``mx.npx`` — numpy-extension namespace (SURVEY.md §2.5: reference
+``python/mxnet/numpy_extension`` / ``npx``): NN ops under numpy
+semantics plus the np-mode switches."""
+from __future__ import annotations
+
+import threading
+
+from ..ndarray.ndarray import NDArray, invoke
+from ..ops.registry import get_op
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "relu", "sigmoid", "softmax", "log_softmax", "waitall",
+           "one_hot"]
+
+_state = threading.local()
+
+
+def set_np(shape=True, array=True):
+    """Enable numpy semantics flags (parity shim: our arrays already
+    support zero-dim/zero-size shapes natively via XLA)."""
+    _state.np_shape = bool(shape)
+    _state.np_array = bool(array)
+
+
+def reset_np():
+    _state.np_shape = False
+    _state.np_array = False
+
+
+def is_np_array() -> bool:
+    return getattr(_state, "np_array", False)
+
+
+def is_np_shape() -> bool:
+    return getattr(_state, "np_shape", False)
+
+
+def _invoke1(op_name, x, **kw):
+    return invoke(get_op(op_name), [x], **kw)
+
+
+def relu(x):
+    return _invoke1("relu", x)
+
+
+def sigmoid(x):
+    return _invoke1("sigmoid", x)
+
+
+def softmax(x, axis=-1):
+    return _invoke1("softmax", x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return _invoke1("log_softmax", x, axis=axis)
+
+
+def one_hot(x, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _invoke1("one_hot", x, depth=depth, on_value=on_value,
+                    off_value=off_value, dtype=dtype)
+
+
+def waitall():
+    from ..ndarray import ndarray as nd_mod
+    nd_mod.waitall()
