@@ -1,0 +1,89 @@
+"""KVStore allreduce bandwidth harness.
+
+Parity model: the reference's ``tools/bandwidth/measure.py``, which
+exists precisely to measure kvstore push/pull bandwidth (SURVEY.md §6,
+BASELINE.md metric #3 "KVStore allreduce GB/s").
+
+Measures the eager kvstore-style allreduce (``parallel.collectives.
+allreduce`` — jitted shard_map psum, one shard per mesh device) across a
+sweep of tensor sizes and reports algorithmic bus bandwidth::
+
+    busbw = 2 * (n-1)/n * bytes / time      (ring-allreduce accounting)
+
+Run on the real chip (mesh=1: measures device<->HBM round trip only) or
+on the virtual CPU mesh::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmark/allreduce_bench.py
+
+Prints one JSON line per size and a trailing summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_allreduce(sizes_mb, iters=10):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = parallel.make_mesh({"dp": n}, devices=devs)
+    ctxs = [mx.Context("tpu" if devs[0].platform == "tpu" else "cpu", i)
+            for i in range(n)]
+
+    rows = []
+    for mb in sizes_mb:
+        elems = int(mb * 1e6 / 4)
+        shards = [nd.array(np.full((elems,), i + 1, "f4"), ctx=ctxs[i])
+                  for i in range(n)]
+        # warm (compiles the shard_map for this shape)
+        out = parallel.collectives.allreduce(shards, axis="dp", mesh=mesh)
+        out[0].wait_to_read()
+        # block every iteration: overlapping in-flight collectives can
+        # wedge the XLA:CPU in-process rendezvous, and for bandwidth
+        # sizes the per-call sync cost is in the noise
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = parallel.collectives.allreduce(shards, axis="dp",
+                                                 mesh=mesh)
+            for o in out:
+                o.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+
+        expect = n * (n + 1) / 2
+        assert abs(float(out[0].asnumpy()[0]) - expect) < 1e-3
+
+        nbytes = elems * 4
+        busbw = (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9
+        row = {"size_mb": mb, "n_devices": n,
+               "time_ms": round(dt * 1e3, 3),
+               "busbw_gbps": round(busbw, 2)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows, n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes-mb", default="1,4,16,64",
+                    help="comma-separated tensor sizes in MB")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+    rows, n = bench_allreduce(sizes, iters=args.iters)
+    peak = max(r["busbw_gbps"] for r in rows)
+    print(json.dumps({"summary": "allreduce", "n_devices": n,
+                      "peak_busbw_gbps": peak}), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""opperf-style per-op micro-benchmarks.
+
+Parity model: the reference's ``benchmark/opperf/`` harness
+(SURVEY.md §6) — per-operator timing with warmup, plus the two numbers
+the reference harness cannot give you but a JAX-backed dispatch layer
+must be honest about:
+
+* ``dispatch_us`` — per-call host overhead on the compile-cache **hit**
+  path (tiny tensors: the op executes in ~0 device time, so the wall
+  time is the imperative dispatch layer itself — the analogue of the
+  reference engine's Push/OnComplete bookkeeping cost that motivated
+  CachedOp bulking).
+* ``compile_ms`` — the compile-cache **miss** cost: first invocation on
+  a fresh shape, i.e. trace + XLA compile + execute.
+* ``large_ms``/``gflops`` — device throughput on a big shape, where the
+  MXU/VPU should dominate and dispatch overhead should vanish.
+
+Usage::
+
+    python benchmark/opperf.py [--ops add,dot,...] [--json out.json]
+
+Runs on whatever backend JAX resolves (pin ``JAX_PLATFORMS=cpu`` for the
+host backend).  Prints one JSON line per op and a trailing summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _now():
+    return time.perf_counter()
+
+
+class OpBench:
+    def __init__(self, name, small_fn, large_fn, fresh_fn, flops=0):
+        self.name = name
+        self.small_fn = small_fn    # tiny shapes: dispatch overhead
+        self.large_fn = large_fn    # big shapes: device throughput
+        self.fresh_fn = fresh_fn    # fn(k) -> thunk on a never-seen shape
+        self.flops = flops          # flops of one large_fn call (0 = n/a)
+
+
+def _build_ops(ctx):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng_small = nd.ones((8, 8), ctx=ctx)
+    rng_small2 = nd.ones((8, 8), ctx=ctx)
+    big = nd.ones((2048, 2048), ctx=ctx)
+    big2 = nd.ones((2048, 2048), ctx=ctx)
+    vec = nd.ones((4, 1024), ctx=ctx)
+    img = nd.ones((8, 16, 32, 32), ctx=ctx)
+    wconv = nd.ones((32, 16, 3, 3), ctx=ctx)
+    bconv = nd.zeros((32,), ctx=ctx)
+    wfc = nd.ones((512, 1024), ctx=ctx)
+    bfc = nd.zeros((512,), ctx=ctx)
+    wfc_s = nd.ones((4, 8), ctx=ctx)
+    bfc_s = nd.zeros((4,), ctx=ctx)
+    img_s = nd.ones((1, 2, 8, 8), ctx=ctx)
+    wconv_s = nd.ones((2, 2, 3, 3), ctx=ctx)
+    bconv_s = nd.zeros((2,), ctx=ctx)
+
+    n = 2048
+    matmul_flops = 2 * n * n * n
+    conv_flops = 2 * 8 * 32 * 32 * 32 * 16 * 3 * 3
+
+    _salt = iter(range(0, 10000, 101))
+
+    def fresh(opname):
+        # prime-ish never-repeating dims (salted per bench entry so two
+        # entries sharing an opname still miss) force a compile-cache miss
+        salt = next(_salt)
+
+        def make(k):
+            a = nd.ones((61 + salt + 2 * k, 67 + salt + 2 * k), ctx=ctx)
+            b = nd.ones((61 + salt + 2 * k, 67 + salt + 2 * k), ctx=ctx)
+            op = getattr(nd, opname)
+            if opname in ("broadcast_add", "broadcast_mul"):
+                return lambda: op(a, b)
+            return lambda: op(a)
+        return make
+
+    ops = [
+        OpBench("broadcast_add",
+                lambda: nd.broadcast_add(rng_small, rng_small2),
+                lambda: nd.broadcast_add(big, big2),
+                fresh("broadcast_add")),
+        OpBench("broadcast_mul",
+                lambda: nd.broadcast_mul(rng_small, rng_small2),
+                lambda: nd.broadcast_mul(big, big2),
+                fresh("broadcast_mul")),
+        OpBench("exp",
+                lambda: nd.exp(rng_small),
+                lambda: nd.exp(big),
+                fresh("exp")),
+        OpBench("sum",
+                lambda: nd.sum(rng_small),
+                lambda: nd.sum(big),
+                fresh("sum")),
+        OpBench("transpose",
+                lambda: nd.transpose(rng_small),
+                lambda: nd.transpose(big),
+                fresh("transpose")),
+        OpBench("softmax",
+                lambda: nd.softmax(rng_small),
+                lambda: nd.softmax(big),
+                fresh("softmax")),
+        OpBench("dot",
+                lambda: nd.dot(rng_small, rng_small2),
+                lambda: nd.dot(big, big2),
+                fresh("exp"), flops=matmul_flops),
+        OpBench("FullyConnected",
+                lambda: nd.FullyConnected(rng_small, wfc_s, bfc_s,
+                                          num_hidden=4),
+                lambda: nd.FullyConnected(vec, wfc, bfc, num_hidden=512),
+                fresh("relu"), flops=2 * 4 * 1024 * 512),
+        OpBench("Convolution",
+                lambda: nd.Convolution(img_s, wconv_s, bconv_s,
+                                       kernel=(3, 3), num_filter=2,
+                                       pad=(1, 1)),
+                lambda: nd.Convolution(img, wconv, bconv, kernel=(3, 3),
+                                       num_filter=32, pad=(1, 1)),
+                fresh("sum"), flops=conv_flops),
+    ]
+    return ops
+
+
+def bench_op(op, hit_iters=200, large_iters=10):
+    import mxnet_tpu as mx
+
+    # warm both cache entries
+    op.small_fn().wait_to_read()
+    op.large_fn().wait_to_read()
+
+    # cache-hit dispatch overhead: tiny tensors, so wall ≈ host dispatch
+    t0 = _now()
+    for _ in range(hit_iters):
+        out = op.small_fn()
+    out.wait_to_read()
+    mx.nd.waitall()
+    dispatch_us = (_now() - t0) / hit_iters * 1e6
+
+    # cache-miss (compile) cost: average over 3 never-seen shapes
+    miss = []
+    for k in range(3):
+        thunk = op.fresh_fn(k)
+        t0 = _now()
+        thunk().wait_to_read()
+        miss.append((_now() - t0) * 1e3)
+    compile_ms = sum(miss) / len(miss)
+
+    # large-shape throughput
+    t0 = _now()
+    for _ in range(large_iters):
+        out = op.large_fn()
+    out.wait_to_read()
+    mx.nd.waitall()
+    large_ms = (_now() - t0) / large_iters * 1e3
+
+    row = {"op": op.name,
+           "dispatch_us": round(dispatch_us, 1),
+           "compile_ms": round(compile_ms, 1),
+           "large_ms": round(large_ms, 3)}
+    if op.flops:
+        row["gflops"] = round(op.flops / (large_ms * 1e-3) / 1e9, 1)
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ops", default="",
+                    help="comma-separated subset of op names")
+    ap.add_argument("--json", default="", help="write full results here")
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    print(f"# opperf on {ctx} (platform="
+          f"{ctx.device.platform})", file=sys.stderr)
+
+    ops = _build_ops(ctx)
+    if args.ops:
+        keep = set(args.ops.split(","))
+        ops = [o for o in ops if o.name in keep]
+
+    rows = []
+    for op in ops:
+        row = bench_op(op)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    avg_dispatch = sum(r["dispatch_us"] for r in rows) / max(len(rows), 1)
+    summary = {"summary": "opperf", "n_ops": len(rows),
+               "avg_dispatch_us": round(avg_dispatch, 1)}
+    print(json.dumps(summary), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=2)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

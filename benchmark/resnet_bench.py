@@ -1,0 +1,101 @@
+"""ResNet-50 images/sec macro benchmark (BASELINE.md metric #2).
+
+Parity model: the reference's
+``example/image-classification/benchmark_score.py`` (inference img/s
+across nets) plus its training-speed tables.  Hybridized whole-graph XLA
+on synthetic ImageNet-shaped data, bf16 matmuls via AMP.
+
+Usage::
+
+    python benchmark/resnet_bench.py [--model resnet50_v1]
+        [--batch 64] [--train] [--steps 20]
+
+On the CPU backend a tiny image size is substituted so the bench stays a
+smoke test; the real number comes from the chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench(model_name, batch, image_size, steps, warmup, train):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    on_tpu = bool(mx.num_tpus())
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+
+    net = vision.get_model(model_name, classes=1000)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+
+    x = mx.nd.array(
+        np.random.rand(batch, 3, image_size, image_size).astype("f4"),
+        ctx=ctx)
+
+    if train:
+        y = mx.nd.array(np.random.randint(0, 1000, batch).astype("f4"),
+                        ctx=ctx)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05}, kvstore=None)
+
+        def step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch)
+            return loss
+    else:
+        def step():
+            return net(x)
+
+    for _ in range(warmup):
+        out = step()
+    mx.nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step()
+    out.wait_to_read()
+    mx.nd.waitall()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, on_tpu
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="0 = auto (64 on tpu, 8 on cpu)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--train", action="store_true",
+                    help="fwd+bwd+update instead of inference")
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    on_tpu = bool(mx.num_tpus())
+    batch = args.batch or (64 if on_tpu else 8)
+    image_size = 224 if on_tpu else 64
+
+    print(f"# {args.model} {'train' if args.train else 'inference'} "
+          f"batch={batch} image={image_size} tpu={on_tpu}",
+          file=sys.stderr)
+    ips, on_tpu = bench(args.model, batch, image_size, args.steps,
+                        args.warmup, args.train)
+    mode = "train" if args.train else "infer"
+    row = {"metric": f"{args.model}_{mode}_images_per_sec",
+           "value": round(ips, 2), "unit": "images/sec",
+           "image_size": image_size, "batch": batch,
+           "platform": "tpu" if on_tpu else "cpu"}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+if __name__ == "__main__":
+    main()

@@ -20,9 +20,14 @@ if _sys.getrecursionlimit() < 3000:
 
 import jax as _jax_config_only
 
-# MXNet supports int64/float64 tensors; JAX demotes them unless x64 is on.
-# Weak-type promotion keeps float32 as the working default (MXNet rule).
-_jax_config_only.config.update("jax_enable_x64", True)
+# MXNet supports int64/float64 tensors; JAX demotes them unless x64 is
+# on.  x64 is OPT-IN (MXTPU_ENABLE_X64=1): on TPU it risks silent f64
+# promotion on hot paths where the MXU wants bf16/f32.  Weak-type
+# promotion keeps float32 as the working default (MXNet rule) in both
+# modes; without x64, f64/i64 requests are demoted to f32/i32.
+from . import envs as _envs
+if _envs.get("MXTPU_ENABLE_X64"):
+    _jax_config_only.config.update("jax_enable_x64", True)
 
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,

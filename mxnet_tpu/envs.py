@@ -44,6 +44,12 @@ _reg("MXTPU_PROFILE_SYNC", bool, False,
      "(slower; like the reference's synchronous profiling mode).")
 _reg("MXTPU_SEED", int, 0,
      "Global RNG seed override applied at import.", "MXNET_SEED")
+_reg("MXTPU_ENABLE_X64", bool, False,
+     "Enable 64-bit tensor types (int64/float64) via jax_enable_x64. "
+     "Off by default: x64 risks silent f64 promotion on TPU hot paths "
+     "where the MXU wants bf16/f32. MXNet's float32-default dtype rules "
+     "are preserved either way; turn this on for workloads that need "
+     "genuine f64/i64 tensors.")
 _reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
      "Accepted for parity; XLA fuses whole graphs at the hybridize "
      "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")

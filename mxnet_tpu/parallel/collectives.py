@@ -93,9 +93,24 @@ def allreduce(values, axis="dp", mesh=None, op="sum"):
             _reduce, mesh=mesh, in_specs=(spec,), out_specs=spec))
         _ALLREDUCE_CACHE[key] = fn
 
-    stacked = jnp.stack([v._data for v in values])
     sharding = NamedSharding(mesh, P(axis, *([None] * len(shape))))
-    stacked = jax.device_put(stacked, sharding)
+    if len({v._data.device for v in values}) <= 1:
+        stacked = jax.device_put(jnp.stack([v._data for v in values]),
+                                 sharding)
+    elif len(mesh.axis_names) == 1:
+        # shards already live on their devices (kvstore 'device'
+        # layout): assemble the global array in place, no host hop
+        devs = list(mesh.devices.flat)
+        arrs = [jax.device_put(v._data[None], d)
+                for v, d in zip(values, devs)]
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + tuple(shape), sharding, arrs)
+    else:
+        # multi-axis mesh with scattered shards: go through the host
+        import numpy as _np
+        stacked = jax.device_put(
+            jnp.asarray(_np.stack([v.asnumpy() for v in values])),
+            sharding)
     out = fn(stacked)
     return [NDArray(out[i], ctx=values[i].context)
             for i in range(len(values))]

@@ -1,0 +1,38 @@
+"""Smoke tests for the benchmark harness (BASELINE.md obligations).
+
+Keeps `benchmark/` importable and runnable — numbers themselves are not
+asserted (CPU backend), only that each harness completes and emits
+well-formed rows.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_opperf_smoke():
+    from benchmark import opperf
+    rows = opperf.main(["--ops", "exp,sum"])
+    assert {r["op"] for r in rows} == {"exp", "sum"}
+    for r in rows:
+        assert r["dispatch_us"] > 0
+        assert r["compile_ms"] > 0
+        assert r["large_ms"] > 0
+
+
+def test_allreduce_bench_smoke():
+    from benchmark import allreduce_bench
+    rows, n = allreduce_bench.bench_allreduce([0.1], iters=2)
+    assert n >= 1
+    assert rows[0]["busbw_gbps"] >= 0
+    assert rows[0]["time_ms"] > 0
+
+
+@pytest.mark.slow
+def test_resnet_bench_smoke():
+    from benchmark import resnet_bench
+    ips, _ = resnet_bench.bench("resnet18_v1", batch=2, image_size=32,
+                                steps=2, warmup=1, train=False)
+    assert ips > 0

@@ -15,7 +15,9 @@ def test_create_and_asnumpy():
 
 def test_create_dtypes():
     a = nd.array(np.arange(6, dtype="int64").reshape(2, 3))
-    assert a.dtype == np.int64
+    # i64 needs MXTPU_ENABLE_X64; otherwise JAX demotes to i32
+    expect_i = np.int64 if mx.envs.get("MXTPU_ENABLE_X64") else np.int32
+    assert a.dtype == expect_i
     b = nd.array([1.0, 2.0], dtype="float16")
     assert b.dtype == np.float16
     # float64 source defaults down to float32 (MXNet default-dtype rule)
