@@ -61,7 +61,12 @@ def bench_allreduce(sizes_mb, iters=10):
         assert abs(float(out[0].asnumpy()[0]) - expect) < 1e-3
 
         nbytes = elems * 4
-        busbw = (2 * (n - 1) / max(n, 1)) * nbytes / dt / 1e9
+        if n == 1:
+            # mesh=1: no inter-device traffic — report the device
+            # round-trip (copy) bandwidth instead of a ring busbw of 0
+            busbw = nbytes / dt / 1e9
+        else:
+            busbw = (2 * (n - 1) / n) * nbytes / dt / 1e9
         row = {"size_mb": mb, "n_devices": n,
                "time_ms": round(dt * 1e3, 3),
                "busbw_gbps": round(busbw, 2)}

@@ -69,17 +69,34 @@ def _build_ops(ctx):
 
     _salt = iter(range(0, 10000, 101))
 
-    def fresh(opname):
-        # prime-ish never-repeating dims (salted per bench entry so two
-        # entries sharing an opname still miss) force a compile-cache miss
+    def fresh(opname, kind="unary"):
+        # compile-cache-miss thunks run the SAME op on never-repeating
+        # dims (salted per bench entry so entries never share a shape)
         salt = next(_salt)
 
         def make(k):
-            a = nd.ones((61 + salt + 2 * k, 67 + salt + 2 * k), ctx=ctx)
-            b = nd.ones((61 + salt + 2 * k, 67 + salt + 2 * k), ctx=ctx)
+            s = salt + 2 * k
             op = getattr(nd, opname)
-            if opname in ("broadcast_add", "broadcast_mul"):
+            if kind == "binary":
+                a = nd.ones((61 + s, 67 + s), ctx=ctx)
+                b = nd.ones((61 + s, 67 + s), ctx=ctx)
                 return lambda: op(a, b)
+            if kind == "dot":
+                a = nd.ones((64 + s, 72 + s), ctx=ctx)
+                b = nd.ones((72 + s, 64 + s), ctx=ctx)
+                return lambda: op(a, b)
+            if kind == "fc":
+                a = nd.ones((4, 8 + s), ctx=ctx)
+                w = nd.ones((4, 8 + s), ctx=ctx)
+                b0 = nd.zeros((4,), ctx=ctx)
+                return lambda: op(a, w, b0, num_hidden=4)
+            if kind == "conv":
+                a = nd.ones((1, 2, 8 + s, 8 + s), ctx=ctx)
+                w = nd.ones((2, 2, 3, 3), ctx=ctx)
+                b0 = nd.zeros((2,), ctx=ctx)
+                return lambda: op(a, w, b0, kernel=(3, 3), num_filter=2,
+                                  pad=(1, 1))
+            a = nd.ones((61 + s, 67 + s), ctx=ctx)
             return lambda: op(a)
         return make
 
@@ -87,11 +104,11 @@ def _build_ops(ctx):
         OpBench("broadcast_add",
                 lambda: nd.broadcast_add(rng_small, rng_small2),
                 lambda: nd.broadcast_add(big, big2),
-                fresh("broadcast_add")),
+                fresh("broadcast_add", "binary")),
         OpBench("broadcast_mul",
                 lambda: nd.broadcast_mul(rng_small, rng_small2),
                 lambda: nd.broadcast_mul(big, big2),
-                fresh("broadcast_mul")),
+                fresh("broadcast_mul", "binary")),
         OpBench("exp",
                 lambda: nd.exp(rng_small),
                 lambda: nd.exp(big),
@@ -111,19 +128,19 @@ def _build_ops(ctx):
         OpBench("dot",
                 lambda: nd.dot(rng_small, rng_small2),
                 lambda: nd.dot(big, big2),
-                fresh("exp"), flops=matmul_flops),
+                fresh("dot", "dot"), flops=matmul_flops),
         OpBench("FullyConnected",
                 lambda: nd.FullyConnected(rng_small, wfc_s, bfc_s,
                                           num_hidden=4),
                 lambda: nd.FullyConnected(vec, wfc, bfc, num_hidden=512),
-                fresh("relu"), flops=2 * 4 * 1024 * 512),
+                fresh("FullyConnected", "fc"), flops=2 * 4 * 1024 * 512),
         OpBench("Convolution",
                 lambda: nd.Convolution(img_s, wconv_s, bconv_s,
                                        kernel=(3, 3), num_filter=2,
                                        pad=(1, 1)),
                 lambda: nd.Convolution(img, wconv, bconv, kernel=(3, 3),
                                        num_filter=32, pad=(1, 1)),
-                fresh("sum"), flops=conv_flops),
+                fresh("Convolution", "conv"), flops=conv_flops),
     ]
     return ops
 

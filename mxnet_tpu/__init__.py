@@ -29,6 +29,17 @@ from . import envs as _envs
 if _envs.get("MXTPU_ENABLE_X64"):
     _jax_config_only.config.update("jax_enable_x64", True)
 
+# Join the launcher's multi-process rendezvous NOW, before anything can
+# initialize the XLA backend (jax.distributed.initialize refuses after
+# that).  tools/launch.py exports MXTPU_DIST_*; single-process runs skip
+# this.  kvstore.init_distributed() recognizes the joined state.
+import os as _os
+if _os.environ.get("MXTPU_DIST_COORDINATOR"):
+    _jax_config_only.distributed.initialize(
+        coordinator_address=_os.environ["MXTPU_DIST_COORDINATOR"],
+        num_processes=int(_os.environ.get("MXTPU_DIST_NUM_PROCS", "1")),
+        process_id=int(_os.environ.get("MXTPU_DIST_PROC_ID", "0")))
+
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)

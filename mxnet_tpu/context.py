@@ -72,13 +72,16 @@ class Context:
         """The underlying ``jax.Device``. Resolved lazily and cached."""
         if self._device is None:
             jax = _jax()
+            # local_devices, not devices: in multi-process SPMD the
+            # global list contains other workers' (non-addressable)
+            # devices; ctx ids are per-worker-local like mx.gpu(i)
             if self.device_type in ("cpu", "cpu_pinned"):
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             elif self.device_type == "tpu":
                 try:
-                    devs = jax.devices()  # default backend is the TPU plugin
+                    devs = jax.local_devices()  # default backend = TPU plugin
                     if not devs or devs[0].platform == "cpu":
-                        devs = jax.devices("tpu")
+                        devs = jax.local_devices(backend="tpu")
                 except RuntimeError as e:
                     raise MXNetError(
                         f"no TPU backend available: {e}") from e
@@ -137,9 +140,9 @@ def num_gpus() -> int:
 def num_tpus() -> int:
     try:
         jax = _jax()
-        devs = jax.devices()
+        devs = jax.local_devices()
         if devs and devs[0].platform != "cpu":
             return len(devs)
-        return len(jax.devices("tpu"))
+        return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return 0

@@ -25,6 +25,6 @@ reference mode      rebuild behaviour
                     TPU) — raises with an explanatory error
 ==================  =====================================================
 """
-from .kvstore import KVStore, KVStoreTPUSync, create
+from .kvstore import KVStore, KVStoreTPUSync, create, init_distributed
 
-__all__ = ["KVStore", "KVStoreTPUSync", "create"]
+__all__ = ["KVStore", "KVStoreTPUSync", "create", "init_distributed"]

@@ -15,7 +15,61 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 from .compression import GradientCompression
 
-__all__ = ["KVStore", "KVStoreTPUSync", "create"]
+__all__ = ["KVStore", "KVStoreTPUSync", "create", "init_distributed"]
+
+_DIST_INITIALIZED = False
+
+
+def init_distributed(coordinator=None, num_processes=None,
+                     process_id=None):
+    """Join the multi-process rendezvous (idempotent).
+
+    The reference's rendezvous was ps-lite's scheduler: every process
+    exported ``DMLC_PS_ROOT_URI``/``DMLC_ROLE`` and connected over
+    ZeroMQ (SURVEY.md §3.5).  The TPU-native rendezvous is the JAX/PJRT
+    distributed runtime: ``tools/launch.py`` exports ``MXTPU_DIST_*``
+    and every worker calls ``jax.distributed.initialize`` against the
+    coordination service.  Arguments default from those env vars; no-op
+    when they are absent (single-process mode) or when already joined.
+    """
+    global _DIST_INITIALIZED
+    if _DIST_INITIALIZED:
+        return True
+    import os
+    import jax
+    # someone (a pod runtime, user code) may have initialized the
+    # distributed client already — treat that as joined, don't re-init
+    try:
+        from jax._src import distributed as _jd
+        if getattr(_jd.global_state, "client", None) is not None:
+            _DIST_INITIALIZED = True
+            return True
+    except (ImportError, AttributeError):
+        pass
+    coordinator = coordinator or os.environ.get("MXTPU_DIST_COORDINATOR")
+    if coordinator is None:
+        return False
+    num_processes = int(num_processes if num_processes is not None
+                        else os.environ.get("MXTPU_DIST_NUM_PROCS", "1"))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("MXTPU_DIST_PROC_ID", "0"))
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            _DIST_INITIALIZED = True
+            return True
+        raise MXNetError(
+            "jax.distributed.initialize failed — it must run before "
+            "anything initializes the XLA backend. Under tools/launch.py "
+            "this happens automatically at `import mxnet_tpu`; if you "
+            "set MXTPU_DIST_* yourself, call "
+            "mx.kvstore.init_distributed() before creating any NDArray. "
+            f"Original error: {e}") from e
+    _DIST_INITIALIZED = True
+    return True
 
 
 def _as_list(x):
@@ -203,6 +257,7 @@ class KVStoreTPUSync(KVStore):
 
     def __init__(self, kv_type="dist_tpu_sync"):
         super().__init__(kv_type)
+        init_distributed()  # join the launcher's rendezvous if exported
         import jax
         self._jax = jax
 

@@ -89,7 +89,10 @@ def _shuffle(key, data):
 
 @register("_sample_unique_zipfian", num_inputs=1)
 def _sample_unique_zipfian(key, *, range_max=1, shape=()):
-    # approximate: log-uniform sampling without dedup guarantee
+    # approximate: log-uniform sampling without dedup guarantee.
+    # 'int64' canonicalizes to int32 without x64, which would wrap for
+    # range_max > 2**31 — sample in float and clip BEFORE the int cast
     u = jax.random.uniform(_k(key), shape)
-    out = jnp.exp(u * jnp.log(float(range_max))).astype("int64") - 1
-    return jnp.clip(out, 0, range_max - 1)
+    vals = jnp.exp(u * jnp.log(float(range_max))) - 1.0
+    vals = jnp.clip(vals, 0.0, float(range_max - 1))
+    return vals.astype("int64")

@@ -116,6 +116,13 @@ def randn(*shape, dtype="float32", ctx=None):
 def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
     from .ndarray.ndarray import invoke
     from .ops.registry import get_op
+    if int(high) > 2**31 - 1 or int(low) < -2**31:
+        import jax
+        if not jax.config.jax_enable_x64:
+            from .base import MXNetError
+            raise MXNetError(
+                f"randint bounds [{low}, {high}) need 64-bit integers; "
+                "set MXTPU_ENABLE_X64=1 to enable int64 tensors")
     ctx = (out.context if out is not None else ctx) or current_context()
     shp = () if shape is None else (
         (shape,) if isinstance(shape, (int, np.integer)) else tuple(shape))

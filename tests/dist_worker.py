@@ -1,0 +1,71 @@
+"""Worker body for the local two-process distributed test.
+
+Run through ``tools/launch.py -n 2 python tests/dist_worker.py`` (the
+reference's ``--launcher local`` trick — SURVEY.md §4 "Distributed tests
+without a cluster").  Asserts, per the reference's
+``dist_sync_kvstore.py``: after every worker pushes known constants, the
+pulled value equals the cross-worker aggregate.
+"""
+import os
+import sys
+
+# CPU backend, pinned before jax init (the axon plugin overrides env)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    # realistic flow: computation happens BEFORE the kvstore exists
+    # (Gluon Trainer creates it lazily at the first step) — this only
+    # works because `import mxnet_tpu` joined the rendezvous already
+    warm = nd.dot(nd.ones((8, 8)), nd.ones((8, 8)))
+    assert float(warm.asnumpy()[0, 0]) == 8.0
+
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.is_distributed
+    n = kv.num_workers
+    rank = kv.rank
+    assert n == int(os.environ["MXTPU_DIST_NUM_PROCS"])
+
+    # 1. push known constants, pull the aggregate: sum_r (r+1)
+    kv.init("w", nd.zeros((4, 2)))
+    kv.push("w", nd.full((4, 2), rank + 1))
+    out = nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    expect = n * (n + 1) / 2
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # 2. multi-key pushpull round
+    kv.init(["a", "b"], [nd.zeros((3,)), nd.zeros((3,))])
+    outs = [nd.zeros((3,)), nd.zeros((3,))]
+    kv.pushpull(["a", "b"],
+                [nd.full((3,), rank * 10 + 1), nd.full((3,), rank + 1)],
+                out=outs)
+    np.testing.assert_allclose(
+        outs[0].asnumpy(), sum(r * 10 + 1 for r in range(n)))
+    np.testing.assert_allclose(outs[1].asnumpy(), expect)
+
+    # 3. barrier then server-side-updater path (optimizer on store)
+    kv._barrier()
+    kv2_key = "u"
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.init(kv2_key, nd.ones((2, 2)))
+    kv.push(kv2_key, nd.full((2, 2), 1.0))  # grad = n after aggregation
+    got = nd.zeros((2, 2))
+    kv.pull(kv2_key, out=got)
+    # w <- w - lr * (sum of grads) = 1 - n
+    np.testing.assert_allclose(got.asnumpy(), 1.0 - n)
+
+    print(f"WORKER_OK rank={rank}/{n}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,57 @@
+"""Two-process distributed kvstore tests via the local launcher.
+
+Parity model: the reference's ``tests/nightly/dist_sync_kvstore.py``
+family, run as ``python tools/launch.py -n 2 --launcher local python
+dist_sync_kvstore.py`` (SURVEY.md §4 "Distributed tests without a
+cluster", §2.3 launcher row).  Exercises ``KVStoreTPUSync._merge`` /
+``_barrier`` across REAL process boundaries — `jax.distributed`
+rendezvous over loopback, cross-process allgather on the CPU backend.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launcher(n, worker, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # workers pin their own platform; scrub the test harness's flags so
+    # each worker gets ONE local cpu device (true multi-process shape)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         sys.executable, os.path.join(_REPO, "tests", worker)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO)
+
+
+def test_launch_local_two_workers():
+    res = _run_launcher(2, "dist_worker.py")
+    sys.stderr.write(res.stdout[-2000:] + res.stderr[-2000:])
+    assert res.returncode == 0
+    assert "WORKER_OK rank=0/2" in res.stdout
+    assert "WORKER_OK rank=1/2" in res.stdout
+
+
+def test_launcher_rejects_remote_modes():
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "echo", "hi"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode != 0
+    assert "capability gap" in res.stderr
+
+
+def test_launcher_propagates_worker_failure():
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local",
+         sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    assert "exited with 3" in res.stderr
