@@ -1,0 +1,205 @@
+"""Host-side pipeline scheduling over the native C++ engine.
+
+This is where ``src/engine.cc`` becomes load-bearing (SURVEY.md §2.1
+engine row, §7 "keeping the C++ core honest"): the data-pipeline stages
+that the reference ran on its threaded dependency engine — record
+reading, decode/augment workers, batch prefetch — submit their work here
+instead of to Python ``threading``/``ThreadPoolExecutor``.
+
+:class:`NativeEnginePool` exposes the ThreadPoolExecutor subset the IO
+layer uses (``submit``/``map``/``shutdown``) on top of
+``NativeEngine.push``: each job gets a fresh engine var, the C++ worker
+pool runs the Python callable (ctypes reacquires the GIL), and
+exceptions teleport to ``result()`` — the reference engine's
+exception-at-sync-point semantics.
+
+:func:`io_pool` is the selection point: the native engine when
+``libmxtpu.so`` is built (the default), a ``ThreadPoolExecutor`` with
+identical semantics otherwise (fresh checkout without a toolchain), or
+when ``MXTPU_NATIVE_IO=0`` forces the fallback.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .. import _native
+
+__all__ = ["EngineFuture", "NativeEnginePool", "StagingBuffers",
+           "io_pool", "native_io_active", "nd_from_staging"]
+
+
+class EngineFuture:
+    """Result handle for one engine-scheduled job."""
+
+    def __init__(self, engine, var):
+        self._engine = engine
+        self._var = var
+        self._value = None
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def _finish(self, value, exc):
+        self._value = value
+        self._exc = exc
+        self._done.set()
+
+    def result(self, timeout=None):
+        """Block until the job ran; re-raise its exception here.
+
+        The engine var orders the wait; the Event carries the payload
+        (and supports ``timeout``, which WaitForVar does not).
+        """
+        if timeout is None:
+            self._engine.wait_for_var(self._var)
+            self._done.wait()  # _finish runs inside the closure; no gap
+        elif not self._done.wait(timeout):
+            raise TimeoutError("engine job did not finish in "
+                               f"{timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class NativeEnginePool:
+    """ThreadPoolExecutor-compatible facade over :class:`NativeEngine`."""
+
+    def __init__(self, num_workers: int):
+        self._engine = _native.NativeEngine(max(1, int(num_workers)))
+        self._closed = False
+
+    def submit(self, fn: Callable, *args, **kwargs) -> EngineFuture:
+        fut = EngineFuture(self._engine, self._engine.new_var())
+
+        def job():
+            try:
+                fut._finish(fn(*args, **kwargs), None)
+            except BaseException as e:  # teleports to result()
+                fut._finish(None, e)
+
+        self._engine.push(job, read_vars=(), write_vars=(fut._var,))
+        return fut
+
+    def map(self, fn, iterable):
+        futs = [self.submit(fn, x) for x in iterable]
+        return [f.result() for f in futs]
+
+    def shutdown(self, wait=True):
+        if not self._closed:
+            self._closed = True
+            if wait:
+                self._engine.wait_for_all()
+                self._engine.close()
+            else:
+                # EngineFree drains in-flight jobs before joining, so a
+                # synchronous close() here would block (the executor
+                # contract says wait=False must not); drain off-thread
+                threading.Thread(target=self._engine.close,
+                                 daemon=True).start()
+
+    def __del__(self):
+        try:
+            self.shutdown(wait=False)
+        except Exception:
+            pass
+
+
+class StagingBuffers:
+    """Rotating host staging buffers from the native pooled allocator.
+
+    Plays the reference's pinned-memory staging role
+    (``iter_prefetcher.h`` double-buffers batches into pinned host
+    memory before the device copy): batch assembly writes into pooled
+    ``NativeStorage`` memory viewed as numpy, rotating ``depth`` buffers
+    so the previous batch's host→device copy can still be in flight.
+    Falls back to plain numpy allocation without the native lib.
+    """
+
+    def __init__(self, depth=2):
+        self._depth = max(2, int(depth))
+        self._storage = _native.NativeStorage(pooled=True) \
+            if native_io_active() else None
+        self._bufs = {}  # (shape, dtype) -> list of arrays
+        self._idx = {}
+        self._ptrs = []
+
+    def get(self, shape, dtype="float32"):
+        """A zeroed array of `shape`; rotates through `depth` buffers.
+
+        The returned view is ASSEMBLY SCRATCH: it is reused (and
+        re-zeroed) after `depth` more calls and dies with
+        :meth:`close`.  Hand data onward with :func:`nd_from_staging`,
+        which forces a real copy — ``jax.device_put`` zero-copy aliases
+        aligned host memory, and an NDArray aliasing a rotating buffer
+        would be silently corrupted.
+        """
+        import numpy as np
+        key = (tuple(shape), str(dtype))
+        bufs = self._bufs.get(key)
+        if bufs is None:
+            bufs = []
+            for _ in range(self._depth):
+                if self._storage is not None:
+                    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+                    ptr = self._storage.alloc(max(nbytes, 1))
+                    self._ptrs.append(ptr)
+                    import ctypes
+                    raw = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
+                    arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+                else:
+                    arr = np.empty(shape, dtype)
+                bufs.append(arr)
+            self._bufs[key] = bufs
+            self._idx[key] = 0
+        i = self._idx[key]
+        self._idx[key] = (i + 1) % self._depth
+        buf = bufs[i]
+        buf[...] = 0
+        return buf
+
+    @property
+    def native(self) -> bool:
+        return self._storage is not None
+
+    def close(self):
+        if self._storage is not None:
+            for p in self._ptrs:
+                self._storage.free(p)
+            self._ptrs = []
+            self._bufs = {}
+            self._storage.close()
+            self._storage = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def nd_from_staging(buf, ctx=None, dtype=None):
+    """NDArray from a staging view, guaranteed NOT to alias it.
+
+    ``buf.copy()`` hands jax a fresh buffer nobody else will mutate;
+    zero-copy device_put aliasing of THAT is then harmless.  Cost is
+    one host memcpy per batch — the price of rotating staging memory.
+    """
+    from .. import ndarray as nd
+    return nd.array(buf.copy(), ctx=ctx, dtype=dtype)
+
+
+def native_io_active() -> bool:
+    """True when IO pools run on the native C++ engine."""
+    from .. import envs
+    return envs.get("MXTPU_NATIVE_IO") and _native.available()
+
+
+def io_pool(num_workers: int):
+    """An executor for pipeline work: native engine, or thread fallback."""
+    if native_io_active():
+        return NativeEnginePool(num_workers)
+    from concurrent.futures import ThreadPoolExecutor
+    return ThreadPoolExecutor(max(1, int(num_workers)))

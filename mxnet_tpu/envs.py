@@ -44,6 +44,10 @@ _reg("MXTPU_PROFILE_SYNC", bool, False,
      "(slower; like the reference's synchronous profiling mode).")
 _reg("MXTPU_SEED", int, 0,
      "Global RNG seed override applied at import.", "MXNET_SEED")
+_reg("MXTPU_NATIVE_IO", bool, True,
+     "Schedule data-pipeline work (prefetch, decode/augment, DataLoader "
+     "workers) on the native C++ engine when libmxtpu.so is built; "
+     "0 falls back to Python thread pools.")
 _reg("MXTPU_ENABLE_X64", bool, False,
      "Enable 64-bit tensor types (int64/float64) via jax_enable_x64. "
      "Off by default: x64 risks silent f64 promotion on TPU hot paths "

@@ -11,8 +11,6 @@ copies already cover.
 """
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 from ...base import MXNetError
@@ -76,7 +74,10 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._batchify_fn = batchify_fn if batchify_fn is not None \
             else default_batchify_fn
-        self._pool = ThreadPoolExecutor(self._num_workers) \
+        # worker jobs run on the native C++ engine when built
+        # (engine.pipeline.io_pool); ThreadPoolExecutor is the fallback
+        from ...engine.pipeline import io_pool
+        self._pool = io_pool(self._num_workers) \
             if self._num_workers > 0 else None
 
     def __iter__(self):

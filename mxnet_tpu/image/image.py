@@ -471,11 +471,16 @@ class ImageIter(io_mod.DataIter):
         return a.transpose(2, 0, 1)
 
     def next(self):
-        batch_data = np.zeros((self.batch_size,) + self.data_shape,
-                              dtype="float32")
+        if getattr(self, "_staging", None) is None:
+            # batch assembly lands in NativeStorage-pooled host buffers
+            # (the reference's pinned-memory staging role)
+            from ..engine.pipeline import StagingBuffers
+            self._staging = StagingBuffers(depth=2)
+        batch_data = self._staging.get(
+            (self.batch_size,) + self.data_shape, "float32")
         shape = (self.batch_size, self.label_width) \
             if self.label_width > 1 else (self.batch_size,)
-        batch_label = np.zeros(shape, dtype="float32")
+        batch_label = self._staging.get(shape, "float32")
         samples = []
         try:
             while len(samples) < self.batch_size:
@@ -485,8 +490,9 @@ class ImageIter(io_mod.DataIter):
                 raise
         if self._num_threads > 1:
             if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._pool = ThreadPoolExecutor(self._num_threads)
+                # decode/augment workers on the native engine when built
+                from ..engine.pipeline import io_pool
+                self._pool = io_pool(self._num_threads)
             processed = list(self._pool.map(
                 self._process, [buf for _, buf in samples]))
         else:
@@ -498,7 +504,9 @@ class ImageIter(io_mod.DataIter):
                 else float(np.asarray(label).reshape(-1)[0])
         i = len(samples)
         pad = self.batch_size - i
+        from ..engine.pipeline import nd_from_staging
         return io_mod.DataBatch(
-            data=[nd.array(batch_data)], label=[nd.array(batch_label)],
+            data=[nd_from_staging(batch_data)],
+            label=[nd_from_staging(batch_label)],
             pad=pad, provide_data=self.provide_data,
             provide_label=self.provide_label)

@@ -10,7 +10,6 @@ recordio core.
 """
 from __future__ import annotations
 
-import threading
 from collections import namedtuple
 
 import numpy as np
@@ -306,12 +305,26 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+class _Resolved:
+    """Future already holding a value (ended-iterator placeholder)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
 class PrefetchingIter(DataIter):
-    """Threaded prefetch over one or more iterators.
+    """Prefetch over one or more iterators, scheduled on the native
+    engine.
 
     Parity: ``mx.io.PrefetchingIter`` / dmlc ThreadedIter double-buffering
-    (SURVEY.md §2.4) — a worker thread per source keeps the next batch
-    ready while the device consumes the current one.
+    (SURVEY.md §2.4) — one in-flight fetch per source keeps the next
+    batch ready while the device consumes the current one.  Fetch jobs
+    run on the C++ dependency engine's worker pool
+    (``engine.pipeline.io_pool``); a Python thread pool with identical
+    semantics is the fallback when the native lib isn't built.
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
@@ -324,37 +337,27 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        from ..engine.pipeline import io_pool
+        self._pool = io_pool(self.n_iter)
+        self.current_batch = None
+        self._pending = None
+        self._prefetch_all()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    def _fetch(self, i):
+        try:
+            return self.iters[i].next()
+        except StopIteration:
+            return None
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i],
-                             daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+    def _prefetch_all(self):
+        self._pending = [self._pool.submit(self._fetch, i)
+                         for i in range(self.n_iter)]
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        try:
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -377,36 +380,32 @@ class PrefetchingIter(DataIter):
             for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        # drain in-flight fetches (they consumed records), then restart
+        for f in self._pending:
+            f.result()
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._prefetch_all()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
+        next_batch = [f.result() for f in self._pending]
+        if next_batch[0] is None:
+            for i in next_batch:
                 assert i is None, "Number of entry mismatches between iters"
+            # keep the ended state visible until reset()
+            self._pending = [_Resolved(None)] * self.n_iter
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
+        for batch in next_batch:
+            assert batch.pad == next_batch[0].pad, \
                 "Number of entry mismatches between iters"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
+            sum([batch.data for batch in next_batch], []),
+            sum([batch.label for batch in next_batch], []),
+            next_batch[0].pad,
+            next_batch[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._prefetch_all()
         return True
 
     def next(self):
