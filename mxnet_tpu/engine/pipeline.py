@@ -72,6 +72,9 @@ class NativeEnginePool:
         self._closed = False
 
     def submit(self, fn: Callable, *args, **kwargs) -> EngineFuture:
+        if self._closed:
+            raise RuntimeError(
+                "cannot schedule new futures after shutdown")
         fut = EngineFuture(self._engine, self._engine.new_var())
 
         def job():

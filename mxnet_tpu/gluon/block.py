@@ -348,14 +348,14 @@ def _is_tracing() -> bool:
 
 
 class _CacheEntry:
-    __slots__ = ("jitted", "n_real_out", "mutated_idx", "out_is_list",
+    __slots__ = ("jitted", "n_real_out", "mutated_idx", "out_tree",
                  "out_avals")
 
     def __init__(self):
         self.jitted = None
         self.n_real_out = 0
         self.mutated_idx = ()
-        self.out_is_list = False
+        self.out_tree = None
         self.out_avals = None
 
 
@@ -494,9 +494,10 @@ class CachedOp:
             _trace_state.active = True
             try:
                 outs = block._call_unhybridized(*call_args)
-                out_is_list = isinstance(outs, (list, tuple))
-                outs_l = list(outs) if out_is_list else [outs]
-                out_data = tuple(o._data for o in outs_l)
+                # outputs may nest (RNN layers return (seq, [h, c])) —
+                # flatten with the same tree scheme as the inputs
+                out_leaves, out_tree = _flatten_args((outs,))
+                out_data = tuple(o._data for o in out_leaves)
                 mutated_idx = tuple(
                     i for i, (r, s) in enumerate(zip(reps, saved))
                     if r._version != s[1])
@@ -510,7 +511,7 @@ class CachedOp:
                     r._version = ver
             entry.n_real_out = len(out_data)
             entry.mutated_idx = mutated_idx
-            entry.out_is_list = out_is_list
+            entry.out_tree = out_tree
             return out_data + mutated_vals
 
         from .. import autograd
@@ -572,9 +573,7 @@ class CachedOp:
             outs.append(o_nd)
         if node is not None:
             node.outputs = list(outs)
-        if entry.out_is_list:
-            return outs
-        return outs[0] if len(outs) == 1 else outs
+        return _unflatten_args(entry.out_tree, outs)[0]
 
 
 # ---------------------------------------------------------------------------

@@ -4,6 +4,9 @@ forecasters; Llama-family stretch)."""
 from . import bert
 from .bert import BERTModel, BERTForPretrain, bert_base, bert_small, \
     bert_large, get_bert
+from . import forecast
+from .forecast import DeepAR, TransformerForecaster
 
 __all__ = ["bert", "BERTModel", "BERTForPretrain", "bert_base",
-           "bert_small", "bert_large", "get_bert"]
+           "bert_small", "bert_large", "get_bert", "forecast",
+           "DeepAR", "TransformerForecaster"]

@@ -1,0 +1,279 @@
+"""Probabilistic time-series forecasters (capability target: GluonTS
+DeepAR and Transformer — SURVEY.md §2.6 "External zoos"; BASELINE
+config #4 "GluonTS DeepAR / Transformer forecasting — RNN scan
+lowering").
+
+TPU-first design notes:
+
+* The DeepAR training pass is ONE hybridizable program: the whole
+  teacher-forced unroll lowers through ``gluon.rnn.LSTM``'s
+  ``lax.scan`` path (the "RNN scan lowering" milestone), so XLA sees a
+  single fused graph — no per-step Python.
+* The Transformer forecaster reuses the contrib attention blocks (fused
+  SDPA path); its decoder does causal self-attention + cross-attention
+  over the encoded context.
+* Both emit a Gaussian likelihood head with GluonTS's mean-|x| scaling
+  trick, train on negative log-likelihood, and sample autoregressively
+  for prediction (eager loop: sampling is latency-, not
+  throughput-bound).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn, rnn
+from ..gluon.contrib.nn import (MultiHeadAttention, PositionwiseFFN,
+                                TransformerEncoder)
+
+__all__ = ["DeepAR", "TransformerForecaster", "gaussian_nll"]
+
+_MIN_SIGMA = 1e-4
+
+
+def gaussian_nll(F, target, mu, sigma):
+    """Per-element Gaussian negative log-likelihood."""
+    return (F.log(sigma)
+            + 0.5 * float(np.log(2 * np.pi))
+            + 0.5 * F.square((target - mu) / sigma))
+
+
+class _GaussianHead(HybridBlock):
+    """Projects features → (mu, sigma); sigma via softplus."""
+
+    def __init__(self, in_units, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.mu_proj = nn.Dense(1, flatten=False, in_units=in_units,
+                                    prefix="mu_")
+            self.sigma_proj = nn.Dense(1, flatten=False,
+                                       in_units=in_units,
+                                       prefix="sigma_")
+
+    def hybrid_forward(self, F, h):
+        mu = self.mu_proj(h).reshape(h.shape[:-1])
+        raw = self.sigma_proj(h).reshape(h.shape[:-1])
+        sigma = F.Activation(raw, act_type="softrelu") + _MIN_SIGMA
+        return mu, sigma
+
+
+def _mean_abs_scale(F, context):
+    """GluonTS mean-|x| scale over the time axis, (B,) → (B, 1)."""
+    return F.mean(F.abs(context), axis=1, keepdims=True) + 1.0
+
+
+class DeepAR(HybridBlock):
+    """Autoregressive LSTM forecaster (capability parity: GluonTS
+    ``DeepAREstimator``'s train network).
+
+    Training call: ``loss = net(past_target, future_target)`` —
+    teacher-forced unroll over context+prediction range, returns per-
+    sample NLL ``(B,)``.  The unroll is a single ``lax.scan`` under
+    hybridize/jit.
+
+    Prediction: :meth:`sample` draws ancestral sample paths;
+    :meth:`forecast` returns the deterministic mean path.
+    """
+
+    def __init__(self, context_length, prediction_length, num_cells=40,
+                 num_layers=2, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        if context_length < 1 or prediction_length < 1:
+            raise MXNetError("context_length and prediction_length must "
+                             "be >= 1")
+        self.context_length = int(context_length)
+        self.prediction_length = int(prediction_length)
+        self._num_cells = int(num_cells)
+        with self.name_scope():
+            self.lstm = rnn.LSTM(num_cells, num_layers=num_layers,
+                                 layout="NTC", dropout=dropout,
+                                 input_size=1, prefix="lstm_")
+            self.head = _GaussianHead(num_cells, prefix="head_")
+
+    def hybrid_forward(self, F, past_target, future_target):
+        """Teacher-forced NLL over the full unrolled range, (B,)."""
+        scale = _mean_abs_scale(F, past_target)          # (B, 1)
+        full = F.concat(past_target, future_target, dim=1) / scale
+        inputs = F.expand_dims(
+            F.slice_axis(full, axis=1, begin=0, end=-1), axis=2)
+        labels = F.slice_axis(full, axis=1, begin=1, end=None)
+        h = self.lstm(inputs)                            # (B, T-1, H)
+        mu, sigma = self.head(h)
+        nll = gaussian_nll(F, labels, mu, sigma)
+        # sigma is in scaled space: + log(scale) restores the true
+        # likelihood's normalization (constant wrt params per sample)
+        return F.mean(nll, axis=1) + F.mean(F.log(scale), axis=1)
+
+    # -- prediction (eager) ----------------------------------------------
+    def _warm_up(self, past_target):
+        """Advance the LSTM over past[:-1]; past[-1] stays unconsumed as
+        the first prediction step's input — matching the training
+        alignment (step t's input is target[t-1], label target[t])."""
+        from .. import ndarray as nd
+        scale = _mean_abs_scale(nd, past_target)
+        past_scaled = past_target / scale
+        states = self.lstm.begin_state(past_target.shape[0],
+                                       ctx=past_target.context)
+        if past_target.shape[1] > 1:
+            ctx_in = nd.expand_dims(
+                nd.slice_axis(past_scaled, axis=1, begin=0, end=-1),
+                axis=2)
+            h, states = self.lstm(ctx_in, states)
+        else:
+            h = None
+        last = nd.slice_axis(past_scaled, axis=1, begin=-1, end=None)
+        return h, states, scale, last
+
+    def forecast(self, past_target):
+        """Deterministic mean path, (B, prediction_length)."""
+        from .. import ndarray as nd
+        h, states, scale, prev = self._warm_up(past_target)
+        outs = []
+        for _ in range(self.prediction_length):
+            step_in = nd.expand_dims(prev, axis=2)
+            h, states = self.lstm(step_in, states)
+            mu, _ = self.head(h)
+            prev = mu.reshape((-1, 1))
+            outs.append(prev * scale)
+        return nd.concat(*outs, dim=1)
+
+    def sample(self, past_target, num_samples=100):
+        """Ancestral sample paths, (num_samples, B, prediction_length)."""
+        from .. import ndarray as nd
+        from .. import random as mxrand
+        b = past_target.shape[0]
+        rep = nd.repeat(past_target, repeats=num_samples, axis=0)
+        h, states, scale, prev = self._warm_up(rep)
+        outs = []
+        for _ in range(self.prediction_length):
+            step_in = nd.expand_dims(prev, axis=2)
+            h, states = self.lstm(step_in, states)
+            mu, sigma = self.head(h)
+            eps = mxrand.normal(0, 1, shape=mu.shape,
+                                ctx=past_target.context)
+            z = (mu + sigma * eps).reshape((-1, 1))
+            prev = z
+            outs.append(z * scale)
+        paths = nd.concat(*outs, dim=1)      # (B*S, P)
+        return paths.reshape((b, num_samples,
+                              self.prediction_length)).transpose(
+                                  (1, 0, 2))
+
+
+class _TransformerDecoderCell(HybridBlock):
+    """Causal self-attention + cross-attention + FFN (post-LN)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_att = MultiHeadAttention(units, num_heads,
+                                               dropout=dropout)
+            self.cross_att = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout)
+            self.ffn = PositionwiseFFN(units, hidden_size,
+                                       dropout=dropout)
+            self.norm_self = nn.LayerNorm(in_channels=units)
+            self.norm_cross = nn.LayerNorm(in_channels=units)
+            self.norm_ffn = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, memory, causal_mask):
+        x = self.norm_self(x + self.self_att(x, None, None, causal_mask))
+        x = self.norm_cross(x + self.cross_att(x, memory, memory))
+        return self.norm_ffn(x + self.ffn(x))
+
+
+class TransformerForecaster(HybridBlock):
+    """Encoder-decoder attention forecaster (capability parity: GluonTS
+    ``TransformerEstimator``).
+
+    Training call: ``loss = net(past_target, future_target)`` → (B,)
+    NLL.  Encoder attends over the scaled context; the decoder runs
+    causal self-attention over the teacher-forced target prefix plus
+    cross-attention into the encoder memory; Gaussian head + NLL.
+    """
+
+    def __init__(self, context_length, prediction_length, units=32,
+                 hidden_size=64, num_heads=4, enc_layers=2, dec_layers=2,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        if context_length < 1 or prediction_length < 1:
+            raise MXNetError("context_length and prediction_length must "
+                             "be >= 1")
+        self.context_length = int(context_length)
+        self.prediction_length = int(prediction_length)
+        self._units = units
+        with self.name_scope():
+            self.enc_proj = nn.Dense(units, flatten=False, in_units=1,
+                                     prefix="encproj_")
+            self.dec_proj = nn.Dense(units, flatten=False, in_units=1,
+                                     prefix="decproj_")
+            self.enc_pos = self.params.get(
+                "enc_pos", shape=(context_length, units), init="normal")
+            self.dec_pos = self.params.get(
+                "dec_pos", shape=(prediction_length, units),
+                init="normal")
+            self.encoder = TransformerEncoder(
+                units, hidden_size, enc_layers, num_heads,
+                dropout=dropout, prefix="enc_")
+            self.dec_cells = []
+            for i in range(dec_layers):
+                cell = _TransformerDecoderCell(
+                    units, hidden_size, num_heads, dropout=dropout,
+                    prefix=f"dec{i}_")
+                self.register_child(cell, f"dec{i}")
+                self.dec_cells.append(cell)
+            self.head = _GaussianHead(units, prefix="head_")
+
+    def _causal_mask(self, F, length, ctx):
+        steps = F.arange(0, length, ctx=ctx)
+        m = F.broadcast_greater_equal(F.expand_dims(steps, axis=1),
+                                      F.expand_dims(steps, axis=0))
+        return m.reshape((1, 1, length, length))
+
+    def _encode(self, F, past_scaled, enc_pos):
+        x = self.enc_proj(F.expand_dims(past_scaled, axis=2))
+        x = x + F.expand_dims(enc_pos, axis=0)
+        return self.encoder(x)
+
+    def _decode(self, F, dec_in_scaled, memory, dec_pos, length):
+        y = self.dec_proj(F.expand_dims(dec_in_scaled, axis=2))
+        y = y + F.expand_dims(
+            F.slice_axis(dec_pos, axis=0, begin=0, end=length), axis=0)
+        cm = self._causal_mask(F, length, dec_in_scaled.context)
+        for cell in self.dec_cells:
+            y = cell(y, memory, cm)
+        return self.head(y)
+
+    def hybrid_forward(self, F, past_target, future_target,
+                       enc_pos=None, dec_pos=None):
+        scale = _mean_abs_scale(F, past_target)
+        past_scaled = past_target / scale
+        future_scaled = future_target / scale
+        memory = self._encode(F, past_scaled, enc_pos)
+        # decoder input: last context value, then future[:-1]
+        dec_in = F.concat(
+            F.slice_axis(past_scaled, axis=1, begin=-1, end=None),
+            F.slice_axis(future_scaled, axis=1, begin=0, end=-1), dim=1)
+        mu, sigma = self._decode(F, dec_in, memory, dec_pos,
+                                 self.prediction_length)
+        nll = gaussian_nll(F, future_scaled, mu, sigma)
+        return F.mean(nll, axis=1) + F.mean(F.log(scale), axis=1)
+
+    def forecast(self, past_target):
+        """Deterministic mean path via greedy autoregression."""
+        from .. import ndarray as nd
+        scale = _mean_abs_scale(nd, past_target)
+        past_scaled = past_target / scale
+        enc_pos = self.enc_pos.data(past_target.context)
+        dec_pos = self.dec_pos.data(past_target.context)
+        memory = self._encode(nd, past_scaled, enc_pos)
+        dec_in = nd.slice_axis(past_scaled, axis=1, begin=-1, end=None)
+        for t in range(self.prediction_length):
+            mu, _ = self._decode(nd, dec_in, memory, dec_pos,
+                                 t + 1)
+            nxt = nd.slice_axis(mu, axis=1, begin=-1, end=None)
+            dec_in = nd.concat(dec_in, nxt, dim=1)
+        preds = nd.slice_axis(dec_in, axis=1, begin=1, end=None)
+        return preds * scale
