@@ -107,6 +107,13 @@ class Trainer:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     self._kvstore.init(str(i), param.data())
+                    if kvstore.is_distributed:
+                        # adopt the broadcast (rank 0) initial value so
+                        # every worker trains the SAME model from step 1
+                        # (the reference Trainer pulls right after init)
+                        for ctx in param.list_ctx():
+                            self._kvstore.pull(str(i),
+                                               out=param.data(ctx))
         self._kv_initialized = True
 
     @property

@@ -273,6 +273,21 @@ class KVStoreTPUSync(KVStore):
     def is_distributed(self) -> bool:
         return True
 
+    def init(self, key, value):
+        """Init + broadcast: every process adopts rank 0's initial
+        value (the reference's dist kvstore keeps ONE server-side copy
+        initialized once; workers with different random seeds must not
+        start from different weights)."""
+        super().init(key, value)
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            keys, _ = _key_list(key)
+            for k in keys:
+                k = str(k)
+                stored = self._store[k]
+                stored._set_data(multihost_utils.broadcast_one_to_all(
+                    stored._data))
+
     def _merge(self, k, values):
         merged = super()._merge(k, values)
         if self.num_workers > 1:

@@ -52,6 +52,13 @@ def main():
         outs[0].asnumpy(), sum(r * 10 + 1 for r in range(n)))
     np.testing.assert_allclose(outs[1].asnumpy(), expect)
 
+    # 2b. init broadcasts rank 0's value (workers may init with
+    # different random weights; all must adopt one copy)
+    kv.init("init_bc", nd.full((2,), float(rank * 7 + 1)))
+    got_bc = nd.zeros((2,))
+    kv.pull("init_bc", out=got_bc)
+    np.testing.assert_allclose(got_bc.asnumpy(), 1.0)  # rank 0's value
+
     # 3. barrier then server-side-updater path (optimizer on store)
     kv._barrier()
     kv2_key = "u"

@@ -1,0 +1,64 @@
+"""Example scripts run as CI smoke tests (parity: the reference runs
+example smoke jobs in CI — SURVEY.md §2.6 "executable documentation")."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420, drop_env=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in drop_env:
+        env.pop(k, None)
+    res = subprocess.run([sys.executable] + args, capture_output=True,
+                         text=True, timeout=timeout, cwd=_REPO, env=env)
+    if res.returncode != 0:
+        sys.stderr.write(res.stdout[-3000:] + res.stderr[-2000:])
+    return res
+
+
+def test_train_mnist_synthetic():
+    res = _run([os.path.join("example", "train_mnist.py"),
+                "--synthetic", "--epochs", "1"])
+    assert res.returncode == 0
+    assert "validation accuracy=" in res.stdout
+
+
+def test_image_classification_smoke():
+    res = _run([os.path.join("example", "image_classification.py"),
+                "--model", "resnet18_v1", "--image-size", "64",
+                "--batch-size", "8", "--steps", "2"])
+    assert res.returncode == 0
+    assert "images/sec" in res.stdout
+
+
+def test_bert_pretrain_smoke():
+    res = _run([os.path.join("example", "bert_pretrain.py"),
+                "--config", "bert_small", "--vocab", "500",
+                "--batch-size", "2", "--seq-len", "32",
+                "--num-masked", "4", "--steps", "2"])
+    assert res.returncode == 0
+    assert "samples/sec" in res.stdout
+
+
+def test_forecasting_deepar_smoke():
+    res = _run([os.path.join("example", "forecasting_deepar.py"),
+                "--steps", "20", "--batch-size", "16",
+                "--num-samples", "20"])
+    assert res.returncode == 0
+    assert "coverage" in res.stdout
+
+
+def test_distributed_training_two_workers():
+    # each worker gets ONE local cpu device (true multi-process shape)
+    res = _run([os.path.join("tools", "launch.py"), "-n", "2",
+                sys.executable,
+                os.path.join(_REPO, "example",
+                             "distributed_training.py")],
+               drop_env=("XLA_FLAGS",))
+    assert res.returncode == 0
+    assert res.stdout.count("final loss") == 2
