@@ -17,7 +17,8 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["quantize_array", "dequantize_array", "calib_minmax",
-           "calib_entropy", "quantize_model", "QuantizedDense"]
+           "calib_entropy", "quantize_model", "QuantizedDense",
+           "QuantizedConv"]
 
 
 def quantize_array(arr: NDArray, min_range=None, max_range=None):
@@ -88,6 +89,7 @@ class QuantizedDense:
         w = dense.weight.data()
         self.wq, self.w_scale = quantize_array(w)
         self.bias = dense.bias.data() if dense.bias is not None else None
+        self._act = getattr(dense, "act", None)  # fused activation
         self._calib = calib_range
 
     def __call__(self, x):
@@ -102,28 +104,66 @@ class QuantizedDense:
         out = out * (self.w_scale * x_scale)
         if self.bias is not None:
             out = out + self.bias
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+
+class QuantizedConv:
+    """Callable wrapping a Conv layer with int8 weights + per-forward
+    input quantization (inference only) — parity:
+    ``quantized_conv`` in the reference's quantization op family.
+
+    The int8 convolution accumulates in int32 on the MXU, then one
+    rescale by (w_scale * x_scale) restores float32.
+    """
+
+    def __init__(self, conv, calib_range=None):
+        w = conv.weight.data()
+        self.wq, self.w_scale = quantize_array(w)
+        self.bias = conv.bias.data() if conv.bias is not None else None
+        self._act = getattr(conv, "act", None)  # fused activation
+        self._kwargs = {k: v for k, v in conv._kwargs.items()
+                        if k != "no_bias"}
+        self._calib = calib_range
+
+    def __call__(self, x):
+        if self._calib is not None:
+            lo, hi = self._calib
+            xq, x_scale = quantize_array(x, lo, hi)
+        else:
+            xq, x_scale = quantize_array(x)
+        out = nd.Convolution(xq.astype("int32"),
+                             self.wq.astype("int32"),
+                             no_bias=True, **self._kwargs)
+        out = out.astype("float32") * (self.w_scale * x_scale)
+        if self.bias is not None:
+            out = out + self.bias.reshape((1, -1, 1, 1))
+        if self._act is not None:
+            out = self._act(out)
         return out
 
 
 def quantize_model(net, calib_data=None, calib_mode="naive",
                    num_calib_batches=None, quantized_dtype="int8"):
-    """Quantize a Gluon net's Dense layers for int8 inference (parity
-    surface of contrib.quantization.quantize_model; conv path follows).
+    """Quantize a Gluon net's Dense AND Conv2D layers for int8
+    inference (parity surface of contrib.quantization.quantize_model).
 
-    Returns (callable_net, layer_map).  With ``calib_data`` (an iterator
-    of input batches), activation ranges are calibrated ('naive' =
-    min/max, 'entropy' = KL).
+    Returns a layer map {block: quantized callable}.  With
+    ``calib_data`` (an iterator of input batches), activation ranges are
+    calibrated ('naive' = min/max, 'entropy' = KL).
     """
     from ..gluon import nn as gnn
     if quantized_dtype != "int8":
         raise MXNetError("only int8 is supported on TPU")
-    # collect activation stats per Dense layer input
-    dense_layers = [b for b in _walk(net) if isinstance(b, gnn.Dense)]
+    # collect activation stats per quantizable layer input
+    targets = [b for b in _walk(net)
+               if isinstance(b, (gnn.Dense, gnn.Conv2D))]
     calib = {}
     if calib_data is not None:
-        taps = {id(d): [] for d in dense_layers}
+        taps = {id(d): [] for d in targets}
         hooks = []
-        for d in dense_layers:
+        for d in targets:
             def mk(d):
                 def hook(block, inputs):
                     taps[id(d)].append(inputs[0])
@@ -135,12 +175,15 @@ def quantize_model(net, calib_data=None, calib_mode="naive",
             net(batch if isinstance(batch, NDArray) else batch[0])
         for h in hooks:
             h.detach()
-        for d in dense_layers:
+        for d in targets:
             xs = taps[id(d)]
             calib[id(d)] = (calib_minmax(xs) if calib_mode == "naive"
                             else calib_entropy(xs))
-    layer_map = {d: QuantizedDense(d, calib.get(id(d)))
-                 for d in dense_layers}
+    layer_map = {}
+    for d in targets:
+        cls = QuantizedDense if isinstance(d, gnn.Dense) else \
+            QuantizedConv
+        layer_map[d] = cls(d, calib.get(id(d)))
     return layer_map
 
 

@@ -1,0 +1,53 @@
+"""Pretrained-weight store (parity: ``python/mxnet/gluon/model_zoo/
+model_store.py`` — SURVEY.md §2.6 "Gluon model zoo" row).
+
+The reference downloads ``<name>-<hash>.params`` from its model repo.
+This environment has no network, so the store is a LOCAL DIRECTORY
+protocol instead (documented format):
+
+* root (default ``~/.mxnet/models``, override with ``MXNET_HOME`` or
+  the ``root=`` argument) contains ``<name>.params`` files,
+* a ``.params`` file is what ``Block.save_parameters`` writes (name →
+  array dict), so weights trained here round-trip;
+  ``get_model(..., pretrained=True)`` loads them with
+  ``load_parameters``.
+
+Drop files into the root (scp, mounted volume, …) and every zoo
+constructor's ``pretrained=True`` works unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "load_pretrained"]
+
+
+def _root(root=None):
+    if root is not None:
+        return os.path.expanduser(root)
+    home = os.environ.get("MXNET_HOME")
+    if home:
+        return os.path.join(os.path.expanduser(home), "models")
+    return os.path.expanduser(os.path.join("~", ".mxnet", "models"))
+
+
+def get_model_file(name, root=None):
+    """Path to ``<root>/<name>.params``; raises with instructions when
+    absent (the reference would download here)."""
+    root = _root(root)
+    path = os.path.join(root, f"{name}.params")
+    if os.path.exists(path):
+        return path
+    raise MXNetError(
+        f"pretrained weights for {name!r} not found at {path}. This "
+        "build has no network access: place a Block.save_parameters-"
+        "format file there (or set MXNET_HOME / pass root=...) to use "
+        "pretrained=True.")
+
+
+def load_pretrained(net, name, root=None, ctx=None):
+    """Initialize ``net`` from the local store; returns ``net``."""
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net

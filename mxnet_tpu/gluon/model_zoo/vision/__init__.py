@@ -2,8 +2,11 @@
 ``python/mxnet/gluon/model_zoo/vision/__init__.py``): get_model registry
 over resnet/vgg/alexnet/densenet/squeezenet/inception/mobilenet.
 
-``pretrained=True`` raises (no network in this environment — documented
-capability gap; the reference downloads from its model store).
+``pretrained=True`` loads ``<name>.params`` from the LOCAL model store
+(default ``~/.mxnet/models``; override via ``MXNET_HOME`` or ``root=``)
+— no network in this environment, so the reference's download protocol
+is replaced by a drop-files-in-a-directory protocol; see
+``model_zoo.model_store``.
 """
 from .alexnet import *
 from .densenet import *

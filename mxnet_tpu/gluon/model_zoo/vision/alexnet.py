@@ -1,7 +1,6 @@
 """AlexNet — parity: ``python/mxnet/gluon/model_zoo/vision/alexnet.py``."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -38,8 +37,9 @@ class AlexNet(HybridBlock):
         return self.output(x)
 
 
-def alexnet(pretrained=False, **kwargs):
+def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
-    return AlexNet(**kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root=root, ctx=ctx)
+    return net

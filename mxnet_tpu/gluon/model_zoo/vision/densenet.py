@@ -2,7 +2,6 @@
 ``python/mxnet/gluon/model_zoo/vision/densenet.py``."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -86,12 +85,14 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                 **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"densenet{num_layers}", root=root, ctx=ctx)
+    return net
 
 
 def densenet121(**kwargs):

@@ -2,7 +2,6 @@
 ``python/mxnet/gluon/model_zoo/vision/inception.py``."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -163,8 +162,9 @@ class Inception3(HybridBlock):
         return self.output(x)
 
 
-def inception_v3(pretrained=False, **kwargs):
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
-    return Inception3(**kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", root=root, ctx=ctx)
+    return net

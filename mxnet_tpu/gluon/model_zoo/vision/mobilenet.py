@@ -6,7 +6,6 @@ a depthwise convolution natively (no special kernel needed on TPU).
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -113,18 +112,23 @@ class MobileNetV2(HybridBlock):
         return self.output(x)
 
 
-def get_mobilenet(multiplier, pretrained=False, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
-    return MobileNet(multiplier, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"mobilenet{multiplier}", root=root, ctx=ctx)
+    return net
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, **kwargs):
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
-    return MobileNetV2(multiplier, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"mobilenetv2_{multiplier}", root=root,
+                        ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):

@@ -243,17 +243,20 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, ctx=None,
+               root=None, **kwargs):
     if num_layers not in resnet_spec:
         raise MXNetError(f"invalid resnet depth {num_layers}; options are "
                          f"{sorted(resnet_spec)}")
-    if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap); construct and train instead")
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    return resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", root=root,
+                        ctx=ctx)
+    return net
 
 
 def resnet18_v1(**kwargs):

@@ -2,7 +2,6 @@
 ``python/mxnet/gluon/model_zoo/vision/squeezenet.py``."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -74,11 +73,13 @@ class SqueezeNet(HybridBlock):
         return self.output(x)
 
 
-def get_squeezenet(version, pretrained=False, **kwargs):
+def get_squeezenet(version, pretrained=False, ctx=None, root=None,
+                   **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"squeezenet{version}", root=root, ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

@@ -2,7 +2,6 @@
 ``python/mxnet/gluon/model_zoo/vision/vgg.py``."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -52,12 +51,14 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights require network access "
-                         "(documented gap)")
+def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", root=root, ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

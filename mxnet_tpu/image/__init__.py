@@ -1,3 +1,7 @@
-"""``mx.image`` (SURVEY.md §2.4): decode, augmenters, ImageIter."""
+"""``mx.image`` (SURVEY.md §2.4): decode, augmenters, ImageIter,
+ImageDetIter."""
 from .image import *  # noqa: F401,F403
-from .image import __all__  # noqa: F401
+from .image import __all__ as _image_all
+from .detection import ImageDetIter  # noqa: F401
+
+__all__ = list(_image_all) + ["ImageDetIter"]

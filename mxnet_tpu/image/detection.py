@@ -1,0 +1,93 @@
+"""Detection-data iterator (parity: ``python/mxnet/image/detection.py``
+``ImageDetIter`` — SURVEY.md §2.4 "Legacy Python iters").
+
+Label convention (the reference's im2rec detection packing): each
+record's label vector is ``[A, B, extra..., obj0..., obj1...]`` where
+``A`` = header length (>= 2), ``B`` = per-object width (>= 5, rows
+``[class_id, xmin, ymin, xmax, ymax, ...]`` normalized to [0, 1]).
+A flat ``N*5`` vector (no header) is also accepted.  Batch labels come
+out ``(batch, max_objects, B)`` padded with -1 rows — the shape GluonCV
+detection losses consume.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import io as io_mod
+from ..engine.pipeline import nd_from_staging
+from .image import ImageIter
+
+__all__ = ["ImageDetIter"]
+
+
+def _parse_det_label(raw):
+    """Raw label vector → (num_obj, obj_width) float array."""
+    raw = np.asarray(raw, dtype="float32").ravel()
+    if raw.size >= 2 and 2 <= raw[0] <= raw.size and raw[1] >= 5:
+        a, b = int(raw[0]), int(raw[1])
+        body = raw[a:]
+    elif raw.size % 5 == 0 and raw.size:
+        a, b = 0, 5
+        body = raw
+    else:
+        raise MXNetError(
+            f"cannot parse detection label of length {raw.size}: "
+            "expected [A, B, ...objs] header or flat N*5 vector")
+    n = body.size // b
+    return body[:n * b].reshape((n, b))
+
+
+class ImageDetIter(ImageIter):
+    """Image iterator yielding (data, padded object labels)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 label_width=-1, max_objects=None, **kwargs):
+        self._max_objects = max_objects
+        self._obj_width = None
+        kwargs.setdefault("label_name", "label")
+        super().__init__(batch_size, data_shape,
+                         path_imgrec=path_imgrec,
+                         label_width=label_width, **kwargs)
+        # peek one record to size the label pad, then rewind
+        label, _ = self.next_sample()
+        objs = _parse_det_label(label)
+        self._obj_width = objs.shape[1]
+        if self._max_objects is None:
+            # scan the epoch for the true maximum (the reference sizes
+            # its pad the same way via label_shape detection)
+            mx_obj = objs.shape[0]
+            try:
+                while True:
+                    l, _ = self.next_sample()
+                    mx_obj = max(mx_obj, _parse_det_label(l).shape[0])
+            except StopIteration:
+                pass
+            self._max_objects = max(1, mx_obj)
+        self.reset()
+
+    @property
+    def provide_label(self):
+        return [io_mod.DataDesc(
+            self._label_name,
+            (self.batch_size, self._max_objects, self._obj_width))]
+
+    def next(self):
+        samples, processed = self._collect_batch()
+        batch_data = self._staging.get(
+            (self.batch_size,) + self.data_shape, "float32")
+        batch_label = self._staging.get(
+            (self.batch_size, self._max_objects, self._obj_width),
+            "float32")
+        batch_label[...] = -1.0
+        for i, ((label, _), a) in enumerate(zip(samples, processed)):
+            batch_data[i] = a
+            objs = _parse_det_label(label)
+            n = min(objs.shape[0], self._max_objects)
+            batch_label[i, :n] = objs[:n]
+        pad = self.batch_size - len(samples)
+        return io_mod.DataBatch(
+            data=[nd_from_staging(batch_data)],
+            label=[nd_from_staging(batch_label)],
+            pad=pad, provide_data=self.provide_data,
+            provide_label=self.provide_label)

@@ -470,17 +470,16 @@ class ImageIter(io_mod.DataIter):
         a = img.asnumpy() if isinstance(img, NDArray) else img
         return a.transpose(2, 0, 1)
 
-    def next(self):
+    def _collect_batch(self):
+        """Gather up to batch_size samples and decode/augment them on
+        the worker pool; returns (samples, processed images).  Shared by
+        ImageIter and ImageDetIter so the staging/pool/StopIteration
+        pipeline logic lives once."""
         if getattr(self, "_staging", None) is None:
             # batch assembly lands in NativeStorage-pooled host buffers
             # (the reference's pinned-memory staging role)
             from ..engine.pipeline import StagingBuffers
             self._staging = StagingBuffers(depth=2)
-        batch_data = self._staging.get(
-            (self.batch_size,) + self.data_shape, "float32")
-        shape = (self.batch_size, self.label_width) \
-            if self.label_width > 1 else (self.batch_size,)
-        batch_label = self._staging.get(shape, "float32")
         samples = []
         try:
             while len(samples) < self.batch_size:
@@ -497,6 +496,15 @@ class ImageIter(io_mod.DataIter):
                 self._process, [buf for _, buf in samples]))
         else:
             processed = [self._process(buf) for _, buf in samples]
+        return samples, processed
+
+    def next(self):
+        samples, processed = self._collect_batch()
+        batch_data = self._staging.get(
+            (self.batch_size,) + self.data_shape, "float32")
+        shape = (self.batch_size, self.label_width) \
+            if self.label_width > 1 else (self.batch_size,)
+        batch_label = self._staging.get(shape, "float32")
         for i, ((label, _), a) in enumerate(zip(samples, processed)):
             batch_data[i] = a
             batch_label[i] = np.asarray(label, "float32").reshape(

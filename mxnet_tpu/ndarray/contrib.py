@@ -35,6 +35,16 @@ from .ndarray import NDArray
 __all__ = ["foreach", "while_loop", "cond"]
 
 
+def __getattr__(name):
+    """Registry-op passthrough: ``nd.contrib.box_nms`` etc. resolve to
+    the same generated wrappers as ``nd.box_nms`` (the reference's
+    contrib namespace mirrors ops registered under ``_contrib_*``)."""
+    if name.startswith("__"):
+        raise AttributeError(name)
+    from .. import ndarray as _nd
+    return getattr(_nd, name)
+
+
 class _CaptureScope:
     """Records external NDArrays observed by invoke() during a dry trace."""
 

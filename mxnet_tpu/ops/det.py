@@ -1,0 +1,151 @@
+"""Detection / bounding-box ops.
+
+Capability parity: reference ``src/operator/contrib/`` detection family
+(``roi_align.cc``, ``bounding_box.cc`` with ``box_iou``/``box_nms`` —
+SURVEY.md §2.2 "Sequence/attention-adjacent ops" row, used by GluonCV).
+
+TPU-first notes: everything is static-shape.  ``box_nms`` keeps the
+MXNet contract — output has the SAME shape as the input with suppressed
+rows' entries set to -1 — which maps cleanly onto a fixed-trip
+``lax.fori_loop`` (greedy suppression over score-sorted boxes) instead
+of the reference's dynamic-length CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, alias
+
+
+def _iou_corner(lhs, rhs):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes → (..., N, M)."""
+    lx1, ly1, lx2, ly2 = [lhs[..., :, None, i] for i in range(4)]
+    rx1, ry1, rx2, ry2 = [rhs[..., None, :, i] for i in range(4)]
+    ix = jnp.clip(jnp.minimum(lx2, rx2) - jnp.maximum(lx1, rx1), 0, None)
+    iy = jnp.clip(jnp.minimum(ly2, ry2) - jnp.maximum(ly1, ry1), 0, None)
+    inter = ix * iy
+    area_l = jnp.clip(lx2 - lx1, 0, None) * jnp.clip(ly2 - ly1, 0, None)
+    area_r = jnp.clip(rx2 - rx1, 0, None) * jnp.clip(ry2 - ry1, 0, None)
+    union = area_l + area_r - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(boxes):
+    """center (x, y, w, h) → corner (x1, y1, x2, y2)."""
+    x, y, w, h = [boxes[..., i] for i in range(4)]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_box_iou", num_inputs=2)
+def box_iou(lhs, rhs, *, format="corner"):
+    """Pairwise IoU (parity: mx.nd.contrib.box_iou)."""
+    if format == "center":
+        lhs, rhs = _to_corner(lhs), _to_corner(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+@register("_contrib_box_nms", num_inputs=1)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Greedy non-maximum suppression (parity: mx.nd.contrib.box_nms).
+
+    data: (..., N, K) — per-box rows with a score at ``score_index``,
+    coords at ``coord_start:coord_start+4``, optional class id at
+    ``id_index``.  Suppressed/invalid rows come back as all -1, rows are
+    sorted by descending score (the reference's default behaviour).
+    """
+    if in_format == "center" or out_format == "center":
+        raise NotImplementedError(
+            "box_nms: center format not implemented (corner only)")
+
+    def nms_single(d):
+        n = d.shape[0]
+        scores = d[:, score_index]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        d_sorted = d[order]
+        valid_sorted = valid[order]
+        if topk > 0:
+            keep_rank = jnp.arange(n) < topk
+            valid_sorted = valid_sorted & keep_rank
+        boxes = jax.lax.dynamic_slice_in_dim(d_sorted, coord_start, 4,
+                                             axis=1)
+        iou = _iou_corner(boxes, boxes)
+        if id_index >= 0 and not force_suppress:
+            ids = d_sorted[:, id_index]
+            same_class = ids[:, None] == ids[None, :]
+            iou = jnp.where(same_class, iou, 0.0)
+
+        def body(i, keep):
+            # suppress j > i overlapping i, iff i itself is kept
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) \
+                & keep[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, valid_sorted)
+        return jnp.where(keep[:, None], d_sorted, -1.0)
+
+    batch_shape = data.shape[:-2]
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(nms_single)(flat)
+    return out.reshape(batch_shape + data.shape[-2:])
+
+
+@register("_contrib_ROIAlign", num_inputs=2)
+def roi_align(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False):
+    """ROI Align with bilinear sampling (parity:
+    mx.nd.contrib.ROIAlign; Mask R-CNN's pooling).
+
+    data: (N, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2]
+    in image coordinates.  Returns (R, C, PH, PW).
+    """
+    if position_sensitive:
+        raise NotImplementedError("position_sensitive ROIAlign")
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    n, c, h, w = data.shape
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        bidx = roi[0].astype("int32")
+        x1, y1, x2, y2 = [roi[i + 1] * spatial_scale for i in range(4)]
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        # sample grid: (ph*sr, pw*sr) bilinear taps, mean-pooled per bin
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * (bin_h / sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * (bin_w / sr)
+        img = data[bidx]                                   # (C, H, W)
+
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype("int32")
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype("int32")
+        wy = jnp.clip(ys - y0, 0.0, 1.0)
+        wx = jnp.clip(xs - x0, 0.0, 1.0)
+        y0 = y0.astype("int32")
+        x0 = x0.astype("int32")
+
+        def gather(yi, xi):
+            return img[:, yi, :][:, :, xi]                 # (C, Sy, Sx)
+
+        v = (gather(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])
+             + gather(y0, x1i) * ((1 - wy)[:, None] * wx[None, :])
+             + gather(y1i, x0) * (wy[:, None] * (1 - wx)[None, :])
+             + gather(y1i, x1i) * (wy[:, None] * wx[None, :]))
+        # mean over each bin's sr x sr taps
+        v = v.reshape((c, ph, sr, pw, sr))
+        return v.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois.astype(data.dtype))
+
+
+alias("box_iou", "_contrib_box_iou")
+alias("box_nms", "_contrib_box_nms")
+alias("ROIAlign", "_contrib_ROIAlign")

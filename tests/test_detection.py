@@ -1,0 +1,199 @@
+"""Detection ops + ImageDetIter + quantized conv + pretrained store
+(VERDICT r1 missing #8/#9/#10)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+class TestBoxOps:
+    def test_box_iou_oracle(self):
+        rng = np.random.RandomState(0)
+        l = np.sort(rng.rand(6, 2, 2), axis=2).transpose(
+            (0, 2, 1)).reshape(6, 4).astype("f4")
+        r = np.sort(rng.rand(4, 2, 2), axis=2).transpose(
+            (0, 2, 1)).reshape(4, 4).astype("f4")
+
+        def np_iou(a, b):
+            ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+            iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+            inter = ix * iy
+            ua = (a[2] - a[0]) * (a[3] - a[1]) + \
+                (b[2] - b[0]) * (b[3] - b[1]) - inter
+            return inter / ua if ua > 0 else 0.0
+
+        want = np.array([[np_iou(a, b) for b in r] for a in l], "f4")
+        got = nd.contrib.box_iou(nd.array(l), nd.array(r)).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_box_iou_center_format(self):
+        # both in center format: (1,1,2,2)c == corner (0,0,2,2)
+        l = nd.array([[1, 1, 2, 2]], dtype="float32")
+        r = nd.array([[1, 1, 2, 2]], dtype="float32")
+        got = nd.contrib.box_iou(l, r, format="center").asnumpy()
+        np.testing.assert_allclose(got, [[1.0]], rtol=1e-6)
+        # and against a shifted center box with a known overlap
+        r2 = nd.array([[2, 2, 2, 2]], dtype="float32")  # corner (1,1,3,3)
+        got2 = nd.contrib.box_iou(l, r2, format="center").asnumpy()
+        np.testing.assert_allclose(got2, [[1.0 / 7.0]], rtol=1e-5)
+
+    def test_box_nms_suppression_and_classes(self):
+        boxes = nd.array([[0, 0.9, 0, 0, 2, 2],
+                          [0, 0.8, 0.1, 0.1, 2.1, 2.1],
+                          [0, 0.7, 5, 5, 7, 7],
+                          [1, 0.6, 0, 0, 2, 2]], dtype="float32")
+        out = nd.contrib.box_nms(boxes, overlap_thresh=0.5,
+                                 coord_start=2, score_index=1,
+                                 id_index=0).asnumpy()
+        assert out[0][1] == pytest.approx(0.9)
+        np.testing.assert_array_equal(out[1], -1)     # suppressed
+        assert out[2][1] == pytest.approx(0.7)        # far away
+        assert out[3][1] == pytest.approx(0.6)        # other class
+        # force_suppress ignores class ids
+        out2 = nd.contrib.box_nms(boxes, overlap_thresh=0.5,
+                                  coord_start=2, score_index=1,
+                                  id_index=0,
+                                  force_suppress=True).asnumpy()
+        np.testing.assert_array_equal(out2[3], -1)
+
+    def test_box_nms_batch_and_topk(self):
+        b = np.tile(np.array([[0, 0.9, 0, 0, 2, 2],
+                              [0, 0.5, 5, 5, 7, 7],
+                              [0, 0.4, 8, 8, 9, 9]], "f4"), (2, 1, 1))
+        out = nd.contrib.box_nms(nd.array(b), topk=2, coord_start=2,
+                                 score_index=1).asnumpy()
+        assert out.shape == (2, 3, 6)
+        for i in range(2):
+            assert out[i, 0, 1] == pytest.approx(0.9)
+            assert out[i, 1, 1] == pytest.approx(0.5)
+            np.testing.assert_array_equal(out[i, 2], -1)  # beyond topk
+
+    def test_roi_align_constant_map(self):
+        # constant feature map → every pooled cell equals the constant
+        data = nd.full((1, 2, 8, 8), 3.5)
+        rois = nd.array([[0, 1, 1, 6, 6]], dtype="float32")
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                                  spatial_scale=1.0)
+        assert out.shape == (1, 2, 3, 3)
+        np.testing.assert_allclose(out.asnumpy(), 3.5, rtol=1e-6)
+
+    def test_roi_align_linear_ramp(self):
+        # f(x, y) = x: bilinear sampling of a linear ramp is exact
+        ramp = np.tile(np.arange(16, dtype="f4"), (16, 1))
+        data = nd.array(ramp.reshape(1, 1, 16, 16))
+        rois = nd.array([[0, 2, 2, 10, 10]], dtype="float32")
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(4, 4),
+                                  spatial_scale=1.0).asnumpy()[0, 0]
+        # column centers: x1 + (j + .5) * bin_w, bin_w = 2
+        want_cols = 2 + (np.arange(4) + 0.5) * 2.0
+        np.testing.assert_allclose(out, np.tile(want_cols, (4, 1)),
+                                   rtol=1e-5)
+
+
+class TestImageDetIter:
+    def _make_rec(self, tmp_path, n=6):
+        from mxnet_tpu import recordio
+        path = str(tmp_path / "det.rec")
+        idxp = str(tmp_path / "det.idx")
+        w = recordio.MXIndexedRecordIO(idxp, path, "w")
+        rng = np.random.RandomState(0)
+        for i in range(n):
+            img = (rng.rand(24, 24, 3) * 255).astype(np.uint8)
+            nobj = 1 + i % 3
+            objs = []
+            for j in range(nobj):
+                objs += [float(j % 4), 0.1, 0.1, 0.6, 0.6]
+            label = np.array([2, 5] + objs, dtype="float32")
+            header = recordio.IRHeader(0, label, i, 0)
+            w.write_idx(i, recordio.pack_img(header, img,
+                                             img_fmt=".png"))
+        w.close()
+        return path
+
+    def test_det_iter_shapes_and_padding(self, tmp_path):
+        path = self._make_rec(tmp_path)
+        it = mx.image.ImageDetIter(batch_size=3, data_shape=(3, 24, 24),
+                                   path_imgrec=path)
+        assert it.provide_label[0].shape == (3, 3, 5)  # max 3 objects
+        batch = it.next()
+        assert batch.data[0].shape == (3, 3, 24, 24)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (3, 3, 5)
+        # record 0 has 1 object → rows 1,2 padded with -1
+        np.testing.assert_array_equal(lab[0, 1:], -1)
+        np.testing.assert_allclose(lab[0, 0],
+                                   [0, 0.1, 0.1, 0.6, 0.6], rtol=1e-6)
+        # two batches then exhaustion
+        it.next()
+        with pytest.raises(StopIteration):
+            it.next()
+        it.reset()
+        assert it.next().data[0].shape == (3, 3, 24, 24)
+
+
+class TestQuantizedConv:
+    def test_quantized_conv_close_to_float(self):
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.contrib import quantization as q
+        conv = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=4)
+        conv.initialize(mx.init.Xavier())
+        x = nd.array(np.random.RandomState(0).rand(2, 4, 8, 8)
+                     .astype("f4"))
+        ref = conv(x).asnumpy()
+        qc = q.QuantizedConv(conv)
+        got = qc(x).asnumpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+    def test_quantize_model_covers_conv(self):
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.contrib import quantization as q
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, 3, padding=1, in_channels=3),
+                    nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(1, 3, 8, 8).astype("f4"))
+        net(x)
+        lm = q.quantize_model(net, calib_data=[x],
+                              calib_mode="naive")
+        kinds = sorted(type(v).__name__ for v in lm.values())
+        assert kinds == ["QuantizedConv", "QuantizedDense"]
+
+
+class TestModelStore:
+    def test_missing_pretrained_raises_with_path(self):
+        from mxnet_tpu.gluon.model_zoo import vision
+        with pytest.raises(mx.MXNetError, match="not found"):
+            vision.resnet18_v1(pretrained=True)
+
+    def test_local_store_round_trip(self, tmp_path):
+        from mxnet_tpu.gluon.model_zoo import vision
+        net = vision.squeezenet1_0(classes=10)
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(1, 3, 64, 64).astype("f4"))
+        y0 = net(x).asnumpy()
+        net.save_parameters(str(tmp_path / "squeezenet1.0.params"))
+        net2 = vision.squeezenet1_0(classes=10, pretrained=True,
+                                    root=str(tmp_path))
+        np.testing.assert_allclose(net2(x).asnumpy(), y0, rtol=1e-5)
+
+    def test_quantized_layers_apply_fused_activation(self):
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.contrib import quantization as q
+        conv = nn.Conv2D(4, 3, padding=1, in_channels=2,
+                         activation="relu")
+        dense = nn.Dense(6, in_units=8, activation="relu")
+        conv.initialize(mx.init.Xavier())
+        dense.initialize(mx.init.Xavier())
+        rng = np.random.RandomState(1)
+        xc = nd.array(rng.randn(2, 2, 6, 6).astype("f4"))
+        xd = nd.array(rng.randn(3, 8).astype("f4"))
+        qc, qd = q.QuantizedConv(conv), q.QuantizedDense(dense)
+        assert float(qc(xc).asnumpy().min()) >= 0.0
+        assert float(qd(xd).asnumpy().min()) >= 0.0
+        np.testing.assert_allclose(qc(xc).asnumpy(), conv(xc).asnumpy(),
+                                   atol=0.05 * abs(conv(xc).asnumpy()).max())
