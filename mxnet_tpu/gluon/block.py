@@ -402,6 +402,22 @@ def _tree_cache_key(tree):
     return tuple(go(t) for t in tree)
 
 
+def jax_tree_leaves_of_ndarrays(out):
+    """Raw jax buffers of every NDArray in a (possibly nested) result —
+    what block_until_ready understands."""
+    bufs = []
+
+    def go(x):
+        if isinstance(x, NDArray):
+            bufs.append(x._data)
+        elif isinstance(x, (list, tuple)):
+            for y in x:
+                go(y)
+
+    go(out)
+    return bufs
+
+
 def _unflatten_args(tree, leaves):
     def go(t):
         tag = t[0]
@@ -528,6 +544,14 @@ class CachedOp:
         return entry
 
     def __call__(self, *args):
+        from .. import profiler
+        with profiler._span(f"CachedOp[{self.block.name}]",
+                            "cachedop") as sp:
+            out = self._execute(args)
+            sp.sync(jax_tree_leaves_of_ndarrays(out))
+            return out
+
+    def _execute(self, args):
         from .. import autograd
         from .. import random as _rnd
         import jax
@@ -542,20 +566,30 @@ class CachedOp:
         flat = [p._data for p in param_nds] + [a._data for a in leaves] \
             + [base_key._data]
 
-        if autograd.is_recording():
-            out_all, vjp_fn = jax.vjp(entry.jitted, *flat)
+        try:
+            if autograd.is_recording():
+                out_all, vjp_fn = jax.vjp(entry.jitted, *flat)
 
-            def vjp_tuple(cots, _fn=vjp_fn):
-                # the traced fn always returns a tuple; the tape passes a
-                # bare cotangent when there is a single output slot
-                return _fn(cots if isinstance(cots, tuple) else (cots,))
+                def vjp_tuple(cots, _fn=vjp_fn):
+                    # the traced fn always returns a tuple; the tape
+                    # passes a bare cotangent for a single output slot
+                    return _fn(cots if isinstance(cots, tuple)
+                               else (cots,))
 
-            node = autograd._Node(
-                vjp_tuple, list(param_nds) + list(leaves), 1,
-                [o.aval for o in out_all])
-        else:
-            out_all = entry.jitted(*flat)
-            node = None
+                node = autograd._Node(
+                    vjp_tuple, list(param_nds) + list(leaves), 1,
+                    [o.aval for o in out_all])
+            else:
+                out_all = entry.jitted(*flat)
+                node = None
+        except jax.errors.JaxRuntimeError as e:
+            # device/callback failure during execution: same error TYPE
+            # whether it surfaces here (sync backend) or at the consumer
+            # sync point (async backend) — the reference's
+            # exception-teleporting contract is MXNetError either way
+            raise MXNetError(
+                f"execution error in CachedOp[{self.block.name}]: {e}"
+            ) from e
 
         real = out_all[:entry.n_real_out]
         aux = out_all[entry.n_real_out:]

@@ -116,6 +116,16 @@ def _invoke_custom(*inputs, op_type=None, **kwargs):
     ctx = inputs[0].context if inputs else None
     op = prop.create_operator(ctx, in_shapes2, out_types)
 
+    # under CachedOp/jit tracing the inputs hold tracers: bridge to the
+    # host with jax.pure_callback (+ custom_vjp through a second
+    # callback for backward), so custom Python ops stay usable inside
+    # compiled graphs — the reference's dedicated-worker-thread role
+    from .gluon.block import _is_tracing
+    if _is_tracing():
+        _require_host_callbacks()
+        return _invoke_custom_traced(op, inputs, out_shapes, out_types,
+                                     ctx, autograd.is_training())
+
     out_arrays = [nd_mod.zeros(tuple(s), ctx=ctx,
                                dtype=np.dtype(t).name)
                   for s, t in zip(out_shapes, out_types)]
@@ -148,6 +158,87 @@ def _invoke_custom(*inputs, op_type=None, **kwargs):
     for i, o in enumerate(out_arrays):
         o._ag_node = node
         o._ag_out_idx = i
+    return out_arrays[0] if len(out_arrays) == 1 else out_arrays
+
+
+def _require_host_callbacks():
+    """Some experimental PJRT plugins (axon) reject host callbacks
+    ("axon_pjrt does not support host send/recv callbacks"); detect
+    that up front and raise a clear error instead of an opaque
+    UNIMPLEMENTED at execution time.  The plugin masquerades as
+    platform 'tpu'; only platform_version names it."""
+    import jax
+    try:
+        ver = getattr(jax.local_devices()[0].client,
+                      "platform_version", "") or ""
+    except Exception:
+        return
+    if "axon" in ver.lower():
+        raise MXNetError(
+            "custom ops inside hybridized/compiled graphs need host "
+            "callbacks (jax.pure_callback), which the axon TPU plugin "
+            "does not support — run the block unhybridized, or move "
+            "the custom op out of the compiled region")
+
+
+def _invoke_custom_traced(op, inputs, out_shapes, out_types, ctx,
+                          is_train):
+    """pure_callback bridge: the op's forward/backward run HOST-side at
+    execution time (not trace time), wrapped in jax.custom_vjp so
+    gradients flow through compiled graphs.  ``is_train`` is captured
+    at trace time — correct because CachedOp caches per training mode.
+    """
+    import jax
+
+    out_spec = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                     for s, t in zip(out_shapes, out_types))
+    n_out = len(out_spec)
+
+    def host_forward(*np_ins):
+        ins = [nd_mod.array(a, dtype=a.dtype) for a in np_ins]
+        outs = [nd_mod.zeros(tuple(s), dtype=np.dtype(t).name)
+                for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=ins, out_data=outs, aux=[])
+        return tuple(o.asnumpy().astype(np.dtype(t))
+                     for o, t in zip(outs, out_types))
+
+    def host_backward(*np_args):
+        n_in = len(inputs)
+        cots = np_args[:n_out]
+        np_ins = np_args[n_out:n_out + n_in]
+        np_outs = np_args[n_out + n_in:]
+        ins = [nd_mod.array(a, dtype=a.dtype) for a in np_ins]
+        outs = [nd_mod.array(a, dtype=a.dtype) for a in np_outs]
+        ogs = [nd_mod.array(a, dtype=a.dtype) for a in cots]
+        igs = [nd_mod.zeros(i.shape, dtype=i.dtype.name)
+               for i in inputs]
+        op.backward(req=["write"] * n_in, out_grad=ogs, in_data=ins,
+                    out_data=outs, in_grad=igs, aux=[])
+        return tuple(g.asnumpy().astype(np.dtype(i.dtype.name))
+                     for g, i in zip(igs, inputs))
+
+    in_spec = tuple(jax.ShapeDtypeStruct(tuple(i.shape),
+                                         np.dtype(i.dtype.name))
+                    for i in inputs)
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(host_forward, out_spec, *xs)
+
+    def f_fwd(*xs):
+        outs = jax.pure_callback(host_forward, out_spec, *xs)
+        return outs, (xs, outs)
+
+    def f_bwd(res, cots):
+        xs, outs = res
+        grads = jax.pure_callback(host_backward, in_spec,
+                                  *(tuple(cots) + xs + outs))
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    res = f(*(i._data for i in inputs))
+    out_arrays = [NDArray(r, ctx=ctx) for r in res]
     return out_arrays[0] if len(out_arrays) == 1 else out_arrays
 
 

@@ -317,6 +317,14 @@ class DataParallelTrainer:
         batch (parity note: this replaces ``split_and_load`` + per-device
         forward + kvstore push/pull with one SPMD program).
         """
+        from .. import profiler
+        with profiler._span("DataParallelTrainer.step",
+                            "spmd_step") as sp:
+            loss = self._step_impl(data, label)
+            sp.sync(loss._data)
+            return loss
+
+    def _step_impl(self, data, label):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         from .. import random as _rnd

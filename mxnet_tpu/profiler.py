@@ -63,22 +63,33 @@ def set_config(**kwargs):
     _config.update(kwargs)
 
 
-def _hook(name, fn, arrays):
-    start = _now_us()
-    out = fn(*arrays)
+def _record_event(name, cat, start_us, end_us):
+    """Append one chrome-trace complete event (shared schema)."""
+    if _paused:
+        return
+    with _lock:
+        _events.append({"name": name, "ph": "X", "ts": start_us,
+                        "dur": end_us - start_us, "pid": 0,
+                        "tid": threading.get_ident() % 100000,
+                        "cat": cat})
+
+
+def _maybe_block(out):
+    """MXTPU_PROFILE_SYNC=1: block on outputs so spans measure device
+    time, not async dispatch."""
     if os.environ.get("MXTPU_PROFILE_SYNC"):
         import jax
         try:
             jax.block_until_ready(out)
         except Exception:
             pass  # non-array outputs (vjp closures) can't be awaited
-    end = _now_us()
-    if not _paused:
-        with _lock:
-            _events.append({"name": name, "ph": "X", "ts": start,
-                            "dur": end - start, "pid": 0, "tid":
-                            threading.get_ident() % 100000,
-                            "cat": "operator"})
+
+
+def _hook(name, fn, arrays):
+    start = _now_us()
+    out = fn(*arrays)
+    _maybe_block(out)
+    _record_event(name, "operator", start, _now_us())
     return out
 
 
@@ -147,6 +158,40 @@ def dumps(reset=False, format_="table"):
         lines.append(f"{name:<40}{n:>8}{tot:>14.1f}{mn:>12.1f}"
                      f"{mx:>12.1f}{tot / n:>12.1f}")
     return "\n".join(lines)
+
+
+def active() -> bool:
+    """True while collection runs (cheap guard for call sites)."""
+    return _state == "run" and not _paused
+
+
+class _span:
+    """Internal span recorder for framework call sites (CachedOp,
+    Executor, DataParallelTrainer) — the reference wired its profiler
+    INSIDE ExecuteOprBlock; these are the jit-path equivalents that the
+    imperative hook cannot see.  Cheap enough to enter unconditionally;
+    the event is only recorded while collection is active.  Call
+    ``sync(out)`` on the produced arrays before leaving the block so
+    MXTPU_PROFILE_SYNC measures device time like the imperative hook.
+    """
+
+    __slots__ = ("name", "cat", "_start")
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._start = _now_us()
+        return self
+
+    def sync(self, out):
+        if active():
+            _maybe_block(out)
+
+    def __exit__(self, *exc):
+        if active():
+            _record_event(self.name, self.cat, self._start, _now_us())
 
 
 class Marker:

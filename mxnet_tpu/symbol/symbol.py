@@ -741,6 +741,14 @@ class Executor:
         runs once (default head cotangents) and the gradients are cached
         for ``backward()`` — the classic forward();backward() idiom costs
         one XLA execution, not two."""
+        from .. import profiler
+        with profiler._span(f"Executor.forward[train={bool(is_train)}]",
+                            "executor") as sp:
+            outs = self._forward_impl(is_train, **kwargs)
+            sp.sync([o._data for o in outs])
+            return outs
+
+    def _forward_impl(self, is_train=False, **kwargs):
         from .. import random as _rnd
         for k, v in kwargs.items():
             if k not in self.arg_dict:
