@@ -69,7 +69,10 @@ def _from_np(a):
 
 
 def _promote(*xs):
-    """NumPy-rules common dtype across NDArray and python operands."""
+    """NumPy-rules common dtype across NDArray and python operands.
+
+    Without MXTPU_ENABLE_X64, 64-bit promotion targets clamp to their
+    32-bit widths (what JAX would silently truncate to anyway)."""
     parts = []
     for x in xs:
         if isinstance(x, NDArray):
@@ -77,12 +80,31 @@ def _promote(*xs):
         else:
             parts.append(x if _onp.isscalar(x) else _onp.asarray(x))
     rt = _onp.result_type(*parts)
+    if not _np_x64():
+        rt = {_onp.dtype("float64"): _onp.dtype("float32"),
+              _onp.dtype("int64"): _onp.dtype("int32"),
+              _onp.dtype("uint64"): _onp.dtype("uint32"),
+              _onp.dtype("complex128"): _onp.dtype("complex64"),
+              }.get(rt, rt)
     return [_as_nd(x, dtype=rt) for x in xs], rt
 
 
+def _float_dtype():
+    """Default float width under the current x64 setting."""
+    return "float64" if _np_x64() else "float32"
+
+
 def _unary(name):
-    def f(x, **kw):
+    def f(x, *args, **kw):
         x = _as_nd(x)
+        if args:
+            # NumPy callers pass the 2nd+ arguments positionally
+            # (np.roll(a, 1), np.tile(a, reps)); map them onto the jnp
+            # function's parameter names so invoke sees attrs
+            import inspect
+            params = [p.name for p in inspect.signature(
+                getattr(_jnp(), name)).parameters.values()][1:]
+            kw.update(dict(zip(params, args)))
         return invoke(_opdef(name, 1), [x], **kw)
     f.__name__ = name
     f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
@@ -94,7 +116,7 @@ def _unary_float(name):
     def f(x, **kw):
         x = _as_nd(x)
         if _onp.dtype(x.dtype).kind in "iub":
-            x = x.astype("float64" if _np_x64() else "float32")
+            x = x.astype(_float_dtype())
         return invoke(_opdef(name, 1), [x], **kw)
     f.__name__ = name
     f.__doc__ = f"NumPy-semantics {name} (see numpy.{name})."
@@ -200,8 +222,7 @@ def divide(a, b, **kw):
     """NumPy true division: integer inputs produce float output."""
     (a, b), rt = _promote(a, b)
     if _onp.dtype(rt).kind in "iub":
-        ft = "float64" if _np_x64() else "float32"
-        a, b = a.astype(ft), b.astype(ft)
+        a, b = a.astype(_float_dtype()), b.astype(_float_dtype())
     return invoke(_opdef("divide", 2), [a, b], **kw)
 
 
@@ -298,3 +319,235 @@ def einsum(subscripts, *operands):
     jnp = _jnp()
     out = jnp.einsum(subscripts, *[o._data for o in ops])
     return NDArray(out, ctx=ops[0]._ctx if ops else None)
+
+
+# -- sorting / indexing -----------------------------------------------------
+
+sort = _unary("sort")
+argsort = _unary("argsort")
+flip = _unary("flip")
+roll = _unary("roll")
+ravel = _unary("ravel")
+diag = _unary("diag")
+tril = _unary("tril")
+triu = _unary("triu")
+trace = _unary("trace")
+cumprod = _unary("cumprod")
+round = _unary("round")
+around = round
+trunc = _unary("trunc")
+rint = _unary("rint")
+isnan = _unary("isnan")
+isinf = _unary("isinf")
+isfinite = _unary("isfinite")
+all = _unary("all")
+any = _unary("any")
+diff = _unary("diff")
+nan_to_num = _unary("nan_to_num")
+exp2 = _unary_float("exp2")
+deg2rad = _unary_float("deg2rad")
+rad2deg = _unary_float("rad2deg")
+median = _unary("median")
+count_nonzero = _unary("count_nonzero")
+
+outer = _binary("outer", promote=False)
+inner = _binary("inner", promote=False)
+kron = _binary("kron", promote=False)
+cross = _binary("cross", promote=False)
+vdot = _binary("vdot", promote=False)
+
+
+def take(a, indices, axis=None, mode="clip"):
+    a, indices = _as_nd(a), _as_nd(indices)
+    return invoke(_opdef("take", 2), [a, indices], axis=axis,
+                  mode=mode)
+
+
+def quantile(a, q, axis=None):
+    a = _as_nd(a)
+    return invoke(_opdef("quantile", 2), [a, _as_nd(q)], axis=axis)
+
+
+def percentile(a, q, axis=None):
+    return quantile(a, _onp.asarray(q, dtype=_float_dtype()) / 100.0,
+                    axis=axis)
+
+
+def meshgrid(*xs, indexing="xy"):
+    xs = [_as_nd(x) for x in xs]
+    jnp = _jnp()
+    outs = jnp.meshgrid(*[x._data for x in xs], indexing=indexing)
+    return [NDArray(o, ctx=xs[0]._ctx) for o in outs]
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    (a, b), _ = _promote(a, b)
+    return bool(_onp.allclose(a.asnumpy(), b.asnumpy(), rtol=rtol,
+                              atol=atol, equal_nan=equal_nan))
+
+
+def array_equal(a, b):
+    (a, b), _ = _promote(a, b)
+    return bool(_onp.array_equal(a.asnumpy(), b.asnumpy()))
+
+
+# -- np.linalg --------------------------------------------------------------
+
+
+class _Linalg:
+    """``mx.np.linalg`` — NumPy-semantics linear algebra over XLA
+    (reference: mxnet.numpy.linalg)."""
+
+    @functools.lru_cache(maxsize=None)
+    def _op(self, name, n_out=1, n_in=1):
+        import jax.numpy as jnp
+        fn = getattr(jnp.linalg, name)
+        return OpDef(f"_np_linalg_{name}", fn, n_in, n_out, (), False,
+                     None)
+
+    def _call(self, name, x, n_out=1, **kw):
+        x = _as_nd(x)
+        if _onp.dtype(x.dtype).kind in "iub":
+            x = x.astype(_float_dtype())
+        return invoke(self._op(name, n_out), [x], **kw)
+
+    def norm(self, x, ord=None, axis=None, keepdims=False):
+        return self._call("norm", x, ord=ord, axis=axis,
+                          keepdims=keepdims)
+
+    def inv(self, x):
+        return self._call("inv", x)
+
+    def det(self, x):
+        return self._call("det", x)
+
+    def cholesky(self, x):
+        return self._call("cholesky", x)
+
+    def svd(self, x):
+        return self._call("svd", x, n_out=3)
+
+    def qr(self, x):
+        return self._call("qr", x, n_out=2)
+
+    def eigh(self, x):
+        return self._call("eigh", x, n_out=2)
+
+    def slogdet(self, x):
+        return self._call("slogdet", x, n_out=2)
+
+    def solve(self, a, b):
+        (a, b), rt = _promote(a, b)
+        if _onp.dtype(rt).kind in "iub":
+            a = a.astype(_float_dtype())
+            b = b.astype(_float_dtype())
+        return invoke(self._op("solve", n_in=2), [a, b])
+
+    def lstsq(self, a, b, rcond=None):
+        import jax.numpy as jnp
+        a, b = _as_nd(a), _as_nd(b)
+        outs = jnp.linalg.lstsq(a._data, b._data, rcond=rcond)
+        return tuple(NDArray(o, ctx=a._ctx) for o in outs)
+
+    def matrix_rank(self, x):
+        return self._call("matrix_rank", x)
+
+
+linalg = _Linalg()
+
+
+# -- np.random --------------------------------------------------------------
+
+
+class _NpRandom:
+    """``mx.np.random`` — numpy-style RNG over the counter-based key
+    stream (reference: mxnet.numpy.random; same seed machinery as
+    mx.random)."""
+
+    @staticmethod
+    def _mx_random():
+        from .. import random as mxrand
+        return mxrand
+
+    def seed(self, s):
+        self._mx_random().seed(s)
+
+    def uniform(self, low=0.0, high=1.0, size=None, dtype="float32",
+                ctx=None):
+        return self._mx_random().uniform(
+            low, high, shape=() if size is None else size,
+            dtype=dtype, ctx=ctx)
+
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype="float32",
+               ctx=None):
+        return self._mx_random().normal(
+            loc, scale, shape=() if size is None else size,
+            dtype=dtype, ctx=ctx)
+
+    def randint(self, low, high=None, size=None, dtype="int32",
+                ctx=None):
+        if high is None:
+            low, high = 0, low
+        return self._mx_random().randint(
+            low, high, shape=() if size is None else size,
+            dtype=dtype, ctx=ctx)
+
+    def rand(self, *shape):
+        return self.uniform(size=shape)
+
+    def randn(self, *shape):
+        return self.normal(size=shape)
+
+    def choice(self, a, size=None, replace=True, p=None):
+        mxr = self._mx_random()
+        if isinstance(a, int):
+            if p is None and replace:
+                return self.randint(0, a, size=size)
+            a = arange(a)
+        a = _as_nd(a)
+        n = a.shape[0]
+        if not replace:
+            if p is not None:
+                raise MXNetError(
+                    "np.random.choice: replace=False with "
+                    "probabilities is not supported")
+            k = 1 if size is None else int(_onp.prod(size))
+            if k > n:
+                raise MXNetError(
+                    f"cannot take {k} unique samples from a "
+                    f"population of {n}")
+            perm = mxr.shuffle(arange(n))
+            idx = perm[0:k]
+            out = take(a, idx, axis=0)
+            return out if size is None else out.reshape(
+                (size,) if isinstance(size, int) else tuple(size))
+        if p is None:
+            idx = self.randint(0, n, size=size)
+            return take(a, idx, axis=0)
+        p = _as_nd(p)
+        idx = invoke(_opdef_multinomial(), [mxr._next_key_nd(a._ctx), p],
+                     shape=() if size is None else tuple(
+                         (size,) if isinstance(size, int) else size))
+        return take(a, idx, axis=0)
+
+    def shuffle(self, x):
+        """In-place shuffle along axis 0 (numpy.random.shuffle
+        contract)."""
+        self._mx_random().shuffle(x, out=x)
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_multinomial():
+    from ..ops.registry import get_op
+    return get_op("_sample_multinomial")
+
+
+random = _NpRandom()
+
+__all__ += ["sort", "argsort", "flip", "roll", "ravel", "diag", "tril",
+            "triu", "trace", "cumprod", "round", "around", "trunc",
+            "rint", "isnan", "isinf", "isfinite", "all", "any", "diff",
+            "nan_to_num", "exp2", "deg2rad", "rad2deg", "median",
+            "count_nonzero", "outer", "inner", "kron", "cross", "vdot",
+            "take", "quantile", "percentile", "meshgrid", "allclose",
+            "array_equal", "linalg", "random"]

@@ -69,7 +69,6 @@ def _sample_multinomial(key, data, *, shape=(), get_prob=False,
                         dtype="int32"):
     """Categorical sampling over the trailing axis of `data` (probs)."""
     logits = jnp.log(jnp.clip(data, 1e-30, None))
-    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
     sample_shape = tuple(shape) if shape else ()
     if data.ndim == 1:
         out = jax.random.categorical(_k(key), logits, shape=sample_shape)

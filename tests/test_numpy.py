@@ -1,0 +1,189 @@
+"""mx.np / mx.npx namespace tests (parity model:
+tests/python/unittest/test_numpy_*.py — SURVEY.md §4): NumPy-oracle
+checks incl. the dtype-PROMOTION rules that differ from mx.nd."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import npx
+
+
+class TestDtypeRules:
+    def test_array_preserves_dtype(self):
+        a = mnp.array(onp.arange(4, dtype="int16"))
+        assert a.dtype == onp.int16
+        # mx.nd would have made this float32
+        b = mx.nd.array(onp.arange(4.0))
+        assert b.dtype == onp.float32
+
+    def test_promotion_int_plus_float(self):
+        a = mnp.array(onp.arange(4, dtype="int32"))
+        out = mnp.add(a, 1.5)
+        assert out.dtype.kind == "f"
+        onp.testing.assert_allclose(out.asnumpy(),
+                                    onp.arange(4) + 1.5)
+
+    def test_true_divide_ints_gives_float(self):
+        a = mnp.array([1, 2, 3])
+        out = mnp.divide(a, 2)
+        assert out.dtype.kind == "f"
+        onp.testing.assert_allclose(out.asnumpy(), [0.5, 1.0, 1.5])
+
+
+class TestOracle:
+    @pytest.mark.parametrize("fn,arg", [
+        ("sort", onp.array([[3., 1., 2.], [9., 7., 8.]])),
+        ("argsort", onp.array([3., 1., 2.])),
+        ("flip", onp.arange(6.).reshape(2, 3)),
+        ("cumprod", onp.array([1., 2., 3., 4.])),
+        ("trace", onp.arange(9.).reshape(3, 3)),
+        ("tril", onp.ones((3, 3))),
+        ("triu", onp.ones((3, 3))),
+        ("isnan", onp.array([1.0, onp.nan, onp.inf])),
+        ("isfinite", onp.array([1.0, onp.nan, onp.inf])),
+        ("diff", onp.array([1., 4., 9., 16.])),
+        ("median", onp.array([1., 3., 2., 5., 4.])),
+        ("ravel", onp.arange(6.).reshape(2, 3)),
+    ])
+    def test_unary_matches_numpy(self, fn, arg):
+        got = getattr(mnp, fn)(mnp.array(arg)).asnumpy()
+        want = getattr(onp, fn)(arg)
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+    @pytest.mark.parametrize("fn", ["outer", "kron", "inner", "vdot"])
+    def test_binary_matches_numpy(self, fn):
+        a = onp.arange(1., 5.)
+        b = onp.arange(2., 6.)
+        got = getattr(mnp, fn)(mnp.array(a), mnp.array(b)).asnumpy()
+        onp.testing.assert_allclose(got, getattr(onp, fn)(a, b),
+                                    rtol=1e-6)
+
+    def test_take_and_where(self):
+        a = onp.arange(10.0)
+        idx = onp.array([1, 3, 5])
+        onp.testing.assert_allclose(
+            mnp.take(mnp.array(a), mnp.array(idx)).asnumpy(), a[idx])
+        onp.testing.assert_allclose(
+            mnp.where(mnp.array(a) > 4, mnp.array(a), 0.0).asnumpy(),
+            onp.where(a > 4, a, 0.0))
+
+    def test_meshgrid_and_allclose(self):
+        xs, ys = mnp.meshgrid(mnp.array([1., 2.]),
+                              mnp.array([3., 4., 5.]))
+        wx, wy = onp.meshgrid([1., 2.], [3., 4., 5.])
+        onp.testing.assert_allclose(xs.asnumpy(), wx)
+        onp.testing.assert_allclose(ys.asnumpy(), wy)
+        assert mnp.allclose(mnp.array([1.0]), mnp.array([1.0 + 1e-9]))
+        assert not mnp.array_equal(mnp.array([1.0]), mnp.array([2.0]))
+
+
+class TestLinalg:
+    def test_norm_inv_det_solve(self):
+        rng = onp.random.RandomState(0)
+        a = rng.rand(4, 4).astype("f4") + 4 * onp.eye(4, dtype="f4")
+        b = rng.rand(4, 2).astype("f4")
+        am = mnp.array(a)
+        onp.testing.assert_allclose(
+            mnp.linalg.norm(am).asnumpy(), onp.linalg.norm(a), rtol=1e-5)
+        onp.testing.assert_allclose(
+            mnp.linalg.inv(am).asnumpy(), onp.linalg.inv(a), rtol=1e-3,
+            atol=1e-5)
+        onp.testing.assert_allclose(
+            float(mnp.linalg.det(am).asnumpy()), onp.linalg.det(a),
+            rtol=1e-4)
+        onp.testing.assert_allclose(
+            mnp.linalg.solve(am, mnp.array(b)).asnumpy(),
+            onp.linalg.solve(a, b), rtol=1e-3, atol=1e-5)
+
+    def test_factorizations_reconstruct(self):
+        rng = onp.random.RandomState(1)
+        a = rng.rand(5, 3).astype("f4")
+        u, s, vt = mnp.linalg.svd(mnp.array(a))
+        got = (u.asnumpy()[:, :3] * s.asnumpy()) @ vt.asnumpy()
+        onp.testing.assert_allclose(got, a, rtol=1e-4, atol=1e-5)
+        q, r = mnp.linalg.qr(mnp.array(a))
+        onp.testing.assert_allclose(q.asnumpy() @ r.asnumpy(), a,
+                                    rtol=1e-4, atol=1e-5)
+        spd = a.T @ a + 3 * onp.eye(3, dtype="f4")
+        c = mnp.linalg.cholesky(mnp.array(spd)).asnumpy()
+        onp.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-5)
+
+    def test_linalg_autograd(self):
+        from mxnet_tpu import autograd
+        a = mnp.array(onp.eye(3, dtype="f4") * 2.0)
+        a.attach_grad()
+        with autograd.record():
+            y = mnp.linalg.norm(a)
+        y.backward()
+        # d||A||_F/dA = A/||A||_F
+        onp.testing.assert_allclose(
+            a.grad.asnumpy(),
+            a.asnumpy() / onp.linalg.norm(a.asnumpy()), rtol=1e-5)
+
+
+class TestNpRandom:
+    def test_seeded_reproducibility(self):
+        mnp.random.seed(42)
+        a = mnp.random.normal(size=(8,)).asnumpy()
+        mnp.random.seed(42)
+        b = mnp.random.normal(size=(8,)).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+
+    def test_uniform_bounds_and_randint(self):
+        u = mnp.random.uniform(2.0, 3.0, size=(100,)).asnumpy()
+        assert (u >= 2.0).all() and (u < 3.0).all()
+        r = mnp.random.randint(0, 5, size=(100,)).asnumpy()
+        assert r.min() >= 0 and r.max() < 5
+
+    def test_choice(self):
+        a = mnp.array([10.0, 20.0, 30.0])
+        c = mnp.random.choice(a, size=(50,)).asnumpy()
+        assert set(onp.unique(c)) <= {10.0, 20.0, 30.0}
+
+
+class TestNpx:
+    def test_activations(self):
+        x = mnp.array(onp.array([-1.0, 0.0, 2.0], "f4"))
+        onp.testing.assert_allclose(npx.relu(x).asnumpy(), [0, 0, 2])
+        sm = npx.softmax(x).asnumpy()
+        onp.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+
+    def test_np_mode_flags(self):
+        npx.set_np()
+        assert npx.is_np_array() and npx.is_np_shape()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+
+class TestNpRandomContracts:
+    def test_shuffle_is_in_place(self):
+        x = mnp.array(onp.arange(32.0))
+        before = x.asnumpy().copy()
+        mnp.random.seed(0)
+        mnp.random.shuffle(x)
+        after = x.asnumpy()
+        assert not onp.array_equal(before, after)
+        onp.testing.assert_array_equal(onp.sort(after), before)
+
+    def test_choice_without_replacement_unique(self):
+        mnp.random.seed(1)
+        c = mnp.random.choice(8, size=(8,), replace=False).asnumpy()
+        onp.testing.assert_array_equal(onp.sort(c), onp.arange(8))
+        with pytest.raises(mx.MXNetError):
+            mnp.random.choice(3, size=(5,), replace=False)
+
+    def test_choice_with_probs_and_size(self):
+        mnp.random.seed(2)
+        c = mnp.random.choice(4, size=(200,),
+                              p=[0.0, 0.0, 0.0, 1.0]).asnumpy()
+        onp.testing.assert_array_equal(c, 3)
+
+    def test_positional_second_args(self):
+        a = mnp.array(onp.arange(6.0))
+        onp.testing.assert_array_equal(
+            mnp.roll(a, 2).asnumpy(), onp.roll(onp.arange(6.0), 2))
+        onp.testing.assert_array_equal(
+            mnp.tile(a, 2).asnumpy(), onp.tile(onp.arange(6.0), 2))
+        onp.testing.assert_array_equal(
+            mnp.repeat(a, 2).asnumpy(), onp.repeat(onp.arange(6.0), 2))
