@@ -1,0 +1,402 @@
+/*
+ * mxnet-cpp: header-only fluent C++ frontend over the flat C API.
+ *
+ * Capability parity: reference cpp-package/include/mxnet-cpp/
+ * (SURVEY.md §2.6 "C++ package") — NDArray / Operator / Symbol /
+ * Executor / KVStore with RAII handles and a fluent Operator builder,
+ * so non-Python programs can build and run models against the TPU
+ * runtime the way the reference's cpp-package drove libmxnet.
+ *
+ * Everything maps 1:1 onto include/mxtpu/c_api.h; failures throw
+ * mxnet::cpp::Error carrying MXTPUGetLastError().
+ */
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw Error(std::string(what) + ": " + MXTPUGetLastError());
+  }
+}
+
+class Context {
+ public:
+  Context(int type, int id) : type_(type), id_(id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context tpu(int id = 0) { return Context(2, id); }
+  int type() const { return type_; }
+  int id() const { return id_; }
+
+ private:
+  int type_;
+  int id_;
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  NDArray(const std::vector<int64_t>& shape, const Context& ctx,
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(), static_cast<int>(shape.size()),
+                          dtype, ctx.type(), ctx.id(), &h),
+          "MXNDArrayCreate");
+    reset(h);
+  }
+
+  NDArray(const std::vector<int64_t>& shape, const float* data,
+          const Context& ctx) {
+    NDArrayHandle h = nullptr;
+    size_t n = 1;
+    for (int64_t d : shape) n *= static_cast<size_t>(d);
+    Check(MXNDArrayFromData(shape.data(),
+                            static_cast<int>(shape.size()), /*dtype=*/0,
+                            ctx.type(), ctx.id(), data,
+                            n * sizeof(float), &h),
+          "MXNDArrayFromData");
+    reset(h);
+  }
+
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  NDArrayHandle handle() const { return h_ ? h_.get() : nullptr; }
+  bool defined() const { return static_cast<bool>(h_); }
+
+  std::vector<int64_t> Shape() const {
+    int ndim = 0;
+    int64_t dims[16];
+    Check(MXNDArrayGetShape(handle(), &ndim, dims, 16),
+          "MXNDArrayGetShape");
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (int64_t d : Shape()) n *= static_cast<size_t>(d);
+    return n;
+  }
+
+  int DType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle(), &dt), "MXNDArrayGetDType");
+    return dt;
+  }
+
+  void SyncCopyToCPU(std::vector<float>* out) const {
+    /* same-width non-float dtypes (int32) would pass the byte-size
+     * check and memcpy raw bits into float storage — reject instead */
+    if (DType() != 0) {
+      throw Error("SyncCopyToCPU(vector<float>*): array dtype is not "
+                  "float32; convert with an astype op first");
+    }
+    out->resize(Size());
+    Check(MXNDArraySyncCopyToCPU(handle(), out->data(),
+                                 out->size() * sizeof(float)),
+          "MXNDArraySyncCopyToCPU");
+  }
+
+  void WaitToRead() const {
+    Check(MXNDArrayWaitToRead(handle()), "MXNDArrayWaitToRead");
+  }
+
+  static void WaitAll() { Check(MXNDArrayWaitAll(), "MXNDArrayWaitAll"); }
+
+  NDArray Copy() const {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArrayCopy(handle(), &out), "MXNDArrayCopy");
+    return FromHandle(out);
+  }
+
+  /* arithmetic sugar over imperative invoke */
+  friend NDArray operator+(const NDArray& a, const NDArray& b);
+  friend NDArray operator-(const NDArray& a, const NDArray& b);
+  friend NDArray operator*(const NDArray& a, const NDArray& b);
+
+ private:
+  void reset(NDArrayHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* Fluent imperative-op builder (parity: reference mxnet-cpp Operator):
+ *   auto out = Operator("FullyConnected")
+ *       .SetParam("num_hidden", 64)
+ *       .PushInput(x).PushInput(w).PushInput(b)
+ *       .Invoke()[0];
+ */
+class Operator {
+ public:
+  explicit Operator(const std::string& name) : name_(name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+    return *this;
+  }
+
+  Operator& PushInput(const NDArray& nd) {
+    inputs_.push_back(nd);
+    return *this;
+  }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<NDArrayHandle> in;
+    for (const auto& a : inputs_) in.push_back(a.handle());
+    std::vector<const char*> k, v;
+    for (const auto& s : keys_) k.push_back(s.c_str());
+    for (const auto& s : vals_) v.push_back(s.c_str());
+    NDArrayHandle outs[8];
+    int num_out = 0;
+    Check(MXImperativeInvoke(name_.c_str(),
+                             in.empty() ? nullptr : in.data(),
+                             static_cast<int>(in.size()),
+                             static_cast<int>(k.size()),
+                             k.empty() ? nullptr : k.data(),
+                             v.empty() ? nullptr : v.data(), &num_out,
+                             outs, 8),
+          name_.c_str());
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i)
+      result.push_back(NDArray::FromHandle(outs[i]));
+    return result;
+  }
+
+ private:
+  std::string name_;
+  std::vector<NDArray> inputs_;
+  std::vector<std::string> keys_, vals_;
+};
+
+inline NDArray _binary_op(const char* op, const NDArray& a,
+                          const NDArray& b) {
+  return Operator(op).PushInput(a).PushInput(b).Invoke()[0];
+}
+
+inline NDArray operator+(const NDArray& a, const NDArray& b) {
+  return _binary_op("broadcast_add", a, b);
+}
+inline NDArray operator-(const NDArray& a, const NDArray& b) {
+  return _binary_op("broadcast_sub", a, b);
+}
+inline NDArray operator*(const NDArray& a, const NDArray& b) {
+  return _binary_op("broadcast_mul", a, b);
+}
+
+inline NDArray dot(const NDArray& a, const NDArray& b) {
+  return _binary_op("dot", a, b);
+}
+
+class Executor;
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h),
+          "MXSymbolCreateVariable");
+    return FromHandle(h);
+  }
+
+  /* compose an op node: Symbol::Create("FullyConnected", "fc1",
+   *   {{"data", x}, {"weight", w}, {"bias", b}},
+   *   {{"num_hidden", "64"}}) */
+  static Symbol Create(
+      const std::string& op_name, const std::string& node_name,
+      const std::vector<std::pair<std::string, Symbol>>& inputs,
+      const std::map<std::string, std::string>& params = {}) {
+    std::vector<SymbolHandle> in_syms;
+    std::vector<const char*> in_names;
+    for (const auto& kv : inputs) {
+      in_names.push_back(kv.first.c_str());
+      in_syms.push_back(kv.second.handle());
+    }
+    std::vector<const char*> k, v;
+    for (const auto& kv : params) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    SymbolHandle out = nullptr;
+    Check(MXSymbolCompose(op_name.c_str(), node_name.c_str(),
+                          in_syms.data(), in_names.data(),
+                          static_cast<int>(in_syms.size()),
+                          static_cast<int>(k.size()),
+                          k.empty() ? nullptr : k.data(),
+                          v.empty() ? nullptr : v.data(), &out),
+          op_name.c_str());
+    return FromHandle(out);
+  }
+
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h),
+          "MXSymbolCreateFromJSON");
+    return FromHandle(h);
+  }
+
+  std::string ToJSON() const {
+    const char* out = nullptr;
+    Check(MXSymbolSaveToJSON(handle(), &out), "MXSymbolSaveToJSON");
+    return std::string(out);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    int count = 0;
+    const char** names = nullptr;
+    Check(MXSymbolListArguments(handle(), &count, &names),
+          "MXSymbolListArguments");
+    return std::vector<std::string>(names, names + count);
+  }
+
+  std::vector<std::string> ListOutputs() const {
+    int count = 0;
+    const char** names = nullptr;
+    Check(MXSymbolListOutputs(handle(), &count, &names),
+          "MXSymbolListOutputs");
+    return std::vector<std::string>(names, names + count);
+  }
+
+  inline Executor SimpleBind(const Context& ctx,
+                             const std::string& shapes_json,
+                             const std::string& grad_req = "write");
+
+  SymbolHandle handle() const { return h_ ? h_.get() : nullptr; }
+
+  static Symbol FromHandle(SymbolHandle h) {
+    Symbol s;
+    s.h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXSymbolFree(p);
+    });
+    return s;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+class Executor {
+ public:
+  Executor() = default;
+
+  static Executor Bind(const Symbol& sym, const Context& ctx,
+                       const std::string& shapes_json,
+                       const std::string& grad_req = "write") {
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorSimpleBind(sym.handle(), shapes_json.c_str(),
+                               ctx.type(), ctx.id(), grad_req.c_str(),
+                               &h),
+          "MXExecutorSimpleBind");
+    Executor e;
+    e.h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXExecutorFree(p);
+    });
+    return e;
+  }
+
+  void SetArg(const std::string& name, const NDArray& value) {
+    Check(MXExecutorSetArg(handle(), name.c_str(), value.handle()),
+          "MXExecutorSetArg");
+  }
+
+  std::vector<NDArray> Forward(bool is_train = false) {
+    NDArrayHandle outs[8];
+    int num_out = 0;
+    Check(MXExecutorForward(handle(), is_train ? 1 : 0, &num_out, outs,
+                            8),
+          "MXExecutorForward");
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i)
+      result.push_back(NDArray::FromHandle(outs[i]));
+    return result;
+  }
+
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (const auto& a : head_grads) hg.push_back(a.handle());
+    Check(MXExecutorBackward(handle(),
+                             hg.empty() ? nullptr : hg.data(),
+                             static_cast<int>(hg.size())),
+          "MXExecutorBackward");
+  }
+
+  NDArray GetGrad(const std::string& name) {
+    NDArrayHandle out = nullptr;
+    Check(MXExecutorGetGrad(handle(), name.c_str(), &out),
+          "MXExecutorGetGrad");
+    return NDArray::FromHandle(out);
+  }
+
+  ExecutorHandle handle() const { return h_ ? h_.get() : nullptr; }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+inline Executor Symbol::SimpleBind(const Context& ctx,
+                                   const std::string& shapes_json,
+                                   const std::string& grad_req) {
+  return Executor::Bind(*this, ctx, shapes_json, grad_req);
+}
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    KVStoreHandle h = nullptr;
+    Check(MXKVStoreCreate(type.c_str(), &h), "MXKVStoreCreate");
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXKVStoreFree(p);
+    });
+  }
+
+  void Init(int key, const NDArray& value) {
+    Check(MXKVStoreInit(handle(), key, value.handle()), "MXKVStoreInit");
+  }
+
+  void Push(int key, const NDArray& value) {
+    Check(MXKVStorePush(handle(), key, value.handle()), "MXKVStorePush");
+  }
+
+  void Pull(int key, NDArray* out) {
+    Check(MXKVStorePull(handle(), key, out->handle()), "MXKVStorePull");
+  }
+
+  KVStoreHandle handle() const { return h_ ? h_.get() : nullptr; }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_MXNETCPP_H_

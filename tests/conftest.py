@@ -48,3 +48,28 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip_tpu)
+
+
+def compile_and_run_c(sources, exe_path, compiler="gcc",
+                      extra_flags=(), timeout=300):
+    """Shared scaffold for standalone C/C++ programs linked against
+    libmxtpu.so (used by test_c_api.py and test_cpp_package.py): builds
+    with the repo include dirs + rpath, runs with the embedded
+    interpreter's PYTHONPATH, returns CompletedProcess."""
+    import subprocess
+    import sys as _sys
+    import numpy as _np
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [compiler, "-O1", "-Wall",
+           "-I", os.path.join(repo, "include"),
+           "-I", os.path.join(repo, "cpp-package", "include"),
+           *extra_flags, "-o", exe_path, *sources,
+           "-L", os.path.join(repo, "mxnet_tpu", "lib"), "-lmxtpu",
+           f"-Wl,-rpath,{os.path.join(repo, 'mxnet_tpu/lib')}"]
+    subprocess.run(cmd, check=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    site = os.path.dirname(os.path.dirname(_np.__file__))
+    env["PYTHONPATH"] = os.pathsep.join([repo, site] + _sys.path[1:])
+    return subprocess.run([exe_path], env=env, capture_output=True,
+                          text=True, timeout=timeout)

@@ -128,19 +128,9 @@ class TestInProcessCAPI:
 @pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
 class TestStandaloneCProgram:
     def test_mlp_smoke(self, tmp_path):
-        exe = str(tmp_path / "mlp_smoke")
-        subprocess.run(
-            ["gcc", "-O1", "-Wall", "-I", os.path.join(REPO, "include"),
-             "-o", exe, os.path.join(REPO, "tests/c_smoke/mlp_smoke.c"),
-             "-L", os.path.join(REPO, "mxnet_tpu/lib"), "-lmxtpu",
-             f"-Wl,-rpath,{os.path.join(REPO, 'mxnet_tpu/lib')}"],
-            check=True)
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        site = os.path.dirname(os.path.dirname(np.__file__))
-        env["PYTHONPATH"] = os.pathsep.join(
-            [REPO, site] + sys.path[1:])
-        out = subprocess.run([exe], env=env, capture_output=True,
-                             text=True, timeout=300)
+        from conftest import compile_and_run_c
+        out = compile_and_run_c(
+            [os.path.join(REPO, "tests/c_smoke/mlp_smoke.c")],
+            str(tmp_path / "mlp_smoke"))
         assert out.returncode == 0, out.stdout + out.stderr
         assert "C SMOKE TEST PASSED" in out.stdout

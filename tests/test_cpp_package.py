@@ -1,0 +1,28 @@
+"""cpp-package fluent C++ frontend test (parity: reference
+cpp-package/example — SURVEY.md §2.6 "C++ package").  Compiles
+cpp-package/example/mlp.cpp with g++ against the header-only API +
+libmxtpu.so and runs it standalone: Symbol building, SimpleBind,
+forward/backward, fluent Operator SGD updates, KVStore — all from C++.
+"""
+import os
+import shutil
+
+import pytest
+
+from mxnet_tpu import _native
+from conftest import compile_and_run_c
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not _native.available() or shutil.which("g++") is None,
+    reason="libmxtpu.so or g++ unavailable")
+
+
+def test_cpp_mlp_trains(tmp_path):
+    out = compile_and_run_c(
+        [os.path.join(REPO, "cpp-package", "example", "mlp.cpp")],
+        str(tmp_path / "cpp_mlp"), compiler="g++",
+        extra_flags=("-std=c++14",))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CPP PACKAGE TEST PASSED" in out.stdout
