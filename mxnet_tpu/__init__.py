@@ -8,7 +8,7 @@ see SURVEY.md §7 for the design stance.
     import mxnet_tpu as mx
     x = mx.nd.ones((2, 3), ctx=mx.tpu())
 """
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 import sys as _sys
 

@@ -289,7 +289,18 @@ class KVStoreTPUSync(KVStore):
                     stored._data))
 
     def _merge(self, k, values):
-        merged = super()._merge(k, values)
+        if self.num_workers > 1 and self._compression is not None:
+            # dist semantics: compression applies ONCE per worker to
+            # the value crossing the wire (the reference compresses the
+            # worker's ZPush, not the intra-host device reduction)
+            root_ctx = self._store[k].context
+            vals = [v.as_in_context(root_ctx) for v in values]
+            local = vals[0] if len(vals) == 1 else nd.add_n(*vals)
+            merged = NDArray(
+                self._compression.compress(f"{k}:dist", local._data),
+                ctx=root_ctx)
+        else:
+            merged = super()._merge(k, values)
         if self.num_workers > 1:
             # cross-host allreduce over DCN: allgather + sum is the
             # portable spelling; on a pod slice XLA lowers it to ICI

@@ -59,6 +59,20 @@ def main():
     kv.pull("init_bc", out=got_bc)
     np.testing.assert_allclose(got_bc.asnumpy(), 1.0)  # rank 0's value
 
+    # 2c. gradient compression on the cross-process hop: 0.3 pushes
+    # quantize to 0 (residual 0.3); the second push sees 0.6 -> snaps
+    # to +0.5 per worker -> aggregate n*0.5 (error feedback carried)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("comp", nd.zeros((4,)))
+    kv.push("comp", nd.full((4,), 0.3))
+    got_c = nd.zeros((4,))
+    kv.pull("comp", out=got_c)
+    np.testing.assert_allclose(got_c.asnumpy(), 0.0)
+    kv.push("comp", nd.full((4,), 0.3))
+    kv.pull("comp", out=got_c)
+    np.testing.assert_allclose(got_c.asnumpy(), 0.5 * n)
+    kv._compression = None  # back to plain aggregation for part 3
+
     # 3. barrier then server-side-updater path (optimizer on store)
     kv._barrier()
     kv2_key = "u"

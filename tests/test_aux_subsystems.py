@@ -211,3 +211,38 @@ class TestCallbacks:
         cb = do_checkpoint(str(tmp_path / "cp"))
         cb(0, None, {"w": nd.ones((2,))}, {})
         assert os.path.exists(str(tmp_path / "cp-0001.params"))
+
+
+class TestTools:
+    def test_parse_log(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        log = tmp_path / "train.log"
+        log.write_text(
+            "epoch 0: train-accuracy=0.91 (3.2s)\n"
+            "Epoch[0] Validation-accuracy=0.89\n"
+            "Epoch[1] Speed: 1543.21 samples/sec\n"
+            "Epoch[1] Train-accuracy=0.95\n")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "parse_log.py"),
+             str(log), "--format", "csv"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0
+        lines = out.stdout.strip().splitlines()
+        assert lines[0] == "epoch,speed,train-accuracy,validation-accuracy"
+        assert lines[1].startswith("0,") and "0.91" in lines[1]
+        assert lines[2].startswith("1,1543.21")
+
+    def test_diagnose_runs(self):
+        import subprocess
+        import sys as _sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "diagnose.py")],
+            capture_output=True, text=True, timeout=240, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "native lib   :" in out.stdout  # built OR fallback note
+        assert "backend      : cpu" in out.stdout

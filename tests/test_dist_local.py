@@ -55,3 +55,16 @@ def test_launcher_propagates_worker_failure():
         capture_output=True, text=True, timeout=60)
     assert res.returncode == 1
     assert "exited with 3" in res.stderr
+
+
+def test_worker_crash_is_detected_not_hung():
+    """Fault injection (SURVEY §5 failure detection): rank 1 dies
+    after round 1; the launcher reports the non-zero exit, and rank 0's
+    next collective raises instead of hanging forever."""
+    res = _run_launcher(2, "dist_worker_crash.py", timeout=300)
+    sys.stderr.write(res.stdout[-2000:] + res.stderr[-2000:])
+    assert res.returncode != 0          # crash propagated
+    assert "exited with 17" in res.stderr or "exited with 17" in res.stdout
+    assert res.stdout.count("ROUND1_OK") == 2
+    assert "SURVIVOR_DETECTED_FAILURE" in res.stdout
+    assert "SURVIVOR_NO_ERROR" not in res.stdout

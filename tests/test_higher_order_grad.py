@@ -1,0 +1,95 @@
+"""Higher-order gradients (parity model:
+tests/python/unittest/test_higher_order_grad.py — SURVEY.md §4;
+VERDICT r1 missing #6: ``create_graph=True`` grad-of-grad)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _second_derivative(fn, d2_oracle, x_np):
+    """autograd.grad(create_graph=True) then backward → d2/dx2."""
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        (dydx,) = autograd.grad(y, [x], create_graph=True)
+        z = dydx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), d2_oracle(x_np),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sin_second_derivative():
+    _second_derivative(lambda x: nd.sin(x).sum(),
+                       lambda a: -np.sin(a),
+                       np.linspace(-2, 2, 7).astype("f4"))
+
+
+def test_cos_second_derivative():
+    _second_derivative(lambda x: nd.cos(x).sum(),
+                       lambda a: -np.cos(a),
+                       np.linspace(-2, 2, 7).astype("f4"))
+
+
+def test_exp_log_second_derivative():
+    _second_derivative(lambda x: nd.exp(x).sum(),
+                       lambda a: np.exp(a),
+                       np.linspace(-1, 1, 5).astype("f4"))
+    _second_derivative(lambda x: nd.log(x).sum(),
+                       lambda a: -1.0 / a ** 2,
+                       np.linspace(0.5, 3, 5).astype("f4"))
+
+
+def test_polynomial_third_derivative():
+    """d3/dx3 of x^4 = 24 x via three nested grads."""
+    x = nd.array(np.array([1.0, 2.0, -1.5], "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        (g1,) = autograd.grad(y, [x], create_graph=True)
+        (g2,) = autograd.grad(g1.sum(), [x], create_graph=True)
+        z = g2.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               24.0 * x.asnumpy(), rtol=1e-4)
+
+
+def test_sigmoid_second_derivative():
+    def sig(a):
+        return 1.0 / (1.0 + np.exp(-a))
+
+    a = np.linspace(-2, 2, 9).astype("f4")
+    _second_derivative(
+        lambda x: nd.sigmoid(x).sum(),
+        lambda a: sig(a) * (1 - sig(a)) * (1 - 2 * sig(a)), a)
+
+
+def test_grad_through_matmul_chain():
+    """Hessian-vector-product style: d/dW of ||X W||^2's gradient."""
+    rng = np.random.RandomState(0)
+    Xn = rng.rand(4, 3).astype("f4")
+    Wn = rng.rand(3, 2).astype("f4")
+    X, W = nd.array(Xn), nd.array(Wn)
+    W.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.dot(X, W) ** 2)
+        (dW,) = autograd.grad(y, [W], create_graph=True)
+        z = (dW ** 2).sum()
+    z.backward()
+    # d/dW sum((2 X^T X W)^2) = 8 (X^T X)^2 W
+    G = Xn.T @ Xn
+    want = 8.0 * G @ G @ Wn
+    np.testing.assert_allclose(W.grad.asnumpy(), want, rtol=1e-3)
+
+
+def test_create_graph_false_stops_tape():
+    x = nd.array(np.array([1.0, 2.0], "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        (g1,) = autograd.grad(y, [x], create_graph=False)
+    assert g1._ag_node is None  # not on the tape
+    np.testing.assert_allclose(g1.asnumpy(), 3.0 * x.asnumpy() ** 2,
+                               rtol=1e-5)

@@ -3,6 +3,7 @@ tests run through ctypes — threaded_engine_test.cc's dependency-ordering
 and stress cases, storage_test.cc's pooling, recordio framing interop
 (SURVEY.md §4 "C++ unit tests")."""
 import os
+import shutil
 import threading
 
 import numpy as np
@@ -379,3 +380,23 @@ class TestPipelineEngine:
         assert c is a
         np.testing.assert_array_equal(c, 0.0)
         st.close()
+
+
+class TestTsan:
+    """Race detection (SURVEY §5 sanitizers): engine ordering must be
+    TSAN-clean under reader/writer stress."""
+
+    @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+    @pytest.mark.slow
+    def test_engine_stress_under_tsan(self):
+        import subprocess
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        subprocess.run(["make", "-C", os.path.join(repo, "src"),
+                        "tsan"], check=True, capture_output=True)
+        exe = os.path.join(repo, "mxnet_tpu", "lib",
+                           "engine_stress_tsan")
+        out = subprocess.run([exe], capture_output=True, text=True,
+                             timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "TSAN STRESS PASSED" in out.stdout
+        assert "WARNING: ThreadSanitizer" not in out.stderr

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Parse training logs into a metrics table (parity: reference
+tools/parse_log.py — SURVEY.md §2.6 "Tools").
+
+Understands the framework's standard log lines:
+
+    epoch 3: train-accuracy=0.9312 (12.4s)
+    Epoch[3] Validation-accuracy=0.9101
+    Epoch[3] Speed: 1543.21 samples/sec
+
+Usage: python tools/parse_log.py train.log [--format md|csv]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from collections import defaultdict
+
+_PATTERNS = [
+    # reference Module.fit style
+    re.compile(r"Epoch\[(?P<epoch>\d+)\]\s+"
+               r"(?P<split>Train|Validation)-(?P<metric>[\w-]+)="
+               r"(?P<value>[0-9.eE+-]+)"),
+    re.compile(r"Epoch\[(?P<epoch>\d+)\]\s+Speed:\s*"
+               r"(?P<value>[0-9.eE+-]+)\s*samples/sec"),
+    # example/train_mnist.py style
+    re.compile(r"epoch (?P<epoch>\d+): (?P<split>train|validation)-"
+               r"(?P<metric>[\w-]+)=(?P<value>[0-9.eE+-]+)"),
+]
+
+
+def parse(lines):
+    """list of log lines → {epoch: {column: value}}."""
+    table = defaultdict(dict)
+    for line in lines:
+        for pat in _PATTERNS:
+            m = pat.search(line)
+            if not m:
+                continue
+            d = m.groupdict()
+            epoch = int(d["epoch"])
+            if "metric" in d and d.get("metric"):
+                col = f"{d['split'].lower()}-{d['metric']}"
+            else:
+                col = "speed"
+            table[epoch][col] = float(d["value"])
+            break
+    return dict(table)
+
+
+def render(table, fmt="md"):
+    cols = sorted({c for row in table.values() for c in row})
+    out = []
+    if fmt == "md":
+        out.append("| epoch | " + " | ".join(cols) + " |")
+        out.append("|" + "---|" * (len(cols) + 1))
+        for e in sorted(table):
+            vals = [f"{table[e].get(c, float('nan')):.6g}" for c in cols]
+            out.append(f"| {e} | " + " | ".join(vals) + " |")
+    else:
+        out.append("epoch," + ",".join(cols))
+        for e in sorted(table):
+            vals = [f"{table[e].get(c, float('nan')):.6g}" for c in cols]
+            out.append(f"{e}," + ",".join(vals))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="md", choices=["md", "csv"])
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        table = parse(f)
+    if not table:
+        print("no metric lines recognized", file=sys.stderr)
+        return 1
+    try:
+        print(render(table, args.format))
+    except BrokenPipeError:  # e.g. piped into head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
