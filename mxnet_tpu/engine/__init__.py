@@ -62,7 +62,13 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict) -> Callable:
             fn = _jit_cache.get(key)
             if fn is None:
                 bound = functools.partial(fcompute, **attrs) if attrs else fcompute
-                fn = __import__("jax").jit(bound)
+                # ops that orchestrate their own device placement /
+                # inner jit (ring attention's shard_map over a mesh)
+                # must not be wrapped in an outer single-device jit
+                if getattr(fcompute, "_mxtpu_no_jit", False):
+                    fn = bound
+                else:
+                    fn = __import__("jax").jit(bound)
                 _jit_cache[key] = fn
     return fn
 

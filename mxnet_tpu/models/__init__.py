@@ -6,7 +6,11 @@ from .bert import BERTModel, BERTForPretrain, bert_base, bert_small, \
     bert_large, get_bert
 from . import forecast
 from .forecast import DeepAR, TransformerForecaster
+from . import llama
+from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
+                    llama_tiny, llama3_8b)
 
 __all__ = ["bert", "BERTModel", "BERTForPretrain", "bert_base",
            "bert_small", "bert_large", "get_bert", "forecast",
-           "DeepAR", "TransformerForecaster"]
+           "DeepAR", "TransformerForecaster", "llama", "LlamaModel",
+           "LlamaForCausalLM", "get_llama", "llama_tiny", "llama3_8b"]

@@ -1,0 +1,231 @@
+"""Llama-family decoder-only LMs (BASELINE config #5 "Llama-3-8B via
+Gluon Blocks" — SURVEY.md §2.6 "External zoos" stretch target).
+
+TPU-first design:
+
+* RMSNorm / RoPE / fused SDPA are single registered ops (XLA fuses the
+  rest); attention takes the flash path on chip, and the whole
+  next-token-prediction step hybridizes to one XLA program.
+* **Grouped-query attention**: ``num_kv_heads < num_heads`` shrinks the
+  KV projections (Llama-3's layout); KV heads are broadcast to query
+  heads inside the compiled graph.
+* **Long context is first-class**: ``attn_impl="ring"`` routes
+  attention through the SPMD ring-attention kernel over a
+  sequence-parallel mesh axis (``sp``), so sequences shard across
+  devices (SURVEY §5 long-context row).
+* ``llama3_8b()`` builds the real 8B geometry — on a single v5e it is
+  for sharded meshes/dryruns; ``llama_tiny`` trains in tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["LlamaModel", "LlamaForCausalLM", "RMSNormBlock",
+           "get_llama", "llama_tiny", "llama3_8b"]
+
+
+class RMSNormBlock(HybridBlock):
+    """RMSNorm with learned gamma (Llama's norm; op: ``RMSNorm``)."""
+
+    def __init__(self, units, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = eps
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(units,),
+                                         init="ones")
+
+    def hybrid_forward(self, F, x, gamma=None):
+        return F.RMSNorm(x, gamma, eps=self._eps)
+
+
+class _LlamaAttention(HybridBlock):
+    def __init__(self, units, num_heads, num_kv_heads, rope_base,
+                 attn_impl="sdpa", sp_axis="sp", **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} % num_heads {num_heads}")
+        if num_heads % num_kv_heads:
+            raise MXNetError("num_heads must be a multiple of "
+                             "num_kv_heads (GQA groups)")
+        self._h = num_heads
+        self._kv = num_kv_heads
+        self._d = units // num_heads
+        self._base = rope_base
+        self._impl = attn_impl
+        self._sp_axis = sp_axis
+        with self.name_scope():
+            self.q_proj = nn.Dense(num_heads * self._d, flatten=False,
+                                   use_bias=False, in_units=units,
+                                   prefix="q_")
+            self.k_proj = nn.Dense(num_kv_heads * self._d, flatten=False,
+                                   use_bias=False, in_units=units,
+                                   prefix="k_")
+            self.v_proj = nn.Dense(num_kv_heads * self._d, flatten=False,
+                                   use_bias=False, in_units=units,
+                                   prefix="v_")
+            self.o_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                   in_units=num_heads * self._d,
+                                   prefix="o_")
+
+    def hybrid_forward(self, F, x):
+        b, s = x.shape[0], x.shape[1]
+        h, kv, d = self._h, self._kv, self._d
+        q = F.rope(self.q_proj(x).reshape((b, s, h, d)),
+                   base=self._base)
+        k = F.rope(self.k_proj(x).reshape((b, s, kv, d)),
+                   base=self._base)
+        v = self.v_proj(x).reshape((b, s, kv, d))
+        if kv != h:  # GQA: broadcast each KV head to its query group
+            rep = h // kv
+            k = F.repeat(k, repeats=rep, axis=2)
+            v = F.repeat(v, repeats=rep, axis=2)
+        if self._impl == "ring":
+            from ..parallel.ring_attention import ring_attention_sharded
+            out = ring_attention_sharded(q, k, v, axis=self._sp_axis,
+                                         causal=True)
+        else:
+            out = F.dot_product_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape((b, s, h * d)))
+
+
+class _LlamaMLP(HybridBlock):
+    """SwiGLU feed-forward: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, units, hidden, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.gate_proj = nn.Dense(hidden, flatten=False,
+                                      use_bias=False, in_units=units,
+                                      prefix="gate_")
+            self.up_proj = nn.Dense(hidden, flatten=False,
+                                    use_bias=False, in_units=units,
+                                    prefix="up_")
+            self.down_proj = nn.Dense(units, flatten=False,
+                                      use_bias=False, in_units=hidden,
+                                      prefix="down_")
+
+    def hybrid_forward(self, F, x):
+        return self.down_proj(F.silu(self.gate_proj(x))
+                              * self.up_proj(x))
+
+
+class _LlamaLayer(HybridBlock):
+    def __init__(self, units, hidden, num_heads, num_kv_heads,
+                 rope_base, attn_impl, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.input_norm = RMSNormBlock(units, prefix="innorm_")
+            self.attn = _LlamaAttention(units, num_heads, num_kv_heads,
+                                        rope_base, attn_impl,
+                                        prefix="attn_")
+            self.post_norm = RMSNormBlock(units, prefix="postnorm_")
+            self.mlp = _LlamaMLP(units, hidden, prefix="mlp_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.input_norm(x))
+        return x + self.mlp(self.post_norm(x))
+
+
+class LlamaModel(HybridBlock):
+    def __init__(self, vocab_size, units, hidden, num_layers, num_heads,
+                 num_kv_heads=None, rope_base=10000.0,
+                 attn_impl="sdpa", **kwargs):
+        super().__init__(**kwargs)
+        num_kv_heads = num_kv_heads or num_heads
+        self._units = units
+        self.vocab_size = vocab_size
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units,
+                                      prefix="embed_")
+            self.layers = []
+            for i in range(num_layers):
+                layer = _LlamaLayer(units, hidden, num_heads,
+                                    num_kv_heads, rope_base, attn_impl,
+                                    prefix=f"layer{i}_")
+                self.register_child(layer, f"layer{i}")
+                self.layers.append(layer)
+            self.final_norm = RMSNormBlock(units, prefix="finalnorm_")
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        for layer in self.layers:
+            x = layer(x)
+        return self.final_norm(x)
+
+
+class LlamaForCausalLM(HybridBlock):
+    """LM head over LlamaModel.
+
+    ``tie_embeddings=True`` (default) shares the embedding matrix with
+    the head — the Llama-3.2-1B/3B layout.  Llama-3-8B/70B use an
+    UNTIED head: pass ``tie_embeddings=False`` with ``llama3_8b()``
+    (that separate head adds ~0.53B params on top of the model's
+    7.50B)."""
+
+    def __init__(self, model: LlamaModel, tie_embeddings=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._tied = tie_embeddings
+        with self.name_scope():
+            self.model = model
+            if not tie_embeddings:
+                self.lm_head = nn.Dense(model.vocab_size, flatten=False,
+                                        use_bias=False,
+                                        in_units=model._units,
+                                        prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        h = self.model(tokens)
+        if self._tied:
+            w = self.model.embed.weight.data(h.context)
+            b, s, u = h.shape
+            return F.dot(h.reshape((b * s, u)), w,
+                         transpose_b=True).reshape(
+                             (b, s, self.model.vocab_size))
+        return self.lm_head(h)
+
+    def loss(self, tokens, F=None):
+        """Next-token cross-entropy over ``tokens`` (B, S) → scalar."""
+        from .. import ndarray as nd
+        from ..gluon.loss import SoftmaxCrossEntropyLoss
+        logits = self(tokens)
+        sce = SoftmaxCrossEntropyLoss()
+        b, s, v = logits.shape
+        pred = nd.slice_axis(logits, axis=1, begin=0,
+                             end=-1).reshape((-1, v))
+        labels = nd.slice_axis(tokens, axis=1, begin=1,
+                               end=None).reshape((-1,))
+        return sce(pred, labels).mean()
+
+
+_LLAMA_SPECS = {
+    # test-size config (trains in seconds on the CPU backend)
+    "llama_tiny": dict(units=64, hidden=176, num_layers=2, num_heads=4,
+                       num_kv_heads=2, rope_base=10000.0),
+    # Llama-3-8B geometry (vocab passed by caller; default 128256)
+    "llama3_8b": dict(units=4096, hidden=14336, num_layers=32,
+                      num_heads=32, num_kv_heads=8,
+                      rope_base=500000.0),
+}
+
+
+def get_llama(name, vocab_size=32000, attn_impl="sdpa", **kwargs):
+    if name not in _LLAMA_SPECS:
+        raise MXNetError(f"unknown llama config {name!r}; options "
+                         f"{sorted(_LLAMA_SPECS)}")
+    spec = dict(_LLAMA_SPECS[name])
+    spec.update(kwargs)
+    return LlamaModel(vocab_size=vocab_size, attn_impl=attn_impl,
+                      **spec)
+
+
+def llama_tiny(**kwargs):
+    return get_llama("llama_tiny", **kwargs)
+
+
+def llama3_8b(vocab_size=128256, **kwargs):
+    return get_llama("llama3_8b", vocab_size=vocab_size, **kwargs)

@@ -94,3 +94,27 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
     att = attention.reshape(b, heads, s, s)
     out = jnp.einsum("bhst,tbhd->sbhd", att, v)
     return out.reshape(s, b, e)
+
+
+@register("rope", num_inputs=1)
+def rope(x, *, base=10000.0, offset=0):
+    """Rotary position embedding over (B, S, H, D) — rotates adjacent
+    feature pairs by position-dependent angles (Llama-family attention;
+    no reference analogue, the reference predates RoPE).
+
+    ``offset`` shifts positions (decode-time KV-cache continuation).
+    """
+    s, d = x.shape[1], x.shape[-1]
+    pos = jnp.arange(offset, offset + s, dtype=jnp.float32)
+    inv = jnp.power(
+        jnp.float32(base),
+        -jnp.arange(0, d, 2, dtype=jnp.float32) / jnp.float32(d))
+    ang = pos[:, None] * inv[None, :]                  # (S, D/2)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    # re-interleave pairs: (..., D/2, 2) -> (..., D)
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)

@@ -115,10 +115,58 @@ def ring_attention(q, k, v, mesh=None, axis="sp", scale=None,
     return jax.jit(fn)(q, k, v)
 
 
+_SHARDED_OPDEF_CACHE = {}
+
+
 def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
                            scale=None, causal=False):
-    """NDArray wrapper around :func:`ring_attention`."""
-    from ..ndarray.ndarray import NDArray
-    out = ring_attention(q_nd._data, k_nd._data, v_nd._data, mesh=mesh,
-                         axis=axis, scale=scale, causal=causal)
-    return NDArray(out, ctx=q_nd.context)
+    """NDArray wrapper around :func:`ring_attention` — on the autograd
+    tape, so training through the ring path gets real gradients.
+
+    When the inputs live on ONE device (eager model forward mixing
+    single-device weights with the SP mesh), the output is brought back
+    to that device — only the attention itself (the quadratic part)
+    runs sequence-sharded.  Fully-sharded callers keep the sharding.
+
+    Not usable inside a single-device CachedOp trace (hybridize): the
+    shard_map needs the mesh's devices, which a one-device jit cannot
+    provide — run eagerly, or inside a mesh-jitted SPMD step.
+    """
+    import jax
+    from ..base import MXNetError
+    from ..gluon.block import _is_tracing
+    from ..ndarray.ndarray import NDArray, invoke
+    from ..ops.registry import OpDef
+
+    if _is_tracing():
+        raise MXNetError(
+            "ring attention cannot run inside a single-device "
+            "hybridize/CachedOp trace; call the block unhybridized or "
+            "run it inside a mesh-jitted SPMD step")
+
+    mesh = mesh if mesh is not None else current_mesh()
+    try:
+        devs = q_nd._data.sharding.device_set
+        restore = (next(iter(devs)) if len(devs) == 1 else None)
+    except Exception:
+        restore = None
+
+    d = q_nd.shape[-1]
+    s = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+    key = (mesh, axis, s, bool(causal), restore)
+    op = _SHARDED_OPDEF_CACHE.get(key)
+    if op is None:
+        def fcompute(q, k, v):
+            out = ring_attention(q, k, v, mesh=mesh, axis=axis,
+                                 scale=s, causal=causal)
+            if restore is not None:
+                out = jax.device_put(out, restore)
+            return out
+
+        # placement (device_put to the mesh, restore to one device)
+        # happens inside fcompute — an outer single-device jit would
+        # reject the cross-device transfers
+        fcompute._mxtpu_no_jit = True
+        op = OpDef("_ring_attention", fcompute, 3, 1, (), False, None)
+        _SHARDED_OPDEF_CACHE[key] = op
+    return invoke(op, [q_nd, k_nd, v_nd])

@@ -1,0 +1,174 @@
+"""Llama-family model tests (BASELINE config #5 stretch: decoder-only
+LM with RMSNorm / RoPE / GQA / SwiGLU on the fused-attention path)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import LlamaForCausalLM, llama_tiny, llama3_8b
+
+
+V, B, S = 97, 8, 16
+
+
+def _tokens(seed=0, b=B, s=S):
+    rng = np.random.RandomState(seed)
+    return nd.array(rng.randint(0, V, (b, s)).astype("f4"))
+
+
+def _net(**kw):
+    net = LlamaForCausalLM(llama_tiny(vocab_size=V, **kw))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_forward_shapes_and_finite():
+    net = _net()
+    logits = net(_tokens())
+    assert logits.shape == (B, S, V)
+    assert np.isfinite(logits.asnumpy()).all()
+
+
+def test_causality():
+    """Changing a future token must not change earlier logits."""
+    net = _net()
+    t1 = _tokens(seed=1)
+    logits1 = net(t1).asnumpy()
+    t2_np = t1.asnumpy().copy()
+    t2_np[:, -1] = (t2_np[:, -1] + 1) % V
+    logits2 = net(nd.array(t2_np)).asnumpy()
+    np.testing.assert_allclose(logits1[:, :-1], logits2[:, :-1],
+                               rtol=1e-5, atol=1e-6)
+    assert np.abs(logits1[:, -1] - logits2[:, -1]).max() > 1e-4
+
+
+def test_rope_positions_matter():
+    """Without position information, causal attention over a permuted
+    prefix is a permutation-invariant bag at the last position; RoPE
+    must break that — swapping two prefix tokens changes the final
+    logits."""
+    net = _net()
+    a = np.array([[3, 7, 11, 2]], "f4")
+    b = np.array([[7, 3, 11, 2]], "f4")  # prefix swapped, suffix same
+    la = net(nd.array(a)).asnumpy()[0, -1]
+    lb = net(nd.array(b)).asnumpy()[0, -1]
+    assert np.abs(la - lb).max() > 1e-4
+
+
+def test_gqa_param_shapes():
+    net = _net()  # tiny config: 4 query heads, 2 kv heads, d=16
+    params = net.collect_params()
+    k_shapes = [p.shape for n, p in params.items() if "k_weight" in n]
+    q_shapes = [p.shape for n, p in params.items() if "q_weight" in n]
+    assert all(s[0] == 32 for s in k_shapes)   # kv heads * d = 2*16
+    assert all(s[0] == 64 for s in q_shapes)   # heads * d = 4*16
+
+
+def test_training_converges_hybridized():
+    net = _net()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    # a memorizable sequence set
+    toks = _tokens(seed=2)
+    losses = []
+    for _ in range(50):
+        with autograd.record():
+            loss = net.loss(toks)
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asnumpy()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 1.0, (losses[0], losses[-1])
+
+
+def test_eager_matches_hybrid():
+    net = _net()
+    toks = _tokens(seed=3)
+    eager = net(toks).asnumpy()
+    net.hybridize()
+    hybrid = net(toks).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_untied_head():
+    net = LlamaForCausalLM(llama_tiny(vocab_size=V),
+                           tie_embeddings=False)
+    net.initialize(mx.init.Xavier())
+    assert net(_tokens()).shape == (B, S, V)
+
+
+def test_llama3_8b_geometry():
+    """Config sanity only — the 8B spec is for sharded meshes."""
+    m = llama3_8b()
+    # count params from declared shapes (no allocation happens)
+    n = sum(int(np.prod(p.shape)) for _, p in
+            m.collect_params().items())
+    assert 7.5e9 < n < 8.6e9, f"llama3_8b has {n/1e9:.2f}B params"
+
+
+def test_ring_attention_impl_on_mesh():
+    """Long-context path: sequence-parallel ring attention over the
+    8-device CPU mesh inside the model forward."""
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh({"sp": 8})
+    parallel.set_mesh(mesh)
+    try:
+        net = LlamaForCausalLM(llama_tiny(vocab_size=V,
+                                          attn_impl="ring"))
+        net.initialize(mx.init.Xavier())
+        toks = _tokens(seed=4, b=2, s=64)  # 64 = 8 shards of 8
+        out = net(toks)
+        assert out.shape == (2, 64, V)
+        assert np.isfinite(out.asnumpy()).all()
+        # ring result matches the dense SDPA reference implementation
+        net2 = LlamaForCausalLM(llama_tiny(vocab_size=V))
+        net2.initialize(mx.init.Xavier())
+        # copy weights so both nets are identical
+        src = net.collect_params()
+        dst = net2.collect_params()
+        for (_, ps), (_, pd) in zip(sorted(src.items()),
+                                    sorted(dst.items())):
+            pd.set_data(ps.data())
+        np.testing.assert_allclose(net2(toks).asnumpy(),
+                                   out.asnumpy(), rtol=2e-4, atol=2e-5)
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_ring_attention_gradients_flow():
+    """The ring path must be on the tape: attention projections get
+    non-zero gradients (was silently zero before the invoke routing)."""
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh({"sp": 8})
+    parallel.set_mesh(mesh)
+    try:
+        net = LlamaForCausalLM(llama_tiny(vocab_size=V,
+                                          attn_impl="ring"))
+        net.initialize(mx.init.Xavier())
+        toks = _tokens(seed=5, b=2, s=64)
+        with autograd.record():
+            loss = net.loss(toks)
+        loss.backward()
+        params = net.collect_params()
+        for name, p in params.items():
+            if "q_weight" in name or "v_weight" in name:
+                g = np.abs(p.grad().asnumpy()).max()
+                assert g > 0, f"zero grad for {name}"
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_ring_attention_hybridize_raises_clearly():
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh({"sp": 8})
+    parallel.set_mesh(mesh)
+    try:
+        net = LlamaForCausalLM(llama_tiny(vocab_size=V,
+                                          attn_impl="ring"))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        with pytest.raises(mx.MXNetError, match="ring attention"):
+            net(_tokens(seed=6, b=2, s=64))
+    finally:
+        parallel.set_mesh(None)
