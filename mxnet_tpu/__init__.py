@@ -20,6 +20,16 @@ if _sys.getrecursionlimit() < 3000:
 
 import jax as _jax_config_only
 
+# The axon site hook re-registers itself into jax_platforms at import
+# time, overriding the JAX_PLATFORMS env var in every child process
+# (example smoke tests, dist workers, bench subprocesses).  Only an
+# in-process config pin wins, so honor the env var explicitly here —
+# before any backend init — mirroring tests/conftest.py.
+import os as _os
+if _os.environ.get("JAX_PLATFORMS"):
+    _jax_config_only.config.update(
+        "jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 # MXNet supports int64/float64 tensors; JAX demotes them unless x64 is
 # on.  x64 is OPT-IN (MXTPU_ENABLE_X64=1): on TPU it risks silent f64
 # promotion on hot paths where the MXU wants bf16/f32.  Weak-type

@@ -18,8 +18,6 @@ TPU-first design:
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..gluon import nn
@@ -79,15 +77,17 @@ class _LlamaAttention(HybridBlock):
         k = F.rope(self.k_proj(x).reshape((b, s, kv, d)),
                    base=self._base)
         v = self.v_proj(x).reshape((b, s, kv, d))
-        if kv != h:  # GQA: broadcast each KV head to its query group
-            rep = h // kv
-            k = F.repeat(k, repeats=rep, axis=2)
-            v = F.repeat(v, repeats=rep, axis=2)
         if self._impl == "ring":
+            # the ring kernel groups query heads per KV head internally,
+            # so only the small KV tensors travel the ICI ring
             from ..parallel.ring_attention import ring_attention_sharded
             out = ring_attention_sharded(q, k, v, axis=self._sp_axis,
                                          causal=True)
         else:
+            if kv != h:  # GQA: broadcast each KV head to its query group
+                rep = h // kv
+                k = F.repeat(k, repeats=rep, axis=2)
+                v = F.repeat(v, repeats=rep, axis=2)
             out = F.dot_product_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape((b, s, h * d)))
 
@@ -115,12 +115,13 @@ class _LlamaMLP(HybridBlock):
 
 class _LlamaLayer(HybridBlock):
     def __init__(self, units, hidden, num_heads, num_kv_heads,
-                 rope_base, attn_impl, **kwargs):
+                 rope_base, attn_impl, sp_axis="sp", **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.input_norm = RMSNormBlock(units, prefix="innorm_")
             self.attn = _LlamaAttention(units, num_heads, num_kv_heads,
                                         rope_base, attn_impl,
+                                        sp_axis=sp_axis,
                                         prefix="attn_")
             self.post_norm = RMSNormBlock(units, prefix="postnorm_")
             self.mlp = _LlamaMLP(units, hidden, prefix="mlp_")
@@ -133,7 +134,7 @@ class _LlamaLayer(HybridBlock):
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size, units, hidden, num_layers, num_heads,
                  num_kv_heads=None, rope_base=10000.0,
-                 attn_impl="sdpa", **kwargs):
+                 attn_impl="sdpa", sp_axis="sp", **kwargs):
         super().__init__(**kwargs)
         num_kv_heads = num_kv_heads or num_heads
         self._units = units
@@ -145,6 +146,7 @@ class LlamaModel(HybridBlock):
             for i in range(num_layers):
                 layer = _LlamaLayer(units, hidden, num_heads,
                                     num_kv_heads, rope_base, attn_impl,
+                                    sp_axis=sp_axis,
                                     prefix=f"layer{i}_")
                 self.register_child(layer, f"layer{i}")
                 self.layers.append(layer)
@@ -188,7 +190,7 @@ class LlamaForCausalLM(HybridBlock):
                              (b, s, self.model.vocab_size))
         return self.lm_head(h)
 
-    def loss(self, tokens, F=None):
+    def loss(self, tokens):
         """Next-token cross-entropy over ``tokens`` (B, S) → scalar."""
         from .. import ndarray as nd
         from ..gluon.loss import SoftmaxCrossEntropyLoss

@@ -96,16 +96,21 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, *,
     return out.reshape(s, b, e)
 
 
-@register("rope", num_inputs=1)
-def rope(x, *, base=10000.0, offset=0):
+@register("rope", num_inputs=1, scalar_attrs=("offset",),
+          scalar_ref_input=None)
+def rope(x, offset=0, *, base=10000.0):
     """Rotary position embedding over (B, S, H, D) — rotates adjacent
     feature pairs by position-dependent angles (Llama-family attention;
     no reference analogue, the reference predates RoPE).
 
-    ``offset`` shifts positions (decode-time KV-cache continuation).
+    ``offset`` shifts positions (decode-time KV-cache continuation); it
+    is a dynamic scalar attr so a generation loop stepping offset
+    0,1,2,... reuses one compiled executable instead of recompiling
+    per position.
     """
     s, d = x.shape[1], x.shape[-1]
-    pos = jnp.arange(offset, offset + s, dtype=jnp.float32)
+    pos = (jnp.arange(s, dtype=jnp.float32)
+           + jnp.asarray(offset, jnp.float32))
     inv = jnp.power(
         jnp.float32(base),
         -jnp.arange(0, d, 2, dtype=jnp.float32) / jnp.float32(d))

@@ -25,7 +25,10 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 def _ring_attention_local(q, k, v, axis_name, scale, causal_offset=None):
     """Per-shard body (runs inside shard_map).
 
-    q: (B, Sq_local, H, D); k/v: (B, Sk_local, H, D).
+    q: (B, Sq_local, H, D); k/v: (B, Sk_local, KV, D) with KV dividing H
+    (grouped-query attention: each KV head serves H//KV query heads).
+    Only the small KV-head tensors travel the ring — queries are grouped
+    by reshape instead of materializing repeated K/V.
     """
     import jax
     import jax.numpy as jnp
@@ -35,30 +38,37 @@ def _ring_attention_local(q, k, v, axis_name, scale, causal_offset=None):
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    m = jnp.full(q.shape[:2] + (q.shape[2], 1), -jnp.inf, jnp.float32)
-    # running (B, Sq, H, 1) max / sum and (B, Sq, H, D) accumulator
+    b, sq, h, d = q.shape
+    kv = k.shape[2]
+    g = h // kv
+    # (B, Sq, KV, G, D): query heads grouped under their KV head
+    qg = q.astype(jnp.float32).reshape(b, sq, kv, g, d)
+
+    m = jnp.full((b, sq, kv, g, 1), -jnp.inf, jnp.float32)
+    # running max / sum and (B, Sq, KV, G, D) accumulator
     l = jnp.zeros_like(m)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    acc = jnp.zeros(qg.shape, jnp.float32)
 
     def step(i, carry):
         k_cur, v_cur, m, l, acc = carry
         # K/V block currently held came from shard (my - i) mod n
         src = (my - i) % n
-        s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+        s = jnp.einsum("bqcgd,bkcd->bqcgk", qg,
                        k_cur.astype(jnp.float32)) * scale
         if causal_offset is not None:
-            sq, sk = q.shape[1], k_cur.shape[1]
+            sk = k_cur.shape[1]
             q_pos = my * sq + jax.lax.broadcasted_iota(
                 jnp.int32, (sq, sk), 0)
             k_pos = src * sk + jax.lax.broadcasted_iota(
                 jnp.int32, (sq, sk), 1)
-            s = jnp.where((q_pos >= k_pos)[None, :, None, :], s, -1e30)
+            s = jnp.where(
+                (q_pos >= k_pos)[None, :, None, None, :], s, -1e30)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + p.sum(axis=-1, keepdims=True)
         acc_new = alpha * acc + jnp.einsum(
-            "bqhk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            "bqcgk,bkcd->bqcgd", p, v_cur.astype(jnp.float32))
         # rotate K/V to the next device; overlapped with next-step compute
         # by XLA's async collectives
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -66,7 +76,7 @@ def _ring_attention_local(q, k, v, axis_name, scale, causal_offset=None):
         return k_nxt, v_nxt, m_new, l_new, acc_new
 
     _, _, m, l, acc = _unrolled(step, n, (k, v, m, l, acc))
-    return (acc / l).astype(q.dtype)
+    return (acc / l).reshape(q.shape).astype(q.dtype)
 
 
 def _unrolled(step, n, carry):
@@ -77,17 +87,40 @@ def _unrolled(step, n, carry):
     return carry
 
 
+# jit caches traces per function OBJECT — a fresh shard_map(partial(...))
+# every call would retrace+recompile per invocation (~200x measured on an
+# 8-device CPU mesh), so the jitted executable is cached per variant
+_RING_EXEC_CACHE = {}
+
+
+def _ring_executable(mesh, axis, scale, causal):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (mesh, axis, float(scale), bool(causal))
+    fn = _RING_EXEC_CACHE.get(key)
+    if fn is None:
+        spec = P(None, axis, None, None)
+        fn = jax.jit(shard_map(
+            partial(_ring_attention_local, axis_name=axis,
+                    scale=float(scale),
+                    causal_offset=True if causal else None),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        _RING_EXEC_CACHE[key] = fn
+    return fn
+
+
 def ring_attention(q, k, v, mesh=None, axis="sp", scale=None,
                    causal=False):
     """SPMD ring attention over sequence-sharded jax arrays.
 
-    q/k/v: (B, S_global, H, D) jax arrays (sharded or to-be-sharded along
-    the sequence dim over ``axis``).  Returns (B, S_global, H, D) with the
-    same sharding.
+    q: (B, S_global, H, D); k/v: (B, S_global, KV, D) with KV dividing H
+    (KV == H is plain multi-head attention), sharded or to-be-sharded
+    along the sequence dim over ``axis``.  Returns (B, S_global, H, D)
+    with the same sharding.
     """
     import jax
-    import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh if mesh is not None else current_mesh()
@@ -98,24 +131,22 @@ def ring_attention(q, k, v, mesh=None, axis="sp", scale=None,
         raise MXNetError(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis!r} size {n}")
+    if q.shape[2] % k.shape[2]:
+        raise MXNetError(
+            f"query heads {q.shape[2]} not a multiple of KV heads "
+            f"{k.shape[2]}")
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
 
-    spec = P(None, axis, None, None)
-    fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis,
-                scale=float(scale),
-                causal_offset=True if causal else None),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-
-    sharding = NamedSharding(mesh, spec)
+    sharding = NamedSharding(mesh, P(None, axis, None, None))
     q = jax.device_put(q, sharding)
     k = jax.device_put(k, sharding)
     v = jax.device_put(v, sharding)
-    return jax.jit(fn)(q, k, v)
+    return _ring_executable(mesh, axis, scale, causal)(q, k, v)
 
 
 _SHARDED_OPDEF_CACHE = {}
+_OPDEF_SEQ = __import__("itertools").count()
 
 
 def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
@@ -135,7 +166,7 @@ def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
     import jax
     from ..base import MXNetError
     from ..gluon.block import _is_tracing
-    from ..ndarray.ndarray import NDArray, invoke
+    from ..ndarray.ndarray import invoke
     from ..ops.registry import OpDef
 
     if _is_tracing():
@@ -167,6 +198,11 @@ def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
         # happens inside fcompute — an outer single-device jit would
         # reject the cross-device transfers
         fcompute._mxtpu_no_jit = True
-        op = OpDef("_ring_attention", fcompute, 3, 1, (), False, None)
+        # engine.get_compiled caches executables by (op.name, attrs), so
+        # the name must be unique per (mesh, axis, scale, causal, restore)
+        # variant — a shared name would silently reuse the first-compiled
+        # closure for every later variant
+        op = OpDef("_ring_attention_%d" % next(_OPDEF_SEQ),
+                   fcompute, 3, 1, (), False, None)
         _SHARDED_OPDEF_CACHE[key] = op
     return invoke(op, [q_nd, k_nd, v_nd])
