@@ -1,6 +1,10 @@
-"""``mx.contrib`` (SURVEY.md §2.5 contrib): amp, quantization; ONNX is a
-documented capability gap (needs the onnx package / network)."""
+"""``mx.contrib`` (SURVEY.md §2.5 contrib): amp, quantization, onnx.
+
+ONNX works fully offline — the protobuf wire format is implemented
+in-repo (``contrib/onnx/_proto.py``), so no onnx package is needed.
+"""
 from . import amp
 from . import quantization
+from . import onnx
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "onnx"]

@@ -1,0 +1,15 @@
+"""ONNX interop (parity: reference ``python/mxnet/contrib/onnx/`` —
+SURVEY.md §2.5 "Contrib: ONNX").
+
+Works fully offline: the protobuf wire format is implemented in-repo
+(``_proto``), so neither export nor import needs the onnx package.
+
+    from mxnet_tpu.contrib import onnx as onnx_mxnet
+    onnx_mxnet.export_model(sym, params, [(1, 3, 224, 224)],
+                            onnx_file_path="net.onnx")
+    sym, arg_params, aux_params = onnx_mxnet.import_model("net.onnx")
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

@@ -1,0 +1,426 @@
+"""Self-contained ONNX protobuf wire codec.
+
+The environment ships no ``onnx`` package, so this module hand-encodes
+the (small, stable) subset of the ONNX schema the exporter/importer
+need: ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto/TypeProto and OperatorSetIdProto — using the protobuf
+wire format directly (field tag = (num << 3) | wire_type; wire 0 =
+varint, 2 = length-delimited, 5 = 32-bit).  Field numbers follow
+onnx/onnx.proto (IR version 8, default opset 17).
+
+Parity: the reference drives ``python/mxnet/contrib/onnx/`` through the
+installed onnx package (SURVEY.md §2.5 "Contrib: ONNX"); this rebuild
+owns the byte format so the capability exists offline.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ...base import MXNetError
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _uvarint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement int64, per proto spec
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _uvarint((field << 3) | wire)
+
+
+def enc_varint(field: int, val: int) -> bytes:
+    return _tag(field, 0) + _uvarint(int(val))
+
+
+def enc_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _uvarint(len(data)) + data
+
+
+def enc_str(field: int, s: str) -> bytes:
+    return enc_bytes(field, s.encode("utf-8"))
+
+
+def enc_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+# ---------------------------------------------------------------------------
+# dtype mapping (TensorProto.DataType)
+# ---------------------------------------------------------------------------
+
+ONNX_DTYPE: Dict[str, int] = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+NP_OF_ONNX: Dict[int, str] = {v: k for k, v in ONNX_DTYPE.items()}
+
+
+def dtype_enum(dt) -> int:
+    name = np.dtype(dt).name if not isinstance(dt, str) else dt
+    try:
+        return ONNX_DTYPE[name]
+    except KeyError:
+        raise MXNetError(f"dtype {name!r} has no ONNX mapping") from None
+
+
+# ---------------------------------------------------------------------------
+# message builders (each returns the raw message bytes; callers wrap with
+# enc_bytes(field, ...) to embed)
+# ---------------------------------------------------------------------------
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(enc_varint(1, d) for d in arr.shape)
+    out += enc_varint(2, dtype_enum(arr.dtype))
+    out += enc_str(8, name)
+    out += enc_bytes(9, arr.tobytes())  # raw_data, little-endian
+    return out
+
+
+# AttributeProto.AttributeType
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS, _AT_STRINGS = 6, 7, 8
+
+
+def attribute(name: str, value: Any) -> bytes:
+    out = enc_str(1, name)
+    if isinstance(value, bool):
+        out += enc_varint(3, int(value)) + enc_varint(20, _AT_INT)
+    elif isinstance(value, (int, np.integer)):
+        out += enc_varint(3, int(value)) + enc_varint(20, _AT_INT)
+    elif isinstance(value, (float, np.floating)):
+        out += enc_float(2, value) + enc_varint(20, _AT_FLOAT)
+    elif isinstance(value, str):
+        out += enc_bytes(4, value.encode()) + enc_varint(20, _AT_STRING)
+    elif isinstance(value, bytes):
+        out += enc_bytes(4, value) + enc_varint(20, _AT_STRING)
+    elif isinstance(value, np.ndarray):
+        out += enc_bytes(5, tensor("", value)) + enc_varint(20, _AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and all(isinstance(v, (float, np.floating))
+                         for v in value):
+            for v in value:
+                out += enc_float(7, v)
+            out += enc_varint(20, _AT_FLOATS)
+        elif all(isinstance(v, (int, np.integer, bool)) for v in value):
+            for v in value:
+                out += enc_varint(8, int(v))
+            out += enc_varint(20, _AT_INTS)
+        elif all(isinstance(v, str) for v in value):
+            for v in value:
+                out += enc_bytes(9, v.encode())
+            out += enc_varint(20, _AT_STRINGS)
+        else:
+            raise MXNetError(f"attribute {name}: unsupported list {value!r}")
+    else:
+        raise MXNetError(f"attribute {name}: unsupported {type(value)}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Dict[str, Any] | None = None,
+         domain: str = "") -> bytes:
+    out = b"".join(enc_str(1, i) for i in inputs)
+    out += b"".join(enc_str(2, o) for o in outputs)
+    if name:
+        out += enc_str(3, name)
+    out += enc_str(4, op_type)
+    for k in sorted(attrs or {}):
+        out += enc_bytes(5, attribute(k, attrs[k]))
+    if domain:
+        out += enc_str(7, domain)
+    return out
+
+
+def _tensor_shape(shape: Sequence[int | str | None]) -> bytes:
+    out = b""
+    for d in shape:
+        if isinstance(d, (int, np.integer)):
+            dim = enc_varint(1, int(d))
+        else:  # symbolic / unknown dimension
+            dim = enc_str(2, str(d) if d is not None else "?")
+        out += enc_bytes(1, dim)
+    return out
+
+
+def value_info(name: str, elem_type: int,
+               shape: Sequence[int | str | None]) -> bytes:
+    tens = enc_varint(1, elem_type) + enc_bytes(2, _tensor_shape(shape))
+    type_proto = enc_bytes(1, tens)  # TypeProto.tensor_type
+    return enc_str(1, name) + enc_bytes(2, type_proto)
+
+
+def graph(nodes: Sequence[bytes], name: str,
+          inputs: Sequence[bytes], outputs: Sequence[bytes],
+          initializers: Sequence[bytes]) -> bytes:
+    out = b"".join(enc_bytes(1, n) for n in nodes)
+    out += enc_str(2, name)
+    out += b"".join(enc_bytes(5, t) for t in initializers)
+    out += b"".join(enc_bytes(11, i) for i in inputs)
+    out += b"".join(enc_bytes(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 17,
+          producer: str = "mxnet_tpu", ir_version: int = 8) -> bytes:
+    out = enc_varint(1, ir_version)
+    out += enc_str(2, producer)
+    out += enc_str(3, "0.2")
+    out += enc_bytes(7, graph_bytes)
+    out += enc_bytes(8, enc_varint(2, opset))  # OperatorSetId{domain="",v}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# generic reader
+# ---------------------------------------------------------------------------
+
+
+def decode_fields(buf: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    """Parse one message into {field: [(wire, value), ...]} preserving
+    order within each field.  varint→int, LEN→bytes, 32/64-bit→bytes."""
+    fields: Dict[int, List[Tuple[int, Any]]] = {}
+    pos, n = 0, len(buf)
+    while pos < n:
+        key, pos = _read_uvarint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_uvarint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_uvarint(buf, pos)
+            val, pos = buf[pos:pos + ln], pos + ln
+        elif wire == 5:
+            val, pos = buf[pos:pos + 4], pos + 4
+        elif wire == 1:
+            val, pos = buf[pos:pos + 8], pos + 8
+        else:
+            raise MXNetError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, val))
+    return fields
+
+
+def _read_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise MXNetError("varint overflow")
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def get_int(fields, num, default=0) -> int:
+    vals = fields.get(num)
+    return _signed64(vals[-1][1]) if vals else default
+
+
+def get_str(fields, num, default="") -> str:
+    vals = fields.get(num)
+    return vals[-1][1].decode("utf-8") if vals else default
+
+
+def get_strs(fields, num) -> List[str]:
+    return [v.decode("utf-8") for _, v in fields.get(num, [])]
+
+
+def get_msgs(fields, num) -> List[bytes]:
+    return [v for _, v in fields.get(num, [])]
+
+
+def get_ints(fields, num) -> List[int]:
+    """Repeated int64: handles both unpacked (wire 0) and packed (wire 2)."""
+    out: List[int] = []
+    for wire, v in fields.get(num, []):
+        if wire == 0:
+            out.append(_signed64(v))
+        else:
+            pos = 0
+            while pos < len(v):
+                val, pos = _read_uvarint(v, pos)
+                out.append(_signed64(val))
+    return out
+
+
+def get_floats(fields, num) -> List[float]:
+    out: List[float] = []
+    for wire, v in fields.get(num, []):
+        if wire == 5:
+            out.append(struct.unpack("<f", v)[0])
+        else:  # packed
+            out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+    return out
+
+
+def get_float(fields, num, default=0.0) -> float:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    return struct.unpack("<f", vals[-1][1])[0]
+
+
+# ---------------------------------------------------------------------------
+# parsed views
+# ---------------------------------------------------------------------------
+
+
+class PTensor:
+    """Parsed TensorProto."""
+
+    def __init__(self, buf: bytes):
+        f = decode_fields(buf)
+        self.dims = tuple(get_ints(f, 1))
+        self.data_type = get_int(f, 2)
+        self.name = get_str(f, 8)
+        self._raw = get_msgs(f, 9)
+        self._f = f
+
+    def array(self) -> np.ndarray:
+        dt = np.dtype(NP_OF_ONNX.get(self.data_type, "float32"))
+        if self.data_type == 16:  # bfloat16 has no numpy dtype
+            raw = self._raw[0] if self._raw else b""
+            u16 = np.frombuffer(raw, dtype="<u2").astype(np.uint32) << 16
+            return u16.view(np.float32).reshape(self.dims).copy()
+        if self._raw:
+            return np.frombuffer(self._raw[0], dtype=dt).reshape(
+                self.dims).copy()
+        # typed repeated fields (float_data=4, int32_data=5, int64_data=7,
+        # double_data=10)
+        if self.data_type == 1:
+            vals = get_floats(self._f, 4)
+        elif self.data_type == 10:
+            # float16 rides int32_data as uint16 BIT PATTERNS — must be
+            # reinterpreted, not numerically converted
+            bits = np.asarray(get_ints(self._f, 5), dtype=np.uint16)
+            return bits.view(np.float16).reshape(self.dims).copy()
+        elif self.data_type in (6, 9, 2, 3, 4, 5):
+            vals = get_ints(self._f, 5)
+        elif self.data_type == 7:
+            vals = get_ints(self._f, 7)
+        else:
+            raise MXNetError(
+                f"tensor {self.name!r}: unsupported data layout")
+        return np.asarray(vals, dtype=dt).reshape(self.dims)
+
+
+def parse_attribute(buf: bytes) -> Tuple[str, Any]:
+    f = decode_fields(buf)
+    name = get_str(f, 1)
+    at = get_int(f, 20)
+    if at == _AT_FLOAT:
+        return name, get_float(f, 2)
+    if at == _AT_INT:
+        return name, get_int(f, 3)
+    if at == _AT_STRING:
+        return name, get_str(f, 4)
+    if at == _AT_TENSOR:
+        return name, PTensor(get_msgs(f, 5)[0])
+    if at == _AT_FLOATS:
+        return name, get_floats(f, 7)
+    if at == _AT_INTS:
+        return name, get_ints(f, 8)
+    if at == _AT_STRINGS:
+        return name, get_strs(f, 9)
+    # untyped (some writers omit field 20): infer from whichever is set
+    for num, getter in ((3, get_int), (2, get_float), (4, get_str)):
+        if num in f:
+            return name, getter(f, num)
+    if 8 in f:
+        return name, get_ints(f, 8)
+    if 7 in f:
+        return name, get_floats(f, 7)
+    raise MXNetError(f"attribute {name!r}: cannot determine type")
+
+
+class PNode:
+    """Parsed NodeProto."""
+
+    def __init__(self, buf: bytes):
+        f = decode_fields(buf)
+        self.inputs = get_strs(f, 1)
+        self.outputs = get_strs(f, 2)
+        self.name = get_str(f, 3)
+        self.op_type = get_str(f, 4)
+        self.attrs: Dict[str, Any] = dict(
+            parse_attribute(a) for a in get_msgs(f, 5))
+
+
+class PValueInfo:
+    """Parsed ValueInfoProto (tensor types only)."""
+
+    def __init__(self, buf: bytes):
+        f = decode_fields(buf)
+        self.name = get_str(f, 1)
+        self.elem_type = 1
+        self.shape: Tuple[Any, ...] = ()
+        tps = get_msgs(f, 2)
+        if tps:
+            tp = decode_fields(tps[0])
+            tts = get_msgs(tp, 1)  # tensor_type
+            if tts:
+                tt = decode_fields(tts[0])
+                self.elem_type = get_int(tt, 1, 1)
+                shapes = get_msgs(tt, 2)
+                if shapes:
+                    dims = []
+                    for d in get_msgs(decode_fields(shapes[0]), 1):
+                        df = decode_fields(d)
+                        if 1 in df:
+                            dims.append(get_int(df, 1))
+                        else:
+                            dims.append(get_str(df, 2) or None)
+                    self.shape = tuple(dims)
+
+
+class PGraph:
+    """Parsed GraphProto."""
+
+    def __init__(self, buf: bytes):
+        f = decode_fields(buf)
+        self.name = get_str(f, 2)
+        self.nodes = [PNode(b) for b in get_msgs(f, 1)]
+        self.initializers = [PTensor(b) for b in get_msgs(f, 5)]
+        self.inputs = [PValueInfo(b) for b in get_msgs(f, 11)]
+        self.outputs = [PValueInfo(b) for b in get_msgs(f, 12)]
+
+
+class PModel:
+    """Parsed ModelProto."""
+
+    def __init__(self, buf: bytes):
+        f = decode_fields(buf)
+        self.ir_version = get_int(f, 1)
+        self.producer = get_str(f, 2)
+        graphs = get_msgs(f, 7)
+        if not graphs:
+            raise MXNetError("ONNX model has no graph")
+        self.graph = PGraph(graphs[0])
+        self.opset = 0
+        for osi in get_msgs(f, 8):
+            of = decode_fields(osi)
+            if get_str(of, 1) == "":  # default domain
+                self.opset = get_int(of, 2)

@@ -1,0 +1,424 @@
+"""Symbol graph → ONNX export.
+
+Parity: reference ``python/mxnet/contrib/onnx/mx2onnx/export_model.py``
+(per-op exporter table over the nnvm graph; SURVEY.md §2.5 "Contrib:
+ONNX").  Here the walk is over the TPU rebuild's pure-Python Symbol DAG
+and the bytes are produced by the self-contained ``_proto`` codec — no
+onnx package needed.
+
+Supported ops cover the whole ``gluon.model_zoo.vision`` surface plus
+the common tensor/NN glue (see ``_EXPORTERS``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+
+class _ExportCtx:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.init_names: set = set()
+        self._uid = 0
+
+    def fresh(self, hint: str) -> str:
+        self._uid += 1
+        return f"{hint}__{self._uid}"
+
+    def emit(self, op_type: str, inputs: Sequence[str],
+             outputs: Sequence[str], name: str = "", **attrs):
+        self.nodes.append(P.node(op_type, inputs, outputs,
+                                 name=name, attrs=attrs))
+
+    def add_init(self, name: str, arr: np.ndarray) -> str:
+        if name not in self.init_names:
+            self.initializers.append(P.tensor(name, np.asarray(arr)))
+            self.init_names.add(name)
+        return name
+
+
+def _pair_pads(pad) -> List[int]:
+    """MXNet symmetric pad tuple → ONNX [b1, b2, ..., e1, e2, ...]."""
+    pad = list(pad)
+    return pad + pad
+
+
+def _conv(ctx, name, ins, attrs, out):
+    kernel = tuple(attrs.get("kernel", ()))
+    a = {"kernel_shape": list(kernel),
+         "strides": list(attrs.get("stride") or (1,) * len(kernel)),
+         "dilations": list(attrs.get("dilate") or (1,) * len(kernel)),
+         "pads": _pair_pads(attrs.get("pad") or (0,) * len(kernel)),
+         "group": int(attrs.get("num_group", 1))}
+    ctx.emit("Conv", ins, [out], name=name, **a)
+
+
+def _deconv(ctx, name, ins, attrs, out):
+    kernel = tuple(attrs.get("kernel", ()))
+    a = {"kernel_shape": list(kernel),
+         "strides": list(attrs.get("stride") or (1,) * len(kernel)),
+         "dilations": list(attrs.get("dilate") or (1,) * len(kernel)),
+         "pads": _pair_pads(attrs.get("pad") or (0,) * len(kernel)),
+         "group": int(attrs.get("num_group", 1))}
+    ctx.emit("ConvTranspose", ins, [out], name=name, **a)
+
+
+def _fc(ctx, name, ins, attrs, out):
+    flat = ins[0]
+    if attrs.get("flatten", True):
+        flat = ctx.fresh(name + "_flat")
+        ctx.emit("Flatten", [ins[0]], [flat], axis=1)
+    gemm_in = [flat, ins[1]]
+    if not attrs.get("no_bias", False) and len(ins) > 2:
+        gemm_in.append(ins[2])
+    ctx.emit("Gemm", gemm_in, [out], name=name, alpha=1.0, beta=1.0,
+             transA=0, transB=1)
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(ctx, name, ins, attrs, out):
+    act = attrs.get("act_type", "relu")
+    if act == "relu6":  # no ONNX op; canonical lowering is Clip(0, 6)
+        lo = ctx.add_init(ctx.fresh(name + "_min"),
+                          np.asarray(0.0, np.float32))
+        hi = ctx.add_init(ctx.fresh(name + "_max"),
+                          np.asarray(6.0, np.float32))
+        ctx.emit("Clip", [ins[0], lo, hi], [out], name=name)
+        return
+    if act not in _ACT_MAP:
+        raise MXNetError(f"ONNX export: Activation {act!r} unsupported")
+    ctx.emit(_ACT_MAP[act], ins, [out], name=name)
+
+
+def _leaky(ctx, name, ins, attrs, out):
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        ctx.emit("LeakyRelu", [ins[0]], [out], name=name, alpha=slope)
+    elif act == "elu":
+        ctx.emit("Elu", [ins[0]], [out], name=name, alpha=slope)
+    elif act == "prelu":
+        ctx.emit("PRelu", ins[:2], [out], name=name)
+    else:
+        raise MXNetError(f"ONNX export: LeakyReLU {act!r} unsupported")
+
+
+def _pooling(ctx, name, ins, attrs, out):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"ONNX export: global {ptype} pool")
+        ctx.emit(op, ins, [out], name=name)
+        return
+    kernel = tuple(attrs.get("kernel", ()))
+    a = {"kernel_shape": list(kernel),
+         "strides": list(attrs.get("stride") or (1,) * len(kernel)),
+         "pads": _pair_pads(attrs.get("pad") or (0,) * len(kernel))}
+    if attrs.get("pooling_convention", "valid") == "full":
+        a["ceil_mode"] = 1
+    if ptype == "max":
+        ctx.emit("MaxPool", ins, [out], name=name, **a)
+    elif ptype == "avg":
+        a["count_include_pad"] = int(attrs.get("count_include_pad", True))
+        ctx.emit("AveragePool", ins, [out], name=name, **a)
+    else:
+        raise MXNetError(f"ONNX export: pool_type {ptype!r}")
+
+
+def _batchnorm(ctx, name, ins, attrs, out):
+    a = {"epsilon": float(attrs.get("eps", 1e-5)),
+         "momentum": float(attrs.get("momentum", 0.9))}
+    ins = list(ins)
+    if attrs.get("fix_gamma", True):
+        # fixed gamma == all-ones scale; bake it in so ONNX semantics match
+        gshape = None
+        # gamma initializer may exist; emit a fresh ones tensor instead
+        ones = ctx.fresh(name + "_gamma1")
+        # shape is recoverable from the beta initializer at runtime; use
+        # a 1-D ones matching beta via a Shape-free trick: emit with the
+        # same length as the recorded gamma param when available
+        gshape = ctx.param_shapes.get(ins[1])
+        if gshape is None:
+            raise MXNetError(
+                "ONNX export: BatchNorm(fix_gamma=True) needs gamma as "
+                "a parameter to size the constant scale")
+        ctx.add_init(ones, np.ones(gshape, dtype=np.float32))
+        ins[1] = ones
+    ctx.emit("BatchNormalization", ins, [out], name=name, **a)
+
+
+def _layernorm(ctx, name, ins, attrs, out):
+    ctx.emit("LayerNormalization", ins, [out], name=name,
+             axis=int(attrs.get("axis", -1)),
+             epsilon=float(attrs.get("eps", 1e-5)))
+
+
+def _reshape(ctx, name, ins, attrs, out):
+    shape = list(attrs.get("shape", ()))
+    # ONNX Reshape defines only 0 (copy) and -1 (infer); MXNet's magic
+    # codes -2/-3/-4 and reverse=True have no ONNX equivalent
+    if attrs.get("reverse", False) or any(int(d) < -1 for d in shape):
+        raise MXNetError(
+            f"ONNX export: Reshape shape={shape} "
+            f"reverse={attrs.get('reverse', False)} uses MXNet magic "
+            "codes with no ONNX equivalent")
+    sh = ctx.add_init(ctx.fresh(name + "_shape"),
+                      np.asarray(shape, dtype=np.int64))
+    ctx.emit("Reshape", [ins[0], sh], [out], name=name)
+
+
+def _transpose(ctx, name, ins, attrs, out):
+    axes = attrs.get("axes", ())
+    a = {"perm": list(axes)} if axes else {}
+    ctx.emit("Transpose", ins, [out], name=name, **a)
+
+
+def _softmax_like(onnx_op, default_axis=-1):
+    def fn(ctx, name, ins, attrs, out):
+        ctx.emit(onnx_op, [ins[0]], [out], name=name,
+                 axis=int(attrs.get("axis", default_axis)))
+    return fn
+
+
+def _binop(onnx_op):
+    def fn(ctx, name, ins, attrs, out):
+        ctx.emit(onnx_op, ins[:2], [out], name=name)
+    return fn
+
+
+def _unop(onnx_op):
+    def fn(ctx, name, ins, attrs, out):
+        ctx.emit(onnx_op, [ins[0]], [out], name=name)
+    return fn
+
+
+def _concat(ctx, name, ins, attrs, out):
+    ctx.emit("Concat", ins, [out], name=name,
+             axis=int(attrs.get("dim", 1)))
+
+
+def _dropout(ctx, name, ins, attrs, out):
+    # inference semantics: default training_mode=false → identity
+    ctx.emit("Dropout", [ins[0]], [out], name=name)
+
+
+def _embedding(ctx, name, ins, attrs, out):
+    idx = ctx.fresh(name + "_idx")
+    ctx.emit("Cast", [ins[0]], [idx], to=P.ONNX_DTYPE["int64"])
+    ctx.emit("Gather", [ins[1], idx], [out], name=name, axis=0)
+
+
+def _cast(ctx, name, ins, attrs, out):
+    ctx.emit("Cast", [ins[0]], [out], name=name,
+             to=P.dtype_enum(attrs.get("dtype", "float32")))
+
+
+def _clip(ctx, name, ins, attrs, out):
+    lo = ctx.add_init(ctx.fresh(name + "_min"),
+                      np.asarray(attrs.get("a_min", 0.0), np.float32))
+    hi = ctx.add_init(ctx.fresh(name + "_max"),
+                      np.asarray(attrs.get("a_max", 0.0), np.float32))
+    ctx.emit("Clip", [ins[0], lo, hi], [out], name=name)
+
+
+def _reduce(onnx_op, axes_as_input=False):
+    def fn(ctx, name, ins, attrs, out):
+        axis = attrs.get("axis", None)
+        keep = int(attrs.get("keepdims", False))
+        if axis is None:
+            axes = []
+        elif isinstance(axis, (int, np.integer)):
+            axes = [int(axis)]
+        else:
+            axes = [int(a) for a in axis]
+        if axes_as_input:  # opset 13 ReduceSum takes axes as an input
+            inputs = [ins[0]]
+            if axes:
+                inputs.append(ctx.add_init(
+                    ctx.fresh(name + "_axes"),
+                    np.asarray(axes, dtype=np.int64)))
+            ctx.emit(onnx_op, inputs, [out], name=name, keepdims=keep)
+        else:
+            a = {"keepdims": keep}
+            if axes:
+                a["axes"] = axes
+            ctx.emit(onnx_op, [ins[0]], [out], name=name, **a)
+    return fn
+
+
+def _slice_axis(ctx, name, ins, attrs, out):
+    axis = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end", None)
+    end = np.iinfo(np.int64).max if end is None else int(end)
+    st = ctx.add_init(ctx.fresh(name + "_starts"),
+                      np.asarray([begin], np.int64))
+    en = ctx.add_init(ctx.fresh(name + "_ends"),
+                      np.asarray([end], np.int64))
+    ax = ctx.add_init(ctx.fresh(name + "_axes"),
+                      np.asarray([axis], np.int64))
+    ctx.emit("Slice", [ins[0], st, en, ax], [out], name=name)
+
+
+def _flatten(ctx, name, ins, attrs, out):
+    ctx.emit("Flatten", ins, [out], name=name, axis=1)
+
+
+def _dot(ctx, name, ins, attrs, out):
+    if attrs.get("transpose_a") or attrs.get("transpose_b"):
+        raise MXNetError("ONNX export: transposed dot unsupported")
+    ctx.emit("MatMul", ins[:2], [out], name=name)
+
+
+_EXPORTERS = {
+    "Convolution": _conv,
+    "Deconvolution": _deconv,
+    "FullyConnected": _fc,
+    "Activation": _activation,
+    "LeakyReLU": _leaky,
+    "Pooling": _pooling,
+    "BatchNorm": _batchnorm,
+    "LayerNorm": _layernorm,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "transpose": _transpose,
+    "softmax": _softmax_like("Softmax"),
+    "log_softmax": _softmax_like("LogSoftmax"),
+    "SoftmaxOutput": lambda ctx, name, ins, attrs, out:
+        ctx.emit("Softmax", [ins[0]], [out], name=name, axis=1),
+    "SoftmaxActivation": _softmax_like("Softmax"),
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "Embedding": _embedding,
+    "cast": _cast,
+    "Cast": _cast,
+    "clip": _clip,
+    "mean": _reduce("ReduceMean"),
+    "sum": _reduce("ReduceSum", axes_as_input=True),
+    "slice_axis": _slice_axis,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "dot": _dot,
+    "elemwise_add": _binop("Add"),
+    "elemwise_sub": _binop("Sub"),
+    "elemwise_mul": _binop("Mul"),
+    "elemwise_div": _binop("Div"),
+    "broadcast_add": _binop("Add"),
+    "broadcast_sub": _binop("Sub"),
+    "broadcast_mul": _binop("Mul"),
+    "broadcast_div": _binop("Div"),
+    "add_n": lambda ctx, name, ins, attrs, out:
+        ctx.emit("Sum", ins, [out], name=name),
+    "relu": _unop("Relu"),
+    "sigmoid": _unop("Sigmoid"),
+    "tanh": _unop("Tanh"),
+    "exp": _unop("Exp"),
+    "log": _unop("Log"),
+    "sqrt": _unop("Sqrt"),
+    "abs": _unop("Abs"),
+    "negative": _unop("Neg"),
+    "identity": _unop("Identity"),
+    "_copy": _unop("Identity"),
+    "BlockGrad": _unop("Identity"),
+}
+
+
+def export_model(sym, params: Dict[str, Any], input_shape=None,
+                 input_type=np.float32, onnx_file_path="model.onnx",
+                 verbose=False):
+    """Export a Symbol + params to an ONNX file; returns the path.
+
+    ``params`` maps argument names to NDArrays/ndarrays (``arg:``/
+    ``aux:`` prefixes accepted, as written by ``Module.save_checkpoint``).
+    ``input_shape``: one tuple, or a list of tuples — one per data input
+    in ``list_arguments`` order.
+    """
+    from ...symbol.symbol import Symbol, _topo
+
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model: sym must be a Symbol")
+    clean_params = {}
+    for k, v in params.items():
+        if k.startswith(("arg:", "aux:")):
+            k = k[4:]
+        clean_params[k] = np.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    if input_shape is None:
+        input_shape = []
+    elif isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+
+    nodes = _topo(sym._head_nodes())
+    data_inputs = [n.name for n in nodes
+                   if n.op is None and n.name not in clean_params]
+    if len(input_shape) < len(data_inputs):
+        raise MXNetError(
+            f"export_model: model has data inputs {data_inputs}; "
+            f"got {len(input_shape)} input shapes")
+    in_shape_of = dict(zip(data_inputs, input_shape))
+
+    # output shapes for the graph's output value_info
+    out_shapes = None
+    try:
+        _, out_shapes, _ = sym.infer_shape(**in_shape_of)
+    except Exception:
+        pass
+
+    ctx = _ExportCtx()
+    ctx.param_shapes = {k: v.shape for k, v in clean_params.items()}
+    elem = P.dtype_enum(np.dtype(input_type))
+
+    # tensor name for each (node, out_index) edge
+    edge_name: Dict[tuple, str] = {}
+
+    def name_of(node, oi):
+        return edge_name[(id(node), oi)]
+
+    graph_inputs = []
+    for n in nodes:
+        if n.op is None:
+            edge_name[(id(n), 0)] = n.name
+            if n.name in clean_params:
+                ctx.add_init(n.name, clean_params[n.name])
+            else:
+                graph_inputs.append(P.value_info(
+                    n.name, elem, in_shape_of[n.name]))
+            continue
+        fn = _EXPORTERS.get(n.op)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX export: operator {n.op!r} (node {n.name!r}) is "
+                f"not supported; supported: {sorted(_EXPORTERS)}")
+        ins = [name_of(i, oi) for i, oi in n.inputs]
+        out = n.name + "_out" if n.num_outputs == 1 else n.name + "_out0"
+        for i in range(n.num_outputs):
+            edge_name[(id(n), i)] = (n.name + f"_out{i}"
+                                     if n.num_outputs > 1
+                                     else out)
+        fn(ctx, n.name, ins, n.attrs, edge_name[(id(n), 0)])
+        if verbose:
+            print(f"  {n.op} {n.name} -> onnx")
+
+    graph_outputs = []
+    for i, (hn, oi) in enumerate(sym._outputs):
+        shp = tuple(out_shapes[i]) if out_shapes else ("?",)
+        graph_outputs.append(P.value_info(name_of(hn, oi), elem, shp))
+
+    g = P.graph(ctx.nodes, "mxnet_tpu_export", graph_inputs,
+                graph_outputs, ctx.initializers)
+    with open(onnx_file_path, "wb") as f:
+        f.write(P.model(g))
+    return onnx_file_path

@@ -1,0 +1,369 @@
+"""ONNX → Symbol import.
+
+Parity: reference ``python/mxnet/contrib/onnx/onnx2mx/import_model.py``
+(SURVEY.md §2.5 "Contrib: ONNX").  Parses the protobuf with the
+self-contained ``_proto`` codec and rebuilds a Symbol DAG; initializers
+become ``arg_params`` (or ``aux_params`` when consumed in an
+auxiliary-state slot, e.g. BatchNorm moving stats).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+__all__ = ["import_model"]
+
+
+def _sym():
+    from ... import symbol
+    return symbol
+
+
+def _sym_pads(pads) -> tuple:
+    """ONNX [b..., e...] pads → symmetric MXNet tuple (reject asym)."""
+    if not pads:
+        return ()
+    k = len(pads) // 2
+    beg, end = pads[:k], pads[k:]
+    if list(beg) != list(end):
+        raise MXNetError(f"ONNX import: asymmetric pads {pads} "
+                         "unsupported")
+    return tuple(int(p) for p in beg)
+
+
+def _conv(g, node, ins):
+    w = g.shape_of(node.inputs[1])
+    kernel = tuple(node.attrs.get("kernel_shape", w[2:]))
+    return _sym()._invoke("Convolution", ins, {
+        "kernel": kernel,
+        "stride": tuple(node.attrs.get("strides", ())),
+        "dilate": tuple(node.attrs.get("dilations", ())),
+        "pad": _sym_pads(node.attrs.get("pads", ())),
+        "num_filter": int(w[0]),
+        "num_group": int(node.attrs.get("group", 1)),
+        "no_bias": len(ins) < 3}, name=node.name or None)
+
+
+def _deconv(g, node, ins):
+    w = g.shape_of(node.inputs[1])
+    group = int(node.attrs.get("group", 1))
+    kernel = tuple(node.attrs.get("kernel_shape", w[2:]))
+    return _sym()._invoke("Deconvolution", ins, {
+        "kernel": kernel,
+        "stride": tuple(node.attrs.get("strides", ())),
+        "dilate": tuple(node.attrs.get("dilations", ())),
+        "pad": _sym_pads(node.attrs.get("pads", ())),
+        "num_filter": int(w[1]) * group,
+        "num_group": group,
+        "no_bias": len(ins) < 3}, name=node.name or None)
+
+
+def _gemm(g, node, ins):
+    alpha = node.attrs.get("alpha", 1.0)
+    beta = node.attrs.get("beta", 1.0)
+    if node.attrs.get("transA", 0) or alpha != 1.0 or beta != 1.0:
+        raise MXNetError("ONNX import: general Gemm unsupported "
+                         "(transA/alpha/beta)")
+    s = _sym()
+    if not node.attrs.get("transB", 0):
+        out = s._invoke("dot", ins[:2], {})
+        if len(ins) > 2:
+            out = s._invoke("broadcast_add", [out, ins[2]], {})
+        return out
+    w = g.shape_of(node.inputs[1])
+    return s._invoke("FullyConnected", ins, {
+        "num_hidden": int(w[0]),
+        "no_bias": len(ins) < 3,
+        "flatten": False}, name=node.name or None)
+
+
+def _pool(ptype):
+    def fn(g, node, ins):
+        attrs = {"pool_type": ptype,
+                 "kernel": tuple(node.attrs.get("kernel_shape", ())),
+                 "stride": tuple(node.attrs.get("strides", ())),
+                 "pad": _sym_pads(node.attrs.get("pads", ()))}
+        if node.attrs.get("ceil_mode", 0):
+            attrs["pooling_convention"] = "full"
+        if ptype == "avg":
+            attrs["count_include_pad"] = bool(
+                node.attrs.get("count_include_pad", 0))
+        return _sym()._invoke("Pooling", ins, attrs,
+                              name=node.name or None)
+    return fn
+
+
+def _global_pool(ptype):
+    def fn(g, node, ins):
+        return _sym()._invoke("Pooling", ins, {
+            "pool_type": ptype, "global_pool": True},
+            name=node.name or None)
+    return fn
+
+
+def _batchnorm(g, node, ins):
+    return _sym()._invoke("BatchNorm", ins, {
+        "eps": float(node.attrs.get("epsilon", 1e-5)),
+        "momentum": float(node.attrs.get("momentum", 0.9)),
+        "fix_gamma": False}, name=node.name or None)
+
+
+def _layernorm(g, node, ins):
+    return _sym()._invoke("LayerNorm", ins, {
+        "axis": int(node.attrs.get("axis", -1)),
+        "eps": float(node.attrs.get("epsilon", 1e-5))},
+        name=node.name or None)
+
+
+def _act(act_type):
+    def fn(g, node, ins):
+        return _sym()._invoke("Activation", ins,
+                              {"act_type": act_type},
+                              name=node.name or None)
+    return fn
+
+
+def _leaky(act_type, default_alpha):
+    def fn(g, node, ins):
+        return _sym()._invoke("LeakyReLU", ins, {
+            "act_type": act_type,
+            "slope": float(node.attrs.get("alpha", default_alpha))},
+            name=node.name or None)
+    return fn
+
+
+def _mxop(opname, **fixed):
+    def fn(g, node, ins):
+        return _sym()._invoke(opname, ins, dict(fixed),
+                              name=node.name or None)
+    return fn
+
+
+def _softmax_like(opname):
+    def fn(g, node, ins):
+        return _sym()._invoke(opname, [ins[0]], {
+            "axis": int(node.attrs.get("axis", -1))},
+            name=node.name or None)
+    return fn
+
+
+def _reshape(g, node, ins):
+    shape = g.const_of(node.inputs[1])
+    if shape is None:
+        raise MXNetError("ONNX import: Reshape needs a constant shape")
+    return _sym()._invoke("Reshape", [ins[0]], {
+        "shape": tuple(int(s) for s in shape)}, name=node.name or None)
+
+
+def _transpose(g, node, ins):
+    perm = node.attrs.get("perm", ())
+    return _sym()._invoke("transpose", ins, {
+        "axes": tuple(int(p) for p in perm)}, name=node.name or None)
+
+
+def _concat(g, node, ins):
+    return _sym()._invoke("Concat", ins, {
+        "dim": int(node.attrs.get("axis", 0))}, name=node.name or None)
+
+
+def _cast(g, node, ins):
+    to = int(node.attrs["to"])
+    return _sym()._invoke("cast", ins, {
+        "dtype": P.NP_OF_ONNX[to]}, name=node.name or None)
+
+
+def _clip(g, node, ins):
+    def bound(pos, attr):
+        v = node.attrs.get(attr)
+        if v is not None:
+            return float(v)
+        # empty input name = "omitted" per the ONNX optional-input rule
+        if len(node.inputs) > pos and node.inputs[pos]:
+            c = g.const_of(node.inputs[pos])
+            if c is None:
+                raise MXNetError(
+                    f"ONNX import: Clip bound {node.inputs[pos]!r} "
+                    "must be an initializer")
+            return float(c)
+        return None
+
+    lo, hi = bound(1, "min"), bound(2, "max")
+    return _sym()._invoke("clip", [ins[0]], {
+        "a_min": lo if lo is not None else -np.inf,
+        "a_max": hi if hi is not None else np.inf},
+        name=node.name or None)
+
+
+def _gather(g, node, ins):
+    axis = int(node.attrs.get("axis", 0))
+    # Gather(data, indices) → take(data, indices, axis)
+    return _sym()._invoke("take", [ins[0], ins[1]], {"axis": axis},
+                          name=node.name or None)
+
+
+def _reduce(opname, axes_input=False):
+    def fn(g, node, ins):
+        axes = node.attrs.get("axes", ())
+        if axes_input and len(node.inputs) > 1:
+            c = g.const_of(node.inputs[1])
+            axes = tuple(int(a) for a in c) if c is not None else ()
+        attrs = {"keepdims": bool(node.attrs.get("keepdims", 1))}
+        if axes:
+            attrs["axis"] = tuple(int(a) for a in axes)
+        return _sym()._invoke(opname, [ins[0]], attrs,
+                              name=node.name or None)
+    return fn
+
+
+def _slice(g, node, ins):
+    starts = g.const_of(node.inputs[1])
+    ends = g.const_of(node.inputs[2])
+    axes = (g.const_of(node.inputs[3])
+            if len(node.inputs) > 3 and node.inputs[3] else
+            range(len(starts)))
+    if len(node.inputs) > 4 and node.inputs[4]:
+        steps = g.const_of(node.inputs[4])
+        if steps is None or any(int(s) != 1 for s in steps):
+            raise MXNetError(
+                f"ONNX import: Slice with steps={steps} unsupported")
+    out = ins[0]
+    s = _sym()
+    imax = np.iinfo(np.int64).max
+    for st, en, ax in zip(starts, ends, axes):
+        out = s._invoke("slice_axis", [out], {
+            "axis": int(ax), "begin": int(st),
+            "end": None if int(en) >= imax else int(en)})
+    return out
+
+
+_IMPORTERS = {
+    "Conv": _conv,
+    "ConvTranspose": _deconv,
+    "Gemm": _gemm,
+    "MatMul": _mxop("dot"),
+    "MaxPool": _pool("max"),
+    "AveragePool": _pool("avg"),
+    "GlobalMaxPool": _global_pool("max"),
+    "GlobalAveragePool": _global_pool("avg"),
+    "BatchNormalization": _batchnorm,
+    "LayerNormalization": _layernorm,
+    "Relu": _act("relu"),
+    "Sigmoid": _act("sigmoid"),
+    "Tanh": _act("tanh"),
+    "Softplus": _act("softrelu"),
+    "Softsign": _act("softsign"),
+    "LeakyRelu": _leaky("leaky", 0.01),
+    "Elu": _leaky("elu", 1.0),
+    "PRelu": _mxop("LeakyReLU", act_type="prelu"),
+    "Add": _mxop("broadcast_add"),
+    "Sub": _mxop("broadcast_sub"),
+    "Mul": _mxop("broadcast_mul"),
+    "Div": _mxop("broadcast_div"),
+    "Sum": _mxop("add_n"),
+    "Identity": _mxop("identity"),
+    "Dropout": _mxop("identity"),
+    "Exp": _mxop("exp"),
+    "Log": _mxop("log"),
+    "Sqrt": _mxop("sqrt"),
+    "Abs": _mxop("abs"),
+    "Neg": _mxop("negative"),
+    "Flatten": _mxop("Flatten"),
+    "Reshape": _reshape,
+    "Transpose": _transpose,
+    "Softmax": _softmax_like("softmax"),
+    "LogSoftmax": _softmax_like("log_softmax"),
+    "Concat": _concat,
+    "Cast": _cast,
+    "Clip": _clip,
+    "Gather": _gather,
+    "ReduceMean": _reduce("mean"),
+    "ReduceSum": _reduce("sum", axes_input=True),
+    "Slice": _slice,
+}
+
+
+# input positions read as compile-time constants, not graph tensors
+_CONST_INPUTS = {"Reshape": (1,), "Slice": (1, 2, 3, 4),
+                 "Clip": (1, 2), "ReduceSum": (1,)}
+
+
+class _GraphCtx:
+    def __init__(self, pgraph: P.PGraph):
+        self.init_arrays: Dict[str, np.ndarray] = {
+            t.name: t.array() for t in pgraph.initializers}
+        self.shapes: Dict[str, tuple] = {
+            t.name: t.dims for t in pgraph.initializers}
+
+    def shape_of(self, name: str) -> tuple:
+        try:
+            return self.shapes[name]
+        except KeyError:
+            raise MXNetError(
+                f"ONNX import: {name!r} must be an initializer") from None
+
+    def const_of(self, name: str):
+        return self.init_arrays.get(name)
+
+
+def import_model(model_file: str):
+    """Import an .onnx file → ``(sym, arg_params, aux_params)``.
+
+    Mirrors the reference's return convention; params are NDArrays.
+    """
+    from ... import ndarray as nd
+    from ...symbol import symbol as S
+
+    with open(model_file, "rb") as f:
+        pm = P.PModel(f.read())
+    g = pm.graph
+    ctx = _GraphCtx(g)
+
+    tensors: Dict[str, Any] = {}  # tensor name → Symbol
+    consumed_inits: set = set()
+
+    for vi in g.inputs:
+        if vi.name not in ctx.init_arrays:
+            tensors[vi.name] = S.var(vi.name)
+
+    def sym_of(name: str):
+        s = tensors.get(name)
+        if s is None:
+            if name not in ctx.init_arrays:
+                raise MXNetError(f"ONNX import: undefined tensor "
+                                 f"{name!r}")
+            consumed_inits.add(name)
+            s = tensors[name] = S.var(name)
+        return s
+
+    for node in g.nodes:
+        fn = _IMPORTERS.get(node.op_type)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX import: operator {node.op_type!r} not supported;"
+                f" supported: {sorted(_IMPORTERS)}")
+        # constant-only inputs (Reshape shape, Slice starts...) are read
+        # via g.const_of inside builders; pass Symbols for the rest
+        const_pos = _CONST_INPUTS.get(node.op_type, ())
+        # empty input name = omitted optional input (ONNX convention)
+        ins = [sym_of(iname) for pos, iname in enumerate(node.inputs)
+               if pos not in const_pos and iname]
+        out_sym = fn(ctx, node, ins)
+        outs = (out_sym._outputs if len(node.outputs) > 1
+                else [out_sym._outputs[0]])
+        for i, oname in enumerate(node.outputs):
+            if i < len(outs):
+                tensors[oname] = S.Symbol([outs[i]])
+
+    heads = [tensors[o.name] for o in g.outputs]
+    sym = heads[0] if len(heads) == 1 else S.Group(heads)
+
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name in consumed_inits:
+        arr = nd.array(ctx.init_arrays[name])
+        (aux_params if name in aux_names else arg_params)[name] = arr
+    return sym, arg_params, aux_params

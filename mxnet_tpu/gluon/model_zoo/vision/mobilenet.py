@@ -107,8 +107,10 @@ class MobileNetV2(HybridBlock):
 
     def hybrid_forward(self, F, x):
         x = self.features(x)
-        # GlobalAvgPool2D flattens to (N, C); conv head needs NCHW
-        x = x.reshape((x.shape[0], -1, 1, 1)) if x.ndim == 2 else x
+        # GlobalAvgPool2D flattens to (N, C); conv head needs NCHW.
+        # 0/-1 reshape semantics keep this valid under Symbol tracing
+        # (Symbols have no ndim), and are a no-op for (N, C, 1, 1).
+        x = x.reshape((0, -1, 1, 1))
         return self.output(x)
 
 

@@ -525,6 +525,14 @@ def _propagate_shapes(sym, shapes):
 
     for node in _topo(sym._head_nodes()):
         if node.op is None:
+            # var(shape=...) hints participate in inference, matching
+            # the reference's Symbol.var(shape=) behavior.  Dims <= 0
+            # mean "unknown" (deferred-init params stamp e.g. (8, 0));
+            # such hints must not pre-empt the param-shape rules below.
+            hint = node._user_attrs.get("__shape__")
+            if node.name not in shapes and hint is not None and \
+                    all(int(d) > 0 for d in hint):
+                shapes[node.name] = tuple(int(d) for d in hint)
             if node.name in shapes:
                 out_shapes[(id(node), 0)] = tuple(shapes[node.name])
             continue
