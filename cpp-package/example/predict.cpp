@@ -1,0 +1,70 @@
+/*
+ * Fluent C++ deploy example: load an exported model and run inference
+ * through mxnet::cpp::Predictor (the c_predict_api analog).
+ *
+ * argv: symbol.json params.bin input.bin expected.bin
+ * input fixed at (2, 16) float32 (see tests/test_cpp_package.py).
+ */
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/MxNetCpp.h"
+
+using namespace mxnet::cpp;
+
+static std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr, "usage: predict sym params input expected\n");
+    return 2;
+  }
+  try {
+    std::string sym_json = slurp(argv[1]);
+    std::string params = slurp(argv[2]);
+    std::string in_raw = slurp(argv[3]);
+    std::string want_raw = slurp(argv[4]);
+    std::vector<float> input(
+        reinterpret_cast<const float*>(in_raw.data()),
+        reinterpret_cast<const float*>(in_raw.data() + in_raw.size()));
+    std::vector<float> want(
+        reinterpret_cast<const float*>(want_raw.data()),
+        reinterpret_cast<const float*>(want_raw.data() +
+                                       want_raw.size()));
+
+    Predictor pred(sym_json, params, Context::cpu(),
+                   {{"data", {2, 16}}});
+    pred.SetInput("data", input);
+    pred.Forward();
+    auto shape = pred.OutputShape(0);
+    auto got = pred.GetOutput(0);
+    std::printf("output ndim=%zu n=%zu\n", shape.size(), got.size());
+    if (got.size() != want.size()) {
+      std::fprintf(stderr, "FAIL: output size %zu != %zu\n", got.size(),
+                   want.size());
+      return 1;
+    }
+    for (size_t i = 0; i < got.size(); ++i) {
+      if (std::fabs(got[i] - want[i]) >
+          1e-5f + 1e-4f * std::fabs(want[i])) {
+        std::fprintf(stderr, "FAIL: mismatch at %zu: %f vs %f\n", i,
+                     got[i], want[i]);
+        return 1;
+      }
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "FAIL: %s\n", e.what());
+    return 1;
+  }
+  std::printf("CPP PREDICT TEST PASSED\n");
+  return 0;
+}

@@ -396,6 +396,64 @@ class KVStore {
   std::shared_ptr<void> h_;
 };
 
+
+// Deploy surface over MXPred* (parity: reference c_predict_api usage
+// from C++ — load an exported model, SetInput/Forward/GetOutput).
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_blob,
+            const Context& ctx,
+            const std::vector<std::pair<std::string,
+                                        std::vector<uint32_t>>>& inputs) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> dims;
+    for (const auto& kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    PredictorHandle h = nullptr;
+    Check(MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                       static_cast<int>(param_blob.size()), ctx.type(),
+                       ctx.id(), static_cast<int>(keys.size()),
+                       keys.data(), indptr.data(), dims.data(), &h),
+          "MXPredCreate");
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXPredFree(p);
+    });
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    Check(MXPredSetInput(h_.get(), key.c_str(), data.data(),
+                         static_cast<uint32_t>(data.size())),
+          "MXPredSetInput");
+  }
+
+  void Forward() { Check(MXPredForward(h_.get()), "MXPredForward"); }
+
+  std::vector<uint32_t> OutputShape(uint32_t index) const {
+    const uint32_t* data = nullptr;
+    uint32_t ndim = 0;
+    Check(MXPredGetOutputShape(h_.get(), index, &data, &ndim),
+          "MXPredGetOutputShape");
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(uint32_t index) const {
+    auto shape = OutputShape(index);
+    uint32_t total = 1;
+    for (uint32_t d : shape) total *= d;
+    std::vector<float> out(total);
+    Check(MXPredGetOutput(h_.get(), index, out.data(), total),
+          "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
 }  // namespace cpp
 }  // namespace mxnet
 

@@ -103,6 +103,22 @@ int MXKVStorePush(KVStoreHandle kv, int key, NDArrayHandle arr);
 int MXKVStorePull(KVStoreHandle kv, int key, NDArrayHandle out_arr);
 int MXKVStoreFree(KVStoreHandle kv);
 
+/* Predict API (deploy surface; parity: c_predict_api.h) */
+typedef void* PredictorHandle;
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int ctx_type, int ctx_id,
+                 int num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         const uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle h);
+
 #ifdef __cplusplus
 }
 #endif

@@ -244,3 +244,81 @@ def random_seed(seed: int):
 def num_tpus() -> int:
     import mxnet_tpu as mx
     return mx.num_tpus()
+
+
+# -- Predict API (deploy surface) -------------------------------------------
+# Parity: reference src/c_api/c_predict_api.cc + include/mxnet/c_predict_api.h
+# (SURVEY.md §2.1 "C API": "predict API is a minimal deploy surface").
+# A predictor = exported symbol JSON + params blob bound for inference.
+
+class _Predictor:
+    def __init__(self, symbol_json, param_bytes, ctx_type, ctx_id,
+                 input_names, input_shapes):
+        from mxnet_tpu import nd
+        from mxnet_tpu import symbol as sym_mod
+        self._sym = sym_mod.load_json(symbol_json)
+        params = nd.load_buffer(param_bytes) if param_bytes else {}
+        clean = {}
+        for k, v in (params.items() if isinstance(params, dict) else []):
+            clean[k[4:] if k.startswith(("arg:", "aux:")) else k] = v
+        shapes = {n: tuple(int(d) for d in s)
+                  for n, s in zip(input_names, input_shapes)}
+        self._ex = self._sym.simple_bind(
+            ctx=_ctx(ctx_type, ctx_id), grad_req="null", **shapes)
+        for name, arr in clean.items():
+            if name in self._ex.arg_dict:
+                self._ex.arg_dict[name][:] = arr
+            elif name in self._ex.aux_dict:
+                self._ex.aux_dict[name][:] = arr
+        self._input_names = list(input_names)
+        self._outputs = None
+        # static output shapes so MXPredGetOutputShape works BEFORE the
+        # first forward (the canonical c_predict_api buffer-sizing flow)
+        try:
+            _, self._static_out_shapes, _ = self._sym.infer_shape(**shapes)
+        except Exception:
+            self._static_out_shapes = None
+
+    def set_input(self, key, data_bytes):
+        arr = self._ex.arg_dict[key]
+        np_arr = np.frombuffer(data_bytes, dtype="float32").reshape(
+            arr.shape)
+        arr[:] = np_arr
+
+    def forward(self):
+        self._outputs = self._ex.forward(is_train=False)
+
+    def output_shape(self, index):
+        if self._outputs is not None:
+            return tuple(int(d) for d in self._outputs[index].shape)
+        if self._static_out_shapes is None:
+            raise RuntimeError("output shape unavailable before forward "
+                               "(shape inference failed at bind time)")
+        return tuple(int(d) for d in self._static_out_shapes[index])
+
+    def get_output(self, index):
+        if self._outputs is None:
+            self.forward()
+        return self._outputs[index].astype("float32").asnumpy().tobytes()
+
+
+def pred_create(symbol_json, param_bytes, ctx_type, ctx_id,
+                input_names, input_shapes):
+    return _Predictor(symbol_json, param_bytes, ctx_type, ctx_id,
+                      input_names, input_shapes)
+
+
+def pred_set_input(p, key, data_bytes):
+    p.set_input(key, data_bytes)
+
+
+def pred_forward(p):
+    p.forward()
+
+
+def pred_output_shape(p, index):
+    return p.output_shape(index)
+
+
+def pred_get_output(p, index):
+    return p.get_output(index)

@@ -278,6 +278,23 @@ def _flatten(ctx, name, ins, attrs, out):
 def _dot(ctx, name, ins, attrs, out):
     if attrs.get("transpose_a") or attrs.get("transpose_b"):
         raise MXNetError("ONNX export: transposed dot unsupported")
+    # mx dot is tensordot(axes=1): equal to ONNX MatMul only for 2-D
+    # operands; higher ranks silently diverge, so reject them
+    for t in ins[:2]:
+        r = ctx.rank.get(t)
+        if r is not None and r > 2:
+            raise MXNetError(
+                f"ONNX export: dot on rank-{r} input {t!r} has no "
+                "MatMul equivalent (tensordot semantics); use "
+                "linalg_gemm2 for batched matmul")
+    ctx.emit("MatMul", ins[:2], [out], name=name)
+
+
+def _gemm2(ctx, name, ins, attrs, out):
+    if attrs.get("transpose_a") or attrs.get("transpose_b") or \
+            attrs.get("alpha", 1.0) != 1.0:
+        raise MXNetError("ONNX export: linalg_gemm2 with transpose/"
+                         "alpha unsupported")
     ctx.emit("MatMul", ins[:2], [out], name=name)
 
 
@@ -311,6 +328,7 @@ _EXPORTERS = {
     "Flatten": _flatten,
     "flatten": _flatten,
     "dot": _dot,
+    "linalg_gemm2": _gemm2,
     "elemwise_add": _binop("Add"),
     "elemwise_sub": _binop("Sub"),
     "elemwise_mul": _binop("Mul"),
@@ -379,6 +397,19 @@ def export_model(sym, params: Dict[str, Any], input_shape=None,
 
     ctx = _ExportCtx()
     ctx.param_shapes = {k: v.shape for k, v in clean_params.items()}
+    # per-tensor ranks (where inferable) let builders reject mappings
+    # that are only rank-conditionally correct (e.g. dot → MatMul)
+    ctx.rank = {k: len(v) for k, v in ctx.param_shapes.items()}
+    ctx.rank.update({k: len(v) for k, v in in_shape_of.items()})
+    internal_rank = {}
+    try:
+        internals = sym.get_internals()
+        _, ishapes, _ = internals.infer_shape(**in_shape_of)
+        for nm, shp in zip(internals.list_outputs(), ishapes):
+            if shp is not None:
+                internal_rank[nm] = len(shp)
+    except Exception:
+        pass
     elem = P.dtype_enum(np.dtype(input_type))
 
     # tensor name for each (node, out_index) edge
@@ -408,6 +439,10 @@ def export_model(sym, params: Dict[str, Any], input_shape=None,
             edge_name[(id(n), i)] = (n.name + f"_out{i}"
                                      if n.num_outputs > 1
                                      else out)
+            key = (n.name + "_output" if n.num_outputs == 1
+                   else f"{n.name}_output{i}")
+            if key in internal_rank:
+                ctx.rank[edge_name[(id(n), i)]] = internal_rank[key]
         fn(ctx, n.name, ins, n.attrs, edge_name[(id(n), 0)])
         if verbose:
             print(f"  {n.op} {n.name} -> onnx")

@@ -200,8 +200,10 @@ def _clip(g, node, ins):
 
 def _gather(g, node, ins):
     axis = int(node.attrs.get("axis", 0))
-    # Gather(data, indices) → take(data, indices, axis)
-    return _sym()._invoke("take", [ins[0], ins[1]], {"axis": axis},
+    # Gather(data, indices) → take; mode="wrap" reproduces ONNX
+    # negative-index (from-the-end) semantics via modulo
+    return _sym()._invoke("take", [ins[0], ins[1]],
+                          {"axis": axis, "mode": "wrap"},
                           name=node.name or None)
 
 
@@ -222,6 +224,10 @@ def _reduce(opname, axes_input=False):
 def _slice(g, node, ins):
     starts = g.const_of(node.inputs[1])
     ends = g.const_of(node.inputs[2])
+    if starts is None or ends is None:
+        raise MXNetError(
+            "ONNX import: Slice starts/ends must be initializers "
+            "(dynamically computed slices unsupported)")
     axes = (g.const_of(node.inputs[3])
             if len(node.inputs) > 3 and node.inputs[3] else
             range(len(starts)))
@@ -244,7 +250,7 @@ _IMPORTERS = {
     "Conv": _conv,
     "ConvTranspose": _deconv,
     "Gemm": _gemm,
-    "MatMul": _mxop("dot"),
+    "MatMul": _mxop("linalg_gemm2"),  # numpy-matmul semantics
     "MaxPool": _pool("max"),
     "AveragePool": _pool("avg"),
     "GlobalMaxPool": _global_pool("max"),

@@ -17,7 +17,7 @@ import numpy as np
 from ..base import MXNetError
 from ..ops.registry import get_op, list_ops, OpDef
 from .ndarray import (NDArray, invoke, array, empty, zeros, ones, full,
-                      arange, eye, concatenate, save, load, waitall,
+                      arange, eye, concatenate, save, load, load_buffer, waitall,
                       moveaxis)
 
 _mod = sys.modules[__name__]
@@ -144,5 +144,5 @@ from . import sparse  # noqa: E402  (stype facade)
 from . import contrib  # noqa: E402  (control-flow ops)
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
-           "eye", "concatenate", "save", "load", "waitall", "invoke",
+           "eye", "concatenate", "save", "load", "load_buffer", "waitall", "invoke",
            "random", "sparse", "contrib", "moveaxis"]

@@ -749,27 +749,38 @@ def save(fname: str, data):
             f.write(raw)
 
 
+def _load_stream(f, what: str):
+    magic = f.read(8)
+    if magic != _MAGIC:
+        raise MXNetError(f"{what}: not an NDArray file")
+    n = struct.unpack("<q", f.read(8))[0]
+    named = {}
+    unnamed = []
+    any_named = False
+    for _ in range(n):
+        ln = struct.unpack("<q", f.read(8))[0]
+        name = f.read(ln).decode()
+        lh = struct.unpack("<q", f.read(8))[0]
+        hdr = json.loads(f.read(lh).decode())
+        lr = struct.unpack("<q", f.read(8))[0]
+        raw = f.read(lr)
+        a = np.frombuffer(raw, dtype=hdr["dtype"]).reshape(hdr["shape"])
+        nd = array(a, dtype=a.dtype)
+        if name:
+            any_named = True
+            named[name] = nd
+        else:
+            unnamed.append(nd)
+    return named if any_named else unnamed
+
+
 def load(fname: str):
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            raise MXNetError(f"{fname}: not an NDArray file")
-        n = struct.unpack("<q", f.read(8))[0]
-        named = {}
-        unnamed = []
-        any_named = False
-        for _ in range(n):
-            ln = struct.unpack("<q", f.read(8))[0]
-            name = f.read(ln).decode()
-            lh = struct.unpack("<q", f.read(8))[0]
-            hdr = json.loads(f.read(lh).decode())
-            lr = struct.unpack("<q", f.read(8))[0]
-            raw = f.read(lr)
-            a = np.frombuffer(raw, dtype=hdr["dtype"]).reshape(hdr["shape"])
-            nd = array(a, dtype=a.dtype)
-            if name:
-                any_named = True
-                named[name] = nd
-            else:
-                unnamed.append(nd)
-    return named if any_named else unnamed
+        return _load_stream(f, fname)
+
+
+def load_buffer(buf: bytes):
+    """Deserialize from in-memory bytes (parity:
+    MXNDArrayLoadFromBuffer — the C predict API's param-blob path)."""
+    import io
+    return _load_stream(io.BytesIO(buf), "<buffer>")

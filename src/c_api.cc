@@ -619,4 +619,115 @@ int MXKVStorePull(KVStoreHandle kv, int key, NDArrayHandle out_arr) {
 
 int MXKVStoreFree(KVStoreHandle kv) { return FreeHandle(kv); }
 
+// ---- Predict API (deploy surface) ----------------------------------------
+// Parity: reference src/c_api/c_predict_api.cc (SURVEY.md §2.1: "predict
+// API is a minimal deploy surface").  A predictor wraps an exported
+// symbol JSON + params blob bound for inference on one device.
+
+typedef void* PredictorHandle;
+
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int ctx_type, int ctx_id,
+                 int num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* names = StrList(input_keys, num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (int i = 0; i < num_input_nodes; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* s = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(s, j - lo, PyLong_FromUnsignedLong(
+                                      input_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, s);
+  }
+  PyObject* r = CallImpl(
+      "pred_create",
+      Py_BuildValue("(sy#iiNN)", symbol_json,
+                    static_cast<const char*>(param_bytes),
+                    static_cast<Py_ssize_t>(param_size), ctx_type, ctx_id,
+                    names, shapes));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "pred_set_input",
+      Py_BuildValue("(Osy#)", static_cast<PyObject*>(h), key,
+                    reinterpret_cast<const char*>(data),
+                    static_cast<Py_ssize_t>(size * sizeof(float))));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle h) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl("pred_forward",
+                         Py_BuildValue("(O)", static_cast<PyObject*>(h)));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// shape_data stays valid until the same thread makes kStrRing more
+// string/shape-returning calls (same ring as the string APIs)
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         const uint32_t** shape_data,
+                         uint32_t* shape_ndim) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "pred_output_shape",
+      Py_BuildValue("(OI)", static_cast<PyObject*>(h), index));
+  if (!r) return -1;
+  Py_ssize_t n = PyTuple_Size(r);
+  StrSlot& slot = NextSlot();
+  slot.str.assign(sizeof(uint32_t) * static_cast<size_t>(n), char(0));
+  uint32_t* dims = reinterpret_cast<uint32_t*>(&slot.str[0]);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    dims[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  if (PyErr_Occurred()) return CaptureErr();
+  *shape_data = dims;
+  *shape_ndim = static_cast<uint32_t>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size) {
+  if (EnsureInit()) return -1;
+  GIL gil;
+  PyObject* r = CallImpl(
+      "pred_get_output",
+      Py_BuildValue("(OI)", static_cast<PyObject*>(h), index));
+  if (!r) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return CaptureErr();
+  }
+  if (static_cast<size_t>(len) != size * sizeof(float)) {
+    Py_DECREF(r);
+    SetError("MXPredGetOutput: size mismatch");
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle h) { return FreeHandle(h); }
+
 }  // extern "C"
+

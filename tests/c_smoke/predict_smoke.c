@@ -1,0 +1,89 @@
+/*
+ * C predict-API smoke test: load an exported model (symbol JSON +
+ * params blob), feed an input, run inference, and compare against the
+ * expected output — the deploy story, all through the flat C ABI.
+ * Mirrors the reference's c_predict_api usage (image-classification
+ * predict examples).
+ *
+ * argv: symbol.json params.bin input.bin expected.bin
+ * input is (2, 16) float32; expected is the Python executor's output.
+ * Build/run: tests/test_c_api.py::TestStandaloneCProgram.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      fprintf(stderr, "FAIL %s:%d: %s — %s\n", __FILE__, __LINE__,   \
+              #cond, MXTPUGetLastError());                           \
+      exit(1);                                                       \
+    }                                                                \
+  } while (0)
+
+static char* slurp(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  CHECK(f != NULL);
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  CHECK(fread(buf, 1, *size, f) == (size_t)*size);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  CHECK(argc == 5);
+  long sym_size, param_size, in_size, want_size;
+  char* sym_json = slurp(argv[1], &sym_size);
+  char* params = slurp(argv[2], &param_size);
+  float* input = (float*)slurp(argv[3], &in_size);
+  float* want = (float*)slurp(argv[4], &want_size);
+
+  const char* input_keys[1] = {"data"};
+  const uint32_t indptr[2] = {0, 2};
+  const uint32_t shape_data[2] = {2, 16};
+  PredictorHandle pred = NULL;
+  CHECK(MXPredCreate(sym_json, params, (int)param_size,
+                     /*cpu*/ 1, 0, 1, input_keys, indptr, shape_data,
+                     &pred) == 0);
+  printf("predictor created\n");
+
+  /* canonical c_predict_api flow: size the output buffer BEFORE the
+   * first forward (shape comes from static inference) */
+  const uint32_t* oshape = NULL;
+  uint32_t ondim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim) == 0);
+  uint32_t total = 1;
+  for (uint32_t i = 0; i < ondim; ++i) total *= oshape[i];
+  printf("output ndim=%u total=%u\n", ondim, total);
+  CHECK(total == (uint32_t)(want_size / sizeof(float)));
+
+  CHECK(MXPredSetInput(pred, "data", input,
+                       (uint32_t)(in_size / sizeof(float))) == 0);
+  CHECK(MXPredForward(pred) == 0);
+
+  float* got = (float*)malloc(total * sizeof(float));
+  CHECK(MXPredGetOutput(pred, 0, got, total) == 0);
+  for (uint32_t i = 0; i < total; ++i)
+    CHECK(fabsf(got[i] - want[i]) <= 1e-5f + 1e-4f * fabsf(want[i]));
+
+  /* error path: unknown input key must fail with a message */
+  CHECK(MXPredSetInput(pred, "not_an_input", input, 4) != 0);
+  CHECK(strlen(MXTPUGetLastError()) > 0);
+
+  CHECK(MXPredFree(pred) == 0);
+  free(sym_json);
+  free(params);
+  free(input);
+  free(want);
+  free(got);
+  printf("C PREDICT TEST PASSED\n");
+  return 0;
+}

@@ -51,7 +51,7 @@ def pytest_collection_modifyitems(config, items):
 
 
 def compile_and_run_c(sources, exe_path, compiler="gcc",
-                      extra_flags=(), timeout=300):
+                      extra_flags=(), timeout=300, run_args=()):
     """Shared scaffold for standalone C/C++ programs linked against
     libmxtpu.so (used by test_c_api.py and test_cpp_package.py): builds
     with the repo include dirs + rpath, runs with the embedded
@@ -71,5 +71,5 @@ def compile_and_run_c(sources, exe_path, compiler="gcc",
     env["JAX_PLATFORMS"] = "cpu"
     site = os.path.dirname(os.path.dirname(_np.__file__))
     env["PYTHONPATH"] = os.pathsep.join([repo, site] + _sys.path[1:])
-    return subprocess.run([exe_path], env=env, capture_output=True,
-                          text=True, timeout=timeout)
+    return subprocess.run([exe_path, *run_args], env=env,
+                          capture_output=True, text=True, timeout=timeout)

@@ -134,3 +134,38 @@ class TestStandaloneCProgram:
             str(tmp_path / "mlp_smoke"))
         assert out.returncode == 0, out.stdout + out.stderr
         assert "C SMOKE TEST PASSED" in out.stdout
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+class TestPredictAPI:
+    def test_predict_smoke(self, tmp_path):
+        """Export a hybridized MLP from Python, run inference from a
+        standalone C program through MXPred*, compare outputs."""
+        from conftest import compile_and_run_c
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="relu"))
+            net.add(nn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        rng = np.random.RandomState(0)
+        data = rng.randn(2, 16).astype("float32")
+        want = net(nd.array(data)).asnumpy()
+
+        prefix = str(tmp_path / "mlp")
+        net.export(prefix)
+        (tmp_path / "input.bin").write_bytes(data.tobytes())
+        (tmp_path / "expected.bin").write_bytes(want.tobytes())
+
+        res = compile_and_run_c(
+            [os.path.join(REPO, "tests/c_smoke/predict_smoke.c")],
+            str(tmp_path / "predict_smoke"),
+            run_args=[prefix + "-symbol.json", prefix + "-0000.params",
+                      str(tmp_path / "input.bin"),
+                      str(tmp_path / "expected.bin")])
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "C PREDICT TEST PASSED" in res.stdout

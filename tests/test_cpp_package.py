@@ -7,6 +7,7 @@ forward/backward, fluent Operator SGD updates, KVStore — all from C++.
 import os
 import shutil
 
+import numpy as np
 import pytest
 
 from mxnet_tpu import _native
@@ -26,3 +27,35 @@ def test_cpp_mlp_trains(tmp_path):
         extra_flags=("-std=c++14",))
     assert out.returncode == 0, out.stdout + out.stderr
     assert "CPP PACKAGE TEST PASSED" in out.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_predictor(tmp_path):
+    """mxnet::cpp::Predictor drives an exported model end to end."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(24, activation="tanh"))
+        net.add(nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    rng = np.random.RandomState(7)
+    data = rng.randn(2, 16).astype("float32")
+    want = net(nd.array(data)).asnumpy()
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    (tmp_path / "input.bin").write_bytes(data.tobytes())
+    (tmp_path / "expected.bin").write_bytes(want.tobytes())
+
+    res = compile_and_run_c(
+        [os.path.join(REPO, "cpp-package", "example", "predict.cpp")],
+        str(tmp_path / "cpp_predict"), compiler="g++",
+        extra_flags=("-std=c++17",),
+        run_args=[prefix + "-symbol.json", prefix + "-0000.params",
+                  str(tmp_path / "input.bin"),
+                  str(tmp_path / "expected.bin")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CPP PREDICT TEST PASSED" in res.stdout
