@@ -258,8 +258,13 @@ class _Predictor:
         from mxnet_tpu import symbol as sym_mod
         self._sym = sym_mod.load_json(symbol_json)
         params = nd.load_buffer(param_bytes) if param_bytes else {}
+        if not isinstance(params, dict):
+            raise ValueError(
+                "predictor params blob must be name->array (saved via "
+                "nd.save(path, dict) / Block.export), got an unnamed "
+                "list")
         clean = {}
-        for k, v in (params.items() if isinstance(params, dict) else []):
+        for k, v in params.items():
             clean[k[4:] if k.startswith(("arg:", "aux:")) else k] = v
         shapes = {n: tuple(int(d) for d in s)
                   for n, s in zip(input_names, input_shapes)}
@@ -280,6 +285,11 @@ class _Predictor:
             self._static_out_shapes = None
 
     def set_input(self, key, data_bytes):
+        if key not in self._input_names:
+            raise KeyError(
+                f"{key!r} is not a declared input "
+                f"(inputs: {self._input_names}); parameters cannot be "
+                "overwritten through MXPredSetInput")
         arr = self._ex.arg_dict[key]
         np_arr = np.frombuffer(data_bytes, dtype="float32").reshape(
             arr.shape)

@@ -274,8 +274,12 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Index → dense vector lookup (parity: nn.Embedding, sparse_grad
-    accepted but dense on TPU — gather rides the MXU-friendly path)."""
+    """Index → dense vector lookup (parity: nn.Embedding).
+
+    ``sparse_grad=True`` types the weight's gradient as ``row_sparse``
+    so optimizers take the lazy touched-rows-only update path — the
+    reference's sparse-embedding training story.  Storage stays a dense
+    XLA buffer (gather/scatter ride the MXU-friendly path)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, prefix=None,
@@ -287,7 +291,8 @@ class Embedding(HybridBlock):
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer, allow_deferred_init=True)
+                init=weight_initializer, allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, **self._kwargs)

@@ -164,7 +164,8 @@ class Parameter:
 
     def _init_grad(self):
         for arr in self._all_replicas():
-            arr.attach_grad(grad_req=self._grad_req)
+            arr.attach_grad(grad_req=self._grad_req,
+                            stype=self._grad_stype)
         self._grad = self._data._grad
 
     def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source=""):
@@ -385,6 +386,18 @@ class ParameterDict:
             self._params[name] = param
         else:
             for k, v in kwargs.items():
+                if k in ("stype", "grad_stype"):
+                    # stored under private names; keep the shared param's
+                    # sparse typing if EITHER declaration requests it
+                    attr = "_" + k
+                    if v is not None and v != "default":
+                        if getattr(param, attr) in (None, "default"):
+                            setattr(param, attr, v)
+                        elif getattr(param, attr) != v:
+                            raise ValueError(
+                                f"Parameter {name!r}: conflicting {k} "
+                                f"{getattr(param, attr)!r} vs {v!r}")
+                    continue
                 existing = getattr(param, k, None)
                 if existing is None or v is None:
                     if v is not None:

@@ -136,9 +136,15 @@ class KVStore:
         if self._compression is not None:
             vals = [NDArray(self._compression.compress(f"{k}:{i}", v._data),
                             ctx=root_ctx) for i, v in enumerate(vals)]
-        if len(vals) == 1:
-            return vals[0]
-        return nd.add_n(*vals)
+        merged = vals[0] if len(vals) == 1 else nd.add_n(*vals)
+        if all(getattr(v, "stype", "default") == "row_sparse"
+               for v in values):
+            # keep the stype so server-side lazy updates still fire
+            from ..ndarray.sparse import RowSparseNDArray
+            if not isinstance(merged, RowSparseNDArray):
+                merged = RowSparseNDArray(merged._data,
+                                          ctx=merged.context)
+        return merged
 
     def push(self, key, value, priority=0):
         keys, _ = _key_list(key)

@@ -370,11 +370,19 @@ class NDArray:
         """Allocate gradient buffer; marks this array as an autograd leaf.
 
         Parity: ``NDArray.attach_grad`` / ``MXAutogradMarkVariables``.
+        ``stype="row_sparse"`` types the grad buffer so optimizers take
+        the lazy (touched-rows-only) update path, as the reference does
+        for ``row_sparse`` gradient storage.
         """
         from .. import autograd
         self.grad_req = grad_req
-        self._grad = NDArray(_jnp().zeros(self.shape, self.dtype),
-                             ctx=self._ctx)
+        if stype == "row_sparse":
+            from .sparse import RowSparseNDArray
+            self._grad = RowSparseNDArray(
+                _jnp().zeros(self.shape, self.dtype), ctx=self._ctx)
+        else:
+            self._grad = NDArray(_jnp().zeros(self.shape, self.dtype),
+                                 ctx=self._ctx)
         self._grad._buf = _jax().device_put(self._grad._buf,
                                             self._ctx.device)
 

@@ -72,9 +72,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype="float32"):
     indptr = np.asarray(indptr, dtype="int64")
     indices = np.asarray(indices, dtype="int64")
     vals = np.asarray(data, dtype=dtype)
-    for row in range(shape[0]):
-        for j in range(indptr[row], indptr[row + 1]):
-            dense[row, indices[j]] = vals[j]
+    rows = np.repeat(np.arange(shape[0]), np.diff(indptr))
+    dense[rows, indices] = vals
     base = array(dense, ctx=ctx, dtype=dtype)
     return _make("csr", base._data, base._ctx)
 
@@ -86,8 +85,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
     data, indices = arg1
     dense = np.zeros(shape, dtype=dtype)
     data = np.asarray(data, dtype=dtype)
-    for k, row in enumerate(np.asarray(indices, dtype="int64")):
-        dense[row] = data[k]
+    dense[np.asarray(indices, dtype="int64")] = data
     base = array(dense, ctx=ctx, dtype=dtype)
     return _make("row_sparse", base._data, base._ctx)
 

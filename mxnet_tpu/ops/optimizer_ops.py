@@ -28,19 +28,37 @@ def _prep_grad(grad, rescale_grad, clip_gradient, wd=None, weight=None):
     return g
 
 
+def _row_mask(grad):
+    """Lazy-update row mask: True for rows the (row-sparse) gradient
+    touches.  Reference lazy semantics (sgd/adam with row_sparse grads)
+    skip untouched rows entirely — no wd decay, no momentum/moment
+    decay; here "touched" = any nonzero in the row."""
+    axes = tuple(range(1, grad.ndim))
+    m = jnp.any(grad != 0, axis=axes)
+    return m.reshape(m.shape + (1,) * (grad.ndim - 1))
+
+
 @register("sgd_update", num_inputs=2, scalar_attrs=("lr", "wd"))
 def sgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
-               clip_gradient=-1.0, lazy_update=True):
+               clip_gradient=-1.0, lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
-    return weight - lr * g
+    new_w = weight - lr * g
+    if lazy_update:
+        return jnp.where(_row_mask(grad), new_w, weight)
+    return new_w
 
 
 @register("sgd_mom_update", num_inputs=3, scalar_attrs=("lr", "wd"),
           num_outputs=2)
 def sgd_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
-                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - lr * g
+    if lazy_update:
+        mask = _row_mask(grad)
+        new_mom = jnp.where(mask, new_mom, mom)
+        return jnp.where(mask, weight + new_mom, weight), new_mom
     return weight + new_mom, new_mom
 
 
@@ -79,11 +97,16 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, *, momentum=0.0,
           num_outputs=3)
 def adam_update(weight, grad, mean, var, lr, wd, *, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
-                lazy_update=True):
+                lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mean = beta1 * mean + (1.0 - beta1) * g
     new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
     w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    if lazy_update:
+        mask = _row_mask(grad)
+        return (jnp.where(mask, w, weight),
+                jnp.where(mask, new_mean, mean),
+                jnp.where(mask, new_var, var))
     return w, new_mean, new_var
 
 

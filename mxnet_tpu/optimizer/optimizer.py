@@ -200,16 +200,22 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        # reference semantics: lazy (touched-rows-only) updates apply
+        # only to row_sparse gradients
+        lazy = self.lazy_update and \
+            getattr(grad, "stype", "default") == "row_sparse"
         if state is not None:
             nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
                               momentum=self.momentum,
                               rescale_grad=self.rescale_grad,
                               clip_gradient=self._clip(),
+                              lazy_update=lazy,
                               out=[weight, state])
         else:
             nd.sgd_update(weight, grad, lr=lr, wd=wd,
                           rescale_grad=self.rescale_grad,
-                          clip_gradient=self._clip(), out=weight)
+                          clip_gradient=self._clip(),
+                          lazy_update=lazy, out=weight)
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == np.float16:
@@ -285,11 +291,14 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        lazy = self.lazy_update and \
+            getattr(grad, "stype", "default") == "row_sparse"
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon,
                        rescale_grad=self.rescale_grad,
                        clip_gradient=self._clip(),
+                       lazy_update=lazy,
                        out=[weight, mean, var])
 
 

@@ -169,3 +169,31 @@ class TestPredictAPI:
                       str(tmp_path / "expected.bin")])
         assert res.returncode == 0, res.stdout + res.stderr
         assert "C PREDICT TEST PASSED" in res.stdout
+
+
+def test_predictor_rejects_bad_inputs(tmp_path):
+    """MXPredSetInput must not overwrite parameters; unnamed params
+    blobs are rejected instead of silently ignored."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.c_api_impl import pred_create, pred_set_input
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net(nd.zeros((1, 6)))
+    prefix = str(tmp_path / "d")
+    net.export(prefix)
+    sym_json = open(prefix + "-symbol.json").read()
+    params = open(prefix + "-0000.params", "rb").read()
+    p = pred_create(sym_json, params, 1, 0, ["data"], [(1, 6)])
+    weight_name = [n for n in p._ex.arg_dict if "weight" in n][0]
+    with pytest.raises(KeyError, match="declared input"):
+        pred_set_input(p, weight_name, b"\0" * 4 * 24)
+    # unnamed list-form params blob → explicit error, not silent zeros
+    lst_path = str(tmp_path / "lst.params")
+    nd.save(lst_path, [nd.zeros((4, 6))])
+    with pytest.raises(ValueError, match="unnamed"):
+        pred_create(sym_json, open(lst_path, "rb").read(), 1, 0,
+                    ["data"], [(1, 6)])

@@ -1,0 +1,172 @@
+"""row_sparse gradient path (parity: reference sparse-embedding
+training — ``test_sparse_operator.py`` lazy-update cases and
+``nn.Embedding(sparse_grad=True)``).  Storage stays dense XLA buffers;
+the reference-visible semantics — lazy touched-rows-only optimizer
+updates, grad stype typing, row_sparse_pull — are real."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray, row_sparse_array
+
+
+def test_row_sparse_array_roundtrip():
+    data = np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")
+    rs = row_sparse_array((data, [1, 3]), shape=(5, 2))
+    assert rs.stype == "row_sparse"
+    dense = rs.asnumpy()
+    assert dense.shape == (5, 2)
+    np.testing.assert_array_equal(dense[1], data[0])
+    np.testing.assert_array_equal(dense[3], data[1])
+    np.testing.assert_array_equal(dense[0], 0)
+    np.testing.assert_array_equal(
+        rs.indices.asnumpy(), np.asarray([1, 3], "int64"))
+
+
+def test_attach_grad_stype():
+    w = nd.random.normal(shape=(6, 3))
+    w.attach_grad(stype="row_sparse")
+    assert isinstance(w.grad, RowSparseNDArray)
+    assert w.grad.stype == "row_sparse"
+    with autograd.record():
+        y = nd.sum(nd.Embedding(nd.array([[1.0, 4.0]]), w,
+                                input_dim=6, output_dim=3))
+    y.backward()
+    # grads accumulate into the SAME typed buffer
+    assert w.grad.stype == "row_sparse"
+    g = w.grad.asnumpy()
+    assert np.all(g[1] == 1.0) and np.all(g[4] == 1.0)
+    assert np.all(g[0] == 0.0)
+
+
+def _ref_sgd_mom_lazy(w, g, mom, lr, wd, momentum):
+    w, mom = w.copy(), mom.copy()
+    touched = np.any(g != 0, axis=1)
+    for r in np.nonzero(touched)[0]:
+        mom[r] = momentum * mom[r] - lr * (g[r] + wd * w[r])
+        w[r] = w[r] + mom[r]
+    return w, mom
+
+
+def test_lazy_sgd_mom_semantics():
+    rng = np.random.RandomState(0)
+    w = rng.randn(5, 3).astype("float32")
+    mom = rng.randn(5, 3).astype("float32")
+    g = np.zeros((5, 3), "float32")
+    g[[1, 3]] = rng.randn(2, 3)
+    want_w, want_mom = _ref_sgd_mom_lazy(w, g, mom, 0.1, 0.01, 0.9)
+
+    wn, mn = nd.array(w), nd.array(mom)
+    nd.sgd_mom_update(wn, nd.array(g), mn, 0.1, 0.01, momentum=0.9,
+                      lazy_update=True, out=[wn, mn])
+    np.testing.assert_allclose(wn.asnumpy(), want_w, rtol=1e-5)
+    np.testing.assert_allclose(mn.asnumpy(), want_mom, rtol=1e-5)
+    # untouched rows: bit-identical (no wd decay, no momentum decay)
+    np.testing.assert_array_equal(wn.asnumpy()[[0, 2, 4]], w[[0, 2, 4]])
+    np.testing.assert_array_equal(mn.asnumpy()[[0, 2, 4]],
+                                  mom[[0, 2, 4]])
+
+
+def test_lazy_adam_touches_only_rows():
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 2).astype("float32")
+    m = rng.randn(6, 2).astype("float32") * 0.1
+    v = np.abs(rng.randn(6, 2)).astype("float32") * 0.1
+    g = np.zeros((6, 2), "float32")
+    g[[0, 5]] = rng.randn(2, 2)
+    wn, mn, vn = nd.array(w), nd.array(m), nd.array(v)
+    nd.adam_update(wn, nd.array(g), mn, vn, 0.01, 0.0,
+                   lazy_update=True, out=[wn, mn, vn])
+    got_w, got_m, got_v = wn.asnumpy(), mn.asnumpy(), vn.asnumpy()
+    untouched = [1, 2, 3, 4]
+    np.testing.assert_array_equal(got_w[untouched], w[untouched])
+    np.testing.assert_array_equal(got_m[untouched], m[untouched])
+    np.testing.assert_array_equal(got_v[untouched], v[untouched])
+    assert np.abs(got_w[[0, 5]] - w[[0, 5]]).max() > 1e-6
+    # non-lazy reference run decays every row's moments
+    wn2, mn2, vn2 = nd.array(w), nd.array(m), nd.array(v)
+    nd.adam_update(wn2, nd.array(g), mn2, vn2, 0.01, 0.0,
+                   lazy_update=False, out=[wn2, mn2, vn2])
+    assert np.abs(mn2.asnumpy()[untouched] - m[untouched]).max() > 1e-6
+
+
+def test_embedding_sparse_grad_end_to_end():
+    """nn.Embedding(sparse_grad=True) + Trainer: untouched vocab rows
+    stay bit-identical under momentum+wd; touched rows train."""
+    vocab, dim = 10, 4
+    emb = gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "wd": 0.01})
+    w0 = emb.weight.data().asnumpy().copy()
+    tokens = nd.array(np.asarray([[1, 3, 3]], "float32"))
+    with autograd.record():
+        loss = nd.sum(emb(tokens) * emb(tokens))
+    loss.backward()
+    assert emb.weight.grad().stype == "row_sparse"
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    untouched = [0, 2, 4, 5, 6, 7, 8, 9]
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert np.abs(w1[[1, 3]] - w0[[1, 3]]).max() > 1e-6
+    # dense-grad control: wd decays EVERY row
+    emb2 = gluon.nn.Embedding(vocab, dim)
+    emb2.initialize(mx.init.Xavier())
+    emb2.weight.set_data(nd.array(w0))
+    tr2 = gluon.Trainer(emb2.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9,
+                         "wd": 0.01})
+    with autograd.record():
+        loss = nd.sum(emb2(tokens) * emb2(tokens))
+    loss.backward()
+    tr2.step(1)
+    w2 = emb2.weight.data().asnumpy()
+    assert np.abs(w2[untouched] - w0[untouched]).max() > 1e-7
+    # touched rows get the SAME update on both paths
+    np.testing.assert_allclose(w2[[1, 3]], w1[[1, 3]], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    src = np.arange(12, dtype="float32").reshape(4, 3)
+    kv.init(7, nd.array(src))
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull(7, out=out, row_ids=nd.array([1, 3]))
+    got = out.asnumpy()
+    np.testing.assert_array_equal(got[1], src[1])
+    np.testing.assert_array_equal(got[3], src[3])
+    np.testing.assert_array_equal(got[0], 0)
+
+
+def test_shared_param_keeps_grad_stype():
+    """Regression: sparse_grad=True must survive parameter sharing
+    (tied embeddings share through ParameterDict.get's merge path)."""
+    emb = gluon.nn.Embedding(8, 4, sparse_grad=True)
+    tied = gluon.nn.Embedding(8, 4, params=emb.collect_params())
+    emb.initialize(mx.init.Xavier())
+    assert emb.weight is tied.weight
+    assert emb.weight._grad_stype == "row_sparse"
+    with autograd.record():
+        loss = nd.sum(tied(nd.array([[2.0]])))
+    loss.backward()
+    assert emb.weight.grad().stype == "row_sparse"
+
+
+def test_kvstore_merge_preserves_row_sparse():
+    """Regression: multi-device grad merge must keep the row_sparse
+    typing so server-side lazy updates still fire."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    kv = mx.kv.create("local")
+    w = nd.zeros((4, 2))
+    kv.init(0, w)
+    g = np.zeros((4, 2), "float32")
+    g[1] = 1.0
+    grads = []
+    for _ in range(2):
+        a = nd.array(g)
+        grads.append(RowSparseNDArray(a._data, ctx=a.context))
+    merged = kv._merge("0", grads)
+    assert getattr(merged, "stype", "default") == "row_sparse"
+    np.testing.assert_allclose(merged.asnumpy(), 2 * g)
