@@ -88,6 +88,7 @@ from . import checkpoint
 from . import checkpoint as model  # mx.model.save_checkpoint parity
 from . import operator
 from . import contrib
+from . import rtc
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",

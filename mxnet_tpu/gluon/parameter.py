@@ -393,6 +393,12 @@ class ParameterDict:
                     if v is not None and v != "default":
                         if getattr(param, attr) in (None, "default"):
                             setattr(param, attr, v)
+                            # already-initialized shared params must
+                            # re-attach so the grad buffer gets typed
+                            if k == "grad_stype" and \
+                                    param._data is not None and \
+                                    param._grad_req != "null":
+                                param._init_grad()
                         elif getattr(param, attr) != v:
                             raise ValueError(
                                 f"Parameter {name!r}: conflicting {k} "

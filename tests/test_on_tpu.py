@@ -86,3 +86,23 @@ def test_int_and_bool_ops_on_chip():
     assert int(nd.sum(a).asnumpy()) == 66
     m = (a > 5).asnumpy()
     assert m.sum() == 6
+
+
+def test_rtc_pallas_kernel_on_chip():
+    """User rtc kernel compiled by Mosaic (interpret=False) on the
+    real chip matches the interpreter result."""
+    from mxnet_tpu import rtc
+    ctx = _ctx()
+
+    def axpy(x_ref, y_ref, o_ref, *, alpha):
+        o_ref[...] = alpha * x_ref[...] + y_ref[...]
+
+    mod = rtc.PallasModule({"axpy": axpy})
+    k = mod.get_kernel("axpy", alpha=3.0, interpret=False)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, 128).astype("f4"), ctx=ctx)
+    y = nd.array(rng.randn(8, 128).astype("f4"), ctx=ctx)
+    (out,) = k.launch([x, y], out_shapes=[(8, 128)])
+    np.testing.assert_allclose(out.asnumpy(),
+                               3.0 * x.asnumpy() + y.asnumpy(),
+                               rtol=1e-6)

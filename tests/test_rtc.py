@@ -1,0 +1,103 @@
+"""mx.rtc user-kernel API (parity: reference ``python/mxnet/rtc.py``
+CudaModule/CudaKernel — SURVEY.md §2.2 "user-facing RTC").  Kernels are
+Pallas functions; on the CPU suite they run under the Pallas
+interpreter, the same path the in-tree flash-attention tests use."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, rtc
+
+
+def test_axpy_kernel_whole_array():
+    def axpy(x_ref, y_ref, o_ref, *, alpha):
+        o_ref[...] = alpha * x_ref[...] + y_ref[...]
+
+    mod = rtc.PallasModule({"axpy": axpy})
+    k = mod.get_kernel("axpy", alpha=2.0)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, 16).astype("float32"))
+    y = nd.array(rng.randn(8, 16).astype("float32"))
+    (out,) = k.launch([x, y], out_shapes=[(8, 16)])
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.0 * x.asnumpy() + y.asnumpy(),
+                               rtol=1e-6)
+    # compile-once: second launch reuses the cached executable
+    assert len(k._compiled) == 1
+    (out2,) = k.launch([x, y], out_shapes=[(8, 16)])
+    assert len(k._compiled) == 1
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy())
+
+
+def test_grid_blockspec_kernel():
+    from jax.experimental import pallas as pl
+
+    def scale_rows(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * (pl.program_id(0) + 1)
+
+    mod = rtc.PallasModule({"scale_rows": scale_rows})
+    k = mod.get_kernel("scale_rows")
+    x = nd.array(np.ones((4, 8), "float32"))
+    (out,) = k.launch(
+        [x], grid=(4,), out_shapes=[(4, 8)],
+        in_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))])
+    want = np.ones((4, 8), "float32") * np.arange(1, 5)[:, None]
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_multi_output_kernel():
+    def stats(x_ref, s_ref, q_ref):
+        s_ref[...] = x_ref[...] + 1.0
+        q_ref[...] = x_ref[...] * x_ref[...]
+
+    mod = rtc.PallasModule({"stats": stats})
+    k = mod.get_kernel("stats")
+    x = nd.array(np.arange(6, dtype="float32").reshape(2, 3))
+    s, q = k.launch([x], out_shapes=[(2, 3), (2, 3)])
+    np.testing.assert_allclose(s.asnumpy(), x.asnumpy() + 1.0)
+    np.testing.assert_allclose(q.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_errors():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        rtc.CudaModule("__global__ void k() {}")
+    with pytest.raises(mx.MXNetError, match="kernel_fn"):
+        rtc.PallasModule("source-string")
+    mod = rtc.PallasModule({"a": lambda x_ref, o_ref: None})
+    with pytest.raises(mx.MXNetError, match="not in module"):
+        mod.get_kernel("b")
+    with pytest.raises(mx.MXNetError, match="out_shapes"):
+        mod.get_kernel("a").launch([nd.zeros((2,))])
+
+
+def test_spec_variants_do_not_collide():
+    """Regression: same shapes/grid with different BlockSpecs must not
+    reuse the first compiled executable."""
+    from jax.experimental import pallas as pl
+
+    def ident(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * (pl.program_id(0) + 1)
+
+    mod = rtc.PallasModule({"ident": ident})
+    k = mod.get_kernel("ident")
+    x = nd.array(np.ones((4, 8), "float32"))
+    specs_a = ([pl.BlockSpec((1, 8), lambda i: (i, 0))],
+               [pl.BlockSpec((1, 8), lambda i: (i, 0))])
+    specs_b = ([pl.BlockSpec((2, 8), lambda i: (i, 0))],
+               [pl.BlockSpec((2, 8), lambda i: (i, 0))])
+    (a,) = k.launch([x], grid=(4,), out_shapes=[(4, 8)],
+                    in_specs=specs_a[0], out_specs=specs_a[1])
+    (b,) = k.launch([x], grid=[2], out_shapes=[(4, 8)],
+                    in_specs=specs_b[0], out_specs=specs_b[1])
+    # row multipliers differ between the two block mappings
+    np.testing.assert_allclose(a.asnumpy()[:, 0], [1, 2, 3, 4])
+    np.testing.assert_allclose(b.asnumpy()[:, 0], [1, 1, 2, 2])
+    # int32 output after float output must not reuse the float kernel
+    def fill(x_ref, o_ref):
+        o_ref[...] = x_ref[...].astype(o_ref.dtype) + 1
+    mod2 = rtc.PallasModule({"fill": fill})
+    kf = mod2.get_kernel("fill")
+    (f32,) = kf.launch([x], out_shapes=[(4, 8)])
+    (i32,) = kf.launch([x], out_shapes=[(4, 8)], out_dtypes=["int32"])
+    assert f32.dtype.name == "float32" and i32.dtype.name == "int32"

@@ -170,3 +170,18 @@ def test_kvstore_merge_preserves_row_sparse():
     merged = kv._merge("0", grads)
     assert getattr(merged, "stype", "default") == "row_sparse"
     np.testing.assert_allclose(merged.asnumpy(), 2 * g)
+
+
+def test_shared_param_grad_stype_after_init():
+    """Regression: declaring sparse_grad on an ALREADY-initialized
+    shared parameter must re-type the attached grad buffer."""
+    emb = gluon.nn.Embedding(8, 4)
+    emb.initialize(mx.init.Xavier())
+    emb(nd.array([[1.0]]))  # grads attached dense
+    tied = gluon.nn.Embedding(8, 4, sparse_grad=True,
+                              params=emb.collect_params())
+    assert emb.weight is tied.weight
+    with autograd.record():
+        loss = nd.sum(tied(nd.array([[2.0]])))
+    loss.backward()
+    assert emb.weight.grad().stype == "row_sparse"
