@@ -90,7 +90,7 @@ from . import operator
 from . import contrib
 from . import rtc
 
-__all__ = ["nd", "ndarray", "autograd", "random", "context",
+__all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
            "num_gpus", "num_tpus", "Context", "MXNetError", "engine",
            "initializer", "init", "lr_scheduler", "optimizer", "gluon",

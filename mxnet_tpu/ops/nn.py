@@ -226,19 +226,56 @@ def deconvolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
                   pad=(), adj=(), num_filter=0, num_group=1, no_bias=True,
                   layout=None, target_shape=(), workspace=0,
                   cudnn_tune=None, cudnn_off=False):
+    """Transposed conv == gradient of the forward conv w.r.t. its input
+    (the reference's deconvolution-inl.h definition), so it is computed
+    as exactly that: the vjp of ``conv_general_dilated`` whose weight is
+    the MXNet deconv layout (C_in, num_filter/num_group, *kernel).
+    This stays correct across groups/dilation/adj, where hand-translated
+    conv_transpose padding arithmetic diverges."""
+    import jax as _jax
     k = len(kernel)
     stride = tuple(stride) if stride else (1,) * k
     pad = tuple(pad) if pad else (0,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
-    # transposed conv == gradient of conv w.r.t. input
-    if num_group != 1:
-        raise NotImplementedError("grouped Deconvolution")
-    # weight layout in MXNet deconv: (C_in, num_filter, *kernel)
-    out = lax.conv_transpose(
-        data, jnp.swapaxes(weight, 0, 1),
-        strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=_conv_dims(k), transpose_kernel=True)
+    adj = tuple(adj) if adj else (0,) * k
+    for i in range(k):
+        if adj[i] >= stride[i]:
+            raise ValueError(
+                f"Deconvolution: adj[{i}]={adj[i]} must be < "
+                f"stride[{i}]={stride[i]}")
+    n_filter = num_filter or weight.shape[1] * num_group
+    if target_shape:
+        # reference semantics: target_shape OVERRIDES pad — padding is
+        # inferred so the output matches the requested spatial shape
+        out_sp = tuple(int(t) for t in target_shape)
+        inferred = []
+        for i in range(k):
+            total = ((data.shape[2 + i] - 1) * stride[i]
+                     + (kernel[i] - 1) * dilate[i] + 1 + adj[i]
+                     - out_sp[i])
+            if total < 0 or total % 2:
+                raise ValueError(
+                    f"Deconvolution: target_shape {target_shape} "
+                    f"unreachable with kernel/stride/dilate along axis "
+                    f"{i} (needs total pad {total})")
+            inferred.append(total // 2)
+        pad = tuple(inferred)
+    else:
+        out_sp = tuple(
+            (data.shape[2 + i] - 1) * stride[i] - 2 * pad[i]
+            + (kernel[i] - 1) * dilate[i] + 1 + adj[i]
+            for i in range(k))
+    y_shape = (data.shape[0], n_filter) + out_sp
+    dn = lax.conv_dimension_numbers(y_shape, weight.shape, _conv_dims(k))
+
+    def fwd(y):
+        return lax.conv_general_dilated(
+            y, weight, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=num_group)
+
+    _, vjp = _jax.vjp(fwd, jnp.zeros(y_shape, data.dtype))
+    out = vjp(data)[0]
     if not no_bias and rest:
         out = out + jnp.reshape(rest[0], (1, -1) + (1,) * k)
     return out

@@ -40,6 +40,31 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _specs_key(specs) -> Tuple:
+    """Structural cache key for BlockSpec lists: block shape + the
+    index_map's compiled code/closure.  Rebuilding an *equal* spec per
+    launch (the idiomatic pattern) therefore hits the cache instead of
+    recompiling the kernel each step."""
+    if specs is None:
+        return ()
+    out = []
+    for s in specs:
+        bs = getattr(s, "block_shape", None)
+        im = getattr(s, "index_map", None)
+        # pallas wraps the user function in _IndexMapFunc; unwrap to
+        # reach the code object
+        im = getattr(im, "index_map", im)
+        code = getattr(im, "__code__", None)
+        if code is not None:
+            closure = getattr(im, "__closure__", None) or ()
+            imk = (code.co_code, repr(code.co_consts),
+                   tuple(repr(c.cell_contents) for c in closure))
+        else:
+            imk = repr(im)
+        out.append((tuple(bs) if bs is not None else None, imk))
+    return tuple(out)
+
+
 _RTC_SEQ = functools.partial(next, __import__("itertools").count())
 
 
@@ -54,10 +79,10 @@ class PallasKernel:
         self._fn = fn
         self._static = dict(static_kwargs)
         self._interpret = interpret
-        # key -> list of (in_specs, out_specs, scratch_shapes, OpDef);
-        # BlockSpecs carry lambdas (unhashable by value), so they are
-        # matched by identity against the strong references held here
-        self._compiled: Dict[Tuple, list] = {}
+        # key (incl. structural BlockSpec keys) -> OpDef; structural
+        # keying means idiomatic callers that rebuild equal specs each
+        # launch still hit the cache instead of recompiling per step
+        self._compiled: Dict[Tuple, Any] = {}
 
     def _build(self, out_shapes, out_dtypes, grid, in_specs, out_specs,
                scratch_shapes):
@@ -107,19 +132,16 @@ class PallasKernel:
             nds = [a.as_in_context(ctx) for a in nds]
         arrs = [a._data for a in nds]
         if not out_dtypes:
-            out_dtypes = [arrs[0].dtype] * len(out_shapes)
+            out_dtypes = [arrs[0].dtype if arrs else "float32"] \
+                * len(out_shapes)
         grid = tuple(grid) if isinstance(grid, (list, tuple)) else grid
         key = (tuple(a.shape for a in arrs),
                tuple(str(a.dtype) for a in arrs),
                tuple(tuple(s) for s in out_shapes),
-               tuple(str(d) for d in out_dtypes), grid)
-        op = None
-        entries = self._compiled.setdefault(key, [])
-        for e_in, e_out, e_scr, e_op in entries:
-            if e_in is in_specs and e_out is out_specs and \
-                    e_scr is scratch_shapes:
-                op = e_op
-                break
+               tuple(str(d) for d in out_dtypes), grid,
+               _specs_key(in_specs), _specs_key(out_specs),
+               repr(scratch_shapes))
+        op = self._compiled.get(key)
         if op is None:
             fn = self._build([tuple(s) for s in out_shapes],
                              list(out_dtypes), grid, in_specs, out_specs,
@@ -128,8 +150,8 @@ class PallasKernel:
             # monotonic op names: never collide even across gc'd kernels
             op = OpDef(f"_rtc_{self._name}_{_RTC_SEQ()}", fn, len(arrs),
                        len(out_shapes), (), False, None)
-            entries.append((in_specs, out_specs, scratch_shapes, op))
-        out = invoke(op, nds)
+            self._compiled[key] = op
+        out = invoke(op, nds, ctx=ctx)
         return out if isinstance(out, (list, tuple)) else (out,)
 
 

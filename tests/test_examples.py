@@ -62,3 +62,16 @@ def test_distributed_training_two_workers():
                drop_env=("XLA_FLAGS",))
     assert res.returncode == 0
     assert res.stdout.count("final loss") == 2
+
+
+def test_word_lm_smoke():
+    res = _run([os.path.join("example", "word_lm.py"), "--steps", "40"])
+    assert res.returncode == 0
+    assert "perplexity" in res.stdout
+
+
+def test_dcgan_smoke():
+    res = _run([os.path.join("example", "dcgan.py"), "--steps", "6",
+                "--batch-size", "8"])
+    assert res.returncode == 0
+    assert "images/sec" in res.stdout

@@ -101,3 +101,32 @@ def test_spec_variants_do_not_collide():
     (f32,) = kf.launch([x], out_shapes=[(4, 8)])
     (i32,) = kf.launch([x], out_shapes=[(4, 8)], out_dtypes=["int32"])
     assert f32.dtype.name == "float32" and i32.dtype.name == "int32"
+
+
+def test_rebuilt_specs_hit_cache():
+    """Regression: rebuilding structurally-equal BlockSpecs per launch
+    (the idiomatic loop pattern) must not recompile each step."""
+    from jax.experimental import pallas as pl
+
+    def ident(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    mod = rtc.PallasModule({"ident": ident})
+    k = mod.get_kernel("ident")
+    x = nd.array(np.ones((4, 8), "float32"))
+    for _ in range(3):
+        k.launch([x], grid=(4,), out_shapes=[(4, 8)],
+                 in_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))],
+                 out_specs=[pl.BlockSpec((1, 8), lambda i: (i, 0))])
+    assert len(k._compiled) == 1
+
+
+def test_zero_input_kernel():
+    def fill(o_ref):
+        import jax.numpy as jnp
+        o_ref[...] = jnp.full(o_ref.shape, 7.0, jnp.float32)
+
+    mod = rtc.PallasModule({"fill": fill})
+    k = mod.get_kernel("fill")
+    (out,) = k.launch([], ctx=mx.cpu(), out_shapes=[(3, 5)])
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
