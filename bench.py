@@ -278,19 +278,35 @@ def main():
     except Exception:
         traceback.print_exc(file=sys.stderr)
 
-    # stage 3: the headline — bert_base, TPU only
+    # stage 3: the headline — bert_base, TPU only.  Batch sweep: larger
+    # global batches raise MXU utilization; keep the best samples/sec
+    # (each config compiles fresh, so only sweep while budget remains)
     if on_tpu:
-        try:
-            _log("stage 3: bert_base pretrain bench")
-            sps, mfu = bench_bert_pretrain(
-                builder_name="bert_base", vocab=30522, batch_size=32,
-                seq_len=128, num_masked=20, steps=20, warmup=3,
-                hidden=768, layers=12, heads=12)
-            _set_result("bert_base_pretrain_samples_per_sec_per_chip",
-                        sps, mfu=round(mfu, 4))
-            _log(f"stage 3 done: {sps:.1f} samples/sec, mfu={mfu:.3f}")
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
+        best = None
+        for bs in (32, 64, 128):
+            remaining = budget - (time.monotonic() - _T0)
+            if best is not None and remaining < 180:
+                _log(f"stage 3: skipping batch {bs} "
+                     f"({remaining:.0f}s budget left)")
+                break
+            try:
+                _log(f"stage 3: bert_base pretrain bench (batch {bs})")
+                sps, mfu = bench_bert_pretrain(
+                    builder_name="bert_base", vocab=30522,
+                    batch_size=bs, seq_len=128, num_masked=20,
+                    steps=20, warmup=3, hidden=768, layers=12, heads=12)
+                _log(f"stage 3 batch {bs}: {sps:.1f} samples/sec, "
+                     f"mfu={mfu:.3f}")
+                if best is None or sps > best[0]:
+                    best = (sps, mfu, bs)
+                    _set_result(
+                        "bert_base_pretrain_samples_per_sec_per_chip",
+                        sps, mfu=round(mfu, 4), batch_size=bs)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        if best:
+            _log(f"stage 3 done: best {best[0]:.1f} samples/sec "
+                 f"(batch {best[2]}, mfu={best[1]:.3f})")
 
     _emit_and_exit(0)
 

@@ -7,10 +7,13 @@ from .bert import BERTModel, BERTForPretrain, bert_base, bert_small, \
 from . import forecast
 from .forecast import DeepAR, TransformerForecaster
 from . import llama
+from . import ssd
+from .ssd import SSD, ssd_tiny, MultiBoxLoss
 from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
                     llama_tiny, llama3_8b)
 
-__all__ = ["bert", "BERTModel", "BERTForPretrain", "bert_base",
+__all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
+           "bert", "BERTModel", "BERTForPretrain", "bert_base",
            "bert_small", "bert_large", "get_bert", "forecast",
            "DeepAR", "TransformerForecaster", "llama", "LlamaModel",
            "LlamaForCausalLM", "get_llama", "llama_tiny", "llama3_8b"]

@@ -149,3 +149,176 @@ def roi_align(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
 alias("box_iou", "_contrib_box_iou")
 alias("box_nms", "_contrib_box_nms")
 alias("ROIAlign", "_contrib_ROIAlign")
+
+
+# ---------------------------------------------------------------------------
+# legacy SSD ops — reference src/operator/contrib/multibox_{prior,target,
+# detection}.cc (the example/ssd training/inference path)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxPrior", num_inputs=1)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (parity: multibox_prior.cc).
+
+    data: (B, C, H, W) feature map (values unused — only H, W matter).
+    Per pixel: ``len(sizes) + len(ratios) - 1`` anchors — every size at
+    ratios[0], plus sizes[0] at each remaining ratio.  Returns
+    (1, H*W*A, 4) corner boxes in normalized [0, 1] coordinates.
+    """
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    half = []
+    r0 = float(np.sqrt(ratios[0]))
+    for s in sizes:
+        half.append((s * r0 / 2.0, s / r0 / 2.0))
+    for r in ratios[1:]:
+        sr = float(np.sqrt(r))
+        half.append((sizes[0] * sr / 2.0, sizes[0] / sr / 2.0))
+    hw = jnp.asarray([p[0] for p in half], jnp.float32)  # (A,)
+    hh = jnp.asarray([p[1] for p in half], jnp.float32)
+    cxg = cx[None, :, None]
+    cyg = cy[:, None, None]
+    boxes = jnp.stack(
+        [jnp.broadcast_to(cxg - hw, (h, w, hw.size)),
+         jnp.broadcast_to(cyg - hh, (h, w, hw.size)),
+         jnp.broadcast_to(cxg + hw, (h, w, hw.size)),
+         jnp.broadcast_to(cyg + hh, (h, w, hw.size))], axis=-1)
+    boxes = boxes.reshape(1, h * w * hw.size, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _encode_loc(anchors, gt, variances):
+    """Corner anchors + corner GT → (dx, dy, dw, dh) regression target."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
+    gw = jnp.clip(gt[..., 2] - gt[..., 0], 1e-8, None)
+    gh = jnp.clip(gt[..., 3] - gt[..., 1], 1e-8, None)
+    gx = (gt[..., 0] + gt[..., 2]) / 2
+    gy = (gt[..., 1] + gt[..., 3]) / 2
+    dx = (gx - ax) / jnp.clip(aw, 1e-8, None) / variances[0]
+    dy = (gy - ay) / jnp.clip(ah, 1e-8, None) / variances[1]
+    dw = jnp.log(gw / jnp.clip(aw, 1e-8, None)) / variances[2]
+    dh = jnp.log(gh / jnp.clip(ah, 1e-8, None)) / variances[3]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+@register("_contrib_MultiBoxTarget", num_inputs=3, num_outputs=3)
+def multibox_target(anchors, labels, cls_preds, *,
+                    overlap_threshold=0.5, ignore_label=-1.0,
+                    negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→GT matching + target encoding (multibox_target.cc).
+
+    anchors (1, N, 4) corner; labels (B, M, 5) rows [cls, x1, y1, x2,
+    y2] padded with cls=-1; cls_preds (B, C+1, N) (used only for hard
+    negative mining, which is structurally supported via the
+    ``negative_mining_ratio`` contract).
+    Returns loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N) —
+    cls_target is shifted by +1 (0 = background), the reference layout.
+    """
+    anc = anchors[0]  # (N, 4)
+    n = anc.shape[0]
+
+    def one(sample_labels, sample_cls_preds):
+        cls = sample_labels[:, 0]
+        valid = cls >= 0  # (M,)
+        gt = sample_labels[:, 1:5]
+        iou = _iou_corner(anc, gt)          # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_iou = iou.max(axis=1)          # per anchor
+        best_gt = iou.argmax(axis=1)
+        pos = best_iou >= overlap_threshold
+        # bipartite half: every valid GT claims its best anchor.
+        # Padding rows (cls<0) are routed to index n, which mode="drop"
+        # discards — otherwise their argmax lands on anchor 0 and can
+        # cancel a valid GT's claim there.
+        gt_best_anchor = jnp.where(valid, iou.argmax(axis=0), n)  # (M,)
+        forced = jnp.zeros((n,), bool).at[gt_best_anchor].set(
+            True, mode="drop")
+        claimed_gt = jnp.zeros((n,), jnp.int32).at[gt_best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        match = jnp.where(forced, claimed_gt, best_gt)
+        pos = pos | forced
+        matched_gt = gt[match]              # (N, 4)
+        loc_t = _encode_loc(anc, matched_gt, variances)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(pos[:, None],
+                          jnp.ones((n, 4), jnp.float32),
+                          0.0).reshape(-1)
+        cls_t = jnp.where(pos, cls[match] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining: keep the highest-background-loss
+            # negatives up to ratio * num_pos; rest -> ignore_label
+            bg_logit = sample_cls_preds[0]  # (N,)
+            max_logit = sample_cls_preds.max(axis=0)
+            neg_score = max_logit - bg_logit  # high = confident non-bg
+            # near-positives (IoU >= mining thresh) are excluded from
+            # mining, per the reference multibox_target.cc contract
+            neg_score = jnp.where(
+                best_iou < negative_mining_thresh, neg_score, -jnp.inf)
+            num_pos = pos.sum()
+            quota = (negative_mining_ratio * num_pos).astype(jnp.int32)
+            quota = jnp.maximum(quota, minimum_negative_samples)
+            neg_rank = jnp.argsort(
+                jnp.argsort(-jnp.where(pos, -jnp.inf, neg_score)))
+            keep_neg = (~pos) & (neg_rank < quota)
+            cls_t = jnp.where(pos | keep_neg, cls_t, ignore_label)
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(labels, cls_preds)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", num_inputs=3)
+def multibox_detection(cls_probs, loc_preds, anchors, *, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS (multibox_detection.cc).
+
+    cls_probs (B, C+1, N), loc_preds (B, N*4), anchors (1, N, 4) →
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2]; suppressed rows -1.
+    """
+    anc = anchors[0]
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    ax = (anc[:, 0] + anc[:, 2]) / 2
+    ay = (anc[:, 1] + anc[:, 3]) / 2
+
+    def one(probs, locs):
+        d = locs.reshape(-1, 4)
+        cx = d[:, 0] * variances[0] * aw + ax
+        cy = d[:, 1] * variances[1] * ah + ay
+        w = jnp.exp(jnp.clip(d[:, 2] * variances[2], None, 10.0)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3] * variances[3], None, 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (reference decode rule)
+        fg = jnp.concatenate(
+            [probs[:background_id], probs[background_id + 1:]], axis=0)
+        # ids are renumbered foreground classes (background row removed),
+        # the reference's output convention
+        cls_id = fg.argmax(axis=0).astype(jnp.float32)
+        score = fg.max(axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None], boxes], axis=1)
+        return box_nms(rows, overlap_thresh=nms_threshold,
+                       valid_thresh=0.0, topk=nms_topk, coord_start=2,
+                       score_index=1, id_index=0,
+                       force_suppress=force_suppress)
+
+    return jax.vmap(one)(cls_probs, loc_preds)

@@ -75,3 +75,10 @@ def test_dcgan_smoke():
                 "--batch-size", "8"])
     assert res.returncode == 0
     assert "images/sec" in res.stdout
+
+
+def test_ssd_train_smoke():
+    res = _run([os.path.join("example", "ssd_train.py"),
+                "--steps", "12", "--batch-size", "4"])
+    assert res.returncode == 0
+    assert "top-det IoU" in res.stdout
