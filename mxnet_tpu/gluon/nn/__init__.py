@@ -1,6 +1,7 @@
 """``mx.gluon.nn`` namespace (parity: python/mxnet/gluon/nn/)."""
 from .basic_layers import (Sequential, HybridSequential, Dense, Dropout,
-                           BatchNorm, LayerNorm, InstanceNorm, Embedding,
+                           BatchNorm, LayerNorm, InstanceNorm, GroupNorm,
+                           Embedding,
                            Flatten, Lambda, HybridLambda, HybridConcatenate,
                            Concatenate, Identity)
 from .activations import (Activation, LeakyReLU, PReLU, ELU, SELU, Swish,
@@ -15,7 +16,8 @@ from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-    "LayerNorm", "InstanceNorm", "Embedding", "Flatten", "Lambda",
+    "LayerNorm", "InstanceNorm", "GroupNorm", "Embedding",
+    "Flatten", "Lambda",
     "HybridLambda", "HybridConcatenate", "Concatenate", "Identity",
     "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU",
     "SiLU",

@@ -273,6 +273,31 @@ class InstanceNorm(HybridBlock):
         return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
 
 
+class GroupNorm(HybridBlock):
+    """Group normalization (parity: reference nn.GroupNorm over the
+    GroupNorm op)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True,
+                 scale=True, beta_initializer="zeros",
+                 gamma_initializer="ones", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            # per-GROUP scale/shift, the reference parameter layout
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(num_groups,), init=gamma_initializer)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(num_groups,), init=beta_initializer)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta,
+                           num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
 class Embedding(HybridBlock):
     """Index → dense vector lookup (parity: nn.Embedding).
 

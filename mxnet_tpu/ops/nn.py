@@ -508,3 +508,172 @@ def all_finite(*arrays, init_output=True):
 
 
 alias("multi_all_finite", "all_finite")
+
+
+# ---------------------------------------------------------------------------
+# round-2 gap closure: remaining reference NN ops
+# (reference src/operator/nn/{group_norm,lrn}.cc,
+#  src/operator/{spatial_transformer,grid_generator,bilinear_sampler,
+#  correlation,crop}.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("GroupNorm", num_inputs=3)
+def group_norm(data, gamma, beta, *, num_groups=1, eps=1e-5,
+               output_mean_var=False):
+    """(N, C, ...) normalized per sample over channel groups;
+    gamma/beta are PER GROUP, shape (num_groups,) — the reference
+    group_norm.cc parameter layout."""
+    n, c = data.shape[0], data.shape[1]
+    spatial = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    norm = (x - mean) * lax.rsqrt(var + eps)
+    gshape = (1, num_groups) + (1,) * (x.ndim - 2)
+    out = norm * gamma.reshape(gshape) + beta.reshape(gshape)
+    return out.reshape(data.shape)
+
+
+@register("LRN")
+def lrn(data, *, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Local response normalization across channels (lrn.cc):
+    out = x / (knorm + alpha/nsize * sum_window(x^2))^beta."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pads = ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2)
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window,
+                             (1,) * data.ndim, pads)
+    return data / jnp.power(knorm + alpha / nsize * ssum, beta)
+
+
+@register("GridGenerator")
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Affine: data (N, 6) θ → sampling grid (N, 2, H, W) in [-1, 1]
+    (x then y rows, the reference layout).  Warp: data IS the grid of
+    offsets added to the identity grid."""
+    h, w = int(target_shape[0]), int(target_shape[1])
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    if transform_type == "affine":
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, HW)
+        theta = data.reshape(-1, 2, 3)
+        grid = jnp.einsum("nij,jk->nik", theta, base)            # (N,2,HW)
+        return grid.reshape(-1, 2, h, w)
+    # warp: data (N, 2, H, W) PIXEL flow added to the identity grid of
+    # the flow's own spatial shape, scaled into normalized units
+    fh, fw = data.shape[2], data.shape[3]
+    ys = jnp.linspace(-1.0, 1.0, fh)
+    xs = jnp.linspace(-1.0, 1.0, fw)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ident = jnp.stack([gx, gy], axis=0)[None].astype(data.dtype)
+    scale = jnp.asarray(
+        [2.0 / max(fw - 1, 1), 2.0 / max(fh - 1, 1)],
+        data.dtype).reshape(1, 2, 1, 1)
+    return ident + data * scale
+
+
+def _bilinear_sample_one(img, grid):
+    """img (C, H, W); grid (2, Ho, Wo) in [-1, 1] → (C, Ho, Wo)."""
+    c, h, w = img.shape
+    gx = (grid[0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def at(yi, xi):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]          # (C, Ho, Wo)
+        return jnp.where(inb[None], vals, 0.0)
+
+    out = (at(y0, x0) * (1 - wx) * (1 - wy)
+           + at(y0, x0 + 1) * wx * (1 - wy)
+           + at(y0 + 1, x0) * (1 - wx) * wy
+           + at(y0 + 1, x0 + 1) * wx * wy)
+    return out.astype(img.dtype)
+
+
+@register("BilinearSampler", num_inputs=2)
+def bilinear_sampler(data, grid):
+    """data (N, C, H, W) sampled at grid (N, 2, Ho, Wo) ∈ [-1, 1]
+    (bilinear_sampler.cc; zero padding outside)."""
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+@register("SpatialTransformer", num_inputs=2)
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """Affine spatial transformer network head (spatial_transformer.cc)
+    = GridGenerator(affine) + BilinearSampler."""
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid.astype(data.dtype))
+
+
+@register("Correlation", num_inputs=2, num_outputs=1)
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet-style correlation (correlation.cc): per displacement
+    (dy, dx), mean over the patch of data1·shifted(data2).
+
+    Static displacement set → one fused XLA program; kernel_size>1 is
+    realized with an average pool over the product map.
+    """
+    if stride1 != 1:
+        raise NotImplementedError("Correlation: stride1 != 1")
+    d = max_displacement
+    p = pad_size
+    radius = kernel_size // 2
+    x1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    # zero-extend data2 by the displacement range so shifted reads see
+    # ZEROS outside the (padded) image, matching the reference — a
+    # plain roll would wrap values around the border
+    x2 = jnp.pad(data2, ((0, 0), (0, 0), (p + d, p + d), (p + d, p + d)))
+    n, c, h, w = x1.shape
+    outs = []
+    disps = range(-d, d + 1, stride2)
+    for dy in disps:
+        for dx in disps:
+            sh = x2[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
+            prod = (x1 * sh) if is_multiply else -jnp.abs(x1 - sh)
+            m = jnp.mean(prod, axis=1)           # (N, H, W), mean over C
+            if kernel_size > 1:
+                k = kernel_size
+                m = lax.reduce_window(
+                    m, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                    ((0, 0), (radius, radius),
+                     (radius, radius))) / float(k * k)
+            outs.append(m)
+    out = jnp.stack(outs, axis=1)
+    # reference output crops the border where windows fall off the
+    # padded extent: H_out = H + 2p - 2*(d + kernel_radius)
+    border = d + radius
+    if border:
+        out = out[:, :, border:h - border, border:w - border]
+    return out
+
+
+@register("Crop", num_inputs=None)
+def crop(data, *rest, offset=(0, 0), h_w=(0, 0), num_args=1,
+         center_crop=False):
+    """Crop data to h_w (or to the 2nd input's spatial size) at offset
+    (crop.cc)."""
+    if len(rest) >= 1 and num_args == 2:
+        th, tw = rest[0].shape[2], rest[0].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy:oy + th, ox:ox + tw]

@@ -676,3 +676,204 @@ def square_sum(data, *, axis=None, keepdims=False, exclude=False):
 def log_sum_exp(data, *, axis=None, keepdims=False):
     axes = None if axis is None else _norm_axis(axis, data.ndim)
     return jax.nn.logsumexp(data, axis=axes, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# round-2 gap closure: remaining reference tensor/linalg ops
+# (reference src/operator/tensor/{matrix_op,ordering_op,init_op}.cc,
+#  src/operator/tensor/la_op.cc, src/operator/contrib/krprod.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("cumsum")
+def cumsum(a, *, axis=None, dtype=None):
+    out = jnp.cumsum(a if axis is not None else a.ravel(),
+                     axis=axis if axis is not None else 0)
+    return out.astype(dtype) if dtype else out
+
+
+@register("cumprod")
+def cumprod(a, *, axis=None, dtype=None):
+    out = jnp.cumprod(a if axis is not None else a.ravel(),
+                      axis=axis if axis is not None else 0)
+    return out.astype(dtype) if dtype else out
+
+
+@register("trace")
+def trace(data, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("triu")
+def triu(data, *, k=0):
+    return jnp.triu(data, k=k)
+
+
+@register("tril")
+def tril(data, *, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register("roll")
+def roll(data, *, shift=0, axis=None):
+    shift = tuple(shift) if isinstance(shift, (tuple, list)) else shift
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.roll(data, shift, axis=axis)
+
+
+@register("linspace", num_inputs=0, wrap_ctx=True)
+def linspace(*, start=0.0, stop=1.0, num=50, endpoint=True,
+             dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=dtype)
+
+
+@register("logspace", num_inputs=0, wrap_ctx=True)
+def logspace(*, start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+             dtype="float32"):
+    return jnp.logspace(start, stop, int(num), endpoint=endpoint,
+                        base=base, dtype=dtype)
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("digamma")
+def digamma(data):
+    import jax.scipy.special as jsp
+    return jsp.digamma(data)
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    """Reference smooth_l1: transition point at 1/scalar**2."""
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data,
+                     a - 0.5 / s2)
+
+
+@register("batch_take", num_inputs=2)
+def batch_take(a, indices):
+    """a (N, K), indices (N,) → picks a[i, indices[i]] per row."""
+    idx = indices.astype("int32")
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("scatter_nd", num_inputs=2)
+def scatter_nd(data, indices, *, shape=()):
+    """Reference scatter_nd: indices (M, N) leading coords for N data
+    items into an output of ``shape`` (duplicates: last write wins)."""
+    out = jnp.zeros(tuple(shape), data.dtype)
+    idx = tuple(indices.astype("int32"))
+    return out.at[idx].set(data)
+
+
+@register("gather_nd_raw", num_inputs=2)
+def gather_nd_raw(data, indices):
+    idx = tuple(indices.astype("int32"))
+    return data[idx]
+
+
+@register("ravel_multi_index")
+def ravel_multi_index(data, *, shape=()):
+    """data (N, M): N coordinate rows → (M,) flat indices."""
+    dims = jnp.asarray(shape, jnp.int32)
+    idx = data.astype(jnp.int32)
+    # strides[i] = prod(dims[i+1:]); last stride is 1
+    rev_cp = jnp.cumprod(dims[::-1])
+    strides = jnp.concatenate(
+        [rev_cp[-2::-1], jnp.ones((1,), dims.dtype)])
+    return (idx * strides[:, None]).sum(axis=0).astype(data.dtype)
+
+
+@register("unravel_index")
+def unravel_index(data, *, shape=()):
+    """(M,) flat indices → (N, M) coordinate rows."""
+    idx = data.astype(jnp.int32)
+    coords = jnp.stack(jnp.unravel_index(idx, tuple(shape)))
+    return coords.astype(data.dtype)
+
+
+@register("khatri_rao", num_inputs=None)
+def khatri_rao(*mats):
+    """Column-wise Kronecker product (reference contrib krprod.cc):
+    inputs (r_i, k) → output (prod r_i, k)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(
+            -1, out.shape[-1])
+    return out
+
+
+# -- linalg family (reference la_op.cc; mshadow-lapack there, XLA here) ----
+
+
+@register("linalg_potrf")
+def linalg_potrf(a):
+    """Cholesky factor (lower), batched."""
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri")
+def linalg_potri(a):
+    """Inverse from the Cholesky factor: inv(L Lᵀ)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_syrk")
+def linalg_syrk(a, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose
+                    else jnp.matmul(a, at))
+
+
+@register("linalg_trmm", num_inputs=2)
+def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside
+                    else jnp.matmul(tri, b))
+
+
+@register("linalg_trsm", num_inputs=2)
+def linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B with rightside),
+    A triangular; op(A) = Aᵀ when transpose."""
+    import jax.scipy.linalg as jsl
+    if rightside:
+        # X op(A) = alpha B  →  op(A)ᵀ Xᵀ = alpha Bᵀ
+        opat = a if transpose else jnp.swapaxes(a, -1, -2)
+        low = lower if transpose else not lower
+        xt = jsl.solve_triangular(opat, jnp.swapaxes(alpha * b, -1, -2),
+                                  lower=low)
+        return jnp.swapaxes(xt, -1, -2)
+    opa = jnp.swapaxes(a, -1, -2) if transpose else a
+    low = (not lower) if transpose else lower
+    return jsl.solve_triangular(opa, alpha * b, lower=low)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(a):
+    """LQ factorization: A = L Q with Q orthonormal rows."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+alias("power", "broadcast_power")
+alias("logical_and", "broadcast_logical_and")
+alias("logical_or", "broadcast_logical_or")
+alias("logical_xor", "broadcast_logical_xor")

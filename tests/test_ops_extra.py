@@ -1,0 +1,248 @@
+"""NumPy-oracle tests for the round-2 gap-closure ops (reference
+test_operator.py strategy — SURVEY.md §4): tensor/linalg additions,
+GroupNorm/LRN/SpatialTransformer/Correlation, and the gluon GroupNorm
+layer."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+def test_cumsum_cumprod_trace_tri_roll():
+    a = _rand(3, 4)
+    np.testing.assert_allclose(nd.cumsum(nd.array(a), axis=1).asnumpy(),
+                               np.cumsum(a, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(nd.cumsum(nd.array(a)).asnumpy(),
+                               np.cumsum(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.cumprod(nd.array(a), axis=0).asnumpy(),
+        np.cumprod(a, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(nd.trace(nd.array(a)).asnumpy(),
+                               np.trace(a), rtol=1e-6)
+    np.testing.assert_allclose(nd.triu(nd.array(a), k=1).asnumpy(),
+                               np.triu(a, 1))
+    np.testing.assert_allclose(nd.tril(nd.array(a)).asnumpy(),
+                               np.tril(a))
+    np.testing.assert_allclose(
+        nd.roll(nd.array(a), shift=2, axis=1).asnumpy(),
+        np.roll(a, 2, axis=1))
+
+
+def test_linspace_logspace_hard_sigmoid():
+    np.testing.assert_allclose(
+        nd.linspace(start=0.0, stop=1.0, num=5).asnumpy(),
+        np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.logspace(start=0.0, stop=2.0, num=3).asnumpy(),
+        np.logspace(0, 2, 3), rtol=1e-5)
+    x = np.asarray([-10.0, 0.0, 1.0, 10.0], "float32")
+    np.testing.assert_allclose(
+        nd.hard_sigmoid(nd.array(x)).asnumpy(),
+        np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-6)
+
+
+def test_smooth_l1_matches_reference_formula():
+    x = np.linspace(-3, 3, 41).astype("float32")
+    for scalar in (1.0, 2.0):
+        s2 = scalar * scalar
+        want = np.where(np.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                        np.abs(x) - 0.5 / s2)
+        np.testing.assert_allclose(
+            nd.smooth_l1(nd.array(x), scalar=scalar).asnumpy(), want,
+            rtol=1e-6)
+
+
+def test_batch_take_scatter_ravel():
+    a = _rand(4, 5)
+    idx = np.asarray([0, 2, 4, 1], "float32")
+    np.testing.assert_allclose(
+        nd.batch_take(nd.array(a), nd.array(idx)).asnumpy(),
+        a[np.arange(4), idx.astype(int)])
+    data = np.asarray([1.0, 2.0, 3.0], "float32")
+    indices = np.asarray([[0, 1, 2], [2, 0, 1]], "float32")
+    got = nd.scatter_nd(nd.array(data), nd.array(indices),
+                        shape=(3, 3)).asnumpy()
+    want = np.zeros((3, 3), "float32")
+    want[0, 2] = 1.0
+    want[1, 0] = 2.0
+    want[2, 1] = 3.0
+    np.testing.assert_allclose(got, want)
+    coords = np.asarray([[0, 1, 2], [2, 0, 1]], "float32")
+    flat = nd.ravel_multi_index(nd.array(coords), shape=(3, 3))
+    np.testing.assert_allclose(flat.asnumpy(), [2.0, 3.0, 7.0])
+    back = nd.unravel_index(flat, shape=(3, 3))
+    np.testing.assert_allclose(back.asnumpy(), coords)
+
+
+def test_khatri_rao():
+    a = _rand(2, 3, seed=1)
+    b = _rand(4, 3, seed=2)
+    got = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    want = np.vstack([np.kron(a[:, k], b[:, k]) for k in range(3)]).T
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linalg_family():
+    rng = np.random.RandomState(3)
+    m = rng.randn(4, 4).astype("float32")
+    spd = m @ m.T + 4 * np.eye(4, dtype="float32")
+    L = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    inv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(
+        nd.linalg_syrk(nd.array(m), alpha=2.0).asnumpy(), 2 * m @ m.T,
+        rtol=1e-5)
+    b = rng.randn(4, 2).astype("float32")
+    tri = np.tril(spd)
+    np.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(spd), nd.array(b)).asnumpy(), tri @ b,
+        rtol=1e-5)
+    x = nd.linalg_trsm(nd.array(tri), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(tri @ x, b, rtol=1e-3, atol=1e-4)
+    xt = nd.linalg_trsm(nd.array(tri), nd.array(b), transpose=True)
+    np.testing.assert_allclose(tri.T @ xt.asnumpy(), b, rtol=1e-3,
+                               atol=1e-4)
+    br = rng.randn(2, 4).astype("float32")
+    xr = nd.linalg_trsm(nd.array(tri), nd.array(br), rightside=True)
+    np.testing.assert_allclose(xr.asnumpy() @ tri, br, rtol=1e-3,
+                               atol=1e-4)
+    lq_l, lq_q = nd.linalg_gelqf(nd.array(m[:2]))
+    np.testing.assert_allclose(
+        lq_l.asnumpy() @ lq_q.asnumpy(), m[:2], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        lq_q.asnumpy() @ lq_q.asnumpy().T, np.eye(2), atol=1e-5)
+    np.testing.assert_allclose(
+        nd.linalg_sumlogdiag(nd.array(spd)).asnumpy(),
+        np.log(np.diag(spd)).sum(), rtol=1e-5)
+
+
+def test_group_norm_op_and_layer():
+    x = _rand(2, 6, 4, 4, seed=4)
+    # gamma/beta are PER GROUP (reference group_norm.cc layout)
+    g = np.abs(_rand(3, seed=5)) + 0.5
+    b = _rand(3, seed=6)
+    got = nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b),
+                       num_groups=3).asnumpy()
+    xr = x.reshape(2, 3, 2, 4, 4)
+    mean = xr.mean(axis=(2, 3, 4), keepdims=True)
+    var = xr.var(axis=(2, 3, 4), keepdims=True)
+    norm = (xr - mean) / np.sqrt(var + 1e-5)
+    want = (norm * g[None, :, None, None, None]
+            + b[None, :, None, None, None]).reshape(x.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    layer = nn.GroupNorm(num_groups=3)
+    layer.initialize()
+    with autograd.record():
+        y = layer(nd.array(x))
+        loss = nd.sum(y * y)
+    loss.backward()
+    assert np.abs(layer.gamma.grad().asnumpy()).max() > 0
+
+
+def test_lrn_oracle():
+    x = _rand(1, 5, 3, 3, seed=7)
+    got = nd.LRN(nd.array(x), nsize=3, alpha=1e-2, beta=0.5,
+                 knorm=1.0).asnumpy()
+    want = np.empty_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        ssum = (x[:, lo:hi] ** 2).sum(axis=1)
+        want[:, c] = x[:, c] / np.power(1.0 + 1e-2 / 3 * ssum, 0.5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    """Affine translation by one pixel: output equals shifted input."""
+    x = _rand(1, 2, 6, 6, seed=8)
+    # x' = x + 2/(W-1) shifts sampling one pixel right
+    theta = np.asarray([[1, 0, 2.0 / 5, 0, 1, 0]], "float32")
+    got = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(6, 6)).asnumpy()
+    np.testing.assert_allclose(got[:, :, :, :-1], x[:, :, :, 1:],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_displacement():
+    """Correlation with a shifted copy peaks at that displacement, the
+    border is cropped, and out-of-image reads are ZERO (not wrapped)."""
+    x = _rand(1, 3, 8, 8, seed=9)
+    y = np.roll(x, 1, axis=3)
+    corr = nd.Correlation(nd.array(x), nd.array(y), max_displacement=1,
+                          pad_size=1).asnumpy()
+    # reference shape: H + 2p - 2*d = 8
+    assert corr.shape == (1, 9, 8, 8)
+    # displacement (dy=0, dx=+1) is channel index 5; interior matches
+    # mean(x*x) exactly (borders involve zero-padding, so compare 1:-1)
+    want = (x * x).mean(1)[0]
+    np.testing.assert_allclose(corr[0, 5, 1:-1, 1:-1],
+                               want[1:-1, 1:-1], rtol=1e-4, atol=1e-5)
+    # zero-border (not wraparound): 1x4 row with a huge sentinel at the
+    # end must correlate to 0 at the right edge for dx=+1
+    row = np.asarray([[[[1.0, 2.0, 3.0, 100.0]]]], "float32")
+    c = nd.Correlation(nd.array(row), nd.array(row), max_displacement=1,
+                       pad_size=1).asnumpy()
+    assert c.shape[2:] == (1, 4)
+    np.testing.assert_allclose(c[0, 5, 0, -1], 0.0, atol=1e-6)
+
+
+def test_grid_generator_warp():
+    """Warp flow: identity flow reproduces the input grid; a one-pixel
+    flow shifts sampling by one pixel (pixel units, reference scale)."""
+    flow = np.zeros((1, 2, 4, 4), "float32")
+    grid = nd.GridGenerator(nd.array(flow),
+                            transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    flow[:, 0] = 1.0  # one pixel right
+    grid = nd.GridGenerator(nd.array(flow),
+                            transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0],
+                               np.linspace(-1, 1, 4) + 2.0 / 3,
+                               atol=1e-6)
+
+
+def test_crop_variants():
+    x = _rand(2, 3, 8, 8, seed=10)
+    np.testing.assert_allclose(
+        nd.Crop(nd.array(x), offset=(1, 2), h_w=(4, 4)).asnumpy(),
+        x[:, :, 1:5, 2:6])
+    np.testing.assert_allclose(
+        nd.Crop(nd.array(x), h_w=(4, 4), center_crop=True).asnumpy(),
+        x[:, :, 2:6, 2:6])
+    like = nd.zeros((2, 3, 5, 5))
+    np.testing.assert_allclose(
+        nd.Crop(nd.array(x), like, num_args=2).asnumpy(),
+        x[:, :, :5, :5])
+
+
+def test_aliases_power_logical():
+    a = np.asarray([2.0, 3.0], "float32")
+    b = np.asarray([3.0, 0.0], "float32")
+    np.testing.assert_allclose(
+        nd.power(nd.array(a), nd.array(b)).asnumpy(), a ** b)
+    np.testing.assert_allclose(
+        nd.logical_and(nd.array(a), nd.array(b)).asnumpy(),
+        np.logical_and(a, b).astype("float32"))
+    np.testing.assert_allclose(
+        nd.logical_xor(nd.array(a), nd.array(b)).asnumpy(),
+        np.logical_xor(a, b).astype("float32"))
+
+
+def test_new_ops_grad_flow():
+    """Gradient sanity through a few of the new differentiable ops."""
+    a = nd.array(_rand(3, 3, seed=11))
+    a.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.smooth_l1(nd.cumsum(a, axis=0), scalar=1.0))
+    y.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+    assert np.abs(a.grad.asnumpy()).max() > 0
