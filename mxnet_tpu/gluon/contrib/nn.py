@@ -17,7 +17,7 @@ from ..block import HybridBlock
 from .. import nn
 
 __all__ = ["MultiHeadAttention", "PositionwiseFFN",
-           "TransformerEncoderCell", "TransformerEncoder"]
+           "TransformerEncoderCell", "TransformerEncoder", "MoEFFN"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -140,3 +140,47 @@ class TransformerEncoder(HybridBlock):
         for layer in self.layers:
             x = layer(x, mask)
         return x
+
+
+class MoEFFN(HybridBlock):
+    """Mixture-of-experts feed-forward layer (beyond-reference; see
+    ops/moe.py).  Input (B, S, d) or (T, d); top-k routing with static
+    capacity; expert weights live as (E, ...) tensors so an ``ep`` mesh
+    axis can shard them (``parallel.moe_param_rule``).
+
+    ``forward`` returns ``(out, aux_loss)``; add ``aux_weight *
+    aux_loss`` to the training loss for load balancing.
+    """
+
+    def __init__(self, units, hidden_size, num_experts, k=1,
+                 capacity_factor=1.25, activation="relu", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._e = num_experts
+        self._kwargs = {"num_experts": num_experts, "k": k,
+                        "capacity_factor": capacity_factor,
+                        "activation": activation}
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(units, num_experts))
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden_size))
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size),
+                init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, units))
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, units), init="zeros")
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        shape = x.shape
+        flat = x.reshape((-1, self._units)) if len(shape) > 2 else x
+        out, aux = F._contrib_MoEFFN(flat, gate_weight, expert_w1,
+                                     expert_b1, expert_w2, expert_b2,
+                                     **self._kwargs)
+        if len(shape) > 2:
+            out = out.reshape(shape)
+        return out, aux

@@ -11,3 +11,4 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import attention  # noqa: F401  (fused SDPA + contrib transformer)
 from . import det     # noqa: F401  (roi_align / box_nms / box_iou)
+from . import moe     # noqa: F401  (expert-parallel MoE FFN)

@@ -644,7 +644,9 @@ def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
     for dy in disps:
         for dx in disps:
             sh = x2[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
-            prod = (x1 * sh) if is_multiply else -jnp.abs(x1 - sh)
+            # is_multiply=False is the SAD variant: positive sum of
+            # absolute differences (correlation.cc semantics)
+            prod = (x1 * sh) if is_multiply else jnp.abs(x1 - sh)
             m = jnp.mean(prod, axis=1)           # (N, H, W), mean over C
             if kernel_size > 1:
                 k = kernel_size

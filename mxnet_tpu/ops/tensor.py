@@ -765,7 +765,8 @@ def batch_take(a, indices):
 @register("scatter_nd", num_inputs=2)
 def scatter_nd(data, indices, *, shape=()):
     """Reference scatter_nd: indices (M, N) leading coords for N data
-    items into an output of ``shape`` (duplicates: last write wins)."""
+    items into an output of ``shape``.  Duplicate indices are
+    implementation-defined (as in the reference)."""
     out = jnp.zeros(tuple(shape), data.dtype)
     idx = tuple(indices.astype("int32"))
     return out.at[idx].set(data)

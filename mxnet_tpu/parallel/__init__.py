@@ -25,7 +25,25 @@ from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_apply
 
-__all__ = ["make_mesh", "set_mesh", "current_mesh", "mesh_shape",
+
+def moe_param_rule(ep_axis="ep", inner=None):
+    """Param-sharding rule for expert-parallel MoE: expert tensors
+    (named expert_*) shard their leading E dim over ``ep_axis``; under
+    the mesh-jitted trainer step GSPMD then inserts the dispatch/return
+    all-to-alls (the canonical GShard lowering).  Compose with a
+    tensor-parallel rule via ``inner``."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(name, shape):
+        if "expert_" in name and len(shape) >= 2:
+            return P(ep_axis, *([None] * (len(shape) - 1)))
+        return inner(name, shape) if inner is not None else None
+
+    return rule
+
+__all__ = ["moe_param_rule", "pipeline_apply",
+           "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
            "collectives", "DataParallelTrainer", "ring_attention",
            "ring_attention_sharded"]

@@ -1,0 +1,133 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh
+axis.
+
+Beyond-reference capability (the reference's closest analog is the
+manual model-parallel LSTM example — SURVEY.md §2.3 "Pipeline parallel:
+none"); built because the rebuild treats pp as a first-class mesh axis
+alongside dp/tp/sp/ep.
+
+TPU-first design: the schedule is SPMD — every device runs the same
+program over its own stage's parameters (stages must therefore share
+one structure, the transformer-stack case); activations hop stage→
+stage with ``lax.ppermute`` (ICI neighbor transfer on a TPU torus) and
+the M+P-1 step loop is statically unrolled so XLA overlaps each hop
+with the next step's compute.  Differentiable end-to-end (the schedule
+is plain traced code), so it composes with ``jax.grad`` and the fused
+trainer.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..base import MXNetError
+from .mesh import current_mesh
+
+__all__ = ["pipeline_apply"]
+
+
+def _local_schedule(params, xs, *, stage_fn, axis, n_microbatches):
+    """Per-device body (runs inside shard_map).
+
+    params: this stage's param pytree (leading stage dim of size 1);
+    xs: (M, mb, ...) microbatches (replicated); returns (M, mb, ...) —
+    nonzero only on the LAST stage, made global with a psum.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis)
+    p = lax.axis_index(axis)
+    m = n_microbatches
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    local_params = jax.tree_util.tree_map(lambda a: a[0], params)
+
+    carry = jnp.zeros_like(xs[0])
+    ys = jnp.zeros_like(xs)
+    for t in range(m + n - 1):
+        mb = t - p                      # microbatch this stage works on
+        active = (mb >= 0) & (mb < m)
+        idx = jnp.clip(mb, 0, m - 1)
+        x_in = jnp.where(p == 0, xs[idx], carry)
+        out = stage_fn(local_params, x_in)
+        out = jnp.where(active, out, jnp.zeros_like(out))
+        is_last = p == n - 1
+        ys = ys.at[idx].add(jnp.where(active & is_last, out,
+                                      jnp.zeros_like(out)))
+        carry = lax.ppermute(out, axis, perm)
+    # only the last stage holds results; sum-replicate across the axis
+    return lax.psum(ys, axis)
+
+
+_EXEC_CACHE = {}
+
+
+def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
+                   mesh=None, axis="pp"):
+    """Apply ``n_stages`` homogeneous stages as a GPipe pipeline.
+
+    stage_fn(params_i, x_mb) -> y_mb (same shape as x_mb);
+    stacked_params: pytree whose leaves have leading dim n_stages
+    (sharded over ``axis``); x: (batch, ...) jax array — split into
+    ``n_microbatches`` along dim 0.  Returns (batch, ...).
+
+    The jitted executable is cached per (mesh, axis, stage_fn, shapes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    n = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if any(l.shape[0] != n for l in leaves):
+        raise MXNetError(
+            f"pipeline_apply: stacked param leading dims "
+            f"{[l.shape[0] for l in leaves]} must equal the {axis!r} "
+            f"axis size {n}")
+    if x.shape[0] % n_microbatches:
+        raise MXNetError(
+            f"batch {x.shape[0]} not divisible by n_microbatches "
+            f"{n_microbatches}")
+
+    # key stage_fn structurally (code + closure) so per-call lambdas
+    # with identical source hit the cache instead of recompiling and
+    # leaking executables (same pitfall as ring_attention's jit cache)
+    code = getattr(stage_fn, "__code__", None)
+    closure = getattr(stage_fn, "__closure__", None) or ()
+    fn_key = ((code.co_code, repr(code.co_consts),
+               tuple(repr(c.cell_contents) for c in closure))
+              if code is not None else stage_fn)
+    key = (mesh, axis, fn_key, n_microbatches,
+           tuple(l.shape for l in leaves), x.shape, str(x.dtype))
+    fn = _EXEC_CACHE.get(key)
+    if fn is None:
+        pspec = P(axis)
+        rspec = P()
+        body = shard_map(
+            partial(_local_schedule, stage_fn=stage_fn, axis=axis,
+                    n_microbatches=n_microbatches),
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: pspec,
+                                             stacked_params), rspec),
+            out_specs=rspec)
+
+        def run(params, xb):
+            xs = xb.reshape((n_microbatches,
+                             xb.shape[0] // n_microbatches)
+                            + xb.shape[1:])
+            ys = body(params, xs)
+            return ys.reshape(xb.shape)
+
+        fn = jax.jit(run)
+        _EXEC_CACHE[key] = fn
+
+    params = jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
+        stacked_params)
+    return fn(params, x)
