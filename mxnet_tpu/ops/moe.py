@@ -45,24 +45,29 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, num_experts=1, k=1,
     capacity = int(np.ceil(k * t / e * capacity_factor))
     capacity = max(capacity, 1)
 
-    combine = jnp.zeros((t, e, capacity), x.dtype)
-    remaining = probs
+    # routing/bookkeeping run in int32/float32 REGARDLESS of x.dtype:
+    # bf16 cannot count past 256, so slot positions would collide and
+    # silently merge tokens under AMP
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    remaining = probs.astype(jnp.float32)
     fill = jnp.zeros((e,), jnp.int32)
     for _ in range(k):
         choice = remaining.argmax(axis=-1)      # (T,)
-        onehot = jax.nn.one_hot(choice, e, dtype=x.dtype)
+        onehot_i = jax.nn.one_hot(choice, e, dtype=jnp.int32)
+        onehot = onehot_i.astype(jnp.float32)
         # position of each token within its chosen expert's buffer
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) + fill[None, :]
-        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+        pos = (jnp.cumsum(onehot_i, axis=0) - 1) + fill[None, :]
+        pos_tok = jnp.sum(pos * onehot_i, axis=-1)
         keep = pos_tok < capacity
-        gate = jnp.sum(probs * onehot, axis=-1) * keep
+        gate = jnp.sum(probs.astype(jnp.float32) * onehot,
+                       axis=-1) * keep
         combine = combine + (gate[:, None, None]
                              * onehot[:, :, None]
                              * jax.nn.one_hot(pos_tok, capacity,
-                                              dtype=x.dtype)[:, None, :])
-        fill = fill + jnp.sum(onehot * keep[:, None],
-                              axis=0).astype(jnp.int32)
+                                              dtype=jnp.float32)[:, None, :])
+        fill = fill + jnp.sum(onehot_i * keep[:, None], axis=0)
         remaining = remaining * (1.0 - onehot)  # next-best expert
+    combine = combine.astype(x.dtype)
 
     dispatch = (combine > 0).astype(x.dtype)    # (T, E, C)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)

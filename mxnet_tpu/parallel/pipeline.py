@@ -95,17 +95,20 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
             f"batch {x.shape[0]} not divisible by n_microbatches "
             f"{n_microbatches}")
 
-    # key stage_fn structurally (code + closure) so per-call lambdas
-    # with identical source hit the cache instead of recompiling and
-    # leaking executables (same pitfall as ring_attention's jit cache)
+    # key stage_fn structurally (code object) so per-call lambdas with
+    # identical source hit the cache instead of recompiling; closure
+    # captures are keyed BY IDENTITY with strong references held in the
+    # cache entry (repr() of large arrays truncates and can collide)
     code = getattr(stage_fn, "__code__", None)
     closure = getattr(stage_fn, "__closure__", None) or ()
+    captured = tuple(c.cell_contents for c in closure)
     fn_key = ((code.co_code, repr(code.co_consts),
-               tuple(repr(c.cell_contents) for c in closure))
+               tuple(id(c) for c in captured))
               if code is not None else stage_fn)
     key = (mesh, axis, fn_key, n_microbatches,
            tuple(l.shape for l in leaves), x.shape, str(x.dtype))
-    fn = _EXEC_CACHE.get(key)
+    entry = _EXEC_CACHE.get(key)
+    fn = entry[0] if entry is not None else None
     if fn is None:
         pspec = P(axis)
         rspec = P()
@@ -125,7 +128,9 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
             return ys.reshape(xb.shape)
 
         fn = jax.jit(run)
-        _EXEC_CACHE[key] = fn
+        # retain the captured objects so their ids stay live while the
+        # cache entry exists (no id-reuse aliasing)
+        _EXEC_CACHE[key] = (fn, captured)
 
     params = jax.tree_util.tree_map(
         lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),

@@ -232,3 +232,32 @@ def test_pipeline_cache_structural():
         parallel.pipeline_apply(lambda p, xx: jnp.tanh(xx @ p["w"]),
                                 params, x, n_microbatches=4, mesh=mesh)
     assert len(pl._EXEC_CACHE) == before + 1
+
+
+def test_moe_bf16_dispatch_positions():
+    """Routing bookkeeping must stay exact under low-precision inputs:
+    with >256 tokens on one expert, bf16 counters would collide."""
+    import jax.numpy as jnp
+    t, d, e = 600, 4, 2
+    x = np.ones((t, d), np.float32)
+    gate_w = np.zeros((d, e), np.float32)
+    gate_w[:, 0] = 5.0  # everyone routes to expert 0
+    w1 = np.ones((e, d, 4), np.float32)
+    b1 = np.zeros((e, 4), np.float32)
+    w2 = np.ones((e, 4, d), np.float32)
+    b2 = np.zeros((e, d), np.float32)
+    out, _ = nd._contrib_MoEFFN(
+        nd.array(x.astype("float32")).astype("bfloat16"),
+        nd.array(gate_w).astype("bfloat16"),
+        nd.array(w1).astype("bfloat16"), nd.array(b1).astype("bfloat16"),
+        nd.array(w2).astype("bfloat16"), nd.array(b2).astype("bfloat16"),
+        num_experts=e, k=1, capacity_factor=2.0)
+    got = out.asnumpy().astype("float32")
+    # capacity = 600 (k*T/E * 2.0): every token fits; each kept row is
+    # gate(=1.0) * ffn(ones) = 16 per element; none doubled/merged
+    rows = np.abs(got).sum(axis=1)
+    kept = rows > 1.0
+    assert kept.sum() == 600
+    np.testing.assert_allclose(
+        got[kept], np.broadcast_to(got[kept][0], got[kept].shape),
+        rtol=0.05)

@@ -246,3 +246,18 @@ def test_new_ops_grad_flow():
     y.backward()
     assert np.isfinite(a.grad.asnumpy()).all()
     assert np.abs(a.grad.asnumpy()).max() > 0
+
+
+def test_correlation_sad_variant():
+    """is_multiply=False is the positive sum-of-absolute-differences
+    variant (reference correlation.cc) — never negative, zero at the
+    matching displacement."""
+    x = _rand(1, 2, 6, 6, seed=12)
+    y = np.roll(x, 1, axis=3)
+    c = nd.Correlation(nd.array(x), nd.array(y), max_displacement=1,
+                       pad_size=1, is_multiply=False).asnumpy()
+    assert (c >= -1e-6).all()
+    # at displacement (0, +1) the interior |diff| is exactly zero
+    np.testing.assert_allclose(c[0, 5, 1:-1, 1:-1], 0.0, atol=1e-6)
+    # and other displacements are strictly positive somewhere
+    assert c[0, 4].max() > 1e-3
