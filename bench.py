@@ -122,9 +122,25 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
     ctx = mx.tpu() if on_tpu else mx.cpu()
     amp.init(target_dtype="bfloat16")
     try:
+        from mxnet_tpu.gluon.block import HybridBlock
+
         builder = getattr(models, builder_name)
-        model = models.BERTForPretrain(
+        inner = models.BERTForPretrain(
             builder(vocab_size=vocab, max_length=seq_len, dropout=0.1))
+
+        # full-length sequences need no padding mask; passing
+        # valid_length=None keeps attention on the Pallas FLASH path
+        # (an all-true mask would force the XLA fallback)
+        class _FullLenPretrain(HybridBlock):
+            def __init__(self, mod, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.mod = mod
+
+            def hybrid_forward(self, F, tokens, types, positions):
+                return self.mod(tokens, types, None, positions)
+
+        model = _FullLenPretrain(inner)
         model.initialize(mx.init.Xavier(), ctx=ctx)
 
         sce = SoftmaxCrossEntropyLoss()
@@ -147,14 +163,13 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
             rng.randint(0, vocab, (b, seq_len)).astype("f"), ctx=ctx)
         types = nd.array(
             rng.randint(0, 2, (b, seq_len)).astype("f"), ctx=ctx)
-        vlen = nd.array(np.full((b,), seq_len, "f"), ctx=ctx)
         positions = nd.array(
             rng.randint(0, seq_len, (b, m)).astype("f"), ctx=ctx)
         label = nd.array(np.concatenate(
             [rng.randint(0, vocab, (b, m)), rng.randint(0, 2, (b, 1))],
             axis=1).astype("f"), ctx=ctx)
 
-        data = (tokens, types, vlen, positions)
+        data = (tokens, types, positions)
         _log(f"{builder_name}: compiling + warmup ({warmup} steps)")
         for _ in range(warmup):
             loss = dpt.step(data, label)

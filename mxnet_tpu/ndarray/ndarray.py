@@ -401,13 +401,36 @@ class NDArray:
         return key
 
     def __getitem__(self, key):
+        from .. import autograd
         key = self._norm_key(key)
-        if isinstance(key, (int, np.integer, builtins.slice)) or (
-                isinstance(key, tuple)
-                and all(isinstance(k, (int, np.integer, builtins.slice))
-                        for k in key)):
+        def _is_basic(k):
+            return isinstance(k, (int, np.integer, builtins.slice)) or \
+                k is Ellipsis or k is None
+        basic = _is_basic(key) if not isinstance(key, tuple) else \
+            all(_is_basic(k) for k in key)
+        if basic and autograd.is_recording():
+            # recording: slice must live ON the tape — a view would
+            # silently produce zero gradients for the base array
+            ks = key if isinstance(key, tuple) else (key,)
+            enc = []
+            for k in ks:
+                if isinstance(k, builtins.slice):
+                    enc.append(("s", k.start, k.stop, k.step))
+                elif k is Ellipsis:
+                    enc.append(("e",))
+                elif k is None:
+                    enc.append(("n",))
+                else:
+                    enc.append(("i", int(k)))
+            return invoke(get_op("_slice_basic"), [self],
+                          key=tuple(enc))
+        if basic:
             # basic indexing → view sharing this buffer slot
             return NDArray(None, _base=self, _index=key)
+        if autograd.is_recording():
+            raise MXNetError(
+                "advanced indexing is not differentiable on the tape; "
+                "use take/gather_nd/pick inside autograd.record()")
         # advanced indexing → copy (same as reference)
         out = self._data[key]
         return NDArray(out, ctx=self._ctx)

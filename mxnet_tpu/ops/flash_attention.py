@@ -1,4 +1,4 @@
-"""Pallas flash-attention kernel for TPU.
+"""Pallas flash-attention kernels for TPU (forward AND backward).
 
 Capability parity / perf: the reference leans on cuDNN fused attention
 (contrib transformer ops); the TPU equivalent is a Pallas kernel that
@@ -6,10 +6,14 @@ streams K/V blocks through VMEM with an online-softmax accumulator, never
 materializing the (S,S) score matrix in HBM (SURVEY.md §5 "Long-context",
 pallas_guide.md tiling/grid sections).
 
-Forward is the Pallas kernel; backward recomputes attention with the XLA
-path under ``jax.custom_vjp`` (flash-bwd kernel is a later milestone —
-recompute costs one extra forward but keeps memory O(S) instead of O(S²)
-on the forward pass, which is where long-context runs die).
+Forward emits the per-row log-sum-exp alongside the output; backward is
+the standard two-pass flash scheme (FlashAttention-2 layout):
+  * pass 1 (grid BH×Qblk×Kblk): recompute P from the saved LSE, accumulate
+    dQ += (P ∘ (dO Vᵀ − Δ)) K · scale in VMEM scratch;
+  * pass 2 (grid BH×Kblk×Qblk): accumulate dV += Pᵀ dO and
+    dK += (P ∘ (dO Vᵀ − Δ))ᵀ Q · scale;
+with Δ = rowsum(dO ∘ O) computed once in XLA.  Neither pass materializes
+(S,S) in HBM.
 """
 from __future__ import annotations
 
@@ -31,8 +35,8 @@ _LANE = 128  # TPU lane width: head_dim is zero-padded up to this
 _INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, num_k_blocks, causal_offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, num_k_blocks, causal_offset):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block loop lives in the GRID (innermost dim, sequential on TPU)
@@ -88,10 +92,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _done():
         o_ref[...] = (acc_scr[...] / l_scr[...][:, :1]).astype(
             o_ref.dtype)
+        # per-row log-sum-exp (lane-replicated), saved for the backward
+        lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _blocked_specs(d):
+    from jax.experimental import pallas as pl
+
+    # NOTE on index maps: with jax_enable_x64 a literal `0` in an index
+    # map becomes i64 and Mosaic rejects the mixed (i32, i64) signature;
+    # `i - i` keeps everything i32 regardless of the x64 flag.
+    zero = lambda i: i - i
+    q_spec = pl.BlockSpec((None, _BLOCK_Q, d),
+                          lambda i, j, kb: (i, j, zero(i)))
+    k_spec = pl.BlockSpec((None, _BLOCK_K, d),
+                          lambda i, j, kb: (i, kb, zero(i)))
+    return zero, q_spec, k_spec
+
+
+def _fold(x, b, h, s, d):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h, s, d):
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal):
-    """q,k,v: (B, S, H, D) → out (B, S, H, D).
+    """q,k,v: (B, S, H, D) → (out (B, S, H, D), lse (B*H, S_q, 128)).
 
     head_dim < 128 (e.g. BERT's 64) is zero-padded up to the lane
     width: QKᵀ contracts over D so zero columns don't change scores,
@@ -111,34 +140,26 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
     d = d_orig + pad
-    # fold batch×head, make seq-major: (B*H, S, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    qf = _fold(q, b, h, s_q, d)
+    kf = _fold(k, b, h, s_k, d)
+    vf = _fold(v, b, h, s_k, d)
 
     num_k_blocks = s_k // _BLOCK_K
     grid = (b * h, s_q // _BLOCK_Q, num_k_blocks)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_k_blocks=num_k_blocks,
                                causal_offset=s_k - s_q)
-    # NOTE on index maps: with jax_enable_x64 a literal `0` in an index
-    # map becomes i64 and Mosaic rejects the mixed (i32, i64) signature;
-    # `i - i` keeps everything i32 regardless of the x64 flag.
-    zero = lambda i: i - i
-    out = pl.pallas_call(
+    zero, q_spec, k_spec = _blocked_specs(d)
+    lse_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+                            lambda i, j, kb: (i, j, zero(i)))
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, _BLOCK_Q, d),
-                         lambda i, j, kb: (i, j, zero(i))),
-            pl.BlockSpec((None, _BLOCK_K, d),
-                         lambda i, j, kb: (i, kb, zero(i))),
-            pl.BlockSpec((None, _BLOCK_K, d),
-                         lambda i, j, kb: (i, kb, zero(i))),
-        ],
-        out_specs=pl.BlockSpec((None, _BLOCK_Q, d),
-                               lambda i, j, kb: (i, j, zero(i))),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, s_q, _LANE),
+                                        jnp.float32)],
         scratch_shapes=[
             pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
             pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
@@ -146,31 +167,187 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
         ],
         interpret=_INTERPRET,
     )(qf, kf, vf)
-    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    return _unfold(out, b, h, s_q, d)[..., :d_orig], lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, num_k_blocks, causal_offset):
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, :1]
+    delta = delta_ref[...][:, :1]
+    block_q, _ = q.shape
+    block_k = k.shape[0]
+
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = q_pos + np.int32(causal_offset) >= k_pos
+        s = jnp.where(mask, s, -1e30)
+    p = jnp.exp(s - lse)
+    if causal:
+        # explicit zero (not exp of a huge negative) so fully-masked
+        # rows contribute NO gradient instead of fp32-rounding noise
+        p = jnp.where(mask, p, 0.0)
+    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_scr[...] += jnp.dot(ds, k,
+                           preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _done():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, num_q_blocks,
+                causal_offset):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    q = q_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, :1]
+    delta = delta_ref[...][:, :1]
+    block_k = k.shape[0]
+    block_q = q.shape[0]
+
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qb * np.int32(block_q) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = q_pos + np.int32(causal_offset) >= k_pos
+        s = jnp.where(mask, s, -1e30)
+    p = jnp.exp(s - lse)                         # (block_q, block_k)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    dv_scr[...] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_scr[...] += jnp.dot(ds.T, q,
+                           preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qb == num_q_blocks - 1)
+    def _done():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, h, d_orig = q.shape
+    s_k = k.shape[1]
+    pad = (-d_orig) % _LANE
     if pad:
-        out = out[..., :d_orig]
-    return out
+        widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        out = jnp.pad(out, widths)
+        g = jnp.pad(g, widths)
+    d = d_orig + pad
+    qf = _fold(q, b, h, s_q, d)
+    kf = _fold(k, b, h, s_k, d)
+    vf = _fold(v, b, h, s_k, d)
+    gf = _fold(g, b, h, s_q, d)
+    of = _fold(out, b, h, s_q, d)
+    # Δ = rowsum(dO ∘ O), lane-replicated like the saved LSE
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (b * h, s_q, _LANE))
+
+    num_q_blocks = s_q // _BLOCK_Q
+    num_k_blocks = s_k // _BLOCK_K
+    causal_offset = s_k - s_q
+    zero, q_spec, k_spec = _blocked_specs(d)
+    lseq_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+                             lambda i, j, kb: (i, j, zero(i)))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          num_k_blocks=num_k_blocks,
+                          causal_offset=causal_offset),
+        grid=(b * h, num_q_blocks, num_k_blocks),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, lseq_spec, lseq_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(qf, kf, vf, gf, lse, delta)
+
+    # pass 2: grid is (BH, k-block, q-block) — index maps swap roles
+    kk_spec = pl.BlockSpec((None, _BLOCK_K, d),
+                           lambda i, kb, j: (i, kb, zero(i)))
+    qq_spec = pl.BlockSpec((None, _BLOCK_Q, d),
+                           lambda i, kb, j: (i, j, zero(i)))
+    lse2_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+                             lambda i, kb, j: (i, j, zero(i)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          num_q_blocks=num_q_blocks,
+                          causal_offset=causal_offset),
+        grid=(b * h, num_k_blocks, num_q_blocks),
+        in_specs=[kk_spec, kk_spec, qq_spec, qq_spec, lse2_spec,
+                  lse2_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((_BLOCK_K, d), jnp.float32),
+                        pltpu.VMEM((_BLOCK_K, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(kf, vf, qf, gf, lse, delta)
+
+    dq = _unfold(dq, b, h, s_q, d)[..., :d_orig]
+    dk = _unfold(dk, b, h, s_k, d)[..., :d_orig]
+    dv = _unfold(dv, b, h, s_k, d)[..., :d_orig]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, mask, scale, causal):
-    return _flash_fwd_pallas(q, k, v, scale, causal)
+    out, _ = _flash_fwd_pallas(q, k, v, scale, causal)
+    return out
 
 
 def _flash_fwd(q, k, v, mask, scale, causal):
-    return _flash_fwd_pallas(q, k, v, scale, causal), (q, k, v, mask)
+    out, lse = _flash_fwd_pallas(q, k, v, scale, causal)
+    # residual holds ONE lane of the lane-replicated LSE: the full
+    # (BH, S, 128) copy would cost 128x the HBM across the fwd→bwd
+    # interval on exactly the long-context runs flash exists for
+    return out, (q, k, v, out, lse[:, :, :1])
 
 
 def _flash_bwd(scale, causal, res, g):
-    # recompute with the XLA path; its vjp gives exact gradients
-    q, k, v, mask = res
-    from .attention import _sdpa_xla
-
-    def f(q, k, v):
-        return _sdpa_xla(q, k, v, mask, scale, causal)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, out, lse1 = res
+    lse = jnp.broadcast_to(lse1, lse1.shape[:2] + (_LANE,))
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal)
     return dq, dk, dv, None
 
 

@@ -878,3 +878,24 @@ alias("power", "broadcast_power")
 alias("logical_and", "broadcast_logical_and")
 alias("logical_or", "broadcast_logical_or")
 alias("logical_xor", "broadcast_logical_xor")
+
+
+@register("_slice_basic")
+def _slice_basic(x, *, key=()):
+    """Differentiable basic indexing (tape path for NDArray.__getitem__
+    under autograd.record; outside recording, views serve reads).
+
+    key: per-axis entries ('s', start, stop, step), ('i', index),
+    ('e',) for Ellipsis, or ('n',) for None/newaxis; trailing axes are
+    implicitly full slices.
+    """
+    def dec(e):
+        if e[0] == "s":
+            return builtins.slice(e[1], e[2], e[3])
+        if e[0] == "e":
+            return Ellipsis
+        if e[0] == "n":
+            return None
+        return int(e[1])
+
+    return x[tuple(dec(e) for e in key)]

@@ -62,6 +62,21 @@ def _local_schedule(params, xs, *, stage_fn, axis, n_microbatches):
 
 
 _EXEC_CACHE = {}
+_EXEC_CACHE_MAX = 64  # FIFO-bounded: a pathological caller cannot leak
+                      # executables without bound
+
+
+def _capture_key(c):
+    """Structural key for one closure capture."""
+    if isinstance(c, (int, float, bool, str, bytes, type(None))):
+        return ("v", c)
+    try:
+        a = np.asarray(c)
+        if a.dtype != object:
+            return ("a", a.shape, str(a.dtype), hash(a.tobytes()))
+    except Exception:
+        pass
+    return ("o", id(c))  # retained via the cache entry while cached
 
 
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
@@ -96,14 +111,15 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
             f"{n_microbatches}")
 
     # key stage_fn structurally (code object) so per-call lambdas with
-    # identical source hit the cache instead of recompiling; closure
-    # captures are keyed BY IDENTITY with strong references held in the
-    # cache entry (repr() of large arrays truncates and can collide)
+    # identical source hit the cache; closure captures are keyed by
+    # VALUE for scalars and by content hash for arrays (so equal
+    # re-created captures hit), falling back to identity (retained in
+    # the entry) for opaque objects
     code = getattr(stage_fn, "__code__", None)
     closure = getattr(stage_fn, "__closure__", None) or ()
     captured = tuple(c.cell_contents for c in closure)
     fn_key = ((code.co_code, repr(code.co_consts),
-               tuple(id(c) for c in captured))
+               tuple(_capture_key(c) for c in captured))
               if code is not None else stage_fn)
     key = (mesh, axis, fn_key, n_microbatches,
            tuple(l.shape for l in leaves), x.shape, str(x.dtype))
@@ -129,7 +145,10 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
 
         fn = jax.jit(run)
         # retain the captured objects so their ids stay live while the
-        # cache entry exists (no id-reuse aliasing)
+        # cache entry exists (no id-reuse aliasing); FIFO-evict so the
+        # cache cannot grow without bound
+        while len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
         _EXEC_CACHE[key] = (fn, captured)
 
     params = jax.tree_util.tree_map(

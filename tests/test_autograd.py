@@ -215,3 +215,45 @@ def test_dropout_respects_mode():
         z = nd.Dropout(x, p=0.5)
     zn = z.asnumpy()
     assert (zn == 0).any() and (zn == 2.0).any()
+
+
+def test_basic_indexing_differentiable():
+    """Regression: x[slice] under record() must land on the tape —
+    views silently produced ZERO gradients for the base array."""
+    x = nd.array(np.arange(6.0).reshape(2, 3).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x[:, :2]
+        loss = nd.sum(y * y)
+    loss.backward()
+    want = np.zeros((2, 3), "float32")
+    want[:, :2] = 2 * x.asnumpy()[:, :2]
+    np.testing.assert_allclose(x.grad.asnumpy(), want)
+    # integer row selection too
+    with autograd.record():
+        loss = nd.sum(x[1] * 3.0)
+    loss.backward()
+    want = np.zeros((2, 3), "float32")
+    want[1] = 3.0
+    np.testing.assert_allclose(x.grad.asnumpy(), want)
+    # advanced indexing: loud error, never silent zeros
+    with pytest.raises(mx.MXNetError, match="advanced indexing"):
+        with autograd.record():
+            nd.sum(x[nd.array([0.0, 1.0])])
+
+
+def test_ellipsis_newaxis_indexing_on_tape():
+    """Ellipsis and None keys are basic indexing — differentiable."""
+    x = nd.array(np.arange(8.0).reshape(2, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.sum(x[..., 0] * 2.0)
+    loss.backward()
+    want = np.zeros((2, 4), "float32")
+    want[:, 0] = 2.0
+    np.testing.assert_allclose(x.grad.asnumpy(), want)
+    with autograd.record():
+        y = x[:, None]           # (2, 1, 4)
+        loss = nd.sum(y * y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())

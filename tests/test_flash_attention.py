@@ -75,6 +75,38 @@ class TestFlashInterpret:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("sq,sk,d", [(128, 128, 64), (128, 256, 64),
+                                         (256, 256, 128)])
+    def test_pallas_backward_random_cotangent(self, interpret, causal,
+                                              sq, sk, d):
+        """The two-pass Pallas backward must match the XLA vjp for a
+        RANDOM cotangent (catches dp/delta mistakes that uniform
+        cotangents hide), across multi-block and cross-attention
+        shapes, padded (64) and unpadded (128) head dims."""
+        q, k, v = _rand_qkv(1, sq, 2, d, seed=9)
+        k = k[:, :sk] if sk <= k.shape[1] else jnp.concatenate(
+            [k] * (sk // k.shape[1]), axis=1)
+        v = v[:, :sk] if sk <= v.shape[1] else jnp.concatenate(
+            [v] * (sk // v.shape[1]), axis=1)
+        rng = np.random.RandomState(11)
+        ct = jnp.asarray(rng.randn(1, sq, 2, d).astype("f"))
+
+        def loss_flash(q, k, v):
+            return (fa_mod.flash_attention(q, k, v, causal=causal)
+                    * ct).sum()
+
+        def loss_xla(q, k, v):
+            return (_sdpa_xla(q, k, v, None, 1 / np.sqrt(d), causal)
+                    * ct).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_flash, g_xla):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
+                err_msg=f"d{name} mismatch")
+
     def test_bert_head_dim_takes_flash_path(self, interpret):
         # bert_base: head_dim 64, seq 128 — the viability gate must
         # accept it (round-1 weak #4: the flagship could never reach

@@ -261,3 +261,21 @@ def test_moe_bf16_dispatch_positions():
     np.testing.assert_allclose(
         got[kept], np.broadcast_to(got[kept][0], got[kept].shape),
         rtol=0.05)
+
+
+def test_pipeline_recreated_array_capture_hits_cache():
+    """Equal-but-recreated array captures must hit the exec cache (the
+    per-step recompile pitfall) — keyed by content, not identity."""
+    import importlib
+    import jax.numpy as jnp
+    from mxnet_tpu import parallel
+    pl = importlib.import_module("mxnet_tpu.parallel.pipeline")
+    mesh = parallel.make_mesh({"pp": 4})
+    params = {"w": jnp.ones((4, 1), "float32")}
+    x = jnp.ones((8, 16), "float32")
+    before = len(pl._EXEC_CACHE)
+    for _ in range(3):
+        cap = jnp.full((16,), 2.0, "float32")  # fresh object, equal value
+        parallel.pipeline_apply(lambda p, xx: xx * cap, params, x,
+                                n_microbatches=4, mesh=mesh)
+    assert len(pl._EXEC_CACHE) == before + 1
