@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Flash-vs-XLA attention micro-benchmark (fwd and fwd+bwd).
+
+Evidence for the Pallas flash kernel claim (SURVEY.md §5 long-context):
+on a TPU it times the Mosaic-compiled kernel against the `_sdpa_xla`
+reference at growing sequence lengths; on CPU it falls back to a tiny
+interpret-mode correctness sweep (timings there measure the
+interpreter, not the kernel, and say so).
+
+    python benchmark/attention_bench.py --seqs 128,512,2048
+"""
+import argparse
+import os as _os
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", default="128,512,1024")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import flash_attention as fa
+    from mxnet_tpu.ops.attention import _sdpa_xla
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        fa._INTERPRET = True
+        print("# CPU backend: interpret-mode correctness sweep "
+              "(timings reflect the interpreter, not the kernel)")
+
+    b, h, d = args.batch, args.heads, args.head_dim
+    scale = 1.0 / np.sqrt(d)
+
+    def bench(fn, *xs):
+        fn(*xs)[0].block_until_ready() if isinstance(fn(*xs), tuple) \
+            else jax.block_until_ready(fn(*xs))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / args.iters * 1e3
+
+    for s in [int(x) for x in args.seqs.split(",")]:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype("float32"))
+
+        flash_f = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True))
+        xla_f = jax.jit(lambda q, k, v: _sdpa_xla(
+            q, k, v, None, scale, True))
+
+        def flash_g(q, k, v):
+            return jax.grad(
+                lambda q, k, v: fa.flash_attention(
+                    q, k, v, causal=True).sum(), argnums=0)(q, k, v)
+
+        def xla_g(q, k, v):
+            return jax.grad(
+                lambda q, k, v: _sdpa_xla(
+                    q, k, v, None, scale, True).sum(), argnums=0)(q, k, v)
+
+        # correctness first, always
+        np.testing.assert_allclose(
+            np.asarray(flash_f(q, k, v)), np.asarray(xla_f(q, k, v)),
+            rtol=2e-4, atol=2e-4)
+        if not on_tpu:
+            np.testing.assert_allclose(
+                np.asarray(jax.jit(flash_g)(q, k, v)),
+                np.asarray(jax.jit(xla_g)(q, k, v)),
+                rtol=5e-4, atol=5e-4)
+            print(f"seq {s:6d}: numerics OK (fwd + bwd)")
+            continue
+
+        tf = bench(flash_f, q, k, v)
+        tx = bench(xla_f, q, k, v)
+        tgf = bench(jax.jit(flash_g), q, k, v)
+        tgx = bench(jax.jit(xla_g), q, k, v)
+        print(f"seq {s:6d}: fwd flash {tf:8.2f} ms vs xla {tx:8.2f} ms "
+              f"({tx / tf:4.2f}x) | fwd+bwd flash {tgf:8.2f} ms vs "
+              f"xla {tgx:8.2f} ms ({tgx / tgf:4.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

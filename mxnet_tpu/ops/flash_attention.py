@@ -35,8 +35,8 @@ _LANE = 128  # TPU lane width: head_dim is zero-padded up to this
 _INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, scale, causal, num_k_blocks, causal_offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
+                num_k_blocks, causal_offset, emit_lse):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block loop lives in the GRID (innermost dim, sequential on TPU)
@@ -45,6 +45,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     static (dynamic in-kernel slices mis-lower under jax_enable_x64).
     """
     from jax.experimental import pallas as pl
+
+    if emit_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
 
     q_idx = pl.program_id(1)
     kb = pl.program_id(2)
@@ -92,9 +98,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     def _done():
         o_ref[...] = (acc_scr[...] / l_scr[...][:, :1]).astype(
             o_ref.dtype)
-        # per-row log-sum-exp (lane-replicated), saved for the backward
-        lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
-        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+        if emit_lse:
+            # per-row log-sum-exp (lane-replicated), for the backward
+            lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
+            lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _blocked_specs(d):
@@ -119,8 +126,10 @@ def _unfold(x, b, h, s, d):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal):
-    """q,k,v: (B, S, H, D) → (out (B, S, H, D), lse (B*H, S_q, 128)).
+def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True):
+    """q,k,v: (B, S, H, D) → (out (B, S, H, D), lse (B*H, S_q, 128) or
+    None when ``want_lse=False`` — the inference path skips the LSE
+    output entirely rather than writing HBM it will discard).
 
     head_dim < 128 (e.g. BERT's 64) is zero-padded up to the lane
     width: QKᵀ contracts over D so zero columns don't change scores,
@@ -148,18 +157,20 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
     grid = (b * h, s_q // _BLOCK_Q, num_k_blocks)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_k_blocks=num_k_blocks,
-                               causal_offset=s_k - s_q)
+                               causal_offset=s_k - s_q,
+                               emit_lse=want_lse)
     zero, q_spec, k_spec = _blocked_specs(d)
     lse_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
                             lambda i, j, kb: (i, j, zero(i)))
-    out, lse = pl.pallas_call(
+    out_specs = [q_spec, lse_spec] if want_lse else q_spec
+    out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+                 jax.ShapeDtypeStruct((b * h, s_q, _LANE), jnp.float32)]
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[q_spec, k_spec, k_spec],
-        out_specs=[q_spec, lse_spec],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, s_q, _LANE),
-                                        jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shape if want_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
             pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
@@ -167,6 +178,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
         ],
         interpret=_INTERPRET,
     )(qf, kf, vf)
+    out, lse = res if want_lse else (res, None)
     return _unfold(out, b, h, s_q, d)[..., :d_orig], lse
 
 
@@ -332,7 +344,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, mask, scale, causal):
-    out, _ = _flash_fwd_pallas(q, k, v, scale, causal)
+    # primal (inference) path: no LSE output at all
+    out, _ = _flash_fwd_pallas(q, k, v, scale, causal, want_lse=False)
     return out
 
 

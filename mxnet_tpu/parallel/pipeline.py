@@ -66,14 +66,32 @@ _EXEC_CACHE_MAX = 64  # FIFO-bounded: a pathological caller cannot leak
                       # executables without bound
 
 
+_HASH_MEMO = {}  # id -> (weakref, content hash): arrays hashed ONCE
+
+
 def _capture_key(c):
     """Structural key for one closure capture."""
     if isinstance(c, (int, float, bool, str, bytes, type(None))):
-        return ("v", c)
+        # include the type: ('v', 2) == ('v', 2.0) == ('v', True) would
+        # otherwise alias executables compiled for different dtypes
+        return ("v", type(c).__name__, c)
     try:
+        import weakref
+        memo = _HASH_MEMO.get(id(c))
+        if memo is not None and memo[0]() is c:
+            return memo[1]
         a = np.asarray(c)
         if a.dtype != object:
-            return ("a", a.shape, str(a.dtype), hash(a.tobytes()))
+            key = ("a", a.shape, str(a.dtype), hash(a.tobytes()))
+            try:
+                # memoize per object so big device arrays pay the
+                # device→host copy + hash ONCE, not per call
+                _HASH_MEMO[id(c)] = (weakref.ref(c), key)
+                if len(_HASH_MEMO) > 512:
+                    _HASH_MEMO.pop(next(iter(_HASH_MEMO)))
+            except TypeError:
+                pass  # object not weakref-able: hash each call
+            return key
     except Exception:
         pass
     return ("o", id(c))  # retained via the cache entry while cached
