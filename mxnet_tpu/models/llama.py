@@ -69,6 +69,50 @@ class _LlamaAttention(HybridBlock):
                                    in_units=num_heads * self._d,
                                    prefix="o_")
 
+    def prefill(self, x, cache_k, cache_v):
+        """Batched prompt pass: full-sequence causal attention that
+        also writes K/V for every prompt position into the caches —
+        one program instead of S sequential steps."""
+        from .. import ndarray as nd
+        b, s = x.shape[0], x.shape[1]
+        h, kv, d = self._h, self._kv, self._d
+        q = nd.rope(self.q_proj(x).reshape((b, s, h, d)),
+                    base=self._base)
+        k = nd.rope(self.k_proj(x).reshape((b, s, kv, d)),
+                    base=self._base)
+        v = self.v_proj(x).reshape((b, s, kv, d))
+        cache_k[:, :s] = k
+        cache_v[:, :s] = v
+        if kv != h:
+            rep = h // kv
+            k = nd.repeat(k, repeats=rep, axis=2)
+            v = nd.repeat(v, repeats=rep, axis=2)
+        out = nd.dot_product_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape((b, s, h * d)))
+
+    def step(self, x, cache_k, cache_v, offset, mask):
+        """Incremental decode: x (B, 1, units), caches
+        (B, max_len, KV, D) written in place at ``offset``; ``mask``
+        is the shared key-validity mask built once per decode_step."""
+        from .. import ndarray as nd
+        b = x.shape[0]
+        h, kv, d = self._h, self._kv, self._d
+        q = nd.rope(self.q_proj(x).reshape((b, 1, h, d)),
+                    offset=offset, base=self._base)
+        k_t = nd.rope(self.k_proj(x).reshape((b, 1, kv, d)),
+                      offset=offset, base=self._base)
+        v_t = self.v_proj(x).reshape((b, 1, kv, d))
+        cache_k[:, offset:offset + 1] = k_t
+        cache_v[:, offset:offset + 1] = v_t
+        k_all, v_all = cache_k, cache_v
+        if kv != h:
+            rep = h // kv
+            k_all = nd.repeat(k_all, repeats=rep, axis=2)
+            v_all = nd.repeat(v_all, repeats=rep, axis=2)
+        out = nd.dot_product_attention(q, k_all, v_all, mask,
+                                       use_mask=True)
+        return self.o_proj(out.reshape((b, 1, h * d)))
+
     def hybrid_forward(self, F, x):
         b, s = x.shape[0], x.shape[1]
         h, kv, d = self._h, self._kv, self._d
@@ -130,6 +174,15 @@ class _LlamaLayer(HybridBlock):
         x = x + self.attn(self.input_norm(x))
         return x + self.mlp(self.post_norm(x))
 
+    def prefill(self, x, cache_k, cache_v):
+        x = x + self.attn.prefill(self.input_norm(x), cache_k, cache_v)
+        return x + self.mlp(self.post_norm(x))
+
+    def step(self, x, cache_k, cache_v, offset, mask):
+        x = x + self.attn.step(self.input_norm(x), cache_k, cache_v,
+                               offset, mask)
+        return x + self.mlp(self.post_norm(x))
+
 
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size, units, hidden, num_layers, num_heads,
@@ -189,6 +242,91 @@ class LlamaForCausalLM(HybridBlock):
                          transpose_b=True).reshape(
                              (b, s, self.model.vocab_size))
         return self.lm_head(h)
+
+    def init_cache(self, batch_size, max_len, ctx=None):
+        """Preallocate per-layer KV caches (B, max_len, KV, D)."""
+        from .. import ndarray as nd
+        caches = []
+        for layer in self.model.layers:
+            a = layer.attn
+            shp = (batch_size, max_len, a._kv, a._d)
+            caches.append((nd.zeros(shp, ctx=ctx),
+                           nd.zeros(shp, ctx=ctx)))
+        return caches
+
+    def _head(self, h):
+        """LM-head projection shared by full-forward and decode paths."""
+        from .. import ndarray as nd
+        if self._tied:
+            w = self.model.embed.weight.data(h.context)
+            return nd.dot(h.reshape((-1, self.model._units)), w,
+                          transpose_b=True)
+        return self.lm_head(h).reshape((-1, self.model.vocab_size))
+
+    def prefill(self, tokens, caches):
+        """Batched prompt pass filling the caches; returns the LAST
+        position's logits (B, vocab)."""
+        x = self.model.embed(tokens)
+        for layer, (ck, cv) in zip(self.model.layers, caches):
+            x = layer.prefill(x, ck, cv)
+        h = self.model.final_norm(x)
+        return self._head(h[:, -1:])
+
+    def decode_step(self, token, caches, offset):
+        """One incremental step: token (B, 1) → logits (B, vocab)."""
+        from .. import ndarray as nd
+        x = self.model.embed(token)
+        # key-validity mask (pos <= offset), shared across all layers
+        max_len = caches[0][0].shape[1]
+        mask = nd.broadcast_lesser_equal(
+            nd.arange(max_len).reshape((1, 1, 1, max_len)),
+            nd.full((1, 1, 1, 1), float(offset)))
+        for layer, (ck, cv) in zip(self.model.layers, caches):
+            x = layer.step(x, ck, cv, offset, mask)
+        h = self.model.final_norm(x)
+        return self._head(h)
+
+    def generate(self, tokens, max_new_tokens, temperature=0.0,
+                 top_k=0, seed=0):
+        """Autoregressive generation with a KV cache.
+
+        tokens: (B, S) prompt NDArray.  Greedy when ``temperature=0``;
+        otherwise softmax sampling with optional top-k truncation.
+        Each step reuses ONE compiled program — positions ride the
+        dynamic rope offset and the cache mask, so nothing recompiles
+        as the sequence grows.  Returns (B, S + max_new_tokens).
+        """
+        import numpy as np
+        from .. import ndarray as nd
+        b, s = tokens.shape
+        max_len = s + max_new_tokens
+        caches = self.init_cache(b, max_len, ctx=tokens.context)
+        rng = np.random.RandomState(seed)
+        out_tokens = [tokens.asnumpy()]
+        logits = self.prefill(tokens, caches)  # one batched program
+        for step_i in range(max_new_tokens):
+            # float64 softmax: float32 normalization residue can make
+            # np.random.choice reject the distribution
+            lg = logits.asnumpy().astype(np.float64)
+            if temperature and temperature > 0:
+                lg = lg / temperature
+                if top_k and top_k > 0:
+                    kk = min(int(top_k), lg.shape[-1])
+                    kth = np.sort(lg, axis=-1)[:, -kk][:, None]
+                    lg = np.where(lg < kth, -np.inf, lg)
+                p = np.exp(lg - lg.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                nxt = np.stack([rng.choice(p.shape[1], p=p[i])
+                                for i in range(b)])
+            else:
+                nxt = lg.argmax(-1)
+            cur = nd.array(nxt.astype("float32").reshape(b, 1),
+                           ctx=tokens.context)
+            out_tokens.append(cur.asnumpy())
+            if step_i < max_new_tokens - 1:  # last logits never read
+                logits = self.decode_step(cur, caches, s + step_i)
+        return nd.array(np.concatenate(out_tokens, axis=1),
+                        ctx=tokens.context)
 
     def loss(self, tokens):
         """Next-token cross-entropy over ``tokens`` (B, S) → scalar."""
