@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Train a tiny Llama on a synthetic grammar, then generate from it.
+
+Demonstrates the decoder-LM loop end to end: next-token training
+(RMSNorm/RoPE/GQA/SwiGLU stack), then KV-cache incremental decoding
+with greedy and top-k sampling (``LlamaForCausalLM.generate``).
+
+The "language" is a deterministic walk (token t → 3t+1 mod V with
+occasional resets), so a trained model must continue prompts along the
+walk — measurable as next-token accuracy.
+
+    python example/llama_generate.py --ctx tpu --steps 400
+    python example/llama_generate.py --steps 120       # CI smoke
+"""
+import argparse
+import time
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+def make_batch(rng, batch, seq_len, vocab):
+    toks = np.empty((batch, seq_len), np.int64)
+    toks[:, 0] = rng.randint(0, vocab, batch)
+    for i in range(1, seq_len):
+        nxt = (3 * toks[:, i - 1] + 1) % vocab
+        reset = rng.rand(batch) < 0.05
+        toks[:, i] = np.where(reset, rng.randint(0, vocab, batch), nxt)
+    return toks.astype("float32")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--vocab", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--new-tokens", type=int, default=8)
+    args = p.parse_args()
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    net = LlamaForCausalLM(llama_tiny(vocab_size=args.vocab))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    rng = np.random.RandomState(0)
+
+    first = last = None
+    t0 = time.time()
+    for step in range(args.steps):
+        toks = nd.array(make_batch(rng, args.batch_size, args.seq_len,
+                                   args.vocab), ctx=ctx)
+        with autograd.record():
+            loss = net.loss(toks)
+        loss.backward()
+        trainer.step(args.batch_size)
+        v = float(loss.asnumpy())
+        first = first if first is not None else v
+        last = v
+        if (step + 1) % 40 == 0:
+            print(f"step {step + 1}: loss={v:.3f}")
+    dt = time.time() - t0
+    print(f"loss {first:.3f} -> {last:.3f} "
+          f"({args.steps * args.batch_size * args.seq_len / dt:.0f} "
+          f"tokens/sec)")
+    assert last < first, "loss did not improve"
+
+    # generate continuations and score them against the true walk
+    prompts = make_batch(rng, 4, 4, args.vocab)
+    t0 = time.time()
+    out = net.generate(nd.array(prompts, ctx=ctx),
+                       max_new_tokens=args.new_tokens).asnumpy()
+    gen_tps = 4 * args.new_tokens / (time.time() - t0)
+    correct = total = 0
+    for row in out.astype(int):
+        for i in range(4, len(row)):
+            total += 1
+            correct += int(row[i] == (3 * row[i - 1] + 1) % args.vocab)
+    print(f"greedy continuation follows the walk "
+          f"{correct}/{total} steps ({gen_tps:.1f} tokens/sec decode)")
+    sampled = net.generate(nd.array(prompts, ctx=ctx),
+                           max_new_tokens=args.new_tokens,
+                           temperature=0.8, top_k=5, seed=1).asnumpy()
+    print("sampled:", sampled[0].astype(int).tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -81,12 +81,8 @@ class _LlamaAttention(HybridBlock):
         k = nd.rope(self.k_proj(x).reshape((b, s, kv, d)),
                     base=self._base)
         v = self.v_proj(x).reshape((b, s, kv, d))
-        cache_k[:, :s] = k
-        cache_v[:, :s] = v
-        if kv != h:
-            rep = h // kv
-            k = nd.repeat(k, repeats=rep, axis=2)
-            v = nd.repeat(v, repeats=rep, axis=2)
+        nd._cache_update(cache_k, k, offset=0, out=cache_k)
+        nd._cache_update(cache_v, v, offset=0, out=cache_v)
         out = nd.dot_product_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape((b, s, h * d)))
 
@@ -102,14 +98,12 @@ class _LlamaAttention(HybridBlock):
         k_t = nd.rope(self.k_proj(x).reshape((b, 1, kv, d)),
                       offset=offset, base=self._base)
         v_t = self.v_proj(x).reshape((b, 1, kv, d))
-        cache_k[:, offset:offset + 1] = k_t
-        cache_v[:, offset:offset + 1] = v_t
-        k_all, v_all = cache_k, cache_v
-        if kv != h:
-            rep = h // kv
-            k_all = nd.repeat(k_all, repeats=rep, axis=2)
-            v_all = nd.repeat(v_all, repeats=rep, axis=2)
-        out = nd.dot_product_attention(q, k_all, v_all, mask,
+        # dynamic-offset scatter: one compiled program for every step
+        nd._cache_update(cache_k, k_t, offset=offset, out=cache_k)
+        nd._cache_update(cache_v, v_t, offset=offset, out=cache_v)
+        # GQA is native in dot_product_attention: the unrepeated cache
+        # is attended directly (no (B, max_len, H, D) materialization)
+        out = nd.dot_product_attention(q, cache_k, cache_v, mask,
                                        use_mask=True)
         return self.o_proj(out.reshape((b, 1, h * d)))
 
@@ -128,10 +122,7 @@ class _LlamaAttention(HybridBlock):
             out = ring_attention_sharded(q, k, v, axis=self._sp_axis,
                                          causal=True)
         else:
-            if kv != h:  # GQA: broadcast each KV head to its query group
-                rep = h // kv
-                k = F.repeat(k, repeats=rep, axis=2)
-                v = F.repeat(v, repeats=rep, axis=2)
+            # GQA is native in the attention op (grouped einsum)
             out = F.dot_product_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape((b, s, h * d)))
 
@@ -276,11 +267,12 @@ class LlamaForCausalLM(HybridBlock):
         """One incremental step: token (B, 1) → logits (B, vocab)."""
         from .. import ndarray as nd
         x = self.model.embed(token)
-        # key-validity mask (pos <= offset), shared across all layers
+        # key-validity mask (pos <= offset), shared across all layers;
+        # offset rides the dynamic-scalar path (nd.full would bake it
+        # into static attrs and compile a fresh program per step)
         max_len = caches[0][0].shape[1]
-        mask = nd.broadcast_lesser_equal(
-            nd.arange(max_len).reshape((1, 1, 1, max_len)),
-            nd.full((1, 1, 1, 1), float(offset)))
+        mask = (nd.arange(max_len) <= float(offset)).reshape(
+            (1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.step(x, ck, cv, offset, mask)
         h = self.model.final_norm(x)
@@ -320,9 +312,9 @@ class LlamaForCausalLM(HybridBlock):
                                 for i in range(b)])
             else:
                 nxt = lg.argmax(-1)
-            cur = nd.array(nxt.astype("float32").reshape(b, 1),
-                           ctx=tokens.context)
-            out_tokens.append(cur.asnumpy())
+            host_tok = nxt.astype("float32").reshape(b, 1)
+            out_tokens.append(host_tok)  # host already has it
+            cur = nd.array(host_tok, ctx=tokens.context)
             if step_i < max_new_tokens - 1:  # last logits never read
                 logits = self.decode_step(cur, caches, s + step_i)
         return nd.array(np.concatenate(out_tokens, axis=1),

@@ -21,7 +21,31 @@ from .registry import register
 
 
 def _sdpa_xla(q, k, v, mask, scale, causal):
-    """Reference XLA path: (B, S, H, D) layout."""
+    """Reference XLA path: (B, S, H, D) layout.
+
+    Grouped-query attention is native: when K/V carry fewer heads than
+    Q, query heads are grouped per KV head in the einsum — no
+    materialized K/V repeat."""
+    h, kv = q.shape[2], k.shape[2]
+    if kv != h:
+        b, s_q, _, d = q.shape
+        s_k = k.shape[1]
+        g = h // kv
+        qg = q.reshape(b, s_q, kv, g, d)
+        logits = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k) * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+            logits = jnp.where(cm[None, None, None], logits, -1e30)
+        if mask is not None:
+            m = mask.astype(bool)
+            if m.shape[1] == 1:
+                m = m[:, :, None]                    # (B,1,1,Sq,Sk)
+            else:
+                m = m.reshape(b, kv, g, m.shape[2], m.shape[3])
+            logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bcgqk,bkcd->bqcgd", probs, v)
+        return out.reshape(b, s_q, h, d).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
@@ -67,6 +91,8 @@ def _flash_viable(q, k):
         except Exception:
             return False
     d = q.shape[-1]
+    if q.shape[2] != k.shape[2]:
+        return False  # GQA rides the grouped XLA path
     return d % 8 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
 
 

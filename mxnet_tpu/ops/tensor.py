@@ -899,3 +899,17 @@ def _slice_basic(x, *, key=()):
         return int(e[1])
 
     return x[tuple(dec(e) for e in key)]
+
+
+@register("_cache_update", num_inputs=2, scalar_attrs=("offset",),
+          scalar_ref_input=None)
+def _cache_update(cache, new, offset=0):
+    """Write ``new`` into ``cache`` at position ``offset`` along axis 1
+    (KV-cache decode).  ``offset`` is a dynamic scalar attr so every
+    decode step reuses ONE compiled scatter instead of compiling a new
+    program per position."""
+    start = (jnp.zeros((), jnp.int32),
+             jnp.asarray(offset, jnp.int32)) + tuple(
+        jnp.zeros((), jnp.int32) for _ in range(cache.ndim - 2))
+    return lax.dynamic_update_slice(cache, new.astype(cache.dtype),
+                                    start)

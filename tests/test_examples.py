@@ -82,3 +82,10 @@ def test_ssd_train_smoke():
                 "--steps", "12", "--batch-size", "4"])
     assert res.returncode == 0
     assert "top-det IoU" in res.stdout
+
+
+def test_llama_generate_smoke():
+    res = _run([os.path.join("example", "llama_generate.py"),
+                "--steps", "60", "--new-tokens", "4"])
+    assert res.returncode == 0
+    assert "tokens/sec decode" in res.stdout

@@ -324,7 +324,28 @@ def test_prefill_matches_stepwise():
                                    rtol=2e-4, atol=2e-5)
         np.testing.assert_allclose(v1.asnumpy(), v2.asnumpy(),
                                    rtol=2e-4, atol=2e-5)
-    # oversized top_k degrades to full-vocab sampling, no crash
-    out = net.generate(toks[:, :4], max_new_tokens=3, temperature=1.0,
+
+
+
+def test_generate_oversized_top_k_clamps():
+    net = _net()
+    toks = _tokens(seed=10, b=2, s=4)
+    out = net.generate(toks, max_new_tokens=3, temperature=1.0,
                        top_k=10 * V, seed=1)
     assert out.shape == (2, 7)
+    a = out.asnumpy()
+    assert (a >= 0).all() and (a < V).all()
+
+
+def test_generate_no_per_step_compiles():
+    """Warm decode must reuse ONE program set: offsets ride dynamic
+    scalars (rope, cache scatter, mask threshold), so the engine jit
+    cache cannot grow across steps at a fixed cache length."""
+    from mxnet_tpu.engine import _jit_cache
+    net = _net()
+    toks = _tokens(seed=11, b=1, s=4)
+    net.generate(toks, max_new_tokens=6)   # warm at this max_len
+    before = len(_jit_cache)
+    net.generate(toks, max_new_tokens=6)
+    assert len(_jit_cache) == before, (
+        set(_jit_cache) if len(_jit_cache) < 400 else "cache grew")
