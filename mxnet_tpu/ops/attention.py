@@ -41,7 +41,10 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
             if m.shape[1] == 1:
                 m = m[:, :, None]                    # (B,1,1,Sq,Sk)
             else:
-                m = m.reshape(b, kv, g, m.shape[2], m.shape[3])
+                # keep the mask's own batch dim so (1, H, Sq, Sk)
+                # masks still broadcast over the query batch
+                m = m.reshape(m.shape[0], kv, g, m.shape[2],
+                              m.shape[3])
             logits = jnp.where(m, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bcgqk,bkcd->bqcgd", probs, v)
@@ -70,9 +73,17 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     mask = rest[0] if use_mask and rest else None
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
-    if flash and _flash_viable(query, key):
+    if flash and mask is None and _flash_viable(query, key):
         from .flash_attention import flash_attention
-        return flash_attention(query, key, value, mask=mask, scale=s,
+        if key.shape[2] != query.shape[2]:
+            # flash kernel wants equal heads: repeat K/V. The repeat
+            # costs O(S·H·D) HBM but keeps attention O(S) instead of
+            # the grouped XLA path's O(S²) score tensor — the right
+            # trade on the long-context runs flash exists for.
+            rep = query.shape[2] // key.shape[2]
+            key = jnp.repeat(key, rep, axis=2)
+            value = jnp.repeat(value, rep, axis=2)
+        return flash_attention(query, key, value, mask=None, scale=s,
                                causal=causal)
     return _sdpa_xla(query, key, value, mask, s, causal)
 
@@ -91,8 +102,8 @@ def _flash_viable(q, k):
         except Exception:
             return False
     d = q.shape[-1]
-    if q.shape[2] != k.shape[2]:
-        return False  # GQA rides the grouped XLA path
+    if q.shape[2] % k.shape[2]:
+        return False  # ragged head grouping
     return d % 8 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
 
 

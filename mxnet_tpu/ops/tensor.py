@@ -908,8 +908,6 @@ def _cache_update(cache, new, offset=0):
     (KV-cache decode).  ``offset`` is a dynamic scalar attr so every
     decode step reuses ONE compiled scatter instead of compiling a new
     program per position."""
-    start = (jnp.zeros((), jnp.int32),
-             jnp.asarray(offset, jnp.int32)) + tuple(
-        jnp.zeros((), jnp.int32) for _ in range(cache.ndim - 2))
-    return lax.dynamic_update_slice(cache, new.astype(cache.dtype),
-                                    start)
+    return lax.dynamic_update_slice_in_dim(
+        cache, new.astype(cache.dtype),
+        jnp.asarray(offset, jnp.int32), axis=1)

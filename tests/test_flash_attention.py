@@ -107,6 +107,21 @@ class TestFlashInterpret:
                 np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
                 err_msg=f"d{name} mismatch")
 
+    def test_gqa_routes_to_flash_and_matches(self, interpret):
+        """GQA inputs (fewer KV heads) must still take the flash path
+        (K/V repeated to full heads) and match the grouped XLA SDPA."""
+        q, _, _ = _rand_qkv(1, 128, 4, 64, seed=13)
+        rng = np.random.RandomState(14)
+        k = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+        v = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+        from mxnet_tpu.ops.attention import dot_product_attention, \
+            _flash_viable
+        assert _flash_viable(q, k)
+        got = dot_product_attention(q, k, v, causal=True)
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_bert_head_dim_takes_flash_path(self, interpret):
         # bert_base: head_dim 64, seq 128 — the viability gate must
         # accept it (round-1 weak #4: the flagship could never reach

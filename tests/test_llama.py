@@ -338,14 +338,17 @@ def test_generate_oversized_top_k_clamps():
 
 
 def test_generate_no_per_step_compiles():
-    """Warm decode must reuse ONE program set: offsets ride dynamic
-    scalars (rope, cache scatter, mask threshold), so the engine jit
-    cache cannot grow across steps at a fixed cache length."""
+    """Offsets ride dynamic scalars (rope, cache scatter, mask
+    threshold): after ONE decode step warms the programs, steps at
+    NEW offsets must add zero jit-cache entries — value-keyed attrs
+    would pass a same-offsets replay but fail this."""
     from mxnet_tpu.engine import _jit_cache
     net = _net()
-    toks = _tokens(seed=11, b=1, s=4)
-    net.generate(toks, max_new_tokens=6)   # warm at this max_len
+    toks = _tokens(seed=11, b=1, s=6)
+    caches = net.init_cache(1, 6)
+    net.decode_step(toks[:, 0:1], caches, 0)   # warm at offset 0
     before = len(_jit_cache)
-    net.generate(toks, max_new_tokens=6)
-    assert len(_jit_cache) == before, (
-        set(_jit_cache) if len(_jit_cache) < 400 else "cache grew")
+    for i in range(1, 6):                      # five UNSEEN offsets
+        net.decode_step(toks[:, i:i + 1], caches, i)
+    grew = len(_jit_cache) - before
+    assert grew == 0, f"decode compiled {grew} programs across offsets"

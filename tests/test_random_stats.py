@@ -1,0 +1,83 @@
+"""Statistical tests for the RNG ops (parity: reference
+``tests/python/unittest/test_random.py`` — moments and KS tests against
+scipy/numpy references, plus seed reproducibility semantics)."""
+import numpy as np
+import pytest
+from scipy import stats
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = 200_000
+
+
+def _moments(a, mean, std, tol=0.02):
+    got_m, got_s = float(a.mean()), float(a.std())
+    assert abs(got_m - mean) < tol * max(1.0, abs(mean) + std), \
+        (got_m, mean)
+    assert abs(got_s - std) < tol * max(1.0, std) + 0.02, (got_s, std)
+
+
+def test_uniform_moments_and_ks():
+    mx.random.seed(42)
+    a = nd.random.uniform(low=-2.0, high=3.0, shape=(N,)).asnumpy()
+    assert a.min() >= -2.0 and a.max() < 3.0
+    _moments(a, 0.5, 5.0 / np.sqrt(12))
+    d, p = stats.kstest((a + 2.0) / 5.0, "uniform")
+    assert p > 1e-4, (d, p)
+
+
+def test_normal_moments_and_ks():
+    mx.random.seed(1)
+    a = nd.random.normal(loc=1.5, scale=2.0, shape=(N,)).asnumpy()
+    _moments(a, 1.5, 2.0)
+    d, p = stats.kstest((a - 1.5) / 2.0, "norm")
+    assert p > 1e-4, (d, p)
+
+
+def test_gamma_moments():
+    mx.random.seed(2)
+    alpha, beta = 3.0, 2.0
+    a = nd.random.gamma(alpha=alpha, beta=beta, shape=(N,)).asnumpy()
+    # MXNet gamma: shape alpha, SCALE beta → mean α·β, var α·β²
+    _moments(a, alpha * beta, np.sqrt(alpha) * beta, tol=0.03)
+    assert (a > 0).all()
+
+
+def test_exponential_and_poisson_moments():
+    mx.random.seed(3)
+    lam = 2.5
+    e = nd.random.exponential(scale=1.0 / lam, shape=(N,)).asnumpy()
+    _moments(e, 1.0 / lam, 1.0 / lam, tol=0.03)
+    p = nd.random.poisson(lam=lam, shape=(N,)).asnumpy()
+    _moments(p, lam, np.sqrt(lam), tol=0.03)
+    assert (p == np.round(p)).all() and (p >= 0).all()
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(4)
+    probs = nd.array(np.asarray([[0.1, 0.2, 0.3, 0.4]], "float32"))
+    draws = mx.random.multinomial(probs, shape=50_000).asnumpy().ravel()
+    freq = np.bincount(draws.astype(int), minlength=4) / draws.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.01)
+
+
+def test_seed_reproducibility_and_divergence():
+    mx.random.seed(7)
+    a = nd.random.normal(shape=(64,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.normal(shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.normal(shape=(64,)).asnumpy()  # stream advances
+    assert np.abs(a - c).max() > 1e-6
+    mx.random.seed(8)
+    d = nd.random.normal(shape=(64,)).asnumpy()
+    assert np.abs(a - d).max() > 1e-6
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(5)
+    x = nd.arange(1000)
+    y = mx.random.shuffle(x).asnumpy()
+    np.testing.assert_array_equal(np.sort(y), np.arange(1000))
+    assert np.abs(y - np.arange(1000)).max() > 0  # actually permuted
