@@ -83,7 +83,7 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
             rep = query.shape[2] // key.shape[2]
             key = jnp.repeat(key, rep, axis=2)
             value = jnp.repeat(value, rep, axis=2)
-        return flash_attention(query, key, value, mask=None, scale=s,
+        return flash_attention(query, key, value, scale=s,
                                causal=causal)
     return _sdpa_xla(query, key, value, mask, s, causal)
 

@@ -23,6 +23,7 @@ Public surface:
 """
 from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
+from .collectives import quantized_psum
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply

@@ -20,7 +20,7 @@ from ..base import MXNetError
 from .mesh import current_mesh
 
 __all__ = ["psum", "pmean", "all_gather", "ppermute", "all_to_all",
-           "allreduce"]
+           "allreduce", "quantized_psum"]
 
 
 def psum(x, axis_name):
@@ -114,3 +114,73 @@ def allreduce(values, axis="dp", mesh=None, op="sum"):
     out = fn(stacked)
     return [NDArray(out[i], ctx=values[i].context)
             for i in range(len(values))]
+
+
+def quantized_psum(x, axis_name, *, bits=8):
+    """int8-wire quantized allreduce (inside shard_map/jit).
+
+    The SPMD analog of the reference's 2-bit gradient compression
+    (``src/kvstore/gradient_compression.cc``; SURVEY.md §7 P6
+    "quantized-allreduce ≙ gradient compression", cf. PAPERS.md
+    EQuARX): a two-phase reduce-scatter/all-gather where BOTH phases
+    move int8 — (1) each device splits into N chunks, quantizes each
+    against its own absmax, and ``all_to_all``s the int8 chunks plus
+    fp32 scalar scales; (2) each device dequant-sums its chunk,
+    REQUANTIZES the partial sum, and int8-``all_gather``s it back.
+    Wire bytes ≈ 2·size·1 vs a ring fp32 psum's ≈ 2·size·4 — a real
+    4x, at the cost of two rounding stages.
+
+    Deterministic, stateless, and differentiable-through (straight
+    through estimator: gradients treat it as psum).  Error feedback is
+    the caller's residual to keep, as in the reference.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    if bits != 8:
+        raise MXNetError(f"quantized_psum: bits must be 8, got {bits}")
+    qmax = float(2 ** (bits - 1) - 1)
+
+    @jax.custom_vjp
+    def _qpsum(v):
+        n = lax.axis_size(axis_name)
+        flat = v.reshape(-1).astype(jnp.float32)
+        padded = flat.size + ((-flat.size) % n)
+        if padded != flat.size:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((padded - flat.size,), jnp.float32)])
+        chunks = flat.reshape(n, -1)                       # (n, c)
+        scale = jnp.maximum(jnp.max(jnp.abs(chunks), axis=1) / qmax,
+                            1e-20)                         # (n,)
+        q = jnp.clip(jnp.round(chunks / scale[:, None]), -qmax,
+                     qmax).astype(jnp.int8)
+        # phase 1: int8 chunks to their owner device + scalar scales
+        q_x = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        s_x = lax.all_to_all(
+            jnp.broadcast_to(scale[:, None], (n, 1)), axis_name, 0, 0,
+            tiled=True)                                    # (n, 1)
+        part = jnp.sum(q_x.astype(jnp.float32) * s_x, axis=0)  # (c,)
+        # phase 2: requantize the partial sum, int8 all-gather back
+        s2 = jnp.maximum(jnp.max(jnp.abs(part)) / qmax, 1e-20)
+        q2 = jnp.clip(jnp.round(part / s2), -qmax,
+                      qmax).astype(jnp.int8)
+        allq = lax.all_gather(q2, axis_name, axis=0)       # (n, c)
+        alls = lax.all_gather(s2, axis_name, axis=0)       # (n,)
+        full = (allq.astype(jnp.float32)
+                * alls[:, None]).reshape(-1)[:v.size]
+        return full.reshape(v.shape).astype(v.dtype)
+
+    def _fwd(v):
+        return _qpsum(v), None
+
+    def _bwd(_, g):
+        # straight-through psum transpose: the all_gather-built output
+        # is VARYING-typed, so its per-device cotangents accumulate
+        # explicitly (psum), then re-mark varying for the input's type
+        ct = lax.psum(g, axis_name)
+        pv = getattr(lax, "pvary", None)
+        return (pv(ct, (axis_name,)) if pv else ct,)
+
+    _qpsum.defvjp(_fwd, _bwd)
+    return _qpsum(x)
