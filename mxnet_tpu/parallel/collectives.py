@@ -157,9 +157,8 @@ def quantized_psum(x, axis_name, *, bits=8):
                      qmax).astype(jnp.int8)
         # phase 1: int8 chunks to their owner device + scalar scales
         q_x = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
-        s_x = lax.all_to_all(
-            jnp.broadcast_to(scale[:, None], (n, 1)), axis_name, 0, 0,
-            tiled=True)                                    # (n, 1)
+        s_x = lax.all_to_all(scale[:, None], axis_name, 0, 0,
+                             tiled=True)                   # (n, 1)
         part = jnp.sum(q_x.astype(jnp.float32) * s_x, axis=0)  # (c,)
         # phase 2: requantize the partial sum, int8 all-gather back
         s2 = jnp.maximum(jnp.max(jnp.abs(part)) / qmax, 1e-20)
@@ -179,8 +178,10 @@ def quantized_psum(x, axis_name, *, bits=8):
         # is VARYING-typed, so its per-device cotangents accumulate
         # explicitly (psum), then re-mark varying for the input's type
         ct = lax.psum(g, axis_name)
-        pv = getattr(lax, "pvary", None)
-        return (pv(ct, (axis_name,)) if pv else ct,)
+        pcast = getattr(lax, "pcast", None)
+        if pcast is not None:
+            return (pcast(ct, (axis_name,), to="varying"),)
+        return (lax.pvary(ct, (axis_name,)),)
 
     _qpsum.defvjp(_fwd, _bwd)
     return _qpsum(x)

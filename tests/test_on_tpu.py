@@ -106,3 +106,43 @@ def test_rtc_pallas_kernel_on_chip():
     np.testing.assert_allclose(out.asnumpy(),
                                3.0 * x.asnumpy() + y.asnumpy(),
                                rtol=1e-6)
+
+
+def test_llama_generate_on_chip():
+    """KV-cache decode on the real chip: warm steps must not compile."""
+    from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+    from mxnet_tpu.engine import _jit_cache
+    ctx = _ctx()
+    net = LlamaForCausalLM(llama_tiny(vocab_size=64))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    toks = nd.array(np.random.RandomState(0).randint(
+        0, 64, (1, 4)).astype("f4"), ctx=ctx)
+    net.generate(toks, max_new_tokens=8)
+    before = len(_jit_cache)
+    out = net.generate(toks, max_new_tokens=8)
+    assert out.shape == (1, 12)
+    assert len(_jit_cache) == before
+
+
+def test_flash_backward_on_chip():
+    """Mosaic-compiled flash fwd+bwd vs the XLA vjp on the chip."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import flash_attention as fa
+    from mxnet_tpu.ops.attention import _sdpa_xla
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f4"))
+    ct = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f4"))
+
+    def lf(q, k, v):
+        return (fa.flash_attention(q, k, v, causal=True) * ct).sum()
+
+    def lx(q, k, v):
+        return (_sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
+                * ct).sum()
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, q, q)
+    gx = jax.grad(lx, argnums=(0, 1, 2))(q, q, q)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

@@ -164,9 +164,8 @@ def test_quantized_psum_accuracy_and_grad():
     exact = shards.sum(axis=0)
     # two-stage int8 bound: per-shard chunk quantization + the
     # requantized partial sum (each rounding ≤ scale/2 = absmax/254)
-    exact0 = shards.sum(axis=0)
     bound = (sum(np.abs(shards[i]).max() / 254 for i in range(8))
-             + np.abs(exact0).max() / 254 + 1e-5)
+             + np.abs(exact).max() / 254 + 1e-5)
     assert np.abs(got - exact).max() <= bound, (
         np.abs(got - exact).max(), bound)
     # relative accuracy sanity
