@@ -73,7 +73,10 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     mask = rest[0] if use_mask and rest else None
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
-    if flash and mask is None and _flash_viable(query, key):
+    from .flash_attention import _as_key_padding
+    if flash and (mask is None or _as_key_padding(
+            mask, batch=query.shape[0], s_k=key.shape[1]) is not None) \
+            and _flash_viable(query, key):
         from .flash_attention import flash_attention
         if key.shape[2] != query.shape[2]:
             # flash kernel wants equal heads: repeat K/V. The repeat
@@ -83,7 +86,7 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
             rep = query.shape[2] // key.shape[2]
             key = jnp.repeat(key, rep, axis=2)
             value = jnp.repeat(value, rep, axis=2)
-        return flash_attention(query, key, value, scale=s,
+        return flash_attention(query, key, value, mask=mask, scale=s,
                                causal=causal)
     return _sdpa_xla(query, key, value, mask, s, causal)
 

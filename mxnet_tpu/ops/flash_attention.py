@@ -35,8 +35,8 @@ _LANE = 128  # TPU lane width: head_dim is zero-padded up to this
 _INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
-                num_k_blocks, causal_offset, emit_lse):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                num_k_blocks, causal_offset, emit_lse, with_kmask):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block loop lives in the GRID (innermost dim, sequential on TPU)
@@ -46,11 +46,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
     """
     from jax.experimental import pallas as pl
 
-    if emit_lse:
-        lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        lse_ref = None
-        m_scr, l_scr, acc_scr = rest
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if with_kmask else None
+    o_ref = rest.pop(0)
+    lse_ref = rest.pop(0) if emit_lse else None
+    m_scr, l_scr, acc_scr = rest
 
     q_idx = pl.program_id(1)
     kb = pl.program_id(2)
@@ -78,6 +78,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal,
         k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(q_pos + np.int32(causal_offset) >= k_pos, s, -1e30)
+    if with_kmask:
+        # key-padding mask row for this (batch, k-block): True = keep
+        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
 
     # m/l scratch is (block_q, 128): TPU vector stores need a full lane
     # dim; value is replicated across lanes, column 0 is authoritative
@@ -118,6 +121,25 @@ def _blocked_specs(d):
     return zero, q_spec, k_spec
 
 
+def _kmask_rows(kmask, s_k):
+    """(B, S_k) key-padding mask → (B, 8, S_k) f32 rows (sublane-padded
+    so the (8, block_k) tile satisfies TPU tiling; row 0 is read)."""
+    m = kmask.astype(jnp.float32)[:, None, :]
+    return jnp.broadcast_to(m, (m.shape[0], 8, s_k))
+
+
+def _kmask_spec(h, kb_in_dim2=True):
+    from jax.experimental import pallas as pl
+
+    # grid dim 0 is b*h: batch index = i // h (static closure over h).
+    # The k-block rides grid dim 2 (fwd, dq) or dim 1 (dkv).
+    if kb_in_dim2:
+        return pl.BlockSpec((None, 8, _BLOCK_K),
+                            lambda i, j, kb: (i // h, j - j, kb))
+    return pl.BlockSpec((None, 8, _BLOCK_K),
+                        lambda i, kb, j: (i // h, j - j, kb))
+
+
 def _fold(x, b, h, s, d):
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
@@ -126,7 +148,8 @@ def _unfold(x, b, h, s, d):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True):
+def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
+                      kmask=None):
     """q,k,v: (B, S, H, D) → (out (B, S, H, D), lse (B*H, S_q, 128) or
     None when ``want_lse=False`` — the inference path skips the LSE
     output entirely rather than writing HBM it will discard).
@@ -158,17 +181,23 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True):
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_k_blocks=num_k_blocks,
                                causal_offset=s_k - s_q,
-                               emit_lse=want_lse)
+                               emit_lse=want_lse,
+                               with_kmask=kmask is not None)
     zero, q_spec, k_spec = _blocked_specs(d)
     lse_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
                             lambda i, j, kb: (i, j, zero(i)))
+    in_specs = [q_spec, k_spec, k_spec]
+    inputs = [qf, kf, vf]
+    if kmask is not None:
+        in_specs.append(_kmask_spec(h))
+        inputs.append(_kmask_rows(kmask, s_k))
     out_specs = [q_spec, lse_spec] if want_lse else q_spec
     out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
                  jax.ShapeDtypeStruct((b * h, s_q, _LANE), jnp.float32)]
     res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[q_spec, k_spec, k_spec],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape if want_lse else out_shape[0],
         scratch_shapes=[
@@ -177,14 +206,18 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True):
             pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(qf, kf, vf)
+    )(*inputs)
     out, lse = res if want_lse else (res, None)
     return _unfold(out, b, h, s_q, d)[..., :d_orig], lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, num_k_blocks, causal_offset):
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
+               scale, causal, num_k_blocks, causal_offset, with_kmask):
     from jax.experimental import pallas as pl
+
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if with_kmask else None
+    dq_ref, dq_scr = rest
 
     q_idx = pl.program_id(1)
     kb = pl.program_id(2)
@@ -210,11 +243,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos + np.int32(causal_offset) >= k_pos
         s = jnp.where(mask, s, -1e30)
+    if with_kmask:
+        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
     p = jnp.exp(s - lse)
     if causal:
         # explicit zero (not exp of a huge negative) so fully-masked
         # rows contribute NO gradient instead of fp32-rounding noise
         p = jnp.where(mask, p, 0.0)
+    if with_kmask:
+        p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
     dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
     dq_scr[...] += jnp.dot(ds, k,
@@ -225,10 +262,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_scr, dv_scr, *, scale, causal, num_q_blocks,
-                causal_offset):
+def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
+                scale, causal, num_q_blocks, causal_offset, with_kmask):
     from jax.experimental import pallas as pl
+
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if with_kmask else None
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
 
     kb = pl.program_id(1)
     qb = pl.program_id(2)
@@ -255,9 +295,13 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, dk_ref,
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos + np.int32(causal_offset) >= k_pos
         s = jnp.where(mask, s, -1e30)
+    if with_kmask:
+        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
     p = jnp.exp(s - lse)                         # (block_q, block_k)
     if causal:
         p = jnp.where(mask, p, 0.0)
+    if with_kmask:
+        p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
     dv_scr[...] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
     dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
@@ -270,7 +314,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
+def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
+                      kmask=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -302,17 +347,24 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
     lseq_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
                              lambda i, j, kb: (i, j, zero(i)))
 
+    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, lseq_spec,
+                   lseq_spec]
+    dq_inputs = [qf, kf, vf, gf, lse, delta]
+    if kmask is not None:
+        dq_in_specs.append(_kmask_spec(h))
+        dq_inputs.append(_kmask_rows(kmask, s_k))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           num_k_blocks=num_k_blocks,
-                          causal_offset=causal_offset),
+                          causal_offset=causal_offset,
+                          with_kmask=kmask is not None),
         grid=(b * h, num_q_blocks, num_k_blocks),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, lseq_spec, lseq_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
         interpret=_INTERPRET,
-    )(qf, kf, vf, gf, lse, delta)
+    )(*dq_inputs)
 
     # pass 2: grid is (BH, k-block, q-block) — index maps swap roles
     kk_spec = pl.BlockSpec((None, _BLOCK_K, d),
@@ -321,20 +373,27 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
                            lambda i, kb, j: (i, j, zero(i)))
     lse2_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
                              lambda i, kb, j: (i, j, zero(i)))
+    dkv_in_specs = [kk_spec, kk_spec, qq_spec, qq_spec, lse2_spec,
+                    lse2_spec]
+    dkv_inputs = [kf, vf, qf, gf, lse, delta]
+    if kmask is not None:
+        # grid here is (BH, k-block, q-block): mask block follows kb
+        dkv_in_specs.append(_kmask_spec(h, kb_in_dim2=False))
+        dkv_inputs.append(_kmask_rows(kmask, s_k))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           num_q_blocks=num_q_blocks,
-                          causal_offset=causal_offset),
+                          causal_offset=causal_offset,
+                          with_kmask=kmask is not None),
         grid=(b * h, num_k_blocks, num_q_blocks),
-        in_specs=[kk_spec, kk_spec, qq_spec, qq_spec, lse2_spec,
-                  lse2_spec],
+        in_specs=dkv_in_specs,
         out_specs=[kk_spec, kk_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((_BLOCK_K, d), jnp.float32),
                         pltpu.VMEM((_BLOCK_K, d), jnp.float32)],
         interpret=_INTERPRET,
-    )(kf, vf, qf, gf, lse, delta)
+    )(*dkv_inputs)
 
     dq = _unfold(dq, b, h, s_q, d)[..., :d_orig]
     dk = _unfold(dk, b, h, s_k, d)[..., :d_orig]
@@ -343,37 +402,71 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, mask, scale, causal):
+def _flash(q, k, v, kmask, scale, causal):
     # primal (inference) path: no LSE output at all
-    out, _ = _flash_fwd_pallas(q, k, v, scale, causal, want_lse=False)
+    out, _ = _flash_fwd_pallas(q, k, v, scale, causal, want_lse=False,
+                               kmask=kmask)
     return out
 
 
-def _flash_fwd(q, k, v, mask, scale, causal):
-    out, lse = _flash_fwd_pallas(q, k, v, scale, causal)
+def _flash_fwd(q, k, v, kmask, scale, causal):
+    out, lse = _flash_fwd_pallas(q, k, v, scale, causal, kmask=kmask)
     # residual holds ONE lane of the lane-replicated LSE: the full
     # (BH, S, 128) copy would cost 128x the HBM across the fwd→bwd
     # interval on exactly the long-context runs flash exists for
-    return out, (q, k, v, out, lse[:, :, :1])
+    return out, (q, k, v, out, lse[:, :, :1], kmask)
 
 
 def _flash_bwd(scale, causal, res, g):
-    q, k, v, out, lse1 = res
+    q, k, v, out, lse1, kmask = res
     lse = jnp.broadcast_to(lse1, lse1.shape[:2] + (_LANE,))
-    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal)
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
+                                   kmask=kmask)
     return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _as_key_padding(mask, batch=None, s_k=None):
+    """(B, 1, 1, S_k) / (B, S_k) masks depend only on key position —
+    the flash kernels support those; anything query- or head-dependent
+    (incl. ambiguous 2-D (S_q, S_k) attention masks) returns None (XLA
+    fallback).  The result is broadcast to ``batch`` rows so the
+    per-batch kernel block indexing is always in range."""
+    import jax.numpy as _jnp
+
+    if mask is None:
+        return None
+    km = None
+    if mask.ndim == 2:
+        # only unambiguously key padding when it matches (B, S_k) —
+        # a (S_q, S_k) attention mask must stay on the XLA path
+        if batch is not None and s_k is not None and \
+                mask.shape == (batch, s_k) and \
+                (batch != s_k or batch == 1):
+            km = mask
+    elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        km = mask.reshape(mask.shape[0], mask.shape[3])
+    if km is None:
+        return None
+    if batch is not None and km.shape[0] == 1 and batch > 1:
+        km = _jnp.broadcast_to(km, (batch,) + km.shape[1:])
+    if batch is not None and km.shape[0] != batch:
+        return None
+    return km
+
+
 def flash_attention(q, k, v, mask=None, scale=None, causal=False):
-    """Flash attention; (B, S, H, D) in/out.  Mask is handled by the XLA
-    fallback path (masked flash lands with the long-context milestone) —
-    callers pass mask=None on the flash path."""
+    """Flash attention; (B, S, H, D) in/out.
+
+    Key-padding masks ((B, 1, 1, S_k) or (B, S_k)) run INSIDE the
+    kernels (fwd and both bwd passes); general query-dependent masks
+    fall back to the XLA path."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    if mask is not None:
+    kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1])
+    if mask is not None and kmask is None:
         from .attention import _sdpa_xla
         return _sdpa_xla(q, k, v, mask, scale, causal)
-    return _flash(q, k, v, None, float(scale), bool(causal))
+    return _flash(q, k, v, kmask, float(scale), bool(causal))
