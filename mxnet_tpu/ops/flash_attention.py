@@ -457,16 +457,24 @@ def _as_key_padding(mask, batch=None, s_k=None):
     return km
 
 
-def flash_attention(q, k, v, mask=None, scale=None, causal=False):
+def flash_attention(q, k, v, mask=None, scale=None, causal=False,
+                    kmask=None):
     """Flash attention; (B, S, H, D) in/out.
 
     Key-padding masks ((B, 1, 1, S_k) or (B, S_k)) run INSIDE the
     kernels (fwd and both bwd passes); general query-dependent masks
-    fall back to the XLA path."""
+    fall back to the XLA path.  Dispatchers that already normalized the
+    mask pass ``kmask`` directly (avoids a second conversion)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1])
-    if mask is not None and kmask is None:
-        from .attention import _sdpa_xla
-        return _sdpa_xla(q, k, v, mask, scale, causal)
+    if kmask is None and mask is not None:
+        kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1])
+        if kmask is None:
+            if mask.ndim == 2:
+                raise ValueError(
+                    f"2-D mask {mask.shape} is not (batch, seq_k) = "
+                    f"{(q.shape[0], k.shape[1])}; pass query-dependent "
+                    "masks as (B, 1|H, S_q, S_k)")
+            from .attention import _sdpa_xla
+            return _sdpa_xla(q, k, v, mask, scale, causal)
     return _flash(q, k, v, kmask, float(scale), bool(causal))

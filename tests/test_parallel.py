@@ -202,3 +202,32 @@ def test_quantized_psum_rejects_bad_bits():
     from mxnet_tpu import parallel
     with _pytest.raises(mx.MXNetError, match="bits"):
         parallel.quantized_psum(jnp.ones((4,)), "dp", bits=4)
+
+
+def test_sync_batchnorm_global_stats():
+    """SyncBatchNorm semantics come free under SPMD: BN statistics in
+    a DataParallelTrainer step reduce over the GLOBAL batch, matching
+    the reference's cross-device sync-BN (bit-exact check)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.nn import SyncBatchNorm
+    from mxnet_tpu.gluon.loss import L2Loss
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4, in_units=3),
+                SyncBatchNorm(num_devices=8))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"dp": 8})
+    dpt = parallel.DataParallelTrainer(net, L2Loss(), "sgd",
+                                       {"learning_rate": 0.0},
+                                       mesh=mesh)
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 3).astype("f4")
+    Y = rng.randn(16, 4).astype("f4")
+    dpt.step(nd.array(X), nd.array(Y))
+    bn = net[1]
+    W = net[0].weight.data().asnumpy()
+    b = net[0].bias.data().asnumpy()
+    want = 0.1 * (X @ W.T + b).mean(axis=0)   # global-batch mean
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
