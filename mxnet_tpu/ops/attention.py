@@ -71,20 +71,17 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     Returns (batch, seq, num_heads, head_dim).
     """
     mask = rest[0] if use_mask and rest else None
-    if mask is not None and mask.ndim == 2:
-        # normalize 2-D key-padding masks ONCE at the dispatch entry so
-        # both the kernel and XLA paths see the documented 4-D layout
-        if mask.shape != (query.shape[0], key.shape[1]):
-            raise ValueError(
-                f"2-D attention mask must be (batch, seq_k) = "
-                f"{(query.shape[0], key.shape[1])}, got {mask.shape}; "
-                "pass query-dependent masks as (B, 1|H, S_q, S_k)")
-        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
     from .flash_attention import _as_key_padding
+    # _as_key_padding is the ONE decision point: unambiguous key-padding
+    # masks go to the kernel; everything else (query-dependent 4-D,
+    # ambiguous/broadcastable 2-D) keeps the XLA broadcast behavior
     kmask = _as_key_padding(mask, batch=query.shape[0],
                             s_k=key.shape[1])
+    if kmask is not None and mask.ndim == 2:
+        # normalize for the XLA path too, in case flash is not viable
+        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key):
         from .flash_attention import flash_attention

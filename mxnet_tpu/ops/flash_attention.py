@@ -470,11 +470,8 @@ def flash_attention(q, k, v, mask=None, scale=None, causal=False,
     if kmask is None and mask is not None:
         kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1])
         if kmask is None:
-            if mask.ndim == 2:
-                raise ValueError(
-                    f"2-D mask {mask.shape} is not (batch, seq_k) = "
-                    f"{(q.shape[0], k.shape[1])}; pass query-dependent "
-                    "masks as (B, 1|H, S_q, S_k)")
+            # query-dependent / ambiguous masks: XLA broadcast path,
+            # exactly the pre-kernel behavior
             from .attention import _sdpa_xla
             return _sdpa_xla(q, k, v, mask, scale, causal)
     return _flash(q, k, v, kmask, float(scale), bool(causal))

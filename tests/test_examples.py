@@ -89,3 +89,10 @@ def test_llama_generate_smoke():
                 "--steps", "60", "--new-tokens", "4"])
     assert res.returncode == 0
     assert "tokens/sec decode" in res.stdout
+
+
+def test_actor_critic_smoke():
+    res = _run([os.path.join("example", "actor_critic.py"),
+                "--episodes", "80"])
+    assert res.returncode == 0
+    assert "avg reward" in res.stdout
