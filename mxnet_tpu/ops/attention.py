@@ -38,6 +38,8 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
             logits = jnp.where(cm[None, None, None], logits, -1e30)
         if mask is not None:
             m = mask.astype(bool)
+            if m.ndim == 2:       # legacy (S_q, S_k) broadcast form
+                m = m[None, None]
             if m.shape[1] == 1:
                 m = m[:, :, None]                    # (B,1,1,Sq,Sk)
             else:
@@ -71,6 +73,11 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     Returns (batch, seq, num_heads, head_dim).
     """
     mask = rest[0] if use_mask and rest else None
+    if mask is not None and mask.ndim == 2 and \
+            mask.shape == (query.shape[0], key.shape[1]):
+        # documented 2-D form: per-batch key padding (incl. B == S_k);
+        # normalized here once for every downstream path
+        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
     from .flash_attention import _as_key_padding
@@ -79,9 +86,6 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     # ambiguous/broadcastable 2-D) keeps the XLA broadcast behavior
     kmask = _as_key_padding(mask, batch=query.shape[0],
                             s_k=key.shape[1])
-    if kmask is not None and mask.ndim == 2:
-        # normalize for the XLA path too, in case flash is not viable
-        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key):
         from .flash_attention import flash_attention

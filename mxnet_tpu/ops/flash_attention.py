@@ -440,11 +440,11 @@ def _as_key_padding(mask, batch=None, s_k=None):
         return None
     km = None
     if mask.ndim == 2:
-        # only unambiguously key padding when it matches (B, S_k) —
-        # a (S_q, S_k) attention mask must stay on the XLA path
+        # the documented 2-D form is per-batch key padding: accept
+        # exactly (B, S_k); other 2-D shapes keep the legacy XLA
+        # broadcast behavior
         if batch is not None and s_k is not None and \
-                mask.shape == (batch, s_k) and \
-                (batch != s_k or batch == 1):
+                mask.shape == (batch, s_k):
             km = mask
     elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
         km = mask.reshape(mask.shape[0], mask.shape[3])

@@ -105,6 +105,25 @@ _FUSED_RULES = {
 }
 
 
+def _apply_rule(rule, opt, tr_count, n_scalars, get_param, tstate_vals,
+                grads, scalar_vals):
+    """Apply the fused optimizer rule to every trainable param (shared
+    by the two-phase update program and the fully-fused step)."""
+    new_params, new_states = [], []
+    for j in range(tr_count):
+        scal = tuple(scalar_vals[j * n_scalars + k]
+                     for k in range(n_scalars))
+        st = tstate_vals[j]
+        res = rule.apply(opt, get_param(j), grads[j], st, *scal)
+        if isinstance(res, tuple) and isinstance(res[1], tuple):
+            w, new_st = res
+        else:
+            w, new_st = res[0], tuple(res[1:])
+        new_params.append(w)
+        new_states.append(new_st if new_st else st)
+    return tuple(new_params), tuple(new_states)
+
+
 class DataParallelTrainer:
     """SPMD data-parallel trainer over a device mesh.
 
@@ -122,7 +141,8 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn: Callable, optimizer,
                  optimizer_params=None, mesh=None, dp_axis: str = "dp",
-                 param_sharding: Optional[Callable] = None):
+                 param_sharding: Optional[Callable] = None,
+                 fuse_step: bool = False):
         from .. import optimizer as opt
 
         self.block = block
@@ -139,8 +159,20 @@ class DataParallelTrainer:
         self._params = None
         self._fwd_bwd = None
         self._fused_update = None
+        self._full_step = None
+        # fuse_step=True compiles forward+backward+optimizer into ONE
+        # program (optimizer states donated), removing the gradient
+        # round-trip through HBM between the two phases; requires a
+        # fused optimizer rule
+        self._fuse_step = fuse_step
         self._mutated_idx: List[int] = []
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
+        if fuse_step and self._rule is None:
+            import warnings
+            warnings.warn(
+                f"fuse_step=True requested but optimizer "
+                f"{type(self.optimizer).__name__} has no fused rule; "
+                "falling back to the two-phase step", stacklevel=2)
 
     # -- lazy setup -------------------------------------------------------
     def _setup(self, args):
@@ -243,6 +275,8 @@ class DataParallelTrainer:
         batch = NamedSharding(self.mesh, P(self.dp_axis))
         repl = NamedSharding(self.mesh, P())
         param_shardings = tuple(p.data()._data.sharding for p in params)
+        self._traced_fn = traced          # reused by the fused step
+        self._n_args = n_args
         self._fwd_bwd = jax.jit(
             traced,
             in_shardings=(param_shardings, (batch,) * n_args, batch, repl))
@@ -259,21 +293,12 @@ class DataParallelTrainer:
         opt = self.optimizer
         n_scalars = len(rule.scalars(opt, 0, 1))
 
+        n_tr = len(self._tr_idx)
+
         def update_all(tparam_vals, tstate_vals, grad_vals, scalar_vals):
-            new_params, new_states = [], []
-            for j in range(len(tparam_vals)):
-                scal = tuple(scalar_vals[j * n_scalars + k]
-                             for k in range(n_scalars))
-                st = tstate_vals[j]
-                res = rule.apply(opt, tparam_vals[j], grad_vals[j], st,
-                                 *scal)
-                if isinstance(res, tuple) and isinstance(res[1], tuple):
-                    w, new_st = res
-                else:
-                    w, new_st = res[0], tuple(res[1:])
-                new_params.append(w)
-                new_states.append(new_st if new_st else st)
-            return tuple(new_params), tuple(new_states)
+            return _apply_rule(rule, opt, n_tr, n_scalars,
+                               lambda j: tparam_vals[j], tstate_vals,
+                               grad_vals, scalar_vals)
 
         # pin output shardings to the input param/state layouts so a
         # TP-sharded forward can't silently re-shard weights between steps
@@ -308,6 +333,48 @@ class DataParallelTrainer:
             else:
                 s._set_data(vals[0])
 
+    def _build_full_step(self):
+        """ONE program: loss/grads + the multi-tensor optimizer update,
+        with optimizer states donated (their buffers are dead the
+        moment the new states exist)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rule = self._rule
+        opt = self.optimizer
+        n_scalars = len(rule.scalars(opt, 0, 1))
+        tr_idx = self._tr_idx
+        traced = self._traced_fn
+
+        def full(param_vals, tstate_vals, scalar_vals, input_vals,
+                 label_val, key_raw):
+            loss, grads, aux = traced(param_vals, input_vals, label_val,
+                                      key_raw)
+            new_params, new_states = _apply_rule(
+                rule, opt, len(tr_idx), n_scalars,
+                lambda j: param_vals[tr_idx[j]], tstate_vals, grads,
+                scalar_vals)
+            return loss, new_params, new_states, aux
+
+        batch = NamedSharding(self.mesh, P(self.dp_axis))
+        repl = NamedSharding(self.mesh, P())
+        param_shardings = tuple(
+            p.data()._data.sharding for p in self._params)
+        tr_param_shardings = tuple(
+            self._params[i].data()._data.sharding for i in tr_idx)
+        state_shardings = tuple(
+            tuple(v.sharding for v in vals) for vals in self._state_vals())
+        # out shardings pinned for the same reason as the two-phase
+        # update: a TP rule must not let XLA silently re-shard weights
+        # between steps (and donation aliasing needs stable layouts)
+        self._full_step = jax.jit(
+            full,
+            in_shardings=(param_shardings, state_shardings, None,
+                          (batch,) * self._n_args, batch, repl),
+            out_shardings=(None, tr_param_shardings, state_shardings,
+                           None),
+            donate_argnums=(1,))
+
     # -- public API -------------------------------------------------------
     def step(self, data, label):
         """Run ONE fused SPMD train step; returns the loss NDArray.
@@ -340,6 +407,7 @@ class DataParallelTrainer:
             finally:
                 autograd.set_training(prev)
 
+        use_full = self._fuse_step and self._rule is not None
         prev = autograd.set_training(True)
         try:
             batch = NamedSharding(self.mesh, P(self.dp_axis))
@@ -348,10 +416,34 @@ class DataParallelTrainer:
             key = _rnd._next_key_nd(args[0].context)
 
             param_vals = tuple(p.data()._data for p in self._params)
-            loss, grads, aux = self._fwd_bwd(param_vals, x_vals, y_val,
-                                             key._data)
+            if use_full:
+                opt = self.optimizer
+                for i in self._tr_idx:
+                    opt._update_count(i)
+                scalar_vals = []
+                for i in self._tr_idx:
+                    t = opt._index_update_count[i]
+                    scalar_vals.extend(
+                        np.asarray(sv, dtype=np.float32)
+                        for sv in self._rule.scalars(opt, i, t))
+                if self._full_step is None:
+                    self._build_full_step()
+                loss, new_params, new_states, aux = self._full_step(
+                    param_vals, self._state_vals(),
+                    tuple(scalar_vals), x_vals, y_val, key._data)
+            else:
+                loss, grads, aux = self._fwd_bwd(param_vals, x_vals,
+                                                 y_val, key._data)
         finally:
             autograd.set_training(prev)
+
+        if use_full:
+            for i, v in zip(self._mutated_idx, aux):
+                self._params[i].data()._set_data(v)
+            for i, v in zip(self._tr_idx, new_params):
+                self._params[i].data()._set_data(v)
+            self._write_states(new_states)
+            return NDArray(loss, ctx=args[0].context)
 
         # write mutated aux state (BatchNorm running stats) back
         for i, v in zip(self._mutated_idx, aux):

@@ -231,3 +231,31 @@ class TestKeyPaddingDispatch:
         # batch mismatch rejected
         assert fa._as_key_padding(jnp.ones((3, 1, 1, 128)), batch=4,
                                   s_k=128) is None
+
+
+def test_square_2d_mask_is_key_padding():
+    """The documented 2-D form (B, S_k) is per-batch key padding even
+    when B == S_k; GQA + legacy 2-D broadcast shapes don't crash."""
+    import importlib
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import dot_product_attention, _sdpa_xla
+    rng = np.random.RandomState(30)
+    B = S = 4
+    q = jnp.asarray(rng.randn(B, S, 2, 8).astype("f"))
+    pad = jnp.asarray(
+        (np.arange(S)[None] < np.asarray([1, 2, 3, 4])[:, None])
+        .astype("f"))
+    got = dot_product_attention(q, q, q, pad, use_mask=True)
+    want = _sdpa_xla(q, q, q, pad.reshape(B, 1, 1, S),
+                     1 / np.sqrt(8), False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    # GQA + legacy (S_q, S_k) broadcast mask: no crash, matches oracle
+    kv = jnp.asarray(rng.randn(2, 4, 1, 8).astype("f"))
+    q2 = jnp.asarray(rng.randn(2, 4, 2, 8).astype("f"))
+    tri = jnp.asarray(np.tril(np.ones((4, 4), "float32")))
+    got2 = dot_product_attention(q2, kv, kv, tri, use_mask=True)
+    want2 = _sdpa_xla(q2, jnp.repeat(kv, 2, 2), jnp.repeat(kv, 2, 2),
+                      tri[None, None], 1 / np.sqrt(8), False)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               rtol=1e-5, atol=1e-6)

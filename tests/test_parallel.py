@@ -231,3 +231,81 @@ def test_sync_batchnorm_global_stats():
     want = 0.1 * (X @ W.T + b).mean(axis=0)   # global-batch mean
     np.testing.assert_allclose(bn.running_mean.data().asnumpy(), want,
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("opt_name,opt_args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-3}),
+])
+def test_fuse_step_matches_two_phase(opt_name, opt_args):
+    """fuse_step=True (one program: fwd+bwd+update, donated states)
+    must be numerically identical to the two-phase trainer."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 6).astype("f4")
+    Y = rng.randint(0, 3, 8).astype("f4")
+
+    def run(fuse):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(16, activation="relu", in_units=6),
+                    gluon.nn.Dense(3, in_units=16))
+        net.initialize(mx.init.Xavier())
+        mesh = parallel.make_mesh({"dp": 4})
+        dpt = parallel.DataParallelTrainer(
+            net, SoftmaxCrossEntropyLoss(), opt_name, dict(opt_args),
+            mesh=mesh, fuse_step=fuse)
+        losses = [float(dpt.step(nd.array(X), nd.array(Y)).asnumpy())
+                  for _ in range(5)]
+        w = net[0].weight.data().asnumpy()
+        return losses, w
+
+    l_fused, w_fused = run(True)
+    l_two, w_two = run(False)
+    np.testing.assert_allclose(l_fused, l_two, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w_fused, w_two, rtol=1e-5, atol=1e-6)
+
+
+def test_fuse_step_with_tensor_parallel_rule():
+    """fuse_step under a TP param-sharding rule: losses match the
+    two-phase TP run and the weight sharding stays pinned."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(8, 6).astype("f4")
+    Y = rng.randint(0, 3, 8).astype("f4")
+
+    def rule(name, shape):
+        if name.endswith("dense0_weight"):
+            return P("tp", None)
+        return None
+
+    def run(fuse):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(8, activation="relu", in_units=6),
+                    gluon.nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier())
+        mesh = parallel.make_mesh({"dp": 2, "tp": 2})
+        dpt = parallel.DataParallelTrainer(
+            net, SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-3}, mesh=mesh, param_sharding=rule,
+            fuse_step=fuse)
+        losses = [float(dpt.step(nd.array(X), nd.array(Y)).asnumpy())
+                  for _ in range(4)]
+        sharding = net[0].weight.data()._data.sharding
+        return losses, sharding
+
+    lf, sf = run(True)
+    lt, st = run(False)
+    np.testing.assert_allclose(lf, lt, rtol=1e-5, atol=1e-6)
+    assert "tp" in str(sf.spec), sf  # weights stayed TP-sharded
