@@ -73,11 +73,6 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     Returns (batch, seq, num_heads, head_dim).
     """
     mask = rest[0] if use_mask and rest else None
-    if mask is not None and mask.ndim == 2 and \
-            mask.shape == (query.shape[0], key.shape[1]):
-        # documented 2-D form: per-batch key padding (incl. B == S_k);
-        # normalized here once for every downstream path
-        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
     from .flash_attention import _as_key_padding
@@ -86,6 +81,10 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     # ambiguous/broadcastable 2-D) keeps the XLA broadcast behavior
     kmask = _as_key_padding(mask, batch=query.shape[0],
                             s_k=key.shape[1])
+    if kmask is not None and mask.ndim == 2:
+        # normalize the documented 2-D key-padding form for the XLA
+        # path too (the shape RULE lives only in _as_key_padding)
+        mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key):
         from .flash_attention import flash_attention

@@ -428,6 +428,20 @@ def _flash_bwd(scale, causal, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+_SQUARE_MASK_WARNED = set()
+
+
+def _warn_square_mask_once(n):
+    if n not in _SQUARE_MASK_WARNED:
+        _SQUARE_MASK_WARNED.add(n)
+        import warnings
+        warnings.warn(
+            f"interpreting square 2-D attention mask ({n}, {n}) as "
+            "PER-BATCH KEY PADDING (the documented 2-D form); pass a "
+            "(1, 1, S_q, S_k) mask for attention-matrix semantics",
+            stacklevel=4)
+
+
 def _as_key_padding(mask, batch=None, s_k=None):
     """(B, 1, 1, S_k) / (B, S_k) masks depend only on key position —
     the flash kernels support those; anything query- or head-dependent
@@ -445,6 +459,8 @@ def _as_key_padding(mask, batch=None, s_k=None):
         # broadcast behavior
         if batch is not None and s_k is not None and \
                 mask.shape == (batch, s_k):
+            if batch == s_k and batch > 1:
+                _warn_square_mask_once(batch)
             km = mask
     elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
         km = mask.reshape(mask.shape[0], mask.shape[3])

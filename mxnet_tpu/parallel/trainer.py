@@ -102,6 +102,23 @@ _FUSED_RULES = {
             w, g, s[0], lr, wd, gamma1=o.gamma1, epsilon=o.epsilon,
             rescale_grad=o.rescale_grad,
             clip_gradient=o._clip() or -1.0)),
+    "AdamW": _FusedRule(
+        2,
+        lambda o, i, t: (
+            o._get_lr(i) * math.sqrt(1.0 - o.beta2 ** t)
+            / (1.0 - o.beta1 ** t),
+            1.0,
+            o._get_wd(i)),
+        lambda o, w, g, s, lr, eta, wd: get_op("adamw_update").fcompute(
+            w, g, s[0], s[1], lr, eta, wd, beta1=o.beta1, beta2=o.beta2,
+            epsilon=o.epsilon, rescale_grad=o.rescale_grad,
+            clip_gradient=o._clip() or -1.0)),
+    "AdaGrad": _FusedRule(
+        1, _sgd_scalars,
+        lambda o, w, g, s, lr, wd: get_op("adagrad_update").fcompute(
+            w, g, s[0], lr, wd, epsilon=o.float_stable_eps,
+            rescale_grad=o.rescale_grad,
+            clip_gradient=o._clip() or -1.0)),
 }
 
 

@@ -236,6 +236,8 @@ def test_sync_batchnorm_global_stats():
 @pytest.mark.parametrize("opt_name,opt_args", [
     ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
     ("adam", {"learning_rate": 1e-3}),
+    ("adamw", {"learning_rate": 1e-3, "wd": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
 ])
 def test_fuse_step_matches_two_phase(opt_name, opt_args):
     """fuse_step=True (one program: fwd+bwd+update, donated states)
