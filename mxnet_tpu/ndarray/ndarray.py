@@ -599,24 +599,34 @@ def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
 
     # dynamic scalar attrs ride as 0-d input arrays (no recompile on change)
     scalar_vals = []
-    if op.scalar_attrs:
+    if op.scalar_attrs and any(s in kwargs for s in op.scalar_attrs):
         ref = op.scalar_ref_input
         ref_dtype = (inputs[ref].dtype if ref is not None and inputs
                      else np.dtype("float32"))
         sdt = ref_dtype if ref_dtype.name in _FLOAT_DTYPES \
             else np.dtype("float32")
+        # scalars bind POSITIONALLY after the tensor inputs, so once
+        # any is supplied EVERY one must be materialized — an omitted
+        # earlier scalar would silently shift later values into the
+        # wrong parameter (e.g. t binding as wd)
         for sname in op.scalar_attrs:
             if sname in kwargs:
                 v = kwargs.pop(sname)
-                if isinstance(v, NDArray):
-                    scalar_vals.append(v._data)
-                else:
-                    dt = sdt
-                    if isinstance(v, (int, np.integer)) and \
-                            not isinstance(v, (bool, np.bool_)) and \
-                            ref_dtype.kind in "iu":
-                        dt = ref_dtype
-                    scalar_vals.append(np.asarray(v, dtype=dt))
+            elif sname in op.scalar_defaults:
+                v = op.scalar_defaults[sname]
+            else:
+                raise MXNetError(
+                    f"{op.name}: scalar attr {sname!r} is required "
+                    f"when any of {op.scalar_attrs} is given")
+            if isinstance(v, NDArray):
+                scalar_vals.append(v._data)
+            else:
+                dt = sdt
+                if isinstance(v, (int, np.integer)) and \
+                        not isinstance(v, (bool, np.bool_)) and \
+                        ref_dtype.kind in "iu":
+                    dt = ref_dtype
+                scalar_vals.append(np.asarray(v, dtype=dt))
 
     all_arrays = arrays + scalar_vals
     jax = _jax()

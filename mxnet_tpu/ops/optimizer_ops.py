@@ -204,17 +204,20 @@ def adadelta_update(weight, grad, acc_g, acc_delta, wd, *, rho=0.9,
 
 
 @register("lamb_update_phase1", num_inputs=4,
-          scalar_attrs=("wd",), num_outputs=3)
-def lamb_update_phase1(weight, grad, mean, var, wd, *, beta1=0.9,
-                       beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+          scalar_attrs=("wd", "t"), num_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, wd, t=1, *, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, bias_correction=True,
                        rescale_grad=1.0, clip_gradient=-1.0):
+    """``t`` (the step count for bias correction) rides as a DYNAMIC
+    scalar so a training loop does not recompile phase1 every step."""
     g = _prep_grad(grad, rescale_grad, clip_gradient)
     new_mean = beta1 * mean + (1.0 - beta1) * g
     new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
     m, v = new_mean, new_var
     if bias_correction:
-        m = m / (1.0 - beta1 ** t)
-        v = v / (1.0 - beta2 ** t)
+        tf = jnp.asarray(t, jnp.float32)
+        m = m / (1.0 - jnp.power(jnp.float32(beta1), tf))
+        v = v / (1.0 - jnp.power(jnp.float32(beta2), tf))
     update = m / (jnp.sqrt(v) + epsilon) + wd * weight
     return update, new_mean, new_var
 

@@ -43,7 +43,7 @@ class OpDef:
 
     __slots__ = ("name", "fcompute", "num_inputs", "num_outputs",
                  "scalar_attrs", "wrap_ctx", "doc", "attr_names",
-                 "scalar_ref_input", "input_names")
+                 "scalar_ref_input", "input_names", "scalar_defaults")
 
     def __init__(self, name: str, fcompute: Callable,
                  num_inputs: Optional[int], num_outputs: int,
@@ -72,9 +72,18 @@ class OpDef:
             n_scal = len(self.scalar_attrs)
             self.input_names = tuple(pos[:len(pos) - n_scal]) \
                 if n_scal else tuple(pos)
+            # signature defaults for scalar attrs: lets the frontend
+            # fill OMITTED scalars positionally so a partial kwarg set
+            # can never misbind (e.g. t provided but wd omitted)
+            self.scalar_defaults = {
+                p.name: p.default
+                for p in sig.parameters.values()
+                if p.name in self.scalar_attrs
+                and p.default is not inspect.Parameter.empty}
         except (TypeError, ValueError):
             self.attr_names = ()
             self.input_names = ()
+            self.scalar_defaults = {}
 
 
 _REGISTRY: Dict[str, OpDef] = {}

@@ -68,6 +68,12 @@ def _sgd_scalars(o, i, t):
     return (o._get_lr(i), o._get_wd(i))
 
 
+def _adam_corrected_lr(o, i, t):
+    """Bias-corrected learning rate (shared by Adam and AdamW)."""
+    return (o._get_lr(i) * math.sqrt(1.0 - o.beta2 ** t)
+            / (1.0 - o.beta1 ** t))
+
+
 _FUSED_RULES = {
     "SGD": _FusedRule(
         1, _sgd_scalars,
@@ -88,10 +94,7 @@ _FUSED_RULES = {
             clip_gradient=o._clip() or -1.0)),
     "Adam": _FusedRule(
         2,
-        lambda o, i, t: (
-            o._get_lr(i) * math.sqrt(1.0 - o.beta2 ** t)
-            / (1.0 - o.beta1 ** t),
-            o._get_wd(i)),
+        lambda o, i, t: (_adam_corrected_lr(o, i, t), o._get_wd(i)),
         lambda o, w, g, s, lr, wd: get_op("adam_update").fcompute(
             w, g, s[0], s[1], lr, wd, beta1=o.beta1, beta2=o.beta2,
             epsilon=o.epsilon, rescale_grad=o.rescale_grad,
@@ -104,11 +107,8 @@ _FUSED_RULES = {
             clip_gradient=o._clip() or -1.0)),
     "AdamW": _FusedRule(
         2,
-        lambda o, i, t: (
-            o._get_lr(i) * math.sqrt(1.0 - o.beta2 ** t)
-            / (1.0 - o.beta1 ** t),
-            1.0,
-            o._get_wd(i)),
+        lambda o, i, t: (_adam_corrected_lr(o, i, t), 1.0,
+                         o._get_wd(i)),
         lambda o, w, g, s, lr, eta, wd: get_op("adamw_update").fcompute(
             w, g, s[0], s[1], lr, eta, wd, beta1=o.beta1, beta2=o.beta2,
             epsilon=o.epsilon, rescale_grad=o.rescale_grad,

@@ -158,3 +158,62 @@ def test_multi_precision_sgd():
     assert w16.dtype == np.float16
     assert_almost_equal(w16.asnumpy().astype("f4"),
                         np.full(4, 0.9, dtype="f4"), rtol=1e-2)
+
+
+def test_lamb_step_count_no_recompile():
+    """Regression: LAMB's bias-correction step count is a dynamic
+    scalar — a training loop must not compile a fresh phase1 program
+    per step."""
+    from mxnet_tpu.engine import _jit_cache
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "lamb",
+                       {"learning_rate": 1e-3})
+    X = nd.array(np.random.RandomState(0).randn(8, 6).astype("f4"))
+    Y = nd.array(np.random.RandomState(1).randn(8, 4).astype("f4"))
+    l2 = gluon.loss.L2Loss()
+
+    def step():
+        with autograd.record():
+            loss = l2(net(X), Y).mean()
+        loss.backward()
+        tr.step(8)
+        return float(loss.asnumpy())
+
+    first = step()          # warm every program at t=1
+    before = len(_jit_cache)
+    losses = [step() for _ in range(4)]   # t = 2..5
+    grew = len(_jit_cache) - before
+    assert grew == 0, f"LAMB compiled {grew} programs across steps"
+    assert losses[-1] < first
+
+
+def test_partial_scalar_attrs_never_misbind():
+    """Regression: supplying a LATER scalar attr without the earlier
+    ones must fill defaults positionally (or raise), never shift values
+    into the wrong parameter (t binding as wd corrupted updates)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    w = nd.ones((2, 2))
+    g = nd.ones((2, 2))
+    m = nd.zeros((2, 2))
+    v = nd.zeros((2, 2))
+    # t given, wd omitted: wd's default (none in signature) -> t has a
+    # default, wd... lamb phase1 signature: wd has no default => error
+    # OR default fill; either way NOT silent misbinding.  Verify the
+    # result equals the full-kwarg call when defaults exist.
+    out1 = nd.lamb_update_phase1(w, g, m, v, wd=0.0, t=5)
+    out2 = nd.lamb_update_phase1(w, g, m, v, t=5, wd=0.0)
+    np.testing.assert_allclose(out1[0].asnumpy(), out2[0].asnumpy())
+    try:
+        r = nd.lamb_update_phase1(w, g, m, v, t=5)  # wd omitted
+    except mx.MXNetError:
+        pass  # loud failure is acceptable
+    else:
+        # if it succeeded, wd must have been treated as its default
+        # (t=1 default misbind would change bias correction)
+        np.testing.assert_allclose(r[0].asnumpy(),
+                                   out1[0].asnumpy(), rtol=1e-6)
