@@ -67,6 +67,16 @@ def _deconv(ctx, name, ins, attrs, out):
          "dilations": list(attrs.get("dilate") or (1,) * len(kernel)),
          "pads": _pair_pads(attrs.get("pad") or (0,) * len(kernel)),
          "group": int(attrs.get("num_group", 1))}
+    # adj / target_shape change the output spatial shape; dropping them
+    # silently would export a different network (ONNX: output_padding /
+    # output_shape carry exactly these semantics)
+    adj = tuple(attrs.get("adj") or ())
+    if any(adj):
+        a["output_padding"] = list(adj)
+    target_shape = tuple(attrs.get("target_shape") or ())
+    if target_shape:
+        a["output_shape"] = list(target_shape)
+        a.pop("pads", None)  # ONNX: output_shape and pads are exclusive
     ctx.emit("ConvTranspose", ins, [out], name=name, **a)
 
 

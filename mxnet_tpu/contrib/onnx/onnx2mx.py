@@ -52,14 +52,28 @@ def _deconv(g, node, ins):
     w = g.shape_of(node.inputs[1])
     group = int(node.attrs.get("group", 1))
     kernel = tuple(node.attrs.get("kernel_shape", w[2:]))
-    return _sym()._invoke("Deconvolution", ins, {
+    attrs = {
         "kernel": kernel,
         "stride": tuple(node.attrs.get("strides", ())),
         "dilate": tuple(node.attrs.get("dilations", ())),
         "pad": _sym_pads(node.attrs.get("pads", ())),
         "num_filter": int(w[1]) * group,
         "num_group": group,
-        "no_bias": len(ins) < 3}, name=node.name or None)
+        "no_bias": len(ins) < 3}
+    # output_padding / output_shape are Deconvolution's adj /
+    # target_shape — dropping them changes the output spatial shape
+    adj = tuple(node.attrs.get("output_padding", ()))
+    if any(adj):
+        attrs["adj"] = adj
+    out_shape = tuple(node.attrs.get("output_shape", ()))
+    if out_shape:
+        # ONNX allows output_shape to carry the full (N, C, spatial...)
+        # rank; Deconvolution's target_shape is spatial-only
+        if len(out_shape) == len(kernel) + 2:
+            out_shape = out_shape[2:]
+        attrs["target_shape"] = out_shape
+    return _sym()._invoke("Deconvolution", ins, attrs,
+                          name=node.name or None)
 
 
 def _gemm(g, node, ins):
@@ -188,7 +202,7 @@ def _clip(g, node, ins):
                 raise MXNetError(
                     f"ONNX import: Clip bound {node.inputs[pos]!r} "
                     "must be an initializer")
-            return float(c)
+            return float(np.asarray(c).reshape(-1)[0])
         return None
 
     lo, hi = bound(1, "min"), bound(2, "max")

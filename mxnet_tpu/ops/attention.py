@@ -80,7 +80,7 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     # masks go to the kernel; everything else (query-dependent 4-D,
     # ambiguous/broadcastable 2-D) keeps the XLA broadcast behavior
     kmask = _as_key_padding(mask, batch=query.shape[0],
-                            s_k=key.shape[1])
+                            s_k=key.shape[1], s_q=query.shape[1])
     if kmask is not None and mask.ndim == 2:
         # normalize the documented 2-D key-padding form for the XLA
         # path too (the shape RULE lives only in _as_key_padding)

@@ -271,7 +271,11 @@ def multibox_target(anchors, labels, cls_preds, *,
             quota = jnp.maximum(quota, minimum_negative_samples)
             neg_rank = jnp.argsort(
                 jnp.argsort(-jnp.where(pos, -jnp.inf, neg_score)))
-            keep_neg = (~pos) & (neg_rank < quota)
+            # near-positives carry -inf score but still occupy ranks;
+            # when the quota exceeds the true-negative count they must
+            # land on ignore_label, not background (ADVICE r2)
+            keep_neg = (~pos) & (best_iou < negative_mining_thresh) \
+                & (neg_rank < quota)
             cls_t = jnp.where(pos | keep_neg, cls_t, ignore_label)
         return loc_t, loc_m, cls_t
 

@@ -428,26 +428,19 @@ def _flash_bwd(scale, causal, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-_SQUARE_MASK_WARNED = set()
-
-
-def _warn_square_mask_once(n):
-    if n not in _SQUARE_MASK_WARNED:
-        _SQUARE_MASK_WARNED.add(n)
-        import warnings
-        warnings.warn(
-            f"interpreting square 2-D attention mask ({n}, {n}) as "
-            "PER-BATCH KEY PADDING (the documented 2-D form); pass a "
-            "(1, 1, S_q, S_k) mask for attention-matrix semantics",
-            stacklevel=4)
-
-
-def _as_key_padding(mask, batch=None, s_k=None):
+def _as_key_padding(mask, batch=None, s_k=None, s_q=None):
     """(B, 1, 1, S_k) / (B, S_k) masks depend only on key position —
     the flash kernels support those; anything query- or head-dependent
-    (incl. ambiguous 2-D (S_q, S_k) attention masks) returns None (XLA
+    (incl. 2-D (S_q, S_k) attention masks) returns None (XLA
     fallback).  The result is broadcast to ``batch`` rows so the
-    per-batch kernel block indexing is always in range."""
+    per-batch kernel block indexing is always in range.
+
+    A 2-D mask whose shape satisfies BOTH readings — (B, S_k) key
+    padding and (S_q, S_k) attention matrix, i.e. B == S_q — is
+    genuinely ambiguous, and either silent binding corrupts numerics
+    for the other intent, so it raises (ADVICE r2): disambiguate with
+    ``kmask=`` / a (B, 1, 1, S_k) reshape for key padding, or a
+    (1, 1, S_q, S_k) reshape for attention-matrix semantics."""
     import jax.numpy as _jnp
 
     if mask is None:
@@ -459,8 +452,15 @@ def _as_key_padding(mask, batch=None, s_k=None):
         # broadcast behavior
         if batch is not None and s_k is not None and \
                 mask.shape == (batch, s_k):
-            if batch == s_k and batch > 1:
-                _warn_square_mask_once(batch)
+            if s_q is not None and batch == s_q and batch > 1:
+                from ..base import MXNetError
+                raise MXNetError(
+                    f"ambiguous 2-D attention mask {mask.shape}: with "
+                    f"batch == S_q == {batch} it reads equally as "
+                    "(B, S_k) key padding or an (S_q, S_k) attention "
+                    "matrix. Pass kmask=/reshape((B, 1, 1, S_k)) for "
+                    "key padding, or reshape((1, 1, S_q, S_k)) for "
+                    "attention-matrix semantics.")
             km = mask
     elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
         km = mask.reshape(mask.shape[0], mask.shape[3])
@@ -484,10 +484,12 @@ def flash_attention(q, k, v, mask=None, scale=None, causal=False,
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if kmask is None and mask is not None:
-        kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1])
+        kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1],
+                                s_q=q.shape[1])
         if kmask is None:
-            # query-dependent / ambiguous masks: XLA broadcast path,
-            # exactly the pre-kernel behavior
+            # query-dependent masks: XLA broadcast path, exactly the
+            # pre-kernel behavior (ambiguous B==S_q 2-D masks raise
+            # inside _as_key_padding instead)
             from .attention import _sdpa_xla
             return _sdpa_xla(q, k, v, mask, scale, causal)
     return _flash(q, k, v, kmask, float(scale), bool(causal))

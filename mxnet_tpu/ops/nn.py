@@ -245,22 +245,25 @@ def deconvolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
                 f"stride[{i}]={stride[i]}")
     n_filter = num_filter or weight.shape[1] * num_group
     if target_shape:
-        # reference semantics: target_shape OVERRIDES pad — padding is
-        # inferred so the output matches the requested spatial shape
+        # reference semantics (deconvolution-inl.h InferPad, bCal
+        # branch): target_shape OVERRIDES both pad and adj — padding is
+        # inferred as pad=(total+1)/2 with adj=total%2 adding back one
+        # element at the end, i.e. an effective asymmetric crop of
+        # (ceil(total/2), floor(total/2)) with the odd remainder
+        # absorbed on the LOW side
         out_sp = tuple(int(t) for t in target_shape)
-        inferred = []
+        pad_pairs = []
         for i in range(k):
             total = ((data.shape[2 + i] - 1) * stride[i]
-                     + (kernel[i] - 1) * dilate[i] + 1 + adj[i]
-                     - out_sp[i])
-            if total < 0 or total % 2:
+                     + (kernel[i] - 1) * dilate[i] + 1 - out_sp[i])
+            if total < 0:
                 raise ValueError(
                     f"Deconvolution: target_shape {target_shape} "
                     f"unreachable with kernel/stride/dilate along axis "
                     f"{i} (needs total pad {total})")
-            inferred.append(total // 2)
-        pad = tuple(inferred)
+            pad_pairs.append(((total + 1) // 2, total // 2))
     else:
+        pad_pairs = [(p, p) for p in pad]
         out_sp = tuple(
             (data.shape[2 + i] - 1) * stride[i] - 2 * pad[i]
             + (kernel[i] - 1) * dilate[i] + 1 + adj[i]
@@ -271,7 +274,7 @@ def deconvolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
     def fwd(y):
         return lax.conv_general_dilated(
             y, weight, window_strides=stride,
-            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            padding=pad_pairs, rhs_dilation=dilate,
             dimension_numbers=dn, feature_group_count=num_group)
 
     _, vjp = _jax.vjp(fwd, jnp.zeros(y_shape, data.dtype))

@@ -182,6 +182,9 @@ class DataParallelTrainer:
         # round-trip through HBM between the two phases; requires a
         # fused optimizer rule
         self._fuse_step = fuse_step
+        # set when a fused step failed after its donated optimizer
+        # state was handed to the executable (see _step_impl)
+        self._donation_poisoned = None
         self._mutated_idx: List[int] = []
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
         if fuse_step and self._rule is None:
@@ -445,9 +448,36 @@ class DataParallelTrainer:
                         for sv in self._rule.scalars(opt, i, t))
                 if self._full_step is None:
                     self._build_full_step()
-                loss, new_params, new_states, aux = self._full_step(
-                    param_vals, self._state_vals(),
-                    tuple(scalar_vals), x_vals, y_val, key._data)
+                if self._donation_poisoned is not None:
+                    raise MXNetError(
+                        "this trainer's optimizer state was donated to "
+                        "a fused step that failed and is no longer "
+                        "valid; rebuild the trainer and restore "
+                        "parameters/optimizer state from a checkpoint. "
+                        f"Original error: {self._donation_poisoned}")
+                try:
+                    loss, new_params, new_states, aux = self._full_step(
+                        param_vals, self._state_vals(),
+                        tuple(scalar_vals), x_vals, y_val, key._data)
+                except Exception as e:
+                    # donate_argnums=(1,): if the executable consumed
+                    # the donated state buffers before failing, they
+                    # are gone and continuing would silently train on
+                    # invalid state (ADVICE r2). Deleted-ness of the
+                    # inputs is the ground truth — pre-dispatch errors
+                    # (arg binding, tracing, compile) leave the
+                    # buffers alive and must NOT brick the trainer.
+                    consumed = any(
+                        getattr(v, "is_deleted", lambda: False)()
+                        for vals in self._state_vals() for v in vals)
+                    if not consumed:
+                        raise
+                    self._donation_poisoned = repr(e)
+                    raise MXNetError(
+                        "fused train step failed AFTER its optimizer "
+                        "state was donated; the trainer is invalid. "
+                        "Rebuild it and restore from a checkpoint. "
+                        f"Original error: {e!r}") from e
             else:
                 loss, grads, aux = self._fwd_bwd(param_vals, x_vals,
                                                  y_val, key._data)

@@ -311,3 +311,27 @@ def test_ssd_tiny_trains():
     det = nd._contrib_MultiBoxDetection(probs, loc_preds, anchors)
     n_anchors = anchors.shape[1]
     assert det.shape == (4, n_anchors, 6)
+
+
+def test_multibox_target_near_positives_get_ignore_label():
+    """When the mining quota exceeds the count of eligible negatives,
+    near-positives (IoU >= negative_mining_thresh but < overlap) must
+    land on ignore_label, never background (ADVICE r2)."""
+    anchors = nd.array(np.asarray(
+        [[[0.0, 0.0, 0.4, 0.4],      # IoU 1.0 -> positive
+          [0.15, 0.0, 0.55, 0.4],    # IoU ~0.45 -> near-positive
+          [0.2, 0.0, 0.6, 0.4],      # IoU ~0.33 -> near-positive
+          [0.6, 0.6, 1.0, 1.0]]],    # IoU 0 -> true negative
+        "float32"))
+    labels = nd.array(np.asarray(
+        [[[1.0, 0.0, 0.0, 0.4, 0.4]]], "float32"))
+    cls_preds = nd.zeros((1, 3, 4))
+    _, _, cls_t = nd._contrib_MultiBoxTarget(
+        anchors, labels, cls_preds, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.3)
+    got = cls_t.asnumpy()[0]
+    assert got[0] == 2.0             # the positive (class 1 -> 2)
+    assert got[3] == 0.0             # true negative kept as background
+    # quota (3) > eligible negatives (1): near-positives must still be
+    # ignored, not swept into the background label
+    assert got[1] == -1.0 and got[2] == -1.0

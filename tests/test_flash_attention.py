@@ -233,11 +233,14 @@ class TestKeyPaddingDispatch:
                                   s_k=128) is None
 
 
-def test_square_2d_mask_is_key_padding():
-    """The documented 2-D form (B, S_k) is per-batch key padding even
-    when B == S_k; GQA + legacy 2-D broadcast shapes don't crash."""
+def test_ambiguous_2d_mask_raises():
+    """A 2-D mask readable as BOTH (B, S_k) key padding and an
+    (S_q, S_k) attention matrix (B == S_q) raises instead of silently
+    picking a binding (ADVICE r2); the explicit 4-D forms still work."""
     import importlib
+    import pytest
     import jax.numpy as jnp
+    from mxnet_tpu.base import MXNetError
     from mxnet_tpu.ops.attention import dot_product_attention, _sdpa_xla
     rng = np.random.RandomState(30)
     B = S = 4
@@ -245,11 +248,21 @@ def test_square_2d_mask_is_key_padding():
     pad = jnp.asarray(
         (np.arange(S)[None] < np.asarray([1, 2, 3, 4])[:, None])
         .astype("f"))
-    got = dot_product_attention(q, q, q, pad, use_mask=True)
+    with pytest.raises(MXNetError, match="ambiguous 2-D"):
+        dot_product_attention(q, q, q, pad, use_mask=True)
+    # the explicit key-padding reshape is accepted and correct
+    got = dot_product_attention(q, q, q, pad.reshape(B, 1, 1, S),
+                                use_mask=True)
     want = _sdpa_xla(q, q, q, pad.reshape(B, 1, 1, S),
                      1 / np.sqrt(8), False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+    # non-square cross-attention ambiguity (B == S_q != S_k) raises too
+    q3 = jnp.asarray(rng.randn(2, 2, 2, 8).astype("f"))
+    kv3 = jnp.asarray(rng.randn(2, 4, 2, 8).astype("f"))
+    with pytest.raises(MXNetError, match="ambiguous 2-D"):
+        dot_product_attention(q3, kv3, kv3, jnp.ones((2, 4)),
+                              use_mask=True)
     # GQA + legacy (S_q, S_k) broadcast mask: no crash, matches oracle
     kv = jnp.asarray(rng.randn(2, 4, 1, 8).astype("f"))
     q2 = jnp.asarray(rng.randn(2, 4, 2, 8).astype("f"))

@@ -414,3 +414,24 @@ def test_deconvolution_target_shape_overrides_pad():
     with pytest.raises(Exception, match="adj"):
         nd.Deconvolution(x, w, kernel=(4, 4), stride=(2, 2),
                          adj=(2, 2), num_filter=5, no_bias=True)
+
+
+def test_deconvolution_target_shape_odd_total_pad():
+    """An odd inferred total pad is absorbed on the high side (the
+    reference folds it into adj) instead of raising (ADVICE r2)."""
+    rng = np.random.RandomState(7)
+    x = nd.array(rng.randn(1, 3, 4, 4).astype("float32"))
+    w = nd.array(rng.randn(3, 5, 3, 3).astype("float32"))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                           num_filter=5, target_shape=(8, 9),
+                           no_bias=True)
+    assert out.shape == (1, 5, 8, 9)
+    # oracle: the unpadded deconv (independently tested) cropped by
+    # (lo, hi) = (1, 0) on the odd axis — the reference's
+    # pad=(total+1)/2, adj=total%2 absorbs the remainder on the LOW side
+    full = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2),
+                            num_filter=5, no_bias=True)
+    assert full.shape == (1, 5, 9, 9)
+    np.testing.assert_allclose(out.asnumpy(),
+                               full.asnumpy()[:, :, 1:9, :],
+                               rtol=1e-5, atol=1e-6)

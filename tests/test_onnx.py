@@ -242,3 +242,30 @@ def test_import_clip_with_omitted_min(tmp_path):
     x = np.asarray([-5.0, 0.5, 2.0, -0.1], "float32")
     got = _eval(s2, arg2, aux2, x)
     np.testing.assert_allclose(got, np.minimum(x, 1.0))
+
+
+def test_deconv_adj_and_target_shape_roundtrip(tmp_path):
+    """Deconvolution adj -> ConvTranspose output_padding and
+    target_shape -> output_shape survive export AND import; dropping
+    either silently changes the output spatial shape (ADVICE r2)."""
+    x = sym.var("data")
+    d1 = sym.Deconvolution(x, sym.var("w1"), kernel=(3, 3),
+                           stride=(2, 2), adj=(1, 1), num_filter=4,
+                           no_bias=True, name="dc_adj")
+    path = _roundtrip(d1, (2, 3, 5, 5), tmp_path, fname="adj.onnx")
+    with open(path, "rb") as f:
+        pm = P.PModel(f.read())
+    (node,) = [n for n in pm.graph.nodes
+               if n.op_type == "ConvTranspose"]
+    assert tuple(node.attrs["output_padding"]) == (1, 1)
+
+    d2 = sym.Deconvolution(x, sym.var("w2"), kernel=(4, 4),
+                           stride=(2, 2), target_shape=(10, 10),
+                           num_filter=4, no_bias=True, name="dc_ts")
+    path = _roundtrip(d2, (2, 3, 5, 5), tmp_path, fname="ts.onnx")
+    with open(path, "rb") as f:
+        pm = P.PModel(f.read())
+    (node,) = [n for n in pm.graph.nodes
+               if n.op_type == "ConvTranspose"]
+    assert tuple(node.attrs["output_shape"]) == (10, 10)
+    assert "pads" not in node.attrs

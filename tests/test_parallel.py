@@ -311,3 +311,54 @@ def test_fuse_step_with_tensor_parallel_rule():
     lt, st = run(False)
     np.testing.assert_allclose(lf, lt, rtol=1e-5, atol=1e-6)
     assert "tp" in str(sf.spec), sf  # weights stayed TP-sharded
+
+
+def test_fuse_step_failure_poisons_donated_state():
+    """donate_argnums hands the optimizer state to the executable; if
+    the fused call fails mid-flight the trainer must refuse to keep
+    stepping on invalid buffers with a clear error (ADVICE r2)."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu import gluon
+
+    rng = np.random.RandomState(0)
+    X, Y = rng.randn(8, 6).astype("f4"), \
+        rng.randint(0, 3, 8).astype("f4")
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu", in_units=6),
+                gluon.nn.Dense(3, in_units=8))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh({"dp": 4})
+    dpt = parallel.DataParallelTrainer(
+        net, SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3}, mesh=mesh, fuse_step=True)
+    dpt.step(nd.array(X), nd.array(Y))   # healthy step builds the jit
+
+    # a PRE-dispatch failure leaves the donated buffers alive (the CPU
+    # backend never consumes them) and must NOT brick the trainer
+    def pre_dispatch_boom(*a, **k):
+        raise TypeError("bad argument binding")
+
+    real_step = dpt._full_step
+    dpt._full_step = pre_dispatch_boom
+    with pytest.raises(TypeError):
+        dpt.step(nd.array(X), nd.array(Y))
+    dpt._full_step = real_step
+    dpt.step(nd.array(X), nd.array(Y))   # still healthy
+
+    # a failure after the executable CONSUMED the donated state (we
+    # simulate consumption by deleting the buffers, which is what
+    # donation does on TPU) poisons the trainer
+    def post_dispatch_boom(params, states, *a, **k):
+        for vals in states:
+            for v in vals:
+                v.delete()
+        raise RuntimeError("transient device error")
+
+    dpt._full_step = post_dispatch_boom
+    with pytest.raises(MXNetError, match="donated"):
+        dpt.step(nd.array(X), nd.array(Y))
+    # the trainer is now invalid and says so — even though the next
+    # call would not itself fail
+    with pytest.raises(MXNetError, match="no longer valid"):
+        dpt.step(nd.array(X), nd.array(Y))
