@@ -312,8 +312,23 @@ class KVStoreTPUSync(KVStore):
             # portable spelling; on a pod slice XLA lowers it to ICI
             # collectives
             from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(merged._data)
-            merged = NDArray(gathered.sum(axis=0), ctx=merged.context)
+            if self._compression is not None:
+                # the compressed push is exactly {-t, 0, +t}: ship the
+                # ternary CODES as int8 so the wire actually carries
+                # 1/4 the bytes of fp32 (the whole point of
+                # gradient_compression.cc), and dequantize after
+                import jax.numpy as jnp
+                t = self._compression.threshold
+                codes = jnp.round(merged._data / t).astype(jnp.int8)
+                gathered = multihost_utils.process_allgather(codes)
+                merged = NDArray(
+                    (gathered.astype("float32") * t).sum(axis=0),
+                    ctx=merged.context)
+            else:
+                gathered = multihost_utils.process_allgather(
+                    merged._data)
+                merged = NDArray(gathered.sum(axis=0),
+                                 ctx=merged.context)
         return merged
 
     def _barrier(self):

@@ -20,7 +20,7 @@ from ..base import MXNetError
 from .mesh import current_mesh
 
 __all__ = ["psum", "pmean", "all_gather", "ppermute", "all_to_all",
-           "allreduce", "quantized_psum"]
+           "allreduce", "quantized_psum", "twobit_psum"]
 
 
 def psum(x, axis_name):
@@ -185,3 +185,50 @@ def quantized_psum(x, axis_name, *, bits=8):
 
     _qpsum.defvjp(_fwd, _bwd)
     return _qpsum(x)
+
+
+def twobit_psum(x, axis_name, *, threshold=0.5, residual=None):
+    """2-bit quantized allreduce with error feedback (inside shard_map).
+
+    The SPMD spelling of the reference's ``dist_sync`` gradient
+    compression (``src/kvstore/gradient_compression.cc``): each device
+    adds its carried ``residual``, snaps every element to
+    {-threshold, 0, +threshold}, and only CODES cross the wire (int8
+    lanes here; the reference packs 16 codes per int32).  Like
+    :func:`quantized_psum`, the exchange is two-phase so wire bytes
+    stay O(size) regardless of axis width — a naive all_gather of
+    full-size code tensors would move O(n·size) and LOSE to fp32 psum
+    beyond n≈8: (1) ``all_to_all`` the chunked ternary codes, (2) each
+    device sums its chunk (a sum of n ternary codes fits int8 exactly
+    while n ≤ 127) and int8-``all_gather``s the partial back.  Wire ≈
+    2·size·1 byte vs a ring fp32 psum's ≈ 2·size·4 — the real 4x.
+
+    Returns ``(summed, new_residual)`` — the caller keeps the residual
+    for the next step, which is what makes the quantization unbiased
+    over time.
+    """
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    n = lax.axis_size(axis_name)
+    g = x if residual is None else x + residual
+    codes = jnp.where(g >= threshold, 1,
+                      jnp.where(g <= -threshold, -1, 0)).astype(jnp.int8)
+    flat = codes.reshape(-1)
+    padded = flat.size + ((-flat.size) % n)
+    if padded != flat.size:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded - flat.size,), jnp.int8)])
+    chunks = flat.reshape(n, -1)                            # (n, c)
+    # phase 1: int8 ternary codes to their owner device
+    cx = lax.all_to_all(chunks, axis_name, 0, 0, tiled=True)
+    # partial sums are in [-n, n]: exact in int8 up to n == 127
+    part_dtype = jnp.int8 if n <= 127 else jnp.int32
+    part = cx.astype(jnp.int32).sum(axis=0).astype(part_dtype)
+    # phase 2: narrow partial sums gathered back
+    allp = lax.all_gather(part, axis_name, axis=0)          # (n, c)
+    summed = (allp.astype(jnp.float32).reshape(-1)[:x.size]
+              * threshold).reshape(x.shape)
+    new_residual = g - codes.astype(g.dtype) * jnp.asarray(
+        threshold, g.dtype)
+    return summed.astype(x.dtype), new_residual
