@@ -20,7 +20,8 @@ def _run_launcher(n, worker, timeout=240):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     # workers pin their own platform; scrub the test harness's flags so
-    # each worker gets ONE local cpu device (true multi-process shape)
+    # each worker OWNS its local device count (dist_worker*: one device;
+    # dist_worker_mesh: four — the 2-proc x 4-dev pod shape)
     env.pop("XLA_FLAGS", None)
     return subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
@@ -68,3 +69,16 @@ def test_worker_crash_is_detected_not_hung():
     assert res.stdout.count("ROUND1_OK") == 2
     assert "SURVIVOR_DETECTED_FAILURE" in res.stdout
     assert "SURVIVOR_NO_ERROR" not in res.stdout
+
+
+def test_two_process_four_device_mesh():
+    """2 procs x 4 virtual devices: ONE mesh composing the
+    cross-process (DCN-analog) and in-process (ICI-analog) axes;
+    collectives reduce across both boundaries (VERDICT r2 #6)."""
+    res = _run_launcher(2, "dist_worker_mesh.py", timeout=300)
+    sys.stderr.write(res.stdout[-2000:] + res.stderr[-2000:])
+    assert res.returncode == 0
+    for r in range(2):
+        assert f"PSUM_BOTH_OK rank={r}" in res.stdout
+        assert f"PSUM_ICI_OK rank={r}" in res.stdout
+        assert f"MESH_OK rank={r}/2" in res.stdout
