@@ -229,13 +229,25 @@ class Block:
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current"):
         loaded = nd.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError(
+                f"file {filename!r} holds an unnamed NDArray list, not "
+                "named parameters")
+        # reference Module checkpoints prefix keys with arg:/aux: —
+        # upstream load_parameters strips these, so we must too
+        loaded = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                  for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
-        # legacy fallback: file saved with full param names
-        if not any("." in k for k in loaded.keys()) and \
-                any("." in k for k in params.keys()):
-            by_name = {p.name: p for p in self.collect_params().values()}
+        # legacy fallback: file saved with FULL param names (reference
+        # Module checkpoints, nd.save of collect_params()) — detect by
+        # a key that resolves as a param name but not as an attribute
+        # path, or by the dotted-path shape heuristic
+        by_name = {p.name: p for p in self.collect_params().values()}
+        if (any(k in by_name and k not in params for k in loaded)
+                or (not any("." in k for k in loaded.keys())
+                    and any("." in k for k in params.keys()))):
             for name, value in loaded.items():
                 if name in by_name:
                     by_name[name]._load_init(value, ctx,

@@ -756,11 +756,12 @@ def waitall():
 
 # ---------------------------------------------------------------------------
 # serialization — API parity with mx.nd.save/load (reference ndarray.cc
-# Save/Load).  NOTE: only the API surface is compatible, NOT the file
-# format — this is a native MXTPU001 layout (magic, count, names, then
-# per array: dtype/shape header + raw little-endian bytes), not the
-# reference's dmlc::Stream NDArray serialization; reference-written
-# .params files cannot be loaded here and vice versa.
+# Save/Load).  The native WRITER uses the self-described MXTPU001
+# layout (magic, count, names, then per array: dtype/shape header + raw
+# little-endian bytes); the LOADER additionally falls back to
+# legacy_io for reference-written dmlc::Stream .params files, so
+# upstream checkpoints load read-only (files written here are not
+# readable by the reference).
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXTPU001"
@@ -793,6 +794,16 @@ def save(fname: str, data):
 def _load_stream(f, what: str):
     magic = f.read(8)
     if magic != _MAGIC:
+        from . import legacy_io
+        if legacy_io.looks_legacy(magic):
+            # reference-written .params / nd.save checkpoint
+            # (dmlc::Stream layout) — read-only interop
+            f.seek(0)
+            names, arrays = legacy_io.load_legacy(f)
+            nds = [array(a, dtype=a.dtype) for a in arrays]
+            if names:
+                return dict(zip(names, nds))
+            return nds
         raise MXNetError(f"{what}: not an NDArray file")
     n = struct.unpack("<q", f.read(8))[0]
     named = {}
