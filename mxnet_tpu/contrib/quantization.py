@@ -17,13 +17,27 @@ from .. import ndarray as nd
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["quantize_array", "dequantize_array", "calib_minmax",
-           "calib_entropy", "quantize_model", "QuantizedDense",
-           "QuantizedConv"]
+           "calib_entropy", "quantize_model", "quantize_net",
+           "QuantizedDense", "QuantizedConv", "QuantizedNet"]
 
 
-def quantize_array(arr: NDArray, min_range=None, max_range=None):
-    """Symmetric int8 quantization → (q_int8, scale)."""
+def quantize_array(arr: NDArray, min_range=None, max_range=None,
+                   axis=None):
+    """Symmetric int8 quantization → (q_int8, scale).
+
+    ``axis``: per-channel mode — one scale per index of that axis (the
+    reference's channel-wise weight quantization; activations stay
+    per-tensor).  ``scale`` is then an ndarray of shape (C,)."""
     a = arr.asnumpy()
+    if axis is not None:
+        red = tuple(i for i in range(a.ndim) if i != axis)
+        amax = np.max(np.abs(a), axis=red)
+        scale = np.where(amax > 0, amax / 127.0, 1.0)
+        shape = [1] * a.ndim
+        shape[axis] = -1
+        q = np.clip(np.round(a / scale.reshape(shape)), -127,
+                    127).astype(np.int8)
+        return nd.array(q, dtype="int8"), scale.astype(np.float32)
     amax = float(np.max(np.abs(a))) if max_range is None else \
         max(abs(min_range), abs(max_range))
     scale = amax / 127.0 if amax > 0 else 1.0
@@ -54,31 +68,62 @@ def calib_entropy(arrays, num_bins=2048, num_quantized_bins=255):
     amax = float(vals.max()) if vals.size else 1.0
     if amax == 0:
         return 0.0, 0.0
+    # exact zeros (relu's dead mass, often >50% of activations)
+    # quantize exactly at ANY threshold, so they carry no information
+    # about the right scale — but left in, their bin dominates the KL
+    # and makes clipping the live tail look artificially cheap
+    vals = vals[vals > 0]
     hist, edges = np.histogram(vals, bins=num_bins, range=(0, amax))
     best_kl, best_t = np.inf, amax
     for i in range(num_quantized_bins, num_bins + 1, 16):
         t = edges[i]
-        p = hist[:i].astype(np.float64).copy()
-        p[-1] += hist[i:].sum()  # clip outliers into last bin
+        # p: the reference distribution WITH the clipped outlier mass
+        # folded into its last bin; q: the int8-quantized
+        # reconstruction built from the UNCLIPPED slice.  Building q
+        # from p instead makes q == p at the smallest threshold
+        # (KL = 0), so the tightest range always won and activations
+        # were saturated to garbage — the clipped mass must COST
+        # divergence, exactly as in the reference's
+        # _get_optimal_threshold.
+        raw = hist[:i].astype(np.float64)
+        p = raw.copy()
+        p[-1] += hist[i:].sum()
         if p.sum() == 0:
             continue
-        # quantize p into num_quantized_bins then expand back
-        factor = i / num_quantized_bins
+        nm = i // num_quantized_bins
         q = np.zeros(i)
         for j in range(num_quantized_bins):
-            start = int(j * factor)
-            end = int((j + 1) * factor) or start + 1
-            mass = p[start:end].sum()
-            nz = (p[start:end] > 0).sum()
+            start = j * nm
+            stop = i if j == num_quantized_bins - 1 else (j + 1) * nm
+            seg = raw[start:stop]
+            nz = (seg > 0).sum()
             if nz:
-                q[start:end] = np.where(p[start:end] > 0, mass / nz, 0)
-        pn = p / p.sum()
-        qn = q / q.sum() if q.sum() else q
-        mask = (pn > 0) & (qn > 0)
-        kl = float(np.sum(pn[mask] * np.log(pn[mask] / qn[mask])))
+                q[start:stop] = np.where(seg > 0, seg.sum() / nz, 0)
+        if q.sum() == 0:
+            continue
+        pn = _smooth(p / p.sum())
+        qn = _smooth(q / q.sum())
+        kl = float(np.sum(pn * np.log(pn / qn)))
         if kl < best_kl:
             best_kl, best_t = kl, t
     return -best_t, best_t
+
+
+def _smooth(d, eps=1e-4):
+    """Allocate tiny mass to empty bins (reference
+    ``_smooth_distribution``) so support mismatches cost finite KL."""
+    is_zero = d == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = d.size - n_zero
+    if n_zero == 0 or n_nonzero == 0:
+        return d
+    out = d.copy()
+    out[is_zero] = eps
+    # floor at eps so bins with less mass than the adjustment cannot
+    # go negative (log of a negative poisons the whole KL sum)
+    out[~is_zero] = np.maximum(out[~is_zero] - eps * n_zero / n_nonzero,
+                               eps)
+    return out
 
 
 class QuantizedDense:
@@ -87,12 +132,22 @@ class QuantizedDense:
 
     def __init__(self, dense, calib_range=None):
         w = dense.weight.data()
-        self.wq, self.w_scale = quantize_array(w)
+        # per-output-channel weight scales (reference channel-wise
+        # quantization): one bad row cannot widen every row's step
+        self.wq, self.w_scale = quantize_array(w, axis=0)
+        # device-resident once; rebuilding per forward would pay a
+        # host->device hop on every call
+        self._w_scale_nd = nd.array(self.w_scale.reshape(1, -1))
         self.bias = dense.bias.data() if dense.bias is not None else None
         self._act = getattr(dense, "act", None)  # fused activation
+        self._flatten = getattr(dense, "_flatten", True)
         self._calib = calib_range
 
     def __call__(self, x):
+        # replicate Dense's flatten contract (a trailing conv feature
+        # map arrives as (N, C, 1, 1) in zoo CNNs)
+        if self._flatten and x.ndim > 2:
+            x = x.reshape((x.shape[0], -1))
         if self._calib is not None:
             lo, hi = self._calib
             xq, x_scale = quantize_array(x, lo, hi)
@@ -101,7 +156,7 @@ class QuantizedDense:
         # int8 matmul on the MXU; accumulate in int32 then rescale
         out = nd.dot(xq.astype("int32"), self.wq.astype("int32"),
                      transpose_b=True).astype("float32")
-        out = out * (self.w_scale * x_scale)
+        out = out * self._w_scale_nd * float(x_scale)
         if self.bias is not None:
             out = out + self.bias
         if self._act is not None:
@@ -118,10 +173,26 @@ class QuantizedConv:
     rescale by (w_scale * x_scale) restores float32.
     """
 
-    def __init__(self, conv, calib_range=None):
-        w = conv.weight.data()
-        self.wq, self.w_scale = quantize_array(w)
-        self.bias = conv.bias.data() if conv.bias is not None else None
+    def __init__(self, conv, calib_range=None, fold_bn=None):
+        w = conv.weight.data().asnumpy()
+        bias = conv.bias.data().asnumpy() if conv.bias is not None \
+            else None
+        if fold_bn is not None:
+            # fold the FOLLOWING BatchNorm's affine into the conv
+            # weights before quantizing (the reference's fuse-bn pass):
+            # quantizing the raw conv and applying fp32 BN after lets
+            # high-gain channels amplify quantization noise
+            g = fold_bn.gamma.data().asnumpy()
+            b = fold_bn.beta.data().asnumpy()
+            mu = fold_bn.running_mean.data().asnumpy()
+            var = fold_bn.running_var.data().asnumpy()
+            eps = fold_bn._kwargs.get("eps", 1e-5)
+            s = g / np.sqrt(var + eps)
+            w = w * s.reshape(-1, 1, 1, 1)
+            bias = ((bias if bias is not None else 0.0) - mu) * s + b
+        self.wq, self.w_scale = quantize_array(nd.array(w), axis=0)
+        self._w_scale_nd = nd.array(self.w_scale.reshape(1, -1, 1, 1))
+        self.bias = nd.array(bias) if bias is not None else None
         self._act = getattr(conv, "act", None)  # fused activation
         self._kwargs = {k: v for k, v in conv._kwargs.items()
                         if k != "no_bias"}
@@ -136,7 +207,7 @@ class QuantizedConv:
         out = nd.Convolution(xq.astype("int32"),
                              self.wq.astype("int32"),
                              no_bias=True, **self._kwargs)
-        out = out.astype("float32") * (self.w_scale * x_scale)
+        out = out.astype("float32") * self._w_scale_nd * float(x_scale)
         if self.bias is not None:
             out = out + self.bias.reshape((1, -1, 1, 1))
         if self._act is not None:
@@ -156,6 +227,14 @@ def quantize_model(net, calib_data=None, calib_mode="naive",
     from ..gluon import nn as gnn
     if quantized_dtype != "int8":
         raise MXNetError("only int8 is supported on TPU")
+    # a hybridized net dispatches through its cached traced graph and
+    # NEVER calls children's forward — calibration hooks and the
+    # quantized-layer swap would both be silently bypassed
+    if any(getattr(b, "_active", False) for b in _walk(net)):
+        raise MXNetError(
+            "quantize_model requires an un-hybridized net (the cached "
+            "graph bypasses the int8 layer swap); call "
+            "net.hybridize(False) first")
     # collect activation stats per quantizable layer input
     targets = [b for b in _walk(net)
                if isinstance(b, (gnn.Dense, gnn.Conv2D))]
@@ -177,17 +256,94 @@ def quantize_model(net, calib_data=None, calib_mode="naive",
             h.detach()
         for d in targets:
             xs = taps[id(d)]
+            if not xs:
+                # a layer the calibration batches never reached falls
+                # back to dynamic per-call ranges instead of poisoning
+                # its output with an (inf, -inf) range
+                continue
             calib[id(d)] = (calib_minmax(xs) if calib_mode == "naive"
                             else calib_entropy(xs))
+    pairs = _conv_bn_pairs(net)
     layer_map = {}
     for d in targets:
-        cls = QuantizedDense if isinstance(d, gnn.Dense) else \
-            QuantizedConv
-        layer_map[d] = cls(d, calib.get(id(d)))
+        if isinstance(d, gnn.Dense):
+            layer_map[d] = QuantizedDense(d, calib.get(id(d)))
+        else:
+            # folding reorders a FUSED activation (act would run after
+            # the folded affine instead of before the BN) — skip it
+            bn = pairs.get(d) if getattr(d, "act", None) is None \
+                else None
+            layer_map[d] = QuantizedConv(d, calib.get(id(d)),
+                                         fold_bn=bn)
+            if bn is not None:
+                # the BN affine is folded into the conv: it must run
+                # as identity on the quantized path
+                layer_map[bn] = _bn_identity
     return layer_map
+
+
+def _bn_identity(x):
+    return x
+
+
+def _conv_bn_pairs(net):
+    """Conv2D blocks immediately followed by a BatchNorm sibling,
+    restricted to Sequential containers — only there does registration
+    order GUARANTEE dataflow order (an arbitrary block's forward may
+    wire siblings any way it likes, and mis-folding is silent)."""
+    from ..gluon import nn as gnn
+    seq_types = tuple(
+        t for t in (getattr(gnn, "HybridSequential", None),
+                    getattr(gnn, "Sequential", None)) if t)
+    pairs = {}
+    for block in _walk(net):
+        if not isinstance(block, seq_types):
+            continue
+        kids = list(block._children.values())
+        for a, b in zip(kids, kids[1:]):
+            if isinstance(a, gnn.Conv2D) and isinstance(b, gnn.BatchNorm):
+                pairs[a] = b
+    return pairs
 
 
 def _walk(block):
     yield block
     for child in block._children.values():
         yield from _walk(child)
+
+
+class QuantizedNet:
+    """Runnable int8 inference wrapper: while called, each quantized
+    layer's ``forward`` is swapped for its int8 callable (the un-
+    hybridized call path dispatches through ``self.forward``), so the
+    ORIGINAL net runs end-to-end with int8 Dense/Conv compute."""
+
+    def __init__(self, net, layer_map):
+        self.net = net
+        self.layer_map = layer_map
+
+    def __call__(self, x):
+        saved = {}
+        try:
+            for blk, q in self.layer_map.items():
+                saved[blk] = blk.__dict__.get("forward")
+                blk.forward = q
+            return self.net(x)
+        finally:
+            for blk, prev in saved.items():
+                if prev is None:
+                    blk.__dict__.pop("forward", None)
+                else:
+                    blk.forward = prev
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 num_calib_batches=None, quantized_dtype="int8"):
+    """Calibrate + quantize a Gluon net and return a runnable
+    :class:`QuantizedNet` (the end-to-end surface of the reference's
+    ``quantize_model`` flow: calibrate → swap int8 layers → infer)."""
+    layer_map = quantize_model(net, calib_data=calib_data,
+                               calib_mode=calib_mode,
+                               num_calib_batches=num_calib_batches,
+                               quantized_dtype=quantized_dtype)
+    return QuantizedNet(net, layer_map)

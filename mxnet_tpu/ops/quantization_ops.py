@@ -1,0 +1,68 @@
+"""INT8 quantization operators (reference ``src/operator/quantization/``
+``quantize-inl.h`` / ``dequantize-inl.h`` / ``requantize-inl.h`` —
+SURVEY.md §2.2 quantization row).
+
+Reference semantics, TPU spelling: symmetric int8 against the signed
+range; the (min, max) companions travel as 1-element float arrays, the
+reference's layout for threading calibration through a graph.  XLA maps
+int8 matmul/conv operands onto native MXU int8 ops, so quantize →
+int8-compute → requantize chains compile to the hardware path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_INT8_MAX = 127.0
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _real_range(min_r, max_r):
+    return jnp.maximum(jnp.max(jnp.abs(min_r)), jnp.max(jnp.abs(max_r)))
+
+
+@register("_contrib_quantize", num_inputs=3, num_outputs=3)
+def quantize(data, min_range, max_range, *, out_type="int8"):
+    """fp32 → (int8, min_out, max_out); symmetric against
+    max(|min_range|, |max_range|)."""
+    if out_type != "int8":
+        raise ValueError("only int8 quantization is supported on TPU")
+    r = _real_range(min_range, max_range)
+    scale = jnp.where(r > 0, _INT8_MAX / jnp.maximum(r, 1e-30), 1.0)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, -r.reshape(1), r.reshape(1)
+
+
+@register("_contrib_dequantize", num_inputs=3)
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    if out_type != "float32":
+        raise ValueError("only float32 dequantization is supported")
+    r = _real_range(min_range, max_range)
+    return data.astype(jnp.float32) * (r / _INT8_MAX)
+
+
+@register("_contrib_requantize", num_inputs=3, num_outputs=3)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 (reference requantize-inl.h).
+
+    ``data`` is the int32 result of an int8×int8 matmul/conv whose real
+    value is ``data * real_range/(2^31-1)``.  With a calibrated range
+    the rescale is static (the fast path the reference's calibration
+    exists for); otherwise the range is computed from the data.
+    Returns (int8, min_out, max_out).
+    """
+    in_r = _real_range(min_range, max_range)
+    in_scale = in_r / _INT32_MAX
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        out_r = jnp.maximum(jnp.abs(jnp.float32(min_calib_range)),
+                            jnp.abs(jnp.float32(max_calib_range)))
+    else:
+        out_r = jnp.max(jnp.abs(real))
+    out_r = jnp.maximum(out_r, 1e-30)
+    q = jnp.clip(jnp.round(real * (_INT8_MAX / out_r)),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, -out_r.reshape(1), out_r.reshape(1)
