@@ -126,6 +126,23 @@ def _smooth(d, eps=1e-4):
     return out
 
 
+def _quantize_act(x, calib):
+    """Per-tensor activation quantization, ON DEVICE (asnumpy here
+    would force a host round-trip per layer per forward, defeating the
+    async engine).  Returns (int8 NDArray, scale as float or 0-d
+    NDArray)."""
+    if calib is not None:
+        lo, hi = calib
+        r = max(abs(float(lo)), abs(float(hi)))
+        scale = r / 127.0 if r > 0 else 1.0
+        q = nd.clip(nd.round(x / scale), -127, 127).astype("int8")
+        return q, scale
+    r = nd.max(nd.abs(x))                      # dynamic: stays async
+    scale = nd.maximum(r, 1e-30) / 127.0
+    q = nd.clip(nd.round(x / scale), -127, 127).astype("int8")
+    return q, scale
+
+
 class QuantizedDense:
     """Callable wrapping a Dense layer with int8 weights + per-forward
     input quantization (inference only)."""
@@ -148,15 +165,11 @@ class QuantizedDense:
         # map arrives as (N, C, 1, 1) in zoo CNNs)
         if self._flatten and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
-        if self._calib is not None:
-            lo, hi = self._calib
-            xq, x_scale = quantize_array(x, lo, hi)
-        else:
-            xq, x_scale = quantize_array(x)
+        xq, x_scale = _quantize_act(x, self._calib)
         # int8 matmul on the MXU; accumulate in int32 then rescale
         out = nd.dot(xq.astype("int32"), self.wq.astype("int32"),
                      transpose_b=True).astype("float32")
-        out = out * self._w_scale_nd * float(x_scale)
+        out = out * self._w_scale_nd * x_scale
         if self.bias is not None:
             out = out + self.bias
         if self._act is not None:
@@ -183,6 +196,10 @@ class QuantizedConv:
             # quantizing the raw conv and applying fp32 BN after lets
             # high-gain channels amplify quantization noise
             g = fold_bn.gamma.data().asnumpy()
+            if fold_bn._kwargs.get("fix_gamma"):
+                # the live BN op substitutes ones when scale=False —
+                # the stored gamma values must NOT leak into the fold
+                g = np.ones_like(g)
             b = fold_bn.beta.data().asnumpy()
             mu = fold_bn.running_mean.data().asnumpy()
             var = fold_bn.running_var.data().asnumpy()
@@ -199,15 +216,11 @@ class QuantizedConv:
         self._calib = calib_range
 
     def __call__(self, x):
-        if self._calib is not None:
-            lo, hi = self._calib
-            xq, x_scale = quantize_array(x, lo, hi)
-        else:
-            xq, x_scale = quantize_array(x)
+        xq, x_scale = _quantize_act(x, self._calib)
         out = nd.Convolution(xq.astype("int32"),
                              self.wq.astype("int32"),
                              no_bias=True, **self._kwargs)
-        out = out.astype("float32") * self._w_scale_nd * float(x_scale)
+        out = out.astype("float32") * self._w_scale_nd * x_scale
         if self.bias is not None:
             out = out + self.bias.reshape((1, -1, 1, 1))
         if self._act is not None:
@@ -301,7 +314,10 @@ def _conv_bn_pairs(net):
             continue
         kids = list(block._children.values())
         for a, b in zip(kids, kids[1:]):
-            if isinstance(a, gnn.Conv2D) and isinstance(b, gnn.BatchNorm):
+            # NCHW convs fold only channel-axis (axis=1) BatchNorms
+            if isinstance(a, gnn.Conv2D) and \
+                    isinstance(b, gnn.BatchNorm) and \
+                    getattr(b, "_axis", 1) == 1:
                 pairs[a] = b
     return pairs
 

@@ -34,10 +34,19 @@ _jit_cache: Dict[Tuple, Callable] = {}
 _live = weakref.WeakSet()
 
 
+_NAIVE = None
+
+
 def is_naive() -> bool:
-    return (os.environ.get("MXTPU_ENGINE_TYPE",
-                           os.environ.get("MXNET_ENGINE_TYPE", ""))
-            == "NaiveEngine")
+    # cached: this sits on the per-op hot path, and two environ reads
+    # per dispatch cost ~6 us; the engine type is a process-lifetime
+    # choice (set _NAIVE = None to re-read in tests)
+    global _NAIVE
+    if _NAIVE is None:
+        _NAIVE = (os.environ.get("MXTPU_ENGINE_TYPE",
+                                 os.environ.get("MXNET_ENGINE_TYPE", ""))
+                  == "NaiveEngine")
+    return _NAIVE
 
 
 def _freeze(v: Any):
@@ -55,8 +64,20 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict) -> Callable:
     engine push: jax.jit re-traces per input shape/dtype/device, which plays
     the role of the per-(shape,dtype,ctx) plan cache in CachedOp.
     """
-    key = (name, _freeze(attrs))
-    fn = _jit_cache.get(key)
+    # attr-less ops (the bulk of elemwise traffic) skip the freeze/sort;
+    # hashable attr values skip the recursive _freeze (insertion order
+    # is stable per call site, so at worst a reordered-kwargs caller
+    # duplicates a cache entry for the same compiled fn)
+    if not attrs:
+        key = name
+        fn = _jit_cache.get(key)
+    else:
+        try:
+            key = (name, tuple(attrs.items()))
+            fn = _jit_cache.get(key)
+        except TypeError:
+            key = (name, _freeze(attrs))
+            fn = _jit_cache.get(key)
     if fn is None:
         with _lock:
             fn = _jit_cache.get(key)

@@ -577,6 +577,10 @@ def _coerce(x, like: NDArray) -> NDArray:
 # external NDArrays a body closure touches — see ndarray/contrib.py)
 _capture_scope = None
 
+# autograd resolved once (a per-call `from .. import` costs ~2 us on
+# the dispatch hot path); deferred because of the import cycle
+_autograd = None
+
 
 def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
            ctx: Optional[Context] = None, **kwargs):
@@ -585,7 +589,11 @@ def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
     Python → compile-cache lookup → PJRT async execute → NDArray handle(s)
     returned immediately; sync happens at wait_to_read/asnumpy.
     """
-    from .. import autograd
+    global _autograd
+    autograd = _autograd
+    if autograd is None:
+        from .. import autograd as _ag
+        autograd = _autograd = _ag
 
     if _capture_scope is not None:
         _capture_scope.observe(inputs)
@@ -629,7 +637,6 @@ def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
                 scalar_vals.append(np.asarray(v, dtype=dt))
 
     all_arrays = arrays + scalar_vals
-    jax = _jax()
 
     if autograd.is_recording():
         if out is not None:
@@ -640,7 +647,7 @@ def invoke(op: OpDef, inputs: Sequence[NDArray], out=None,
         return _wrap_outputs(op, outputs_data, ctx, node)
 
     if op.wrap_ctx or not inputs:
-        with jax.default_device(ctx.device):
+        with _jax().default_device(ctx.device):
             outputs_data = engine.invoke_compiled(op.name, op.fcompute,
                                                   kwargs, *all_arrays)
     else:

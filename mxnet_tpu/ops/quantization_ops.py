@@ -54,6 +54,11 @@ def requantize(data, min_range, max_range, *, min_calib_range=None,
     exists for); otherwise the range is computed from the data.
     Returns (int8, min_out, max_out).
     """
+    if (min_calib_range is None) != (max_calib_range is None):
+        raise ValueError(
+            "requantize: min_calib_range and max_calib_range must be "
+            "given together (a half-supplied pair would silently fall "
+            "back to dynamic ranges)")
     in_r = _real_range(min_range, max_range)
     in_scale = in_r / _INT32_MAX
     real = data.astype(jnp.float32) * in_scale

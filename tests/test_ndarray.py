@@ -382,3 +382,33 @@ class TestLegacyDmlcLoad:
                                    ("arg:fc0_bias", b)]))
         net.load_parameters(p)
         np.testing.assert_array_equal(net.weight.data().asnumpy(), w)
+
+
+def test_cache_hit_dispatch_does_no_tracing():
+    """VERDICT r2 #5: the imperative cache-hit path must not re-trace
+    (tracing runs the op's Python body; a compiled hit must not)."""
+    from mxnet_tpu.ops.registry import register, get_op, _REGISTRY
+    from mxnet_tpu.ndarray.ndarray import invoke
+
+    name = "_test_trace_probe"
+    traces = []
+    if name not in _REGISTRY:
+        @register(name)
+        def _probe(x, *, k=1.0):
+            traces.append(1)
+            return x + k
+    traces.clear()
+
+    a = nd.ones((4, 4))
+    op = get_op(name)
+    r1 = invoke(op, [a], k=2.0)
+    n_after_first = len(traces)
+    assert n_after_first >= 1          # first call traced
+    for _ in range(5):
+        r = invoke(op, [a], k=2.0)     # same shape+attrs: pure hits
+    assert len(traces) == n_after_first, "cache hit re-traced"
+    np.testing.assert_allclose(r.asnumpy(), 3.0)
+    # different attrs compile a NEW entry (not silently reusing k=2)
+    r2 = invoke(op, [a], k=5.0)
+    assert len(traces) == n_after_first + 1
+    np.testing.assert_allclose(r2.asnumpy(), 6.0)
