@@ -27,6 +27,7 @@ from .collectives import quantized_psum
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply
+from .planning import llama_param_rule, sharding_plan
 
 
 def moe_param_rule(ep_axis="ep", inner=None):
@@ -47,4 +48,5 @@ def moe_param_rule(ep_axis="ep", inner=None):
 __all__ = ["moe_param_rule", "pipeline_apply",
            "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
            "collectives", "DataParallelTrainer", "ring_attention",
-           "ring_attention_sharded"]
+           "ring_attention_sharded", "llama_param_rule",
+           "sharding_plan"]

@@ -203,7 +203,11 @@ def test_rope_offset_dynamic_no_recompile():
     """Decode loops step offset per token; offset is a dynamic scalar
     attr so every step reuses one compiled executable."""
     from mxnet_tpu.engine import _jit_cache
-    before = {k for k in _jit_cache if k[0] == "rope"}
+    def is_rope(k):
+        # attr-less ops key by bare name; attr-ful ones by (name, ...)
+        return k == "rope" or (isinstance(k, tuple) and k[0] == "rope")
+
+    before = {k for k in _jit_cache if is_rope(k)}
     rng = np.random.RandomState(3)
     x = nd.array(rng.randn(1, 4, 2, 8).astype("float32"))
     outs = [nd.rope(x, offset=i).asnumpy() for i in range(4)]
@@ -219,7 +223,10 @@ def test_rope_offset_dynamic_no_recompile():
     np.testing.assert_allclose(nd.rope(x, offset=4).asnumpy(),
                                full[:, 4:], rtol=1e-5, atol=1e-6)
     rope_entries = [k for k in _jit_cache
-                    if k[0] == "rope" and k not in before]
+                    if is_rope(k) and k not in before]
+    # the guard must not be vacuous: rope WAS invoked, so an entry for
+    # it exists somewhere in the cache
+    assert any(is_rope(k) for k in _jit_cache)
     assert len(rope_entries) <= 1, rope_entries
 
 
@@ -352,3 +359,59 @@ def test_generate_no_per_step_compiles():
         net.decode_step(toks[:, i:i + 1], caches, i)
     grew = len(_jit_cache) - before
     assert grew == 0, f"decode compiled {grew} programs across offsets"
+
+
+class TestLlama8BShardingPlan:
+    """VERDICT r2 #8: the 8B config's tp/pp layout is validated by
+    exact shape math on the 8-device mesh — no 16 GB of weights needed
+    to learn whether they fit a v5e."""
+
+    def test_8b_plan_fits_v5e_hbm(self):
+        from mxnet_tpu import parallel
+        net = LlamaForCausalLM(llama3_8b(), tie_embeddings=False)
+        mesh = parallel.make_mesh({"tp": 4, "pp": 2})
+        plan = parallel.sharding_plan(
+            net, mesh, parallel.llama_param_rule("tp"),
+            dtype_bytes=2, pp_axis="pp")
+        # Llama-3-8B: 8.03B params (7.50B model + 0.53B untied head)
+        assert abs(plan["total_params"] / 1e9 - 8.03) < 0.05
+        assert plan["fits_hbm"], plan
+        # bf16 weights: 16.06 GB over 8 devices ~ 1.9 GiB each, and
+        # the two pipeline stages must come out balanced
+        assert plan["max_device_bytes"] < 2.2 * 2**30
+        s0, s1 = plan["per_stage_bytes"]
+        assert abs(s0 - s1) / max(s0, s1) < 0.15
+        # training plan: weights + grads (bf16) + adam m/v (fp32)
+        # = 2 + 2 + 8 bytes/param -> still inside HBM per device
+        train_bytes = plan["max_device_bytes"] * 6
+        assert train_bytes < 16 * 2**30, train_bytes / 2**30
+
+    def test_llama_rule_trains_tiny_tp(self):
+        """The SAME rule drives a real TP trainer step at tiny scale:
+        losses finite, weights stay sharded across the step."""
+        from mxnet_tpu import parallel
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = LlamaForCausalLM(llama_tiny())
+        net.initialize(mx.init.Xavier())
+        mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+        sce = SoftmaxCrossEntropyLoss()
+
+        def lm_loss(logits, toks):
+            v = logits.shape[-1]
+            return sce(logits[:, :-1].reshape((-1, v)),
+                       toks[:, 1:].reshape((-1,))).mean()
+
+        dpt = parallel.DataParallelTrainer(
+            net, lm_loss, "adam", {"learning_rate": 1e-3}, mesh=mesh,
+            param_sharding=parallel.llama_param_rule("tp"))
+        toks = nd.array(
+            np.random.randint(0, 32, (4, 8)).astype("f"))
+        l0 = float(dpt.step(toks, toks).asnumpy())
+        l1 = float(dpt.step(toks, toks).asnumpy())
+        assert np.isfinite(l0) and np.isfinite(l1)
+        w = [p for n, p in net.collect_params().items()
+             if n.endswith("_attn_q_weight")][0].data()
+        assert "tp" in str(w._data.sharding.spec), w._data.sharding
