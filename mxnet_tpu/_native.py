@@ -25,7 +25,10 @@ __all__ = ["lib", "available", "NativeEngine", "NativeStorage",
            "NativeRecordIO", "build"]
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu.so")
+_IMG_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib",
+                             "libmxtpu_image.so")
 lib = None
+_img_lib = None      # False = tried and failed; loaded CDLL otherwise
 _build_attempted = False
 
 
@@ -35,14 +38,29 @@ def _src_dir():
 
 
 def _stale() -> bool:
-    """True when the .so is missing or older than any src/*.cc."""
+    """True when a built lib is missing or older than ITS sources
+    (image_aug.cc feeds only libmxtpu_image.so — comparing it against
+    libmxtpu.so would re-run make forever)."""
     if not os.path.exists(_LIB_PATH):
         return True
     src = _src_dir()
     try:
         lib_m = os.path.getmtime(_LIB_PATH)
-        return any(os.path.getmtime(os.path.join(src, f)) > lib_m
-                   for f in os.listdir(src) if f.endswith(".cc"))
+        for f in os.listdir(src):
+            if not f.endswith(".cc"):
+                continue
+            if f == "image_aug.cc":
+                # missing image lib counts as stale: OpenCV may have
+                # appeared since the last build (make skips the target
+                # harmlessly when the headers are still absent)
+                if not os.path.exists(_IMG_LIB_PATH) or \
+                        os.path.getmtime(os.path.join(src, f)) > \
+                        os.path.getmtime(_IMG_LIB_PATH):
+                    return True
+                continue
+            if os.path.getmtime(os.path.join(src, f)) > lib_m:
+                return True
+        return False
     except OSError:
         return False
 
@@ -302,3 +320,80 @@ class NativeRecordIO:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# native image decode/augment stage (src/image_aug.cc — reference
+# iter_image_recordio_2.cc + image_aug_default.cc).  Separate .so so
+# the core runtime has no OpenCV dependency; loads lazily and fails
+# soft on systems without it.
+# ---------------------------------------------------------------------------
+
+
+def _try_load_image():
+    global _img_lib
+    if _img_lib is None:
+        _try_load()  # triggers the make that also builds the image lib
+        if os.path.exists(_IMG_LIB_PATH):
+            try:
+                L = ctypes.CDLL(_IMG_LIB_PATH)
+                L.MXTPUImageAugAvailable.restype = ctypes.c_int
+                L.MXTPUImageLastError.restype = ctypes.c_char_p
+                L.MXTPUImageDecodeAugment.restype = ctypes.c_int
+                L.MXTPUImageDecodeAugment.argtypes = [
+                    ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                    ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float)]
+                _img_lib = L
+            except OSError:
+                _img_lib = False
+        else:
+            _img_lib = False
+    return _img_lib or None
+
+
+def image_available() -> bool:
+    return _try_load_image() is not None
+
+
+def decode_augment(buf, crop_w, crop_h, resize=0, interp=2, to_rgb=1,
+                   rand_x=-1.0, rand_y=-1.0, mirror=0, mean=None,
+                   std=None):
+    """Decode + augment ONE encoded image into a float32 CHW array.
+
+    The whole stage runs in C++ with the GIL released (ctypes drops it
+    for the call), so pool workers get true parallel decode — the
+    reference's preprocess_threads behavior, natively."""
+    import numpy as np
+    L = _try_load_image()
+    if L is None:
+        raise RuntimeError("native image stage unavailable "
+                           "(libmxtpu_image.so not built)")
+    out = np.empty((3, int(crop_h), int(crop_w)), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+
+    def vec3(v):
+        if v is None:
+            return None
+        a = np.asarray(v, np.float32).reshape(-1)
+        if a.size == 1:
+            a = np.repeat(a, 3)     # scalar broadcasts over channels
+        if a.size != 3:
+            raise ValueError(
+                f"mean/std must have 1 or 3 elements, got {a.size}")
+        return (ctypes.c_float * 3)(*a)
+
+    buf = bytes(buf)
+    rc = L.MXTPUImageDecodeAugment(
+        buf, len(buf), int(to_rgb), int(resize), int(interp),
+        int(crop_w), int(crop_h), float(rand_x), float(rand_y),
+        int(mirror), vec3(mean), vec3(std),
+        out.ctypes.data_as(fp))
+    if rc != 0:
+        from .base import MXNetError
+        raise MXNetError("native decode_augment failed: "
+                         + L.MXTPUImageLastError().decode())
+    return out

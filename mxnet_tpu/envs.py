@@ -48,6 +48,12 @@ _reg("MXTPU_NATIVE_IO", bool, True,
      "Schedule data-pipeline work (prefetch, decode/augment, DataLoader "
      "workers) on the native C++ engine when libmxtpu.so is built; "
      "0 falls back to Python thread pools.")
+_reg("MXTPU_NATIVE_IMAGE", bool, True,
+     "Run the recognized decode/resize/crop/normalize pipeline as one "
+     "native C++ call (libmxtpu_image.so) inside ImageIter workers; "
+     "0 keeps the Python augmenter path. Independent of "
+     "MXTPU_NATIVE_IO so pool backend and decode stage toggle "
+     "separately.")
 _reg("MXTPU_ENABLE_X64", bool, False,
      "Enable 64-bit tensor types (int64/float64) via jax_enable_x64. "
      "Off by default: x64 risks silent f64 promotion on TPU hot paths "

@@ -361,6 +361,48 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def _native_aug_plan(auglist, data_shape):
+    """Recognize the standard CreateAugmenter pipeline —
+    [Resize?] (Center|Random)Crop [Flip?] Cast [Normalize?] — and
+    compile it to one native decode+augment call.  Returns None (keep
+    the Python path) for anything else, when the native image lib is
+    absent, or when MXTPU_NATIVE_IMAGE=0 (independent of the
+    MXTPU_NATIVE_IO pool switch, so each can be toggled alone)."""
+    from .. import _native, envs
+    if not envs.get("MXTPU_NATIVE_IMAGE") \
+            or not _native.image_available():
+        return None
+    seq = list(auglist)
+    resize, interp = 0, None
+    if seq and type(seq[0]) is ResizeAug:
+        resize, interp = seq[0].size, seq[0].interp
+        seq.pop(0)
+    if not seq or type(seq[0]) not in (CenterCropAug, RandomCropAug):
+        return None
+    crop = seq.pop(0)
+    if interp is not None and crop.interp != interp:
+        return None                      # one interp per native call
+    if tuple(crop.size) != (data_shape[2], data_shape[1]):
+        return None
+    mirror_p = 0.0
+    if seq and type(seq[0]) is HorizontalFlipAug:
+        mirror_p = seq.pop(0).p
+    if not seq or type(seq[0]) is not CastAug or seq[0].typ != "float32":
+        return None
+    seq.pop(0)
+    mean = std = None
+    if seq and type(seq[0]) is ColorNormalizeAug:
+        aug = seq.pop(0)
+        mean = aug.mean.asnumpy() if aug.mean is not None else None
+        std = aug.std.asnumpy() if aug.std is not None else None
+    if seq:
+        return None
+    return dict(resize=resize, interp=crop.interp,
+                crop_w=crop.size[0], crop_h=crop.size[1],
+                rand_crop=type(crop) is RandomCropAug,
+                mirror_p=mirror_p, mean=mean, std=std)
+
+
 # ---------------------------------------------------------------------------
 # ImageIter
 # ---------------------------------------------------------------------------
@@ -419,6 +461,10 @@ class ImageIter(io_mod.DataIter):
         if aug_list is None:
             aug_list = CreateAugmenter(data_shape)
         self.auglist = aug_list
+        # the standard resize/crop/flip/normalize pipeline runs fully
+        # native (C++ decode+augment, GIL released) when recognized;
+        # anything fancier keeps the Python augmenter path
+        self._native_plan = _native_aug_plan(aug_list, data_shape)
         self.cur = 0
         self.reset()
 
@@ -461,9 +507,26 @@ class ImageIter(io_mod.DataIter):
         return header.label, img
 
     def _process(self, buf):
-        """Decode + augment one sample (runs on pool workers: OpenCV
-        releases the GIL, so threads give real parallel decode — the
-        reference's preprocess_threads equivalent)."""
+        """Decode + augment one sample (runs on pool workers).
+
+        Native path: the WHOLE stage is one C++ call
+        (``src/image_aug.cc``: decode → resize → crop → mirror →
+        normalize → CHW) with the GIL released — the reference's
+        ``iter_image_recordio_2.cc`` worker, rather than Python ops
+        the engine merely schedules.  RNG draws happen here in Python
+        so seeded augmentation is reproducible either way."""
+        p = self._native_plan
+        if p is not None:
+            from .. import _native
+            rx = ry = -1.0
+            if p["rand_crop"]:
+                rx, ry = pyrandom.random(), pyrandom.random()
+            mirror = 1 if (p["mirror_p"]
+                           and pyrandom.random() < p["mirror_p"]) else 0
+            return _native.decode_augment(
+                buf, p["crop_w"], p["crop_h"], resize=p["resize"],
+                interp=p["interp"], rand_x=rx, rand_y=ry,
+                mirror=mirror, mean=p["mean"], std=p["std"])
         img = imdecode(buf)
         for aug in self.auglist:
             img = aug(img)

@@ -1,0 +1,113 @@
+// Native image decode + augment stage (reference:
+// src/io/iter_image_recordio_2.cc + image_aug_default.cc — the C++
+// OpenCV decode/augment workers of the reference's data pipeline).
+//
+// One C ABI call takes an ENCODED image payload and produces the
+// ready-to-batch float32 CHW tensor: decode -> BGR2RGB -> short-side
+// resize -> (center|random) crop -> mirror -> normalize.  The Python
+// side keeps the RNG (crop position / mirror decisions arrive as
+// arguments), so seeded-augmentation semantics stay identical to the
+// Python augmenter path; everything size-dependent happens here.
+//
+// The arithmetic mirrors mxnet_tpu/image/image.py exactly
+// (resize_short's integer division, scale_down's shrink-then-refit,
+// fixed_crop's resize-after-crop), so the native path is numerically
+// interchangeable with the Python one — same OpenCV underneath.
+//
+// Built as a SEPARATE libmxtpu_image.so: the core runtime must not
+// acquire a hard OpenCV dependency.
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include <exception>
+#include <string>
+
+static thread_local std::string g_err;
+
+extern "C" const char* MXTPUImageLastError() { return g_err.c_str(); }
+
+extern "C" int MXTPUImageAugAvailable() { return 1; }
+
+// image.py scale_down: shrink the crop target to fit the image
+static void scale_down(int sw, int sh, int* w, int* h) {
+  double W = *w, H = *h;
+  if (sh < H) { W = W * sh / H; H = sh; }
+  if (sw < W) { H = H * sw / W; W = sw; }
+  *w = (int)W;
+  *h = (int)H;
+}
+
+// Decode + augment one sample into out (float32, 3 x crop_h x crop_w,
+// CHW).  rand_x/rand_y in [0,1) select the crop corner; pass -1 for a
+// center crop.  mean/stdv may be null (then 0 / 1).  Returns 0, or a
+// negative code with MXTPUImageLastError() set.
+extern "C" int MXTPUImageDecodeAugment(
+    const unsigned char* buf, long long len, int to_rgb, int resize,
+    int interp, int crop_w, int crop_h, double rand_x, double rand_y,
+    int mirror, const float* mean, const float* stdv, float* out) {
+  try {
+    cv::Mat raw(1, (int)len, CV_8UC1, const_cast<unsigned char*>(buf));
+    cv::Mat img = cv::imdecode(raw, cv::IMREAD_COLOR);
+    if (img.empty()) {
+      g_err = "imdecode failed (unsupported or corrupt image payload)";
+      return -1;
+    }
+    if (to_rgb) cv::cvtColor(img, img, cv::COLOR_BGR2RGB);
+    if (resize > 0) {
+      // image.py resize_short (note the INTEGER division)
+      long long h = img.rows, w = img.cols, nw, nh;
+      if (h > w) {
+        nw = resize;
+        nh = (long long)resize * h / w;
+      } else {
+        nw = (long long)resize * w / h;
+        nh = resize;
+      }
+      cv::resize(img, img, cv::Size((int)nw, (int)nh), 0, 0, interp);
+    }
+    int w = img.cols, h = img.rows;
+    int cw = crop_w, ch = crop_h;
+    scale_down(w, h, &cw, &ch);
+    if (cw <= 0 || ch <= 0) {
+      g_err = "degenerate crop after scale_down";
+      return -2;
+    }
+    int x0, y0;
+    if (rand_x < 0 || rand_y < 0) {
+      x0 = (w - cw) / 2;
+      y0 = (h - ch) / 2;
+    } else {
+      x0 = (int)(rand_x * (w - cw + 1));
+      y0 = (int)(rand_y * (h - ch + 1));
+      if (x0 > w - cw) x0 = w - cw;
+      if (y0 > h - ch) y0 = h - ch;
+    }
+    cv::Mat patch = img(cv::Rect(x0, y0, cw, ch));
+    cv::Mat fin;
+    if (cw != crop_w || ch != crop_h) {
+      cv::resize(patch, fin, cv::Size(crop_w, crop_h), 0, 0, interp);
+    } else {
+      fin = patch;  // ROI view; read-only below
+    }
+    const int H = crop_h, W = crop_w;
+    float m[3] = {0.f, 0.f, 0.f}, s[3] = {1.f, 1.f, 1.f};
+    if (mean) { m[0] = mean[0]; m[1] = mean[1]; m[2] = mean[2]; }
+    if (stdv) { s[0] = stdv[0]; s[1] = stdv[1]; s[2] = stdv[2]; }
+    for (int y = 0; y < H; ++y) {
+      const unsigned char* row = fin.ptr<unsigned char>(y);
+      for (int x = 0; x < W; ++x) {
+        // mirror = read columns right-to-left (flip after crop,
+        // before normalize — the Python augmenter order)
+        const int sx = mirror ? (W - 1 - x) : x;
+        const long long o = (long long)y * W + x;
+        out[0 * (long long)H * W + o] = (row[sx * 3 + 0] - m[0]) / s[0];
+        out[1 * (long long)H * W + o] = (row[sx * 3 + 1] - m[1]) / s[1];
+        out[2 * (long long)H * W + o] = (row[sx * 3 + 2] - m[2]) / s[2];
+      }
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    g_err = e.what();
+    return -3;
+  }
+}

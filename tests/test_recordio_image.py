@@ -161,3 +161,87 @@ def test_im2rec_tool(tmp_path):
     r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
     header, img = recordio.unpack_img(r.read_idx(r.keys[0]))
     assert img.shape == (16, 16, 3)
+
+
+class TestNativeDecodeAugment:
+    """src/image_aug.cc (reference iter_image_recordio_2.cc +
+    image_aug_default.cc): the whole decode/augment stage as ONE
+    native call, numerically interchangeable with the Python
+    augmenter path (VERDICT r2 next #4)."""
+
+    def _needs_native(self):
+        from mxnet_tpu import _native
+        if not _native.image_available():
+            pytest.skip("libmxtpu_image.so not built (no OpenCV dev)")
+
+    def test_parity_with_python_path(self, tmp_path, monkeypatch):
+        self._needs_native()
+        path = str(tmp_path / "imgs.rec")
+        rng = np.random.RandomState(0)
+        w = recordio.MXIndexedRecordIO(
+            str(tmp_path / "imgs.idx"), path, "w")
+        for i in range(8):
+            img = (rng.rand(40, 52, 3) * 255).astype("uint8")
+            header = recordio.IRHeader(0, float(i % 4), i, 0)
+            w.write_idx(i, recordio.pack_img(header, img,
+                                             img_fmt=".jpg"))
+        w.close()
+
+        def batches(native):
+            monkeypatch.setenv("MXTPU_NATIVE_IMAGE",
+                               "1" if native else "0")
+            it = ImageRecordIter(
+                path_imgrec=path, data_shape=(3, 24, 32), batch_size=4,
+                resize=36, mean_r=10.0, mean_g=20.0, mean_b=30.0,
+                std_r=2.0, std_g=2.0, std_b=2.0, preprocess_threads=2,
+                prefetch_buffer=0)
+            out = [b.data[0].asnumpy() for b in it]
+            return np.concatenate(out)
+
+        nat = batches(True)
+        py = batches(False)
+        assert nat.shape == py.shape == (8, 3, 24, 32)
+        # the pip cv2 (OpenCV 5) and system libopencv (4.x) round
+        # cubic interpolation one uint8 level apart; std=2 makes one
+        # level == 0.5 in output units
+        assert np.abs(nat - py).max() <= 0.5 + 1e-5
+
+    def test_plan_rejects_unsupported_augmenters(self):
+        self._needs_native()
+        from mxnet_tpu.image.image import (_native_aug_plan,
+                                           CreateAugmenter)
+        shape = (3, 24, 24)
+        assert _native_aug_plan(
+            CreateAugmenter(shape, resize=30), shape) is not None
+        assert _native_aug_plan(
+            CreateAugmenter(shape, rand_crop=True, rand_mirror=True),
+            shape)["rand_crop"]
+        # color jitter is python-only -> whole pipeline falls back
+        assert _native_aug_plan(
+            CreateAugmenter(shape, brightness=0.2), shape) is None
+        # pca noise too
+        assert _native_aug_plan(
+            CreateAugmenter(shape, pca_noise=0.1), shape) is None
+
+    def test_corrupt_payload_raises(self):
+        self._needs_native()
+        from mxnet_tpu import _native
+        with pytest.raises(mx.MXNetError, match="decode_augment"):
+            _native.decode_augment(b"not an image", 8, 8)
+
+    def test_rand_crop_and_mirror_within_bounds(self):
+        self._needs_native()
+        import cv2
+        from mxnet_tpu import _native
+        rng = np.random.RandomState(1)
+        img = (rng.rand(30, 30, 3) * 255).astype("uint8")
+        ok, enc = cv2.imencode(".png", img[:, :, ::-1])
+        # mirror of a center crop == flipped columns of the unmirrored
+        a = _native.decode_augment(enc.tobytes(), 16, 16)
+        b = _native.decode_augment(enc.tobytes(), 16, 16, mirror=1)
+        np.testing.assert_allclose(b, a[:, :, ::-1])
+        # random corners stay in range at the extremes
+        for r in (0.0, 0.999999):
+            c = _native.decode_augment(enc.tobytes(), 16, 16,
+                                       rand_x=r, rand_y=r)
+            assert np.isfinite(c).all()
