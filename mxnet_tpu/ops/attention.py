@@ -19,6 +19,14 @@ import numpy as np
 
 from .registry import register
 
+# trace-time count of dot_product_attention dispatches that chose the
+# Pallas flash kernel (see the increment site for why this is proof)
+_FLASH_DISPATCHES = 0
+
+
+def flash_dispatch_count() -> int:
+    return _FLASH_DISPATCHES
+
 
 def _sdpa_xla(q, k, v, mask, scale, causal):
     """Reference XLA path: (B, S, H, D) layout.
@@ -87,6 +95,11 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
         mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key):
+        # dispatch evidence: incremented at TRACE time, so a nonzero
+        # count proves the compiled program contains the Pallas kernel
+        # (bench asserts this instead of hoping — VERDICT r2 weak #2)
+        global _FLASH_DISPATCHES
+        _FLASH_DISPATCHES += 1
         from .flash_attention import flash_attention
         if key.shape[2] != query.shape[2]:
             # flash kernel wants equal heads: repeat K/V. The repeat
