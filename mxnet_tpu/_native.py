@@ -27,6 +27,9 @@ __all__ = ["lib", "available", "NativeEngine", "NativeStorage",
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "lib", "libmxtpu.so")
 _IMG_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib",
                              "libmxtpu_image.so")
+# single source of truth for the PJRT core path (pjrt_native imports it)
+_PJRT_LIB_PATH = os.path.join(os.path.dirname(__file__), "lib",
+                              "libmxtpu_pjrt.so")
 lib = None
 _img_lib = None      # False = tried and failed; loaded CDLL otherwise
 _build_attempted = False
@@ -37,9 +40,19 @@ def _src_dir():
         os.path.abspath(__file__))), "src")
 
 
+# sources that feed their own optional lib, not libmxtpu.so; a missing
+# optional lib counts as stale (its toolchain dep — OpenCV, the PJRT
+# headers — may have appeared since the last build; make skips the
+# target harmlessly when it still can't build)
+_AUX_LIBS = {
+    "image_aug.cc": _IMG_LIB_PATH,
+    "pjrt_executor.cc": _PJRT_LIB_PATH,
+}
+
+
 def _stale() -> bool:
     """True when a built lib is missing or older than ITS sources
-    (image_aug.cc feeds only libmxtpu_image.so — comparing it against
+    (aux sources feed their own .so — comparing them against
     libmxtpu.so would re-run make forever)."""
     if not os.path.exists(_LIB_PATH):
         return True
@@ -49,13 +62,11 @@ def _stale() -> bool:
         for f in os.listdir(src):
             if not f.endswith(".cc"):
                 continue
-            if f == "image_aug.cc":
-                # missing image lib counts as stale: OpenCV may have
-                # appeared since the last build (make skips the target
-                # harmlessly when the headers are still absent)
-                if not os.path.exists(_IMG_LIB_PATH) or \
+            path = _AUX_LIBS.get(f)
+            if path is not None:
+                if not os.path.exists(path) or \
                         os.path.getmtime(os.path.join(src, f)) > \
-                        os.path.getmtime(_IMG_LIB_PATH):
+                        os.path.getmtime(path):
                     return True
                 continue
             if os.path.getmtime(os.path.join(src, f)) > lib_m:

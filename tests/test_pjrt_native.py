@@ -1,0 +1,76 @@
+"""Native PJRT dispatch core (src/pjrt_executor.cc — SURVEY.md §7
+hard-part 7, VERDICT r2 Missing #2).
+
+Host-side tests always run: the lib must build, load, declare its
+symbols, and fail loudly (not crash) on bad plugins.  The execute path
+needs real hardware behind a PJRT plugin — covered by the tpu-marked
+class, which the on-chip suite (chip_hunt's on_tpu_pytest job) runs."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import pjrt_native
+from mxnet_tpu.base import MXNetError
+
+
+def test_lib_builds_and_loads():
+    assert pjrt_native.lib_available(), \
+        "libmxtpu_pjrt.so must build (PJRT headers are in the image)"
+    L = pjrt_native._load()
+    for sym in ("MXTPUPjrtLoad", "MXTPUPjrtCompile", "MXTPUPjrtExecute",
+                "MXTPUPjrtBufferFromHost", "MXTPUPjrtBufferToHost",
+                "MXTPUPjrtLastError"):
+        assert hasattr(L, sym)
+
+
+def test_bogus_plugin_raises_not_crashes(tmp_path):
+    with pytest.raises(MXNetError, match="dlopen|PJRT"):
+        pjrt_native.NativeClient(str(tmp_path / "nope.so"))
+    # a real .so without GetPjrtApi is rejected with the right message
+    lib = str(tmp_path / "empty.so")
+    src = str(tmp_path / "empty.c")
+    with open(src, "w") as f:
+        f.write("int mxtpu_not_pjrt(void) { return 0; }\n")
+    import subprocess
+    r = subprocess.run(["gcc", "-shared", "-fPIC", "-o", lib, src],
+                       capture_output=True)
+    if r.returncode == 0:
+        with pytest.raises(MXNetError, match="GetPjrtApi"):
+            pjrt_native.NativeClient(lib)
+
+
+def test_plugin_candidates_exist_in_image():
+    cands = pjrt_native.plugin_candidates()
+    assert any("axon" in c or "libtpu" in c for c in cands), cands
+
+
+@pytest.mark.tpu
+class TestOnChip:
+    """Real-hardware path: compile StableHLO through the C API and run
+    with device-resident buffers, no Python in the dispatch loop."""
+
+    def test_matmul_end_to_end(self):
+        import jax.numpy as jnp
+        client = pjrt_native.NativeClient()
+        assert client.device_count >= 1
+        rng = np.random.RandomState(0)
+        a = rng.randn(64, 64).astype("float32")
+        b = rng.randn(64, 64).astype("float32")
+        exe = client.compile_jax(
+            lambda x, y: jnp.dot(x, y) + 1.0, (a, b))
+        assert exe.num_outputs == 1
+        (out,) = exe(a, b)
+        np.testing.assert_allclose(np.asarray(out.to_numpy()),
+                                   a @ b + 1.0, rtol=2e-2, atol=1e-2)
+
+    def test_device_buffers_chain_without_host_hops(self):
+        import jax.numpy as jnp
+        client = pjrt_native.NativeClient()
+        x = np.ones((32, 32), np.float32)
+        exe = client.compile_jax(lambda v: v * 2.0, (x,))
+        buf = client.buffer_from_host(x)
+        for _ in range(3):           # device->device chaining
+            (buf,) = exe(buf)
+        np.testing.assert_allclose(buf.to_numpy(), x * 8.0, rtol=1e-5)

@@ -65,7 +65,8 @@ def jobs(log_dir):
         # (these assert mx.num_tpus() > 0, so rc==0 implies on-chip)
         ("on_tpu_pytest",
          [sys.executable, "-m", "pytest", "tests/test_on_tpu.py",
-          "tests/test_flash_attention.py", "-q", "--no-header"],
+          "tests/test_flash_attention.py", "tests/test_pjrt_native.py",
+          "-q", "--no-header"],
          2400, {"MXTPU_TEST_ON_TPU": "1"}, r"passed", r"\bfailed\b"),
         # flash-vs-XLA attention delta (VERDICT r2 weak #2)
         ("attention_bench",
