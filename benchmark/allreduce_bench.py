@@ -116,19 +116,29 @@ def bench_dist(sizes_mb, iters=10):
 
 
 def _launch_dist(n, sizes, iters):
+    import signal
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
-    res = subprocess.run(
+    # own process group: a wedged rendezvous must not leave orphaned
+    # workers holding the coordinator port after the timeout kill
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(repo, "tools", "launch.py"),
          "-n", str(n), "--launcher", "local",
          sys.executable, os.path.abspath(__file__), "--dist",
          "--sizes-mb", ",".join(str(s) for s in sizes),
          "--iters", str(iters)],
-        env=env, cwd=repo, timeout=600)
-    return res.returncode
+        env=env, cwd=repo, start_new_session=True)
+    try:
+        return proc.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
+        print(json.dumps({"error": "dist bench timed out"}),
+              flush=True)
+        return 124
 
 
 def main(argv=None):
@@ -145,7 +155,9 @@ def main(argv=None):
 
     sizes = [float(s) for s in args.sizes_mb.split(",")]
     if args.dist_launch:
-        return _launch_dist(args.dist_launch, sizes, args.iters)
+        # worker failures must surface as a nonzero exit, not be
+        # dropped by the bare __main__ call
+        sys.exit(_launch_dist(args.dist_launch, sizes, args.iters))
     if args.dist:
         # worker process: pin CPU before anything touches jax (the
         # image pins JAX_PLATFORMS=axon and one bench worker must not
