@@ -169,6 +169,14 @@ class TestPredictAPI:
                       str(tmp_path / "expected.bin")])
         assert res.returncode == 0, res.stdout + res.stderr
         assert "C PREDICT TEST PASSED" in res.stdout
+        # warm-path round-trip latency (set-input/forward/get-output),
+        # surfaced so the deploy number exists on record
+        m = [ln for ln in res.stdout.splitlines()
+             if ln.startswith("PREDICT_LATENCY_US:")]
+        assert m, "latency line missing"
+        us = float(m[0].split(":")[1])
+        print(f"\nC predict warm latency: {us:.1f} us/call")
+        assert us < 100_000, us   # sanity, not a perf gate
 
 
 def test_predictor_rejects_bad_inputs(tmp_path):
