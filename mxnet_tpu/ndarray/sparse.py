@@ -1,19 +1,50 @@
-"""Sparse NDArray facade (parity: python/mxnet/ndarray/sparse.py).
+"""Sparse NDArrays (parity: python/mxnet/ndarray/sparse.py).
 
-Capability note (SURVEY.md §7 P6): the reference supports ``row_sparse`` and
-``csr`` storage types end-to-end.  TPU/XLA has no sparse buffer type, so this
-facade keeps the *API* (stype metadata, ``tostype``, ``row_sparse_array``,
-``csr_matrix``) over dense device buffers with an explicit documented perf
-caveat — numerics are identical, memory is dense.
+Capability note (SURVEY.md §7 P6): the reference supports ``row_sparse``
+and ``csr`` storage types end-to-end.  TPU/XLA has no sparse buffer
+type; the rebuild's answer has two tiers:
+
+* **csr built from (data, indices, indptr)** stores ONLY the compressed
+  arrays on device — no dense buffer exists until a generic op touches
+  the array (lazy densification), and :func:`dot` computes on the nnz
+  values via a scatter-add (XLA segment-sum lowering).  A 100k x 100k
+  matrix with 1k nonzeros costs kilobytes, not 40 GB.
+* **everything else** (dense-built sparse arrays, generic ops on any
+  sparse array) runs on dense buffers with stype metadata — numerics
+  identical, memory dense, documented in docs/capability_gaps.md.
+
+row_sparse keeps real LAZY-UPDATE semantics in the optimizers (only
+touched rows advance state) over dense storage.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
+from ..base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
-           "row_sparse_array", "zeros"]
+           "row_sparse_array", "zeros", "dot"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _csr_rows(iptr, nnz):
+    jnp = _jnp()
+    return jnp.searchsorted(iptr, jnp.arange(nnz), side="right") - 1
+
+
+def _densify_csr(vals, idx, iptr, shape):
+    jnp = _jnp()
+    rows = _csr_rows(iptr, vals.shape[0])
+    # .add, not .set: duplicate (row, col) entries SUM (scipy/reference
+    # semantics), and the dot path must agree with the densified path
+    return jnp.zeros(shape, vals.dtype).at[rows, idx].add(vals)
 
 
 class _SparseFacade(NDArray):
@@ -31,17 +62,73 @@ class _SparseFacade(NDArray):
 
 
 class CSRNDArray(_SparseFacade):
-    __slots__ = ()
+    __slots__ = ("_csr",)
     _stype = "csr"
+
+    def __init__(self, data, ctx=None, _base=None, _index=None):
+        super().__init__(data, ctx=ctx, _base=_base, _index=_index)
+        self._csr = None   # (vals, indices, indptr, shape) when compressed
+
+    @property
+    def _data(self):
+        # generic ops densify LAZILY; sparse-aware paths (dot, the
+        # compressed-part properties) never come through here
+        if self._buf is None and self._base is None and \
+                self._csr is not None:
+            self._buf = _densify_csr(*self._csr)
+        return NDArray._data.fget(self)
+
+    def _set_data(self, new):
+        self._csr = None   # a dense mutation invalidates the parts
+        NDArray._set_data(self, new)
+
+    @property
+    def shape(self):
+        if self._buf is None and self._csr is not None:
+            return tuple(self._csr[3])
+        return NDArray.shape.fget(self)
+
+    @property
+    def dtype(self):
+        if self._buf is None and self._csr is not None:
+            return self._csr[0].dtype
+        return NDArray.dtype.fget(self)
+
+    @property
+    def is_compressed(self):
+        """True while no dense buffer has been materialized."""
+        return self._buf is None and self._csr is not None
+
+    @property
+    def ndim(self):
+        if self._buf is None and self._csr is not None:
+            return len(self._csr[3])
+        return NDArray.ndim.fget(self)
 
     @property
     def indices(self):
+        if self._csr is not None:
+            # already on device: wrap, don't round-trip via host
+            return NDArray(self._csr[1].astype(_jnp().int64),
+                           ctx=self._ctx)
         a = self.asnumpy()
         return array(np.nonzero(a)[1].astype("int64"), ctx=self._ctx,
                      dtype="int64")
 
     @property
+    def indptr(self):
+        if self._csr is not None:
+            return NDArray(self._csr[2].astype(_jnp().int64),
+                           ctx=self._ctx)
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        return array(np.concatenate([[0], np.cumsum(counts)])
+                     .astype("int64"), ctx=self._ctx, dtype="int64")
+
+    @property
     def data(self):
+        if self._csr is not None:
+            return NDArray(self._csr[0], ctx=self._ctx)
         a = self.asnumpy()
         return array(a[a != 0], ctx=self._ctx)
 
@@ -67,15 +154,41 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype="float32"):
     if isinstance(arg1, (list, np.ndarray, NDArray)):
         base = array(arg1, ctx=ctx, dtype=dtype)
         return _make("csr", base._data, base._ctx)
+    # (data, indices, indptr): store ONLY the compressed parts — the
+    # dense buffer appears lazily if a generic op ever needs it
     data, indices, indptr = arg1
-    dense = np.zeros(shape, dtype=dtype)
-    indptr = np.asarray(indptr, dtype="int64")
-    indices = np.asarray(indices, dtype="int64")
-    vals = np.asarray(data, dtype=dtype)
-    rows = np.repeat(np.arange(shape[0]), np.diff(indptr))
-    dense[rows, indices] = vals
-    base = array(dense, ctx=ctx, dtype=dtype)
-    return _make("csr", base._data, base._ctx)
+    if shape is None:
+        raise MXNetError("csr_matrix from (data, indices, indptr) "
+                         "requires shape=")
+    jnp = _jnp()
+    vals_np = np.asarray(data, dtype=dtype)
+    idx_np = np.asarray(indices, dtype="int32")
+    iptr_np = np.asarray(indptr, dtype="int32")
+    if iptr_np.shape[0] != int(shape[0]) + 1:
+        raise MXNetError(
+            f"indptr length {iptr_np.shape[0]} != shape[0]+1 "
+            f"({int(shape[0]) + 1})")
+    # malformed structure must fail HERE: jax scatter silently drops
+    # out-of-bounds updates and gather clamps, so bad csr parts would
+    # otherwise produce quietly wrong numerics
+    if iptr_np.size and (iptr_np[0] != 0
+                         or iptr_np[-1] != vals_np.size
+                         or (np.diff(iptr_np) < 0).any()):
+        raise MXNetError(
+            f"invalid indptr: must start at 0, end at nnz "
+            f"({vals_np.size}) and be non-decreasing")
+    if idx_np.size and (idx_np.min() < 0
+                        or idx_np.max() >= int(shape[1])):
+        raise MXNetError(
+            f"column indices out of range for shape {tuple(shape)}")
+    if idx_np.shape[0] != vals_np.shape[0]:
+        raise MXNetError("data and indices must have equal length")
+    vals = jnp.asarray(vals_np)
+    idx = jnp.asarray(idx_np)
+    iptr = jnp.asarray(iptr_np)
+    out = CSRNDArray(None, ctx=ctx)
+    out._csr = (vals, idx, iptr, tuple(int(d) for d in shape))
+    return out
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
@@ -88,6 +201,68 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
     dense[np.asarray(indices, dtype="int64")] = data
     base = array(dense, ctx=ctx, dtype=dtype)
     return _make("row_sparse", base._data, base._ctx)
+
+
+_CSR_DOT = None
+
+
+def _get_csr_dot():
+    global _CSR_DOT
+    if _CSR_DOT is None:
+        import jax
+        jnp = _jnp()
+
+        @partial(jax.jit, static_argnums=(4, 5))
+        def f(vals, idx, iptr, rhs, out_rows, transpose):
+            rows = _csr_rows(iptr, vals.shape[0])
+            if transpose:
+                contrib = vals[:, None] * rhs[rows]
+                return jnp.zeros((out_rows, rhs.shape[1]),
+                                 vals.dtype).at[idx].add(contrib)
+            contrib = vals[:, None] * rhs[idx]
+            return jnp.zeros((out_rows, rhs.shape[1]),
+                             vals.dtype).at[rows].add(contrib)
+
+        _CSR_DOT = f
+    return _CSR_DOT
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (parity: ``mx.nd.sparse.dot``).
+
+    A COMPRESSED csr lhs runs a scatter-add over its nnz values only
+    (XLA lowers to a segment-sum): FLOPs and intermediate memory scale
+    with nnz, never with the dense shape, and the lhs stays
+    un-densified.  Anything else — including calls under
+    ``autograd.record()``, which must flow through the recorded op so
+    gradients exist — falls back to the dense ``dot``."""
+    from .. import autograd
+    if isinstance(lhs, CSRNDArray) and lhs._csr is not None and \
+            isinstance(rhs, NDArray) and \
+            not isinstance(rhs, _SparseFacade) and \
+            not autograd.is_recording():
+        vals, idx, iptr, shape = lhs._csr
+        r = rhs._data
+        if transpose_b:
+            r = r.T
+        squeeze = r.ndim == 1
+        if squeeze:
+            r = r[:, None]
+        want = shape[0] if transpose_a else shape[1]
+        if int(r.shape[0]) != want:
+            raise MXNetError(
+                f"sparse.dot: lhs {shape}{'^T' if transpose_a else ''} "
+                f"incompatible with rhs {tuple(rhs.shape)}")
+        out_rows = shape[1] if transpose_a else shape[0]
+        res = _get_csr_dot()(vals, idx, iptr, r, out_rows,
+                             bool(transpose_a))
+        if squeeze:
+            res = res[:, 0]
+        return NDArray(res, ctx=lhs._ctx)
+    from ..ops.registry import get_op
+    from .ndarray import invoke
+    return invoke(get_op("dot"), [lhs, rhs], transpose_a=transpose_a,
+                  transpose_b=transpose_b)
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
