@@ -96,3 +96,10 @@ def test_actor_critic_smoke():
                 "--episodes", "80"])
     assert res.returncode == 0
     assert "avg reward" in res.stdout
+
+
+def test_int8_inference_smoke():
+    res = _run([os.path.join("example", "int8_inference.py"),
+                "--train-steps", "24"], timeout=420)
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "INT8 INFERENCE OK" in res.stdout
