@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Where does a BERT pretrain step spend its time? (VERDICT r2 next #2)
+
+Decomposes the step into separately-timed compiled programs so the MFU
+ceiling has an itemized bill instead of a guess:
+
+* ``matmul_roofline`` — a bare bf16 matmul at the model's dominant
+  shape: the achievable ceiling on this backend.
+* ``qkv_ffn``        — the transformer's matmul skeleton (qkv/attn-out/
+  ffn-in/ffn-out for all layers, fwd only).
+* ``attention``      — the SDPA/flash stack alone, all layers.
+* ``embed``          — embedding gathers + layernorm, the non-matmul
+  front.
+* ``mlm_head``       — masked-position gather + vocab projection, the
+  fat tail.
+* ``fwd``            — whole-model forward (hybridized, jitted).
+* ``full_step``      — the fused train step (fwd+bwd+adam, the bench
+  headline path).
+
+fwd+bwd+update ≈ 3x fwd FLOPs; comparing ``full_step`` against
+3*(qkv_ffn + attention) + embed + mlm_head + optimizer shows which
+phase eats the difference.  Run on CPU it exercises the harness with
+tiny shapes; the real numbers come from the chip (chip_hunt job).
+
+    python benchmark/bert_phase_bench.py [--tpu-config]
+"""
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _time(fn, *args, iters=10):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu-config", action="store_true",
+                    help="bert_base batch 64 seq 128 (default: tiny "
+                         "CPU shapes)")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    if jax.default_backend() == "cpu" and not args.tpu_config:
+        cfg = dict(vocab=1000, b=4, s=64, m=8, h=128, layers=2,
+                   heads=2)
+    else:
+        cfg = dict(vocab=30522, b=64, s=128, m=20, h=768, layers=12,
+                   heads=12)
+    v, b, s, m, h, L, heads = (cfg["vocab"], cfg["b"], cfg["s"],
+                               cfg["m"], cfg["h"], cfg["layers"],
+                               cfg["heads"])
+    d = h // heads
+    dt = jax.numpy.bfloat16
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+
+    rows = {}
+
+    def rec(name, secs, flops=None):
+        row = {"phase": name, "ms": round(secs * 1e3, 3)}
+        if flops:
+            row["tflops"] = round(flops / secs / 1e12, 2)
+        rows[name] = row
+        print(json.dumps(row), flush=True)
+
+    # 1. roofline: the dominant matmul shape (b*s, h) x (h, 4h)
+    A = jnp.asarray(rng.randn(b * s, h), dt)
+    B = jnp.asarray(rng.randn(h, 4 * h), dt)
+    f = jax.jit(lambda x, y: x @ y)
+    secs = _time(f, A, B, iters=args.iters)
+    rec("matmul_roofline", secs, 2.0 * b * s * h * 4 * h)
+
+    # 2. qkv/ffn skeleton: all matmuls of L layers, fwd only
+    Wq = jnp.asarray(rng.randn(L, h, 3 * h) * 0.02, dt)
+    Wo = jnp.asarray(rng.randn(L, h, h) * 0.02, dt)
+    W1 = jnp.asarray(rng.randn(L, h, 4 * h) * 0.02, dt)
+    W2 = jnp.asarray(rng.randn(L, 4 * h, h) * 0.02, dt)
+
+    @jax.jit
+    def skeleton(x, wq, wo, w1, w2):
+        def layer(x, ws):
+            q, o, a, c = ws
+            x = x + (x @ q)[:, :, :h] @ o
+            return x + jax.nn.gelu(x @ a) @ c
+        import jax.lax as lax
+        return lax.scan(lambda x, ws: (layer(x, ws), 0.0), x,
+                        (wq, wo, w1, w2))[0]
+
+    X = jnp.asarray(rng.randn(b, s, h) * 0.1, dt)
+    secs = _time(skeleton, X, Wq, Wo, W1, W2, iters=args.iters)
+    sk_flops = 2.0 * b * s * L * (h * 3 * h + h * h + 2 * h * 4 * h)
+    rec("qkv_ffn", secs, sk_flops)
+
+    # 3. attention stack alone (the framework's dispatch: flash on TPU)
+    from mxnet_tpu.ops.attention import dot_product_attention
+    Q = jnp.asarray(rng.randn(b, s, heads, d), dt)
+
+    @jax.jit
+    def attn_stack(q):
+        for _ in range(L):
+            q = dot_product_attention(q, q, q)
+        return q
+
+    secs = _time(attn_stack, Q, iters=args.iters)
+    rec("attention", secs, 4.0 * b * s * s * h * L)
+
+    # 4. embedding front: token+type+pos gathers + add + layernorm
+    Etok = jnp.asarray(rng.randn(v, h) * 0.02, dt)
+    Epos = jnp.asarray(rng.randn(s, h) * 0.02, dt)
+    toks = jnp.asarray(rng.randint(0, v, (b, s)))
+
+    @jax.jit
+    def embed(et, ep, t):
+        x = et[t] + ep[None, :, :]
+        mu = x.mean(-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5)
+
+    rec("embed", _time(embed, Etok, Epos, toks, iters=args.iters))
+
+    # 5. MLM head tail: gather masked positions, project to vocab
+    Wv = jnp.asarray(rng.randn(h, v) * 0.02, dt)
+    pos = jnp.asarray(rng.randint(0, s, (b, m)))
+
+    @jax.jit
+    def mlm_head(x, wv, p):
+        g = jnp.take_along_axis(x, p[:, :, None], axis=1)
+        return g @ wv
+
+    secs = _time(mlm_head, X, Wv, pos, iters=args.iters)
+    rec("mlm_head", secs, 2.0 * b * m * h * v)
+
+    # 6/7. whole model fwd + the fused train step via the framework
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu import models
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    amp.init(target_dtype="bfloat16")
+    try:
+        ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+        builder = (models.bert_base if h == 768 else models.bert_small)
+        inner = models.BERTForPretrain(
+            builder(vocab_size=v, max_length=s, dropout=0.1))
+
+        class _Full(HybridBlock):
+            def __init__(self, mod, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.mod = mod
+
+            def hybrid_forward(self, F, tokens, types, positions):
+                return self.mod(tokens, types, None, positions)
+
+        model = _Full(inner)
+        model.initialize(mx.init.Xavier(), ctx=ctx)
+        toks_nd = nd.array(rng.randint(0, v, (b, s)).astype("f"),
+                           ctx=ctx)
+        typ_nd = nd.array(rng.randint(0, 2, (b, s)).astype("f"),
+                          ctx=ctx)
+        pos_nd = nd.array(rng.randint(0, s, (b, m)).astype("f"),
+                          ctx=ctx)
+        lab_nd = nd.array(np.concatenate(
+            [rng.randint(0, v, (b, m)), rng.randint(0, 2, (b, 1))],
+            axis=1).astype("f"), ctx=ctx)
+        model.hybridize()
+
+        def fwd():
+            out = model(toks_nd, typ_nd, pos_nd)
+            return out[0]._data
+
+        fwd()
+        secs = _time(lambda: fwd(), iters=args.iters)
+        rec("fwd", secs)
+
+        sce = SoftmaxCrossEntropyLoss()
+
+        def loss_fn(outs, label):
+            mlm, nsp = outs
+            return sce(mlm, label[:, :m].reshape((-1,))).mean() + \
+                sce(nsp, label[:, m]).mean()
+
+        mesh = parallel.make_mesh({"dp": 1}, devices=[ctx.device])
+        dpt = parallel.DataParallelTrainer(
+            model, loss_fn, "adam", {"learning_rate": 1e-4},
+            mesh=mesh, fuse_step=True)
+        data = (toks_nd, typ_nd, pos_nd)
+        for _ in range(2):
+            dpt.step(data, lab_nd).wait_to_read()
+
+        def step():
+            loss = dpt.step(data, lab_nd)
+            return loss._data
+
+        secs = _time(lambda: step(), iters=args.iters)
+        rec("full_step", secs)
+    finally:
+        amp._deinit()
+
+    # the bill
+    parts = 3 * (rows["qkv_ffn"]["ms"] + rows["attention"]["ms"]) \
+        + rows["embed"]["ms"] + rows["mlm_head"]["ms"] * 3
+    print(json.dumps({
+        "summary": "bert_phases", "config": cfg,
+        "full_step_ms": rows["full_step"]["ms"],
+        "modeled_parts_ms": round(parts, 3),
+        "unexplained_ms": round(rows["full_step"]["ms"] - parts, 3),
+        "note": "modeled = 3x(qkv_ffn+attention) fwd-bwd scaling + "
+                "embed + 3x mlm_head; the gap is optimizer, "
+                "layernorms, residual traffic, and dispatch",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

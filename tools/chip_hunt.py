@@ -68,6 +68,11 @@ def jobs(log_dir):
           "tests/test_flash_attention.py", "tests/test_pjrt_native.py",
           "-q", "--no-header"],
          2400, {"MXTPU_TEST_ON_TPU": "1"}, r"passed", r"\bfailed\b"),
+        # per-phase step decomposition for the MFU analysis
+        ("bert_phases",
+         [sys.executable, "benchmark/bert_phase_bench.py",
+          "--tpu-config"], 1800, {},
+         r"full_step", r"degraded"),
         # flash-vs-XLA attention delta (VERDICT r2 weak #2)
         ("attention_bench",
          [sys.executable, "benchmark/attention_bench.py",
