@@ -1,16 +1,28 @@
 """``mx.npx`` — numpy-extension namespace (SURVEY.md §2.5: reference
-``python/mxnet/numpy_extension`` / ``npx``): NN ops under numpy
-semantics plus the np-mode switches."""
+``python/mxnet/numpy_extension``).
+
+The reference's ``npx`` is where NN operators live under numpy
+semantics: the np namespace stays pure-array-math, and everything
+neural (activations, normed layers as functions, embedding/FC/conv,
+sequence ops, special functions) plus the np-mode switches and engine
+sync sits here.  The wrappers dispatch through the SAME op registry as
+``mx.nd`` — one compiled implementation per op, two calling
+conventions.
+"""
 from __future__ import annotations
 
 import threading
 
-from ..ndarray.ndarray import NDArray, invoke
+from ..ndarray.ndarray import invoke
 from ..ops.registry import get_op
 
 __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
-           "relu", "sigmoid", "softmax", "log_softmax", "waitall",
-           "one_hot"]
+           "relu", "sigmoid", "softmax", "log_softmax", "leaky_relu",
+           "activation", "one_hot", "pick", "topk", "batch_dot",
+           "reshape_like", "broadcast_like", "erf", "erfinv",
+           "gamma", "gammaln", "smooth_l1", "sequence_mask",
+           "embedding", "fully_connected", "convolution", "pooling",
+           "batch_norm", "layer_norm", "dropout", "waitall"]
 
 _state = threading.local()
 
@@ -35,29 +47,147 @@ def is_np_shape() -> bool:
     return getattr(_state, "np_shape", False)
 
 
-def _invoke1(op_name, x, **kw):
-    return invoke(get_op(op_name), [x], **kw)
+def _inv(op_name, inputs, **kw):
+    return invoke(get_op(op_name), list(inputs), **kw)
 
+
+# -- activations ------------------------------------------------------------
 
 def relu(x):
-    return _invoke1("relu", x)
+    return _inv("relu", [x])
 
 
 def sigmoid(x):
-    return _invoke1("sigmoid", x)
+    return _inv("sigmoid", [x])
 
 
 def softmax(x, axis=-1):
-    return _invoke1("softmax", x, axis=axis)
+    return _inv("softmax", [x], axis=axis)
 
 
 def log_softmax(x, axis=-1):
-    return _invoke1("log_softmax", x, axis=axis)
+    return _inv("log_softmax", [x], axis=axis)
 
+
+def leaky_relu(x, slope=0.25):
+    return _inv("LeakyReLU", [x], act_type="leaky", slope=slope)
+
+
+def activation(x, act_type="relu"):
+    return _inv("Activation", [x], act_type=act_type)
+
+
+# -- indexing / shape helpers ----------------------------------------------
 
 def one_hot(x, depth, on_value=1.0, off_value=0.0, dtype="float32"):
-    return _invoke1("one_hot", x, depth=depth, on_value=on_value,
-                    off_value=off_value, dtype=dtype)
+    return _inv("one_hot", [x], depth=depth, on_value=on_value,
+                off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _inv("pick", [data, index], axis=axis, mode=mode,
+                keepdims=keepdims)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return _inv("topk", [data], k=k, axis=axis, ret_typ=ret_typ,
+                is_ascend=is_ascend)
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    return _inv("batch_dot", [a, b], transpose_a=transpose_a,
+                transpose_b=transpose_b)
+
+
+def reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+def broadcast_like(lhs, rhs):
+    return _inv("broadcast_like", [lhs, rhs])
+
+
+def sequence_mask(data, valid_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    ins = [data] + ([valid_length] if valid_length is not None else [])
+    return _inv("SequenceMask", ins,
+                use_sequence_length=use_sequence_length, value=value,
+                axis=axis)
+
+
+# -- special functions ------------------------------------------------------
+
+def erf(x):
+    return _inv("erf", [x])
+
+
+def erfinv(x):
+    return _inv("erfinv", [x])
+
+
+def gamma(x):
+    return _inv("gamma", [x])
+
+
+def gammaln(x):
+    return _inv("gammaln", [x])
+
+
+def smooth_l1(x, scalar=1.0):
+    return _inv("smooth_l1", [x], scalar=scalar)
+
+
+# -- NN layers as functions -------------------------------------------------
+
+def embedding(data, weight, input_dim, output_dim, dtype="float32"):
+    return _inv("Embedding", [data, weight], input_dim=input_dim,
+                output_dim=output_dim, dtype=dtype)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=0,
+                    no_bias=False, flatten=True):
+    ins = [x, weight] + ([] if bias is None else [bias])
+    return _inv("FullyConnected", ins, num_hidden=num_hidden,
+                no_bias=bias is None or no_bias, flatten=flatten)
+
+
+def convolution(data, weight, bias=None, kernel=(), stride=(),
+                dilate=(), pad=(), num_filter=0, num_group=1,
+                layout=None):
+    ins = [data, weight] + ([] if bias is None else [bias])
+    return _inv("Convolution", ins, kernel=kernel, stride=stride,
+                dilate=dilate, pad=pad, num_filter=num_filter,
+                num_group=num_group, no_bias=bias is None,
+                layout=layout)
+
+
+def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, pooling_convention="valid"):
+    return _inv("Pooling", [data], kernel=kernel, pool_type=pool_type,
+                stride=stride, pad=pad, global_pool=global_pool,
+                pooling_convention=pooling_convention)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, axis=1, use_global_stats=False):
+    # delegate to the nd frontend: it owns the moving-stats aux update
+    # and the training/inference switch (the raw op returns 3 outputs)
+    from .. import ndarray as _nd
+    return _nd.BatchNorm(x, gamma, beta, running_mean, running_var,
+                         eps=eps, momentum=momentum, axis=axis,
+                         fix_gamma=False,
+                         use_global_stats=use_global_stats)
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    return _inv("LayerNorm", [x, gamma, beta], axis=axis, eps=eps)
+
+
+def dropout(x, p=0.5, mode="training"):
+    # delegate to the nd frontend: it threads the RNG key and the
+    # training flag (the raw op requires an explicit key input)
+    from .. import ndarray as _nd
+    return _nd.Dropout(x, p=p, mode=mode)
 
 
 def waitall():

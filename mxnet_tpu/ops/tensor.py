@@ -460,7 +460,10 @@ def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
         raise NotImplementedError(
             "pick(mode='raise'): use mode='clip' or 'wrap' (no "
             "data-dependent raising inside compiled XLA programs)")
-    idx = jnp.clip(index.astype("int32"), 0, data.shape[axis] - 1)
+    if mode == "wrap":
+        idx = jnp.mod(index.astype("int32"), data.shape[axis])
+    else:
+        idx = jnp.clip(index.astype("int32"), 0, data.shape[axis] - 1)
     out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)

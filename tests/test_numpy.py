@@ -187,3 +187,75 @@ class TestNpRandomContracts:
             mnp.tile(a, 2).asnumpy(), onp.tile(onp.arange(6.0), 2))
         onp.testing.assert_array_equal(
             mnp.repeat(a, 2).asnumpy(), onp.repeat(onp.arange(6.0), 2))
+
+
+class TestNpxSurface:
+    """npx = NN ops under numpy semantics (reference numpy_extension);
+    wrappers dispatch through the same registry as mx.nd."""
+
+    def test_activations_and_special(self):
+        x = mx.nd.array(onp.linspace(-2, 2, 12).reshape(3, 4)
+                     .astype("float32"))
+        onp.testing.assert_allclose(
+            mx.npx.relu(x).asnumpy(), onp.maximum(x.asnumpy(), 0))
+        onp.testing.assert_allclose(
+            mx.npx.leaky_relu(x, 0.1).asnumpy(),
+            onp.where(x.asnumpy() > 0, x.asnumpy(),
+                     0.1 * x.asnumpy()), rtol=1e-6)
+        from scipy import special as sp
+        onp.testing.assert_allclose(
+            mx.npx.erf(x).asnumpy(), sp.erf(x.asnumpy()), rtol=1e-5)
+        onp.testing.assert_allclose(
+            mx.npx.gammaln(mx.nd.array([2.5, 3.0])).asnumpy(),
+            sp.gammaln([2.5, 3.0]), rtol=1e-5)
+
+    def test_indexing_and_layers(self):
+        rng = onp.random.RandomState(0)
+        d = mx.nd.array(rng.randn(2, 5).astype("float32"))
+        got = mx.npx.pick(d, mx.nd.array([1.0, 3.0]))
+        onp.testing.assert_allclose(
+            got.asnumpy(), d.asnumpy()[[0, 1], [1, 3]])
+        a = mx.nd.array(rng.randn(2, 3, 4).astype("float32"))
+        onp.testing.assert_allclose(
+            mx.npx.batch_dot(a, a, transpose_b=True).asnumpy(),
+            onp.einsum("bij,bkj->bik", a.asnumpy(), a.asnumpy()),
+            rtol=1e-5)
+        w = mx.nd.array(rng.randn(6, 12).astype("float32"))
+        fc = mx.npx.fully_connected(a, w, num_hidden=6)
+        onp.testing.assert_allclose(
+            fc.asnumpy(), a.asnumpy().reshape(2, -1) @ w.asnumpy().T,
+            rtol=1e-4)
+        g = mx.nd.array(onp.ones(4, "float32"))
+        b = mx.nd.array(onp.zeros(4, "float32"))
+        ln = mx.npx.layer_norm(a, g, b).asnumpy()
+        ref = (a.asnumpy() - a.asnumpy().mean(-1, keepdims=True)) / \
+            onp.sqrt(a.asnumpy().var(-1, keepdims=True) + 1e-5)
+        onp.testing.assert_allclose(ln, ref, rtol=1e-4, atol=1e-5)
+
+    def test_np_mode_flags(self):
+        assert not mx.npx.is_np_array()
+        mx.npx.set_np()
+        assert mx.npx.is_np_array() and mx.npx.is_np_shape()
+        mx.npx.reset_np()
+        assert not mx.npx.is_np_shape()
+
+    def test_dropout_batchnorm_and_pick_wrap(self):
+        rng = onp.random.RandomState(0)
+        x = mx.nd.array(rng.randn(4, 6).astype("float32"))
+        # inference mode: identity
+        onp.testing.assert_allclose(mx.npx.dropout(x).asnumpy(),
+                                    x.asnumpy())
+        # always mode actually drops
+        d = mx.npx.dropout(x, p=0.5, mode="always").asnumpy()
+        assert (d == 0).any()
+        g = mx.nd.array(onp.ones(6, "float32"))
+        b = mx.nd.array(onp.zeros(6, "float32"))
+        rm = mx.nd.array(onp.zeros(6, "float32"))
+        rv = mx.nd.array(onp.ones(6, "float32"))
+        bn = mx.npx.batch_norm(x, g, b, rm, rv, axis=1)
+        onp.testing.assert_allclose(bn.asnumpy(), x.asnumpy(),
+                                    rtol=1e-4, atol=1e-5)
+        # wrap indexing: 5 % 4 == 1
+        got = mx.npx.pick(mx.nd.array([[0., 1., 2., 3.]]),
+                          mx.nd.array([5.]), mode="wrap")
+        assert float(got.asnumpy()[0]) == 1.0
