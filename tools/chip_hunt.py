@@ -78,6 +78,11 @@ def jobs(log_dir):
          [sys.executable, "benchmark/attention_bench.py",
           "--seqs", "128,512,1024,2048"], 1500, {},
          None, r"CPU backend"),
+        # ResNet-50 img/s — BASELINE.json macro metric #2
+        ("resnet50_bench",
+         [sys.executable, "benchmark/resnet_bench.py",
+          "--model", "resnet50_v1"], 1500, {},
+         r"images_per_sec", r'"platform": "cpu"'),
         # llama on-chip decode tok/s (VERDICT r2 next #8)
         ("llama_decode",
          [sys.executable, "example/llama_generate.py", "--ctx", "tpu",
