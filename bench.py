@@ -145,7 +145,7 @@ def probe_platform(timeout):
 
 def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
                         num_masked, steps, warmup, hidden, layers,
-                        heads):
+                        heads, remat=False):
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.contrib import amp
@@ -160,7 +160,8 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
 
         builder = getattr(models, builder_name)
         inner = models.BERTForPretrain(
-            builder(vocab_size=vocab, max_length=seq_len, dropout=0.1))
+            builder(vocab_size=vocab, max_length=seq_len, dropout=0.1,
+                    remat=remat))
 
         # full-length sequences need no padding mask; passing
         # valid_length=None keeps attention on the Pallas FLASH path
@@ -383,7 +384,8 @@ def main():
                 sps, mfu, fl = bench_bert_pretrain(
                     builder_name="bert_base", vocab=30522,
                     batch_size=bs, seq_len=seq, num_masked=20,
-                    steps=20, warmup=3, hidden=768, layers=12, heads=12)
+                    steps=20, warmup=3, hidden=768, layers=12,
+                    heads=12, remat=(seq >= 512))
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
                      f"samples/sec, mfu={mfu:.3f}, flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):

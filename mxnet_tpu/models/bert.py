@@ -25,7 +25,8 @@ __all__ = ["BERTModel", "BERTForPretrain", "bert_base", "bert_small",
 class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 type_vocab_size=2, dropout=0.1, **kwargs):
+                 type_vocab_size=2, dropout=0.1, remat=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.vocab_size = vocab_size
@@ -41,7 +42,8 @@ class BERTModel(HybridBlock):
             self.embed_dropout = nn.Dropout(dropout) if dropout else None
             self.encoder = TransformerEncoder(
                 units, hidden_size, num_layers, num_heads,
-                dropout=dropout, activation="gelu", prefix="enc_")
+                dropout=dropout, activation="gelu", remat=remat,
+                prefix="enc_")
             self.pooler = nn.Dense(units, activation="tanh",
                                    in_units=units, flatten=False,
                                    prefix="pooler_")

@@ -185,10 +185,12 @@ class DataParallelTrainer:
         # set when a fused step failed after its donated optimizer
         # state was handed to the executable (see _step_impl)
         self._donation_poisoned = None
-        # NDArray -> (source buffer, batch-sharded placement); weak so
-        # retired batches don't pin device memory
-        import weakref
-        self._placed = weakref.WeakKeyDictionary()
+        # id(NDArray) -> (weakref, source buffer, placed buffer);
+        # pruned to the CURRENT step's inputs each step, so at most
+        # n_args+1 placements are ever pinned (id keys because NDArray
+        # __eq__ is elementwise — a WeakKeyDictionary lookup would
+        # crash in bool())
+        self._placed = {}
         self._mutated_idx: List[int] = []
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
         if fuse_step and self._rule is None:
@@ -560,13 +562,16 @@ class DataParallelTrainer:
         try:
             batch = NamedSharding(self.mesh, P(self.dp_axis))
 
+            import weakref
+            used = set()
+
             def _put(a):
                 # skip the device_put when the array already carries
                 # the batch sharding — re-placing identical arrays
                 # cost ~400 us/step of pure host overhead.  Placements
-                # are cached in a trainer-side weak map (NOT written
-                # back into the caller's NDArray, whose advertised
-                # context must keep matching its actual buffer).
+                # live in a trainer-side cache (NOT written back into
+                # the caller's NDArray, whose advertised context must
+                # keep matching its actual buffer).
                 v = a._data
                 s = getattr(v, "sharding", None)
                 if s == batch:
@@ -577,15 +582,21 @@ class DataParallelTrainer:
                         return v
                 except (AttributeError, TypeError):
                     pass
-                hit = self._placed.get(a)
-                if hit is not None and hit[0] is v:
-                    return hit[1]
+                used.add(id(a))
+                hit = self._placed.get(id(a))
+                if hit is not None and hit[0]() is a and hit[1] is v:
+                    return hit[2]
                 out = jax.device_put(v, batch)
-                self._placed[a] = (v, out)
+                self._placed[id(a)] = (weakref.ref(a), v, out)
                 return out
 
             x_vals = tuple(_put(a) for a in args)
             y_val = _put(label)
+            if len(self._placed) > len(used):
+                # only this step's inputs stay pinned — an epoch of
+                # distinct batches must not accumulate device copies
+                self._placed = {k: h for k, h in self._placed.items()
+                                if k in used}
             key = _rnd._next_key_nd(args[0].context)
 
             param_vals = tuple(p.data()._data for p in self._params)

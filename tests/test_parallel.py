@@ -450,3 +450,27 @@ class TestGradientCompressionInTrainer:
             check_vma=False))
         txt = fq.lower(jnp.ones((8, 64), jnp.float32)).as_text()
         assert "all_to_all" in txt and "i8" in txt, txt[:500]
+
+
+def test_step_placement_cache_bounded_and_correct():
+    """The input-placement cache must serve reused batch NDArrays on a
+    multi-device mesh (the crash path for naive weak-keying: NDArray
+    __eq__ is elementwise) and stay bounded across distinct batches."""
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(3, in_units=6)
+    net.initialize(mx.init.Xavier())
+    dpt = parallel.DataParallelTrainer(
+        net, L2Loss(), "sgd", {"learning_rate": 0.01},
+        mesh=parallel.make_mesh({"dp": 4}), fuse_step=True)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, 6).astype("f4"))
+    y = nd.array(rng.randn(8, 3).astype("f4"))
+    sh = x._data.sharding
+    for _ in range(4):                      # reuse: hits the cache
+        l1 = float(dpt.step(x, y).asnumpy())
+    assert np.isfinite(l1)
+    assert x._data.sharding == sh           # caller never mutated
+    for i in range(6):                      # distinct batches
+        dpt.step(nd.array(rng.randn(8, 6).astype("f4")),
+                 nd.array(rng.randn(8, 3).astype("f4")))
+    assert len(dpt._placed) <= 2            # bounded to current inputs
