@@ -26,7 +26,7 @@ from ..base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
-           "row_sparse_array", "zeros", "dot"]
+           "row_sparse_array", "zeros", "dot", "retain"]
 
 
 def _jnp():
@@ -48,8 +48,56 @@ def _densify_csr(vals, idx, iptr, shape):
 
 
 class _SparseFacade(NDArray):
-    __slots__ = ()
+    """Common lazy-compressed machinery: subclasses store their
+    compressed parts in ``_parts`` (+ ``_parts_shape`` metadata) and
+    implement ``_densify()``; a dense buffer materializes only when a
+    generic op touches ``_data``."""
+
+    __slots__ = ("_parts",)
     _stype = "default"
+
+    def __init__(self, data, ctx=None, _base=None, _index=None):
+        super().__init__(data, ctx=ctx, _base=_base, _index=_index)
+        self._parts = None
+
+    def _densify(self):  # pragma: no cover - overridden when used
+        raise NotImplementedError
+
+    @property
+    def _data(self):
+        # generic ops densify LAZILY; sparse-aware paths (dot/retain,
+        # the compressed-part properties) never come through here
+        if self._buf is None and self._base is None and \
+                self._parts is not None:
+            self._buf = self._densify()
+        return NDArray._data.fget(self)
+
+    def _set_data(self, new):
+        self._parts = None   # a dense mutation invalidates the parts
+        NDArray._set_data(self, new)
+
+    @property
+    def is_compressed(self):
+        """True while no dense buffer has been materialized."""
+        return self._buf is None and self._parts is not None
+
+    @property
+    def shape(self):
+        if self.is_compressed:
+            return tuple(self._parts[-1])
+        return NDArray.shape.fget(self)
+
+    @property
+    def dtype(self):
+        if self.is_compressed:
+            return self._parts[0].dtype
+        return NDArray.dtype.fget(self)
+
+    @property
+    def ndim(self):
+        if self.is_compressed:
+            return len(self._parts[-1])
+        return NDArray.ndim.fget(self)
 
     @property
     def stype(self):
@@ -62,54 +110,22 @@ class _SparseFacade(NDArray):
 
 
 class CSRNDArray(_SparseFacade):
-    __slots__ = ("_csr",)
+    __slots__ = ()
     _stype = "csr"
-
-    def __init__(self, data, ctx=None, _base=None, _index=None):
-        super().__init__(data, ctx=ctx, _base=_base, _index=_index)
-        self._csr = None   # (vals, indices, indptr, shape) when compressed
+    # _parts = (vals, indices, indptr, shape) when compressed
 
     @property
-    def _data(self):
-        # generic ops densify LAZILY; sparse-aware paths (dot, the
-        # compressed-part properties) never come through here
-        if self._buf is None and self._base is None and \
-                self._csr is not None:
-            self._buf = _densify_csr(*self._csr)
-        return NDArray._data.fget(self)
+    def _csr(self):   # sparse-aware callers (dot) read this
+        return self._parts
 
-    def _set_data(self, new):
-        self._csr = None   # a dense mutation invalidates the parts
-        NDArray._set_data(self, new)
-
-    @property
-    def shape(self):
-        if self._buf is None and self._csr is not None:
-            return tuple(self._csr[3])
-        return NDArray.shape.fget(self)
-
-    @property
-    def dtype(self):
-        if self._buf is None and self._csr is not None:
-            return self._csr[0].dtype
-        return NDArray.dtype.fget(self)
-
-    @property
-    def is_compressed(self):
-        """True while no dense buffer has been materialized."""
-        return self._buf is None and self._csr is not None
-
-    @property
-    def ndim(self):
-        if self._buf is None and self._csr is not None:
-            return len(self._csr[3])
-        return NDArray.ndim.fget(self)
+    def _densify(self):
+        return _densify_csr(*self._parts)
 
     @property
     def indices(self):
-        if self._csr is not None:
+        if self._parts is not None:
             # already on device: wrap, don't round-trip via host
-            return NDArray(self._csr[1].astype(_jnp().int64),
+            return NDArray(self._parts[1].astype(_jnp().int64),
                            ctx=self._ctx)
         a = self.asnumpy()
         return array(np.nonzero(a)[1].astype("int64"), ctx=self._ctx,
@@ -117,8 +133,8 @@ class CSRNDArray(_SparseFacade):
 
     @property
     def indptr(self):
-        if self._csr is not None:
-            return NDArray(self._csr[2].astype(_jnp().int64),
+        if self._parts is not None:
+            return NDArray(self._parts[2].astype(_jnp().int64),
                            ctx=self._ctx)
         a = self.asnumpy()
         counts = (a != 0).sum(axis=1)
@@ -127,8 +143,8 @@ class CSRNDArray(_SparseFacade):
 
     @property
     def data(self):
-        if self._csr is not None:
-            return NDArray(self._csr[0], ctx=self._ctx)
+        if self._parts is not None:
+            return NDArray(self._parts[0], ctx=self._ctx)
         a = self.asnumpy()
         return array(a[a != 0], ctx=self._ctx)
 
@@ -136,12 +152,32 @@ class CSRNDArray(_SparseFacade):
 class RowSparseNDArray(_SparseFacade):
     __slots__ = ()
     _stype = "row_sparse"
+    # _parts = (row values, row indices, shape) when compressed
+
+    @property
+    def _rsp(self):   # sparse-aware callers (retain) read this
+        return self._parts
+
+    def _densify(self):
+        vals, idx, shape = self._parts
+        return _jnp().zeros(shape, vals.dtype).at[idx].set(vals)
 
     @property
     def indices(self):
+        if self._parts is not None:
+            return NDArray(self._parts[1].astype(_jnp().int64),
+                           ctx=self._ctx)
         a = self.asnumpy()
         nz = np.nonzero(np.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
         return array(nz.astype("int64"), ctx=self._ctx, dtype="int64")
+
+    @property
+    def data(self):
+        if self._parts is not None:
+            return NDArray(self._parts[0], ctx=self._ctx)
+        a = self.asnumpy()
+        nz = np.nonzero(np.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
+        return array(a[nz], ctx=self._ctx)
 
 
 def _make(stype, data, ctx):
@@ -187,7 +223,7 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype="float32"):
     idx = jnp.asarray(idx_np)
     iptr = jnp.asarray(iptr_np)
     out = CSRNDArray(None, ctx=ctx)
-    out._csr = (vals, idx, iptr, tuple(int(d) for d in shape))
+    out._parts = (vals, idx, iptr, tuple(int(d) for d in shape))
     return out
 
 
@@ -195,11 +231,61 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype="float32"):
     if isinstance(arg1, (list, np.ndarray, NDArray)) and shape is None:
         base = array(arg1, ctx=ctx, dtype=dtype)
         return _make("row_sparse", base._data, base._ctx)
+    # (data, indices): compressed rows only — the 10M-row embedding
+    # gradient with 1k touched rows costs 1k rows of memory
     data, indices = arg1
-    dense = np.zeros(shape, dtype=dtype)
-    data = np.asarray(data, dtype=dtype)
-    dense[np.asarray(indices, dtype="int64")] = data
-    base = array(dense, ctx=ctx, dtype=dtype)
+    if shape is None:
+        raise MXNetError("row_sparse_array from (data, indices) "
+                         "requires shape=")
+    vals_np = np.asarray(data, dtype=dtype)
+    idx_np = np.asarray(indices, dtype="int32")
+    if vals_np.shape[0] != idx_np.shape[0]:
+        raise MXNetError("data and indices must have equal length")
+    if vals_np.ndim != len(shape) or \
+            vals_np.shape[1:] != tuple(int(d) for d in shape[1:]):
+        raise MXNetError(
+            f"data shape {vals_np.shape} incompatible with row-sparse "
+            f"shape {tuple(shape)} (need (k,) + shape[1:])")
+    if idx_np.size and (idx_np.min() < 0
+                        or idx_np.max() >= int(shape[0])):
+        raise MXNetError(
+            f"row indices out of range for shape {tuple(shape)}")
+    if idx_np.size > 1 and not (np.diff(idx_np) > 0).all():
+        raise MXNetError("row indices must be strictly increasing "
+                         "(sorted, unique) — the row_sparse contract")
+    jnp = _jnp()
+    out = RowSparseNDArray(None, ctx=ctx)
+    out._parts = (jnp.asarray(vals_np), jnp.asarray(idx_np),
+                  tuple(int(d) for d in shape))
+    return out
+
+
+def retain(data, indices):
+    """Keep only the listed rows (parity: ``mx.nd.sparse.retain``).
+
+    On a COMPRESSED row_sparse array the selection runs on the stored
+    rows only (host-side index intersection, device gather); anything
+    else densifies and masks."""
+    keep = np.asarray(
+        indices.asnumpy() if isinstance(indices, NDArray) else indices,
+        dtype="int64")
+    n_rows = int(data.shape[0])
+    if keep.size and (keep.min() < 0 or keep.max() >= n_rows):
+        raise MXNetError(
+            f"retain: indices out of range for {n_rows} rows")
+    if isinstance(data, RowSparseNDArray) and data._parts is not None:
+        vals, idx, shape = data._parts
+        sel = _jnp().asarray(np.nonzero(np.isin(np.asarray(idx),
+                                                keep))[0])
+        out = RowSparseNDArray(None, ctx=data._ctx)
+        out._parts = (vals[sel], idx[sel], shape)
+        return out
+    a = data.asnumpy().copy()
+    mask = np.zeros(a.shape[0], bool)
+    mask[keep] = True
+    a[~mask] = 0
+    base = array(a, ctx=data.context if isinstance(data, NDArray)
+                 else None)
     return _make("row_sparse", base._data, base._ctx)
 
 

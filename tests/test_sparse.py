@@ -285,3 +285,65 @@ class TestCompressedCSR:
         assert m.ndim == 2 and m.shape == (4, 4)
         assert m.dtype == np.float32
         assert m.is_compressed
+
+
+class TestCompressedRowSparse:
+    """row_sparse from (data, indices) mirrors the csr tier: compressed
+    storage, lazy densify, nnz-only retain."""
+
+    def test_compressed_roundtrip_and_lazy(self):
+        vals = np.asarray([[1., 2.], [3., 4.]], "float32")
+        m = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 2))
+        assert m.is_compressed and m.shape == (5, 2) and m.ndim == 2
+        np.testing.assert_array_equal(m.indices.asnumpy(), [1, 3])
+        np.testing.assert_array_equal(m.data.asnumpy(), vals)
+        assert m.is_compressed          # metadata reads stay light
+        dense = m.asnumpy()             # lazy materialize
+        want = np.zeros((5, 2), "float32")
+        want[[1, 3]] = vals
+        np.testing.assert_array_equal(dense, want)
+
+    def test_retain_compressed_and_dense(self):
+        vals = np.asarray([[1.], [2.], [3.]], "float32")
+        m = sparse.row_sparse_array((vals, [0, 2, 4]), shape=(6, 1))
+        r = sparse.retain(m, nd.array([2., 4.]))
+        assert r.is_compressed
+        np.testing.assert_array_equal(r.indices.asnumpy(), [2, 4])
+        np.testing.assert_array_equal(r.data.asnumpy(), [[2.], [3.]])
+        # dense-built path agrees
+        d = sparse.retain(m.tostype("row_sparse"), nd.array([2., 4.]))
+        np.testing.assert_array_equal(d.asnumpy(), r.asnumpy())
+
+    def test_huge_gradient_stays_row_sized(self):
+        n = 10_000_000                       # dense would be 40 GB
+        vals = np.ones((1000, 1), "float32")
+        m = sparse.row_sparse_array((vals, np.arange(1000) * 9973),
+                                    shape=(n, 1))
+        assert m.is_compressed
+        r = sparse.retain(m, np.arange(500) * 9973)
+        assert r.is_compressed
+        assert float(r.data.asnumpy().sum()) == 500
+
+    def test_validation(self):
+        with pytest.raises(mx.MXNetError, match="increasing"):
+            sparse.row_sparse_array((np.ones((2, 1), "f4"), [3, 1]),
+                                    shape=(5, 1))
+        with pytest.raises(mx.MXNetError, match="range"):
+            sparse.row_sparse_array((np.ones((1, 1), "f4"), [9]),
+                                    shape=(5, 1))
+
+    def test_retain_rejects_bad_indices_both_paths(self):
+        vals = np.asarray([[1.], [2.]], "float32")
+        m = sparse.row_sparse_array((vals, [0, 2]), shape=(4, 1))
+        for bad in ([-1], [9]):
+            with pytest.raises(mx.MXNetError, match="range"):
+                sparse.retain(m, np.asarray(bad))
+            with pytest.raises(mx.MXNetError, match="range"):
+                sparse.retain(m.tostype("row_sparse"),
+                              np.asarray(bad))
+
+    def test_row_shape_mismatch_rejected(self):
+        with pytest.raises(mx.MXNetError, match="incompatible"):
+            sparse.row_sparse_array(
+                (np.asarray([1., 2.], "float32"), [0, 1]),
+                shape=(5, 2))
