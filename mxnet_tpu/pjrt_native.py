@@ -115,7 +115,10 @@ def _err(L) -> str:
 
 
 class NativeBuffer:
-    """A device-resident PJRT buffer handle."""
+    """A device-resident PJRT buffer handle.
+
+    Lifetime contract (standard PJRT): close every buffer and
+    executable BEFORE closing the client that produced them."""
 
     def __init__(self, client, handle):
         self._client = client

@@ -1,0 +1,239 @@
+// A minimal in-memory PJRT plugin for testing the native executor
+// (src/pjrt_executor.cc) without TPU hardware.
+//
+// Semantics: one fake device; Compile accepts any program and returns
+// an "echo executable" with ONE output; Execute copies argument 0's
+// buffer to the output.  That is enough to drive every call the
+// executor makes — plugin load, client create, compile, host->device,
+// execute, device->host, destroys — through the real PJRT C ABI
+// structs, so the ctypes marshaling and C++ plumbing are testable in
+// CI.  Built by tests/test_pjrt_native.py.
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct Error {  // our PJRT_Error
+  std::string msg;
+};
+
+struct MockEvent {
+  Error* err = nullptr;  // ownership transferred on Await
+};
+
+struct MockBuffer {
+  PJRT_Buffer_Type type;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> bytes;
+};
+
+struct MockExec {
+  int dummy = 0;
+};
+
+int g_client = 0;   // address doubles as PJRT_Client*
+int g_device = 0;   // address doubles as PJRT_Device*
+
+PJRT_Error* err(const std::string& m) {
+  return reinterpret_cast<PJRT_Error*>(new Error{m});
+}
+
+void error_message(PJRT_Error_Message_Args* a) {
+  auto* e = reinterpret_cast<const Error*>(a->error);
+  a->message = e->msg.c_str();
+  a->message_size = e->msg.size();
+}
+
+void error_destroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<Error*>(const_cast<PJRT_Error*>(a->error));
+}
+
+PJRT_Error* plugin_initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* client_create(PJRT_Client_Create_Args* a) {
+  a->client = reinterpret_cast<PJRT_Client*>(&g_client);
+  return nullptr;
+}
+
+PJRT_Error* client_destroy(PJRT_Client_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Device* g_devices[1] = {
+    reinterpret_cast<PJRT_Device*>(&g_device)};
+
+PJRT_Error* addressable_devices(
+    PJRT_Client_AddressableDevices_Args* a) {
+  a->addressable_devices = g_devices;
+  a->num_addressable_devices = 1;
+  return nullptr;
+}
+
+const char kPlatform[] = "mockpjrt";
+
+PJRT_Error* platform_name(PJRT_Client_PlatformName_Args* a) {
+  a->platform_name = kPlatform;
+  a->platform_name_size = sizeof(kPlatform) - 1;
+  return nullptr;
+}
+
+PJRT_Error* compile(PJRT_Client_Compile_Args* a) {
+  if (a->program == nullptr || a->program->code_size == 0)
+    return err("mock compile: empty program");
+  a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(new MockExec);
+  return nullptr;
+}
+
+PJRT_Error* get_executable(
+    PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable =
+      reinterpret_cast<PJRT_Executable*>(new MockExec);
+  return nullptr;
+}
+
+PJRT_Error* num_outputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs = 1;  // the echo executable
+  return nullptr;
+}
+
+PJRT_Error* exec_destroy(PJRT_Executable_Destroy_Args* a) {
+  delete reinterpret_cast<MockExec*>(a->executable);
+  return nullptr;
+}
+
+PJRT_Error* loaded_destroy(PJRT_LoadedExecutable_Destroy_Args* a) {
+  delete reinterpret_cast<MockExec*>(a->executable);
+  return nullptr;
+}
+
+size_t type_size(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+      return 8;
+    default:
+      return 4;
+  }
+}
+
+PJRT_Error* buffer_from_host(
+    PJRT_Client_BufferFromHostBuffer_Args* a) {
+  auto* b = new MockBuffer;
+  b->type = a->type;
+  b->dims.assign(a->dims, a->dims + a->num_dims);
+  int64_t n = 1;
+  for (auto d : b->dims) n *= d;
+  size_t nbytes = (size_t)n * type_size(a->type);
+  b->bytes.resize(nbytes);
+  if (a->byte_strides != nullptr && a->num_byte_strides != 0)
+    return err("mock: strided host buffers unsupported");
+  std::memcpy(b->bytes.data(), a->data, nbytes);
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  a->done_with_host_buffer =
+      reinterpret_cast<PJRT_Event*>(new MockEvent);
+  return nullptr;
+}
+
+PJRT_Error* event_await(PJRT_Event_Await_Args* a) {
+  auto* e = reinterpret_cast<MockEvent*>(a->event);
+  PJRT_Error* out = reinterpret_cast<PJRT_Error*>(e->err);
+  e->err = nullptr;
+  return out;
+}
+
+PJRT_Error* event_destroy(PJRT_Event_Destroy_Args* a) {
+  auto* e = reinterpret_cast<MockEvent*>(a->event);
+  delete e->err;
+  delete e;
+  return nullptr;
+}
+
+PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
+  delete reinterpret_cast<MockBuffer*>(a->buffer);
+  return nullptr;
+}
+
+PJRT_Error* buffer_element_type(PJRT_Buffer_ElementType_Args* a) {
+  a->type = reinterpret_cast<MockBuffer*>(a->buffer)->type;
+  return nullptr;
+}
+
+PJRT_Error* buffer_dimensions(PJRT_Buffer_Dimensions_Args* a) {
+  auto* b = reinterpret_cast<MockBuffer*>(a->buffer);
+  a->dims = b->dims.data();
+  a->num_dims = b->dims.size();
+  return nullptr;
+}
+
+PJRT_Error* buffer_to_host(PJRT_Buffer_ToHostBuffer_Args* a) {
+  auto* b = reinterpret_cast<MockBuffer*>(a->src);
+  if (a->dst == nullptr) {
+    a->dst_size = b->bytes.size();
+    return nullptr;
+  }
+  if (a->dst_size < b->bytes.size())
+    return err("mock: dst too small");
+  std::memcpy(a->dst, b->bytes.data(), b->bytes.size());
+  a->event = reinterpret_cast<PJRT_Event*>(new MockEvent);
+  return nullptr;
+}
+
+PJRT_Error* execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  if (a->num_devices != 1) return err("mock: single device only");
+  if (a->num_args < 1) return err("mock echo: needs >= 1 argument");
+  auto* in = reinterpret_cast<MockBuffer*>(
+      const_cast<PJRT_Buffer*>(a->argument_lists[0][0]));
+  auto* out = new MockBuffer(*in);  // the echo
+  a->output_lists[0][0] = reinterpret_cast<PJRT_Buffer*>(out);
+  if (a->device_complete_events != nullptr)
+    a->device_complete_events[0] =
+        reinterpret_cast<PJRT_Event*>(new MockEvent);
+  return nullptr;
+}
+
+PJRT_Api g_api = [] {
+  PJRT_Api api;
+  std::memset(&api, 0, sizeof(api));
+  api.struct_size = sizeof(PJRT_Api);
+  api.PJRT_Error_Message = error_message;
+  api.PJRT_Error_Destroy = error_destroy;
+  api.PJRT_Plugin_Initialize = plugin_initialize;
+  api.PJRT_Client_Create = client_create;
+  api.PJRT_Client_Destroy = client_destroy;
+  api.PJRT_Client_AddressableDevices = addressable_devices;
+  api.PJRT_Client_PlatformName = platform_name;
+  api.PJRT_Client_Compile = compile;
+  api.PJRT_Client_BufferFromHostBuffer = buffer_from_host;
+  api.PJRT_LoadedExecutable_GetExecutable = get_executable;
+  api.PJRT_LoadedExecutable_Destroy = loaded_destroy;
+  api.PJRT_LoadedExecutable_Execute = execute;
+  api.PJRT_Executable_NumOutputs = num_outputs;
+  api.PJRT_Executable_Destroy = exec_destroy;
+  api.PJRT_Event_Await = event_await;
+  api.PJRT_Event_Destroy = event_destroy;
+  api.PJRT_Buffer_Destroy = buffer_destroy;
+  api.PJRT_Buffer_ElementType = buffer_element_type;
+  api.PJRT_Buffer_Dimensions = buffer_dimensions;
+  api.PJRT_Buffer_ToHostBuffer = buffer_to_host;
+  return api;
+}();
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() { return &g_api; }
