@@ -66,6 +66,7 @@ from . import optimizer
 from . import metric
 from . import io
 from . import gluon
+from . import deploy
 from . import test_utils
 from . import kvstore
 from . import kvstore as kv

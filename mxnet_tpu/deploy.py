@@ -1,0 +1,131 @@
+"""Python-free deployment artifacts.
+
+The reference's deploy story is ``net.export()`` → symbol JSON + params
+blob → the C predict API rebuilds the graph at load time.  The
+TPU-native equivalent skips graph rebuilding entirely:
+:func:`export_stablehlo` lowers a hybridizable block ONCE with its
+trained parameters baked in as constants and writes a bundle holding
+
+* the raw serialized StableHLO module — exactly what
+  ``PJRT_Client_Compile`` takes, so the C ABI in ``libmxtpu_pjrt.so``
+  (load → compile → execute) and ``mxnet_tpu.pjrt_native`` consume it
+  with no Python anywhere; and
+* a ``jax.export`` blob for in-process consumers (versioned, shape-
+  checked calls).
+
+The two sections both embed the module (so the bundle is ~2x the
+module size, weights included); large pure-C deployments can strip the
+jax blob by rewriting the bundle with ``n_blob = 0``.
+
+    mx.deploy.export_stablehlo(net, example, "model.mxshlo")
+    run = mx.deploy.load_stablehlo_jax("model.mxshlo")   # python
+    code = mx.deploy.read_stablehlo("model.mxshlo")      # C / PJRT
+"""
+from __future__ import annotations
+
+import struct
+
+from .base import MXNetError
+
+__all__ = ["export_stablehlo", "load_stablehlo_jax", "read_stablehlo"]
+
+_MAGIC = b"MXTPUSHLO2"
+
+
+def _functionalize(block, example_inputs):
+    """A pure fn(x...) -> flat outputs with params closed over as
+    constants (the hybridize trace seam, weights baked)."""
+    from .gluon import block as block_mod
+    from .ndarray.ndarray import NDArray
+
+    ctx = example_inputs[0].context
+
+    def fn(*xs):
+        shells = [NDArray(x, ctx=ctx) for x in xs]
+        prev = getattr(block_mod._trace_state, "active", False)
+        block_mod._trace_state.active = True
+        try:
+            out = block(*shells)
+        finally:
+            block_mod._trace_state.active = prev
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in outs)
+
+    return fn
+
+
+def export_stablehlo(block, example_inputs, path: str) -> int:
+    """Lower ``block`` (params as constants) and write the bundle.
+    Returns the number of outputs.
+
+    The block must be initialized and shape-resolved (run one forward
+    first, as for ``export``)."""
+    import jax
+
+    if not isinstance(example_inputs, (list, tuple)):
+        example_inputs = [example_inputs]
+    if not example_inputs:
+        raise MXNetError("export_stablehlo needs example inputs")
+    fn = _functionalize(block, example_inputs)
+    exported = jax.export.export(jax.jit(fn))(
+        *[a._data for a in example_inputs])
+    blob = exported.serialize()
+    code = exported.mlir_module_serialized
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<QQ", len(code), len(blob)))
+        f.write(code)
+        f.write(blob)
+    return len(exported.out_avals)
+
+
+def _read(path: str):
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            raise MXNetError(f"{path}: not an MXTPU StableHLO bundle")
+        hdr = f.read(16)
+        if len(hdr) != 16:
+            raise MXNetError(f"{path}: truncated bundle header")
+        n_code, n_blob = struct.unpack("<QQ", hdr)
+        code = f.read(n_code)
+        blob = f.read(n_blob)
+        if len(code) != n_code or len(blob) != n_blob:
+            raise MXNetError(f"{path}: truncated bundle")
+        return code, blob
+
+
+def read_stablehlo(path: str) -> bytes:
+    """The raw StableHLO module bytes — what ``MXTPUPjrtCompile`` /
+    ``pjrt_native.NativeClient.compile`` consume directly.  Reads only
+    the raw section (the jax blob is skipped, not loaded)."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            raise MXNetError(f"{path}: not an MXTPU StableHLO bundle")
+        hdr = f.read(16)
+        if len(hdr) != 16:
+            raise MXNetError(f"{path}: truncated bundle header")
+        n_code, _ = struct.unpack("<QQ", hdr)
+        code = f.read(n_code)
+        if len(code) != n_code:
+            raise MXNetError(f"{path}: truncated bundle")
+        return code
+
+
+def load_stablehlo_jax(path: str):
+    """Load the bundle as a Python callable (in-process consumer;
+    returns a list of numpy arrays)."""
+    import jax
+    import numpy as np
+
+    _, blob = _read(path)
+    exported = jax.export.deserialize(blob)
+
+    def run(*arrays):
+        outs = exported.call(*[np.asarray(a) for a in arrays])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return [np.asarray(o) for o in outs]
+
+    return run

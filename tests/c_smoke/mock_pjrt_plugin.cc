@@ -134,6 +134,8 @@ size_t type_size(PJRT_Buffer_Type t) {
 
 PJRT_Error* buffer_from_host(
     PJRT_Client_BufferFromHostBuffer_Args* a) {
+  if (a->byte_strides != nullptr && a->num_byte_strides != 0)
+    return err("mock: strided host buffers unsupported");
   auto* b = new MockBuffer;
   b->type = a->type;
   b->dims.assign(a->dims, a->dims + a->num_dims);
@@ -141,8 +143,6 @@ PJRT_Error* buffer_from_host(
   for (auto d : b->dims) n *= d;
   size_t nbytes = (size_t)n * type_size(a->type);
   b->bytes.resize(nbytes);
-  if (a->byte_strides != nullptr && a->num_byte_strides != 0)
-    return err("mock: strided host buffers unsupported");
   std::memcpy(b->bytes.data(), a->data, nbytes);
   a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
   a->done_with_host_buffer =

@@ -136,3 +136,26 @@ class TestAgainstMockPlugin:
         with pytest.raises(MXNetError, match="empty program"):
             client.compile(b"", "mlir", options=b"")
         client.close()
+
+
+@pytest.mark.tpu
+def test_exported_bundle_runs_natively(tmp_path):
+    """mx.deploy bundle -> NativeClient.compile -> execute on the
+    real chip; output matches the Python forward."""
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import nd
+    net = gnn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 8)
+                 .astype("float32"))
+    want = net(x).asnumpy()
+    p = str(tmp_path / "m.mxshlo")
+    mx.deploy.export_stablehlo(net, [x], p)
+    client = pjrt_native.NativeClient()
+    exe = client.compile(mx.deploy.read_stablehlo(p), "mlir")
+    (out,) = exe(x.asnumpy())
+    np.testing.assert_allclose(out.to_numpy(), want, rtol=2e-2,
+                               atol=1e-2)
+    out.close()
+    exe.close()
+    client.close()
