@@ -67,6 +67,8 @@ from . import metric
 from . import io
 from . import gluon
 from . import deploy
+from . import visualization
+from . import visualization as viz
 from . import test_utils
 from . import kvstore
 from . import kvstore as kv

@@ -79,7 +79,7 @@ def export_stablehlo(block, example_inputs, path: str) -> int:
     return len(exported.out_avals)
 
 
-def _read(path: str):
+def _read(path: str, want_blob: bool = True):
     with open(path, "rb") as f:
         head = f.read(len(_MAGIC))
         if head != _MAGIC:
@@ -89,8 +89,12 @@ def _read(path: str):
             raise MXNetError(f"{path}: truncated bundle header")
         n_code, n_blob = struct.unpack("<QQ", hdr)
         code = f.read(n_code)
+        if len(code) != n_code:
+            raise MXNetError(f"{path}: truncated bundle")
+        if not want_blob:
+            return code, None
         blob = f.read(n_blob)
-        if len(code) != n_code or len(blob) != n_blob:
+        if len(blob) != n_blob:
             raise MXNetError(f"{path}: truncated bundle")
         return code, blob
 
@@ -99,18 +103,7 @@ def read_stablehlo(path: str) -> bytes:
     """The raw StableHLO module bytes — what ``MXTPUPjrtCompile`` /
     ``pjrt_native.NativeClient.compile`` consume directly.  Reads only
     the raw section (the jax blob is skipped, not loaded)."""
-    with open(path, "rb") as f:
-        head = f.read(len(_MAGIC))
-        if head != _MAGIC:
-            raise MXNetError(f"{path}: not an MXTPU StableHLO bundle")
-        hdr = f.read(16)
-        if len(hdr) != 16:
-            raise MXNetError(f"{path}: truncated bundle header")
-        n_code, _ = struct.unpack("<QQ", hdr)
-        code = f.read(n_code)
-        if len(code) != n_code:
-            raise MXNetError(f"{path}: truncated bundle")
-        return code
+    return _read(path, want_blob=False)[0]
 
 
 def load_stablehlo_jax(path: str):

@@ -1,0 +1,159 @@
+"""``mx.viz`` — network visualization (parity:
+``python/mxnet/visualization.py``): ``print_summary`` renders the
+layer table with per-layer output shapes and parameter counts;
+``plot_network`` emits a graphviz Digraph of the symbol DAG.  Both
+read the same serialized graph (``Symbol.tojson``) the executor uses.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+_PARAM_SUFFIXES = ("weight", "bias", "gamma", "beta", "moving_mean",
+                   "moving_var", "running_mean", "running_var")
+
+
+def _graph(symbol):
+    g = json.loads(symbol.tojson())
+    return g["nodes"], g["heads"]
+
+
+def _infer(symbol, shape):
+    """All internal output shapes keyed by output name, or {} when
+    inference cannot complete (infer_shape_partial yields None)."""
+    if not shape:
+        return {}
+    internals = symbol.get_internals()
+    names = internals.list_outputs()
+    _, shapes, _ = internals.infer_shape_partial(**shape)
+    if shapes is None:
+        return {}
+    return dict(zip(names, shapes))
+
+
+def _make_is_param(inputs):
+    def is_param(node):
+        # a null node is a PARAMETER unless the caller listed it as an
+        # input; without shapes, fall back to conventional suffixes
+        if node["op"] != "null":
+            return False
+        if inputs:
+            return node["name"] not in inputs
+        return node["name"].endswith(_PARAM_SUFFIXES)
+    return is_param
+
+
+def _out_shape(shapes, name):
+    """Probe the single- and multi-output key spellings."""
+    for k in (name + "_output", name + "_output0", name):
+        if k in shapes:
+            return shapes[k]
+    return ""
+
+
+def print_summary(symbol, shape=None, line_length=98):
+    """Layer-table summary (parity: ``mx.viz.print_summary``).
+
+    ``shape``: dict of input name -> shape, forwarded to
+    ``infer_shape`` so the table carries real output shapes and exact
+    parameter counts."""
+    nodes, _ = _graph(symbol)
+    inputs = set(shape or ())
+    out_shapes = _infer(symbol, shape)
+    is_param = _make_is_param(inputs)
+
+    def n_params(node):
+        # variable nodes appear in the internals outputs by plain
+        # name, so one inference pass serves both columns
+        total = 0
+        for i_idx, *_ in node["inputs"]:
+            src = nodes[i_idx]
+            if is_param(src):
+                shp = out_shapes.get(src["name"])
+                if shp:
+                    p = 1
+                    for d in shp:
+                        p *= int(d)
+                    total += p
+        return total
+
+    hdr = f"{'Layer (type)':<34}{'Output Shape':<26}" \
+          f"{'Param #':>10}  Connected to"
+    lines = ["_" * line_length, hdr, "=" * line_length]
+    total_params = 0
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        name = node["name"]
+        oshape = _out_shape(out_shapes, name)
+        p = n_params(node)
+        total_params += p
+        ins = ", ".join(
+            nodes[i]["name"] for i, *_ in node["inputs"]
+            if nodes[i]["op"] != "null")
+        lines.append(f"{name + ' (' + node['op'] + ')':<34}"
+                     f"{str(oshape):<26}{p:>10}  {ins}")
+    lines += ["=" * line_length,
+              f"Total params: {total_params:,}",
+              "_" * line_length]
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+_FILL = {"Convolution": "#4f8dd1", "Deconvolution": "#4f8dd1",
+         "FullyConnected": "#cd6155", "BatchNorm": "#58d68d",
+         "LayerNorm": "#58d68d", "Activation": "#f5b041",
+         "Pooling": "#af7ac5", "softmax": "#5dade2",
+         "SoftmaxOutput": "#5dade2"}
+
+
+def plot_network(symbol, title="plot", shape=None,
+                 node_attrs=None, save_format="pdf"):
+    """Graphviz Digraph of the symbol DAG (parity:
+    ``mx.viz.plot_network``); call ``.render()`` / ``.view()`` on the
+    result, or access ``.source`` for the dot text."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError("plot_network requires the graphviz "
+                         "package") from e
+    nodes, heads = _graph(symbol)
+    inputs = set(shape or ())
+    shape_info = _infer(symbol, shape)
+    is_param = _make_is_param(inputs)
+
+    dot = Digraph(name=title, format=save_format)
+    base_attrs = {"shape": "box", "fixedsize": "false",
+                  "style": "rounded,filled"}
+    base_attrs.update(node_attrs or {})
+    for idx, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if not is_param(node):
+                dot.node(str(idx), name, **dict(
+                    base_attrs, fillcolor="#eeeeee", shape="oval"))
+            continue
+        label = f"{name}\\n{op}"
+        attrs = node.get("attrs") or {}
+        for k in ("kernel", "stride", "num_hidden", "num_filter",
+                  "act_type", "pool_type"):
+            if k in attrs:
+                label += f"\\n{k}={attrs[k]}"
+        dot.node(str(idx), label, **dict(
+            base_attrs, fillcolor=_FILL.get(op, "#d5dbdb")))
+        for i_idx, *_ in node["inputs"]:
+            src = nodes[i_idx]
+            if is_param(src):
+                continue
+            edge_label = ""
+            shp = _out_shape(shape_info, src["name"]) \
+                if src["op"] != "null" else shape_info.get(src["name"])
+            if shp:
+                edge_label = "x".join(str(d) for d in shp[1:]) or "1"
+            dot.edge(str(i_idx), str(idx), label=edge_label)
+    return dot

@@ -1,0 +1,86 @@
+"""mx.viz (reference python/mxnet/visualization.py): layer summary +
+graphviz network plot over the serialized symbol graph."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _convnet():
+    x = sym.var("data")
+    h = sym.Convolution(x, sym.var("cw"), sym.var("cb"),
+                        kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        name="conv1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool1")
+    h = sym.Flatten(h, name="flat")
+    h = sym.FullyConnected(h, sym.var("fw"), sym.var("fb"),
+                           num_hidden=10, name="fc1")
+    return sym.softmax(h, name="sm")
+
+
+def test_print_summary_shapes_and_params(capsys):
+    txt = mx.viz.print_summary(_convnet(),
+                               shape={"data": (2, 3, 16, 16)})
+    assert "conv1 (Convolution)" in txt
+    assert "(2, 8, 16, 16)" in txt        # conv output shape
+    assert "(2, 512)" in txt              # flatten
+    # 3*3*3*8 + 8 = 224 conv; 512*10 + 10 = 5130 fc
+    assert "224" in txt and "5130" in txt
+    assert "Total params: 5,354" in txt
+    assert "conv1" in capsys.readouterr().out
+
+
+def test_print_summary_without_shapes():
+    txt = mx.viz.print_summary(_convnet())
+    assert "Total params: 0" in txt       # no shapes -> no counts
+    assert "fc1 (FullyConnected)" in txt
+
+
+def test_infer_failure_degrades_not_crashes():
+    # a graph whose inference cannot complete from a partial shape
+    # dict degrades to a shapeless table instead of raising TypeError
+    x = sym.var("data")
+    lbl = sym.var("label")
+    h = sym.FullyConnected(x, sym.var("w"), sym.var("b"),
+                           num_hidden=4, name="fc")
+    out = sym.SoftmaxOutput(h, lbl, name="sm")
+    txt = mx.viz.print_summary(out, shape={"data": (2, 8)})
+    assert "fc (FullyConnected)" in txt
+    dot = mx.viz.plot_network(out, shape={"data": (2, 8)})
+    assert "fc" in dot.source
+
+
+def test_plot_network_dot_structure():
+    pytest.importorskip("graphviz")
+    dot = mx.viz.plot_network(_convnet(),
+                              shape={"data": (2, 3, 16, 16)})
+    s = dot.source
+    assert "conv1" in s and "fc1" in s and "->" in s
+    assert "8x16x16" in s                 # edge labeled with shape
+    # params (cw/cb/fw/fb) are not drawn as nodes
+    assert "cw" not in s.replace("cw\\n", "")
+    # a gluon-exported net (weight/bias suffixes, no shape dict) works
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import nd
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net(nd.ones((1, 8)))
+    import tempfile, os
+    prefix = tempfile.mktemp()
+    net.export(prefix)
+    s2, _, _ = mx.model.load_checkpoint(prefix, 0) \
+        if hasattr(mx, "model") else (None, None, None)
+    if s2 is None:
+        from mxnet_tpu import symbol as s_mod
+        s2 = s_mod.load(prefix + "-symbol.json")
+    dot2 = mx.viz.plot_network(s2)
+    assert "->" in dot2.source
+    for f in (prefix + "-symbol.json", prefix + "-0000.params"):
+        if os.path.exists(f):
+            os.remove(f)
