@@ -345,3 +345,57 @@ extern "C" int MXTPUPjrtExecute(void* ep, void** arg_bufs, int n_args,
   }
   return (int)e->num_outputs;
 }
+
+// ---------------------------------------------------------------------------
+// Predict convenience over the core (reference c_predict_api.h shape):
+// load an MXTPUSHLO2 bundle from disk, compile it, run the
+// set-input/forward/get-output loop — every line C++, no interpreter.
+// The bundle layout is written by mxnet_tpu.deploy.export_stablehlo:
+//   "MXTPUSHLO2" | u64 n_code | u64 n_blob | code | blob
+// (only the raw StableHLO `code` section is read here).
+// ---------------------------------------------------------------------------
+#include <cstdio>
+
+static const char kBundleMagic[] = "MXTPUSHLO2";
+
+extern "C" void* MXTPUPjrtPredictCreate(void* client,
+                                        const char* bundle_path) {
+  FILE* f = std::fopen(bundle_path, "rb");
+  if (f == nullptr) {
+    g_err = std::string("cannot open bundle: ") + bundle_path;
+    return nullptr;
+  }
+  char magic[sizeof(kBundleMagic) - 1];
+  uint64_t lens[2];
+  std::vector<char> code;
+  bool ok_read =
+      std::fread(magic, 1, sizeof(magic), f) == sizeof(magic) &&
+      std::memcmp(magic, kBundleMagic, sizeof(magic)) == 0 &&
+      std::fread(lens, sizeof(uint64_t), 2, f) == 2;
+  if (ok_read) {
+    // bound n_code by the actual file size: a corrupt length field
+    // must produce an error, not a std::bad_alloc flying across the
+    // extern "C" boundary
+    long here = std::ftell(f);
+    std::fseek(f, 0, SEEK_END);
+    long fsize = std::ftell(f);
+    std::fseek(f, here, SEEK_SET);
+    ok_read = here >= 0 && fsize >= here &&
+              lens[0] <= (uint64_t)(fsize - here);
+  }
+  if (ok_read) {
+    code.resize(lens[0]);
+    ok_read = std::fread(code.data(), 1, code.size(), f) == code.size();
+  }
+  std::fclose(f);
+  if (!ok_read) {
+    g_err = std::string("not a valid MXTPUSHLO2 bundle: ") + bundle_path;
+    return nullptr;
+  }
+  // empty options = proto defaults.  Plugins that need non-default
+  // CompileOptions (device assignments etc.) should read the bundle
+  // with read_stablehlo and call MXTPUPjrtCompile with explicit
+  // serialized options (the Python path passes jaxlib defaults).
+  return MXTPUPjrtCompile(client, code.data(), (int64_t)code.size(),
+                          "mlir", "", 0);
+}

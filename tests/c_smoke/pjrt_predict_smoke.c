@@ -1,0 +1,78 @@
+/*
+ * Python-free deploy smoke: load a PJRT plugin, load an exported
+ * StableHLO bundle, push a host buffer, execute, read the result —
+ * through libmxtpu_pjrt.so's C ABI only.  Run against the mock plugin
+ * in CI (echo executable → output equals input) and against the real
+ * chip when one is reachable.
+ *
+ * argv: libmxtpu_pjrt.so plugin.so bundle.mxshlo
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static void* lib;
+#define LOAD(name) do { \
+    *(void**)(&name) = dlsym(lib, #name); \
+    if (!name) { fprintf(stderr, "missing symbol: %s\n", #name); \
+                 return 1; } \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 4) { fprintf(stderr, "usage: %s lib plugin bundle\n", argv[0]); return 2; }
+  lib = dlopen(argv[1], RTLD_NOW);
+  if (!lib) { fprintf(stderr, "dlopen: %s\n", dlerror()); return 1; }
+
+  void* (*MXTPUPjrtLoad)(const char*);
+  const char* (*MXTPUPjrtLastError)(void);
+  int (*MXTPUPjrtDeviceCount)(void*);
+  void* (*MXTPUPjrtPredictCreate)(void*, const char*);
+  int (*MXTPUPjrtExecNumOutputs)(void*);
+  void* (*MXTPUPjrtBufferFromHost)(void*, const void*, int,
+                                   const int64_t*, int, int);
+  int (*MXTPUPjrtExecute)(void*, void**, int, void**, int);
+  int64_t (*MXTPUPjrtBufferToHost)(void*, void*, int64_t);
+  void (*MXTPUPjrtBufferFree)(void*);
+  void (*MXTPUPjrtExecFree)(void*);
+  void (*MXTPUPjrtFree)(void*);
+  LOAD(MXTPUPjrtLoad); LOAD(MXTPUPjrtLastError);
+  LOAD(MXTPUPjrtDeviceCount); LOAD(MXTPUPjrtPredictCreate);
+  LOAD(MXTPUPjrtExecNumOutputs); LOAD(MXTPUPjrtBufferFromHost);
+  LOAD(MXTPUPjrtExecute); LOAD(MXTPUPjrtBufferToHost);
+  LOAD(MXTPUPjrtBufferFree); LOAD(MXTPUPjrtExecFree); LOAD(MXTPUPjrtFree);
+
+#define CHECK(c) do { if (!(c)) { \
+    fprintf(stderr, "FAIL %d: %s — %s\n", __LINE__, #c, \
+            MXTPUPjrtLastError()); return 1; } } while (0)
+
+  void* client = MXTPUPjrtLoad(argv[2]);
+  CHECK(client != NULL);
+  CHECK(MXTPUPjrtDeviceCount(client) >= 1);
+  void* exec = MXTPUPjrtPredictCreate(client, argv[3]);
+  CHECK(exec != NULL);
+  int n_out = MXTPUPjrtExecNumOutputs(exec);
+  CHECK(n_out >= 1);
+  printf("bundle compiled, %d output(s)\n", n_out);
+
+  float in[16];
+  for (int i = 0; i < 16; ++i) in[i] = (float)i;
+  int64_t dims[2] = {2, 8};
+  void* buf = MXTPUPjrtBufferFromHost(client, in, /*F32*/ 11, dims, 2, 0);
+  CHECK(buf != NULL);
+  void* outs[8];
+  int got = MXTPUPjrtExecute(exec, &buf, 1, outs, 8);
+  CHECK(got >= 1);
+  float host[64];
+  int64_t n = MXTPUPjrtBufferToHost(outs[0], host, sizeof(host));
+  CHECK(n > 0);
+  printf("output bytes: %lld first=%g\n", (long long)n, host[0]);
+
+  for (int i = 0; i < got; ++i) MXTPUPjrtBufferFree(outs[i]);
+  MXTPUPjrtBufferFree(buf);
+  MXTPUPjrtExecFree(exec);
+  MXTPUPjrtFree(client);
+  printf("C PJRT PREDICT PASSED\n");
+  return 0;
+}

@@ -159,3 +159,38 @@ def test_exported_bundle_runs_natively(tmp_path):
     out.close()
     exe.close()
     client.close()
+
+
+def test_c_predict_smoke_against_mock(mock_plugin, tmp_path):
+    """The COMPLETE Python-free deploy story in CI: a standalone C
+    program loads libmxtpu_pjrt.so + a PJRT plugin + an exported
+    bundle and runs predict — no interpreter anywhere in that
+    process's dispatch path."""
+    import subprocess
+    from mxnet_tpu.gluon import nn as gnn
+    from mxnet_tpu import nd, _native
+
+    # ensure the lib under test is built fresh (this diff may have
+    # changed pjrt_executor.cc; a stale .so would lack symbols)
+    assert pjrt_native.lib_available()
+
+    net = gnn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 8))
+    net(x)
+    bundle = str(tmp_path / "m.mxshlo")
+    mx.deploy.export_stablehlo(net, [x], bundle)
+
+    exe = str(tmp_path / "predict_smoke")
+    src = os.path.join(os.path.dirname(__file__), "c_smoke",
+                       "pjrt_predict_smoke.c")
+    r = subprocess.run(["gcc", "-O1", "-o", exe, src, "-ldl"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    res = subprocess.run(
+        [exe, _native._PJRT_LIB_PATH, mock_plugin, bundle],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "C PJRT PREDICT PASSED" in res.stdout
+    # the mock's echo executable returns the input: 2x8 f32 = 64 bytes
+    assert "output bytes: 64" in res.stdout
