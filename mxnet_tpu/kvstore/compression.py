@@ -1,16 +1,17 @@
-"""2-bit gradient compression with error-feedback residual.
+"""Gradient compression for the kvstore push path.
 
 Capability parity: reference ``src/kvstore/gradient_compression.{cc,cu,h}``
-(SURVEY.md §2.3): each gradient element is quantized to one of
+(SURVEY.md §2.3): ``2bit`` quantizes each element to one of
 {-threshold, 0, +threshold}; the quantization error is kept in a per-key
-residual and added to the next gradient before quantizing (error feedback),
-so the compression is unbiased over time.
+residual and added to the next gradient before quantizing (error
+feedback), so the compression is unbiased over time.  The rebuild adds
+``int8`` (absmax-scaled with the same residual carry) to match the
+SPMD trainer's ``compression={'type': 'int8'}`` option.
 
-TPU-native design: the quantize/dequantize round-trip runs as one fused XLA
-computation per key (jitted); on a real multi-host mesh the 2-bit packing
-would ride the wire — here the observable *numerics* (what the reference
-tests assert: pushed values snap to ±threshold/0 with residual carry) are
-reproduced exactly.
+TPU-native design: the quantize/dequantize round-trip runs as one fused
+XLA computation per key (jitted); the cross-process hop in
+``KVStoreTPUSync._merge`` ships the compressed representation narrow
+(int8 codes), not fp32.
 """
 from __future__ import annotations
 
@@ -25,30 +26,44 @@ class GradientCompression:
     def __init__(self, params: dict):
         params = dict(params)
         ctype = params.pop("type", params.pop("compression", "2bit"))
-        if ctype != "2bit":
+        if ctype not in ("2bit", "int8"):
             raise ValueError(
-                f"unsupported gradient compression type {ctype!r}; the "
-                "reference supports only '2bit' (src/kvstore/"
-                "gradient_compression.cc) and so does the rebuild")
+                f"unsupported gradient compression type {ctype!r}; "
+                "'2bit' (reference src/kvstore/gradient_compression.cc)"
+                " and 'int8' are available")
         self.type = ctype
+        # threshold only parameterizes 2bit; int8 is absmax-scaled
         self.threshold = float(params.pop("threshold", 0.5))
-        if self.threshold <= 0:
+        if ctype == "2bit" and self.threshold <= 0:
             raise ValueError("threshold must be positive")
         self._residuals = {}
         self._jitted = None
+        self._jitted_enc = None
 
     def _fn(self):
         if self._jitted is None:
             import jax
             import jax.numpy as jnp
 
-            @partial(jax.jit, static_argnums=())
-            def roundtrip(grad, residual, threshold):
-                g = grad + residual
-                q = jnp.where(g >= threshold, threshold,
-                              jnp.where(g <= -threshold, -threshold,
-                                        jnp.zeros_like(g)))
-                return q, g - q
+            if self.type == "2bit":
+
+                @partial(jax.jit, static_argnums=())
+                def roundtrip(grad, residual, threshold):
+                    g = grad + residual
+                    q = jnp.where(g >= threshold, threshold,
+                                  jnp.where(g <= -threshold, -threshold,
+                                            jnp.zeros_like(g)))
+                    return q, g - q
+
+            else:  # int8: absmax-scaled symmetric quantization
+
+                @partial(jax.jit, static_argnums=())
+                def roundtrip(grad, residual, threshold):
+                    g = grad + residual
+                    scale = jnp.maximum(jnp.max(jnp.abs(g)) / 127.0,
+                                        1e-20)
+                    q = jnp.round(g / scale).clip(-127, 127) * scale
+                    return q, g - q
 
             self._jitted = roundtrip
         return self._jitted
@@ -63,3 +78,59 @@ class GradientCompression:
                                 np.asarray(self.threshold, grad_jax.dtype))
         self._residuals[key] = new_res
         return q
+
+    def _enc(self):
+        """Wire codec: (grad, residual, threshold) -> (int8 codes,
+        0-d scale, new residual).  One home for the quantization math
+        — the dist hop ships codes+scale, never fp32."""
+        if self._jitted_enc is None:
+            import jax
+            import jax.numpy as jnp
+
+            if self.type == "2bit":
+
+                @partial(jax.jit, static_argnums=())
+                def enc(grad, residual, threshold):
+                    g = grad + residual
+                    codes = jnp.where(
+                        g >= threshold, 1,
+                        jnp.where(g <= -threshold, -1, 0)).astype(
+                            jnp.int8)
+                    deq = codes.astype(g.dtype) * threshold
+                    return codes, threshold.astype(jnp.float32), \
+                        g - deq
+
+            else:
+
+                @partial(jax.jit, static_argnums=())
+                def enc(grad, residual, threshold):
+                    g = grad + residual
+                    scale = jnp.maximum(
+                        jnp.max(jnp.abs(g)) / 127.0, 1e-20)
+                    codes = jnp.round(g / scale).clip(
+                        -127, 127).astype(jnp.int8)
+                    deq = codes.astype(g.dtype) * scale
+                    return codes, scale.astype(jnp.float32), g - deq
+
+            self._jitted_enc = enc
+        return self._jitted_enc
+
+    def encode(self, key, grad_jax):
+        """-> (int8 codes, 0-d fp32 scale); carries per-key residual."""
+        import jax.numpy as jnp
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad_jax.shape:
+            res = jnp.zeros_like(grad_jax)
+        codes, scale, new_res = self._enc()(
+            grad_jax, res, np.asarray(self.threshold, grad_jax.dtype))
+        self._residuals[key] = new_res
+        return codes, scale
+
+    @staticmethod
+    def decode(gathered_codes, gathered_scales):
+        """Sum per-process (codes, scale) pairs back to fp32."""
+        import jax.numpy as jnp
+        ndim = gathered_codes.ndim - 1
+        return (gathered_codes.astype(jnp.float32)
+                * gathered_scales.reshape(-1, *([1] * ndim))
+                ).sum(axis=0)

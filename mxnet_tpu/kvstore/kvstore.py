@@ -298,37 +298,28 @@ class KVStoreTPUSync(KVStore):
         if self.num_workers > 1 and self._compression is not None:
             # dist semantics: compression applies ONCE per worker to
             # the value crossing the wire (the reference compresses the
-            # worker's ZPush, not the intra-host device reduction)
+            # worker's ZPush, not the intra-host device reduction).
+            # encode() produces the int8 CODES + per-process scale that
+            # actually travel — 1/4 the bytes of fp32, the whole point
+            # of gradient_compression.cc — in ONE allgather.
+            from jax.experimental import multihost_utils
             root_ctx = self._store[k].context
             vals = [v.as_in_context(root_ctx) for v in values]
             local = vals[0] if len(vals) == 1 else nd.add_n(*vals)
-            merged = NDArray(
-                self._compression.compress(f"{k}:dist", local._data),
-                ctx=root_ctx)
-        else:
-            merged = super()._merge(k, values)
+            codes, meta = self._compression.encode(f"{k}:dist",
+                                                   local._data)
+            gc, gs = multihost_utils.process_allgather(
+                (codes, meta.reshape(1)))
+            return NDArray(
+                self._compression.decode(gc, gs), ctx=root_ctx)
+        merged = super()._merge(k, values)
         if self.num_workers > 1:
             # cross-host allreduce over DCN: allgather + sum is the
             # portable spelling; on a pod slice XLA lowers it to ICI
             # collectives
             from jax.experimental import multihost_utils
-            if self._compression is not None:
-                # the compressed push is exactly {-t, 0, +t}: ship the
-                # ternary CODES as int8 so the wire actually carries
-                # 1/4 the bytes of fp32 (the whole point of
-                # gradient_compression.cc), and dequantize after
-                import jax.numpy as jnp
-                t = self._compression.threshold
-                codes = jnp.round(merged._data / t).astype(jnp.int8)
-                gathered = multihost_utils.process_allgather(codes)
-                merged = NDArray(
-                    (gathered.astype("float32") * t).sum(axis=0),
-                    ctx=merged.context)
-            else:
-                gathered = multihost_utils.process_allgather(
-                    merged._data)
-                merged = NDArray(gathered.sum(axis=0),
-                                 ctx=merged.context)
+            gathered = multihost_utils.process_allgather(merged._data)
+            merged = NDArray(gathered.sum(axis=0), ctx=merged.context)
         return merged
 
     def _barrier(self):

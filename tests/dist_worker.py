@@ -71,6 +71,14 @@ def main():
     kv.push("comp", nd.full((4,), 0.3))
     kv.pull("comp", out=got_c)
     np.testing.assert_allclose(got_c.asnumpy(), 0.5 * n)
+    # 2d. int8 compression on the same hop: absmax codes + per-proc
+    # scale travel the wire; result within one quantization step
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init("comp8", nd.zeros((4,)))
+    kv.push("comp8", nd.full((4,), 0.37))
+    got_8 = nd.zeros((4,))
+    kv.pull("comp8", out=got_8)
+    np.testing.assert_allclose(got_8.asnumpy(), 0.37 * n, rtol=2e-2)
     kv._compression = None  # back to plain aggregation for part 3
 
     # 3. barrier then server-side-updater path (optimizer on store)

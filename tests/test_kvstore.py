@@ -218,3 +218,21 @@ def test_allreduce_collective():
     out = parallel.collectives.allreduce(vals, axis="dp", mesh=mesh)
     for o in out:
         np.testing.assert_allclose(o.asnumpy(), sum(range(8)))
+
+
+def test_gradient_compression_int8():
+    """int8 kvstore compression: absmax quantization with error
+    feedback (the SPMD trainer's int8 option, kvstore spelling)."""
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init("w", nd.zeros((64,)))
+    rng = np.random.RandomState(0)
+    g = rng.randn(64).astype("float32")
+    kv.push("w", nd.array(g))
+    out = nd.zeros((64,))
+    kv.pull("w", out=out)
+    scale = np.abs(g).max() / 127.0
+    np.testing.assert_allclose(out.asnumpy(), g, atol=scale / 2 + 1e-7)
+    with pytest.raises(ValueError, match="unsupported"):
+        mx.kv.create("local").set_gradient_compression(
+            {"type": "fp4"})
