@@ -36,7 +36,9 @@ setup(
     description=("TPU-native deep-learning framework with MXNet's "
                  "capabilities (JAX/XLA/Pallas compute, C++ runtime)"),
     packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
-    package_data={"mxnet_tpu": ["lib/libmxtpu.so"]},
+    package_data={"mxnet_tpu": ["lib/libmxtpu.so",
+                                "lib/libmxtpu_image.so",
+                                "lib/libmxtpu_pjrt.so"]},
     python_requires=">=3.10",
     install_requires=["numpy", "jax"],
     extras_require={"checkpoint": ["orbax-checkpoint"]},
