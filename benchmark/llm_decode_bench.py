@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Warm KV-cache decode throughput (BASELINE config #5 methodology).
+
+Separates the three costs the one-shot example conflates: prefill,
+first-step compile, and steady-state decode.  Reports tokens/sec for
+the WARM loop only, per batch size.
+
+    python benchmark/llm_decode_bench.py [--config llama_tiny]
+"""
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="llama_tiny")
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--batches", default="1,4,16")
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu or not _os.environ.get("MXTPU_BENCH_ON_TPU"):
+        _os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import LlamaForCausalLM, get_llama
+
+    on_tpu = jax.default_backend() != "cpu"
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = LlamaForCausalLM(get_llama(args.config,
+                                     vocab_size=args.vocab))
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for b in (int(x) for x in args.batches.split(",")):
+        toks = nd.array(rng.randint(
+            0, args.vocab, (b, args.prompt_len)).astype("f"), ctx=ctx)
+        # prefill + compile (timed separately, excluded from the rate)
+        t0 = time.perf_counter()
+        caches = net.init_cache(b, args.max_len)
+        logits = net(toks)
+        last = logits[:, -1:].argmax(axis=-1).astype("float32")
+        # run the whole prompt through decode_step to warm its program
+        # and fill the cache
+        for i in range(args.prompt_len):
+            out = net.decode_step(toks[:, i:i + 1], caches, i)
+        jax.block_until_ready(out._data)
+        t_warm = time.perf_counter() - t0
+
+        # steady state: one decode_step per token, greedy feedback
+        pos = args.prompt_len
+        cur = last
+        t0 = time.perf_counter()
+        for i in range(args.tokens):
+            logits = net.decode_step(cur, caches, pos + i)
+            cur = logits.argmax(axis=-1).astype("float32")
+            cur = cur.reshape((b, 1))
+        jax.block_until_ready(cur._data)
+        dt = time.perf_counter() - t0
+        row = {"metric": "llm_warm_decode_tokens_per_sec",
+               "config": args.config, "batch": b,
+               "tokens_per_sec": round(b * args.tokens / dt, 1),
+               "per_token_ms": round(dt / args.tokens * 1e3, 2),
+               "warmup_s": round(t_warm, 2),
+               "platform": "tpu" if on_tpu else "cpu"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    best = max(r["tokens_per_sec"] for r in rows)
+    print(json.dumps({"summary": "llm_decode", "config": args.config,
+                      "best_tokens_per_sec": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

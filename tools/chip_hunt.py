@@ -83,6 +83,12 @@ def jobs(log_dir):
          [sys.executable, "benchmark/resnet_bench.py",
           "--model", "resnet50_v1"], 1500, {},
          r"images_per_sec", r'"platform": "cpu"'),
+        # warm KV-cache decode series (compile excluded; BASELINE #5)
+        ("llm_decode_bench",
+         [sys.executable, "benchmark/llm_decode_bench.py",
+          "--config", "llama_tiny"], 1500,
+         {"MXTPU_BENCH_ON_TPU": "1"},
+         r'"platform": "tpu"', r'"platform": "cpu"'),
         # llama on-chip decode tok/s (VERDICT r2 next #8)
         ("llama_decode",
          [sys.executable, "example/llama_generate.py", "--ctx", "tpu",
