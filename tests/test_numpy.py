@@ -259,3 +259,27 @@ class TestNpxSurface:
         got = mx.npx.pick(mx.nd.array([[0., 1., 2., 3.]]),
                           mx.nd.array([5.]), mode="wrap")
         assert float(got.asnumpy()[0]) == 1.0
+
+    def test_layers_as_functions(self):
+        rng = onp.random.RandomState(1)
+        x = mx.nd.array(rng.randn(2, 3, 8, 8).astype("float32"))
+        w = mx.nd.array(rng.randn(4, 3, 3, 3).astype("float32"))
+        y = mx.npx.convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                               num_filter=4)
+        assert y.shape == (2, 4, 8, 8)
+        p = mx.npx.pooling(y, kernel=(2, 2), stride=(2, 2))
+        assert p.shape == (2, 4, 4, 4)
+        emb_w = mx.nd.array(rng.randn(10, 5).astype("float32"))
+        e = mx.npx.embedding(mx.nd.array([[1., 9.]]), emb_w,
+                             input_dim=10, output_dim=5)
+        onp.testing.assert_allclose(
+            e.asnumpy()[0], emb_w.asnumpy()[[1, 9]], rtol=1e-6)
+        oh = mx.npx.one_hot(mx.nd.array([0., 2.]), 3)
+        onp.testing.assert_array_equal(
+            oh.asnumpy(), onp.eye(3, dtype="float32")[[0, 2]])
+        sl = mx.npx.smooth_l1(mx.nd.array([-2., 0.25, 2.]))
+        onp.testing.assert_allclose(
+            sl.asnumpy(), [1.5, 0.03125, 1.5], rtol=1e-6)
+        bl = mx.npx.broadcast_like(mx.nd.ones((1, 4)),
+                                   mx.nd.zeros((3, 4)))
+        assert bl.shape == (3, 4)
