@@ -81,7 +81,14 @@ def main():
         "      'NOT built (pure-Python fallbacks active)')\n"
         "from mxnet_tpu.engine import pipeline\n"
         "print('native IO    :', 'active' if"
-        " pipeline.native_io_active() else 'off')\n"), timeout=120)
+        " pipeline.native_io_active() else 'off')\n"
+        "print('native image :', 'built' if _native.image_available()"
+        " else 'NOT built (no OpenCV dev headers)')\n"
+        "from mxnet_tpu import pjrt_native\n"
+        "print('pjrt core    :', ('built; plugins: ' + "
+        "(', '.join(pjrt_native.plugin_candidates()) or 'none found'))"
+        " if pjrt_native.lib_available() else 'NOT built')\n"),
+        timeout=120)
 
     probe("Device Info", prelude + (
         "import jax\n"
