@@ -1,0 +1,19 @@
+/* Header/link smoke: include the public prototypes and link directly
+ * against libmxtpu_pjrt.so (no dlsym) — a compile-time check that the
+ * header matches the library, plus the error path with no plugin. */
+#include <stdio.h>
+#include <string.h>
+
+#include "mxtpu/pjrt_c_api.h"
+
+int main(void) {
+  void* c = MXTPUPjrtLoad("/nonexistent/plugin.so");
+  if (c != NULL) { fprintf(stderr, "expected NULL client\n"); return 1; }
+  const char* err = MXTPUPjrtLastError();
+  if (err == NULL || strlen(err) == 0) {
+    fprintf(stderr, "expected an error message\n");
+    return 1;
+  }
+  printf("HEADER SMOKE PASSED: %s\n", err);
+  return 0;
+}

@@ -194,3 +194,28 @@ def test_c_predict_smoke_against_mock(mock_plugin, tmp_path):
     assert "C PJRT PREDICT PASSED" in res.stdout
     # the mock's echo executable returns the input: 2x8 f32 = 64 bytes
     assert "output bytes: 64" in res.stdout
+
+
+def test_header_links_against_library(tmp_path):
+    """include/mxtpu/pjrt_c_api.h must match the built library: a C
+    program compiled against the prototypes and LINKED (not dlsym'd)
+    runs and gets a proper error for a bogus plugin."""
+    import subprocess
+    from mxnet_tpu import _native
+    assert pjrt_native.lib_available()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = str(tmp_path / "hdr_smoke")
+    libdir = os.path.dirname(_native._PJRT_LIB_PATH)
+    r = subprocess.run(
+        ["gcc", "-O1", "-I" + os.path.join(repo, "include"),
+         "-o", exe,
+         os.path.join(repo, "tests/c_smoke/pjrt_header_smoke.c"),
+         "-L" + libdir, "-lmxtpu_pjrt",
+         "-Wl,-rpath," + libdir],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    res = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "HEADER SMOKE PASSED" in res.stdout
+    assert "dlopen" in res.stdout
