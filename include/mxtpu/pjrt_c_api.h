@@ -28,7 +28,9 @@ const char* MXTPUPjrtLastError(void);
 void* MXTPUPjrtLoad(const char* plugin_path);
 void MXTPUPjrtFree(void* client);
 int MXTPUPjrtDeviceCount(void* client);
-/* writes a NUL-terminated name, returns its length or -1 */
+/* writes a NUL-terminated (possibly truncated) name into out
+ * (cap >= 1); returns the FULL name length (snprintf-style, so
+ * truncation is detectable) or -1 */
 int MXTPUPjrtPlatformName(void* client, char* out, int cap);
 
 /* compile serialized code; format is "mlir" (StableHLO bytecode or
@@ -51,7 +53,8 @@ void* MXTPUPjrtBufferFromHost(void* client, const void* data,
                               int ndims, int device_index);
 void MXTPUPjrtBufferFree(void* buf);
 int MXTPUPjrtBufferType(void* buf);
-/* fills out[0..ndim); returns ndim or -1 */
+/* out == NULL: returns the rank; else fills out[0..ndim) (cap must
+ * be >= rank) and returns ndim, or -1 */
 int MXTPUPjrtBufferDims(void* buf, int64_t* out, int cap);
 /* dst == NULL: returns required byte size; else copies and returns
  * the byte count, or -1 */

@@ -127,14 +127,18 @@ extern "C" int MXTPUPjrtDeviceCount(void* hp) {
 
 extern "C" int MXTPUPjrtPlatformName(void* hp, char* out, int cap) {
   auto* h = (MXTPUPjrtClient*)hp;
+  if (out == nullptr || cap < 1) {
+    g_err = "platform name needs a buffer with cap >= 1";
+    return -1;
+  }
   ZERO_ARGS(PJRT_Client_PlatformName_Args, pa);
   pa.client = h->client;
   if (!ok(h->api, h->api->PJRT_Client_PlatformName(&pa))) return -1;
-  int n = (int)pa.platform_name_size < cap - 1
-              ? (int)pa.platform_name_size : cap - 1;
+  int len = (int)pa.platform_name_size;
+  int n = len < cap - 1 ? len : cap - 1;
   std::memcpy(out, pa.platform_name, n);
   out[n] = 0;
-  return n;
+  return len;  // full length: truncation is detectable (snprintf-style)
 }
 
 extern "C" void MXTPUPjrtFree(void* hp) {
@@ -265,6 +269,7 @@ extern "C" int MXTPUPjrtBufferDims(void* bp, int64_t* out, int cap) {
   ZERO_ARGS(PJRT_Buffer_Dimensions_Args, da);
   da.buffer = b->buf;
   if (!ok(b->c->api, b->c->api->PJRT_Buffer_Dimensions(&da))) return -1;
+  if (out == nullptr) return (int)da.num_dims;  // rank query
   if ((int)da.num_dims > cap) {
     g_err = "dims capacity too small";
     return -1;

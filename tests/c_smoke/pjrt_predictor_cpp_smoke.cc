@@ -1,0 +1,23 @@
+// cpp-package PjrtPredictor smoke: the fluent C++ deploy loop against
+// a PJRT plugin.  argv: plugin.so bundle.mxshlo
+#include <cstdio>
+
+#include "mxnet-cpp/PjrtPredictor.h"
+
+int main(int argc, char** argv) {
+  if (argc != 3) return 2;
+  try {
+    mxnet_cpp::PjrtPredictor pred(argv[1], argv[2]);
+    std::printf("outputs: %d\n", pred.NumOutputs());
+    float data[16];
+    for (int i = 0; i < 16; ++i) data[i] = (float)i;
+    auto outs = pred.Forward({{data, {2, 8}}});
+    std::printf("out0: %zu floats, first=%g\n", outs[0].first.size(),
+                outs[0].first[0]);
+    std::printf("CPP PJRT PREDICTOR PASSED\n");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "FAIL: %s\n", e.what());
+    return 1;
+  }
+}

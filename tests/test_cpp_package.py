@@ -6,6 +6,7 @@ forward/backward, fluent Operator SGD updates, KVStore — all from C++.
 """
 import os
 import shutil
+from test_pjrt_native import mock_plugin  # noqa: F401 (fixture)
 
 import numpy as np
 import pytest
@@ -59,3 +60,39 @@ def test_cpp_predictor(tmp_path):
                   str(tmp_path / "expected.bin")])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "CPP PREDICT TEST PASSED" in res.stdout
+
+
+def test_pjrt_predictor_cpp(tmp_path, mock_plugin):
+    """The fluent C++ PjrtPredictor runs the full deploy loop against
+    the mock PJRT plugin — a second consumer of the public header."""
+    import subprocess
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, _native, pjrt_native
+    from mxnet_tpu.gluon import nn
+
+    assert pjrt_native.lib_available()
+    mock = mock_plugin
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((2, 8))
+    net(x)
+    bundle = str(tmp_path / "m.mxshlo")
+    mx.deploy.export_stablehlo(net, [x], bundle)
+
+    exe = str(tmp_path / "cpp_smoke")
+    libdir = os.path.dirname(_native._PJRT_LIB_PATH)
+    r = subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         "-I" + os.path.join(REPO, "include"),
+         "-I" + os.path.join(REPO, "cpp-package", "include"),
+         "-o", exe,
+         os.path.join(REPO, "tests/c_smoke/pjrt_predictor_cpp_smoke.cc"),
+         "-L" + libdir, "-lmxtpu_pjrt", "-Wl,-rpath," + libdir],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-1500:]
+    res = subprocess.run([exe, mock, bundle], capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CPP PJRT PREDICTOR PASSED" in res.stdout
+    assert "out0: 16 floats, first=0" in res.stdout  # mock echo
