@@ -239,3 +239,60 @@ def test_encoder_remat_numerics_identical():
     # the checkpoint branch must actually have fired during tracing
     assert contrib_nn._REMAT_APPLICATIONS > before
     np.testing.assert_allclose(base, rem, rtol=1e-5, atol=1e-6)
+
+
+def test_remat_with_flash_kernel_fused_step():
+    """The seq-512 chip config's exact composition: jax.checkpoint'd
+    encoder layers whose attention runs the Pallas flash custom_vjp,
+    inside the fused trainer — must compile, train, and actually
+    dispatch flash (interpret mode stands in for the chip)."""
+    from mxnet_tpu import parallel, models
+    from mxnet_tpu.ops import flash_attention as fa
+    from mxnet_tpu.ops import attention as attn
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    old = fa._INTERPRET
+    fa._INTERPRET = True
+    try:
+        np.random.seed(0)
+        mx.random.seed(0)
+        inner = models.BERTForPretrain(models.bert_small(
+            vocab_size=200, max_length=128, dropout=0.0, remat=True))
+
+        class _Full(HybridBlock):
+            def __init__(self, mod, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.mod = mod
+
+            def hybrid_forward(self, F, t, ty, p):
+                return self.mod(t, ty, None, p)
+
+        model = _Full(inner)
+        model.initialize(mx.init.Xavier())
+        sce = SoftmaxCrossEntropyLoss()
+
+        def loss_fn(outs, label):
+            mlm, nsp = outs
+            return sce(mlm, label[:, :4].reshape((-1,))).mean() + \
+                sce(nsp, label[:, 4]).mean()
+
+        dpt = parallel.DataParallelTrainer(
+            model, loss_fn, "adam", {"learning_rate": 1e-3},
+            mesh=parallel.make_mesh({"dp": 1}), fuse_step=True)
+        rng = np.random.RandomState(0)
+        data = (nd.array(rng.randint(0, 200, (2, 128)).astype("f")),
+                nd.array(rng.randint(0, 2, (2, 128)).astype("f")),
+                nd.array(rng.randint(0, 128, (2, 4)).astype("f")))
+        label = nd.array(np.concatenate(
+            [rng.randint(0, 200, (2, 4)), rng.randint(0, 2, (2, 1))],
+            1).astype("f"))
+        before = attn.flash_dispatch_count()
+        l0 = float(dpt.step(data, label).asnumpy())
+        l1 = float(dpt.step(data, label).asnumpy())
+        assert np.isfinite(l0) and l1 < l0
+        assert attn.flash_dispatch_count() > before, \
+            "flash must dispatch under jax.checkpoint"
+    finally:
+        fa._INTERPRET = old
