@@ -58,7 +58,12 @@ def _log(msg):
 _T0 = time.monotonic()
 
 
-_LOG_DIR = os.environ.get("MXTPU_BENCH_LOG_DIR")
+# default the evidence dir so even the driver's own end-of-round run
+# leaves a committed report (the driver commits uncommitted work)
+_LOG_DIR = os.environ.get("MXTPU_BENCH_LOG_DIR",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)),
+                              "bench_logs", "driver"))
 _STARTED = datetime.datetime.now()
 # per-attempt filename: retries (chip_hunt runs this up to 3x into the
 # same log dir) must not clobber a previous attempt's evidence
