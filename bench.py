@@ -370,7 +370,7 @@ def main():
     # are recorded in the report with their own MFU.
     if on_tpu:
         best = None
-        for bs, seq in ((32, 128), (64, 128), (128, 128),
+        for bs, seq in ((32, 128), (64, 128), (128, 128), (256, 128),
                         (16, 512), (32, 512)):
             remaining = budget - (time.monotonic() - _T0)
             # seq-512 steps cost ~4-8x a seq-128 step plus a larger
