@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Audit the fused BERT train step's HLO for matmul dtype coverage.
+
+MFU suspect #1 (docs/bert_mfu_analysis.md): if the big matmuls leak
+into f32 the MXU runs at half rate and the observed 0.212 MFU is
+explained. This runs the SAME fused step bench.py times (bert_small
+sized by default so it lowers in seconds on CPU) under
+``--xla_dump_to``, then parses the optimized HLO of the largest module
+(the fused train step) and buckets every ``dot`` by operand dtype.
+
+Dtype lowering is platform-generic, so a CPU run answers the question
+the chip run would: are the MXU-bound dots bf16?
+
+Prints one JSON line; exits 1 if any big (>=1 MFLOP) dot is f32-only.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, models
+from mxnet_tpu.contrib import amp
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+hidden, layers, heads = {hidden}, {layers}, {heads}
+vocab, batch, seq, masked = {vocab}, {batch}, {seq}, {masked}
+
+ctx = mx.cpu()
+amp.init(target_dtype="bfloat16")
+inner = models.BERTForPretrain(models.get_bert(
+    "bert_small", vocab_size=vocab, max_length=seq, dropout=0.1,
+    units=hidden, num_layers=layers, num_heads=heads,
+    hidden_size=hidden * 4))
+
+class _FullLenPretrain(HybridBlock):
+    def __init__(self, mod, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.mod = mod
+    def hybrid_forward(self, F, tokens, types, positions):
+        return self.mod(tokens, types, None, positions)
+
+model = _FullLenPretrain(inner)
+model.initialize(mx.init.Xavier(), ctx=ctx)
+sce = SoftmaxCrossEntropyLoss()
+
+def loss_fn(outs, label):
+    mlm_scores, nsp_scores = outs
+    mlm_labels = label[:, :masked].reshape((-1,))
+    nsp_labels = label[:, masked]
+    return sce(mlm_scores, mlm_labels).mean() + \
+        sce(nsp_scores, nsp_labels).mean()
+
+mesh = parallel.make_mesh({{"dp": 1}}, devices=[ctx.device])
+dpt = parallel.DataParallelTrainer(model, loss_fn, "adam",
+                                   {{"learning_rate": 1e-4}},
+                                   mesh=mesh, fuse_step=True)
+rng = np.random.RandomState(0)
+tokens = nd.array(rng.randint(0, vocab, (batch, seq)).astype("f"), ctx=ctx)
+types = nd.array(rng.randint(0, 2, (batch, seq)).astype("f"), ctx=ctx)
+positions = nd.array(rng.randint(0, seq, (batch, masked)).astype("f"),
+                     ctx=ctx)
+label = nd.array(np.concatenate(
+    [rng.randint(0, vocab, (batch, masked)),
+     rng.randint(0, 2, (batch, 1))], axis=1).astype("f"), ctx=ctx)
+loss = dpt.step((tokens, types, positions), label)
+loss.wait_to_read()
+print("STEP_OK", float(loss.asnumpy()))
+"""
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT )?(%[\w.\-]+) = (\w+)\[([\d,]*)\]")
+
+
+def parse_dots(hlo_text):
+    """Two-pass: map every instruction name to its (dtype, shape), then
+    resolve each dot's operand dtypes through that map (HLO text does
+    not inline operand types)."""
+    types = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if m:
+            types[m.group(1)] = (m.group(2), m.group(3))
+    dots = []
+    for line in hlo_text.splitlines():
+        m = re.match(
+            r"^\s*(?:ROOT )?%?[\w.\-]+ = (\w+)\[([\d,]*)\][^=]*? dot\(",
+            line)
+        if not m:
+            continue
+        args = line.split("dot(", 1)[1].split(")", 1)[0]
+        operands = [types.get(a.strip().split(" ")[-1], ("?", ""))
+                    for a in args.split(",")
+                    if a.strip().startswith("%")
+                    or " %" in a]
+        # fallback: pull %names directly
+        if not operands:
+            names = re.findall(r"%[\w.\-]+", args)
+            operands = [types.get(n, ("?", "")) for n in names]
+        operands = operands[:2]
+        in_dtypes = sorted({t for t, _ in operands})
+        flops = 0
+        try:
+            out_dims = [int(x) for x in m.group(2).split(",") if x]
+            km = re.search(r"rhs_contracting_dims=\{(\d+)", line)
+            k = 1
+            if operands:
+                rhs_shape = [int(x) for x in operands[-1][1].split(",")
+                             if x]
+                if km and rhs_shape:
+                    k = rhs_shape[min(int(km.group(1)),
+                                      len(rhs_shape) - 1)]
+                elif rhs_shape:
+                    k = rhs_shape[0]
+            flops = 2 * int(np.prod(out_dims, dtype=np.int64) or 1) * k
+        except Exception:
+            pass
+        dots.append({"in": in_dtypes, "out": m.group(1),
+                     "out_shape": m.group(2), "flops": int(flops)})
+    return dots
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--masked", type=int, default=20)
+    ap.add_argument("--keep-dump", help="copy the chosen HLO file here")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="hlo_audit_") as dump:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" --xla_dump_to={dump}"
+                            " --xla_dump_hlo_as_text").strip()
+        code = _WORKER.format(repo=REPO, hidden=args.hidden,
+                              layers=args.layers, heads=args.heads,
+                              vocab=args.vocab, batch=args.batch,
+                              seq=args.seq, masked=args.masked)
+        res = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=1200)
+        if res.returncode != 0 or "STEP_OK" not in res.stdout:
+            print(json.dumps({"metric": "hlo_dot_dtype_audit",
+                              "error": res.stderr[-2000:]}))
+            return 2
+        # BEFORE optimizations: XLA:CPU's pipeline upcasts bf16 dots to
+        # f32 (no native bf16 FMA), which would mask the answer; the
+        # pre-pass module shows the dtypes the traced program requested,
+        # which is what the TPU pipeline consumes.
+        candidates = glob.glob(
+            os.path.join(dump, "*before_optimizations.txt"))
+        if not candidates:
+            candidates = glob.glob(os.path.join(dump, "*.txt"))
+        # the fused train step is the largest dumped module
+        path = max(candidates, key=os.path.getsize)
+        with open(path) as f:
+            hlo = f.read()
+        if args.keep_dump:
+            with open(args.keep_dump, "w") as f:
+                f.write(hlo)
+
+    dots = parse_dots(hlo)
+    big = [d for d in dots if d["flops"] >= 1e6]
+    f32_big = [d for d in big if d["in"] == ["f32"]]
+    report = {
+        "metric": "hlo_dot_dtype_audit",
+        "module": os.path.basename(path),
+        "dots_total": len(dots),
+        "dots_bf16_in": sum(1 for d in dots if "bf16" in d["in"]),
+        "dots_f32_only": sum(1 for d in dots if d["in"] == ["f32"]),
+        "big_dots": len(big),
+        "big_f32_dots": len(f32_big),
+        "big_f32_flops_share": round(
+            sum(d["flops"] for d in f32_big)
+            / max(1, sum(d["flops"] for d in big)), 4),
+        "worst_f32": sorted(f32_big, key=lambda d: -d["flops"])[:10],
+    }
+    print(json.dumps(report))
+    return 1 if f32_big else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

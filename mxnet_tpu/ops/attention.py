@@ -34,6 +34,11 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
     Grouped-query attention is native: when K/V carry fewer heads than
     Q, query heads are grouped per KV head in the einsum — no
     materialized K/V repeat."""
+    # keep the score pipeline in the input dtype (the MXU dtype under
+    # AMP) and run ONLY the softmax in f32: a strongly-typed f32 scale
+    # scalar would otherwise promote logits — and every backward dot of
+    # the attention — to f32 (found by benchmark/hlo_dtype_audit.py)
+    scale = jnp.asarray(scale, q.dtype)
     h, kv = q.shape[2], k.shape[2]
     if kv != h:
         b, s_q, _, d = q.shape
@@ -41,9 +46,10 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
         g = h // kv
         qg = q.reshape(b, s_q, kv, g, d)
         logits = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k) * scale
+        neg = jnp.asarray(-1e30, logits.dtype)
         if causal:
             cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
-            logits = jnp.where(cm[None, None, None], logits, -1e30)
+            logits = jnp.where(cm[None, None, None], logits, neg)
         if mask is not None:
             m = mask.astype(bool)
             if m.ndim == 2:       # legacy (S_q, S_k) broadcast form
@@ -55,19 +61,21 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
                 # masks still broadcast over the query batch
                 m = m.reshape(m.shape[0], kv, g, m.shape[2],
                               m.shape[3])
-            logits = jnp.where(m, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bcgqk,bkcd->bqcgd", probs, v)
+            logits = jnp.where(m, logits, neg)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bcgqk,bkcd->bqcgd", probs.astype(v.dtype), v)
         return out.reshape(b, s_q, h, d).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.asarray(-1e30, logits.dtype)
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
         cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
-        logits = jnp.where(cm[None, None], logits, -1e30)
+        logits = jnp.where(cm[None, None], logits, neg)
     if mask is not None:
-        logits = jnp.where(mask.astype(bool), logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+        logits = jnp.where(mask.astype(bool), logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype),
+                      v).astype(q.dtype)
 
 
 @register("dot_product_attention", num_inputs=None)
