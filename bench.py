@@ -32,6 +32,17 @@ import threading
 import time
 import traceback
 
+# Persistent XLA compilation cache, BEFORE jax import: the bench host
+# has a single core and the bert_base fused step takes >30 min to
+# compile cold — without a cross-process cache every hunter retry
+# re-pays it and the budget dies in the compiler (observed r3:
+# bench.log attempt 1, watchdog at 2100s still inside the b32 compile).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 import numpy as np
 
 # v5e (TPU v5 lite) peak bf16 matmul throughput, used for analytic MFU
@@ -150,7 +161,7 @@ def probe_platform(timeout):
 
 def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
                         num_masked, steps, warmup, hidden, layers,
-                        heads, remat=False):
+                        heads, remat=False, scan_layers=False):
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.contrib import amp
@@ -166,7 +177,7 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
         builder = getattr(models, builder_name)
         inner = models.BERTForPretrain(
             builder(vocab_size=vocab, max_length=seq_len, dropout=0.1,
-                    remat=remat))
+                    remat=remat, scan_layers=scan_layers))
 
         # full-length sequences need no padding mask; passing
         # valid_length=None keeps attention on the Pallas FLASH path
@@ -247,7 +258,8 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
             seq_len=seq_len, steps=steps, total_s=round(dt, 3),
             avg_step_ms=round(dt / steps * 1e3, 2),
             samples_per_sec=round(sps, 2), mfu=round(mfu, 4),
-            flash_dispatches=flash_hits)
+            flash_dispatches=flash_hits, scan_layers=scan_layers,
+            remat=remat)
     if on_tpu and flash_hits == 0:
         _log(f"WARNING: {builder_name} compiled WITHOUT the flash "
              "kernel (0 flash dispatches) — MFU claims assume it")
@@ -370,8 +382,32 @@ def main():
     # are recorded in the report with their own MFU.
     if on_tpu:
         best = None
-        for bs, seq in ((32, 128), (64, 128), (128, 128), (256, 128),
-                        (16, 512), (32, 512)):
+        sweep = ((32, 128), (64, 128), (128, 128), (256, 128),
+                 (16, 512), (32, 512))
+        # MXTPU_BENCH_SWEEP="32x128,64x128" restricts the sweep — the
+        # chip hunter warms the compile cache one config at a time so
+        # a single cold compile can't eat the whole window
+        sel = os.environ.get("MXTPU_BENCH_SWEEP")
+        if sel:
+            try:
+                want = {tuple(int(v) for v in c.lower().split("x"))
+                        for c in sel.split(",") if c}
+            except ValueError:
+                _log(f"MXTPU_BENCH_SWEEP={sel!r} unparseable "
+                     "(want e.g. '32x128,64x128'); running full sweep")
+                want = None
+            if want is not None:
+                chosen = tuple(c for c in sweep if c in want)
+                unknown = want - set(sweep)
+                if unknown:
+                    _log(f"MXTPU_BENCH_SWEEP: ignoring unknown "
+                         f"configs {sorted(unknown)}")
+                if chosen:
+                    sweep = chosen
+                else:
+                    _log("MXTPU_BENCH_SWEEP selected nothing; "
+                         "running full sweep")
+        for bs, seq in sweep:
             remaining = budget - (time.monotonic() - _T0)
             # seq-512 steps cost ~4-8x a seq-128 step plus a larger
             # compile; only the first config may run on a thin budget
@@ -386,11 +422,18 @@ def main():
             try:
                 _log(f"stage 3: bert_base pretrain bench "
                      f"(batch {bs}, seq {seq})")
+                # scan-over-layers (default on): ONE compiled layer
+                # body instead of 12 — the 1-core bench host pays
+                # >30 min to compile the unrolled fused step, which is
+                # longer than the chip windows last. MXTPU_BENCH_SCAN=0
+                # restores the unrolled program (same math either way).
                 sps, mfu, fl = bench_bert_pretrain(
                     builder_name="bert_base", vocab=30522,
                     batch_size=bs, seq_len=seq, num_masked=20,
                     steps=20, warmup=3, hidden=768, layers=12,
-                    heads=12, remat=(seq >= 512))
+                    heads=12, remat=(seq >= 512),
+                    scan_layers=os.environ.get(
+                        "MXTPU_BENCH_SCAN", "1") != "0")
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
                      f"samples/sec, mfu={mfu:.3f}, flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):
@@ -398,7 +441,8 @@ def main():
                     _set_result(
                         "bert_base_pretrain_samples_per_sec_per_chip",
                         sps, mfu=round(mfu, 4), batch_size=bs,
-                        flash_active=fl > 0)
+                        flash_active=fl > 0, scan_layers=os.environ.get(
+                            "MXTPU_BENCH_SCAN", "1") != "0")
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 _record("bert_base", error=repr(e), batch_size=bs,

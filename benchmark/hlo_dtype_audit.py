@@ -156,8 +156,14 @@ def main():
                               layers=args.layers, heads=args.heads,
                               vocab=args.vocab, batch=args.batch,
                               seq=args.seq, masked=args.masked)
-        res = subprocess.run([sys.executable, "-c", code], env=env,
-                             capture_output=True, text=True, timeout=1200)
+        try:
+            res = subprocess.run([sys.executable, "-c", code], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=1200)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"metric": "hlo_dot_dtype_audit",
+                              "error": "worker timeout (1200s)"}))
+            return 2
         if res.returncode != 0 or "STEP_OK" not in res.stdout:
             print(json.dumps({"metric": "hlo_dot_dtype_audit",
                               "error": res.stderr[-2000:]}))
@@ -170,6 +176,10 @@ def main():
             os.path.join(dump, "*before_optimizations.txt"))
         if not candidates:
             candidates = glob.glob(os.path.join(dump, "*.txt"))
+        if not candidates:
+            print(json.dumps({"metric": "hlo_dot_dtype_audit",
+                              "error": "no HLO dumps produced"}))
+            return 2
         # the fused train step is the largest dumped module
         path = max(candidates, key=os.path.getsize)
         with open(path) as f:

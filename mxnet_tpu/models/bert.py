@@ -26,7 +26,7 @@ class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  type_vocab_size=2, dropout=0.1, remat=False,
-                 **kwargs):
+                 scan_layers=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.vocab_size = vocab_size
@@ -43,7 +43,7 @@ class BERTModel(HybridBlock):
             self.encoder = TransformerEncoder(
                 units, hidden_size, num_layers, num_heads,
                 dropout=dropout, activation="gelu", remat=remat,
-                prefix="enc_")
+                scan_layers=scan_layers, prefix="enc_")
             self.pooler = nn.Dense(units, activation="tanh",
                                    in_units=units, flatten=False,
                                    prefix="pooler_")
