@@ -407,6 +407,11 @@ def main():
                 else:
                     _log("MXTPU_BENCH_SWEEP selected nothing; "
                          "running full sweep")
+        # scan-over-layers (default on): ONE compiled layer body
+        # instead of 12 — the 1-core bench host pays >30 min to compile
+        # the unrolled fused step, longer than chip windows last.
+        # MXTPU_BENCH_SCAN=0 restores the unrolled program (same math).
+        scan = os.environ.get("MXTPU_BENCH_SCAN", "1") != "0"
         for bs, seq in sweep:
             remaining = budget - (time.monotonic() - _T0)
             # seq-512 steps cost ~4-8x a seq-128 step plus a larger
@@ -422,18 +427,12 @@ def main():
             try:
                 _log(f"stage 3: bert_base pretrain bench "
                      f"(batch {bs}, seq {seq})")
-                # scan-over-layers (default on): ONE compiled layer
-                # body instead of 12 — the 1-core bench host pays
-                # >30 min to compile the unrolled fused step, which is
-                # longer than the chip windows last. MXTPU_BENCH_SCAN=0
-                # restores the unrolled program (same math either way).
                 sps, mfu, fl = bench_bert_pretrain(
                     builder_name="bert_base", vocab=30522,
                     batch_size=bs, seq_len=seq, num_masked=20,
                     steps=20, warmup=3, hidden=768, layers=12,
                     heads=12, remat=(seq >= 512),
-                    scan_layers=os.environ.get(
-                        "MXTPU_BENCH_SCAN", "1") != "0")
+                    scan_layers=scan)
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
                      f"samples/sec, mfu={mfu:.3f}, flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):
@@ -441,8 +440,7 @@ def main():
                     _set_result(
                         "bert_base_pretrain_samples_per_sec_per_chip",
                         sps, mfu=round(mfu, 4), batch_size=bs,
-                        flash_active=fl > 0, scan_layers=os.environ.get(
-                            "MXTPU_BENCH_SCAN", "1") != "0")
+                        flash_active=fl > 0, scan_layers=scan)
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 _record("bert_base", error=repr(e), batch_size=bs,

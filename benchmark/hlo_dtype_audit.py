@@ -190,13 +190,16 @@ def main():
 
     dots = parse_dots(hlo)
     big = [d for d in dots if d["flops"] >= 1e6]
-    f32_big = [d for d in big if d["in"] == ["f32"]]
+    # a dot is only MXU-clean if EVERY operand is bf16: a mixed
+    # bf16 x f32 dot promotes and executes in f32 — the same leak as
+    # f32-only, so both count against the audit
+    f32_big = [d for d in big if "f32" in d["in"]]
     report = {
         "metric": "hlo_dot_dtype_audit",
         "module": os.path.basename(path),
         "dots_total": len(dots),
-        "dots_bf16_in": sum(1 for d in dots if "bf16" in d["in"]),
-        "dots_f32_only": sum(1 for d in dots if d["in"] == ["f32"]),
+        "dots_all_bf16": sum(1 for d in dots if d["in"] == ["bf16"]),
+        "dots_f32_touched": sum(1 for d in dots if "f32" in d["in"]),
         "big_dots": len(big),
         "big_f32_dots": len(f32_big),
         "big_f32_flops_share": round(

@@ -1,0 +1,405 @@
+/* XS glue for AI::MXNetTPU — hand-written binding over the flat C ABI
+ * (include/mxtpu/c_api.h).  Parity target: the reference's
+ * perl-package/AI-MXNetCAPI SWIG layer; scope here is the NDArray +
+ * imperative-invoke + predict surfaces the pure-Perl OO layer
+ * (lib/AI/MXNetTPU.pm) builds on.
+ *
+ * Handles cross the XS boundary as opaque IVs (pointer-sized ints),
+ * exactly how the reference's SWIG layer passed NDArrayHandle.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu/c_api.h"
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* libmxtpu embeds CPython; the interpreter's own extension modules
+ * (math, numpy, ...) resolve libpython symbols from the GLOBAL symbol
+ * table. An executable linking libmxtpu gets that for free (load-time
+ * deps of the main program are global), but a dlopen'd XS module does
+ * not — so promote libpython explicitly before the first Python call.
+ * MXTPU_PYLIB is baked in by Makefile.PL from python's INSTSONAME. */
+#ifndef MXTPU_PYLIB
+#define MXTPU_PYLIB "libpython3.so"
+#endif
+static void promote_libpython(void) {
+  static int done = 0;
+  if (!done) {
+    dlopen(MXTPU_PYLIB, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD)
+        || dlopen(MXTPU_PYLIB, RTLD_NOW | RTLD_GLOBAL);
+    done = 1;
+  }
+}
+
+#define MAX_NDIM 16
+#define MAX_IO 64
+
+static void croak_on(pTHX_ int rc, const char *what) {
+  if (rc != 0)
+    croak("%s failed: %s", what, MXTPUGetLastError());
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU::CAPI
+
+PROTOTYPES: DISABLE
+
+int
+init()
+  CODE:
+    promote_libpython();
+    RETVAL = MXTPUCAPIInit();
+  OUTPUT:
+    RETVAL
+
+int
+version()
+  CODE:
+    RETVAL = MXTPUGetVersion();
+  OUTPUT:
+    RETVAL
+
+int
+has_feature(name)
+    const char *name
+  CODE:
+    RETVAL = MXTPUHasFeature(name);
+  OUTPUT:
+    RETVAL
+
+const char *
+last_error()
+  CODE:
+    RETVAL = MXTPUGetLastError();
+  OUTPUT:
+    RETVAL
+
+void
+random_seed(seed)
+    int seed
+  CODE:
+    croak_on(aTHX_ MXRandomSeed(seed), "MXRandomSeed");
+
+void
+wait_all()
+  CODE:
+    croak_on(aTHX_ MXNDArrayWaitAll(), "MXNDArrayWaitAll");
+
+IV
+nd_from_data(shape_ref, data_ref, ctx_type, ctx_id)
+    SV *shape_ref
+    SV *data_ref
+    int ctx_type
+    int ctx_id
+  PREINIT:
+    AV *shape_av;
+    AV *data_av;
+    int64_t shape[MAX_NDIM];
+    int ndim, i;
+    ssize_t n;
+    float *buf;
+    NDArrayHandle out;
+    int rc;
+  CODE:
+    if (!SvROK(shape_ref) || SvTYPE(SvRV(shape_ref)) != SVt_PVAV)
+      croak("nd_from_data: shape must be an ARRAY ref");
+    if (!SvROK(data_ref) || SvTYPE(SvRV(data_ref)) != SVt_PVAV)
+      croak("nd_from_data: data must be an ARRAY ref");
+    shape_av = (AV *)SvRV(shape_ref);
+    data_av = (AV *)SvRV(data_ref);
+    ndim = (int)(av_len(shape_av) + 1);
+    if (ndim <= 0 || ndim > MAX_NDIM)
+      croak("nd_from_data: ndim %d out of range", ndim);
+    n = 1;
+    for (i = 0; i < ndim; ++i) {
+      SV **e = av_fetch(shape_av, i, 0);
+      shape[i] = e ? (int64_t)SvIV(*e) : 0;
+      n *= shape[i];
+    }
+    if (av_len(data_av) + 1 != n)
+      croak("nd_from_data: data has %ld elements, shape wants %ld",
+            (long)(av_len(data_av) + 1), (long)n);
+    buf = (float *)malloc((size_t)n * sizeof(float));
+    if (!buf) croak("nd_from_data: out of memory");
+    for (i = 0; i < n; ++i) {
+      SV **e = av_fetch(data_av, i, 0);
+      buf[i] = e ? (float)SvNV(*e) : 0.0f;
+    }
+    /* dtype 0 == float32 (the binding's only wire type, like the
+     * reference perl package's PDL_F default) */
+    rc = MXNDArrayFromData(shape, ndim, 0, ctx_type, ctx_id, buf,
+                           (size_t)n * sizeof(float), &out);
+    free(buf);
+    croak_on(aTHX_ rc, "MXNDArrayFromData");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+SV *
+nd_shape(h)
+    IV h
+  PREINIT:
+    int64_t shape[MAX_NDIM];
+    int ndim, i;
+    AV *av;
+  CODE:
+    croak_on(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                                     shape, MAX_NDIM),
+             "MXNDArrayGetShape");
+    av = newAV();
+    for (i = 0; i < ndim; ++i)
+      av_push(av, newSViv((IV)shape[i]));
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+SV *
+nd_to_aref(h)
+    IV h
+  PREINIT:
+    int64_t shape[MAX_NDIM];
+    int ndim, i;
+    ssize_t n;
+    float *buf;
+    AV *av;
+    int rc;
+  CODE:
+    croak_on(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                                     shape, MAX_NDIM),
+             "MXNDArrayGetShape");
+    n = 1;
+    for (i = 0; i < ndim; ++i) n *= shape[i];
+    buf = (float *)malloc((size_t)n * sizeof(float));
+    if (!buf) croak("nd_to_aref: out of memory");
+    rc = MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf,
+                                (size_t)n * sizeof(float));
+    if (rc != 0) {
+      free(buf);
+      croak("MXNDArraySyncCopyToCPU failed: %s", MXTPUGetLastError());
+    }
+    av = newAV();
+    for (i = 0; i < n; ++i)
+      av_push(av, newSVnv((NV)buf[i]));
+    free(buf);
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+void
+nd_free(h)
+    IV h
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+SV *
+invoke(op_name, in_ref, keys_ref, vals_ref)
+    const char *op_name
+    SV *in_ref
+    SV *keys_ref
+    SV *vals_ref
+  PREINIT:
+    AV *in_av;
+    AV *keys_av;
+    AV *vals_av;
+    NDArrayHandle inputs[MAX_IO];
+    NDArrayHandle outputs[MAX_IO];
+    const char *keys[MAX_IO];
+    const char *vals[MAX_IO];
+    int num_in, num_params, num_out = 0, i;
+    AV *av;
+  CODE:
+    if (!SvROK(in_ref) || SvTYPE(SvRV(in_ref)) != SVt_PVAV)
+      croak("invoke: inputs must be an ARRAY ref of handles");
+    if (!SvROK(keys_ref) || SvTYPE(SvRV(keys_ref)) != SVt_PVAV)
+      croak("invoke: keys must be an ARRAY ref");
+    if (!SvROK(vals_ref) || SvTYPE(SvRV(vals_ref)) != SVt_PVAV)
+      croak("invoke: vals must be an ARRAY ref");
+    in_av = (AV *)SvRV(in_ref);
+    keys_av = (AV *)SvRV(keys_ref);
+    vals_av = (AV *)SvRV(vals_ref);
+    num_in = (int)(av_len(in_av) + 1);
+    num_params = (int)(av_len(keys_av) + 1);
+    if (num_in > MAX_IO || num_params > MAX_IO)
+      croak("invoke: too many inputs/params");
+    if (av_len(vals_av) + 1 != num_params)
+      croak("invoke: keys/vals length mismatch");
+    for (i = 0; i < num_in; ++i) {
+      SV **e = av_fetch(in_av, i, 0);
+      inputs[i] = e ? INT2PTR(NDArrayHandle, SvIV(*e)) : NULL;
+    }
+    for (i = 0; i < num_params; ++i) {
+      SV **k = av_fetch(keys_av, i, 0);
+      SV **v = av_fetch(vals_av, i, 0);
+      keys[i] = k ? SvPV_nolen(*k) : "";
+      vals[i] = v ? SvPV_nolen(*v) : "";
+    }
+    croak_on(aTHX_ MXImperativeInvoke(op_name, inputs, num_in,
+                                      num_params, keys, vals, &num_out,
+                                      outputs, MAX_IO),
+             "MXImperativeInvoke");
+    av = newAV();
+    for (i = 0; i < num_out; ++i)
+      av_push(av, newSViv(PTR2IV(outputs[i])));
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+SV *
+list_ops()
+  PREINIT:
+    int count, i;
+    const char **names;
+    AV *av;
+  CODE:
+    croak_on(aTHX_ MXListOps(&count, &names), "MXListOps");
+    av = newAV();
+    for (i = 0; i < count; ++i)
+      av_push(av, newSVpv(names[i], 0));
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+IV
+pred_create(symbol_json, param_sv, ctx_type, ctx_id, input_keys_ref, shapes_ref)
+    const char *symbol_json
+    SV *param_sv
+    int ctx_type
+    int ctx_id
+    SV *input_keys_ref
+    SV *shapes_ref
+  PREINIT:
+    AV *keys_av;
+    AV *shapes_av;
+    const char *keys[MAX_IO];
+    uint32_t indptr[MAX_IO + 1];
+    uint32_t shape_data[MAX_IO * MAX_NDIM];
+    int nkeys, i, j, pos = 0;
+    STRLEN param_len;
+    const char *param_bytes;
+    PredictorHandle out;
+  CODE:
+    if (!SvROK(input_keys_ref)
+        || SvTYPE(SvRV(input_keys_ref)) != SVt_PVAV)
+      croak("pred_create: input_keys must be an ARRAY ref");
+    if (!SvROK(shapes_ref) || SvTYPE(SvRV(shapes_ref)) != SVt_PVAV)
+      croak("pred_create: shapes must be an ARRAY ref of ARRAY refs");
+    keys_av = (AV *)SvRV(input_keys_ref);
+    shapes_av = (AV *)SvRV(shapes_ref);
+    nkeys = (int)(av_len(keys_av) + 1);
+    if (nkeys > MAX_IO) croak("pred_create: too many inputs");
+    if (av_len(shapes_av) + 1 != nkeys)
+      croak("pred_create: keys/shapes length mismatch");
+    indptr[0] = 0;
+    for (i = 0; i < nkeys; ++i) {
+      SV **k = av_fetch(keys_av, i, 0);
+      SV **s = av_fetch(shapes_av, i, 0);
+      AV *sh;
+      int ndim;
+      keys[i] = k ? SvPV_nolen(*k) : "";
+      if (!s || !SvROK(*s) || SvTYPE(SvRV(*s)) != SVt_PVAV)
+        croak("pred_create: shapes[%d] must be an ARRAY ref", i);
+      sh = (AV *)SvRV(*s);
+      ndim = (int)(av_len(sh) + 1);
+      for (j = 0; j < ndim; ++j) {
+        SV **e = av_fetch(sh, j, 0);
+        if (pos >= MAX_IO * MAX_NDIM)
+          croak("pred_create: shape data overflow");
+        shape_data[pos++] = e ? (uint32_t)SvUV(*e) : 0;
+      }
+      indptr[i + 1] = (uint32_t)pos;
+    }
+    param_bytes = SvPV(param_sv, param_len);
+    croak_on(aTHX_ MXPredCreate(symbol_json, param_bytes,
+                                (int)param_len, ctx_type, ctx_id,
+                                nkeys, keys, indptr, shape_data, &out),
+             "MXPredCreate");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+pred_set_input(h, key, data_ref)
+    IV h
+    const char *key
+    SV *data_ref
+  PREINIT:
+    AV *av;
+    ssize_t n;
+    float *buf;
+    int i, rc;
+  CODE:
+    if (!SvROK(data_ref) || SvTYPE(SvRV(data_ref)) != SVt_PVAV)
+      croak("pred_set_input: data must be an ARRAY ref");
+    av = (AV *)SvRV(data_ref);
+    n = av_len(av) + 1;
+    buf = (float *)malloc((size_t)n * sizeof(float));
+    if (!buf) croak("pred_set_input: out of memory");
+    for (i = 0; i < n; ++i) {
+      SV **e = av_fetch(av, i, 0);
+      buf[i] = e ? (float)SvNV(*e) : 0.0f;
+    }
+    rc = MXPredSetInput(INT2PTR(PredictorHandle, h), key, buf,
+                        (uint32_t)n);
+    free(buf);
+    croak_on(aTHX_ rc, "MXPredSetInput");
+
+void
+pred_forward(h)
+    IV h
+  CODE:
+    croak_on(aTHX_ MXPredForward(INT2PTR(PredictorHandle, h)),
+             "MXPredForward");
+
+SV *
+pred_get_output(h, index)
+    IV h
+    unsigned int index
+  PREINIT:
+    const uint32_t *shape_data;
+    uint32_t shape_ndim, i;
+    ssize_t n;
+    float *buf;
+    AV *av;
+    AV *shape_av;
+    HV *hv;
+    int rc;
+  CODE:
+    croak_on(aTHX_ MXPredGetOutputShape(INT2PTR(PredictorHandle, h),
+                                        index, &shape_data,
+                                        &shape_ndim),
+             "MXPredGetOutputShape");
+    n = 1;
+    shape_av = newAV();
+    for (i = 0; i < shape_ndim; ++i) {
+      n *= shape_data[i];
+      av_push(shape_av, newSVuv(shape_data[i]));
+    }
+    buf = (float *)malloc((size_t)n * sizeof(float));
+    if (!buf) croak("pred_get_output: out of memory");
+    rc = MXPredGetOutput(INT2PTR(PredictorHandle, h), index, buf,
+                         (uint32_t)n);
+    if (rc != 0) {
+      free(buf);
+      croak("MXPredGetOutput failed: %s", MXTPUGetLastError());
+    }
+    av = newAV();
+    for (i = 0; i < n; ++i)
+      av_push(av, newSVnv((NV)buf[i]));
+    free(buf);
+    hv = newHV();
+    hv_store(hv, "shape", 5, newRV_noinc((SV *)shape_av), 0);
+    hv_store(hv, "data", 4, newRV_noinc((SV *)av), 0);
+    RETVAL = newRV_noinc((SV *)hv);
+  OUTPUT:
+    RETVAL
+
+void
+pred_free(h)
+    IV h
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, h));

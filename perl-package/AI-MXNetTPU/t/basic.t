@@ -1,0 +1,88 @@
+#!/usr/bin/perl
+# AI::MXNetTPU smoke: NDArray round-trip, overloaded arithmetic, dot on
+# the MXU path, invoke-by-name, error propagation, and the predict
+# surface over a symbol JSON built by the Python frontend when the
+# fixture exists (tests/test_perl_package.py generates it).
+use strict;
+use warnings;
+use Test::More;
+use FindBin;
+
+use_ok('AI::MXNetTPU');
+
+ok(AI::MXNetTPU::version() >= 200, 'version');
+ok(AI::MXNetTPU::has_feature('C_API'), 'C_API feature');
+AI::MXNetTPU::seed(0);
+
+my @ops = AI::MXNetTPU::list_ops();
+ok(@ops > 100, 'op registry visible (' . scalar(@ops) . ' ops)');
+
+# --- NDArray round-trip
+my $a = AI::MXNetTPU::NDArray->new([2, 3], [1, 2, 3, 4, 5, 6]);
+is_deeply($a->shape, [2, 3], 'shape');
+is($a->size, 6, 'size');
+is_deeply($a->aslist, [1, 2, 3, 4, 5, 6], 'data round-trip');
+
+# --- overloaded arithmetic
+my $b = AI::MXNetTPU::NDArray->new([2, 3], [10, 20, 30, 40, 50, 60]);
+is_deeply(($a + $b)->aslist, [11, 22, 33, 44, 55, 66], 'add');
+is_deeply(($b - $a)->aslist, [9, 18, 27, 36, 45, 54], 'sub');
+is_deeply(($a * 2)->aslist, [2, 4, 6, 8, 10, 12], 'mul scalar');
+
+# --- dot: (2,3) x (3,2)
+my $c = AI::MXNetTPU::NDArray->new([3, 2], [1, 0, 0, 1, 1, 1]);
+is_deeply($a->dot($c)->aslist, [4, 5, 10, 11], 'dot');
+
+# --- arbitrary op via invoke (activation)
+my $neg = AI::MXNetTPU::NDArray->new([4], [-2, -1, 1, 2]);
+is_deeply(AI::MXNetTPU::NDArray::invoke('relu', [$neg])->aslist,
+          [0, 0, 1, 2], 'invoke relu');
+
+# --- softmax sums to 1
+my $sm = AI::MXNetTPU::NDArray::invoke('softmax',
+    [ AI::MXNetTPU::NDArray->new([1, 3], [1, 2, 3]) ]);
+my $sum = 0;
+$sum += $_ for @{ $sm->aslist };
+ok(abs($sum - 1) < 1e-5, 'softmax normalized');
+
+# --- errors surface as croaks with the C-side message
+eval { AI::MXNetTPU::NDArray::invoke('no_such_op_xyz', [$a]) };
+like($@, qr/MXImperativeInvoke failed/, 'bad op croaks');
+
+my $bad = AI::MXNetTPU::NDArray->new([2, 2], [1, 2, 3, 4]);
+eval { $a->dot($bad) };    # (2,3) x (2,2) mismatch
+like($@, qr/failed/, 'shape mismatch croaks');
+
+# --- predict surface (fixture written by tests/test_perl_package.py)
+my $fixture_dir = $ENV{MXTPU_PERL_FIXTURE} // "$FindBin::Bin/fixture";
+SKIP: {
+    skip 'no predict fixture', 3
+        unless -e "$fixture_dir/model-symbol.json";
+    open my $fh, '<', "$fixture_dir/model-symbol.json" or die $!;
+    my $json = do { local $/; <$fh> };
+    close $fh;
+    open my $pf, '<:raw', "$fixture_dir/model-0000.params" or die $!;
+    my $params = do { local $/; <$pf> };
+    close $pf;
+    my $pred = AI::MXNetTPU::Predictor->new(
+        symbol_json => $json, params => $params,
+        inputs => { data => [1, 16] });
+    ok($pred, 'predictor created');
+    $pred->set_input('data', [ map { 0.1 * $_ } 1 .. 16 ])->forward;
+    my $out = $pred->output(0);
+    is_deeply($out->{shape}, [1, 8], 'predict output shape');
+    my $expect = do {
+        open my $ef, '<', "$fixture_dir/expected.txt" or die $!;
+        local $/;
+        [ split ' ', <$ef> ];
+    };
+    my $max_err = 0;
+    for my $i (0 .. $#{ $out->{data} }) {
+        my $e = abs($out->{data}[$i] - $expect->[$i]);
+        $max_err = $e if $e > $max_err;
+    }
+    ok($max_err < 1e-4, "predict matches python frontend "
+        . "(max err $max_err)");
+}
+
+done_testing();

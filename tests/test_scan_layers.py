@@ -96,13 +96,7 @@ class TestScanLayers:
         np.testing.assert_allclose(both(x).asnumpy(), base(x).asnumpy(),
                                    rtol=2e-5, atol=2e-5)
 
-    def test_dropout_differs_per_layer(self):
-        """each scanned layer must draw its own dropout mask — a
-        shared mask would silently change training statistics. With
-        identity-ish layers the outputs of a 2-layer stack under the
-        SAME mask would correlate; instead we check the per-layer keys
-        really fold the layer index by comparing two stacks that only
-        differ in depth."""
+    def test_dropout_reproducible_across_seeds(self):
         enc = _mk(scan=True, dropout=0.5, layers=2)
         enc.hybridize()
         x = nd.ones((2, 8, 16), ctx=mx.cpu())
@@ -119,6 +113,44 @@ class TestScanLayers:
             c = enc(x).asnumpy()
         assert np.abs(a - c).max() > 1e-6, \
             "different seed must change dropout draws"
+
+    def test_per_layer_keys_are_independent(self, monkeypatch):
+        """the scan must feed each layer its OWN folded key — spy on
+        the xs handed to lax.scan and pin both pairwise distinctness
+        and the exact fold_in(base, layer_idx) rule, so a regression
+        to a shared key (identical dropout masks every layer) cannot
+        pass silently."""
+        import jax
+        import mxnet_tpu.random as _rnd
+
+        L = 4
+        enc = _mk(scan=True, dropout=0.3, layers=L)
+        x = nd.random.normal(shape=(2, 8, 16), ctx=mx.cpu())
+
+        # reproduce the base key _scan_forward will draw next
+        mx.random.seed(21)
+        base = _rnd._next_key_nd(mx.cpu())._data
+        expected = np.stack([
+            np.asarray(jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(base), i)))
+            for i in range(L)])
+
+        captured = []
+        orig_scan = jax.lax.scan
+
+        def spy(body, init, xs, *a, **kw):
+            captured.append(xs)
+            return orig_scan(body, init, xs, *a, **kw)
+
+        monkeypatch.setattr(jax.lax, "scan", spy)
+        mx.random.seed(21)
+        enc._scan_forward(x, None)   # eager scan: concrete xs
+        assert captured, "scan was not invoked"
+        keys = np.asarray(captured[0][-1])
+        assert keys.shape[0] == L
+        assert len({k.tobytes() for k in keys}) == L, \
+            "layer keys must be pairwise distinct"
+        np.testing.assert_array_equal(keys, expected)
 
     def test_bert_scan_trains_in_fused_step(self):
         """end-to-end: a scanned BERT through the fused SPMD trainer
