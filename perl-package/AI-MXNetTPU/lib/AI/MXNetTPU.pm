@@ -101,15 +101,12 @@ sub invoke {
 sub _binop {
     my ($op, $a, $b, $swap) = @_;
     if (!ref $b) {    # scalar operand
-        my $scalar_op = { add => '_plus_scalar',
-                          sub => '_minus_scalar',
-                          mul => '_mul_scalar' }->{$op};
-        my $out = invoke($scalar_op, [$a], scalar => $b);
-        return $swap && $op eq 'sub'
-            ? invoke('_mul_scalar', [ invoke('_minus_scalar', [$a],
-                                             scalar => $b) ],
-                     scalar => -1)
-            : $out;
+        my $scalar_op = ($swap && $op eq 'sub')
+            ? '_rminus_scalar'
+            : { add => '_plus_scalar',
+                sub => '_minus_scalar',
+                mul => '_mul_scalar' }->{$op};
+        return invoke($scalar_op, [$a], scalar => $b);
     }
     my @pair = $swap ? ($b, $a) : ($a, $b);
     my $array_op = { add => 'elemwise_add', sub => 'elemwise_sub',

@@ -50,6 +50,36 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_tpu)
 
 
+def pjrt_include_dir():
+    """The vendored PJRT C API headers, shared with tools/amalgamate."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_amalgamate", os.path.join(repo, "tools", "amalgamate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.pjrt_include_dir()
+
+
+@pytest.fixture(scope="session")
+def mock_plugin(tmp_path_factory):
+    """Build the in-memory mock PJRT plugin (echo executable)."""
+    import subprocess
+    inc = pjrt_include_dir()
+    if not inc:
+        pytest.skip("PJRT headers not present")
+    out = str(tmp_path_factory.mktemp("mockpjrt") / "mock_pjrt.so")
+    src = os.path.join(os.path.dirname(__file__), "c_smoke",
+                       "mock_pjrt_plugin.cc")
+    r = subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-fPIC", "-shared",
+         "-I" + inc + "/tensorflow/compiler", "-o", out, src],
+        capture_output=True, text=True, timeout=240)
+    if r.returncode != 0:
+        pytest.fail("mock plugin build failed:\n" + r.stderr[-2000:])
+    return out
+
+
 def compile_and_run_c(sources, exe_path, compiler="gcc",
                       extra_flags=(), timeout=300, run_args=()):
     """Shared scaffold for standalone C/C++ programs linked against

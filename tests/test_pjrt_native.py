@@ -76,27 +76,6 @@ class TestOnChip:
         np.testing.assert_allclose(buf.to_numpy(), x * 8.0, rtol=1e-5)
 
 
-@pytest.fixture(scope="module")
-def mock_plugin(tmp_path_factory):
-    """Build the in-memory mock PJRT plugin (echo executable)."""
-    import subprocess
-    import glob
-    inc = glob.glob("/opt/venv/lib/python*/site-packages/tensorflow/"
-                    "include")
-    if not inc:
-        pytest.skip("PJRT headers not present")
-    out = str(tmp_path_factory.mktemp("mockpjrt") / "mock_pjrt.so")
-    src = os.path.join(os.path.dirname(__file__), "c_smoke",
-                       "mock_pjrt_plugin.cc")
-    r = subprocess.run(
-        ["g++", "-O1", "-std=c++17", "-fPIC", "-shared",
-         "-I" + inc[0] + "/tensorflow/compiler", "-o", out, src],
-        capture_output=True, text=True, timeout=240)
-    if r.returncode != 0:
-        pytest.fail("mock plugin build failed:\n" + r.stderr[-2000:])
-    return out
-
-
 class TestAgainstMockPlugin:
     """The full native loop — load, client, compile, host->device,
     execute, device->host, chaining, teardown — through the REAL PJRT
