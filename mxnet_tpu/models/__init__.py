@@ -11,9 +11,15 @@ from . import ssd
 from .ssd import SSD, ssd_tiny, MultiBoxLoss
 from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
                     llama_tiny, llama3_8b)
+from . import nmt
+from .nmt import (TransformerNMT, BeamSearchScorer, BeamSearchSampler,
+                  get_nmt, nmt_tiny, transformer_en_de_512)
 
 __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
            "bert_small", "bert_large", "get_bert", "forecast",
            "DeepAR", "TransformerForecaster", "llama", "LlamaModel",
-           "LlamaForCausalLM", "get_llama", "llama_tiny", "llama3_8b"]
+           "LlamaForCausalLM", "get_llama", "llama_tiny", "llama3_8b",
+           "nmt", "TransformerNMT", "BeamSearchScorer",
+           "BeamSearchSampler", "get_nmt", "nmt_tiny",
+           "transformer_en_de_512"]
