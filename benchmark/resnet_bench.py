@@ -17,8 +17,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os as _os
 import sys
 import time
+
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
 
 
 def bench(model_name, batch, image_size, steps, warmup, train):

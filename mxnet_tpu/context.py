@@ -25,6 +25,9 @@ def _jax():
     return jax
 
 
+_WARNED_NO_CPU_BACKEND = False
+
+
 class Context:
     """A device context.  Compared by (device_type, device_id).
 
@@ -76,7 +79,23 @@ class Context:
             # global list contains other workers' (non-addressable)
             # devices; ctx ids are per-worker-local like mx.gpu(i)
             if self.device_type in ("cpu", "cpu_pinned"):
-                devs = jax.local_devices(backend="cpu")
+                try:
+                    devs = jax.local_devices(backend="cpu")
+                except RuntimeError:
+                    # some PJRT plugins (axon) register themselves as
+                    # the ONLY jax backend — there is no host XLA
+                    # device at all.  Fall back to the plugin's devices
+                    # so default-ctx creation ops still run, instead of
+                    # crashing every call site that omitted ctx=
+                    global _WARNED_NO_CPU_BACKEND
+                    if not _WARNED_NO_CPU_BACKEND:
+                        _WARNED_NO_CPU_BACKEND = True
+                        import warnings
+                        warnings.warn(
+                            "no cpu XLA backend is registered; "
+                            "mx.cpu() falls back to the default "
+                            "accelerator device")
+                    devs = jax.local_devices()
             elif self.device_type == "tpu":
                 try:
                     devs = jax.local_devices()  # default backend = TPU plugin

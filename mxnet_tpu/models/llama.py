@@ -271,8 +271,11 @@ class LlamaForCausalLM(HybridBlock):
         # offset rides the dynamic-scalar path (nd.full would bake it
         # into static attrs and compile a fresh program per step)
         max_len = caches[0][0].shape[1]
-        mask = (nd.arange(max_len) <= float(offset)).reshape(
-            (1, 1, 1, max_len))
+        # build the mask on the token's device: the default (cpu) ctx
+        # does not exist under the axon plugin, which registers itself
+        # as the ONLY jax backend
+        mask = (nd.arange(max_len, ctx=token.context)
+                <= float(offset)).reshape((1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.step(x, ck, cv, offset, mask)
         h = self.model.final_norm(x)

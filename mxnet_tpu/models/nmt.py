@@ -225,10 +225,11 @@ class TransformerNMT(HybridBlock):
 
     # ---- teacher-forcing path ---------------------------------------
 
-    def _embed(self, F, embed, tokens, offset=0):
+    def _embed(self, F, embed, tokens, pos_table=None):
         s = tokens.shape[1]
-        pos = F.slice_axis(self.pos_table.data(tokens.context), axis=0,
-                           begin=offset, end=offset + s)
+        if pos_table is None:
+            pos_table = self.pos_table.data(tokens.context)
+        pos = F.slice_axis(pos_table, axis=0, begin=0, end=s)
         return embed(tokens) * self._scale + F.expand_dims(pos, axis=0)
 
     def _head(self, F, h):
@@ -252,17 +253,13 @@ class TransformerNMT(HybridBlock):
     def hybrid_forward(self, F, src, tgt, src_valid=None,
                        tgt_valid=None, pos_table=None):
         s_src, s_tgt = src.shape[1], tgt.shape[1]
-        pos = pos_table if pos_table is not None else \
-            self.pos_table.data(src.context)
-        x = self.src_embed(src) * self._scale + F.expand_dims(
-            F.slice_axis(pos, axis=0, begin=0, end=s_src), axis=0)
+        x = self._embed(F, self.src_embed, src, pos_table)
         src_mask = None
         if src_valid is not None:
             src_mask = self._key_mask(F, src_valid, s_src, src.context)
         memory = self.encoder(x, src_mask)
 
-        y = self.tgt_embed(tgt) * self._scale + F.expand_dims(
-            F.slice_axis(pos, axis=0, begin=0, end=s_tgt), axis=0)
+        y = self._embed(F, self.tgt_embed, tgt, pos_table)
         tgt_mask = None
         if tgt_valid is not None:
             tgt_mask = self._key_mask(F, tgt_valid, s_tgt, tgt.context)
@@ -330,8 +327,9 @@ class TransformerNMT(HybridBlock):
         x = (self.tgt_embed(tok) * self._scale
              + nd.expand_dims(pos, axis=0))
         max_len = states[0][0].shape[1]
-        self_mask = (nd.arange(max_len) <= float(offset)).reshape(
-            (1, 1, 1, max_len))
+        # mask on the token's device (no cpu backend under axon)
+        self_mask = (nd.arange(max_len, ctx=tok.context)
+                     <= float(offset)).reshape((1, 1, 1, max_len))
         for cell, (ck, cv), (mk, mv) in zip(self.decoder_cells, states,
                                             mem_kvs):
             x = cell.step(x, ck, cv, offset, self_mask, mk, mv,
@@ -478,13 +476,17 @@ class BeamSearchSampler:
             # reorder the beam axis of every state by parent index
             flat_parent = (parent
                            + np.arange(b)[:, None] * k).reshape(-1)
-            idx_nd = nd.array(flat_parent.astype(np.float32), ctx=ctx)
-            states = _gather_states(states, idx_nd)
             hist = np.concatenate(
                 [hist[np.arange(b)[:, None], parent],
                  next_tok[:, :, None]], axis=-1)
-            cur = nd.array(next_tok.reshape(b * k, 1).astype(
-                np.float32), ctx=ctx)
+            if step < self.max_length - 2:
+                # the final iteration's gather/upload would never be
+                # consumed — only the host-side close-out remains
+                idx_nd = nd.array(flat_parent.astype(np.float32),
+                                  ctx=ctx)
+                states = _gather_states(states, idx_nd)
+                cur = nd.array(next_tok.reshape(b * k, 1).astype(
+                    np.float32), ctx=ctx)
 
         # close out still-alive beams without EOS at max length
         for i in range(b):
@@ -507,10 +509,9 @@ class BeamSearchSampler:
                 samples[i, j, :len(seq)] = seq
                 scores[i, j] = sc
                 lens[i, j] = len(seq)
-        from .. import ndarray as nd2
-        return (nd2.array(samples.astype(np.float32)),
-                nd2.array(scores.astype(np.float32)),
-                nd2.array(lens.astype(np.float32)))
+        return (nd.array(samples.astype(np.float32), ctx=ctx),
+                nd.array(scores.astype(np.float32), ctx=ctx),
+                nd.array(lens.astype(np.float32), ctx=ctx))
 
 
 def _gather_states(states, idx_nd):

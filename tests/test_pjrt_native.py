@@ -62,8 +62,12 @@ class TestOnChip:
             lambda x, y: jnp.dot(x, y) + 1.0, (a, b))
         assert exe.num_outputs == 1
         (out,) = exe(a, b)
-        np.testing.assert_allclose(np.asarray(out.to_numpy()),
-                                   a @ b + 1.0, rtol=2e-2, atol=1e-2)
+        # bf16-operand MXU matmul: absolute error scales with the
+        # result magnitude, so anchor atol to it
+        ref = a @ b + 1.0
+        np.testing.assert_allclose(np.asarray(out.to_numpy()), ref,
+                                   rtol=2e-2,
+                                   atol=2e-2 * np.abs(ref).max())
 
     def test_device_buffers_chain_without_host_hops(self):
         import jax.numpy as jnp
