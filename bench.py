@@ -232,17 +232,38 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
         # trace-time counter: nonzero delta == the compiled step
         # CONTAINS the Pallas flash kernel (not merely could)
         flash_hits = _attn.flash_dispatch_count() - flash_before
-        _log(f"{builder_name}: timing {steps} steps")
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = dpt.step(data, label)
-        loss.wait_to_read()
-        dt = time.perf_counter() - t0
-        assert np.isfinite(float(loss.asnumpy()))
+        # Two-point slope timing: the axon tunnel's block_until_ready
+        # can acknowledge before execution finishes and its host
+        # round-trip adds a large fixed cost, so a single timed loop
+        # mixes both errors into the step time.  Timing n and 3n steps
+        # with a FORCED scalar materialization inside each window and
+        # taking the slope cancels every fixed cost (probe, transfer,
+        # early-ack queue drain) and leaves the true per-step time.
+        def timed_window(n):
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(n):
+                last = dpt.step(data, label)
+            val = float(last.asnumpy())      # cannot return early
+            assert np.isfinite(val)
+            return time.perf_counter() - t0
+
+        n1 = max(min(steps // 3, steps - 1), 1)
+        _log(f"{builder_name}: timing {n1} + {steps} steps (slope)")
+        t_small = timed_window(n1)
+        dt = timed_window(steps)
+        slope = (dt - t_small) / (steps - n1)
+        naive = dt / steps
+        if slope <= 0 or slope < 0.2 * naive:
+            # contention artifact (window order flipped); fall back
+            _log(f"{builder_name}: slope unstable "
+                 f"({slope * 1e3:.2f} vs naive {naive * 1e3:.2f} "
+                 "ms/step), reporting naive")
+            slope = naive
     finally:
         amp._deinit()
 
-    sps = batch_size * steps / dt
+    sps = batch_size / slope
     # analytic MFU: fwd+bwd ≈ 6 * non-embedding-params * tokens, plus
     # attention 12 * L * H * S^2 per sample (fwd+bwd); embedding
     # lookups are gathers, not matmuls, so exclude those tables
@@ -256,7 +277,8 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
     _record("bert_pretrain", platform="tpu" if on_tpu else "cpu",
             builder=builder_name, batch_size=batch_size,
             seq_len=seq_len, steps=steps, total_s=round(dt, 3),
-            avg_step_ms=round(dt / steps * 1e3, 2),
+            avg_step_ms=round(slope * 1e3, 2),
+            naive_step_ms=round(naive * 1e3, 2),
             samples_per_sec=round(sps, 2), mfu=round(mfu, 4),
             flash_dispatches=flash_hits, scan_layers=scan_layers,
             remat=remat)
