@@ -14,6 +14,9 @@ from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
 from . import nmt
 from .nmt import (TransformerNMT, BeamSearchScorer, BeamSearchSampler,
                   get_nmt, nmt_tiny, transformer_en_de_512)
+from . import segmentation
+from .segmentation import (FCN, DeepLabV3, SegmentationMetric,
+                           SoftmaxSegLoss, fcn_tiny, deeplab_tiny)
 
 __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
@@ -22,4 +25,6 @@ __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "LlamaForCausalLM", "get_llama", "llama_tiny", "llama3_8b",
            "nmt", "TransformerNMT", "BeamSearchScorer",
            "BeamSearchSampler", "get_nmt", "nmt_tiny",
-           "transformer_en_de_512"]
+           "transformer_en_de_512", "segmentation", "FCN", "DeepLabV3",
+           "SegmentationMetric", "SoftmaxSegLoss", "fcn_tiny",
+           "deeplab_tiny"]

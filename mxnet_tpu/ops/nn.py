@@ -437,11 +437,28 @@ def dropout(data, key, *, p=0.5, mode="training", axes=(), training=False):
 @register("UpSampling", num_inputs=None)
 def upsampling(data, *rest, scale=1, sample_type="nearest", num_args=1,
                num_filter=0, multi_input_mode="concat", workspace=0):
-    if sample_type != "nearest":
-        raise NotImplementedError("bilinear UpSampling lands with the "
-                                  "vision-ops milestone")
-    out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
-    return out
+    """Reference src/operator/nn/upsampling.cc.  ``nearest`` repeats
+    pixels; ``bilinear`` resizes with the standard align-corners=False
+    linear kernel — equivalent to the reference's fixed-bilinear-weight
+    deconvolution (callers there pass the conventional
+    ``init.Bilinear()`` weight; a learnable variant is a Conv2DTranspose
+    in user code, so the extra weight input, when given, is ignored)."""
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2),
+                          scale, axis=3)
+    if sample_type != "bilinear":
+        raise NotImplementedError(
+            f"UpSampling sample_type {sample_type!r}: only 'nearest' "
+            "and 'bilinear' exist (reference upsampling.cc)")
+    if rest:
+        import warnings
+        warnings.warn(
+            "UpSampling(bilinear): the weight input is ignored — this "
+            "op implements the FIXED bilinear kernel (init.Bilinear); "
+            "for a learned upsampling filter use Conv2DTranspose")
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * scale, w * scale),
+                            method="linear")
 
 
 @register("BilinearResize2D")

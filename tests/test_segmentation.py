@@ -1,0 +1,157 @@
+"""Segmentation family tests (GluonCV FCN/DeepLabV3 capability —
+SURVEY.md §2.6): shapes, ignore-label semantics, metric math against a
+hand computation, bilinear UpSampling, and convergence on a synthetic
+blob-segmentation task with pixAcc/mIoU checked through the streaming
+metric."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.segmentation import (
+    FCN, DeepLabV3, SegmentationMetric, SoftmaxSegLoss, fcn_tiny,
+    deeplab_tiny)
+
+
+def _blob_batch(n, size=32, seed=0):
+    """Dark background (class 0), bright square (1), mid circle (2)."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, size, size).astype("f4") * 0.1
+    y = np.zeros((n, size, size), "f4")
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        cx, cy, r = rng.randint(8, size - 8, 3)
+        r = max(r // 4, 3)
+        sq = (np.abs(yy - cy) < r) & (np.abs(xx - cx) < r)
+        x[i, :, sq] += 0.8
+        y[i][sq] = 1
+        cx2, cy2 = rng.randint(6, size - 6, 2)
+        circ = (yy - cy2) ** 2 + (xx - cx2) ** 2 < 9
+        x[i, 1, circ] += 0.5
+        y[i][circ] = 2
+    return nd.array(x), nd.array(y)
+
+
+class TestForward:
+    @pytest.mark.parametrize("mk", [fcn_tiny, deeplab_tiny])
+    def test_shapes_and_grads(self, mk):
+        net = mk(nclass=3)
+        net.initialize(mx.init.Xavier())
+        x, y = _blob_batch(2)
+        out, aux = net(x)
+        assert out.shape == (2, 3, 32, 32)
+        assert aux.shape == (2, 3, 32, 32)
+        with autograd.record():
+            loss = SoftmaxSegLoss()(net(x), y)
+        loss.backward()
+        assert np.isfinite(float(loss.asnumpy().ravel()[0]))
+        assert net.predict(x).shape == (2, 32, 32)
+
+    def test_no_aux_single_output(self):
+        net = fcn_tiny(nclass=3, aux=False)
+        net.initialize(mx.init.Xavier())
+        x, _ = _blob_batch(1)
+        out = net(x)
+        assert not isinstance(out, tuple)
+        assert out.shape == (1, 3, 32, 32)
+
+    def test_ignore_label_excluded_from_loss(self):
+        net = fcn_tiny(nclass=3, aux=False)
+        net.initialize(mx.init.Xavier())
+        x, y = _blob_batch(2)
+        loss_fn = SoftmaxSegLoss(ignore_label=-1)
+        base = float(loss_fn(net(x), y).asnumpy().ravel()[0])
+        # flip half the pixels to ignore: the loss over the REMAINING
+        # pixels must stay finite and generally change, but setting
+        # ALL to ignore must not divide by zero
+        y_all = nd.array(np.full(y.shape, -1, "f4"))
+        z = float(loss_fn(net(x), y_all).asnumpy().ravel()[0])
+        assert np.isfinite(base) and z == 0.0
+
+
+class TestMetric:
+    def test_matches_hand_computation(self):
+        m = SegmentationMetric(nclass=2)
+        label = np.array([[0, 0, 1, 1, -1]])
+        pred = np.array([[0, 1, 1, 0, 1]])
+        m.update(label, pred)
+        (_, acc), (_, miou) = m.get()
+        assert acc == pytest.approx(2 / 4)
+        # class0: inter 1, union 3; class1: inter 1, union 3
+        assert miou == pytest.approx(1 / 3)
+
+    def test_streaming_accumulates(self):
+        m = SegmentationMetric(nclass=2)
+        m.update(np.array([[0, 1]]), np.array([[0, 1]]))
+        m.update(np.array([[1, 0]]), np.array([[0, 1]]))
+        (_, acc), _ = m.get()
+        assert acc == pytest.approx(0.5)
+
+
+def _np_bilinear(img, sh, sw):
+    """Independent half-pixel edge-clamped bilinear (numpy only)."""
+    h, w = img.shape
+    out = np.zeros((sh, sw), img.dtype)
+    for oy in range(sh):
+        for ox in range(sw):
+            sy = np.clip((oy + 0.5) * h / sh - 0.5, 0, h - 1)
+            sx = np.clip((ox + 0.5) * w / sw - 0.5, 0, w - 1)
+            y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+            y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+            fy, fx = sy - y0, sx - x0
+            out[oy, ox] = (img[y0, x0] * (1 - fy) * (1 - fx)
+                           + img[y0, x1] * (1 - fy) * fx
+                           + img[y1, x0] * fy * (1 - fx)
+                           + img[y1, x1] * fy * fx)
+    return out
+
+
+class TestUpSampling:
+    def test_bilinear_matches_independent_numpy(self):
+        rng = np.random.RandomState(3)
+        img = rng.rand(4, 4).astype("f4")
+        x = nd.array(img.reshape(1, 1, 4, 4))
+        up = nd.UpSampling(x, scale=2, sample_type="bilinear")
+        want = _np_bilinear(img, 8, 8)
+        np.testing.assert_allclose(up.asnumpy()[0, 0], want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unknown_sample_type_raises(self):
+        x = nd.array(np.zeros((1, 1, 2, 2), "f4"))
+        with pytest.raises(Exception):
+            nd.UpSampling(x, scale=2, sample_type="bicubic")
+
+    def test_nearest_repeats(self):
+        x = nd.array(np.arange(4, dtype="f4").reshape(1, 1, 2, 2))
+        up = nd.UpSampling(x, scale=2, sample_type="nearest")
+        np.testing.assert_array_equal(
+            up.asnumpy()[0, 0],
+            [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+class TestConvergence:
+    def test_fcn_learns_blobs(self):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = fcn_tiny(nclass=3)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 2e-3})
+        loss_fn = SoftmaxSegLoss()
+        losses = []
+        for step in range(40):
+            x, y = _blob_batch(8, seed=step)
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+            losses.append(float(loss.asnumpy().ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+        m = SegmentationMetric(nclass=3)
+        x, y = _blob_batch(8, seed=999)
+        m.update(y, net.predict(x))
+        (_, acc), (_, miou) = m.get()
+        assert acc > 0.8, (acc, miou)
