@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Semantic segmentation with FCN (or DeepLabV3) on synthetic blobs.
+
+Parity model: upstream example/fcn-xs and GluonCV's segmentation
+training scripts.  Images contain a bright square (class 1) and a
+tinted circle (class 2) on noise; the net learns per-pixel labels,
+evaluated with the streaming pixAcc/mIoU metric.
+
+    python example/segmentation_fcn.py --ctx tpu --model deeplab
+    python example/segmentation_fcn.py --steps 12     # CI smoke
+"""
+import argparse
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import (fcn_tiny, deeplab_tiny, SoftmaxSegLoss,
+                              SegmentationMetric)
+
+
+def blob_batch(n, size, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, size, size).astype("f4") * 0.1
+    y = np.zeros((n, size, size), "f4")
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        cx, cy = rng.randint(8, size - 8, 2)
+        sq = (np.abs(yy - cy) < 4) & (np.abs(xx - cx) < 4)
+        x[i, :, sq] += 0.8
+        y[i][sq] = 1
+        cx2, cy2 = rng.randint(6, size - 6, 2)
+        circ = (yy - cy2) ** 2 + (xx - cx2) ** 2 < 9
+        x[i, 1, circ] += 0.5
+        y[i][circ] = 2
+    return nd.array(x), nd.array(y)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--model", default="fcn",
+                    choices=["fcn", "deeplab"])
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--size", type=int, default=32)
+    args = ap.parse_args()
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    mk = fcn_tiny if args.model == "fcn" else deeplab_tiny
+    net = mk(nclass=3)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = SoftmaxSegLoss()
+
+    for step in range(args.steps):
+        x, y = blob_batch(args.batch_size, args.size, seed=step)
+        x, y = x.as_in_context(ctx), y.as_in_context(ctx)
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 20 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss="
+                  f"{float(loss.asnumpy().ravel()[0]):.4f}")
+
+    metric = SegmentationMetric(nclass=3)
+    for s in range(4):
+        x, y = blob_batch(args.batch_size, args.size, seed=5000 + s)
+        metric.update(y, net.predict(x.as_in_context(ctx)))
+    (name_a, acc), (name_m, miou) = metric.get_name_value()
+    print(f"{args.model}: {name_a}={acc:.3f} {name_m}={miou:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

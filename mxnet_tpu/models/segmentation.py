@@ -16,6 +16,7 @@ import numpy as np
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..gluon import nn
+from ..metric import EvalMetric
 
 __all__ = ["FCN", "DeepLabV3", "SegmentationMetric", "fcn_tiny",
            "deeplab_tiny", "SoftmaxSegLoss"]
@@ -35,6 +36,11 @@ class _Backbone(HybridBlock):
             blocks = blocks[:-1]
         if len(blocks) < 3:
             raise MXNetError("backbone too shallow for segmentation")
+        if any(b.__class__.__name__ == "Dense" for b in blocks):
+            raise MXNetError(
+                "backbone features contain Dense layers (vgg/alexnet "
+                "style); segmentation taps need a fully-convolutional "
+                "backbone such as the resnet/mobilenet/densenet zoos")
         # plain-list storage + one register_child each: attribute
         # assignment would auto-register the taps a second time
         self._blocks = blocks
@@ -106,9 +112,11 @@ class _SegBase(HybridBlock):
 class FCN(_SegBase):
     """FCN-32s with stage-3 auxiliary supervision (GluonCV ``FCN``).
 
-    ``backbone`` is any zoo classification net (its classifier is
-    ignored); channels are read from the tapped stages at first call.
-    """
+    ``backbone`` is a fully-convolutional zoo classification net
+    (resnet/mobilenet/densenet family; the classifier head is
+    ignored); ``c3_channels``/``c4_channels`` name the channel counts
+    of its last two stages — 256/512 for resnet18/34, 1024/2048 for
+    resnet50+."""
 
     def __init__(self, nclass, backbone, c3_channels, c4_channels,
                  aux=True, dropout=0.1, **kwargs):
@@ -220,43 +228,54 @@ class SoftmaxSegLoss:
         return loss
 
 
-class SegmentationMetric:
+class SegmentationMetric(EvalMetric):
     """pixAcc + mIoU over streaming batches (GluonCV
-    ``SegmentationMetric`` semantics; ignore label excluded)."""
+    ``SegmentationMetric`` semantics; ignore label excluded).
+
+    Subclasses :class:`mxnet_tpu.metric.EvalMetric`, so it composes
+    with ``CompositeEvalMetric``/``get_name_value()``.  One confusion
+    matrix accumulates per update via a single ``bincount`` pass
+    (O(pixels), not O(nclass·pixels))."""
 
     def __init__(self, nclass, ignore_label=-1):
         self.nclass = nclass
         self.ignore_label = ignore_label
-        self.reset()
+        super().__init__(name=["pixAcc", "mIoU"])
 
     def reset(self):
-        self._inter = np.zeros(self.nclass, np.int64)
-        self._union = np.zeros(self.nclass, np.int64)
-        self._correct = 0
-        self._labeled = 0
+        super().reset()
+        # reset() runs from the base __init__, before our __init__
+        # body assigns nclass
+        n = getattr(self, "nclass", 0)
+        self._cm = np.zeros((n, n), np.int64)
 
-    def update(self, label, pred):
-        label = np.asarray(label.asnumpy()
-                           if hasattr(label, "asnumpy") else label,
-                           np.int64)
-        pred = np.asarray(pred.asnumpy()
-                          if hasattr(pred, "asnumpy") else pred,
-                          np.int64)
-        keep = label != self.ignore_label
-        self._correct += int(((pred == label) & keep).sum())
-        self._labeled += int(keep.sum())
-        for c in range(self.nclass):
-            pi, li = (pred == c) & keep, label == c
-            self._inter[c] += int((pi & li).sum())
-            self._union[c] += int((pi | li).sum())
+    def update(self, labels, preds):
+        if not isinstance(labels, (list, tuple)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label = np.asarray(label.asnumpy()
+                               if hasattr(label, "asnumpy") else label,
+                               np.int64)
+            pred = np.asarray(pred.asnumpy()
+                              if hasattr(pred, "asnumpy") else pred,
+                              np.int64)
+            keep = label != self.ignore_label
+            li, pi = label[keep], pred[keep]
+            self._cm += np.bincount(
+                self.nclass * li + pi,
+                minlength=self.nclass ** 2).reshape(self.nclass,
+                                                    self.nclass)
+            self.num_inst += int(keep.sum())
 
     def get(self):
-        pix_acc = self._correct / max(self._labeled, 1)
-        seen = self._union > 0
-        iou = np.where(seen, self._inter / np.maximum(self._union, 1),
-                       np.nan)
+        cm = self._cm
+        inter = np.diag(cm)
+        acc = float(inter.sum() / max(cm.sum(), 1))
+        union = cm.sum(0) + cm.sum(1) - inter
+        seen = union > 0
+        iou = np.where(seen, inter / np.maximum(union, 1), np.nan)
         miou = float(np.nanmean(iou)) if seen.any() else 0.0
-        return ("pixAcc", pix_acc), ("mIoU", miou)
+        return (["pixAcc", "mIoU"], [acc, miou])
 
 
 def _tiny_backbone():

@@ -103,3 +103,24 @@ def test_int8_inference_smoke():
                 "--train-steps", "24"], timeout=420)
     assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
     assert "INT8 INFERENCE OK" in res.stdout
+
+
+def test_nmt_translate_smoke():
+    res = _run([os.path.join("example", "nmt_translate.py"),
+                "--steps", "30", "--batch-size", "16"])
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "exact-match" in res.stdout
+
+
+def test_segmentation_fcn_smoke():
+    res = _run([os.path.join("example", "segmentation_fcn.py"),
+                "--steps", "8", "--batch-size", "4"])
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "pixAcc=" in res.stdout
+
+
+def test_recommender_mf_smoke():
+    res = _run([os.path.join("example", "recommender_mf.py"),
+                "--steps", "60", "--batch-size", "256"])
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "held-out RMSE=" in res.stdout

@@ -75,7 +75,7 @@ class TestMetric:
         label = np.array([[0, 0, 1, 1, -1]])
         pred = np.array([[0, 1, 1, 0, 1]])
         m.update(label, pred)
-        (_, acc), (_, miou) = m.get()
+        (_, acc), (_, miou) = m.get_name_value()
         assert acc == pytest.approx(2 / 4)
         # class0: inter 1, union 3; class1: inter 1, union 3
         assert miou == pytest.approx(1 / 3)
@@ -84,7 +84,7 @@ class TestMetric:
         m = SegmentationMetric(nclass=2)
         m.update(np.array([[0, 1]]), np.array([[0, 1]]))
         m.update(np.array([[1, 0]]), np.array([[0, 1]]))
-        (_, acc), _ = m.get()
+        (_, acc), _ = m.get_name_value()
         assert acc == pytest.approx(0.5)
 
 
@@ -153,5 +153,5 @@ class TestConvergence:
         m = SegmentationMetric(nclass=3)
         x, y = _blob_batch(8, seed=999)
         m.update(y, net.predict(x))
-        (_, acc), (_, miou) = m.get()
+        (_, acc), (_, miou) = m.get_name_value()
         assert acc > 0.8, (acc, miou)
