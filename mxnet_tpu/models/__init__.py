@@ -17,6 +17,8 @@ from .nmt import (TransformerNMT, BeamSearchScorer, BeamSearchSampler,
 from . import segmentation
 from .segmentation import (FCN, DeepLabV3, SegmentationMetric,
                            SoftmaxSegLoss, fcn_tiny, deeplab_tiny)
+from . import yolo
+from .yolo import YOLOv3, YOLOv3Loss, yolo3_tiny
 
 __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
@@ -27,4 +29,5 @@ __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "BeamSearchSampler", "get_nmt", "nmt_tiny",
            "transformer_en_de_512", "segmentation", "FCN", "DeepLabV3",
            "SegmentationMetric", "SoftmaxSegLoss", "fcn_tiny",
-           "deeplab_tiny"]
+           "deeplab_tiny", "yolo", "YOLOv3", "YOLOv3Loss",
+           "yolo3_tiny"]

@@ -240,7 +240,9 @@ class SegmentationMetric(EvalMetric):
     def __init__(self, nclass, ignore_label=-1):
         self.nclass = nclass
         self.ignore_label = ignore_label
-        super().__init__(name=["pixAcc", "mIoU"])
+        # scalar base name (EvalMetric stringifies it); get() returns
+        # the two-value list form, which get_name_value() zips
+        super().__init__(name="segmentation")
 
     def reset(self):
         super().reset()

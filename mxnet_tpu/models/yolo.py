@@ -1,0 +1,295 @@
+"""YOLOv3 single-stage detector (capability target: GluonCV ``YOLOV3``
+family — SURVEY.md §2.6 external zoos; reference-era analog
+``example/ssd`` is covered by models/ssd.py, this adds the
+anchor-prior/multi-scale-grid family).
+
+TPU-first design — everything static-shape so train and decode each
+compile to one XLA program:
+- the three detection grids are fixed by the input size; anchors are
+  compile-time constants;
+- target assignment (best wh-IoU anchor per padded GT box) is computed
+  as dense one-hot matrices and applied by reductions, not scatter —
+  the (M, N) assignment matrix routes each GT to its grid slot, and
+  colliding GTs resolve to the lowest index;
+- the ignore mask (unmatched slots whose decoded box overlaps any GT
+  above ``ignore_iou``) is a dense (N, M) IoU reduced over M.
+Decode reuses the framework NMS (``_contrib_box_nms``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["YOLOv3", "YOLOv3Loss", "yolo3_tiny", "build_targets"]
+
+
+def _conv_bn_leaky(channels, kernel, stride=1, prefix=""):
+    out = nn.HybridSequential(prefix=prefix)
+    with out.name_scope():
+        out.add(nn.Conv2D(channels, kernel, strides=stride,
+                          padding=kernel // 2, use_bias=False),
+                nn.BatchNorm(),
+                nn.LeakyReLU(0.1))
+    return out
+
+
+class YOLOv3(HybridBlock):
+    """Darknet-style backbone + 3-scale YOLO heads.
+
+    ``anchors``: list of 3 lists of (w, h) pairs in PIXELS of the
+    input image, finest scale first (GluonCV convention reversed to
+    ascending stride).  ``forward`` returns the raw head tensor
+    (B, N, 5 + num_classes) with N = sum over scales of H*W*A, slot
+    layout [tx, ty, tw, th, obj, cls...]; ``decode`` turns it into
+    corner boxes + scores; the loss consumes it raw.
+    """
+
+    def __init__(self, num_classes, image_size=32, base_channels=16,
+                 anchors=None, **kwargs):
+        super().__init__(**kwargs)
+        if image_size % 32:
+            raise MXNetError("image_size must be a multiple of 32")
+        self.num_classes = num_classes
+        self._size = image_size
+        if anchors is None:
+            s = image_size
+            anchors = [[(s * .08, s * .08), (s * .16, s * .12),
+                        (s * .12, s * .20)],
+                       [(s * .25, s * .25), (s * .40, s * .30),
+                        (s * .30, s * .45)],
+                       [(s * .55, s * .55), (s * .80, s * .60),
+                        (s * .65, s * .85)]]
+        if len(anchors) != 3:
+            raise MXNetError("YOLOv3 uses exactly 3 scales")
+        self._anchors = [[(float(w), float(h)) for w, h in a]
+                         for a in anchors]
+        self._strides = [8, 16, 32]
+        with self.name_scope():
+            self.stem = nn.HybridSequential(prefix="stem_")
+            with self.stem.name_scope():
+                self.stem.add(_conv_bn_leaky(base_channels, 3))
+                for i in range(3):      # /8
+                    self.stem.add(_conv_bn_leaky(
+                        base_channels * 2 ** (i + 1), 3, stride=2))
+            self.stage4 = _conv_bn_leaky(base_channels * 16, 3,
+                                         stride=2, prefix="s4_")
+            self.stage5 = _conv_bn_leaky(base_channels * 32, 3,
+                                         stride=2, prefix="s5_")
+            self.heads = []
+            for i in range(3):
+                a = len(self._anchors[i])
+                head = nn.Conv2D(a * (5 + num_classes), 1,
+                                 prefix=f"head{i}_")
+                self.register_child(head, f"head{i}")
+                self.heads.append(head)
+        self._layout = self._build_layout()
+
+    # ---- static slot geometry ---------------------------------------
+
+    def _build_layout(self):
+        """Per-slot constants: grid cell origin (pixels), anchor w/h,
+        stride.  Shapes (N, 2)/(N, 2)/(N, 1), numpy float32."""
+        cells, awh, strides = [], [], []
+        for i, stride in enumerate(self._strides):
+            g = self._size // stride
+            ys, xs = np.mgrid[0:g, 0:g]
+            # slot order: (cell row-major) x anchors — matches the
+            # head reshape below
+            cell = np.stack([xs, ys], -1).reshape(-1, 2)   # (g*g, 2)
+            a = len(self._anchors[i])
+            cells.append(np.repeat(cell, a, axis=0) * stride)
+            awh.append(np.tile(np.asarray(self._anchors[i], "f4"),
+                               (g * g, 1)).reshape(-1, 2))
+            strides.append(np.full((g * g * a, 1), stride, "f4"))
+        return (np.concatenate(cells).astype("f4"),
+                np.concatenate(awh).astype("f4"),
+                np.concatenate(strides).astype("f4"))
+
+    @property
+    def num_slots(self):
+        return self._layout[0].shape[0]
+
+    # ---- forward ----------------------------------------------------
+
+    def hybrid_forward(self, F, x):
+        c3 = self.stem(x)
+        c4 = self.stage4(c3)
+        c5 = self.stage5(c4)
+        outs = []
+        for feat, head, anchors in zip((c3, c4, c5), self.heads,
+                                       self._anchors):
+            y = head(feat)                     # (B, A*(5+C), H, W)
+            b, _, h, w = y.shape
+            a = len(anchors)
+            y = y.reshape((b, a, 5 + self.num_classes, h * w))
+            # slot order (cell, anchor): transpose to (B, HW, A, ch)
+            y = y.transpose((0, 3, 1, 2)).reshape(
+                (b, h * w * a, 5 + self.num_classes))
+            outs.append(y)
+        return F.concat(*outs, dim=1)          # (B, N, 5+C)
+
+    def _layout_nd(self, ctx):
+        from .. import ndarray as nd
+        memo = getattr(self, "_layout_memo", None)
+        if memo is None:
+            memo = self._layout_memo = {}
+        if ctx not in memo:
+            cells, awh, strides = self._layout
+            memo[ctx] = (nd.array(cells, ctx=ctx),
+                         nd.array(awh, ctx=ctx),
+                         nd.array(strides, ctx=ctx))
+        return memo[ctx]
+
+    def decode(self, preds, conf_thresh=0.01, nms_thresh=0.45,
+               topk=100):
+        """Raw preds → (B, N, 6) [cls_id, score, x1, y1, x2, y2] in
+        [0,1] coords, NMS-suppressed rows set to -1 (framework NMS)."""
+        from .. import ndarray as nd
+        cells, awh, strides = self._layout_nd(preds.context)
+        xy = (nd.sigmoid(preds[:, :, 0:2]) * strides + cells) \
+            / self._size
+        wh = nd.exp(nd.clip(preds[:, :, 2:4], -8.0, 8.0)) * awh \
+            / self._size
+        obj = nd.sigmoid(preds[:, :, 4:5])
+        cls = nd.sigmoid(preds[:, :, 5:])
+        scores = obj * cls                       # (B, N, C)
+        cls_id = nd.argmax(scores, axis=-1, keepdims=True)
+        best = nd.max(scores, axis=-1, keepdims=True)
+        x1y1 = xy - wh / 2.0
+        x2y2 = xy + wh / 2.0
+        rows = nd.concat(cls_id.astype("float32"), best, x1y1, x2y2,
+                         dim=-1)
+        return nd.contrib.box_nms(rows, overlap_thresh=nms_thresh,
+                                  valid_thresh=conf_thresh, topk=topk,
+                                  id_index=0, score_index=1,
+                                  coord_start=2, force_suppress=False)
+
+
+def build_targets(net, labels, ctx):
+    """Static-shape YOLOv3 target assignment.
+
+    For each valid GT (cls >= 0), the matched slot is the one whose
+    anchor has the best wh-IoU with the GT AND whose grid cell (at
+    that slot's stride) contains the GT center.  Assignment is a dense
+    (B, M, N) matrix; slot targets come out of matmuls, never scatter.
+    Returns (obj_target (B,N), t_x, t_y, t_w, t_h, cls (B,N),
+    x1, y1, x2, y2 (B,M, pixels), valid (B,M,1))."""
+    from .. import ndarray as nd
+    size = float(net._size)
+    cells, awh, strides = net._layout_nd(ctx)
+    n = net.num_slots
+    valid = (labels[:, :, 0:1] >= 0)                       # (B, M, 1)
+    gt_cls = nd.maximum(labels[:, :, 0],
+                        nd.zeros_like(labels[:, :, 0]))
+    x1, y1 = labels[:, :, 1] * size, labels[:, :, 2] * size
+    x2, y2 = labels[:, :, 3] * size, labels[:, :, 4] * size
+    gx, gy = (x1 + x2) / 2.0, (y1 + y2) / 2.0              # (B, M)
+    gw = nd.maximum(x2 - x1, nd.ones_like(x1))
+    gh = nd.maximum(y2 - y1, nd.ones_like(y1))
+
+    # best anchor per GT by wh-IoU at the origin
+    aw = awh[:, 0].reshape((1, 1, n))
+    ah = awh[:, 1].reshape((1, 1, n))
+    gw_ = gw.expand_dims(-1)
+    gh_ = gh.expand_dims(-1)
+    inter = nd.minimum(gw_, aw) * nd.minimum(gh_, ah)
+    wh_iou = inter / (gw_ * gh_ + aw * ah - inter)         # (B, M, N)
+    best_iou = nd.max(wh_iou, axis=-1, keepdims=True)
+    is_best_shape = (wh_iou >= best_iou - 1e-9)
+    cx = cells[:, 0].reshape((1, 1, n))
+    cy = cells[:, 1].reshape((1, 1, n))
+    st = strides[:, 0].reshape((1, 1, n))
+    gx_ = gx.expand_dims(-1)
+    gy_ = gy.expand_dims(-1)
+    in_cell = ((gx_ >= cx) * (gx_ < cx + st)
+               * (gy_ >= cy) * (gy_ < cy + st))
+    assign = is_best_shape * in_cell * valid               # (B, M, N)
+
+    obj_target = nd.max(assign, axis=1)                    # (B, N)
+    # per-slot targets: when GTs collide on a slot, the LOWEST-index
+    # GT wins (argmax of the 0/1 assignment column) — categorical ids
+    # must never be averaged.  Unmatched slots read GT 0's values, but
+    # every consumer multiplies by the positive mask first.
+    first_gt = nd.argmax(assign, axis=1).astype("int32")   # (B, N)
+    sel = nd.one_hot(first_gt, labels.shape[1])            # (B, N, M)
+
+    def to_slots(v):
+        return nd.sum(sel * v.expand_dims(1), axis=-1)
+
+    sx, sy = to_slots(gx), to_slots(gy)
+    sw, sh = to_slots(gw), to_slots(gh)
+    scls = to_slots(gt_cls)
+    cxs = cells[:, 0].reshape((1, n))
+    cys = cells[:, 1].reshape((1, n))
+    sts = strides[:, 0].reshape((1, n))
+    t_x = nd.clip((sx - cxs) / sts, 1e-4, 1.0 - 1e-4)
+    t_y = nd.clip((sy - cys) / sts, 1e-4, 1.0 - 1e-4)
+    t_w = nd.log(nd.maximum(sw, nd.ones_like(sw))
+                 / awh[:, 0].reshape((1, n)))
+    t_h = nd.log(nd.maximum(sh, nd.ones_like(sh))
+                 / awh[:, 1].reshape((1, n)))
+    return (obj_target, t_x, t_y, t_w, t_h, scls, x1, y1, x2, y2,
+            valid)
+
+
+class YOLOv3Loss:
+    """GluonCV YOLOV3Loss pairing: sigmoid-BCE for center offsets and
+    objectness and classes, L1 for the log-scale wh; unmatched slots
+    overlapping a GT above ``ignore_iou`` are excluded from the
+    objectness loss.  ``labels`` are SSD-style (B, M, 5)
+    [cls, x1, y1, x2, y2] in [0,1], padded rows cls = -1."""
+
+    def __init__(self, net: YOLOv3, ignore_iou=0.7):
+        self.net = net
+        self.ignore_iou = float(ignore_iou)
+
+    def __call__(self, preds, labels):
+        from .. import ndarray as nd
+        net = self.net
+        cells, awh, strides = net._layout_nd(preds.context)
+        b = labels.shape[0]
+        (obj_target, t_x, t_y, t_w, t_h, scls, x1, y1, x2, y2,
+         valid) = build_targets(net, labels, preds.context)
+
+        # ---- ignore mask: decoded boxes vs GT IoU -------------------
+        xy = (nd.sigmoid(preds[:, :, 0:2]) * strides + cells)
+        wh = nd.exp(nd.clip(preds[:, :, 2:4], -8.0, 8.0)) * awh
+        dec = nd.concat(xy - wh / 2, xy + wh / 2, dim=-1)  # px corner
+        gtb = nd.concat(x1.expand_dims(-1), y1.expand_dims(-1),
+                        x2.expand_dims(-1), y2.expand_dims(-1),
+                        dim=-1)                            # (B, M, 4)
+        ious = nd.contrib.box_iou(dec, gtb) \
+            * valid.transpose((0, 2, 1))                   # (B, N, M)
+        best_over_gt = nd.max(ious, axis=-1)               # (B, N)
+        ignore = (best_over_gt > self.ignore_iou) * \
+            (1.0 - obj_target)
+
+        # ---- the loss pairing ---------------------------------------
+        def bce(logit, target):
+            return nd.relu(logit) - logit * target + \
+                nd.log(1.0 + nd.exp(-nd.abs(logit)))
+
+        obj_logit = preds[:, :, 4]
+        obj_loss = bce(obj_logit, obj_target) * (1.0 - ignore)
+        pos = obj_target
+        npos = nd.maximum(nd.sum(pos), nd.ones((1,),
+                                                ctx=preds.context))
+        xy_loss = (bce(preds[:, :, 0], t_x)
+                   + bce(preds[:, :, 1], t_y)) * pos
+        wh_loss = (nd.abs(preds[:, :, 2] - t_w)
+                   + nd.abs(preds[:, :, 3] - t_h)) * pos
+        cls_onehot = nd.one_hot(scls.astype("int32"),
+                                net.num_classes)
+        cls_loss = nd.sum(bce(preds[:, :, 5:], cls_onehot),
+                          axis=-1) * pos
+        return (nd.sum(obj_loss) / (b * 1.0)
+                + nd.sum(xy_loss + wh_loss + cls_loss) / npos)
+
+
+def yolo3_tiny(num_classes=2, image_size=32, **kwargs):
+    """Test-size YOLOv3 (32px input -> 4+2+1 cells x 3 anchors)."""
+    return YOLOv3(num_classes, image_size=image_size,
+                  base_channels=8, **kwargs)

@@ -1,0 +1,172 @@
+"""YOLOv3 tests (GluonCV YOLOV3 capability — SURVEY.md §2.6): slot
+geometry, target assignment against hand-derived slot indices, decode
+math against hand computation, and bright-square convergence measured
+by top-detection IoU (the ssd_train example's metric)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.yolo import (YOLOv3, YOLOv3Loss, build_targets,
+                                   yolo3_tiny)
+
+
+def _make_batch(rng, n, size=32):
+    imgs = np.zeros((n, 3, size, size), "f4")
+    labels = np.zeros((n, 1, 5), "f4")
+    for i in range(n):
+        x1, y1 = rng.randint(0, size // 2, 2)
+        w = rng.randint(size // 4, size // 2)
+        imgs[i, :, y1:y1 + w, x1:x1 + w] = 1.0
+        labels[i, 0] = [0.0, x1 / size, y1 / size,
+                        (x1 + w) / size, (y1 + w) / size]
+    return nd.array(imgs), nd.array(labels)
+
+
+class TestGeometry:
+    def test_slot_count_and_forward_shape(self):
+        net = yolo3_tiny(num_classes=2)
+        # 32px: grids 4/2/1 -> (16+4+1)*3 = 63 slots
+        assert net.num_slots == 63
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.rand(2, 3, 32, 32).astype("f4"))
+        preds = net(x)
+        assert preds.shape == (2, 63, 7)
+        det = net.decode(preds)
+        assert det.shape == (2, 63, 6)
+
+    def test_image_size_must_be_multiple_of_32(self):
+        with pytest.raises(mx.MXNetError):
+            YOLOv3(2, image_size=40)
+
+
+class TestTargets:
+    def test_single_gt_assignment(self):
+        """A centered 16px box must match exactly one slot: the cell
+        containing its center at the best anchor's scale."""
+        net = yolo3_tiny(num_classes=2)
+        # GT: center (16, 16), 16x16 px -> best wh-IoU anchor is
+        # (8,8) scale-2 anchor (8,8)? compute from layout instead:
+        labels = nd.array(np.array(
+            [[[1, 0.25, 0.25, 0.75, 0.75]]], "f4"))
+        obj, t_x, t_y, t_w, t_h, cls, *_ = build_targets(
+            net, labels, labels.context)
+        obj_np = obj.asnumpy()[0]
+        assert obj_np.sum() == 1.0, obj_np.nonzero()
+        slot = int(obj_np.argmax())
+        cells, awh, strides = net._layout
+        # the matched cell contains the center (16,16)
+        assert cells[slot][0] <= 16 < cells[slot][0] + strides[slot][0]
+        assert cells[slot][1] <= 16 < cells[slot][1] + strides[slot][0]
+        # the matched anchor is the best wh-IoU anchor for 16x16
+        def wh_iou(a):
+            iw, ih = min(16, a[0]), min(16, a[1])
+            inter = iw * ih
+            return inter / (256 + a[0] * a[1] - inter)
+        best = max(wh_iou(a) for a in awh)
+        assert wh_iou(awh[slot]) == pytest.approx(best)
+        # regression targets: center offset in (0,1), log-scale wh
+        tx = t_x.asnumpy()[0, slot]
+        tw = t_w.asnumpy()[0, slot]
+        st = strides[slot][0]
+        assert tx == pytest.approx((16 - cells[slot][0]) / st,
+                                   abs=1e-3)
+        assert tw == pytest.approx(np.log(16 / awh[slot][0]), abs=1e-5)
+        assert cls.asnumpy()[0, slot] == pytest.approx(1.0)
+
+    def test_padded_rows_assign_nothing(self):
+        net = yolo3_tiny(num_classes=2)
+        labels = nd.array(np.array(
+            [[[-1, 0.2, 0.2, 0.6, 0.6]]], "f4"))
+        obj, *_ = build_targets(net, labels, labels.context)
+        assert obj.asnumpy().sum() == 0.0
+
+    def test_colliding_gts_keep_first_class(self):
+        """Two identical boxes with different classes land on one
+        slot; the lowest-index GT's class must win — never an average
+        of categorical ids."""
+        net = yolo3_tiny(num_classes=3)
+        labels = nd.array(np.array(
+            [[[2, 0.25, 0.25, 0.75, 0.75],
+              [0, 0.25, 0.25, 0.75, 0.75]]], "f4"))
+        obj, _, _, _, _, cls, *_ = build_targets(
+            net, labels, labels.context)
+        slot = int(obj.asnumpy()[0].argmax())
+        assert obj.asnumpy().sum() == 1.0
+        assert cls.asnumpy()[0, slot] == pytest.approx(2.0)
+
+    def test_two_gts_two_slots(self):
+        net = yolo3_tiny(num_classes=2)
+        labels = nd.array(np.array(
+            [[[0, 0.05, 0.05, 0.30, 0.30],
+              [1, 0.55, 0.55, 0.95, 0.95]]], "f4"))
+        obj, *_ = build_targets(net, labels, labels.context)
+        assert obj.asnumpy().sum() == 2.0
+
+
+class TestDecode:
+    def test_hand_computed_box(self):
+        """Zero logits at a known slot decode to the cell-centered
+        anchor box: sigmoid(0)=0.5 -> center at cell + stride/2,
+        exp(0)=1 -> w/h = anchor."""
+        net = yolo3_tiny(num_classes=2)
+        n = net.num_slots
+        preds = np.full((1, n, 7), -20.0, "f4")   # everything off
+        slot = 5
+        preds[0, slot, :4] = 0.0                  # neutral box
+        preds[0, slot, 4] = 20.0                  # objectness on
+        preds[0, slot, 5] = 20.0                  # class 0 on
+        det = net.decode(nd.array(preds), conf_thresh=0.5).asnumpy()[0]
+        rows = det[det[:, 0] >= 0]
+        assert len(rows) == 1
+        cells, awh, strides = net._layout
+        cx = (cells[slot][0] + 0.5 * strides[slot][0]) / 32.0
+        cy = (cells[slot][1] + 0.5 * strides[slot][0]) / 32.0
+        w, h = awh[slot][0] / 32.0, awh[slot][1] / 32.0
+        np.testing.assert_allclose(
+            rows[0, 2:], [cx - w / 2, cy - h / 2, cx + w / 2,
+                          cy + h / 2], atol=1e-5)
+        assert rows[0, 0] == 0 and rows[0, 1] > 0.99
+
+
+class TestConvergence:
+    def test_learns_bright_square(self):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = yolo3_tiny(num_classes=2)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        loss_fn = YOLOv3Loss(net)
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 2e-3})
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(200):
+            x, y = _make_batch(rng, 16)
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(16)
+            losses.append(float(loss.asnumpy().ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] / 4, (losses[0], losses[-1])
+
+        x, y = _make_batch(rng, 16)
+        det = net.decode(net(x)).asnumpy()
+        lab = y.asnumpy()
+        ious = []
+        for i in range(16):
+            rows = det[i]
+            rows = rows[rows[:, 0] >= 0]
+            if not rows.size:
+                ious.append(0.0)
+                continue
+            b = rows[rows[:, 1].argmax()][2:]
+            g = lab[i, 0, 1:]
+            ix1, iy1 = max(b[0], g[0]), max(b[1], g[1])
+            ix2, iy2 = min(b[2], g[2]), min(b[3], g[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            union = ((b[2] - b[0]) * (b[3] - b[1])
+                     + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+            ious.append(inter / max(union, 1e-9))
+        assert np.mean(ious) > 0.45, np.mean(ious)
