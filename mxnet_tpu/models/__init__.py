@@ -19,6 +19,9 @@ from .segmentation import (FCN, DeepLabV3, SegmentationMetric,
                            SoftmaxSegLoss, fcn_tiny, deeplab_tiny)
 from . import yolo
 from .yolo import YOLOv3, YOLOv3Loss, yolo3_tiny
+from . import pose
+from .pose import (SimplePose, PoseHeatmapLoss, PCKMetric,
+                   simple_pose_tiny)
 
 __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
@@ -30,4 +33,5 @@ __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "transformer_en_de_512", "segmentation", "FCN", "DeepLabV3",
            "SegmentationMetric", "SoftmaxSegLoss", "fcn_tiny",
            "deeplab_tiny", "yolo", "YOLOv3", "YOLOv3Loss",
-           "yolo3_tiny"]
+           "yolo3_tiny", "pose", "SimplePose", "PoseHeatmapLoss",
+           "PCKMetric", "simple_pose_tiny"]

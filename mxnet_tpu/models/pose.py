@@ -1,0 +1,132 @@
+"""Pose estimation: SimplePose heatmap regression (capability target:
+GluonCV ``simple_pose_resnet*`` — SURVEY.md §2.6 external zoos).
+
+SimplePose (Xiao et al.) = classification backbone truncated at the
+stride-32 features + three stride-2 deconvolution stages + a 1x1 head
+producing one heatmap per keypoint; training regresses Gaussian target
+heatmaps with an L2 loss masked by keypoint visibility; decoding takes
+the per-heatmap argmax (with the classic quarter-pixel offset toward
+the second-highest neighbor omitted — argmax is exact on the synthetic
+tasks and keeps decode a single compiled program).
+
+TPU notes: deconvs are MXU-shaped convs; the whole train step fuses
+under hybridize(); decode is argmax + unravel, no host loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+from ..metric import EvalMetric
+from .feature import truncate_features
+
+__all__ = ["SimplePose", "PoseHeatmapLoss", "gaussian_heatmaps",
+           "PCKMetric", "simple_pose_tiny"]
+
+
+class SimplePose(HybridBlock):
+    """Backbone stages + deconv head + per-keypoint heatmap layer.
+
+    ``backbone`` is a fully-convolutional zoo net (classifier head
+    ignored); the heatmap resolution is input/4 with the standard
+    three stride-2 deconvs over stride-32 features."""
+
+    def __init__(self, num_keypoints, backbone, deconv_channels=64,
+                 num_deconv=3, **kwargs):
+        super().__init__(**kwargs)
+        self.num_keypoints = num_keypoints
+        with self.name_scope():
+            self._backbone = truncate_features(backbone,
+                                               reject_dense=False)
+            for i, b in enumerate(self._backbone):
+                self.register_child(b, f"bb{i}")
+            self.deconv = nn.HybridSequential(prefix="deconv_")
+            with self.deconv.name_scope():
+                for _ in range(num_deconv):
+                    self.deconv.add(
+                        nn.Conv2DTranspose(deconv_channels, 4,
+                                           strides=2, padding=1,
+                                           use_bias=False),
+                        nn.BatchNorm(),
+                        nn.Activation("relu"))
+            self.head = nn.Conv2D(num_keypoints, 1, prefix="head_")
+
+    def hybrid_forward(self, F, x):
+        for b in self._backbone:
+            x = b(x)
+        return self.head(self.deconv(x))        # (B, K, H', W')
+
+    def predict(self, x):
+        """Keypoint coords in [0, 1]: (B, K, 2) as (x, y)."""
+        from .. import ndarray as nd
+        hm = self(x)
+        b, k, h, w = hm.shape
+        flat = hm.reshape((b, k, h * w))
+        idx = nd.argmax(flat, axis=-1)           # (B, K)
+        ys = nd.floor(idx / w)
+        xs = idx - ys * w
+        # heatmap-cell centers, normalized by the heatmap size
+        return nd.stack((xs + 0.5) / w, (ys + 0.5) / h, axis=-1)
+
+
+def gaussian_heatmaps(keypoints, heatmap_size, sigma=1.5):
+    """(B, K, 3) [x, y, visible] in [0,1] → (B, K, H, W) float32
+    Gaussian targets (numpy; targets are data, not model)."""
+    kp = np.asarray(keypoints, "f4")
+    b, k, _ = kp.shape
+    h = w = int(heatmap_size)
+    ys, xs = np.mgrid[0:h, 0:w].astype("f4") + 0.5
+    out = np.zeros((b, k, h, w), "f4")
+    for i in range(b):
+        for j in range(k):
+            x, y, v = kp[i, j]
+            if v <= 0:
+                continue
+            d2 = (xs - x * w) ** 2 + (ys - y * h) ** 2
+            out[i, j] = np.exp(-d2 / (2.0 * sigma ** 2))
+    return out
+
+
+class PoseHeatmapLoss:
+    """Visibility-masked L2 between predicted and target heatmaps."""
+
+    def __call__(self, pred, target, visible):
+        from .. import ndarray as nd
+        diff = (pred - target) ** 2              # (B, K, H, W)
+        per_kp = nd.mean(diff, axis=(2, 3))      # (B, K)
+        vis = visible.astype("float32")
+        n = nd.maximum(nd.sum(vis),
+                       nd.ones((1,), ctx=pred.context))
+        return nd.sum(per_kp * vis) / n
+
+
+class PCKMetric(EvalMetric):
+    """Percentage of Correct Keypoints at a distance threshold (the
+    standard pose metric; GluonCV evaluates PCK/OKS families)."""
+
+    def __init__(self, threshold=0.1):
+        self.threshold = float(threshold)
+        super().__init__(name=f"PCK@{threshold}")
+
+    def update(self, labels, preds):
+        if not isinstance(labels, (list, tuple)):
+            labels, preds = [labels], [preds]
+        for kp, pred in zip(labels, preds):
+            kp = np.asarray(kp.asnumpy()
+                            if hasattr(kp, "asnumpy") else kp, "f4")
+            pred = np.asarray(pred.asnumpy()
+                              if hasattr(pred, "asnumpy") else pred,
+                              "f4")
+            vis = kp[:, :, 2] > 0
+            dist = np.sqrt(((pred - kp[:, :, :2]) ** 2).sum(-1))
+            self._inc(float((dist[vis] < self.threshold).sum()),
+                      int(vis.sum()))
+
+
+def simple_pose_tiny(num_keypoints=4):
+    """Test-size SimplePose over thumbnail resnet18."""
+    from ..gluon.model_zoo import vision
+    return SimplePose(num_keypoints,
+                      vision.resnet18_v1(classes=10, thumbnail=True),
+                      deconv_channels=32, num_deconv=2)

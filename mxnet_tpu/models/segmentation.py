@@ -13,10 +13,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 from ..metric import EvalMetric
+from .feature import truncate_features
 
 __all__ = ["FCN", "DeepLabV3", "SegmentationMetric", "fcn_tiny",
            "deeplab_tiny", "SoftmaxSegLoss"]
@@ -28,23 +28,11 @@ class _Backbone(HybridBlock):
 
     def __init__(self, zoo_net, **kwargs):
         super().__init__(**kwargs)
-        blocks = list(zoo_net.features._children.values())
-        # drop the trailing global pool; the last two remaining blocks
-        # are stage N-1 (stride/16) and stage N (stride/32)
-        while blocks and blocks[-1].__class__.__name__ in (
-                "GlobalAvgPool2D", "Flatten", "Dropout"):
-            blocks = blocks[:-1]
-        if len(blocks) < 3:
-            raise MXNetError("backbone too shallow for segmentation")
-        if any(b.__class__.__name__ == "Dense" for b in blocks):
-            raise MXNetError(
-                "backbone features contain Dense layers (vgg/alexnet "
-                "style); segmentation taps need a fully-convolutional "
-                "backbone such as the resnet/mobilenet/densenet zoos")
-        # plain-list storage + one register_child each: attribute
-        # assignment would auto-register the taps a second time
-        self._blocks = blocks
-        for i, b in enumerate(blocks):
+        # the last two remaining blocks are stage N-1 (stride/16) and
+        # stage N (stride/32); plain-list storage + one register_child
+        # each (attribute assignment would auto-register a 2nd time)
+        self._blocks = truncate_features(zoo_net)
+        for i, b in enumerate(self._blocks):
             self.register_child(b, f"bb{i}")
 
     def hybrid_forward(self, F, x):

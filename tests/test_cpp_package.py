@@ -6,7 +6,6 @@ forward/backward, fluent Operator SGD updates, KVStore — all from C++.
 """
 import os
 import shutil
-from test_pjrt_native import mock_plugin  # noqa: F401 (fixture)
 
 import numpy as np
 import pytest

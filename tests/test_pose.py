@@ -1,0 +1,111 @@
+"""SimplePose tests (GluonCV simple_pose capability — SURVEY.md §2.6):
+heatmap shapes, Gaussian target placement, visibility masking, PCK
+metric math, and convergence on a synthetic bright-corner keypoint
+task."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.pose import (SimplePose, PoseHeatmapLoss,
+                                   gaussian_heatmaps, PCKMetric,
+                                   simple_pose_tiny)
+
+K = 2   # two keypoints: the square's top-left and bottom-right
+
+
+def _scene_batch(n, size=32, seed=0):
+    """Images with one bright square; keypoints = its TL/BR corners."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, size, size).astype("f4") * 0.1
+    kp = np.zeros((n, K, 3), "f4")
+    for i in range(n):
+        x1, y1 = rng.randint(2, size // 2, 2)
+        w = rng.randint(size // 4, size // 2 - 2)
+        x[i, :, y1:y1 + w, x1:x1 + w] += 0.9
+        kp[i, 0] = [x1 / size, y1 / size, 1]
+        kp[i, 1] = [(x1 + w) / size, (y1 + w) / size, 1]
+    return nd.array(x), kp
+
+
+class TestForward:
+    def test_shapes(self):
+        net = simple_pose_tiny(num_keypoints=K)
+        net.initialize(mx.init.Xavier())
+        x, _ = _scene_batch(2)
+        hm = net(x)
+        assert hm.shape == (2, K, 16, 16)
+        assert net.predict(x).shape == (2, K, 2)
+
+    def test_gaussian_target_peaks_at_keypoint(self):
+        kp = np.zeros((1, 1, 3), "f4")
+        kp[0, 0] = [0.25, 0.75, 1]
+        hm = gaussian_heatmaps(kp, 16, sigma=1.0)
+        assert hm.shape == (1, 1, 16, 16)
+        py, px = np.unravel_index(hm[0, 0].argmax(), (16, 16))
+        # cell centers: x=0.25*16=4 -> cell 3 or 4 (center 3.5/4.5)
+        assert px in (3, 4) and py in (11, 12)
+        assert hm[0, 0].max() <= 1.0
+
+    def test_invisible_keypoints_empty_target_and_masked_loss(self):
+        kp = np.zeros((1, 2, 3), "f4")
+        kp[0, 0] = [0.5, 0.5, 1]
+        kp[0, 1] = [0.5, 0.5, 0]      # invisible
+        hm = gaussian_heatmaps(kp, 8)
+        assert hm[0, 1].sum() == 0.0
+        # masked loss: error on the invisible channel contributes 0
+        pred = nd.array(np.ones((1, 2, 8, 8), "f4"))
+        tgt = nd.array(hm)
+        vis = nd.array(kp[:, :, 2])
+        base = float(PoseHeatmapLoss()(pred, tgt, vis)
+                     .asnumpy().ravel()[0])
+        pred2 = pred.asnumpy().copy()
+        pred2[0, 1] = 99.0            # only the invisible channel
+        got = float(PoseHeatmapLoss()(nd.array(pred2), tgt, vis)
+                    .asnumpy().ravel()[0])
+        assert got == pytest.approx(base)
+
+
+class TestPCK:
+    def test_hand_math(self):
+        m = PCKMetric(threshold=0.1)
+        kp = np.array([[[0.5, 0.5, 1], [0.2, 0.2, 1],
+                        [0.9, 0.9, 0]]], "f4")
+        pred = np.array([[[0.55, 0.5], [0.5, 0.5],
+                          [0.0, 0.0]]], "f4")
+        m.update(kp, pred)
+        name, val = m.get()
+        # kp0 dist 0.05 < 0.1 correct; kp1 dist ~0.42 wrong; kp2
+        # invisible (excluded despite the huge error)
+        assert val == pytest.approx(0.5)
+        assert name.startswith("PCK")
+
+
+class TestConvergence:
+    def test_learns_square_corners(self):
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = simple_pose_tiny(num_keypoints=K)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        loss_fn = PoseHeatmapLoss()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 2e-3})
+        losses = []
+        for step in range(60):
+            x, kp = _scene_batch(8, seed=step)
+            tgt = nd.array(gaussian_heatmaps(kp, 16))
+            vis = nd.array(kp[:, :, 2])
+            with autograd.record():
+                loss = loss_fn(net(x), tgt, vis)
+            loss.backward()
+            trainer.step(8)
+            losses.append(float(loss.asnumpy().ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        m = PCKMetric(threshold=0.15)
+        x, kp = _scene_batch(16, seed=777)
+        m.update(kp, net.predict(x))
+        _, pck = m.get()
+        assert pck > 0.6, pck
