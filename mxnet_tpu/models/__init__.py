@@ -22,6 +22,8 @@ from .yolo import YOLOv3, YOLOv3Loss, yolo3_tiny
 from . import pose
 from .pose import (SimplePose, PoseHeatmapLoss, PCKMetric,
                    simple_pose_tiny)
+from . import rcnn
+from .rcnn import FasterRCNN, FasterRCNNLoss, faster_rcnn_tiny
 
 __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
@@ -34,4 +36,5 @@ __all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "SegmentationMetric", "SoftmaxSegLoss", "fcn_tiny",
            "deeplab_tiny", "yolo", "YOLOv3", "YOLOv3Loss",
            "yolo3_tiny", "pose", "SimplePose", "PoseHeatmapLoss",
-           "PCKMetric", "simple_pose_tiny"]
+           "PCKMetric", "simple_pose_tiny", "rcnn", "FasterRCNN",
+           "FasterRCNNLoss", "faster_rcnn_tiny"]

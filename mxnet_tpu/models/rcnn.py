@@ -1,0 +1,259 @@
+"""Faster R-CNN two-stage detector (capability target: reference
+``example/rcnn`` + GluonCV ``faster_rcnn`` family — SURVEY.md §2.6).
+
+TPU-first design: both stages are STATIC-shape so the whole train step
+compiles to one XLA program —
+- the RPN proposes a FIXED number of regions per image (top-K by
+  objectness over the dense anchor grid; the classic dynamic
+  NMS-then-threshold pipeline survives only in ``decode``, where the
+  framework NMS marks suppressed rows instead of dropping them);
+- RoI features come from the framework ``ROIAlign`` (batched, static
+  K rois per image);
+- target assignment for both stages is dense IoU matrices + argmax
+  selection (no scatter, no dynamic box lists), the same recipe as
+  models/yolo.py;
+- proposals are gradient-blocked before RoIAlign (standard two-stage
+  training: the head does not backprop through box coordinates).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["FasterRCNN", "FasterRCNNLoss", "faster_rcnn_tiny"]
+
+
+def _conv_bn_relu(channels, stride=1, prefix=""):
+    out = nn.HybridSequential(prefix=prefix)
+    with out.name_scope():
+        out.add(nn.Conv2D(channels, 3, strides=stride, padding=1,
+                          use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"))
+    return out
+
+
+def _encode_deltas(nd, src, dst):
+    """Box regression targets src→dst, both (..., 4) corner px."""
+    sw = nd.maximum(src[..., 2] - src[..., 0], nd.ones_like(src[..., 0]))
+    sh = nd.maximum(src[..., 3] - src[..., 1], nd.ones_like(src[..., 0]))
+    sx = (src[..., 0] + src[..., 2]) / 2.0
+    sy = (src[..., 1] + src[..., 3]) / 2.0
+    dw = nd.maximum(dst[..., 2] - dst[..., 0], nd.ones_like(src[..., 0]))
+    dh = nd.maximum(dst[..., 3] - dst[..., 1], nd.ones_like(src[..., 0]))
+    dx = (dst[..., 0] + dst[..., 2]) / 2.0
+    dy = (dst[..., 1] + dst[..., 3]) / 2.0
+    return nd.stack((dx - sx) / sw, (dy - sy) / sh,
+                    nd.log(dw / sw), nd.log(dh / sh), axis=-1)
+
+
+def _apply_deltas(nd, boxes, deltas, size):
+    """Inverse of _encode_deltas, clipped to the image."""
+    bw = nd.maximum(boxes[..., 2] - boxes[..., 0],
+                    nd.ones_like(boxes[..., 0]))
+    bh = nd.maximum(boxes[..., 3] - boxes[..., 1],
+                    nd.ones_like(boxes[..., 0]))
+    bx = (boxes[..., 0] + boxes[..., 2]) / 2.0
+    by = (boxes[..., 1] + boxes[..., 3]) / 2.0
+    cx = bx + deltas[..., 0] * bw
+    cy = by + deltas[..., 1] * bh
+    w = bw * nd.exp(nd.clip(deltas[..., 2], -4.0, 4.0))
+    h = bh * nd.exp(nd.clip(deltas[..., 3], -4.0, 4.0))
+    out = nd.stack(cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2,
+                   axis=-1)
+    return nd.clip(out, 0.0, float(size))
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector with a fixed proposal budget.
+
+    ``forward(x)`` returns (rpn_obj (B, Na), rpn_deltas (B, Na, 4),
+    proposals (B, K, 4) px corner, cls_logits (B, K, C+1),
+    head_deltas (B, K, 4)); class 0 is background.
+    """
+
+    def __init__(self, num_classes, image_size=64, base_channels=16,
+                 anchor_sizes=(12, 24, 40), num_proposals=16,
+                 roi_size=4, **kwargs):
+        super().__init__(**kwargs)
+        if image_size % 8:
+            raise MXNetError("image_size must be a multiple of 8")
+        self.num_classes = num_classes
+        self._size = image_size
+        self._stride = 8
+        self._k = int(num_proposals)
+        self._roi = int(roi_size)
+        g = image_size // self._stride
+        # dense centered anchors: one square per size per cell
+        ys, xs = np.mgrid[0:g, 0:g].astype("f4")
+        cxy = np.stack([xs, ys], -1).reshape(-1, 2) * self._stride \
+            + self._stride / 2.0
+        anchors = []
+        for s in anchor_sizes:
+            anchors.append(np.concatenate(
+                [cxy - s / 2.0, cxy + s / 2.0], axis=1))
+        # slot order: (anchor size, cell) — matches the head reshape
+        self._anchors_np = np.concatenate(anchors, 0).astype("f4")
+        self._num_anchor_shapes = len(anchor_sizes)
+        with self.name_scope():
+            # constant param: under hybridize the anchors ride the
+            # params mechanism instead of closing over a live NDArray
+            self.anchors_c = self.params.get_constant(
+                "anchors", self._anchors_np)
+            self.backbone = nn.HybridSequential(prefix="backbone_")
+            with self.backbone.name_scope():
+                self.backbone.add(_conv_bn_relu(base_channels))
+                self.backbone.add(_conv_bn_relu(base_channels * 2, 2))
+                self.backbone.add(_conv_bn_relu(base_channels * 4, 2))
+                self.backbone.add(_conv_bn_relu(base_channels * 8, 2))
+            self.rpn_conv = _conv_bn_relu(base_channels * 8,
+                                          prefix="rpnc_")
+            a = self._num_anchor_shapes
+            self.rpn_obj = nn.Conv2D(a, 1, prefix="rpno_")
+            self.rpn_box = nn.Conv2D(a * 4, 1, prefix="rpnb_")
+            self.head_fc = nn.Dense(128, activation="relu",
+                                    flatten=False, prefix="fc_")
+            self.head_cls = nn.Dense(num_classes + 1, flatten=False,
+                                     prefix="cls_")
+            self.head_box = nn.Dense(4, flatten=False, prefix="box_")
+
+    @property
+    def num_anchors(self):
+        return self._anchors_np.shape[0]
+
+    def hybrid_forward(self, F, x, anchors_c=None):
+        b = x.shape[0]
+        feat = self.backbone(x)
+        r = self.rpn_conv(feat)
+        a = self._num_anchor_shapes
+        g2 = feat.shape[2] * feat.shape[3]
+        obj = self.rpn_obj(r).reshape((b, a * g2))         # (B, Na)
+        deltas = self.rpn_box(r).reshape((b, a, 4, g2))
+        deltas = deltas.transpose((0, 1, 3, 2)).reshape(
+            (b, a * g2, 4))                                # (B, Na, 4)
+
+        boxes = _apply_deltas(F, anchors_c.expand_dims(0), deltas,
+                              self._size)                  # (B, Na, 4)
+        # fixed proposal budget: top-K objectness, gradient-blocked
+        k = self._k
+        top_idx = F.topk(obj, k=k, axis=-1)                # (B, K)
+        props = F.stop_gradient(
+            _take_rows(F, boxes, top_idx))                 # (B, K, 4)
+
+        # RoIAlign over the batch: rois (B*K, 5) with batch index
+        bidx = F.repeat(F.arange(0, b, ctx=x.context)
+                        .reshape((b, 1)), repeats=k, axis=1)
+        rois = F.concat(bidx.reshape((b * k, 1)),
+                        props.reshape((b * k, 4)), dim=-1)
+        pooled = F.ROIAlign(
+            feat, rois, pooled_size=(self._roi, self._roi),
+            spatial_scale=1.0 / self._stride)              # (BK,C,r,r)
+        h = self.head_fc(pooled.reshape((b, k, -1)))
+        return (obj, deltas, props, self.head_cls(h),
+                self.head_box(h))
+
+    def decode(self, outs, conf_thresh=0.05, nms_thresh=0.5):
+        """(B, K, 6) [cls_id, score, x1, y1, x2, y2] in [0, 1] with
+        suppressed rows -1 (framework NMS); background excluded."""
+        from .. import ndarray as nd
+        _, _, props, cls_logits, head_deltas = outs
+        probs = nd.softmax(cls_logits, axis=-1)            # (B,K,C+1)
+        fg = probs[:, :, 1:]
+        cls_id = nd.argmax(fg, axis=-1, keepdims=True)
+        score = nd.max(fg, axis=-1, keepdims=True)
+        boxes = _apply_deltas(nd, props, head_deltas, self._size) \
+            / float(self._size)
+        rows = nd.concat(cls_id.astype("float32"), score, boxes,
+                         dim=-1)
+        return nd.contrib.box_nms(
+            rows, overlap_thresh=nms_thresh, valid_thresh=conf_thresh,
+            topk=self._k, id_index=0, score_index=1, coord_start=2)
+
+
+def _take_rows(nd, data, idx):
+    """data (B, N, D), idx (B, K) → (B, K, D) without scatter: one-hot
+    select (K x N matmul), static shapes."""
+    n = data.shape[1]
+    onehot = nd.one_hot(idx.astype("int32"), n)            # (B, K, N)
+    return nd.batch_dot(onehot, data)
+
+
+class FasterRCNNLoss:
+    """RPN BCE + smooth-L1 and head CE + smooth-L1, with dense-IoU
+    target assignment (pos ≥ ``rpn_pos_iou``/``head_pos_iou``, RPN
+    negatives < ``rpn_neg_iou``, in-between ignored).  ``labels`` are
+    SSD-style (B, M, 5) [cls, x1..y2] in [0, 1], pad cls = -1."""
+
+    def __init__(self, net: FasterRCNN, rpn_pos_iou=0.5,
+                 rpn_neg_iou=0.3, head_pos_iou=0.5):
+        self.net = net
+        self.rpn_pos = float(rpn_pos_iou)
+        self.rpn_neg = float(rpn_neg_iou)
+        self.head_pos = float(head_pos_iou)
+
+    def __call__(self, outs, labels):
+        from .. import ndarray as nd
+        net = self.net
+        size = float(net._size)
+        obj, deltas, props, cls_logits, head_deltas = outs
+        b, m = labels.shape[0], labels.shape[1]
+        valid = (labels[:, :, 0:1] >= 0)                   # (B, M, 1)
+        gt_boxes = labels[:, :, 1:] * size                 # (B, M, 4)
+        gt_cls = nd.maximum(labels[:, :, 0],
+                            nd.zeros_like(labels[:, :, 0]))
+
+        def match(boxes):
+            """(B, X, 4) → (iou_best (B, X), best_gt_idx (B, X))."""
+            iou = nd.contrib.box_iou(boxes, gt_boxes) \
+                * valid.transpose((0, 2, 1))               # (B, X, M)
+            return nd.max(iou, axis=-1), nd.argmax(iou, axis=-1)
+
+        def gather_gt(field, idx):
+            """field (B, M, D), idx (B, X) → (B, X, D)."""
+            return _take_rows(nd, field, idx)
+
+        def bce(logit, target):
+            return nd.relu(logit) - logit * target + \
+                nd.log(1.0 + nd.exp(-nd.abs(logit)))
+
+        def smooth_l1(x):
+            ax = nd.abs(x)
+            return nd.where(ax > 1.0, ax - 0.5, 0.5 * x * x)
+
+        # ---- RPN stage ----------------------------------------------
+        anchors = net.anchors_c.data(obj.context).expand_dims(0)
+        anc = nd.broadcast_to(anchors, (b,) + anchors.shape[1:])
+        a_iou, a_gt = match(anc)
+        pos = (a_iou >= self.rpn_pos)
+        neg = (a_iou < self.rpn_neg)
+        npos = nd.maximum(nd.sum(pos), nd.ones((1,), ctx=obj.context))
+        rpn_obj_loss = nd.sum(
+            bce(obj, pos) * (pos + neg)) / nd.maximum(
+                nd.sum(pos + neg), nd.ones((1,), ctx=obj.context))
+        t = _encode_deltas(nd, anc, gather_gt(gt_boxes, a_gt))
+        rpn_box_loss = nd.sum(
+            smooth_l1(deltas - t) * pos.expand_dims(-1)) / npos
+
+        # ---- head stage ---------------------------------------------
+        p_iou, p_gt = match(props)
+        fg = (p_iou >= self.head_pos)                      # (B, K)
+        cls_target = (gather_gt(gt_cls.expand_dims(-1),
+                                p_gt)[:, :, 0] + 1.0) * fg  # 0 = bg
+        logp = nd.log_softmax(cls_logits, axis=-1)
+        head_cls_loss = -nd.mean(
+            nd.pick(logp, cls_target.astype("int32"), axis=-1))
+        th = _encode_deltas(nd, props, gather_gt(gt_boxes, p_gt))
+        nfg = nd.maximum(nd.sum(fg), nd.ones((1,), ctx=obj.context))
+        head_box_loss = nd.sum(
+            smooth_l1(head_deltas - th) * fg.expand_dims(-1)) / nfg
+
+        return (rpn_obj_loss + rpn_box_loss + head_cls_loss
+                + head_box_loss)
+
+
+def faster_rcnn_tiny(num_classes=2, image_size=64, **kwargs):
+    """Test-size Faster R-CNN (64px, 8x8 grid, 16 proposals)."""
+    return FasterRCNN(num_classes, image_size=image_size,
+                      base_channels=8, **kwargs)

@@ -10,7 +10,6 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.gluon import nn
-from test_pjrt_native import mock_plugin  # noqa: F401 (shared fixture)
 
 
 def _net():
