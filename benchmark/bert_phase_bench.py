@@ -70,46 +70,11 @@ def _time(fn, *args, iters=10):
     return _slope(window, iters)
 
 
-def _slope(window, iters):
-    """Shared two-window slope with noise guards: grow windows while
-    the spread is below timer/transfer noise; if the slope still comes
-    out non-positive or implausibly small vs the naive rate (window
-    order flipped by contention), warn and fall back to naive."""
-    t1 = window(iters)
-    t3 = window(3 * iters)
-    while (t3 - t1) < 0.02 and iters < 2000:
-        iters *= 4
-        t1 = window(iters)
-        t3 = window(3 * iters)
-    slope = (t3 - t1) / (2 * iters)
-    naive = t3 / (3 * iters)
-    if slope <= 0 or slope < 0.2 * naive:
-        print(json.dumps({"warn": "slope unstable, reporting naive",
-                          "slope_ms": round(slope * 1e3, 4),
-                          "naive_ms": round(naive * 1e3, 4)}),
-              flush=True)
-        return naive
-    return slope
-
-
-def _time_nd(step_fn, iters=10):
-    """Slope timing for framework-path phases (nd arrays).  step_fn()
-    must return a scalar NDArray whose value depends on that call's
-    work (loss / output sum).  Each window chains every iteration's
-    output into an accumulator, so a deferred/early-acked execution
-    cannot escape the closing asnumpy."""
-    step_fn().asnumpy()
-
-    def window(n):
-        t0 = time.perf_counter()
-        acc = None
-        for _ in range(n):
-            out = step_fn()
-            acc = out if acc is None else acc + out * 1e-30
-        float(acc.asnumpy().ravel()[0])
-        return time.perf_counter() - t0
-
-    return _slope(window, iters)
+try:
+    from benchmark._timing import slope as _slope, \
+        time_nd_steps as _time_nd
+except ImportError:
+    from _timing import slope as _slope, time_nd_steps as _time_nd
 
 
 def main():

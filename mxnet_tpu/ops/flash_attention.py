@@ -67,8 +67,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     block_q, d = q.shape
     block_k = k.shape[0]
 
-    s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
-                preferred_element_type=jnp.float32) * scale
+    # operands stay in the input dtype (bf16 on the AMP path) so the
+    # MXU runs at native rate; preferred_element_type keeps the
+    # ACCUMULATOR f32 either way.  f32 inputs take the f32 pass —
+    # precision the interpret-mode oracle tests rely on.
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     if causal:
         # end-aligned like the XLA oracle's tril(k=s_k-s_q): query i may
         # attend keys up to i + (s_k - s_q), so cross-attention with
@@ -94,8 +97,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     m_scr[...] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
     l_new = alpha * l + p.sum(axis=1, keepdims=True)
     l_scr[...] = jnp.broadcast_to(l_new, (l_new.shape[0], lanes))
+    # P rides the MXU in the value dtype when v is low-precision (what
+    # the bf16 XLA oracle does too); f32 v keeps the f32 pass
+    p_op = p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p
     acc_scr[...] = alpha * acc + jnp.dot(
-        p, v.astype(jnp.float32), preferred_element_type=jnp.float32)
+        p_op, v, preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_k_blocks - 1)
     def _done():
@@ -226,14 +232,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
     def _init():
         dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    q = q_ref[...].astype(jnp.float32)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    g = g_ref[...].astype(jnp.float32)
+    # operands keep the input dtype (MXU-native on the bf16 path; f32
+    # precision when inputs are f32) — accumulators are always f32
+    q = q_ref[...]
+    k = k_ref[...]
+    v = v_ref[...]
+    g = g_ref[...]
     lse = lse_ref[...][:, :1]
     delta = delta_ref[...][:, :1]
     block_q, _ = q.shape
     block_k = k.shape[0]
+    lowp = q.dtype == jnp.bfloat16
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     if causal:
@@ -253,8 +262,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
     if with_kmask:
         p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
     dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dq_scr[...] += jnp.dot(ds, k,
+    ds = p * (dp - delta.astype(jnp.float32))
+    ds_op = ds.astype(jnp.bfloat16) if lowp else ds
+    dq_scr[...] += jnp.dot(ds_op, k,
                            preferred_element_type=jnp.float32) * scale
 
     @pl.when(kb == num_k_blocks - 1)
@@ -278,14 +288,15 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
         dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    q = q_ref[...].astype(jnp.float32)
-    g = g_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
+    q = q_ref[...]
+    g = g_ref[...]
     lse = lse_ref[...][:, :1]
     delta = delta_ref[...][:, :1]
     block_k = k.shape[0]
     block_q = q.shape[0]
+    lowp = q.dtype == jnp.bfloat16
 
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     if causal:
@@ -302,10 +313,13 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
         p = jnp.where(mask, p, 0.0)
     if with_kmask:
         p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
-    dv_scr[...] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+    p_op = p.astype(jnp.bfloat16) if lowp else p
+    dv_scr[...] += jnp.dot(p_op.T, g,
+                           preferred_element_type=jnp.float32)
     dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dk_scr[...] += jnp.dot(ds.T, q,
+    ds = p * (dp - delta.astype(jnp.float32))
+    ds_op = ds.astype(jnp.bfloat16) if lowp else ds
+    dk_scr[...] += jnp.dot(ds_op.T, q,
                            preferred_element_type=jnp.float32) * scale
 
     @pl.when(qb == num_q_blocks - 1)
