@@ -131,8 +131,10 @@ def _watchdog(budget):
 def probe_platform(timeout):
     """Ask a subprocess which backend is reachable, with a hard deadline.
 
-    Returns 'tpu' or 'cpu'. A hang/crash in the PJRT plugin kills only
-    the child.
+    Returns 'tpu', 'cpu' (the probe ran and honestly found no
+    accelerator), or 'unreachable' (timeout/crash — the chip may exist
+    but is not answering; callers may retry).  A hang/crash in the
+    PJRT plugin kills only the child.
     """
     if os.environ.get("MXTPU_BENCH_FORCE_CPU"):
         return "cpu"
@@ -468,12 +470,26 @@ def main():
             try:
                 _log(f"stage 3: bert_base pretrain bench "
                      f"(batch {bs}, seq {seq})")
-                sps, mfu, fl = bench_bert_pretrain(
-                    builder_name="bert_base", vocab=30522,
-                    batch_size=bs, seq_len=seq, num_masked=20,
-                    steps=20, warmup=3, hidden=768, layers=12,
-                    heads=12, remat=(seq >= 512),
-                    scan_layers=scan)
+                # no-remat first: at b16-32 s512 the activations
+                # (~1-2 GB with flash) fit v5e HBM, and remat's
+                # recompute tax is ~1/3 of the forward FLOPs.  OOM
+                # falls back to the remat program (large-batch s512).
+                try:
+                    sps, mfu, fl = bench_bert_pretrain(
+                        builder_name="bert_base", vocab=30522,
+                        batch_size=bs, seq_len=seq, num_masked=20,
+                        steps=20, warmup=3, hidden=768, layers=12,
+                        heads=12, remat=False, scan_layers=scan)
+                except Exception as e:
+                    if seq < 512 or "RESOURCE_EXHAUSTED" not in repr(e):
+                        raise
+                    _log(f"stage 3 batch {bs} seq {seq}: OOM without "
+                         "remat; retrying with remat")
+                    sps, mfu, fl = bench_bert_pretrain(
+                        builder_name="bert_base", vocab=30522,
+                        batch_size=bs, seq_len=seq, num_masked=20,
+                        steps=20, warmup=3, hidden=768, layers=12,
+                        heads=12, remat=True, scan_layers=scan)
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
                      f"samples/sec, mfu={mfu:.3f}, flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):

@@ -39,6 +39,12 @@ _reg("MXTPU_TEST_ON_TPU", bool, False,
 _reg("MXTPU_DISABLE_FLASH", bool, False,
      "Disable the Pallas flash-attention kernel (use the XLA SDPA "
      "path everywhere).")
+_reg("MXTPU_PRNG_IMPL", str, "auto",
+     "Key implementation for mx.random: auto (rbg on accelerator "
+     "backends — the hardware-friendly analog of the reference's "
+     "counter-based per-device PRNG; threefry on CPU so seeded test "
+     "values stay stable), or an explicit threefry2x32 / rbg / "
+     "unsafe_rbg. Latched at the first key creation.")
 _reg("MXTPU_PROFILE_SYNC", bool, False,
      "Profiler blocks on each op for accurate per-op device time "
      "(slower; like the reference's synchronous profiling mode).")

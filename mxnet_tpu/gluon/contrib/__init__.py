@@ -1,5 +1,5 @@
-"""``mx.gluon.contrib``: transformer blocks and other staging-ground
-layers (SURVEY.md §2.2 contrib)."""
-from . import nn
+"""``mx.gluon.contrib``: transformer blocks, the Estimator fit loop,
+and other staging-ground layers (SURVEY.md §2.2 contrib)."""
+from . import estimator, nn
 
-__all__ = ["nn"]
+__all__ = ["nn", "estimator"]

@@ -1,0 +1,16 @@
+"""``mx.gluon.contrib.estimator`` (reference:
+``python/mxnet/gluon/contrib/estimator/``): high-level fit loop +
+lifecycle event handlers."""
+from .estimator import Estimator
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler,
+                            StoppingHandler, TrainBegin, TrainEnd,
+                            ValidationHandler)
+
+__all__ = [
+    "Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+    "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+    "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+    "EarlyStoppingHandler",
+]

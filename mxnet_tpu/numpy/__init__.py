@@ -544,6 +544,150 @@ def _opdef_multinomial():
 
 random = _NpRandom()
 
+
+# -- array manipulation / statistics tail (reference mx.np parity) ----------
+
+
+def pad(a, pad_width, mode="constant", **kw):
+    a = _as_nd(a)
+    return invoke(_opdef("pad", 1), [a], pad_width=_tupled(pad_width),
+                  mode=mode, **kw)
+
+
+def _tupled(pw):
+    """jnp.pad wants hashable static pad_width for the jit cache."""
+    if isinstance(pw, int):
+        return pw
+    return tuple(tuple(p) if isinstance(p, (list, tuple)) else p
+                 for p in pw)
+
+
+def searchsorted(a, v, side="left"):
+    a, v = _as_nd(a), _as_nd(v)
+    return invoke(_opdef("searchsorted", 2), [a, v], side=side)
+
+
+def cov(m, rowvar=True, bias=False, ddof=None):
+    m = _as_nd(m)
+    if _onp.dtype(m.dtype).kind in "iub":
+        m = m.astype(_float_dtype())
+    return invoke(_opdef("cov", 1), [m], rowvar=rowvar, bias=bias,
+                  ddof=ddof)
+
+
+def corrcoef(x, rowvar=True):
+    x = _as_nd(x)
+    if _onp.dtype(x.dtype).kind in "iub":
+        x = x.astype(_float_dtype())
+    return invoke(_opdef("corrcoef", 1), [x], rowvar=rowvar)
+
+
+def interp(x, xp, fp, left=None, right=None):
+    x, xp, fp = _as_nd(x), _as_nd(xp), _as_nd(fp)
+    return invoke(_opdef("interp", 3), [x, xp, fp], left=left,
+                  right=right)
+
+
+def gradient(f, *varargs, axis=None):
+    f = _as_nd(f)
+    jnp = _jnp()
+    spacing = [_as_nd(v)._data if isinstance(v, NDArray) else v
+               for v in varargs]
+    out = jnp.gradient(f._data, *spacing, axis=axis)
+    if isinstance(out, (list, tuple)):
+        return [NDArray(o, ctx=f._ctx) for o in out]
+    return NDArray(out, ctx=f._ctx)
+
+
+def histogram(a, bins=10, range=None, weights=None):
+    """Static-shape when ``bins`` is an int (jit-friendly); returns
+    (hist, bin_edges) like numpy."""
+    a = _as_nd(a)
+    jnp = _jnp()
+    w = _as_nd(weights)._data if weights is not None else None
+    b = _as_nd(bins)._data if isinstance(bins, NDArray) else bins
+    hist, edges = jnp.histogram(a._data, bins=b, range=range,
+                                weights=w)
+    return NDArray(hist, ctx=a._ctx), NDArray(edges, ctx=a._ctx)
+
+
+def unique(a, return_index=False, return_inverse=False,
+           return_counts=False):
+    """Data-dependent output shape → computed on host (sync point),
+    like the reference's CPU fallback for dynamic-shape ops."""
+    a = _as_nd(a)
+    out = _onp.unique(a.asnumpy(), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts)
+    if isinstance(out, tuple):
+        return tuple(_from_np(o) for o in out)
+    return _from_np(out)
+
+
+class _Fft:
+    """``mx.np.fft`` — FFT family over XLA (complex64 under the
+    default x64-off config; parity: numpy.fft's interface)."""
+
+    @functools.lru_cache(maxsize=None)
+    def _op(self, name, n_in=1):
+        import jax.numpy as jnp
+        fn = getattr(jnp.fft, name)
+        return OpDef(f"_np_fft_{name}", fn, n_in, 1, (), False, None)
+
+    def _call(self, name, x, **kw):
+        x = _as_nd(x)
+        if _onp.dtype(x.dtype).kind in "iub":
+            x = x.astype(_float_dtype())
+        return invoke(self._op(name), [x], **kw)
+
+    def fft(self, a, n=None, axis=-1):
+        return self._call("fft", a, n=n, axis=axis)
+
+    def ifft(self, a, n=None, axis=-1):
+        return self._call("ifft", a, n=n, axis=axis)
+
+    def rfft(self, a, n=None, axis=-1):
+        return self._call("rfft", a, n=n, axis=axis)
+
+    def irfft(self, a, n=None, axis=-1):
+        return self._call("irfft", a, n=n, axis=axis)
+
+    def fft2(self, a, axes=(-2, -1)):
+        return self._call("fft2", a, axes=tuple(axes))
+
+    def ifft2(self, a, axes=(-2, -1)):
+        return self._call("ifft2", a, axes=tuple(axes))
+
+    def fftn(self, a, axes=None):
+        return self._call("fftn", a,
+                          axes=None if axes is None else tuple(axes))
+
+    def ifftn(self, a, axes=None):
+        return self._call("ifftn", a,
+                          axes=None if axes is None else tuple(axes))
+
+    def fftshift(self, a, axes=None):
+        return self._call("fftshift", a,
+                          axes=None if axes is None else tuple(axes))
+
+    def ifftshift(self, a, axes=None):
+        return self._call("ifftshift", a,
+                          axes=None if axes is None else tuple(axes))
+
+    def fftfreq(self, n, d=1.0):
+        import jax.numpy as jnp
+        return _from_np(_onp.asarray(jnp.fft.fftfreq(n, d=d)))
+
+    def rfftfreq(self, n, d=1.0):
+        import jax.numpy as jnp
+        return _from_np(_onp.asarray(jnp.fft.rfftfreq(n, d=d)))
+
+
+fft = _Fft()
+
+__all__ += ["pad", "searchsorted", "cov", "corrcoef", "interp",
+            "gradient", "histogram", "unique", "fft"]
+
 __all__ += ["sort", "argsort", "flip", "roll", "ravel", "diag", "tril",
             "triu", "trace", "cumprod", "round", "around", "trunc",
             "rint", "isnan", "isinf", "isfinite", "all", "any", "diff",

@@ -46,9 +46,45 @@ def _jax():
     return jax
 
 
+_prng_impl_set = False
+
+
+def _ensure_prng_impl():
+    """Pick the key implementation ONCE, before the first key exists.
+
+    Threefry (jax's default) burns real MXU/VPU time generating dropout
+    masks on TPU; the hardware-friendly ``rbg`` generator is the analog
+    of the reference's counter-based per-device PRNG
+    (``include/mxnet/random_generator.h``) and is what large TPU
+    trainers use.  ``MXTPU_PRNG_IMPL`` ∈ {auto, threefry2x32, rbg,
+    unsafe_rbg}; auto = rbg on an accelerator backend, threefry on CPU
+    (keeps the CPU test suite's sampled values stable).  Keys created
+    before and after a flag flip don't mix, hence the once-latch.
+    """
+    global _prng_impl_set
+    if _prng_impl_set:
+        return
+    import os
+    impl = os.environ.get("MXTPU_PRNG_IMPL", "auto")
+    jax = _jax()
+    if impl == "auto":
+        try:
+            impl = ("rbg" if jax.default_backend() != "cpu"
+                    else "threefry2x32")
+        except Exception:
+            return  # backend not up yet — retry at the next key
+    if impl not in ("rbg", "unsafe_rbg", "threefry2x32"):
+        raise ValueError(
+            f"MXTPU_PRNG_IMPL={impl!r}: expected auto, threefry2x32, "
+            "rbg, or unsafe_rbg")
+    jax.config.update("jax_default_prng_impl", impl)
+    _prng_impl_set = True
+
+
 def seed(seed_state: int, ctx: Optional[Context] = None):
     """Reset the RNG. ``ctx=None`` reseeds every context (parity: 'all')."""
     global _keys
+    _ensure_prng_impl()
     if ctx is None or ctx == "all":
         _keys = {"__seed__": int(seed_state)}
     else:
@@ -58,6 +94,7 @@ def seed(seed_state: int, ctx: Optional[Context] = None):
 
 def _next_key(ctx: Context):
     jax = _jax()
+    _ensure_prng_impl()
     base_seed = _keys.get("__seed__", _DEFAULT_SEED)
     k = _keys.get(ctx)
     if k is None:

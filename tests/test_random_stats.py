@@ -81,3 +81,44 @@ def test_shuffle_is_permutation():
     y = mx.random.shuffle(x).asnumpy()
     np.testing.assert_array_equal(np.sort(y), np.arange(1000))
     assert np.abs(y - np.arange(1000)).max() > 0  # actually permuted
+
+
+def test_prng_impl_knob_rbg(tmp_path):
+    """MXTPU_PRNG_IMPL=rbg switches the key implementation (the TPU
+    fast path — auto-selected on accelerator backends) and sampling
+    still behaves: reproducible under a seed, statistically sane."""
+    import subprocess
+    import sys
+    code = (
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "mx.random.seed(3)\n"
+        "a = nd.random.normal(shape=(4096,)).asnumpy()\n"
+        "mx.random.seed(3)\n"
+        "b = nd.random.normal(shape=(4096,)).asnumpy()\n"
+        "np.testing.assert_array_equal(a, b)\n"
+        "assert abs(float(a.mean())) < 0.1 and 0.9 < float(a.std()) < 1.1\n"
+        "import jax\n"
+        "assert jax.config.jax_default_prng_impl == 'rbg', \\\n"
+        "    jax.config.jax_default_prng_impl\n"
+        "print('RBG_OK')\n")
+    env = dict(__import__('os').environ,
+               MXTPU_PRNG_IMPL="rbg", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "RBG_OK" in out.stdout
+
+
+def test_prng_impl_default_threefry_on_cpu():
+    """The CPU harness keeps threefry (auto mode) so seeded sample
+    values stay stable across the suite.  On the real-chip harness
+    auto latches rbg instead, so the assertion only applies on CPU."""
+    import jax
+    if jax.default_backend() != "cpu":
+        import pytest
+        pytest.skip("auto mode selects rbg on accelerator backends")
+    mx.random.seed(1)
+    nd.random.normal(shape=(4,)).asnumpy()   # forces the impl latch
+    assert jax.config.jax_default_prng_impl == "threefry2x32"
