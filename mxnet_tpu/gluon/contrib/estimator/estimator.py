@@ -11,6 +11,7 @@ as whole-graph XLA.
 """
 from __future__ import annotations
 
+import copy
 import logging
 
 from .... import autograd
@@ -39,9 +40,14 @@ class Estimator:
             self.train_metrics = [Accuracy()]
         self.train_loss_metric = Loss(
             f"training {getattr(loss, 'name', 'loss')}")
-        self.val_metrics = [m.__class__(name=f"validation {m.name}")
-                            if _clonable(m) else m.__class__()
-                            for m in self.train_metrics]
+        # clone by deepcopy so constructor config (top_k, axis, ...)
+        # survives — reconstructing via __class__() dropped it
+        self.val_metrics = []
+        for m in self.train_metrics:
+            vm = copy.deepcopy(m)
+            vm.name = f"validation {m.name}"
+            vm.reset()
+            self.val_metrics.append(vm)
         self.val_loss_metric = Loss(
             f"validation {getattr(loss, 'name', 'loss')}")
         for m in self.train_metrics:
@@ -73,18 +79,19 @@ class Estimator:
 
     def _initialize(self, initializer):
         params = self.net.collect_params()
-        uninit = [p for p in params.values()
-                  if getattr(p, "_initialized", True) is False or
-                  p._data is None]
-        if initializer is not None or uninit:
+        uninit = [p for p in params.values() if p._data is None]
+        if uninit:
             from .... import init as _init
-            try:
-                self.net.initialize(
-                    initializer or _init.Xavier(),
-                    ctx=self.context[0])
-            except ValueError:
-                # already initialized without force_reinit — keep
-                pass
+            self.net.initialize(initializer or _init.Xavier(),
+                                ctx=self.context[0])
+        elif initializer is not None:
+            # reference contract: an explicit initializer on an
+            # already-initialized net is NOT applied — warn, don't
+            # silently drop the request
+            logging.getLogger("mxnet_tpu.estimator").warning(
+                "Estimator: network already initialized; the passed "
+                "initializer is ignored (call net.initialize("
+                "force_reinit=True) first to re-initialize)")
 
     # -- evaluation --------------------------------------------------
 
@@ -169,22 +176,20 @@ class Estimator:
                           event_handlers):
         handlers = list(event_handlers or [])
         has = lambda cls: any(isinstance(h, cls) for h in handlers)
+        if val_data is not None and not has(ValidationHandler):
+            # FIRST in the list: epoch_end hooks run in handler order,
+            # and checkpoint/early-stop handlers monitoring a
+            # validation metric must see THIS epoch's value, not the
+            # previous one (the reference gives validation top
+            # priority for the same reason)
+            handlers.insert(0, ValidationHandler(val_data,
+                                                 self.evaluate))
         if not has(StoppingHandler):
             handlers.append(StoppingHandler(max_epoch=epochs,
                                             max_batch=batches))
         if not has(MetricHandler):
             handlers.append(MetricHandler(self.train_metrics))
-        if val_data is not None and not has(ValidationHandler):
-            handlers.append(ValidationHandler(val_data, self.evaluate))
         if not has(LoggingHandler):
             handlers.append(LoggingHandler(
                 metrics=[*self.train_metrics, self.train_loss_metric]))
         return handlers
-
-
-def _clonable(m):
-    try:
-        m.__class__(name="probe")
-        return True
-    except Exception:
-        return False

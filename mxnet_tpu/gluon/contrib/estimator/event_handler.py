@@ -146,6 +146,9 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
 
     def train_begin(self, estimator, *args, **kwargs):
         self.train_start = time.time()
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
         self.logger.info("Training begin")
 
     def train_end(self, estimator, *args, **kwargs):

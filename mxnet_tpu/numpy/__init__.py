@@ -314,11 +314,21 @@ def tensordot(a, b, axes=2):
     return invoke(_opdef("tensordot", 2), [a, b], axes=axes)
 
 
-def einsum(subscripts, *operands):
-    ops = [_as_nd(o) for o in operands]
+@functools.lru_cache(maxsize=None)
+def _opdef_einsum():
     jnp = _jnp()
-    out = jnp.einsum(subscripts, *[o._data for o in ops])
-    return NDArray(out, ctx=ops[0]._ctx if ops else None)
+
+    def fc(*arrays, subscripts):
+        return jnp.einsum(subscripts, *arrays)
+
+    return OpDef("_np_einsum", fc, None, 1, (), False, None)
+
+
+def einsum(subscripts, *operands):
+    """Routed through the invoke seam so autograd records it (a direct
+    jnp call here once produced silent zero grads under record())."""
+    ops = [_as_nd(o) for o in operands]
+    return invoke(_opdef_einsum(), ops, subscripts=subscripts)
 
 
 # -- sorting / indexing -----------------------------------------------------
@@ -588,27 +598,59 @@ def interp(x, xp, fp, left=None, right=None):
                   right=right)
 
 
+@functools.lru_cache(maxsize=None)
+def _opdef_gradient(n_out):
+    jnp = _jnp()
+
+    def fc(f, *spacing, axis=None):
+        out = jnp.gradient(f, *spacing, axis=axis)
+        return tuple(out) if isinstance(out, (list, tuple)) else out
+
+    return OpDef("_np_gradient", fc, None, n_out, (), False, None)
+
+
 def gradient(f, *varargs, axis=None):
     f = _as_nd(f)
+    axes = (axis if axis is not None
+            else tuple(range(f.ndim)) if f.ndim > 1 else 0)
+    n_out = len(axes) if isinstance(axes, (tuple, list)) else 1
+    spacing = [_as_nd(v) for v in varargs]
+    out = invoke(_opdef_gradient(n_out), [f, *spacing], axis=axis)
+    return list(out) if isinstance(out, (list, tuple)) else out
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_histogram():
     jnp = _jnp()
-    spacing = [_as_nd(v)._data if isinstance(v, NDArray) else v
-               for v in varargs]
-    out = jnp.gradient(f._data, *spacing, axis=axis)
-    if isinstance(out, (list, tuple)):
-        return [NDArray(o, ctx=f._ctx) for o in out]
-    return NDArray(out, ctx=f._ctx)
+
+    def fc(*arrays, bins, range, has_bins_arr, has_w):
+        it = iter(arrays)
+        a = next(it)
+        b = next(it) if has_bins_arr else bins
+        w = next(it) if has_w else None
+        return jnp.histogram(a, bins=b, range=range, weights=w)
+
+    return OpDef("_np_histogram", fc, None, 2, (), False, None)
 
 
 def histogram(a, bins=10, range=None, weights=None):
     """Static-shape when ``bins`` is an int (jit-friendly); returns
-    (hist, bin_edges) like numpy."""
+    (hist, bin_edges) like numpy.  Routed through the invoke seam like
+    every other function here (engine sync, profiler, NaiveEngine)."""
     a = _as_nd(a)
-    jnp = _jnp()
-    w = _as_nd(weights)._data if weights is not None else None
-    b = _as_nd(bins)._data if isinstance(bins, NDArray) else bins
-    hist, edges = jnp.histogram(a._data, bins=b, range=range,
-                                weights=w)
-    return NDArray(hist, ctx=a._ctx), NDArray(edges, ctx=a._ctx)
+    inputs = [a]
+    if isinstance(bins, NDArray):
+        inputs.append(bins)
+        bins_attr = None
+    else:
+        bins_attr = bins
+    if weights is not None:
+        inputs.append(_as_nd(weights))
+    hist, edges = invoke(_opdef_histogram(), inputs, bins=bins_attr,
+                         range=range,
+                         has_bins_arr=isinstance(bins, NDArray),
+                         has_w=weights is not None)
+    return hist, edges
 
 
 def unique(a, return_index=False, return_inverse=False,

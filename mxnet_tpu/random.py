@@ -64,8 +64,8 @@ def _ensure_prng_impl():
     global _prng_impl_set
     if _prng_impl_set:
         return
-    import os
-    impl = os.environ.get("MXTPU_PRNG_IMPL", "auto")
+    from . import envs
+    impl = envs.get("MXTPU_PRNG_IMPL")
     jax = _jax()
     if impl == "auto":
         try:

@@ -124,3 +124,10 @@ def test_recommender_mf_smoke():
                 "--steps", "60", "--batch-size", "256"])
     assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
     assert "held-out RMSE=" in res.stdout
+
+
+def test_estimator_fit_smoke():
+    res = _run([os.path.join("example", "estimator_fit.py"),
+                "--synthetic", "--epochs", "3"])
+    assert res.returncode == 0
+    assert "final validation accuracy" in res.stdout

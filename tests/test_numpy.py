@@ -354,3 +354,27 @@ class TestNpTailFunctions:
         onp.testing.assert_allclose(
             sh.asnumpy(), onp.fft.fftshift(onp.fft.fftfreq(8)),
             rtol=1e-6)
+
+
+class TestNpAutogradRouting:
+    """Functions must route through the invoke seam: a direct jnp call
+    silently yields ZERO grads under record() (the slicing bug class
+    from r2)."""
+
+    def test_einsum_records(self):
+        a = mx.nd.array(onp.arange(12, dtype="f4").reshape(3, 4))
+        a.attach_grad()
+        b = mx.nd.array(onp.ones((4, 5), "f4"))
+        with mx.autograd.record():
+            out = mx.np.einsum("ij,jk->ik", a, b).sum()
+        out.backward()
+        onp.testing.assert_allclose(a.grad.asnumpy(),
+                                    onp.full((3, 4), 5.0), rtol=1e-6)
+
+    def test_gradient_records(self):
+        a = mx.nd.array(onp.array([1., 2., 4., 7.], "f4"))
+        a.attach_grad()
+        with mx.autograd.record():
+            out = mx.np.gradient(a).sum()
+        out.backward()
+        assert float(onp.abs(a.grad.asnumpy()).sum()) > 0
