@@ -42,12 +42,8 @@ def _functionalize(block, example_inputs):
 
     def fn(*xs):
         shells = [NDArray(x, ctx=ctx) for x in xs]
-        prev = getattr(block_mod._trace_state, "active", False)
-        block_mod._trace_state.active = True
-        try:
+        with block_mod.tracing_scope():
             out = block(*shells)
-        finally:
-            block_mod._trace_state.active = prev
         outs = out if isinstance(out, (list, tuple)) else [out]
         return tuple(o._data for o in outs)
 

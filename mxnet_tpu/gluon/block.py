@@ -23,6 +23,7 @@ Mechanics worth knowing:
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 import re
 import threading
@@ -357,6 +358,33 @@ _trace_state = threading.local()
 
 def _is_tracing() -> bool:
     return getattr(_trace_state, "active", False)
+
+
+@contextlib.contextmanager
+def tracing_scope(param_nds=(), param_vals=None):
+    """Enter the trace seam: NDArray ops apply directly on jax tracers
+    instead of dispatching compiled programs.
+
+    Optionally swaps each NDArray in ``param_nds`` to the traced value
+    at the same position of ``param_vals``; buffers AND versions are
+    restored on exit, so in-place mutation during the trace cannot
+    leak into the imperative state.  Shared by the fused trainer,
+    ``deploy._functionalize``, and fused generation loops — the
+    save/restore choreography lives in ONE place.
+    """
+    saved = [(r._buf, r._version) for r in param_nds]
+    prev = getattr(_trace_state, "active", False)
+    _trace_state.active = True
+    try:
+        if param_vals is not None:
+            for r, v in zip(param_nds, param_vals):
+                r._buf = v
+        yield
+    finally:
+        _trace_state.active = prev
+        for r, (buf, ver) in zip(param_nds, saved):
+            r._buf = buf
+            r._version = ver
 
 
 class _CacheEntry:

@@ -273,9 +273,12 @@ class LlamaForCausalLM(HybridBlock):
         max_len = caches[0][0].shape[1]
         # build the mask on the token's device: the default (cpu) ctx
         # does not exist under the axon plugin, which registers itself
-        # as the ONLY jax backend
+        # as the ONLY jax backend.  offset may be a python number (the
+        # per-step path) or a 0-d NDArray (the fused on-device
+        # generation loop carries it through lax.scan).
+        off = offset if isinstance(offset, nd.NDArray) else float(offset)
         mask = (nd.arange(max_len, ctx=token.context)
-                <= float(offset)).reshape((1, 1, 1, max_len))
+                <= off).reshape((1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.step(x, ck, cv, offset, mask)
         h = self.model.final_norm(x)
@@ -322,6 +325,117 @@ class LlamaForCausalLM(HybridBlock):
                 logits = self.decode_step(cur, caches, s + step_i)
         return nd.array(np.concatenate(out_tokens, axis=1),
                         ctx=tokens.context)
+
+    def generate_fused(self, tokens, max_new_tokens, temperature=0.0,
+                       top_k=0, seed=0):
+        """Whole-generation as ONE compiled program.
+
+        Same contract as :meth:`generate`, but prefill + every decode
+        step run inside a single jit with the sampling loop as
+        ``lax.scan`` and the KV cache as the scan carry — the
+        TPU-idiomatic serving shape.  The per-step path pays one host
+        round trip per token (~30-40 ms through the axon tunnel, vs
+        microseconds of compute for small models); this path pays one
+        dispatch for the whole sequence.  Sampling uses on-device
+        ``jax.random.categorical`` (seeded, reproducible) instead of
+        the per-step path's host ``np.random`` — same distribution,
+        different stream.  Compiled once per (batch, prompt_len,
+        max_new_tokens, temperature>0, top_k) signature.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from .. import ndarray as nd
+        from ..ndarray.ndarray import NDArray
+        from ..gluon import block as block_mod
+
+        ctx = tokens.context
+        if max_new_tokens <= 0:
+            return tokens
+        b, s = tokens.shape
+        max_len = s + max_new_tokens
+        params = [p.data(ctx) for p in
+                  self.collect_params().values()]
+        sample = bool(temperature and temperature > 0)
+        kk = min(int(top_k), self.model.vocab_size) if top_k else 0
+
+        cache_shapes = []
+        for layer in self.model.layers:
+            a = layer.attn
+            cache_shapes.append((b, max_len, a._kv, a._d))
+
+        key = (b, s, max_new_tokens, sample, kk, str(tokens.dtype))
+        cache = getattr(self, "_gen_fused_cache", None)
+        if cache is None:
+            cache = self._gen_fused_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            def traced(param_vals, tok_val, key_data, temp_val):
+                with block_mod.tracing_scope(params, param_vals):
+                    # caches hold activations: always the f32 compute
+                    # dtype (int tokens once leaked int32 caches here,
+                    # truncating every K/V write)
+                    shells = [
+                        (NDArray(jnp.zeros(shp, jnp.float32), ctx=ctx),
+                         NDArray(jnp.zeros(shp, jnp.float32), ctx=ctx))
+                        for shp in cache_shapes]
+                    toks = NDArray(tok_val, ctx=ctx)
+                    logits0 = self.prefill(toks, shells)._data
+
+                    def pick(lg, k_step):
+                        if not sample:
+                            return jnp.argmax(lg, axis=-1)
+                        lg = lg.astype(jnp.float32) / temp_val
+                        if kk:
+                            kth = lax.top_k(lg, kk)[0][:, -1:]
+                            lg = jnp.where(lg < kth, -jnp.inf, lg)
+                        return jax.random.categorical(k_step, lg)
+
+                    def body(carry, _):
+                        tok, off, k, flat = carry
+                        k, sub = jax.random.split(k)
+                        cshells = [
+                            (NDArray(flat[2 * i], ctx=ctx),
+                             NDArray(flat[2 * i + 1], ctx=ctx))
+                            for i in range(len(cache_shapes))]
+                        lg = self.decode_step(
+                            NDArray(tok, ctx=ctx), cshells,
+                            NDArray(off, ctx=ctx))._data
+                        nxt = pick(lg, sub).astype(tok.dtype)
+                        nxt = nxt.reshape((b, 1))
+                        new_flat = tuple(
+                            shell._data for pair in cshells
+                            for shell in pair)
+                        return (nxt, off + 1.0, k, new_flat), \
+                            nxt[:, 0]
+
+                    k0 = jax.random.wrap_key_data(key_data)
+                    k0, sub0 = jax.random.split(k0)
+                    first = pick(logits0, sub0).astype(
+                        tok_val.dtype).reshape((b, 1))
+                    flat0 = tuple(shell._data for pair in shells
+                                  for shell in pair)
+                    off0 = jnp.asarray(float(s), jnp.float32)
+                    (_, _, _, _), toks_out = lax.scan(
+                        body, (first, off0, k0, flat0), None,
+                        length=max_new_tokens - 1) \
+                        if max_new_tokens > 1 else ((None,) * 4,
+                                                    jnp.zeros(
+                                                        (0, b),
+                                                        tok_val.dtype))
+                    # sequence: prompt + first + scanned tokens
+                    gen = jnp.concatenate(
+                        [first, toks_out.T.astype(tok_val.dtype)],
+                        axis=1)
+                    return jnp.concatenate([tok_val, gen], axis=1)
+
+            fn = cache[key] = jax.jit(traced)
+
+        kd = jax.random.key_data(
+            jax.random.key(int(seed)))
+        out = fn([p._data for p in params], tokens._data, kd,
+                 jnp.asarray(float(temperature or 1.0), jnp.float32))
+        return NDArray(out, ctx=ctx)
 
     def loss(self, tokens):
         """Next-token cross-entropy over ``tokens`` (B, S) → scalar."""

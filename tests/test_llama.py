@@ -415,3 +415,66 @@ class TestLlama8BShardingPlan:
         w = [p for n, p in net.collect_params().items()
              if n.endswith("_attn_q_weight")][0].data()
         assert "tp" in str(w._data.sharding.spec), w._data.sharding
+
+
+class TestGenerateFused:
+    """One-compiled-program generation: lax.scan over decode steps
+    with the KV cache as carry (the TPU serving shape — no per-token
+    host dispatch)."""
+
+    def test_greedy_matches_per_step_path_exactly(self):
+        net = _net()
+        toks = _tokens(3, b=2, s=8)
+        g1 = net.generate(toks, 10, temperature=0.0).asnumpy()
+        g2 = net.generate_fused(toks, 10, temperature=0.0).asnumpy()
+        np.testing.assert_array_equal(g1, g2)
+
+    def test_sampling_seeded_and_in_range(self):
+        net = _net()
+        toks = _tokens(4, b=3, s=6)
+        a = net.generate_fused(toks, 7, temperature=0.9, top_k=12,
+                               seed=11).asnumpy()
+        b = net.generate_fused(toks, 7, temperature=0.9, top_k=12,
+                               seed=11).asnumpy()
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a[:, :6], toks.asnumpy())
+        assert a.shape == (3, 13)
+        assert (a >= 0).all() and (a < V).all()
+        c = net.generate_fused(toks, 7, temperature=0.9, top_k=12,
+                               seed=12).asnumpy()
+        assert (a != c).any()          # different seed, different draw
+
+    def test_single_new_token(self):
+        net = _net()
+        toks = _tokens(5, b=2, s=4)
+        g = net.generate_fused(toks, 1).asnumpy()
+        ref = net.generate(toks, 1, temperature=0.0).asnumpy()
+        np.testing.assert_array_equal(g, ref)
+
+    def test_executable_cached_across_calls(self):
+        net = _net()
+        toks = _tokens(6, b=2, s=4)
+        net.generate_fused(toks, 3)
+        n_before = len(net._gen_fused_cache)
+        net.generate_fused(_tokens(7, b=2, s=4), 3)   # same signature
+        assert len(net._gen_fused_cache) == n_before
+        net.generate_fused(toks, 4)                   # new signature
+        assert len(net._gen_fused_cache) == n_before + 1
+
+    def test_int32_tokens_match_per_step(self):
+        """Integer prompts are legal (embedding casts); the fused
+        path's caches must stay f32 — int caches once truncated every
+        K/V write, silently corrupting output."""
+        net = _net()
+        rng = np.random.RandomState(9)
+        toks = nd.array(rng.randint(0, V, (2, 6)).astype("int32"),
+                        dtype="int32")
+        g1 = net.generate(toks, 8, temperature=0.0).asnumpy()
+        g2 = net.generate_fused(toks, 8).asnumpy()
+        np.testing.assert_array_equal(g1, g2)
+
+    def test_zero_new_tokens_is_identity(self):
+        net = _net()
+        toks = _tokens(2, b=2, s=5)
+        out = net.generate_fused(toks, 0).asnumpy()
+        np.testing.assert_array_equal(out, toks.asnumpy())
