@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Ablation attribution for the BERT-base step time.
+
+Same-window A/B deltas are robust to the shared chip's multi-x
+contention variance in a way absolute phase timings are not: each
+variant runs the SAME fused-step harness minutes apart, and the step
+time DIFFERENCE attributes cost to the toggled component.  Variants:
+
+* ``base``        — bench.py's headline config (dropout 0.1, flash
+                    attention, adam, MLM+NSP loss, bf16 AMP).
+* ``no_dropout``  — dropout 0: the cost of on-device mask generation
+                    (+ the fused program's RNG plumbing).
+* ``xla_attn``    — MXTPU_DISABLE_FLASH equivalent: the XLA SDPA path
+                    instead of the Pallas kernel.
+* ``sgd``         — plain SGD instead of adam: optimizer HBM traffic
+                    (m/v state reads/writes) and update math.
+* ``nsp_only``    — MLM head ablated from the loss: the masked-gather
+                    + vocab-projection tail (fwd+bwd).
+
+    python benchmark/bert_ablation_bench.py [--batch 64] [--steps 12]
+
+One JSON line per variant; the CPU backend runs a tiny config as a
+harness smoke test.
+"""
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+try:
+    from benchmark._timing import slope
+except ImportError:
+    from _timing import slope
+
+
+def run_variant(name, cfg, dropout, use_flash, optimizer, loss_mode,
+                steps):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu import models
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    v, b, s, m = cfg["vocab"], cfg["b"], cfg["s"], cfg["m"]
+
+    amp.init(target_dtype="bfloat16")
+    # flash routing reads MXTPU_DISABLE_FLASH at trace time; each
+    # variant compiles its own program so the toggle is per-variant
+    prev_flash = _os.environ.get("MXTPU_DISABLE_FLASH")
+    if not use_flash:
+        _os.environ["MXTPU_DISABLE_FLASH"] = "1"
+    try:
+        inner = models.BERTForPretrain(models.bert_base(
+            vocab_size=v, max_length=s, dropout=dropout,
+            scan_layers=True) if cfg["h"] == 768 else
+            models.bert_small(vocab_size=v, max_length=s,
+                              dropout=dropout, scan_layers=True))
+
+        class _Full(HybridBlock):
+            def __init__(self, mod, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.mod = mod
+
+            def hybrid_forward(self, F, tokens, types, positions):
+                return self.mod(tokens, types, None, positions)
+
+        model = _Full(inner)
+        model.initialize(mx.init.Xavier(), ctx=ctx)
+        sce = SoftmaxCrossEntropyLoss()
+
+        def loss_fn(outs, label):
+            mlm_scores, nsp_scores = outs
+            nsp = sce(nsp_scores, label[:, m]).mean()
+            if loss_mode == "nsp_only":
+                return nsp
+            mlm = sce(mlm_scores,
+                      label[:, :m].reshape((-1,))).mean()
+            return mlm + nsp
+
+        mesh = parallel.make_mesh({"dp": 1}, devices=[ctx.device])
+        opt_args = {"learning_rate": 1e-4}
+        dpt = parallel.DataParallelTrainer(model, loss_fn, optimizer,
+                                           opt_args, mesh=mesh,
+                                           fuse_step=True)
+        rng = np.random.RandomState(0)
+        data = (nd.array(rng.randint(0, v, (b, s)).astype("f"),
+                         ctx=ctx),
+                nd.array(rng.randint(0, 2, (b, s)).astype("f"),
+                         ctx=ctx),
+                nd.array(rng.randint(0, s, (b, m)).astype("f"),
+                         ctx=ctx))
+        label = nd.array(np.concatenate(
+            [rng.randint(0, v, (b, m)), rng.randint(0, 2, (b, 1))],
+            axis=1).astype("f"), ctx=ctx)
+
+        dpt.step(data, label).wait_to_read()   # compile + warm
+
+        def window(n):
+            t0 = time.perf_counter()
+            acc = None
+            for _ in range(n):
+                out = dpt.step(data, label)
+                acc = out if acc is None else acc + out * 1e-30
+            float(acc.asnumpy().ravel()[0])
+            return time.perf_counter() - t0
+
+        per_step = slope(window, max(steps // 3, 2))
+        row = {"variant": name, "step_ms": round(per_step * 1e3, 2),
+               "samples_per_sec": round(b / per_step, 1),
+               "batch": b, "seq": s,
+               "platform": "tpu" if mx.num_tpus() else "cpu"}
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        if prev_flash is None:
+            _os.environ.pop("MXTPU_DISABLE_FLASH", None)
+        else:
+            _os.environ["MXTPU_DISABLE_FLASH"] = prev_flash
+        amp._deinit()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--variants", default="base,no_dropout,xla_attn,"
+                                          "sgd,nsp_only")
+    args = ap.parse_args()
+
+    import jax
+    if _os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = dict(vocab=30522, b=args.batch, s=128, m=20, h=768)
+    else:
+        cfg = dict(vocab=1000, b=4, s=32, m=4, h=256)
+
+    variants = {
+        "base": dict(dropout=0.1, use_flash=True, optimizer="adam",
+                     loss_mode="full"),
+        "no_dropout": dict(dropout=0.0, use_flash=True,
+                           optimizer="adam", loss_mode="full"),
+        "xla_attn": dict(dropout=0.1, use_flash=False,
+                         optimizer="adam", loss_mode="full"),
+        "sgd": dict(dropout=0.1, use_flash=True, optimizer="sgd",
+                    loss_mode="full"),
+        "nsp_only": dict(dropout=0.1, use_flash=True,
+                         optimizer="adam", loss_mode="nsp_only"),
+    }
+    rows = {}
+    for name in args.variants.split(","):
+        if name not in variants:
+            print(json.dumps({"warn": f"unknown variant {name}"}),
+                  flush=True)
+            continue
+        try:
+            rows[name] = run_variant(name, cfg, steps=args.steps,
+                                     **variants[name])
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": repr(e)[:300]}), flush=True)
+    if "base" in rows:
+        base = rows["base"]["step_ms"]
+        deltas = {n: round(base - r["step_ms"], 2)
+                  for n, r in rows.items() if n != "base"}
+        print(json.dumps({"summary": "bert_ablation",
+                          "base_step_ms": base,
+                          "savings_ms_vs_base": deltas,
+                          "platform": rows["base"]["platform"]}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -368,9 +368,11 @@ def tracing_scope(param_nds=(), param_vals=None):
     Optionally swaps each NDArray in ``param_nds`` to the traced value
     at the same position of ``param_vals``; buffers AND versions are
     restored on exit, so in-place mutation during the trace cannot
-    leak into the imperative state.  Shared by the fused trainer,
-    ``deploy._functionalize``, and fused generation loops — the
-    save/restore choreography lives in ONE place.
+    leak into the imperative state.  Yields the saved
+    ``[(buf, version), ...]`` list so callers can detect in-trace
+    mutation (version drift).  Used by CachedOp's ``pure()``, the
+    fused trainer, ``deploy._functionalize``, and fused generation
+    loops — the save/restore choreography lives in ONE place.
     """
     saved = [(r._buf, r._version) for r in param_nds]
     prev = getattr(_trace_state, "active", False)
@@ -379,7 +381,7 @@ def tracing_scope(param_nds=(), param_vals=None):
         if param_vals is not None:
             for r, v in zip(param_nds, param_vals):
                 r._buf = v
-        yield
+        yield saved
     finally:
         _trace_state.active = prev
         for r, (buf, ver) in zip(param_nds, saved):
@@ -532,7 +534,6 @@ class CachedOp:
             param_vals = flat[:n_params]
             input_vals = flat[n_params:n_params + n_args]
             base_key_raw = flat[-1]
-            saved = [(r._buf, r._version) for r in reps]
             key_counter = [0]
 
             def key_provider(_ctx):
@@ -541,30 +542,24 @@ class CachedOp:
                 key_counter[0] += 1
                 return NDArray(jax.random.key_data(k), ctx=ctx)
 
-            for r, v in zip(reps, param_vals):
-                r._buf = v
-            shells = [NDArray(v, ctx=ctx) for v in input_vals]
-            call_args = _unflatten_args(tree, shells)
             _rnd._push_key_provider(key_provider)
-            prev_tracing = getattr(_trace_state, "active", False)
-            _trace_state.active = True
             try:
-                outs = block._call_unhybridized(*call_args)
-                # outputs may nest (RNN layers return (seq, [h, c])) —
-                # flatten with the same tree scheme as the inputs
-                out_leaves, out_tree = _flatten_args((outs,))
-                out_data = tuple(o._data for o in out_leaves)
-                mutated_idx = tuple(
-                    i for i, (r, s) in enumerate(zip(reps, saved))
-                    if r._version != s[1])
-                mutated_vals = tuple(reps[i]._buf
-                                     for i in mutated_idx)
+                with tracing_scope(reps, param_vals) as saved:
+                    shells = [NDArray(v, ctx=ctx) for v in input_vals]
+                    call_args = _unflatten_args(tree, shells)
+                    outs = block._call_unhybridized(*call_args)
+                    # outputs may nest (RNN layers return (seq,
+                    # [h, c])) — flatten with the same tree scheme as
+                    # the inputs
+                    out_leaves, out_tree = _flatten_args((outs,))
+                    out_data = tuple(o._data for o in out_leaves)
+                    mutated_idx = tuple(
+                        i for i, (r, s) in enumerate(zip(reps, saved))
+                        if r._version != s[1])
+                    mutated_vals = tuple(reps[i]._buf
+                                         for i in mutated_idx)
             finally:
-                _trace_state.active = prev_tracing
                 _rnd._pop_key_provider()
-                for r, (buf, ver) in zip(reps, saved):
-                    r._buf = buf
-                    r._version = ver
             entry.n_real_out = len(out_data)
             entry.mutated_idx = mutated_idx
             entry.out_tree = out_tree

@@ -351,13 +351,18 @@ class LlamaForCausalLM(HybridBlock):
 
         ctx = tokens.context
         if max_new_tokens <= 0:
-            return tokens
+            # fresh array like generate() (callers may mutate the
+            # result in place; aliasing the prompt would corrupt it)
+            return tokens.copy()
         b, s = tokens.shape
         max_len = s + max_new_tokens
         params = [p.data(ctx) for p in
                   self.collect_params().values()]
         sample = bool(temperature and temperature > 0)
-        kk = min(int(top_k), self.model.vocab_size) if top_k else 0
+        # top_k only shapes the program when sampling — greedy ignores
+        # it, and including it in the key would compile a duplicate
+        kk = min(int(top_k), self.model.vocab_size) \
+            if (top_k and sample) else 0
 
         cache_shapes = []
         for layer in self.model.layers:

@@ -289,7 +289,6 @@ class DataParallelTrainer:
         mutated_idx: List[int] = []
 
         def traced(param_vals, input_vals, label_val, key_raw):
-            saved = [(r._buf, r._version) for r in param_nds]
             key_counter = [0]
 
             def key_provider(_ctx):
@@ -298,42 +297,43 @@ class DataParallelTrainer:
                 key_counter[0] += 1
                 return NDArray(jax.random.key_data(k), ctx=ctx)
 
-            prev_tracing = getattr(block_mod._trace_state, "active", False)
-            block_mod._trace_state.active = True
             _rnd._push_key_provider(key_provider)
             try:
-                # differentiate only trainable params — frozen weights /
-                # BN running stats ride along as closed-over constants,
-                # so no dead gradient buffers are materialized
-                tr_set = set(tr_idx)
+                # tracing_scope restores every param buffer+version on
+                # exit; loss_of still swaps buffers per-invocation
+                with block_mod.tracing_scope(param_nds):
+                    # differentiate only trainable params — frozen
+                    # weights / BN running stats ride along as
+                    # closed-over constants, so no dead gradient
+                    # buffers are materialized
+                    tr_set = set(tr_idx)
 
-                def loss_of(tvals):
-                    vers = []
-                    for j, i in enumerate(tr_idx):
-                        param_nds[i]._buf = tvals[j]
-                    for i, r in enumerate(param_nds):
-                        if i not in tr_set:
-                            r._buf = param_vals[i]
-                        vers.append(r._version)
-                    shells = [NDArray(v, ctx=ctx) for v in input_vals]
-                    out = block._call_unhybridized(*shells)
-                    l = loss_fn(out, NDArray(label_val, ctx=ctx))
-                    mutated_idx.clear()
-                    mutated_idx.extend(
-                        i for i, (r, v0) in enumerate(zip(param_nds, vers))
-                        if r._version != v0)
-                    aux = tuple(param_nds[i]._buf for i in mutated_idx)
-                    return jnp.mean(l._data), aux
+                    def loss_of(tvals):
+                        vers = []
+                        for j, i in enumerate(tr_idx):
+                            param_nds[i]._buf = tvals[j]
+                        for i, r in enumerate(param_nds):
+                            if i not in tr_set:
+                                r._buf = param_vals[i]
+                            vers.append(r._version)
+                        shells = [NDArray(v, ctx=ctx)
+                                  for v in input_vals]
+                        out = block._call_unhybridized(*shells)
+                        l = loss_fn(out, NDArray(label_val, ctx=ctx))
+                        mutated_idx.clear()
+                        mutated_idx.extend(
+                            i for i, (r, v0) in enumerate(
+                                zip(param_nds, vers))
+                            if r._version != v0)
+                        aux = tuple(param_nds[i]._buf
+                                    for i in mutated_idx)
+                        return jnp.mean(l._data), aux
 
-                tvals = tuple(param_vals[i] for i in tr_idx)
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(tvals)
+                    tvals = tuple(param_vals[i] for i in tr_idx)
+                    (loss, aux), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(tvals)
             finally:
-                block_mod._trace_state.active = prev_tracing
                 _rnd._pop_key_provider()
-                for r, (buf, ver) in zip(param_nds, saved):
-                    r._buf = buf
-                    r._version = ver
             return loss, grads, aux
 
         batch = NamedSharding(self.mesh, P(self.dp_axis))

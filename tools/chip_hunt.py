@@ -73,6 +73,13 @@ def jobs(log_dir):
          [sys.executable, "benchmark/bert_phase_bench.py",
           "--tpu-config"], 1800, {},
          r"full_step", r"degraded"),
+        # same-window A/B step-time attribution (dropout/flash/adam/
+        # mlm-head) — robust to contention in a way absolute phase
+        # timings are not
+        ("bert_ablation",
+         [sys.executable, "benchmark/bert_ablation_bench.py",
+          "--batch", "64"], 2400, {},
+         r"bert_ablation", r'"platform": "cpu"'),
         # flash-vs-XLA attention delta (VERDICT r2 weak #2)
         ("attention_bench",
          [sys.executable, "benchmark/attention_bench.py",
