@@ -26,7 +26,7 @@ from . import collectives
 from .collectives import quantized_psum
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, pipeline_value_and_grad
 from .planning import llama_param_rule, sharding_plan
 
 
@@ -46,6 +46,7 @@ def moe_param_rule(ep_axis="ep", inner=None):
     return rule
 
 __all__ = ["moe_param_rule", "pipeline_apply",
+           "pipeline_value_and_grad",
            "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
            "collectives", "DataParallelTrainer", "ring_attention",
            "ring_attention_sharded", "llama_param_rule",

@@ -24,7 +24,7 @@ import numpy as np
 from ..base import MXNetError
 from .mesh import current_mesh
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_value_and_grad"]
 
 
 def _local_schedule(params, xs, *, stage_fn, axis, n_microbatches):
@@ -97,6 +97,53 @@ def _capture_key(c):
     return ("o", id(c))  # retained via the cache entry while cached
 
 
+def _structural_fn_key(fn):
+    """Key a callable structurally (code object + closure captures) so
+    per-call lambdas with identical source hit the exec cache; closure
+    captures are keyed by VALUE for scalars and by content hash for
+    arrays (so equal re-created captures hit), falling back to
+    identity (retained in the entry) for opaque objects.  Returns
+    (key, captured) — captured must be retained alongside the cache
+    entry so ids stay live."""
+    code = getattr(fn, "__code__", None)
+    closure = getattr(fn, "__closure__", None) or ()
+    captured = tuple(c.cell_contents for c in closure)
+    key = ((code.co_code, repr(code.co_consts),
+            tuple(_capture_key(c) for c in captured))
+           if code is not None else fn)
+    return key, captured
+
+
+def _validate_and_place(fname, stacked_params, x, n_microbatches,
+                        mesh, axis, y=None):
+    """Shared arg validation + param placement for the pipeline entry
+    points.  Returns (mesh, n_stages, params placed on P(axis))."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if axis not in mesh.axis_names:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    n = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if any(l.shape[0] != n for l in leaves):
+        raise MXNetError(
+            f"{fname}: stacked param leading dims "
+            f"{[l.shape[0] for l in leaves]} must equal the {axis!r} "
+            f"axis size {n}")
+    if x.shape[0] % n_microbatches:
+        raise MXNetError(
+            f"batch {x.shape[0]} not divisible by n_microbatches "
+            f"{n_microbatches}")
+    if y is not None and y.shape[0] != x.shape[0]:
+        raise MXNetError(
+            f"{fname}: y batch {y.shape[0]} != x batch {x.shape[0]}")
+    params = jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
+        stacked_params)
+    return mesh, n, params
+
+
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
                    mesh=None, axis="pp"):
     """Apply ``n_stages`` homogeneous stages as a GPipe pipeline.
@@ -111,34 +158,13 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
     import jax
     import jax.numpy as jnp
     from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    mesh = mesh if mesh is not None else current_mesh()
-    if axis not in mesh.axis_names:
-        raise MXNetError(f"mesh has no axis {axis!r}")
-    n = mesh.shape[axis]
+    mesh, n, params = _validate_and_place(
+        "pipeline_apply", stacked_params, x, n_microbatches, mesh,
+        axis)
     leaves = jax.tree_util.tree_leaves(stacked_params)
-    if any(l.shape[0] != n for l in leaves):
-        raise MXNetError(
-            f"pipeline_apply: stacked param leading dims "
-            f"{[l.shape[0] for l in leaves]} must equal the {axis!r} "
-            f"axis size {n}")
-    if x.shape[0] % n_microbatches:
-        raise MXNetError(
-            f"batch {x.shape[0]} not divisible by n_microbatches "
-            f"{n_microbatches}")
-
-    # key stage_fn structurally (code object) so per-call lambdas with
-    # identical source hit the cache; closure captures are keyed by
-    # VALUE for scalars and by content hash for arrays (so equal
-    # re-created captures hit), falling back to identity (retained in
-    # the entry) for opaque objects
-    code = getattr(stage_fn, "__code__", None)
-    closure = getattr(stage_fn, "__closure__", None) or ()
-    captured = tuple(c.cell_contents for c in closure)
-    fn_key = ((code.co_code, repr(code.co_consts),
-               tuple(_capture_key(c) for c in captured))
-              if code is not None else stage_fn)
+    fn_key, captured = _structural_fn_key(stage_fn)
     key = (mesh, axis, fn_key, n_microbatches,
            tuple(l.shape for l in leaves), x.shape, str(x.dtype))
     entry = _EXEC_CACHE.get(key)
@@ -169,7 +195,151 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
             _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
         _EXEC_CACHE[key] = (fn, captured)
 
-    params = jax.tree_util.tree_map(
-        lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
-        stacked_params)
     return fn(params, x)
+
+
+def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
+                n_microbatches):
+    """Per-device 1F1B schedule (runs inside shard_map).
+
+    Interleaved one-forward-one-backward over ``R = m + 2(n-1)``
+    rounds: stage p forwards microbatch ``r - p`` and backwards
+    microbatch ``r - 2(n-1) + p`` in round r, so the last stage runs
+    its backward immediately after its forward (the 1F1B signature)
+    and every stage holds at most ``2(n-1)+1`` stashed activations —
+    bounded by PIPELINE DEPTH, not by the microbatch count (GPipe via
+    plain autodiff keeps all m alive).
+
+    The stash is a ring buffer of INPUT activations only (a jax array,
+    so the traced per-stage slot index can dynamically select into
+    it); the backward recomputes the stage forward under ``jax.vjp``
+    — the standard remat trade (≈1 extra forward) that makes the
+    schedule static-shape and SPMD-uniform.  Activations hop stage→
+    stage with ``lax.ppermute`` (+1 forward, −1 cotangent), one
+    neighbor transfer each way per round on a TPU torus.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis)
+    p = lax.axis_index(axis)
+    m = n_microbatches
+    local = jax.tree_util.tree_map(lambda a: a[0], params)
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [((i + 1) % n, i) for i in range(n)]
+    depth = 2 * (n - 1) + 1
+    mb_shape = xs[0].shape
+
+    ring = jnp.zeros((depth,) + mb_shape, xs.dtype)
+    fcarry = jnp.zeros(mb_shape, xs.dtype)
+    bcarry = jnp.zeros(mb_shape, xs.dtype)
+    grad_acc = jax.tree_util.tree_map(
+        lambda a: jnp.zeros_like(a, jnp.float32), local)
+    loss_acc = jnp.zeros((), jnp.float32)
+    is_last = p == n - 1
+
+    R = m + 2 * (n - 1)
+    for r in range(R):
+        # ---- forward half-round
+        f = r - p
+        f_active = (f >= 0) & (f < m)
+        fidx = jnp.clip(f, 0, m - 1)
+        x_in = jnp.where(p == 0, xs[fidx], fcarry)
+        out = stage_fn(local, x_in)
+        # last stage: loss for THIS microbatch + cotangent wrt out
+        loss_mb, loss_vjp = jax.vjp(
+            lambda o: loss_fn(o, ys[fidx]), out)
+        # the seed cotangent must carry the same device-varying type
+        # as loss_mb under shard_map's manual-axes checking — derive
+        # it from loss_mb instead of a fresh (replicated) constant
+        (dy,) = loss_vjp(loss_mb * 0 + 1)
+        loss_acc = loss_acc + jnp.where(
+            f_active & is_last, loss_mb.astype(jnp.float32), 0.0)
+        # stash the stage INPUT at this round's slot (static index)
+        ring = ring.at[r % depth].set(
+            jnp.where(f_active, x_in, ring[r % depth]))
+        fcarry = lax.ppermute(out, axis, perm_fwd)
+
+        # ---- backward half-round
+        b = r - 2 * (n - 1) + p
+        b_active = (b >= 0) & (b < m)
+        # the slot this stage forwarded microbatch b in: traced per
+        # stage, hence the array ring + dynamic take
+        slot = jnp.mod(r - 2 * (n - 1) + 2 * p, depth)
+        x_saved = jnp.take(ring, slot, axis=0)
+        cot = jnp.where(is_last, dy, bcarry).astype(x_saved.dtype)
+        _, stage_vjp = jax.vjp(stage_fn, local, x_saved)
+        dparams, dx = stage_vjp(cot)
+        grad_acc = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(
+                b_active, d.astype(jnp.float32), 0.0),
+            grad_acc, dparams)
+        bcarry = lax.ppermute(dx, axis, perm_bwd)
+
+    # loss lives on the last stage; grads are per-stage (stay sharded)
+    # and return in the PARAM dtype (f32 accumulation is internal)
+    loss = lax.psum(loss_acc, axis) / m
+    grads = jax.tree_util.tree_map(
+        lambda g, a: (g[None] / m).astype(a.dtype), grad_acc, local)
+    return loss, grads
+
+
+def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
+                            n_microbatches, mesh=None, axis="pp"):
+    """1F1B pipeline training step: mean loss + stacked param grads.
+
+    stage_fn(params_i, x_mb) -> y_mb (same shape); loss_fn(out_mb,
+    y_mb) -> scalar (mean over the microbatch); stacked_params: pytree
+    with leading dim n_stages sharded over ``axis``; x, y: (batch,
+    ...) split into ``n_microbatches`` along dim 0.  Returns
+    ``(loss, grads)`` with ``grads`` shaped/sharded like
+    ``stacked_params`` — feed them to any optimizer.
+
+    Compared with differentiating :func:`pipeline_apply`, the explicit
+    1F1B schedule bounds in-flight activation memory by pipeline depth
+    instead of microbatch count, at the cost of one recompute-forward
+    per microbatch per stage (the jax.checkpoint trade).
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, n, params = _validate_and_place(
+        "pipeline_value_and_grad", stacked_params, x, n_microbatches,
+        mesh, axis, y=y)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    sfn_key, s_cap = _structural_fn_key(stage_fn)
+    lfn_key, l_cap = _structural_fn_key(loss_fn)
+    key = ("1f1b", mesh, axis, sfn_key, lfn_key, n_microbatches,
+           tuple(l.shape for l in leaves),
+           tuple(str(l.dtype) for l in leaves),
+           x.shape, str(x.dtype), y.shape, str(y.dtype))
+    entry = _EXEC_CACHE.get(key)
+    fn = entry[0] if entry is not None else None
+    if fn is None:
+        pspec = P(axis)
+        rspec = P()
+        body = shard_map(
+            partial(_local_1f1b, stage_fn=stage_fn, loss_fn=loss_fn,
+                    axis=axis, n_microbatches=n_microbatches),
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: pspec,
+                                             stacked_params),
+                      rspec, rspec),
+            out_specs=(rspec,
+                       jax.tree_util.tree_map(lambda _: pspec,
+                                              stacked_params)))
+
+        def run(params, xb, yb):
+            mb = xb.shape[0] // n_microbatches
+            xs = xb.reshape((n_microbatches, mb) + xb.shape[1:])
+            ys = yb.reshape((n_microbatches, mb) + yb.shape[1:])
+            return body(params, xs, ys)
+
+        fn = jax.jit(run)
+        while len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+        _EXEC_CACHE[key] = (fn, (s_cap, l_cap))
+
+    return fn(params, x, y)

@@ -1,0 +1,131 @@
+"""1F1B pipeline-parallel schedule tests (beyond-reference: the
+reference's only pp analog is the manual model-parallel LSTM example;
+GPipe coverage lives in tests/test_parallel.py)."""
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: F401  (backend/env setup via conftest)
+
+
+class Test1F1B:
+    """pipeline_value_and_grad vs the sequential oracle: identical
+    loss and per-stage grads (up to fp accumulation order)."""
+
+    def _setup(self, n=4, m=4, mb=2, dim=8):
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        W = jnp.asarray(rng.randn(n, dim, dim).astype("f4") * 0.4)
+        b = jnp.asarray(rng.randn(n, dim).astype("f4") * 0.1)
+        X = jnp.asarray(rng.randn(m * mb, dim).astype("f4"))
+        Y = jnp.asarray(rng.randn(m * mb, dim).astype("f4"))
+
+        def stage(params, x):
+            w, bb = params
+            return jnp.tanh(x @ w + bb)
+
+        def loss_fn(out, y):
+            return ((out - y) ** 2).mean()
+
+        return (W, b), X, Y, stage, loss_fn
+
+    def _oracle(self, params, X, Y, stage, loss_fn, m):
+        import jax
+        import jax.numpy as jnp
+
+        def full_loss(ps):
+            xs = X.reshape((m, X.shape[0] // m) + X.shape[1:])
+            ys = Y.reshape((m, Y.shape[0] // m) + Y.shape[1:])
+            total = 0.0
+            for i in range(m):
+                h = xs[i]
+                for s in range(ps[0].shape[0]):
+                    h = stage((ps[0][s], ps[1][s]), h)
+                total = total + loss_fn(h, ys[i])
+            return total / m
+
+        return jax.value_and_grad(full_loss)(params)
+
+    def test_matches_sequential_oracle(self):
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad
+        params, X, Y, stage, loss_fn = self._setup(n=4, m=4)
+        mesh = parallel.make_mesh({"pp": 4})
+        loss, grads = pipeline_value_and_grad(
+            stage, params, X, Y, loss_fn, n_microbatches=4, mesh=mesh)
+        ref_loss, ref_grads = self._oracle(params, X, Y, stage,
+                                           loss_fn, m=4)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_more_microbatches_than_stages(self):
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad
+        params, X, Y, stage, loss_fn = self._setup(n=2, m=8, mb=2)
+        # rebuild shapes for n=2, m=8
+        import jax.numpy as jnp
+        rng = np.random.RandomState(1)
+        W = jnp.asarray(rng.randn(2, 8, 8).astype("f4") * 0.4)
+        b = jnp.asarray(rng.randn(2, 8).astype("f4") * 0.1)
+        X = jnp.asarray(rng.randn(16, 8).astype("f4"))
+        Y = jnp.asarray(rng.randn(16, 8).astype("f4"))
+        mesh = parallel.make_mesh({"pp": 2})
+        loss, grads = pipeline_value_and_grad(
+            stage, (W, b), X, Y, loss_fn, n_microbatches=8, mesh=mesh)
+        ref_loss, ref_grads = self._oracle((W, b), X, Y, stage,
+                                           loss_fn, m=8)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grads_drive_training(self):
+        """A few SGD steps through the 1F1B grads reduce the loss."""
+        import jax.numpy as jnp
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad
+        params, X, Y, stage, loss_fn = self._setup(n=4, m=4)
+        mesh = parallel.make_mesh({"pp": 4})
+        losses = []
+        W, b = params
+        for _ in range(6):
+            loss, (gW, gb) = pipeline_value_and_grad(
+                stage, (W, b), X, Y, loss_fn, n_microbatches=4,
+                mesh=mesh)
+            losses.append(float(loss))
+            W = W - 0.5 * gW.astype(W.dtype)
+            b = b - 0.5 * gb.astype(b.dtype)
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_executable_cached_and_grad_dtype(self):
+        """Same-signature calls reuse the compiled executable; grads
+        come back in the PARAM dtype (f32 accumulation internal)."""
+        import jax.numpy as jnp
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel import pipeline as pl
+        params, X, Y, stage, loss_fn = self._setup(n=4, m=4)
+        W16 = params[0].astype(jnp.bfloat16)
+        b16 = params[1].astype(jnp.bfloat16)
+        mesh = parallel.make_mesh({"pp": 4})
+        X16, Y16 = X.astype(jnp.bfloat16), Y.astype(jnp.bfloat16)
+        _, g = pl.pipeline_value_and_grad(
+            stage, (W16, b16), X16, Y16, loss_fn, 4, mesh=mesh)
+        assert g[0].dtype == jnp.bfloat16 and g[1].dtype == jnp.bfloat16
+        n_before = len(pl._EXEC_CACHE)
+        pl.pipeline_value_and_grad(stage, (W16, b16), X16, Y16,
+                                   loss_fn, 4, mesh=mesh)
+        assert len(pl._EXEC_CACHE) == n_before
+
+    def test_mismatched_y_raises(self):
+        import pytest
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad
+        params, X, Y, stage, loss_fn = self._setup(n=4, m=4)
+        mesh = parallel.make_mesh({"pp": 4})
+        with pytest.raises(MXNetError):
+            pipeline_value_and_grad(stage, params, X, Y[:4], loss_fn,
+                                    4, mesh=mesh)
