@@ -1,5 +1,6 @@
 """``mx.gluon.contrib``: transformer blocks, the Estimator fit loop,
-and other staging-ground layers (SURVEY.md §2.2 contrib)."""
-from . import estimator, nn
+deformable convolution, and other staging-ground layers (SURVEY.md
+§2.2 contrib)."""
+from . import cnn, estimator, nn
 
-__all__ = ["nn", "estimator"]
+__all__ = ["nn", "estimator", "cnn"]

@@ -1,0 +1,78 @@
+"""``gluon.contrib.cnn`` — deformable convolution layer (reference:
+``python/mxnet/gluon/contrib/cnn/conv_layers.py``)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.conv_layers import Conv2D
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution (v1): an internal regular conv
+    predicts per-tap sampling offsets, and the main kernel samples the
+    input bilinearly at base+offset positions
+    (``_contrib_DeformableConvolution``; reference
+    ``src/operator/contrib/deformable_convolution.cc`` + the gluon
+    contrib layer).  The offset branch is zero-initialized so the
+    layer starts as a plain convolution.
+    """
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, in_channels=0, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 offset_use_bias=True, activation=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        ks = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        strides = (strides,) * 2 if isinstance(strides, int) \
+            else tuple(strides)
+        padding = (padding,) * 2 if isinstance(padding, int) \
+            else tuple(padding)
+        dilation = (dilation,) * 2 if isinstance(dilation, int) \
+            else tuple(dilation)
+        self._channels = channels
+        self._kwargs = {
+            "kernel": ks, "stride": strides, "pad": padding,
+            "dilate": dilation, "num_filter": channels,
+            "num_group": groups,
+            "num_deformable_group": num_deformable_group,
+            "no_bias": not use_bias}
+        with self.name_scope():
+            # offsets start at zero → identity sampling grid
+            self.offset_conv = Conv2D(
+                2 * num_deformable_group * ks[0] * ks[1], ks,
+                strides=strides, padding=padding, dilation=dilation,
+                in_channels=in_channels, use_bias=offset_use_bias,
+                weight_initializer="zeros",
+                bias_initializer="zeros", prefix="offset_")
+            self.weight = self.params.get(
+                "weight",
+                shape=(channels,
+                       in_channels // groups if in_channels else 0)
+                + ks,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            if activation is not None:
+                from ..nn.activations import Activation
+                self.act = Activation(activation)
+            else:
+                self.act = None
+
+    def infer_shape(self, x):
+        groups = self._kwargs["num_group"]
+        self.weight.shape = (self._channels, x.shape[1] // groups) + \
+            self._kwargs["kernel"]
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        offset = self.offset_conv(x)
+        op = getattr(F, "_contrib_DeformableConvolution")
+        if bias is None:
+            out = op(x, offset, weight, **self._kwargs)
+        else:
+            out = op(x, offset, weight, bias, **self._kwargs)
+        return self.act(out) if self.act is not None else out

@@ -37,12 +37,20 @@ __all__ = ["foreach", "while_loop", "cond"]
 
 def __getattr__(name):
     """Registry-op passthrough: ``nd.contrib.box_nms`` etc. resolve to
-    the same generated wrappers as ``nd.box_nms`` (the reference's
-    contrib namespace mirrors ops registered under ``_contrib_*``)."""
+    the same generated wrappers as ``nd.box_nms``; ops registered ONLY
+    under a ``_contrib_`` name (DeformableConvolution) resolve through
+    the prefixed registry entry."""
     if name.startswith("__"):
         raise AttributeError(name)
     from .. import ndarray as _nd
-    return getattr(_nd, name)
+    try:
+        return getattr(_nd, name)
+    except AttributeError:
+        pass
+    prefixed = getattr(_nd, f"_contrib_{name}", None)
+    if prefixed is not None:
+        return prefixed
+    raise AttributeError(name)
 
 
 class _CaptureScope:

@@ -15,6 +15,7 @@ dtype rules (the reference reuses its engine the same way).
 """
 from __future__ import annotations
 
+import builtins as _builtins
 import functools
 
 import numpy as _onp
@@ -666,6 +667,137 @@ def unique(a, return_index=False, return_inverse=False,
     return _from_np(out)
 
 
+# nan-aware reductions + misc numpy tail, all registry-routed
+nansum = _unary("nansum")
+nanmean = _unary("nanmean")
+nanmax = _unary("nanmax")
+nanmin = _unary("nanmin")
+nanstd = _unary("nanstd")
+nanvar = _unary("nanvar")
+ptp = _unary("ptp")
+real = _unary("real")
+imag = _unary("imag")
+conj = _unary("conj")
+conjugate = conj
+angle = _unary("angle")
+digitize = _binary("digitize", promote=False)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    """numpy.trapezoid contract: optional sample positions ``x`` ride
+    as a tensor INPUT (an attr would hand a raw NDArray to jax)."""
+    y = _as_nd(y)
+    if x is None:
+        return invoke(_opdef("trapezoid", 1), [y], dx=dx, axis=axis)
+    return invoke(_opdef_trapz_x(), [y, _as_nd(x)], axis=axis)
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_trapz_x():
+    jnp = _jnp()
+
+    def fc(y, x, axis):
+        return jnp.trapezoid(y, x, axis=axis)
+
+    return OpDef("_np_trapz_x", fc, 2, 1, (), False, None)
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    ary = _as_nd(ary)
+    inputs = [ary]
+    if to_end is not None:
+        inputs.append(_as_nd(to_end))
+    if to_begin is not None:
+        inputs.append(_as_nd(to_begin))
+    return invoke(_opdef_ediff1d(), inputs,
+                  has_end=to_end is not None,
+                  has_begin=to_begin is not None)
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_ediff1d():
+    jnp = _jnp()
+
+    def fc(*arrays, has_end, has_begin):
+        it = iter(arrays)
+        a = next(it)
+        end = next(it) if has_end else None
+        begin = next(it) if has_begin else None
+        return jnp.ediff1d(a, to_end=end, to_begin=begin)
+
+    return OpDef("_np_ediff1d", fc, None, 1, (), False, None)
+
+
+def average(a, axis=None, weights=None):
+    a = _as_nd(a)
+    if weights is None:
+        return invoke(_opdef("mean", 1), [a], axis=axis)
+    w = _as_nd(weights)
+    return invoke(_opdef_average(), [a, w], axis=axis)
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_average():
+    jnp = _jnp()
+
+    def fc(a, w, axis):
+        return jnp.average(a, axis=axis, weights=w)
+
+    return OpDef("_np_average", fc, 2, 1, (), False, None)
+
+
+def bincount(x, weights=None, minlength=0):
+    """Static-shape when ``minlength`` covers the value range; like
+    jnp, values >= the output length are dropped.  Computed with
+    length = max(minlength, host max+1) — a sync point, matching the
+    reference's dynamic-shape ops."""
+    x = _as_nd(x)
+    host = _onp.asarray(x.asnumpy())
+    # numpy contract: negatives are an error, floats must be integral
+    # (silent clipping/truncation would fabricate plausible counts)
+    if host.size and host.min() < 0:
+        raise ValueError("bincount: input must be non-negative")
+    if host.dtype.kind == "f" and not _onp.equal(
+            _onp.mod(host, 1), 0).all():
+        raise TypeError("bincount: input must hold integral values")
+    # NB: plain `max` here would resolve to this module's np.max
+    length = _builtins.max(
+        int(minlength), int(host.max(initial=-1)) + 1)
+    inputs = [x]
+    if weights is not None:
+        inputs.append(_as_nd(weights))
+    return invoke(_opdef_bincount(), inputs, length=length,
+                  has_w=weights is not None)
+
+
+@functools.lru_cache(maxsize=None)
+def _opdef_bincount():
+    jnp = _jnp()
+
+    def fc(*arrays, length, has_w):
+        w = arrays[1] if has_w else None
+        return jnp.bincount(arrays[0].astype(jnp.int32), weights=w,
+                            length=length)
+
+    return OpDef("_np_bincount", fc, None, 1, (), False, None)
+
+
+def nonzero(a):
+    """Dynamic output shape → host fallback (sync point)."""
+    a = _as_nd(a)
+    return tuple(_from_np(i) for i in _onp.nonzero(a.asnumpy()))
+
+
+def argwhere(a):
+    a = _as_nd(a)
+    return _from_np(_onp.argwhere(a.asnumpy()))
+
+
+def flatnonzero(a):
+    a = _as_nd(a)
+    return _from_np(_onp.flatnonzero(a.asnumpy()))
+
+
 class _Fft:
     """``mx.np.fft`` — FFT family over XLA (complex64 under the
     default x64-off config; parity: numpy.fft's interface)."""
@@ -728,7 +860,11 @@ class _Fft:
 fft = _Fft()
 
 __all__ += ["pad", "searchsorted", "cov", "corrcoef", "interp",
-            "gradient", "histogram", "unique", "fft"]
+            "gradient", "histogram", "unique", "fft",
+            "nansum", "nanmean", "nanmax", "nanmin", "nanstd",
+            "nanvar", "ptp", "ediff1d", "real", "imag", "conj",
+            "conjugate", "angle", "digitize", "trapz", "average",
+            "bincount", "nonzero", "argwhere", "flatnonzero"]
 
 __all__ += ["sort", "argsort", "flip", "roll", "ravel", "diag", "tril",
             "triu", "trace", "cumprod", "round", "around", "trunc",

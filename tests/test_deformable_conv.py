@@ -1,0 +1,142 @@
+"""Deformable convolution tests (reference:
+``src/operator/contrib/deformable_convolution.cc`` +
+gluon.contrib.cnn.DeformableConvolution).
+
+Oracles: with zero offsets the op must EQUAL plain Convolution; with a
+constant integer offset it must equal the plain conv of the shifted
+input (interior pixels); gradients must flow to data, weight, AND
+offsets.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return nd.array((np.random.RandomState(seed).randn(*shape)
+                     * scale).astype("f4"))
+
+
+class TestDeformableOp:
+    def test_zero_offsets_equal_plain_conv(self):
+        x = _rand((2, 4, 9, 9))
+        w = _rand((6, 4, 3, 3), seed=1, scale=0.3)
+        b = _rand((6,), seed=2)
+        off = nd.zeros((2, 2 * 9, 7, 7))
+        got = nd.contrib.DeformableConvolution(
+            x, off, w, b, kernel=(3, 3), num_filter=6)
+        want = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=6)
+        np.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_zero_offsets_stride_pad_dilate(self):
+        x = _rand((1, 3, 11, 11))
+        w = _rand((5, 3, 3, 3), seed=3, scale=0.3)
+        kw = dict(kernel=(3, 3), stride=(2, 2), pad=(2, 2),
+                  dilate=(2, 2), num_filter=5)
+        ho = (11 + 4 - 5) // 2 + 1
+        off = nd.zeros((1, 18, ho, ho))
+        got = nd.contrib.DeformableConvolution(
+            x, off, w, no_bias=True, **kw)
+        want = nd.Convolution(x, w, no_bias=True, **kw)
+        np.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_integer_offset_equals_shifted_input(self):
+        """Constant (dy=1, dx=0) offset == sampling the input one row
+        down; compare on output rows whose receptive field stays
+        in-bounds."""
+        x = _rand((1, 2, 10, 10))
+        w = _rand((3, 2, 3, 3), seed=4, scale=0.3)
+        off_np = np.zeros((1, 18, 8, 8), "f4")
+        off_np[:, 0::2] = 1.0             # y-offsets (pairs are y,x)
+        got = nd.contrib.DeformableConvolution(
+            x, nd.array(off_np), w, kernel=(3, 3), num_filter=3,
+            no_bias=True)
+        shifted = nd.array(np.roll(x.asnumpy(), -1, axis=2))
+        want = nd.Convolution(shifted, w, kernel=(3, 3), num_filter=3,
+                              no_bias=True)
+        np.testing.assert_allclose(got.asnumpy()[:, :, :7],
+                                   want.asnumpy()[:, :, :7],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow_to_all_inputs(self):
+        x = _rand((1, 2, 6, 6))
+        w = _rand((2, 2, 3, 3), seed=5, scale=0.3)
+        off = nd.array(np.random.RandomState(6).uniform(
+            -0.4, 0.4, (1, 18, 4, 4)).astype("f4"))
+        for a in (x, w, off):
+            a.attach_grad()
+        with autograd.record():
+            out = nd.contrib.DeformableConvolution(
+                x, off, w, kernel=(3, 3), num_filter=2, no_bias=True)
+            loss = (out * out).sum()
+        loss.backward()
+        for name, a in (("data", x), ("weight", w), ("offset", off)):
+            g = a.grad.asnumpy()
+            assert np.isfinite(g).all(), name
+            assert np.abs(g).max() > 0, f"zero grad for {name}"
+
+    def test_deformable_groups(self):
+        """dg=2: each half of the channels follows its own offsets."""
+        x = _rand((1, 4, 8, 8))
+        w = _rand((4, 4, 3, 3), seed=7, scale=0.3)
+        off = nd.array(np.random.RandomState(8).uniform(
+            -0.5, 0.5, (1, 2 * 2 * 9, 6, 6)).astype("f4"))
+        out = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=4,
+            num_deformable_group=2, no_bias=True)
+        assert out.shape == (1, 4, 6, 6)
+        assert np.isfinite(out.asnumpy()).all()
+        # sanity: differs from the zero-offset result
+        base = nd.contrib.DeformableConvolution(
+            x, nd.zeros_like(off), w, kernel=(3, 3), num_filter=4,
+            num_deformable_group=2, no_bias=True)
+        assert np.abs(out.asnumpy() - base.asnumpy()).max() > 1e-4
+
+
+class TestDeformableLayer:
+    def test_starts_as_plain_conv_and_trains(self):
+        from mxnet_tpu import gluon
+        from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+        net = DeformableConvolution(4, kernel_size=(3, 3),
+                                    padding=(1, 1), in_channels=3)
+        net.initialize(mx.init.Xavier())
+        x = _rand((2, 3, 8, 8))
+        y0 = net(x)
+        assert y0.shape == (2, 4, 8, 8)
+        # zero-initialized offsets → equals plain conv with same weight
+        ref = nd.Convolution(x, net.weight.data(), net.bias.data(),
+                             kernel=(3, 3), pad=(1, 1), num_filter=4)
+        np.testing.assert_allclose(y0.asnumpy(), ref.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+        # trains: offset conv receives gradient
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        tgt = _rand((2, 4, 8, 8), seed=9)
+        L = gluon.loss.L2Loss()
+        losses = []
+        for _ in range(8):
+            with autograd.record():
+                l = L(net(x), tgt).mean()
+            l.backward()
+            tr.step(2)
+            losses.append(float(l.asnumpy()))
+        assert losses[-1] < losses[0]
+        ow = net.offset_conv.weight.data().asnumpy()
+        assert np.abs(ow).max() > 0, "offset branch never updated"
+
+    def test_hybridized_matches_eager(self):
+        from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+        net = DeformableConvolution(2, kernel_size=(3, 3),
+                                    padding=(1, 1), in_channels=2,
+                                    num_deformable_group=2)
+        net.initialize(mx.init.Xavier())
+        x = _rand((1, 2, 6, 6), seed=10)
+        eager = net(x).asnumpy()
+        net.hybridize()
+        hybrid = net(x).asnumpy()
+        np.testing.assert_allclose(eager, hybrid, rtol=1e-5,
+                                   atol=1e-6)

@@ -378,3 +378,98 @@ class TestNpAutogradRouting:
             out = mx.np.gradient(a).sum()
         out.backward()
         assert float(onp.abs(a.grad.asnumpy()).sum()) > 0
+
+
+class TestNpTail2:
+    """nan-reductions, bincount/digitize, complex views, host-fallback
+    index finders, and np.average/trapz."""
+
+    def test_nan_reductions(self):
+        rng = onp.random.RandomState(0)
+        a = rng.randn(4, 6).astype("f4")
+        a[1, 2] = onp.nan
+        m = mx.np.array(a)
+        onp.testing.assert_allclose(mx.np.nansum(m).asnumpy(),
+                                    onp.nansum(a), rtol=1e-5)
+        onp.testing.assert_allclose(
+            mx.np.nanmean(m, axis=0).asnumpy(),
+            onp.nanmean(a, axis=0), rtol=1e-5)
+        onp.testing.assert_allclose(mx.np.nanmax(m).asnumpy(),
+                                    onp.nanmax(a), rtol=1e-6)
+        onp.testing.assert_allclose(mx.np.nanstd(m).asnumpy(),
+                                    onp.nanstd(a), rtol=1e-4)
+        onp.testing.assert_allclose(mx.np.nanvar(m).asnumpy(),
+                                    onp.nanvar(a), rtol=1e-4)
+
+    def test_bincount_digitize(self):
+        x = onp.array([3, 1, 3, 0, 2], "f4")
+        w = onp.array([1., 2., 3., 4., 5.], "f4")
+        onp.testing.assert_array_equal(
+            mx.np.bincount(mx.np.array(x)).asnumpy(),
+            onp.bincount(x.astype(int)))
+        onp.testing.assert_array_equal(
+            mx.np.bincount(mx.np.array(x), minlength=8).asnumpy(),
+            onp.bincount(x.astype(int), minlength=8))
+        onp.testing.assert_allclose(
+            mx.np.bincount(mx.np.array(x),
+                           weights=mx.np.array(w)).asnumpy(),
+            onp.bincount(x.astype(int), weights=w), rtol=1e-6)
+        b = onp.array([0.2, 0.9, 1.5], "f4")
+        edges = onp.array([0., 1., 2.], "f4")
+        onp.testing.assert_array_equal(
+            mx.np.digitize(mx.np.array(b),
+                           mx.np.array(edges)).asnumpy(),
+            onp.digitize(b, edges))
+
+    def test_complex_views_and_misc(self):
+        b = onp.random.RandomState(1).rand(8).astype("f4")
+        c = mx.np.fft.fft(mx.np.array(b))
+        ref = onp.fft.fft(b).astype("complex64")
+        onp.testing.assert_allclose(mx.np.real(c).asnumpy(),
+                                    ref.real, atol=1e-4)
+        onp.testing.assert_allclose(mx.np.imag(c).asnumpy(),
+                                    ref.imag, atol=1e-4)
+        onp.testing.assert_allclose(mx.np.angle(c).asnumpy(),
+                                    onp.angle(ref), atol=1e-3)
+        onp.testing.assert_allclose(mx.np.ptp(mx.np.array(b)).asnumpy(),
+                                    onp.ptp(b), rtol=1e-6)
+        onp.testing.assert_allclose(
+            mx.np.average(mx.np.array(b),
+                          weights=mx.np.array(b)).asnumpy(),
+            onp.average(b, weights=b), rtol=1e-5)
+        onp.testing.assert_allclose(
+            mx.np.trapz(mx.np.array(b)).asnumpy(),
+            onp.trapezoid(b), rtol=1e-5)
+        onp.testing.assert_allclose(
+            mx.np.ediff1d(mx.np.array(b)).asnumpy(),
+            onp.ediff1d(b), rtol=1e-5)
+
+    def test_index_finders_host_fallback(self):
+        a = onp.array([[0, 1], [2, 0]], "f4")
+        nz = mx.np.nonzero(mx.np.array(a))
+        onp.testing.assert_array_equal(nz[0].asnumpy(), [0, 1])
+        onp.testing.assert_array_equal(nz[1].asnumpy(), [1, 0])
+        v = onp.array([0, 3, 0, 5], "f4")
+        onp.testing.assert_array_equal(
+            mx.np.argwhere(mx.np.array(v)).asnumpy(), [[1], [3]])
+        onp.testing.assert_array_equal(
+            mx.np.flatnonzero(mx.np.array(v)).asnumpy(), [1, 3])
+
+    def test_trapz_with_x_and_ediff1d_endpoints(self):
+        y = onp.array([1., 2., 3.], "f4")
+        x = onp.array([0., 1., 4.], "f4")
+        onp.testing.assert_allclose(
+            mx.np.trapz(mx.np.array(y), mx.np.array(x)).asnumpy(),
+            onp.trapezoid(y, x), rtol=1e-6)
+        onp.testing.assert_allclose(
+            mx.np.ediff1d(mx.np.array(y),
+                          to_end=mx.np.array([9.]),
+                          to_begin=mx.np.array([-9.])).asnumpy(),
+            onp.ediff1d(y, to_end=[9.], to_begin=[-9.]), rtol=1e-6)
+
+    def test_bincount_rejects_bad_input(self):
+        import pytest
+        with pytest.raises(ValueError):
+            mx.np.bincount(mx.np.array(onp.array([-2, 1], "f4")))
+        with pytest.raises(TypeError):
+            mx.np.bincount(mx.np.array(onp.array([0.5, 1.0], "f4")))
