@@ -191,6 +191,8 @@ class DataParallelTrainer:
         # __eq__ is elementwise — a WeakKeyDictionary lookup would
         # crash in bool())
         self._placed = {}
+        self._full_fn = None
+        self._multi_step_cache = {}
         self._mutated_idx: List[int] = []
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
         if fuse_step and self._rule is None:
@@ -420,14 +422,12 @@ class DataParallelTrainer:
                 scalar_vals)
             return loss, new_params, new_states, aux
 
+        self._full_fn = full          # unjitted: reused by step_multi
         batch = NamedSharding(self.mesh, P(self.dp_axis))
         repl = NamedSharding(self.mesh, P())
-        param_shardings = tuple(
-            p.data()._data.sharding for p in self._params)
+        param_shardings, state_shardings = self._sharding_tuples()
         tr_param_shardings = tuple(
             self._params[i].data()._data.sharding for i in tr_idx)
-        state_shardings = tuple(
-            tuple(v.sharding for v in vals) for vals in self._state_vals())
         # out shardings pinned for the same reason as the two-phase
         # update: a TP rule must not let XLA silently re-shard weights
         # between steps (and donation aliasing needs stable layouts)
@@ -541,6 +541,229 @@ class DataParallelTrainer:
             sp.sync(loss._data)
             return loss
 
+    def step_multi(self, data, label):
+        """Run K fused train steps as ONE compiled program.
+
+        ``data``: NDArray or tuple of NDArrays shaped (K, B, ...);
+        ``label``: (K, B, ...).  Returns the (K,) per-step losses.
+
+        A ``lax.scan`` over the fused step with params + optimizer
+        state as the carry — the XLA rebuild of the reference engine's
+        bulked execution (``MXNET_EXEC_BULK_EXEC_TRAIN``): one host
+        dispatch amortizes fixed per-step cost (through a remote PJRT
+        tunnel that cost is a full RPC round trip, ~30 ms measured)
+        over K real optimizer steps.  Per-step RNG keys and per-step
+        optimizer scalars (bias-correction t, schedules) are threaded,
+        so K scanned steps are numerically the K individual steps.
+        Requires ``fuse_step=True`` and no gradient compression.
+        """
+        from .. import profiler
+        with profiler._span("DataParallelTrainer.step_multi",
+                            "spmd_step_multi") as sp:
+            loss = self._step_multi_impl(data, label)
+            sp.sync(loss._data)
+            return loss
+
+    def _step_multi_impl(self, data, label):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .. import random as _rnd
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+
+        args = list(data) if isinstance(data, (list, tuple)) else [data]
+        k_steps = args[0].shape[0]
+        if label.shape[0] != k_steps:
+            raise MXNetError(
+                f"step_multi: label leading dim {label.shape[0]} != "
+                f"data leading dim {k_steps}")
+        if not (self._fuse_step and self._rule is not None):
+            raise MXNetError("step_multi requires fuse_step=True and "
+                             "a fused optimizer rule")
+        if self._compression_cfg is not None:
+            raise MXNetError("step_multi does not support gradient "
+                             "compression")
+
+        # single-step views drive setup/tracing (shapes minus K)
+        args0 = [a[0] for a in args]
+        if self._params is None:
+            self._setup(args0)
+        prev = autograd.set_training(True)
+        try:
+            if self._fwd_bwd is None:
+                self._build_fwd_bwd(args0, label[0])
+            if self._full_fn is None:
+                self._build_full_step()
+            if self._donation_poisoned is not None:
+                raise MXNetError(
+                    "this trainer's optimizer state was donated to a "
+                    "fused step that failed and is no longer valid; "
+                    "rebuild the trainer and restore from a "
+                    "checkpoint. Original error: "
+                    f"{self._donation_poisoned}")
+
+            opt = self.optimizer
+            tr_idx = self._tr_idx
+            # per-inner-step optimizer scalars from PROSPECTIVE update
+            # counts (t+1..t+K) — the real counters only advance after
+            # a successful dispatch, so a compile/shape failure cannot
+            # silently skew Adam bias correction for later steps
+            scal_rows = []
+            for k in range(k_steps):
+                row = []
+                for i in tr_idx:
+                    t = opt._index_update_count.get(
+                        i, opt.begin_num_update) + k + 1
+                    row.extend(np.asarray(sv, dtype=np.float32)
+                               for sv in self._rule.scalars(opt, i, t))
+                scal_rows.append(np.stack(row) if row
+                                 else np.zeros((0,), np.float32))
+            scalar_k = jnp.asarray(np.stack(scal_rows))   # (K, S)
+
+            # RNG: snapshot the stream so a pre-dispatch failure can
+            # rewind instead of skipping K keys
+            ctx0 = args[0].context
+            key_snapshot = dict(_rnd._keys)
+            keys = [_rnd._next_key_nd(ctx0)._data
+                    for _ in range(k_steps)]
+            keys_k = jnp.stack(keys)
+
+            batch_k = NamedSharding(self.mesh, P(None, self.dp_axis))
+            used = set()
+            x_vals = tuple(self._put_cached(a, batch_k, used)
+                           for a in args)
+            y_val = self._put_cached(label, batch_k, used)
+            self._prune_placed(used)
+            param_vals = tuple(p.data()._data for p in self._params)
+
+            fn = self._multi_step_cache.get(k_steps)
+            if fn is None:
+                fn = self._build_full_step_multi(k_steps)
+            try:
+                loss_k, new_all_params, new_states = fn(
+                    param_vals, self._state_vals(), scalar_k, x_vals,
+                    y_val, keys_k)
+            except Exception as e:
+                # donate_argnums=(0, 1): if the executable consumed
+                # the donated param/state buffers before failing they
+                # are gone (same protocol as _step_impl, with params
+                # in the blast radius too)
+                consumed = any(
+                    getattr(v, "is_deleted", lambda: False)()
+                    for vals in self._state_vals() for v in vals) or \
+                    any(getattr(p.data()._data, "is_deleted",
+                                lambda: False)()
+                        for p in self._params)
+                if not consumed:
+                    # trainer still valid: rewind the RNG stream (the
+                    # counters never advanced)
+                    _rnd._keys.clear()
+                    _rnd._keys.update(key_snapshot)
+                    raise
+                self._donation_poisoned = repr(e)
+                raise MXNetError(
+                    "bulked train step failed AFTER its param/state "
+                    "buffers were donated; the trainer is invalid. "
+                    "Rebuild it and restore from a checkpoint. "
+                    f"Original error: {e!r}") from e
+            # success: commit the K update-count advances
+            for _ in range(k_steps):
+                for i in tr_idx:
+                    opt._update_count(i)
+        finally:
+            autograd.set_training(prev)
+
+        for p, v in zip(self._params, new_all_params):
+            p.data()._set_data(v)
+        self._write_states(new_states)
+        return NDArray(loss_k, ctx=args[0].context)
+
+    def _put_cached(self, a, sharding, used):
+        """Device-place ``a._data`` under ``sharding`` through the
+        trainer's placement cache (skips the device_put when the same
+        NDArray/buffer was placed before — ~400 µs/dispatch of host
+        overhead otherwise; shared by step and step_multi)."""
+        import jax
+        import weakref
+        v = a._data
+        s = getattr(v, "sharding", None)
+        if s == sharding:
+            return v
+        try:
+            if s is not None and s.is_equivalent_to(sharding, v.ndim):
+                return v
+        except (AttributeError, TypeError):
+            pass
+        used.add(id(a))
+        hit = self._placed.get(id(a))
+        if hit is not None and hit[0]() is a and hit[1] is v:
+            return hit[2]
+        out = jax.device_put(v, sharding)
+        self._placed[id(a)] = (weakref.ref(a), v, out)
+        return out
+
+    def _prune_placed(self, used):
+        if len(self._placed) > len(used):
+            self._placed = {k: h for k, h in self._placed.items()
+                            if k in used}
+
+    def _build_full_step_multi(self, k_steps):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        full = self._full_fn
+        tr_idx = self._tr_idx
+        mutated_idx = self._mutated_idx
+        # same count _build_full_step derives as n_scalars per param
+        n_scal = len(self._rule.scalars(self.optimizer, 0, 1)) \
+            * len(tr_idx)
+
+        def full_k(param_vals, tstate_vals, scalar_k, inputs_k,
+                   label_k, keys_k):
+            def body(carry, xs):
+                params, tstates = carry
+                scal_row, inputs, label, key = xs
+                scal = tuple(scal_row[i] for i in range(n_scal))
+                loss, new_params, new_states, aux = full(
+                    params, tstates, scal, inputs, label, key)
+                params = list(params)
+                for j, i in enumerate(tr_idx):
+                    params[i] = new_params[j]
+                for j, i in enumerate(mutated_idx):
+                    params[i] = aux[j]
+                return (tuple(params), new_states), loss
+
+            (params_f, tstates_f), losses = lax.scan(
+                body, (param_vals, tstate_vals),
+                (scalar_k, inputs_k, label_k, keys_k))
+            return losses, params_f, tstates_f
+
+        batch_k = NamedSharding(self.mesh, P(None, self.dp_axis))
+        repl = NamedSharding(self.mesh, P())
+        param_shardings, state_shardings = self._sharding_tuples()
+        # out-shardings pinned for the same TP-safety reason as
+        # _build_full_step (weights must not silently re-shard
+        # between steps; donation aliasing needs stable layouts)
+        fn = jax.jit(
+            full_k,
+            in_shardings=(param_shardings, state_shardings, None,
+                          (batch_k,) * self._n_args, batch_k, repl),
+            out_shardings=(None, param_shardings, state_shardings),
+            donate_argnums=(0, 1))
+        self._multi_step_cache[k_steps] = fn
+        return fn
+
+    def _sharding_tuples(self):
+        """Current param/optimizer-state shardings (shared by the
+        fused single-step and bulked-step builders)."""
+        return (tuple(p.data()._data.sharding for p in self._params),
+                tuple(tuple(v.sharding for v in vals)
+                      for vals in self._state_vals()))
+
     def _step_impl(self, data, label):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -562,41 +785,13 @@ class DataParallelTrainer:
         try:
             batch = NamedSharding(self.mesh, P(self.dp_axis))
 
-            import weakref
             used = set()
-
-            def _put(a):
-                # skip the device_put when the array already carries
-                # the batch sharding — re-placing identical arrays
-                # cost ~400 us/step of pure host overhead.  Placements
-                # live in a trainer-side cache (NOT written back into
-                # the caller's NDArray, whose advertised context must
-                # keep matching its actual buffer).
-                v = a._data
-                s = getattr(v, "sharding", None)
-                if s == batch:
-                    return v
-                try:
-                    if s is not None and s.is_equivalent_to(batch,
-                                                            v.ndim):
-                        return v
-                except (AttributeError, TypeError):
-                    pass
-                used.add(id(a))
-                hit = self._placed.get(id(a))
-                if hit is not None and hit[0]() is a and hit[1] is v:
-                    return hit[2]
-                out = jax.device_put(v, batch)
-                self._placed[id(a)] = (weakref.ref(a), v, out)
-                return out
-
-            x_vals = tuple(_put(a) for a in args)
-            y_val = _put(label)
-            if len(self._placed) > len(used):
-                # only this step's inputs stay pinned — an epoch of
-                # distinct batches must not accumulate device copies
-                self._placed = {k: h for k, h in self._placed.items()
-                                if k in used}
+            x_vals = tuple(self._put_cached(a, batch, used)
+                           for a in args)
+            y_val = self._put_cached(label, batch, used)
+            # only this step's inputs stay pinned — an epoch of
+            # distinct batches must not accumulate device copies
+            self._prune_placed(used)
             key = _rnd._next_key_nd(args[0].context)
 
             param_vals = tuple(p.data()._data for p in self._params)

@@ -474,3 +474,83 @@ def test_step_placement_cache_bounded_and_correct():
         dpt.step(nd.array(rng.randn(8, 6).astype("f4")),
                  nd.array(rng.randn(8, 3).astype("f4")))
     assert len(dpt._placed) <= 2            # bounded to current inputs
+
+
+class TestStepMulti:
+    """step_multi: K scanned fused steps == K individual step() calls
+    (same RNG stream, same optimizer-scalar schedule)."""
+
+    def _mk(self, seed=0):
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon, nd, parallel
+        from mxnet_tpu.gluon import nn
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(1, in_units=16))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        L = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh({"dp": 8})
+        tr = parallel.DataParallelTrainer(
+            net, lambda o, l: L(o, l).mean(), "adam",
+            {"learning_rate": 0.05}, mesh=mesh, fuse_step=True)
+        return net, tr
+
+    def test_matches_sequential_steps(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        rng = np.random.RandomState(0)
+        K, B = 4, 16
+        Xk = rng.randn(K, B, 8).astype("f4")
+        Yk = (Xk[..., :1] * 0.5 + 0.1).astype("f4")
+
+        net_a, tr_a = self._mk(seed=3)
+        seq_losses = []
+        for k in range(K):
+            seq_losses.append(float(tr_a.step(
+                (nd.array(Xk[k]),), nd.array(Yk[k])).asnumpy()))
+
+        net_b, tr_b = self._mk(seed=3)
+        multi = tr_b.step_multi((nd.array(Xk),), nd.array(Yk))
+        np.testing.assert_allclose(multi.asnumpy(),
+                                   np.asarray(seq_losses),
+                                   rtol=1e-5, atol=1e-6)
+        for (ka, pa), (kb, pb) in zip(
+                sorted(net_a.collect_params().items()),
+                sorted(net_b.collect_params().items())):
+            np.testing.assert_allclose(pa.data().asnumpy(),
+                                       pb.data().asnumpy(),
+                                       rtol=1e-4, atol=1e-6, err_msg=ka)
+
+    def test_multi_then_single_continues(self):
+        from mxnet_tpu import nd
+        rng = np.random.RandomState(1)
+        net, tr = self._mk(seed=5)
+        Xk = rng.randn(3, 16, 8).astype("f4")
+        Yk = (Xk[..., :1]).astype("f4")
+        l0 = tr.step_multi((nd.array(Xk),), nd.array(Yk))
+        assert l0.shape == (3,)
+        l1 = tr.step((nd.array(Xk[0]),), nd.array(Yk[0]))
+        assert np.isfinite(float(l1.asnumpy()))
+        # losses trend down across the combined sequence
+        l2 = tr.step_multi((nd.array(Xk),), nd.array(Yk))
+        assert float(l2.asnumpy()[-1]) < float(l0.asnumpy()[0])
+
+    def test_requires_fused(self):
+        import pytest
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon, nd, parallel
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.gluon import nn
+        net = nn.Dense(1, in_units=4)
+        net.initialize(mx.init.Xavier())
+        L = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh({"dp": 8})
+        tr = parallel.DataParallelTrainer(
+            net, lambda o, l: L(o, l).mean(), "adam",
+            {"learning_rate": 0.01}, mesh=mesh, fuse_step=False)
+        with pytest.raises(MXNetError):
+            tr.step_multi((nd.zeros((2, 8, 4)),), nd.zeros((2, 8, 1)))
