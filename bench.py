@@ -480,7 +480,16 @@ def main():
                 for c in sweep:
                     if c[:2] in want:
                         by_cfg[c[:2]] = c   # later variant wins
-                chosen = tuple(by_cfg[k] for k in sorted(by_cfg))
+                # preserve the curated cheap-first sweep ORDER (a
+                # seq-512 cold compile must not run before the
+                # headline config)
+                chosen, seen = [], set()
+                for c in sweep:
+                    k = c[:2]
+                    if k in by_cfg and k not in seen:
+                        seen.add(k)
+                        chosen.append(by_cfg[k])
+                chosen = tuple(chosen)
                 unknown = want - {c[:2] for c in sweep}
                 if unknown:
                     _log(f"MXTPU_BENCH_SWEEP: ignoring unknown "

@@ -359,3 +359,70 @@ def cond(pred, then_func, else_func):
 
     res = _dispatch(fn, [pred_nd], captured, ctx)
     return res if out_struct["out_is_list"] else res[0]
+
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where ``index`` is nonzero (parity:
+    mx.nd.contrib.boolean_mask).  Output shape depends on the DATA —
+    like ``np.unique`` this computes the row set on the host (a sync
+    point; the reference's dynamic-shape op has the same
+    non-hybridizable character)."""
+    import numpy as _np
+    mask = _np.asarray(index.asnumpy()).astype(bool)
+    keep = _np.nonzero(mask)[0]
+    from . import ndarray as nd_mod
+    idx = nd_mod.array(keep.astype("int32"), ctx=data.context,
+                       dtype="int32")
+    from ..ops.registry import get_op
+    return nd_core.invoke(get_op("take"), [data, idx], axis=axis,
+                          mode="clip")
+
+
+def fft(data, *, compute_size=128):
+    """Batched 1-D FFT over the last axis with the reference's
+    interleaved real/imag output layout (parity: mx.nd.contrib.fft —
+    output (..., 2n): [re0, im0, re1, im1, ...])."""
+    return nd_core.invoke(_fft_opdef(), [data])
+
+
+def ifft(data, *, compute_size=128):
+    """Inverse of :func:`fft` (parity: mx.nd.contrib.ifft): input
+    interleaved real/imag (..., 2n) → real (..., n), scaled by n like
+    the reference (which does NOT normalize, so fft→ifft gains a
+    factor n — reproduced faithfully)."""
+    return nd_core.invoke(_ifft_opdef(), [data])
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _fft_opdef():
+    import jax.numpy as jnp
+    from ..ops.registry import OpDef
+
+    def fc(x):
+        f = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+        out = jnp.stack([f.real, f.imag], axis=-1)
+        return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)) \
+            .astype(x.dtype)
+
+    return OpDef("_contrib_fft_impl", fc, 1, 1, (), False, None)
+
+
+@_functools.lru_cache(maxsize=None)
+def _ifft_opdef():
+    import jax.numpy as jnp
+    from ..ops.registry import OpDef
+
+    def fc(x):
+        n = x.shape[-1] // 2
+        pairs = x.reshape(x.shape[:-1] + (n, 2)).astype(jnp.float32)
+        z = pairs[..., 0] + 1j * pairs[..., 1]
+        # reference ifft does not divide by n: reproduce (fft∘ifft = n·x)
+        return (jnp.fft.ifft(z, axis=-1).real * n).astype(x.dtype)
+
+    return OpDef("_contrib_ifft_impl", fc, 1, 1, (), False, None)
+
+
+__all__ += ["boolean_mask", "fft", "ifft"]

@@ -326,3 +326,113 @@ def multibox_detection(cls_probs, loc_preds, anchors, *, clip=True,
                        force_suppress=force_suppress)
 
     return jax.vmap(one)(cls_probs, loc_preds)
+
+
+@register("_contrib_box_encode", num_inputs=4, num_outputs=2)
+def box_encode(samples, matches, anchors, refs, *,
+               means=(0.0, 0.0, 0.0, 0.0),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor-box regression targets (parity:
+    ``mx.nd.contrib.box_encode``; reference
+    ``src/operator/contrib/bounding_box.cc``).
+
+    samples (B, N) ∈ {1 pos, 0/-1 ignore}; matches (B, N) gt index per
+    anchor; anchors (B, N, 4) and refs (B, M, 4) in corner format.
+    Returns (targets (B, N, 4), masks (B, N, 4)).
+    """
+    a_w = anchors[:, :, 2] - anchors[:, :, 0]
+    a_h = anchors[:, :, 3] - anchors[:, :, 1]
+    a_x = anchors[:, :, 0] + a_w * 0.5
+    a_y = anchors[:, :, 1] + a_h * 0.5
+    m = matches.astype(jnp.int32)
+    g = jnp.take_along_axis(refs, m[:, :, None].clip(0), axis=1)
+    g_w = g[:, :, 2] - g[:, :, 0]
+    g_h = g[:, :, 3] - g[:, :, 1]
+    g_x = g[:, :, 0] + g_w * 0.5
+    g_y = g[:, :, 1] + g_h * 0.5
+    eps = 1e-8
+    t = jnp.stack([
+        ((g_x - a_x) / (a_w + eps) - means[0]) / stds[0],
+        ((g_y - a_y) / (a_h + eps) - means[1]) / stds[1],
+        (jnp.log(jnp.maximum(g_w, eps) / (a_w + eps)) - means[2])
+        / stds[2],
+        (jnp.log(jnp.maximum(g_h, eps) / (a_h + eps)) - means[3])
+        / stds[3]], axis=-1)
+    mask = jnp.broadcast_to((samples > 0.5)[:, :, None],
+                            t.shape).astype(t.dtype)
+    return t * mask, mask
+
+
+@register("_contrib_box_decode", num_inputs=2)
+def box_decode(data, anchors, *, std0=0.1, std1=0.1, std2=0.2,
+               std3=0.2, clip=-1.0, format="corner"):
+    """Regression deltas → boxes (parity: ``mx.nd.contrib.box_decode``).
+
+    data (B, N, 4) deltas; anchors (1|B, N, 4).  Output corner boxes.
+    """
+    if format == "center":
+        a_x, a_y = anchors[..., 0], anchors[..., 1]
+        a_w, a_h = anchors[..., 2], anchors[..., 3]
+    else:
+        a_w = anchors[..., 2] - anchors[..., 0]
+        a_h = anchors[..., 3] - anchors[..., 1]
+        a_x = anchors[..., 0] + a_w * 0.5
+        a_y = anchors[..., 1] + a_h * 0.5
+    x = data[..., 0] * std0 * a_w + a_x
+    y = data[..., 1] * std1 * a_h + a_y
+    w = jnp.exp(jnp.minimum(data[..., 2] * std2, 10.0)) * a_w * 0.5
+    h = jnp.exp(jnp.minimum(data[..., 3] * std3, 10.0)) * a_h * 0.5
+    out = jnp.stack([x - w, y - h, x + w, y + h], axis=-1)
+    if clip > 0:
+        out = jnp.clip(out, 0.0, clip)
+    return out
+
+
+@register("_contrib_bipartite_matching", num_inputs=1, num_outputs=2)
+def bipartite_matching(dist, *, is_ascend=False, threshold=0.5,
+                       topk=-1):
+    """Greedy bipartite matching over a (B, N, M) score matrix
+    (parity: ``mx.nd.contrib.bipartite_matching``; used by detection
+    target assignment).  Returns (row_match (B, N), col_match (B, M)):
+    each row/col used at most once, matched greedily best-first until
+    ``threshold`` fails.  Static-shape: min(N, M) sequential rounds
+    via lax.fori_loop.
+    """
+    import jax.lax as lax
+
+    b, n, m = dist.shape
+    sign = 1.0 if is_ascend else -1.0
+    big = jnp.asarray(1e30, dist.dtype)
+    rounds = min(n, m) if topk <= 0 else min(topk, min(n, m))
+
+    def body(_, carry):
+        d, rmatch, cmatch = carry
+        flat = d.reshape(b, n * m)
+        best = jnp.argmin(flat, axis=1) if is_ascend \
+            else jnp.argmax(flat, axis=1)
+        bi = jnp.arange(b)
+        val = flat[bi, best]
+        ok = (val <= threshold) if is_ascend else (val >= threshold)
+        r, c = best // m, best % m
+        rmatch = rmatch.at[bi, r].set(
+            jnp.where(ok & (rmatch[bi, r] < 0), c.astype(jnp.float32),
+                      rmatch[bi, r]))
+        cmatch = cmatch.at[bi, c].set(
+            jnp.where(ok & (cmatch[bi, c] < 0), r.astype(jnp.float32),
+                      cmatch[bi, c]))
+        # burn the taken row AND column: +big hides cells from argmin
+        # (ascend, sign=1), -big from argmax (descend, sign=-1)
+        d = jnp.where(ok[:, None, None]
+                      & ((jnp.arange(n)[None, :, None] == r[:, None, None])
+                         | (jnp.arange(m)[None, None, :]
+                            == c[:, None, None])),
+                      sign * big, d)
+        return d, rmatch, cmatch
+
+    rmatch0 = jnp.full((b, n), -1.0, jnp.float32)
+    cmatch0 = jnp.full((b, m), -1.0, jnp.float32)
+    _, rmatch, cmatch = lax.fori_loop(
+        0, rounds, body, (dist.astype(jnp.float32)
+                          if dist.dtype != jnp.float32 else dist,
+                          rmatch0, cmatch0))
+    return rmatch, cmatch

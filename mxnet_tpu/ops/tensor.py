@@ -914,3 +914,74 @@ def _cache_update(cache, new, offset=0):
     return lax.dynamic_update_slice_in_dim(
         cache, new.astype(cache.dtype),
         jnp.asarray(offset, jnp.int32), axis=1)
+
+
+@register("_contrib_arange_like", num_inputs=1)
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
+    """Arange shaped like ``data`` (parity: mx.nd.contrib.arange_like;
+    hybridizable position indices without a shape-dependent constant).
+    """
+    # repeat holds each value ``repeat`` times WITHIN the output
+    # length (reference semantics: total length stays n)
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        out = start + step * (jnp.arange(n) // repeat)
+        return out.reshape(data.shape).astype(data.dtype)
+    n = data.shape[axis]
+    return (start + step * (jnp.arange(n) // repeat)) \
+        .astype(data.dtype)
+
+
+@register("_contrib_index_array", num_inputs=1)
+def index_array(data, *, axes=None):
+    """Per-element N-D indices of ``data`` (parity:
+    mx.nd.contrib.index_array): output (*data.shape, len(axes))."""
+    shape = data.shape
+    sel = tuple(range(len(shape))) if axes is None else tuple(axes)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
+                         indexing="ij")
+    return jnp.stack([grids[a] for a in sel], axis=-1).astype("int32")
+
+
+@register("_contrib_index_copy", num_inputs=3)
+def index_copy(old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index`` along axis 0
+    (parity: mx.nd.contrib.index_copy; out-of-place like the
+    reference's functional form)."""
+    return old.at[index.astype(jnp.int32)].set(new.astype(old.dtype))
+
+
+@register("_contrib_AdaptiveAvgPooling2D", num_inputs=1)
+def adaptive_avg_pooling(data, *, output_size=()):
+    """NCHW adaptive average pooling to ``output_size`` (parity:
+    mx.nd.contrib.AdaptiveAvgPooling2D; reference
+    ``src/operator/contrib/adaptive_avg_pooling.cc``).  Matches the
+    reference's variable-window semantics (start = floor(i*H/h'),
+    end = ceil((i+1)*H/h')) via a normalized matmul per axis — dense
+    MXU work instead of ragged windows.
+    """
+    b, c, h, w = data.shape
+    if not output_size:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = int(output_size)
+    elif len(output_size) == 1:
+        oh = ow = int(output_size[0])
+    else:
+        oh, ow = int(output_size[0]), int(output_size[1])
+
+    def pool_matrix(n_in, n_out):
+        i = jnp.arange(n_out)
+        starts = jnp.floor(i * n_in / n_out).astype(jnp.int32)
+        ends = jnp.ceil((i + 1) * n_in / n_out).astype(jnp.int32)
+        pos = jnp.arange(n_in)
+        m = ((pos[None, :] >= starts[:, None])
+             & (pos[None, :] < ends[:, None])).astype(data.dtype)
+        return m / m.sum(axis=1, keepdims=True)
+
+    mh = pool_matrix(h, oh)                     # (oh, h)
+    mw = pool_matrix(w, ow)                     # (ow, w)
+    out = jnp.einsum("oh,bchw->bcow", mh, data)
+    return jnp.einsum("pw,bcow->bcop", mw, out)

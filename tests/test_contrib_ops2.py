@@ -1,0 +1,139 @@
+"""Second contrib-op batch (reference ``src/operator/contrib/``):
+box_encode/box_decode, bipartite_matching, arange_like, index_array,
+index_copy, AdaptiveAvgPooling2D, boolean_mask, fft/ifft."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_box_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    anchors = np.stack([
+        rng.uniform(0.0, 0.4, (1, 8)), rng.uniform(0.0, 0.4, (1, 8)),
+        rng.uniform(0.5, 0.9, (1, 8)), rng.uniform(0.5, 0.9, (1, 8)),
+    ], axis=-1).astype("f4")
+    refs = anchors[:, :3] + 0.05
+    matches = np.tile(np.array([0., 1., 2., 0., 1., 2., 0., 1.],
+                               "f4"), (1, 1))
+    samples = np.ones((1, 8), "f4")
+    t, m = nd.contrib.box_encode(nd.array(samples), nd.array(matches),
+                                 nd.array(anchors), nd.array(refs))
+    assert t.shape == (1, 8, 4) and m.shape == (1, 8, 4)
+    np.testing.assert_array_equal(m.asnumpy(), np.ones((1, 8, 4)))
+    # decode(encode(gt)) reproduces the matched gt boxes
+    dec = nd.contrib.box_decode(t, nd.array(anchors))
+    gt = refs[0][matches[0].astype(int)]
+    np.testing.assert_allclose(dec.asnumpy()[0], gt, rtol=1e-4,
+                               atol=1e-5)
+    # ignored anchors produce zero targets and zero mask
+    samples0 = samples.copy(); samples0[0, 3] = 0.0
+    t0, m0 = nd.contrib.box_encode(
+        nd.array(samples0), nd.array(matches), nd.array(anchors),
+        nd.array(refs))
+    assert np.abs(t0.asnumpy()[0, 3]).max() == 0
+    assert m0.asnumpy()[0, 3].max() == 0
+
+
+def test_bipartite_matching_greedy_order():
+    score = np.array([[[0.5, 0.9, 0.1],
+                       [0.8, 0.2, 0.3]]], "f4")
+    rm, cm = nd.contrib.bipartite_matching(nd.array(score),
+                                           threshold=0.2)
+    # best pair (row0,col1)=0.9 first, then (row1,col0)=0.8
+    np.testing.assert_array_equal(rm.asnumpy()[0], [1, 0])
+    np.testing.assert_array_equal(cm.asnumpy()[0], [1, 0, -1])
+    # ascending mode on a cost matrix
+    cost = np.array([[[0.5, 0.1, 0.9],
+                      [0.2, 0.8, 0.3]]], "f4")
+    rm2, cm2 = nd.contrib.bipartite_matching(
+        nd.array(cost), is_ascend=True, threshold=0.6)
+    np.testing.assert_array_equal(rm2.asnumpy()[0], [1, 0])
+
+
+def test_arange_like_and_index_array():
+    x = nd.zeros((2, 3, 4))
+    a = nd.contrib.arange_like(x, axis=1)
+    np.testing.assert_array_equal(a.asnumpy(), [0, 1, 2])
+    full = nd.contrib.arange_like(x, start=5.0, step=2.0)
+    assert full.shape == (2, 3, 4)
+    assert float(full.asnumpy()[0, 0, 1]) == 7.0
+    ia = nd.contrib.index_array(nd.zeros((2, 3)))
+    assert ia.shape == (2, 3, 2)
+    np.testing.assert_array_equal(ia.asnumpy()[1, 2], [1, 2])
+    ia1 = nd.contrib.index_array(nd.zeros((2, 3)), axes=(1,))
+    np.testing.assert_array_equal(ia1.asnumpy()[..., 0],
+                                  [[0, 1, 2], [0, 1, 2]])
+
+
+def test_index_copy():
+    old = nd.zeros((5, 3))
+    new = nd.array(np.arange(6, dtype="f4").reshape(2, 3))
+    idx = nd.array(np.array([1, 4], "f4"))
+    out = nd.contrib.index_copy(old, idx, new)
+    ref = np.zeros((5, 3), "f4")
+    ref[[1, 4]] = np.arange(6, dtype="f4").reshape(2, 3)
+    np.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+def test_adaptive_avg_pooling():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 7, 5).astype("f4")
+    out = nd.contrib.AdaptiveAvgPooling2D(nd.array(x),
+                                          output_size=(3, 2))
+    assert out.shape == (2, 3, 3, 2)
+    # reference semantics oracle (torch-style variable windows)
+    ref = np.zeros((2, 3, 3, 2), "f4")
+    for i in range(3):
+        for j in range(2):
+            hs, he = int(np.floor(i * 7 / 3)), int(np.ceil((i + 1) * 7 / 3))
+            ws, we = int(np.floor(j * 5 / 2)), int(np.ceil((j + 1) * 5 / 2))
+            ref[:, :, i, j] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    # global pooling default
+    g = nd.contrib.AdaptiveAvgPooling2D(nd.array(x))
+    np.testing.assert_allclose(g.asnumpy()[..., 0, 0],
+                               x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_boolean_mask():
+    x = nd.array(np.arange(12, dtype="f4").reshape(4, 3))
+    m = nd.array(np.array([1, 0, 1, 0], "f4"))
+    out = nd.contrib.boolean_mask(x, m)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[[0, 2]])
+
+
+def test_contrib_fft_interleaved_layout():
+    rng = np.random.RandomState(1)
+    x = rng.rand(3, 8).astype("f4")
+    out = nd.contrib.fft(nd.array(x)).asnumpy()
+    assert out.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(out[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(out[:, 1::2], ref.imag, atol=1e-4)
+    # reference ifft is unnormalized: ifft(fft(x)) == n * x
+    back = nd.contrib.ifft(nd.array(out)).asnumpy()
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-3)
+
+
+def test_grads_flow_box_decode():
+    from mxnet_tpu import autograd
+    d = nd.array(np.random.RandomState(2).randn(1, 4, 4)
+                 .astype("f4") * 0.1)
+    a = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5]] * 4], "f4"))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.contrib.box_decode(d, a).sum()
+    out.backward()
+    assert np.abs(d.grad.asnumpy()).max() > 0
+
+
+def test_arange_like_repeat():
+    x = nd.zeros((2, 3))
+    a = nd.contrib.arange_like(x, repeat=2)
+    np.testing.assert_array_equal(a.asnumpy(),
+                                  [[0, 0, 1], [1, 2, 2]])
+    a1 = nd.contrib.arange_like(nd.zeros((4, 2)), axis=0, repeat=2)
+    np.testing.assert_array_equal(a1.asnumpy(), [0, 0, 1, 1])
