@@ -369,10 +369,13 @@ def boolean_mask(data, index, axis=0):
     non-hybridizable character)."""
     import numpy as _np
     mask = _np.asarray(index.asnumpy()).astype(bool)
+    if mask.shape[0] != data.shape[axis]:
+        raise MXNetError(
+            f"boolean_mask: mask length {mask.shape[0]} != data dim "
+            f"{data.shape[axis]} along axis {axis}")
     keep = _np.nonzero(mask)[0]
-    from . import ndarray as nd_mod
-    idx = nd_mod.array(keep.astype("int32"), ctx=data.context,
-                       dtype="int32")
+    idx = nd_core.array(keep.astype("int32"), ctx=data.context,
+                        dtype="int32")
     from ..ops.registry import get_op
     return nd_core.invoke(get_op("take"), [data, idx], axis=axis,
                           mode="clip")

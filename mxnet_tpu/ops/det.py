@@ -380,12 +380,12 @@ def box_decode(data, anchors, *, std0=0.1, std1=0.1, std2=0.2,
         a_y = anchors[..., 1] + a_h * 0.5
     x = data[..., 0] * std0 * a_w + a_x
     y = data[..., 1] * std1 * a_h + a_y
-    w = jnp.exp(jnp.minimum(data[..., 2] * std2, 10.0)) * a_w * 0.5
-    h = jnp.exp(jnp.minimum(data[..., 3] * std3, 10.0)) * a_h * 0.5
-    out = jnp.stack([x - w, y - h, x + w, y + h], axis=-1)
-    if clip > 0:
-        out = jnp.clip(out, 0.0, clip)
-    return out
+    # reference clip bounds the SCALED log-deltas before exp (a
+    # growth cap like GluonCV's clip≈6.586), not the output coords
+    cap = clip if clip > 0 else 10.0
+    w = jnp.exp(jnp.minimum(data[..., 2] * std2, cap)) * a_w * 0.5
+    h = jnp.exp(jnp.minimum(data[..., 3] * std3, cap)) * a_h * 0.5
+    return jnp.stack([x - w, y - h, x + w, y + h], axis=-1)
 
 
 @register("_contrib_bipartite_matching", num_inputs=1, num_outputs=2)
@@ -436,3 +436,60 @@ def bipartite_matching(dist, *, is_ascend=False, threshold=0.5,
                           if dist.dtype != jnp.float32 else dist,
                           rmatch0, cmatch0))
     return rmatch, cmatch
+
+
+@register("_contrib_PSROIPooling", num_inputs=2)
+def psroi_pooling(data, rois, *, spatial_scale=1.0, output_dim=0,
+                  pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling (parity:
+    mx.nd.contrib.PSROIPooling; reference
+    ``src/operator/contrib/psroi_pooling.cc`` — R-FCN's head).
+
+    data: (N, k*k*output_dim, H, W) position-sensitive score maps;
+    rois: (R, 5) rows [batch_idx, x1, y1, x2, y2] in image coords.
+    Output (R, output_dim, k, k): bin (i, j) average-pools its spatial
+    region from channel group ``(i*k + j)`` — every bin reads a
+    DIFFERENT channel slice, which is the position-sensitivity.
+    Static-shape: bins are averaged with a per-roi normalized mask
+    matmul over the full H, W extent (dense MXU work).
+    """
+    k = int(pooled_size)
+    gs = int(group_size) if group_size else k
+    if gs != k:
+        raise NotImplementedError("PSROIPooling: group_size != "
+                                  "pooled_size")
+    n, ctot, h, w = data.shape
+    od = int(output_dim) if output_dim else ctot // (k * k)
+
+    def one_roi(roi):
+        bidx = roi[0].astype("int32")
+        # reference rounds ROI coords BEFORE the scale
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3]) * spatial_scale
+        y2 = jnp.round(roi[4]) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / k, rh / k
+        # reference channel layout is output_dim-MAJOR:
+        # channel = (ctop*k + gh)*k + gw
+        img = data[bidx].reshape(od, k, k, h, w)
+
+        ys = jnp.arange(h, dtype=jnp.float32) + 0.5
+        xs = jnp.arange(w, dtype=jnp.float32) + 0.5
+        out = []
+        for i in range(k):          # static k: unrolled bin masks
+            for j in range(k):
+                y_lo, y_hi = y1 + i * bin_h, y1 + (i + 1) * bin_h
+                x_lo, x_hi = x1 + j * bin_w, x1 + (j + 1) * bin_w
+                my = ((ys >= jnp.floor(y_lo))
+                      & (ys < jnp.ceil(y_hi))).astype(data.dtype)
+                mx_ = ((xs >= jnp.floor(x_lo))
+                       & (xs < jnp.ceil(x_hi))).astype(data.dtype)
+                mask = my[:, None] * mx_[None, :]
+                denom = jnp.maximum(mask.sum(), 1.0)
+                grp = img[:, i, j]                # (od, h, w)
+                out.append((grp * mask).sum(axis=(1, 2)) / denom)
+        return jnp.stack(out, axis=-1).reshape(od, k, k)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))

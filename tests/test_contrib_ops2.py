@@ -137,3 +137,59 @@ def test_arange_like_repeat():
                                   [[0, 0, 1], [1, 2, 2]])
     a1 = nd.contrib.arange_like(nd.zeros((4, 2)), axis=0, repeat=2)
     np.testing.assert_array_equal(a1.asnumpy(), [0, 0, 1, 1])
+
+
+def test_psroi_pooling_position_sensitivity():
+    """Each output bin must read its OWN channel group: constant maps
+    with per-group values reproduce the group values per bin."""
+    k, od, h, w = 3, 2, 12, 12
+    # reference layout: channel = (ctop*k + gh)*k + gw (od-major)
+    data = np.zeros((1, k * k * od, h, w), "f4")
+    for c in range(od):
+        for gh in range(k):
+            for gw in range(k):
+                data[0, (c * k + gh) * k + gw] = (gh * k + gw) * 10 + c
+    rois = np.array([[0, 1.0, 1.0, 11.0, 11.0]], "f4")
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  output_dim=od, pooled_size=k)
+    assert out.shape == (1, od, k, k)
+    got = out.asnumpy()[0]
+    for i in range(k):
+        for j in range(k):
+            g = i * k + j
+            np.testing.assert_allclose(got[:, i, j],
+                                       [g * 10, g * 10 + 1],
+                                       rtol=1e-5)
+
+
+def test_psroi_pooling_spatial_average():
+    """A linear-in-y map pools to increasing bin means down the roi."""
+    k = 2
+    data = np.tile(np.arange(8, dtype="f4")[None, None, :, None],
+                   (1, k * k, 1, 8))
+    rois = np.array([[0, 0.0, 0.0, 8.0, 8.0]], "f4")
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  output_dim=1, pooled_size=k)
+    got = out.asnumpy()[0, 0]
+    assert got[0, 0] < got[1, 0]          # top bins < bottom bins
+    np.testing.assert_allclose(got[0, 0], data[0, 0, :4].mean(),
+                               rtol=1e-5)
+
+
+def test_boolean_mask_length_mismatch_raises():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    x = nd.array(np.arange(12, dtype="f4").reshape(4, 3))
+    with pytest.raises(MXNetError):
+        nd.contrib.boolean_mask(x, nd.array(np.ones(6, "f4")))
+
+
+def test_box_decode_clip_caps_growth_not_coords():
+    d = nd.array(np.array([[[0.0, 0.0, 100.0, 0.0]]], "f4"))
+    a = nd.array(np.array([[[10.0, 10.0, 20.0, 20.0]]], "f4"))
+    out = nd.contrib.box_decode(d, a, clip=1.0).asnumpy()[0, 0]
+    # width delta capped at exp(1.0): w_half = e * 10 * 0.5
+    import math
+    assert abs((out[2] - out[0]) - 2 * math.e * 10 * 0.5) < 1e-2
+    # coordinates themselves are NOT squashed into [0, clip]
+    assert out[2] > 1.0
