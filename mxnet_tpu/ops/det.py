@@ -493,3 +493,128 @@ def psroi_pooling(data, rois, *, spatial_scale=1.0, output_dim=0,
         return jnp.stack(out, axis=-1).reshape(od, k, k)
 
     return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register("_contrib_MultiProposal", num_inputs=3, num_outputs=2)
+def multi_proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+                   rpn_post_nms_top_n=300, threshold=0.7,
+                   rpn_min_size=16, scales=(4, 8, 16, 32),
+                   ratios=(0.5, 1, 2), feature_stride=16,
+                   output_score=False, iou_loss=False):
+    """RPN proposal generation (parity: mx.nd.contrib.MultiProposal /
+    Proposal; reference ``src/operator/contrib/multi_proposal.cc``).
+
+    ``iou_loss=True`` (the reference's corner-offset decode) is not
+    implemented and raises.  cls_prob (B, 2A, H, W) softmax scores
+    (bg first A, fg last A);
+    bbox_pred (B, 4A, H, W) anchor deltas; im_info (B, 3) rows
+    [height, width, scale].  Returns (B*post_nms, 5) rows
+    [batch_idx, x1, y1, x2, y2] (+ scores when ``output_score``) —
+    static shape: short batches pad with the last kept proposal, the
+    reference's own behaviour.
+    """
+    if iou_loss:
+        raise NotImplementedError(
+            "MultiProposal: iou_loss=True (IoUTransformInv decode) "
+            "is not implemented")
+    b, c2, h, w = cls_prob.shape
+    a = c2 // 2
+    base = float(feature_stride)
+
+    # exact reference base-anchor math (generate_anchors):
+    def _whctr(an):
+        return (an[2] - an[0] + 1, an[3] - an[1] + 1,
+                an[0] + 0.5 * (an[2] - an[0]),
+                an[1] + 0.5 * (an[3] - an[1]))
+
+    def _mkanchor(ws_, hs_, xc, yc):
+        return [xc - 0.5 * (ws_ - 1), yc - 0.5 * (hs_ - 1),
+                xc + 0.5 * (ws_ - 1), yc + 0.5 * (hs_ - 1)]
+
+    base_anchor = (0.0, 0.0, base - 1, base - 1)
+    w0, h0, xc, yc = _whctr(base_anchor)
+    rows = []
+    for r in ratios:
+        size = w0 * h0
+        ws_ = float(np.round(np.sqrt(size / r)))
+        hs_ = float(np.round(ws_ * r))
+        for s in scales:
+            rows.append(_mkanchor(ws_ * s, hs_ * s, xc, yc))
+    banch = jnp.asarray(rows, jnp.float32)            # (A, 4)
+
+    shift_x = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)           # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)     # (H, W, 4)
+    all_anchors = (shifts[:, :, None, :]
+                   + banch[None, None, :, :])         # (H, W, A, 4)
+    anchors_flat = all_anchors.reshape(-1, 4)         # (H*W*A, 4)
+
+    fg = cls_prob[:, a:].transpose(0, 2, 3, 1).reshape(b, -1)
+    deltas = bbox_pred.transpose(0, 2, 3, 1).reshape(b, -1, 4)
+
+    # decode with the Faster-RCNN coder (std=1, center form)
+    aw = anchors_flat[:, 2] - anchors_flat[:, 0] + 1.0
+    ah = anchors_flat[:, 3] - anchors_flat[:, 1] + 1.0
+    ax = anchors_flat[:, 0] + aw * 0.5
+    ay = anchors_flat[:, 1] + ah * 0.5
+    px = deltas[..., 0] * aw + ax
+    py = deltas[..., 1] * ah + ay
+    pw = jnp.exp(jnp.minimum(deltas[..., 2], 10.0)) * aw
+    ph = jnp.exp(jnp.minimum(deltas[..., 3], 10.0)) * ah
+    x1 = px - 0.5 * (pw - 1)
+    y1 = py - 0.5 * (ph - 1)
+    x2 = px + 0.5 * (pw - 1)
+    y2 = py + 0.5 * (ph - 1)
+
+    imh = im_info[:, 0][:, None]
+    imw = im_info[:, 1][:, None]
+    x1 = jnp.clip(x1, 0, imw - 1)
+    y1 = jnp.clip(y1, 0, imh - 1)
+    x2 = jnp.clip(x2, 0, imw - 1)
+    y2 = jnp.clip(y2, 0, imh - 1)
+    min_size = rpn_min_size * im_info[:, 2][:, None]
+    keep = ((x2 - x1 + 1 >= min_size)
+            & (y2 - y1 + 1 >= min_size))
+    scores = jnp.where(keep, fg, -1.0)
+
+    n_pre = min(int(rpn_pre_nms_top_n), scores.shape[1])
+    n_post = int(rpn_post_nms_top_n)
+    outs, out_scores = [], []
+    for bi in range(b):                      # static batch unroll
+        order = jnp.argsort(-scores[bi])[:n_pre]
+        rows_b = jnp.stack([scores[bi][order], x1[bi][order],
+                            y1[bi][order], x2[bi][order],
+                            y2[bi][order]], axis=-1)
+        # box_nms (same module) returns rows already sorted by
+        # descending score with suppressed rows as all -1 last
+        kept = box_nms(rows_b, overlap_thresh=threshold,
+                       valid_thresh=0.0, topk=-1, coord_start=1,
+                       score_index=0, id_index=-1,
+                       force_suppress=True)
+        sel = kept[:n_post]
+        if sel.shape[0] < n_post:      # fewer anchors than post_nms
+            sel = jnp.concatenate(
+                [sel, jnp.broadcast_to(
+                    sel[0], (n_post - sel.shape[0],) + sel.shape[1:])],
+                axis=0)
+        # pad short outputs by repeating the TOP proposal (reference
+        # pads with earlier valid proposals, never -1 garbage rows
+        # that would poison downstream ROI pooling)
+        invalid = sel[:, 0] <= 0
+        sel = jnp.where(invalid[:, None], sel[0][None, :], sel)
+        bcol = jnp.full((n_post, 1), float(bi), sel.dtype)
+        outs.append(jnp.concatenate([bcol, sel[:, 1:5]], axis=-1))
+        out_scores.append(sel[:, 0:1])
+    # registry outputs are static: ALWAYS (proposals, scores) — the
+    # reference's output_score flag only controls whether the second
+    # output is wired; here it is simply available
+    proposals = jnp.concatenate(outs, axis=0)
+    return proposals, jnp.concatenate(out_scores, axis=0)
+
+
+@register("_contrib_Proposal", num_inputs=3, num_outputs=2)
+def proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Single-image alias of :func:`multi_proposal` (reference
+    ``proposal.cc``)."""
+    return multi_proposal(cls_prob, bbox_pred, im_info, **kwargs)

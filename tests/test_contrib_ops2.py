@@ -193,3 +193,81 @@ def test_box_decode_clip_caps_growth_not_coords():
     assert abs((out[2] - out[0]) - 2 * math.e * 10 * 0.5) < 1e-2
     # coordinates themselves are NOT squashed into [0, clip]
     assert out[2] > 1.0
+
+
+def test_multi_proposal_recovers_planted_object():
+    """A strong fg score at one anchor with zero deltas must yield a
+    top proposal at that anchor's location."""
+    rng = np.random.RandomState(0)
+    h = w = 8
+    stride = 16
+    ratios, scales = (1.0,), (2.0,)   # 32px boxes stay unclipped
+    a = len(ratios) * len(scales)
+    cls = np.full((1, 2 * a, h, w), 0.1, "f4")
+    cls[0, a + 0, 4, 3] = 0.99            # fg anchor at cell (4, 3)
+    bbox = np.zeros((1, 4 * a, h, w), "f4")
+    im_info = np.array([[128.0, 128.0, 1.0]], "f4")
+    props, scores = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=32, rpn_post_nms_top_n=5,
+        ratios=ratios, scales=scales, feature_stride=stride,
+        rpn_min_size=1)
+    p = props.asnumpy()
+    s = scores.asnumpy()
+    assert p.shape == (5, 5) and s.shape == (5, 1)
+    assert abs(s[0, 0] - 0.99) < 1e-5
+    # top proposal centered at the planted cell (x=3*16+7.5, y=4*16+7.5)
+    cx = (p[0, 1] + p[0, 3]) / 2
+    cy = (p[0, 2] + p[0, 4]) / 2
+    assert abs(cx - (3 * stride + 7.5)) < 1.0, p[0]
+    assert abs(cy - (4 * stride + 7.5)) < 1.0, p[0]
+    # boxes clipped into the image
+    assert (p[:, 1:] >= 0).all() and (p[:, 1:] <= 127).all()
+
+
+def test_multi_proposal_deltas_shift_box():
+    h = w = 4
+    a = 1
+    cls = np.full((1, 2, h, w), 0.1, "f4")
+    cls[0, 1, 2, 2] = 0.95
+    bbox = np.zeros((1, 4, h, w), "f4")
+    bbox[0, 0, 2, 2] = 0.25               # dx shifts center right
+    im_info = np.array([[256.0, 256.0, 1.0]], "f4")
+    p0, _ = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(np.zeros_like(bbox)),
+        nd.array(im_info), rpn_post_nms_top_n=1, ratios=(1.0,),
+        scales=(8.0,), rpn_min_size=1)
+    p1, _ = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_post_nms_top_n=1, ratios=(1.0,), scales=(8.0,),
+        rpn_min_size=1)
+    c0 = (p0.asnumpy()[0, 1] + p0.asnumpy()[0, 3]) / 2
+    c1 = (p1.asnumpy()[0, 1] + p1.asnumpy()[0, 3]) / 2
+    assert c1 > c0                         # shifted right
+
+
+def test_multi_proposal_pads_with_valid_rows():
+    """Fewer NMS survivors than post_nms must repeat valid proposals,
+    never emit -1 garbage boxes."""
+    cls = np.full((1, 2, 2, 2), 0.1, "f4")
+    cls[0, 1, 0, 0] = 0.9
+    bbox = np.zeros((1, 4, 2, 2), "f4")
+    im_info = np.array([[64.0, 64.0, 1.0]], "f4")
+    props, scores = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_post_nms_top_n=10, ratios=(1.0,), scales=(2.0,),
+        rpn_min_size=1, threshold=0.3)
+    p = props.asnumpy()
+    assert p.shape == (10, 5)
+    assert (p[:, 1:] >= 0).all(), p
+    assert (scores.asnumpy() > 0).all()
+
+
+def test_multi_proposal_iou_loss_raises():
+    import pytest
+    cls = np.full((1, 2, 2, 2), 0.5, "f4")
+    with pytest.raises(Exception):
+        nd.contrib.MultiProposal(
+            nd.array(cls), nd.array(np.zeros((1, 4, 2, 2), "f4")),
+            nd.array(np.array([[64., 64., 1.]], "f4")),
+            iou_loss=True)
