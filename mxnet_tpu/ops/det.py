@@ -510,8 +510,9 @@ def multi_proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
     bbox_pred (B, 4A, H, W) anchor deltas; im_info (B, 3) rows
     [height, width, scale].  Returns (B*post_nms, 5) rows
     [batch_idx, x1, y1, x2, y2] (+ scores when ``output_score``) —
-    static shape: short batches pad with the last kept proposal, the
-    reference's own behaviour.
+    static shape: images with fewer NMS survivors than ``post_nms``
+    pad by repeating their top proposal (whole-image box at score 0
+    when nothing survives the min-size filter).
     """
     if iou_loss:
         raise NotImplementedError(
@@ -578,43 +579,79 @@ def multi_proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
             & (y2 - y1 + 1 >= min_size))
     scores = jnp.where(keep, fg, -1.0)
 
-    n_pre = min(int(rpn_pre_nms_top_n), scores.shape[1])
-    n_post = int(rpn_post_nms_top_n)
-    outs, out_scores = [], []
-    for bi in range(b):                      # static batch unroll
-        order = jnp.argsort(-scores[bi])[:n_pre]
-        rows_b = jnp.stack([scores[bi][order], x1[bi][order],
-                            y1[bi][order], x2[bi][order],
-                            y2[bi][order]], axis=-1)
-        # box_nms (same module) returns rows already sorted by
-        # descending score with suppressed rows as all -1 last
-        kept = box_nms(rows_b, overlap_thresh=threshold,
-                       valid_thresh=0.0, topk=-1, coord_start=1,
-                       score_index=0, id_index=-1,
-                       force_suppress=True)
-        sel = kept[:n_post]
-        if sel.shape[0] < n_post:      # fewer anchors than post_nms
-            sel = jnp.concatenate(
-                [sel, jnp.broadcast_to(
-                    sel[0], (n_post - sel.shape[0],) + sel.shape[1:])],
-                axis=0)
-        # pad short outputs by repeating the TOP proposal (reference
-        # pads with earlier valid proposals, never -1 garbage rows
-        # that would poison downstream ROI pooling)
-        invalid = sel[:, 0] <= 0
-        sel = jnp.where(invalid[:, None], sel[0][None, :], sel)
-        bcol = jnp.full((n_post, 1), float(bi), sel.dtype)
-        outs.append(jnp.concatenate([bcol, sel[:, 1:5]], axis=-1))
-        out_scores.append(sel[:, 0:1])
-    # registry outputs are static: ALWAYS (proposals, scores) — the
-    # reference's output_score flag only controls whether the second
-    # output is wired; here it is simply available
-    proposals = jnp.concatenate(outs, axis=0)
-    return proposals, jnp.concatenate(out_scores, axis=0)
+    n_all = scores.shape[1]
+    # reference semantics: top_n <= 0 means "keep everything"
+    n_pre = n_all if int(rpn_pre_nms_top_n) <= 0 \
+        else min(int(rpn_pre_nms_top_n), n_all)
+    n_post = n_pre if int(rpn_post_nms_top_n) <= 0 \
+        else int(rpn_post_nms_top_n)
+
+    # batched pre-NMS top-k, then ONE vmapped box_nms call (it vmaps
+    # over leading batch dims) instead of a per-image traced loop
+    order = jnp.argsort(-scores, axis=1)[:, :n_pre]     # (B, n_pre)
+    take = lambda v: jnp.take_along_axis(v, order, axis=1)
+    rows = jnp.stack([take(scores), take(x1), take(y1), take(x2),
+                      take(y2)], axis=-1)               # (B, n_pre, 5)
+    kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                   topk=-1, coord_start=1, score_index=0, id_index=-1,
+                   force_suppress=True)
+    # box_nms suppresses IN PLACE (rows become -1 at their sorted
+    # position) — COMPACT the survivors to the front before the
+    # static n_post window, or scattered survivors past n_post are
+    # lost and replaced by duplicates (recall collapse)
+    valid = kept[:, :, 0] > 0
+    comp = jnp.argsort(~valid, axis=1, stable=True)     # valid first
+    kept = jnp.take_along_axis(kept, comp[:, :, None], axis=1)
+    valid = jnp.take_along_axis(valid, comp, axis=1)
+
+    if kept.shape[1] < n_post:
+        pad_n = n_post - kept.shape[1]
+        kept = jnp.concatenate(
+            [kept, jnp.broadcast_to(kept[:, :1],
+                                    (b, pad_n, 5))], axis=1)
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((b, pad_n), bool)], axis=1)
+    sel = kept[:, :n_post]
+    valid = valid[:, :n_post]
+    # pad invalid tail rows with the image's TOP proposal; when an
+    # image has NO valid proposal (everything min-size-filtered), fall
+    # back to the whole-image box at score 0 — never -1 garbage that
+    # poisons downstream ROI pooling
+    top = sel[:, :1]
+    whole = jnp.stack(
+        [jnp.zeros((b,)), jnp.zeros((b,)), jnp.zeros((b,)),
+         imw[:, 0] - 1, imh[:, 0] - 1], axis=-1)[:, None]  # (B,1,5)
+    any_valid = valid.any(axis=1)[:, None, None]
+    fill = jnp.where(any_valid, top, whole.astype(sel.dtype))
+    sel = jnp.where(valid[:, :, None], sel, fill)
+
+    bcol = jnp.broadcast_to(
+        jnp.arange(b, dtype=sel.dtype)[:, None, None], (b, n_post, 1))
+    proposals = jnp.concatenate([bcol, sel[:, :, 1:5]],
+                                axis=-1).reshape(b * n_post, 5)
+    out_scores = jnp.maximum(sel[:, :, 0:1],
+                             0.0).reshape(b * n_post, 1)
+    return proposals, out_scores
 
 
-@register("_contrib_Proposal", num_inputs=3, num_outputs=2)
-def proposal(cls_prob, bbox_pred, im_info, **kwargs):
-    """Single-image alias of :func:`multi_proposal` (reference
-    ``proposal.cc``)."""
-    return multi_proposal(cls_prob, bbox_pred, im_info, **kwargs)
+@register("_contrib_Proposal", num_inputs=3)
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """Single-output RPN proposals (reference ``proposal.cc``; the
+    commonly ported name).  Returns the (B*post_nms, 5) proposals
+    NDArray directly like the reference's default; callers needing
+    scores use MultiProposal (whose second output is always wired
+    here — the registry has static output counts)."""
+    if output_score:
+        raise NotImplementedError(
+            "Proposal: output_score=True — use MultiProposal, whose "
+            "scores output is always available")
+    props, _ = multi_proposal(
+        cls_prob, bbox_pred, im_info,
+        rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+        rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=scales, ratios=ratios,
+        feature_stride=feature_stride, iou_loss=iou_loss)
+    return props

@@ -728,6 +728,54 @@ def _deform_bilinear(data_g, y, x):
             + corner(y0 + 1, x0 + 1) * wy * wx)
 
 
+def _deform_conv_impl(data, offset, weight, rest, mask, kernel,
+                      stride, dilate, pad, num_group,
+                      num_deformable_group, no_bias):
+    """Shared v1/v2 deformable-conv body: build the sampled patches
+    tensor with vectorized corner gathers (optionally modulated by a
+    per-tap mask) and reduce via one grouped einsum."""
+    kh, kw = kernel
+    sh, sw = tuple(stride) if stride else (1, 1)
+    dh, dw = tuple(dilate) if dilate else (1, 1)
+    ph, pw = tuple(pad) if pad else (0, 0)
+    b, c, h, w = data.shape
+    dg = num_deformable_group
+    K = kh * kw
+    ho = (h + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
+    wo = (w + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+
+    ys = jnp.arange(ho) * sh - ph
+    xs = jnp.arange(wo) * sw - pw
+    ry = jnp.repeat(jnp.arange(kh) * dh, kw)
+    rx = jnp.tile(jnp.arange(kw) * dw, kh)
+    base_y = ry[:, None, None] + ys[None, :, None]
+    base_x = rx[:, None, None] + xs[None, None, :]
+
+    off = offset.reshape(b, dg, K, 2, ho, wo)
+    y = base_y[None, None] + off[:, :, :, 0]
+    x = base_x[None, None] + off[:, :, :, 1]
+
+    data_g = data.reshape(b, dg, c // dg, h, w)
+    patches = _deform_bilinear(data_g.astype(jnp.float32),
+                               y.astype(jnp.float32),
+                               x.astype(jnp.float32))
+    if mask is not None:
+        mod = mask.reshape(b, dg, 1, K, ho, wo).astype(jnp.float32)
+        patches = patches * mod
+    patches = patches.reshape(b, c, K, ho, wo).astype(data.dtype)
+
+    ng = num_group
+    o = weight.shape[0]
+    wt = weight.reshape(ng, o // ng, c // ng, K)
+    pg = patches.reshape(b, ng, c // ng, K, ho, wo)
+    out = jnp.einsum("bgckhw,gock->bgohw", pg, wt,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(b, o, ho, wo).astype(data.dtype)
+    if not no_bias:
+        out = out + jnp.reshape(rest[0], (1, -1, 1, 1))
+    return out
+
+
 @register("_contrib_DeformableConvolution", num_inputs=None)
 def deformable_convolution(data, offset, weight, *rest, kernel=(),
                            stride=(), dilate=(), pad=(), num_filter=0,
@@ -745,41 +793,24 @@ def deformable_convolution(data, offset, weight, *rest, kernel=(),
     (B, 2*dg*kh*kw, Ho, Wo), pairs ordered (y, x) per tap, taps
     row-major, per deformable group.
     """
-    kh, kw = kernel
-    sh, sw = tuple(stride) if stride else (1, 1)
-    dh, dw = tuple(dilate) if dilate else (1, 1)
-    ph, pw = tuple(pad) if pad else (0, 0)
-    b, c, h, w = data.shape
-    dg = num_deformable_group
-    K = kh * kw
-    ho = (h + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
-    wo = (w + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+    return _deform_conv_impl(data, offset, weight, rest, None, kernel,
+                             stride, dilate, pad, num_group,
+                             num_deformable_group, no_bias)
 
-    # base sampling grid per tap: (K, Ho, Wo)
-    ys = jnp.arange(ho) * sh - ph                      # (Ho,)
-    xs = jnp.arange(wo) * sw - pw
-    ry = jnp.repeat(jnp.arange(kh) * dh, kw)           # (K,)
-    rx = jnp.tile(jnp.arange(kw) * dw, kh)
-    base_y = ry[:, None, None] + ys[None, :, None]     # (K, Ho, 1)
-    base_x = rx[:, None, None] + xs[None, None, :]     # (K, 1, Wo)
 
-    off = offset.reshape(b, dg, K, 2, ho, wo)
-    y = base_y[None, None] + off[:, :, :, 0]           # (B,dg,K,Ho,Wo)
-    x = base_x[None, None] + off[:, :, :, 1]
-
-    data_g = data.reshape(b, dg, c // dg, h, w)
-    patches = _deform_bilinear(data_g.astype(jnp.float32),
-                               y.astype(jnp.float32),
-                               x.astype(jnp.float32))
-    patches = patches.reshape(b, c, K, ho, wo).astype(data.dtype)
-
-    ng = num_group
-    o = weight.shape[0]
-    wt = weight.reshape(ng, o // ng, c // ng, K)
-    pg = patches.reshape(b, ng, c // ng, K, ho, wo)
-    out = jnp.einsum("bgckhw,gock->bgohw", pg, wt,
-                     preferred_element_type=jnp.float32)
-    out = out.reshape(b, o, ho, wo).astype(data.dtype)
-    if not no_bias:
-        out = out + jnp.reshape(rest[0], (1, -1, 1, 1))
-    return out
+@register("_contrib_ModulatedDeformableConvolution", num_inputs=None)
+def modulated_deformable_convolution(data, offset, mask, weight, *rest,
+                                     kernel=(), stride=(), dilate=(),
+                                     pad=(), num_filter=0, num_group=1,
+                                     num_deformable_group=1,
+                                     no_bias=False, workspace=0,
+                                     layout=None):
+    """Deformable convolution v2 (reference:
+    ``src/operator/contrib/modulated_deformable_convolution.cc``):
+    v1's learned offsets plus a per-tap modulation MASK (the mask
+    input is already post-sigmoid in the reference op) scaling every
+    sampled value.  mask: (B, dg*kh*kw, Ho, Wo); everything else
+    matches ``_contrib_DeformableConvolution`` (shared body)."""
+    return _deform_conv_impl(data, offset, weight, rest, mask, kernel,
+                             stride, dilate, pad, num_group,
+                             num_deformable_group, no_bias)

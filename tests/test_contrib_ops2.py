@@ -271,3 +271,53 @@ def test_multi_proposal_iou_loss_raises():
             nd.array(cls), nd.array(np.zeros((1, 4, 2, 2), "f4")),
             nd.array(np.array([[64., 64., 1.]], "f4")),
             iou_loss=True)
+
+
+def test_multi_proposal_compacts_scattered_survivors():
+    """Survivors ranked past the post-NMS window must still be kept:
+    many overlapping high-score anchors (suppressed in place by NMS)
+    must not displace distinct lower-score survivors."""
+    h = w = 6
+    a = 1
+    cls = np.full((1, 2 * a, h, w), 0.01, "f4")
+    # a 3x3 block of near-identical high scores (mutually suppressed)
+    cls[0, 1, 0:3, 0:3] = 0.9
+    # three isolated lower-score objects far away
+    cls[0, 1, 5, 0] = 0.5
+    cls[0, 1, 5, 3] = 0.45
+    cls[0, 1, 0, 5] = 0.4
+    bbox = np.zeros((1, 4 * a, h, w), "f4")
+    im_info = np.array([[96.0, 96.0, 1.0]], "f4")
+    # scale 4 -> 64px boxes: neighboring-cell IoU 0.6 > threshold, so
+    # the 0.9 block mutually suppresses down to a couple of survivors
+    props, scores = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(bbox), nd.array(im_info),
+        rpn_post_nms_top_n=4, ratios=(1.0,), scales=(4.0,),
+        rpn_min_size=1, threshold=0.3)
+    s = scores.asnumpy().ravel()
+    # the distinct lower-score survivors appear, not top-box copies
+    assert (np.abs(s - 0.5) < 1e-4).any(), s
+    assert (np.abs(s - 0.45) < 1e-4).any(), s
+    uniq = np.unique(np.round(props.asnumpy()[:, 1:], 2), axis=0)
+    assert uniq.shape[0] >= 3, props.asnumpy()
+
+
+def test_proposal_single_output():
+    cls = np.full((1, 2, 4, 4), 0.3, "f4")
+    out = nd.contrib.Proposal(
+        nd.array(cls), nd.array(np.zeros((1, 4, 4, 4), "f4")),
+        nd.array(np.array([[64., 64., 1.]], "f4")),
+        rpn_post_nms_top_n=6, ratios=(1.0,), scales=(2.0,),
+        rpn_min_size=1)
+    assert not isinstance(out, (list, tuple))
+    assert out.shape == (6, 5)
+
+
+def test_multi_proposal_keep_all_flags():
+    cls = np.full((1, 2, 4, 4), 0.3, "f4")
+    props, _ = nd.contrib.MultiProposal(
+        nd.array(cls), nd.array(np.zeros((1, 4, 4, 4), "f4")),
+        nd.array(np.array([[64., 64., 1.]], "f4")),
+        rpn_pre_nms_top_n=-1, rpn_post_nms_top_n=-1, ratios=(1.0,),
+        scales=(2.0,), rpn_min_size=1)
+    assert props.shape == (16, 5)       # all 4*4 anchors kept

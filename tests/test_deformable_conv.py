@@ -140,3 +140,45 @@ class TestDeformableLayer:
         hybrid = net(x).asnumpy()
         np.testing.assert_allclose(eager, hybrid, rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestModulatedDeformableOp:
+    def test_all_ones_mask_equals_v1(self):
+        x = _rand((1, 4, 8, 8))
+        w = _rand((3, 4, 3, 3), seed=1, scale=0.3)
+        off = nd.array(np.random.RandomState(2).uniform(
+            -0.3, 0.3, (1, 18, 6, 6)).astype("f4"))
+        ones = nd.ones((1, 9, 6, 6))
+        got = nd.contrib.ModulatedDeformableConvolution(
+            x, off, ones, w, kernel=(3, 3), num_filter=3,
+            no_bias=True)
+        want = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(3, 3), num_filter=3, no_bias=True)
+        np.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_zero_mask_zeroes_output(self):
+        x = _rand((1, 2, 6, 6))
+        w = _rand((2, 2, 3, 3), seed=3, scale=0.3)
+        off = nd.zeros((1, 18, 4, 4))
+        zeros = nd.zeros((1, 9, 4, 4))
+        out = nd.contrib.ModulatedDeformableConvolution(
+            x, off, zeros, w, kernel=(3, 3), num_filter=2,
+            no_bias=True)
+        np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-7)
+
+    def test_grads_flow_to_mask(self):
+        from mxnet_tpu import autograd
+        x = _rand((1, 2, 6, 6))
+        w = _rand((2, 2, 3, 3), seed=4, scale=0.3)
+        off = nd.zeros((1, 18, 4, 4))
+        m = nd.array(np.random.RandomState(5).uniform(
+            0.2, 0.8, (1, 9, 4, 4)).astype("f4"))
+        m.attach_grad()
+        with autograd.record():
+            out = nd.contrib.ModulatedDeformableConvolution(
+                x, off, m, w, kernel=(3, 3), num_filter=2,
+                no_bias=True)
+            loss = (out * out).sum()
+        loss.backward()
+        assert np.abs(m.grad.asnumpy()).max() > 0
