@@ -123,5 +123,7 @@ _CUSTOM = {"Dropout": Dropout, "BatchNorm": BatchNorm, "RNN": RNN,
 
 _generate(_mod)
 
+from . import contrib  # noqa: E402  (mirrors nd.contrib resolution)
+
 __all__ = ["Symbol", "Executor", "var", "Variable", "Group", "load",
-           "load_json"]
+           "load_json", "contrib"]

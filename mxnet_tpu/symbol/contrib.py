@@ -1,0 +1,25 @@
+"""``mx.sym.contrib`` — contrib namespace over symbol op wrappers
+(parity: reference ``python/mxnet/symbol/contrib.py``).
+
+Mirrors ``nd.contrib``'s resolution: plain names fall through to the
+symbol module's generated wrappers; ops registered only under a
+``_contrib_`` name (DeformableConvolution, MultiProposal, ...)
+resolve through the prefixed registry entry.  Control-flow ops
+(foreach/while_loop/cond) stay on the nd side — hybridized blocks
+trace through nd, which is where those higher-order ops live.
+"""
+from __future__ import annotations
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    from .. import symbol as _sym
+    try:
+        return getattr(_sym, name)
+    except AttributeError:
+        pass
+    prefixed = getattr(_sym, f"_contrib_{name}", None)
+    if prefixed is not None:
+        return prefixed
+    raise AttributeError(name)

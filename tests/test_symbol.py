@@ -1,0 +1,18 @@
+"""Symbol namespace tests (sym.contrib resolution — parity:
+reference python/mxnet/symbol/contrib.py; graph-level symbol
+coverage lives in test_symbol_module.py)."""
+def test_sym_contrib_namespace():
+    """mx.sym.contrib mirrors nd.contrib (plain + _contrib_ names),
+    and a contrib op builds + binds in a symbol graph."""
+    import numpy as np
+    import mxnet_tpu as mx
+    lhs = mx.sym.var("lhs")
+    rhs = mx.sym.var("rhs")
+    iou = mx.sym.contrib.box_iou(lhs, rhs)
+    ex = iou.bind(mx.cpu(), {
+        "lhs": mx.nd.array(np.array([[0., 0., 2., 2.]], "f4")),
+        "rhs": mx.nd.array(np.array([[1., 1., 3., 3.]], "f4"))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [[1.0 / 7.0]], rtol=1e-5)
+    assert mx.sym.contrib.DeformableConvolution is not None
+    assert mx.sym.contrib.MultiProposal is not None
