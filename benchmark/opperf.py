@@ -24,6 +24,11 @@ host backend).  Prints one JSON line per op and a trailing summary line.
 """
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
 import argparse
 import json
 import sys
