@@ -36,7 +36,7 @@ def bench_allreduce(sizes_mb, iters=10):
     devs = jax.devices()
     n = len(devs)
     mesh = parallel.make_mesh({"dp": n}, devices=devs)
-    ctxs = [mx.Context("tpu" if devs[0].platform == "tpu" else "cpu", i)
+    ctxs = [mx.Context("tpu" if devs[0].platform != "cpu" else "cpu", i)
             for i in range(n)]
 
     rows = []

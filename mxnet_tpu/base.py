@@ -9,11 +9,31 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "numeric_types", "string_types", "integer_types"]
+__all__ = ["MXNetError", "numeric_types", "string_types", "integer_types",
+           "on_accelerator"]
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+def on_accelerator() -> bool:
+    """True when jax's default backend is the TPU chip.
+
+    Experimental PJRT plugins register their platform under their OWN
+    name — the axon tunnel has shown up as ``"axon"`` in some sessions
+    and ``"tpu"`` in others — so TPU gates must never string-match
+    ``== "tpu"`` (that silently turned the flash kernels off for a
+    whole session).  A denylist of platforms KNOWN not to be a TPU
+    keeps unknown plugin spellings on the TPU path without enabling
+    Mosaic kernels on e.g. a CUDA backend.
+    """
+    import jax
+    try:
+        return jax.default_backend() not in (
+            "cpu", "gpu", "cuda", "rocm", "metal")
+    except Exception:
+        return False
 
 
 numeric_types = (float, int, np.generic)

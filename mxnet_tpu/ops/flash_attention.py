@@ -30,6 +30,20 @@ _BLOCK_Q = 128
 _BLOCK_K = 128
 _LANE = 128  # TPU lane width: head_dim is zero-padded up to this
 
+
+def _blocks(s_q, s_k):
+    """(block_q, block_k) for this launch: env-tunable so the on-chip
+    attention bench can sweep backward block sizes (the s>=1024 dq/dkv
+    perf lever, VERDICT r3 #4) without rebuilding; clamped back to 128
+    when they don't divide the (128-aligned) sequence lengths."""
+    bq = int(os.environ.get("MXTPU_FLASH_BLOCK_Q", _BLOCK_Q))
+    bk = int(os.environ.get("MXTPU_FLASH_BLOCK_K", _BLOCK_K))
+    if bq <= 0 or s_q % bq:
+        bq = _BLOCK_Q
+    if bk <= 0 or s_k % bk:
+        bk = _BLOCK_K
+    return bq, bk
+
 # interpret mode runs the kernel on the Pallas interpreter (any backend)
 # — used by the CPU test suite; toggled via tests or MXTPU_FLASH_INTERPRET
 _INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
@@ -67,41 +81,69 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     block_q, d = q.shape
     block_k = k.shape[0]
 
-    # operands stay in the input dtype (bf16 on the AMP path) so the
-    # MXU runs at native rate; preferred_element_type keeps the
-    # ACCUMULATOR f32 either way.  f32 inputs take the f32 pass —
-    # precision the interpret-mode oracle tests rely on.
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-    if causal:
-        # end-aligned like the XLA oracle's tril(k=s_k-s_q): query i may
-        # attend keys up to i + (s_k - s_q), so cross-attention with
-        # s_k != s_q masks identically on both paths
-        q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos + np.int32(causal_offset) >= k_pos, s, -1e30)
-    if with_kmask:
-        # key-padding mask row for this (batch, k-block): True = keep
-        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
+    def _accum():
+        # operands stay in the input dtype (bf16 on the AMP path) so
+        # the MXU runs at native rate; preferred_element_type keeps the
+        # ACCUMULATOR f32 either way.  f32 inputs pin Precision.HIGHEST
+        # explicitly: without it XLA's DEFAULT runs f32 matmuls at bf16
+        # operand precision on TPU, making kernel numerics depend on the
+        # ambient jax.default_matmul_precision context (the r3 on-chip
+        # failures, bench_logs/r3/on_tpu_pytest.log).  Contract: f32 in
+        # → f32-grade math, bf16 in → MXU-native ops + f32 accumulate.
+        prec = (None if q.dtype == jnp.bfloat16
+                else jax.lax.Precision.HIGHEST)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=prec) * scale
+        if causal:
+            # end-aligned like the XLA oracle's tril(k=s_k-s_q): query
+            # i may attend keys up to i + (s_k - s_q), so
+            # cross-attention with s_k != s_q masks identically
+            q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + np.int32(causal_offset) >= k_pos, s,
+                          -1e30)
+        if with_kmask:
+            # key-padding mask row for this (batch, k-block): keep=True
+            s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
 
-    # m/l scratch is (block_q, 128): TPU vector stores need a full lane
-    # dim; value is replicated across lanes, column 0 is authoritative
-    m = m_scr[...][:, :1]
-    l = l_scr[...][:, :1]
-    acc = acc_scr[...]
-    m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    lanes = m_scr.shape[1]
-    m_scr[...] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
-    l_new = alpha * l + p.sum(axis=1, keepdims=True)
-    l_scr[...] = jnp.broadcast_to(l_new, (l_new.shape[0], lanes))
-    # P rides the MXU in the value dtype when v is low-precision (what
-    # the bf16 XLA oracle does too); f32 v keeps the f32 pass
-    p_op = p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p
-    acc_scr[...] = alpha * acc + jnp.dot(
-        p_op, v, preferred_element_type=jnp.float32)
+        # m/l scratch is (block_q, 128): TPU vector stores need a full
+        # lane dim; value is replicated, column 0 is authoritative
+        m = m_scr[...][:, :1]
+        l = l_scr[...][:, :1]
+        acc = acc_scr[...]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        lanes = m_scr.shape[1]
+        m_scr[...] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
+        l_new = alpha * l + p.sum(axis=1, keepdims=True)
+        l_scr[...] = jnp.broadcast_to(l_new, (l_new.shape[0], lanes))
+        # P rides the MXU in the value dtype when v is low-precision
+        # (what the bf16 XLA oracle does too); f32 v keeps the f32 pass
+        p_op = p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p
+        acc_scr[...] = alpha * acc + jnp.dot(
+            p_op, v, preferred_element_type=jnp.float32, precision=prec)
+
+    if causal and causal_offset >= 0:
+        # block-level causal skip: a k-block whose FIRST key is beyond
+        # the last query this q-block may attend is entirely masked —
+        # skip its matmuls (≈2x less MXU work over the full grid, the
+        # long-seq causal perf lever).  With offset >= 0 this is
+        # EXACTLY the old math: kb=0 is always visible, so by the time
+        # a skipped block would run, m is finite and its contribution
+        # was p = exp(-1e30 - m) = 0, alpha = 1 — a no-op.  offset < 0
+        # (causal cross-attention, s_q > s_k) keeps the full grid:
+        # there a whole q-block can attend zero keys and skipping it
+        # would leave l = 0 → 0/0 NaN where the oracle emits uniform
+        # rows.
+        visible = (q_idx * np.int32(block_q)
+                   + np.int32(block_q - 1 + causal_offset)
+                   >= kb * np.int32(block_k))
+        pl.when(visible)(_accum)
+    else:
+        _accum()
 
     @pl.when(kb == num_k_blocks - 1)
     def _done():
@@ -113,16 +155,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
-def _blocked_specs(d):
+def _blocked_specs(d, bq=_BLOCK_Q, bk=_BLOCK_K):
     from jax.experimental import pallas as pl
 
     # NOTE on index maps: with jax_enable_x64 a literal `0` in an index
     # map becomes i64 and Mosaic rejects the mixed (i32, i64) signature;
     # `i - i` keeps everything i32 regardless of the x64 flag.
     zero = lambda i: i - i
-    q_spec = pl.BlockSpec((None, _BLOCK_Q, d),
+    q_spec = pl.BlockSpec((None, bq, d),
                           lambda i, j, kb: (i, j, zero(i)))
-    k_spec = pl.BlockSpec((None, _BLOCK_K, d),
+    k_spec = pl.BlockSpec((None, bk, d),
                           lambda i, j, kb: (i, kb, zero(i)))
     return zero, q_spec, k_spec
 
@@ -134,15 +176,15 @@ def _kmask_rows(kmask, s_k):
     return jnp.broadcast_to(m, (m.shape[0], 8, s_k))
 
 
-def _kmask_spec(h, kb_in_dim2=True):
+def _kmask_spec(h, kb_in_dim2=True, bk=_BLOCK_K):
     from jax.experimental import pallas as pl
 
     # grid dim 0 is b*h: batch index = i // h (static closure over h).
     # The k-block rides grid dim 2 (fwd, dq) or dim 1 (dkv).
     if kb_in_dim2:
-        return pl.BlockSpec((None, 8, _BLOCK_K),
+        return pl.BlockSpec((None, 8, bk),
                             lambda i, j, kb: (i // h, j - j, kb))
-    return pl.BlockSpec((None, 8, _BLOCK_K),
+    return pl.BlockSpec((None, 8, bk),
                         lambda i, kb, j: (i // h, j - j, kb))
 
 
@@ -182,20 +224,21 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
     kf = _fold(k, b, h, s_k, d)
     vf = _fold(v, b, h, s_k, d)
 
-    num_k_blocks = s_k // _BLOCK_K
-    grid = (b * h, s_q // _BLOCK_Q, num_k_blocks)
+    bq, bk = _blocks(s_q, s_k)
+    num_k_blocks = s_k // bk
+    grid = (b * h, s_q // bq, num_k_blocks)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_k_blocks=num_k_blocks,
                                causal_offset=s_k - s_q,
                                emit_lse=want_lse,
                                with_kmask=kmask is not None)
-    zero, q_spec, k_spec = _blocked_specs(d)
-    lse_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+    zero, q_spec, k_spec = _blocked_specs(d, bq, bk)
+    lse_spec = pl.BlockSpec((None, bq, _LANE),
                             lambda i, j, kb: (i, j, zero(i)))
     in_specs = [q_spec, k_spec, k_spec]
     inputs = [qf, kf, vf]
     if kmask is not None:
-        in_specs.append(_kmask_spec(h))
+        in_specs.append(_kmask_spec(h, bk=bk))
         inputs.append(_kmask_rows(kmask, s_k))
     out_specs = [q_spec, lse_spec] if want_lse else q_spec
     out_shape = [jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
@@ -207,9 +250,9 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
         out_specs=out_specs,
         out_shape=out_shape if want_lse else out_shape[0],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, 128), jnp.float32),
-            pltpu.VMEM((_BLOCK_Q, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=_INTERPRET,
     )(*inputs)
@@ -243,29 +286,50 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
     block_q, _ = q.shape
     block_k = k.shape[0]
     lowp = q.dtype == jnp.bfloat16
+    # same precision contract as the forward: f32 inputs pin HIGHEST
+    prec = None if lowp else jax.lax.Precision.HIGHEST
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    def _accum():
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=prec) * scale
+        mask = None
+        if causal:
+            q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos + np.int32(causal_offset) >= k_pos
+            s_m = jnp.where(mask, s, -1e30)
+        else:
+            s_m = s
+        if with_kmask:
+            s_m = jnp.where(kmask_ref[...][:1] > 0, s_m, -1e30)
+        p = jnp.exp(s_m - lse)
+        if causal:
+            # explicit zero (not exp of a huge negative) so fully-masked
+            # rows contribute NO gradient instead of fp32-rounding noise
+            p = jnp.where(mask, p, 0.0)
+        if with_kmask:
+            p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32,
+                     precision=prec)
+        ds = p * (dp - delta.astype(jnp.float32))
+        ds_op = ds.astype(jnp.bfloat16) if lowp else ds
+        dq_scr[...] += jnp.dot(ds_op, k,
+                               preferred_element_type=jnp.float32,
+                               precision=prec) * scale
+
     if causal:
-        q_pos = q_idx * np.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = q_pos + np.int32(causal_offset) >= k_pos
-        s = jnp.where(mask, s, -1e30)
-    if with_kmask:
-        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
-    p = jnp.exp(s - lse)
-    if causal:
-        # explicit zero (not exp of a huge negative) so fully-masked
-        # rows contribute NO gradient instead of fp32-rounding noise
-        p = jnp.where(mask, p, 0.0)
-    if with_kmask:
-        p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
-    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta.astype(jnp.float32))
-    ds_op = ds.astype(jnp.bfloat16) if lowp else ds
-    dq_scr[...] += jnp.dot(ds_op, k,
-                           preferred_element_type=jnp.float32) * scale
+        # skip k-blocks this q-block cannot attend.  Safe for ANY
+        # causal_offset (unlike the forward): a skipped block's
+        # contribution was exactly zero — p is hard-zeroed by the
+        # where(mask, p, 0) — so dq_scr is untouched either way.
+        visible = (q_idx * np.int32(block_q)
+                   + np.int32(block_q - 1 + causal_offset)
+                   >= kb * np.int32(block_k))
+        pl.when(visible)(_accum)
+    else:
+        _accum()
 
     @pl.when(kb == num_k_blocks - 1)
     def _done():
@@ -297,30 +361,51 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
     block_k = k.shape[0]
     block_q = q.shape[0]
     lowp = q.dtype == jnp.bfloat16
+    prec = None if lowp else jax.lax.Precision.HIGHEST
 
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    def _accum():
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=prec) * scale
+        mask = None
+        if causal:
+            q_pos = qb * np.int32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos + np.int32(causal_offset) >= k_pos
+            s_m = jnp.where(mask, s, -1e30)
+        else:
+            s_m = s
+        if with_kmask:
+            s_m = jnp.where(kmask_ref[...][:1] > 0, s_m, -1e30)
+        p = jnp.exp(s_m - lse)                   # (block_q, block_k)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        if with_kmask:
+            p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
+        p_op = p.astype(jnp.bfloat16) if lowp else p
+        dv_scr[...] += jnp.dot(p_op.T, g,
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32,
+                     precision=prec)
+        ds = p * (dp - delta.astype(jnp.float32))
+        ds_op = ds.astype(jnp.bfloat16) if lowp else ds
+        dk_scr[...] += jnp.dot(ds_op.T, q,
+                               preferred_element_type=jnp.float32,
+                               precision=prec) * scale
+
     if causal:
-        q_pos = qb * np.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = q_pos + np.int32(causal_offset) >= k_pos
-        s = jnp.where(mask, s, -1e30)
-    if with_kmask:
-        s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
-    p = jnp.exp(s - lse)                         # (block_q, block_k)
-    if causal:
-        p = jnp.where(mask, p, 0.0)
-    if with_kmask:
-        p = jnp.where(kmask_ref[...][:1] > 0, p, 0.0)
-    p_op = p.astype(jnp.bfloat16) if lowp else p
-    dv_scr[...] += jnp.dot(p_op.T, g,
-                           preferred_element_type=jnp.float32)
-    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta.astype(jnp.float32))
-    ds_op = ds.astype(jnp.bfloat16) if lowp else ds
-    dk_scr[...] += jnp.dot(ds_op.T, q,
-                           preferred_element_type=jnp.float32) * scale
+        # skip q-blocks that cannot attend this k-block: fully-masked
+        # key columns keep their exact-zero dK/dV from the scratch
+        # init (p is hard-zeroed in the old path, so this is exact for
+        # any causal_offset)
+        visible = (qb * np.int32(block_q)
+                   + np.int32(block_q - 1 + causal_offset)
+                   >= kb * np.int32(block_k))
+        pl.when(visible)(_accum)
+    else:
+        _accum()
 
     @pl.when(qb == num_q_blocks - 1)
     def _done():
@@ -354,18 +439,19 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
                     axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta, (b * h, s_q, _LANE))
 
-    num_q_blocks = s_q // _BLOCK_Q
-    num_k_blocks = s_k // _BLOCK_K
+    bq, bk = _blocks(s_q, s_k)
+    num_q_blocks = s_q // bq
+    num_k_blocks = s_k // bk
     causal_offset = s_k - s_q
-    zero, q_spec, k_spec = _blocked_specs(d)
-    lseq_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+    zero, q_spec, k_spec = _blocked_specs(d, bq, bk)
+    lseq_spec = pl.BlockSpec((None, bq, _LANE),
                              lambda i, j, kb: (i, j, zero(i)))
 
     dq_in_specs = [q_spec, k_spec, k_spec, q_spec, lseq_spec,
                    lseq_spec]
     dq_inputs = [qf, kf, vf, gf, lse, delta]
     if kmask is not None:
-        dq_in_specs.append(_kmask_spec(h))
+        dq_in_specs.append(_kmask_spec(h, bk=bk))
         dq_inputs.append(_kmask_rows(kmask, s_k))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -376,23 +462,23 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
         in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((_BLOCK_Q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_INTERPRET,
     )(*dq_inputs)
 
     # pass 2: grid is (BH, k-block, q-block) — index maps swap roles
-    kk_spec = pl.BlockSpec((None, _BLOCK_K, d),
+    kk_spec = pl.BlockSpec((None, bk, d),
                            lambda i, kb, j: (i, kb, zero(i)))
-    qq_spec = pl.BlockSpec((None, _BLOCK_Q, d),
+    qq_spec = pl.BlockSpec((None, bq, d),
                            lambda i, kb, j: (i, j, zero(i)))
-    lse2_spec = pl.BlockSpec((None, _BLOCK_Q, _LANE),
+    lse2_spec = pl.BlockSpec((None, bq, _LANE),
                              lambda i, kb, j: (i, j, zero(i)))
     dkv_in_specs = [kk_spec, kk_spec, qq_spec, qq_spec, lse2_spec,
                     lse2_spec]
     dkv_inputs = [kf, vf, qf, gf, lse, delta]
     if kmask is not None:
         # grid here is (BH, k-block, q-block): mask block follows kb
-        dkv_in_specs.append(_kmask_spec(h, kb_in_dim2=False))
+        dkv_in_specs.append(_kmask_spec(h, kb_in_dim2=False, bk=bk))
         dkv_inputs.append(_kmask_rows(kmask, s_k))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -404,8 +490,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
         out_specs=[kk_spec, kk_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((_BLOCK_K, d), jnp.float32),
-                        pltpu.VMEM((_BLOCK_K, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=_INTERPRET,
     )(*dkv_inputs)
 

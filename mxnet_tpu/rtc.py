@@ -36,8 +36,8 @@ __all__ = ["PallasModule", "CudaModule"]
 
 
 def _interpret_default() -> bool:
-    import jax
-    return jax.default_backend() != "tpu"
+    from .base import on_accelerator
+    return not on_accelerator()
 
 
 def _specs_key(specs) -> Tuple:

@@ -21,10 +21,15 @@ def _f32_matmuls_on_tpu():
     """On the chip, XLA runs f32 matmuls at bf16 operand precision by
     default, which breaks the 2e-5 interpret-vs-oracle tolerances (the
     two sides truncate differently).  These tests check ALGORITHM
-    equivalence, so pin true-f32 precision for both sides on TPU; the
-    real Mosaic kernel's precision is covered by TestFlashOnChip with
+    equivalence, so pin true-f32 precision for the XLA ORACLE side on
+    any accelerator backend (the kernel side pins Precision.HIGHEST for
+    f32 inputs itself since r4 — the r3 on-chip failures were this
+    fixture missing the backend when the axon plugin registered as
+    "axon", leaving the oracle at bf16 operand precision); the real
+    Mosaic kernel's bf16 path is covered by TestFlashOnChip with
     bf16-scale tolerance."""
-    if jax.default_backend() == "tpu":
+    from mxnet_tpu.base import on_accelerator
+    if on_accelerator():
         with jax.default_matmul_precision("float32"):
             yield
     else:
@@ -39,9 +44,12 @@ def interpret(monkeypatch):
 
 def _tol(base):
     """Interpret-vs-oracle tolerance: calibrated on the CPU backend;
-    on TPU hardware f32 accumulation order differs slightly between
-    the interpret kernel and the XLA oracle (observed excess ~8e-5),
-    so widen one decade there — still 100x tighter than bf16."""
+    on TPU hardware both sides now run true-f32 matmuls (kernel pins
+    Precision.HIGHEST, fixture pins the oracle) but f32 accumulation
+    ORDER still differs between the blocked kernel and the one-shot
+    einsum, so widen one decade there — still 100x tighter than the
+    bf16-scale error the r3 run showed when the precision pin missed
+    the backend."""
     return base * (10.0 if jax.default_backend() != "cpu" else 1.0)
 
 
@@ -80,6 +88,21 @@ class TestFlashInterpret:
         v = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
         got = fa_mod.flash_attention(q, k, v, causal=causal)
         want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
+
+    def test_causal_short_keys_no_nan(self, interpret):
+        """Causal cross-attention with s_q > s_k: early q-blocks attend
+        ZERO keys.  The causal block-skip must not skip there (l would
+        be 0 → 0/0 NaN); the oracle emits finite uniform rows and the
+        kernel must match them (r4 code-review finding #1)."""
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
+        k = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+        v = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+        got = fa_mod.flash_attention(q, k, v, causal=True)
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
+        assert np.isfinite(np.asarray(got)).all()
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=_tol(2e-5), atol=_tol(2e-5))
 
@@ -227,12 +250,96 @@ class TestFlashDispatch:
 @pytest.mark.tpu
 class TestFlashOnChip:
     def test_matches_xla_on_tpu(self):
-        assert jax.default_backend() == "tpu"
+        from mxnet_tpu.base import on_accelerator
+        assert on_accelerator()
         q, k, v = _rand_qkv(2, 128, 4, 64, dtype="float32")
         got = fa_mod.flash_attention(q, k, v, causal=True)
         want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-2, atol=2e-2)
+
+
+class TestFlashSelection:
+    def test_auto_policy_crossover(self, monkeypatch):
+        """Auto mode: flash below the measured XLA-win window, XLA
+        inside it, flash again where the S² score tensor would blow
+        HBM (bench_logs/r3/attention_bench.log crossover)."""
+        from mxnet_tpu.ops.attention import _flash_preferred
+        monkeypatch.delenv("MXTPU_FLASH_MODE", raising=False)
+        assert _flash_preferred(128, 128)
+        assert _flash_preferred(1024, 1024)
+        assert not _flash_preferred(2048, 2048)
+        assert _flash_preferred(4096, 4096)
+        # cross-attention uses the max of the two lengths
+        assert not _flash_preferred(128, 2048)
+
+    def test_mode_env_overrides(self, monkeypatch):
+        from mxnet_tpu.ops.attention import _flash_preferred
+        monkeypatch.setenv("MXTPU_FLASH_MODE", "never")
+        assert not _flash_preferred(128, 128)
+        monkeypatch.setenv("MXTPU_FLASH_MODE", "always")
+        assert _flash_preferred(2048, 2048)
+
+    def test_window_env_tunable(self, monkeypatch):
+        from mxnet_tpu.ops.attention import _flash_preferred
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "1024")
+        monkeypatch.setenv("MXTPU_FLASH_XLA_UNTIL", "8192")
+        assert not _flash_preferred(1024, 1024)
+        assert _flash_preferred(8192, 8192)
+
+    def test_dispatch_respects_policy(self, interpret, monkeypatch):
+        """dot_product_attention at a policy-excluded seq takes the
+        XLA path (no flash dispatch counted)."""
+        from mxnet_tpu.ops import attention as attn
+        q, k, v = _rand_qkv(1, 256, 2, 64)
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "256")
+        before = attn.flash_dispatch_count()
+        attn.dot_product_attention(q, k, v)
+        assert attn.flash_dispatch_count() == before
+        monkeypatch.delenv("MXTPU_FLASH_XLA_FROM")
+        attn.dot_product_attention(q, k, v)
+        assert attn.flash_dispatch_count() == before + 1
+
+    @pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 256)])
+    def test_block_size_env_numerics(self, interpret, monkeypatch,
+                                     bq, bk):
+        """Tunable block sizes change tiling only — fwd and bwd match
+        the oracle at non-default (block_q, block_k)."""
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_Q", str(bq))
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_K", str(bk))
+        q, k, v = _rand_qkv(1, 256, 2, 64, seed=31)
+        rng = np.random.RandomState(32)
+        ct = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
+
+        def lf(q, k, v):
+            return (fa_mod.flash_attention(q, k, v, causal=True)
+                    * ct).sum()
+
+        def lx(q, k, v):
+            return (_sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)
+                    * ct).sum()
+
+        np.testing.assert_allclose(
+            np.asarray(fa_mod.flash_attention(q, k, v, causal=True)),
+            np.asarray(_sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True)),
+            rtol=_tol(2e-5), atol=_tol(2e-5))
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gx):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=_tol(5e-5),
+                atol=_tol(5e-5), err_msg=f"d{name} (bq={bq}, bk={bk})")
+
+    def test_block_size_invalid_falls_back(self, interpret, monkeypatch):
+        """Block sizes that don't divide the seq len are clamped to the
+        128 default instead of crashing mid-launch."""
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_Q", "96")
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_K", "0")
+        q, k, v = _rand_qkv(1, 128, 2, 64, seed=33)
+        got = fa_mod.flash_attention(q, k, v)
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
 
 
 class TestKeyPaddingDispatch:
