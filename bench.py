@@ -112,6 +112,68 @@ def _set_result(metric, value, unit="samples/sec", **extra):
             "vs_baseline": 1.0,
             **extra,
         }
+        ptr = _state.get("onchip_ptr")
+        if ptr:
+            _state["result"]["latest_committed_onchip"] = ptr
+
+
+def _latest_committed_onchip():
+    """Pointer to the newest COMMITTED on-chip bert_base record, so the
+    driver JSON links to auditable chip evidence even when this very
+    invocation degrades to a CPU smoke (VERDICT r3 next #5).  Returns
+    {path, git_sha, metric, value, mfu, timestamp} or None."""
+    import glob
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # ONE git call up front for the committed set (the hunter commits a
+    # report per attempt, so per-file `git log` calls would grow
+    # without bound), then one more only for the chosen file's sha
+    try:
+        committed = set(subprocess.run(
+            ["git", "ls-files", "bench_logs"], cwd=repo,
+            capture_output=True, text=True, timeout=30)
+            .stdout.splitlines())
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    best = None
+    for path in glob.glob(os.path.join(repo, "bench_logs", "*",
+                                       "bench_report_*.json")):
+        rel = os.path.relpath(path, repo)
+        if rel not in committed:
+            continue                  # uncommitted = not evidence yet
+        try:
+            with open(path) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            continue
+        started = rep.get("started", "")
+        if best is not None and started <= best["timestamp"]:
+            continue
+        hit = None
+        for e in rep.get("entries", []):
+            if (e.get("stage") == "bert_pretrain"
+                    and e.get("platform") == "tpu"
+                    and e.get("builder") == "bert_base"
+                    and e.get("samples_per_sec")):
+                hit = e               # entries are chronological:
+        if hit is None:               # keep the file's newest record
+            continue
+        best = {
+            "path": rel, "timestamp": started,
+            "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+            "value": hit["samples_per_sec"],
+            "mfu": hit.get("mfu"),
+            "batch_size": hit.get("batch_size"),
+            "bulked_steps": hit.get("bulked_steps"),
+        }
+    if best is not None:
+        try:
+            best["git_sha"] = subprocess.run(
+                ["git", "log", "-1", "--format=%H", "--",
+                 best["path"]], cwd=repo, capture_output=True,
+                text=True, timeout=30).stdout.strip()
+        except (OSError, subprocess.TimeoutExpired):
+            best["git_sha"] = ""
+    return best
 
 
 def _emit_and_exit(code=0):
@@ -354,33 +416,92 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
     return batch_size * steps / dt
 
 
+def _run_cpu_smoke_subprocess(sub_budget=240):
+    """Run the degraded CPU smoke in a CHILD bench.py (so this process
+    stays jax-free and can still take the chip path if a window opens
+    later — VERDICT r3 next #5), and adopt its JSON line as the
+    best-so-far result."""
+    env = dict(os.environ)
+    env["MXTPU_BENCH_FORCE_CPU"] = "1"
+    env["MXTPU_BENCH_BUDGET"] = str(sub_budget)
+    _log(f"running CPU smoke in subprocess (budget {sub_budget}s)")
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=sub_budget + 120,
+            env=env)
+        sys.stderr.write(res.stderr[-3000:])
+        line = None
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                line = ln
+        if line:
+            parsed = json.loads(line)
+            with _lock:
+                parsed.setdefault("degraded",
+                                  "tpu unreachable; cpu backend")
+                ptr = _state.get("onchip_ptr")
+                if ptr:
+                    parsed["latest_committed_onchip"] = ptr
+                _state["result"] = parsed
+            _record("cpu_smoke_subprocess", adopted=parsed)
+            return True
+    except (OSError, subprocess.TimeoutExpired, ValueError) as e:
+        _record("cpu_smoke_subprocess", error=repr(e))
+        traceback.print_exc(file=sys.stderr)
+    return False
+
+
 def main():
     acquire_timeout = float(
         os.environ.get("MXTPU_BENCH_ACQUIRE_TIMEOUT", "180"))
-    budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "900"))
-    retries = int(os.environ.get("MXTPU_BENCH_ACQUIRE_RETRIES", "3"))
+    # default budget sized so the probe-spanning loop is REAL: probe
+    # (≤180 s) + banked smoke (≤240 s) must leave several re-probes
+    # before the ≥600 s TPU-attempt reserve (a 900 s default left ~0)
+    budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "1800"))
     threading.Thread(target=_watchdog, args=(budget,),
                      daemon=True).start()
 
-    # the shared chip can be unreachable for minutes at a stretch; one
-    # 180 s probe converts "busy right now" into a degraded CPU round
-    # (VERDICT r2 missing #1).  Retry ONLY on hangs/crashes (an honest
-    # PLATFORM:cpu answer means there is no chip to wait for), and only
-    # while the budget still covers the retry itself plus the ~300 s
-    # CPU fallback stages.
+    # evidence pointer first: EVERY emitted line — including degraded
+    # ones — must link to the newest committed chip record
+    try:
+        _state["onchip_ptr"] = _latest_committed_onchip()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    if _state.get("onchip_ptr"):
+        with _lock:
+            _state["result"]["latest_committed_onchip"] = \
+                _state["onchip_ptr"]
+
     platform = probe_platform(acquire_timeout)
     tries = 1
-    while (platform == "unreachable" and tries < retries
-           and budget - (time.monotonic() - _T0)
-           > 300 + 60 + acquire_timeout
-           and not os.environ.get("MXTPU_BENCH_FORCE_CPU")):
-        _log(f"chip unreachable (probe {tries}/{retries}); "
-             "retrying in 60s")
-        time.sleep(60)
-        platform = probe_platform(acquire_timeout)
-        tries += 1
     _record("probe", platform=platform,
             acquire_timeout_s=acquire_timeout, probes=tries)
+
+    if platform != "tpu" and not os.environ.get("MXTPU_BENCH_FORCE_CPU"):
+        # chip not answering NOW: bank the CPU smoke immediately in a
+        # subprocess, then spend the WHOLE remaining budget probing —
+        # the r3 failure mode was a probe window of minutes against
+        # chip-contention timescales of hours.
+        _run_cpu_smoke_subprocess()
+        while True:
+            remaining = budget - (time.monotonic() - _T0)
+            # a TPU attempt needs headroom for compile + two timed
+            # windows; below that, keep the banked smoke
+            if remaining < 420 + acquire_timeout:
+                break
+            time.sleep(min(90.0, remaining))
+            platform = probe_platform(acquire_timeout)
+            tries += 1
+            if platform == "tpu":
+                _log(f"chip window opened on probe {tries}")
+                break
+        _record("probe_spanned", platform=platform, probes=tries)
+        if platform != "tpu":
+            _log("no chip window in budget; emitting banked CPU smoke")
+            _emit_and_exit(0)
+
     if platform == "unreachable":
         platform = "cpu"
     if platform == "cpu":
@@ -400,19 +521,22 @@ def main():
         _record("import_failure", error=repr(e))
         _emit_and_exit(0)
 
-    # stage 1: cheap MLP so a number always exists
-    try:
-        _log("stage 1: MLP trainer bench")
-        sps = bench_mlp_train()
-        extra = {} if on_tpu else {
-            "degraded": "tpu unreachable; cpu backend"}
-        _record("mlp_train", samples_per_sec=round(sps, 2),
-                platform=platform)
-        _set_result("mlp_mnist_train_samples_per_sec", sps, **extra)
-        _log(f"stage 1 done: {sps:.1f} samples/sec")
-    except Exception as e:
-        traceback.print_exc(file=sys.stderr)
-        _record("mlp_train", error=repr(e))
+    # stage 1 (CPU smoke only): cheap MLP so a number always exists.
+    # On the chip it is SKIPPED: sub-ms steps through the tunnel
+    # measure the tunnel, not the framework (VERDICT r3 weak #4), and
+    # the window minutes belong to the BERT series.
+    if not on_tpu:
+        try:
+            _log("stage 1: MLP trainer bench")
+            sps = bench_mlp_train()
+            _record("mlp_train", samples_per_sec=round(sps, 2),
+                    platform=platform)
+            _set_result("mlp_mnist_train_samples_per_sec", sps,
+                        degraded="tpu unreachable; cpu backend")
+            _log(f"stage 1 done: {sps:.1f} samples/sec")
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            _record("mlp_train", error=repr(e))
 
     # stage 2: bert_small (tiny on cpu, real config on tpu)
     try:
@@ -516,16 +640,13 @@ def main():
                 _log(f"stage 3: skipping batch {bs}/seq {seq} "
                      f"({remaining:.0f}s budget left, need {need})")
                 continue
-            try:
-                _log(f"stage 3: bert_base pretrain bench "
-                     f"(batch {bs}, seq {seq}, "
-                     f"bulk={bulk_cfg or 'auto'})")
+            def _one_config():
                 # no-remat first: at b16-32 s512 the activations
                 # (~1-2 GB with flash) fit v5e HBM, and remat's
                 # recompute tax is ~1/3 of the forward FLOPs.  OOM
                 # falls back to the remat program (large-batch s512).
                 try:
-                    sps, mfu, fl = bench_bert_pretrain(
+                    return bench_bert_pretrain(
                         builder_name="bert_base", vocab=30522,
                         batch_size=bs, seq_len=seq, num_masked=20,
                         steps=20, warmup=3, hidden=768, layers=12,
@@ -536,12 +657,33 @@ def main():
                         raise
                     _log(f"stage 3 batch {bs} seq {seq}: OOM without "
                          "remat; retrying with remat")
-                    sps, mfu, fl = bench_bert_pretrain(
+                    return bench_bert_pretrain(
                         builder_name="bert_base", vocab=30522,
                         batch_size=bs, seq_len=seq, num_masked=20,
                         steps=20, warmup=3, hidden=768, layers=12,
                         heads=12, remat=True, scan_layers=scan,
                         bulk=bulk_cfg)
+
+            try:
+                _log(f"stage 3: bert_base pretrain bench "
+                     f"(batch {bs}, seq {seq}, "
+                     f"bulk={bulk_cfg or 'auto'})")
+                try:
+                    sps, mfu, fl = _one_config()
+                except Exception as e:
+                    # the r3 b256 attempt died on ONE transient axon
+                    # remote-compile HTTP 500 and was never retried
+                    # (VERDICT r3 weak #6); OOM is the only error
+                    # class a retry can't help
+                    if "RESOURCE_EXHAUSTED" in repr(e) or \
+                            budget - (time.monotonic() - _T0) < need:
+                        raise
+                    _log(f"stage 3 batch {bs} seq {seq}: transient? "
+                         f"({repr(e)[:200]}); one retry in 30s")
+                    _record("bert_base_retry", error=repr(e),
+                            batch_size=bs, seq_len=seq)
+                    time.sleep(30)
+                    sps, mfu, fl = _one_config()
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
                      f"samples/sec, mfu={mfu:.3f}, flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):

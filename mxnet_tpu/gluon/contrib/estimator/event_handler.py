@@ -207,6 +207,10 @@ class CheckpointHandler(TrainBegin, EpochEnd):
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
         self.current_epoch = 0
+        # a second fit() is a fresh run: a stale best from the previous
+        # run must not suppress this run's best checkpoint (ADVICE r3)
+        self.best = float("inf") if self.mode == "min" \
+            else -float("inf")
 
     def _improved(self, value):
         return value < self.best if self.mode == "min" \

@@ -380,11 +380,17 @@ def box_decode(data, anchors, *, std0=0.1, std1=0.1, std2=0.2,
         a_y = anchors[..., 1] + a_h * 0.5
     x = data[..., 0] * std0 * a_w + a_x
     y = data[..., 1] * std1 * a_h + a_y
-    # reference clip bounds the SCALED log-deltas before exp (a
-    # growth cap like GluonCV's clip≈6.586), not the output coords
-    cap = clip if clip > 0 else 10.0
-    w = jnp.exp(jnp.minimum(data[..., 2] * std2, cap)) * a_w * 0.5
-    h = jnp.exp(jnp.minimum(data[..., 3] * std3, cap)) * a_h * 0.5
+    # reference clip bounds the SCALED log-deltas before exp (a growth
+    # cap like GluonCV's clip≈6.586), not the output coords — and ONLY
+    # when clip > 0: the default -1 means "no clip", so extreme deltas
+    # must decode unclamped exactly as the reference op does (ADVICE r3)
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * a_w * 0.5
+    h = jnp.exp(dh) * a_h * 0.5
     return jnp.stack([x - w, y - h, x + w, y + h], axis=-1)
 
 

@@ -185,7 +185,8 @@ class DataParallelTrainer:
         # set when a fused step failed after its donated optimizer
         # state was handed to the executable (see _step_impl)
         self._donation_poisoned = None
-        # id(NDArray) -> (weakref, source buffer, placed buffer);
+        # id(NDArray) -> (weakref, source buffer, placed buffer,
+        # requested sharding);
         # pruned to the CURRENT step's inputs each step, so at most
         # n_args+1 placements are ever pinned (id keys because NDArray
         # __eq__ is elementwise — a WeakKeyDictionary lookup would
@@ -698,10 +699,15 @@ class DataParallelTrainer:
             pass
         used.add(id(a))
         hit = self._placed.get(id(a))
-        if hit is not None and hit[0]() is a and hit[1] is v:
+        # the requested sharding is part of the key: step (P(dp)) and
+        # step_multi (P(None, dp)) share this cache, and a same-buffer
+        # hit under a DIFFERENT sharding must re-place, not silently
+        # return the stale placement (ADVICE r3)
+        if hit is not None and hit[0]() is a and hit[1] is v \
+                and hit[3] == sharding:
             return hit[2]
         out = jax.device_put(v, sharding)
-        self._placed[id(a)] = (weakref.ref(a), v, out)
+        self._placed[id(a)] = (weakref.ref(a), v, out, sharding)
         return out
 
     def _prune_placed(self, used):

@@ -49,7 +49,7 @@ def _jax():
 _prng_impl_set = False
 
 
-def _ensure_prng_impl():
+def _ensure_prng_impl(required=True):
     """Pick the key implementation ONCE, before the first key exists.
 
     Threefry (jax's default) burns real MXU/VPU time generating dropout
@@ -71,8 +71,21 @@ def _ensure_prng_impl():
         try:
             impl = ("rbg" if jax.default_backend() != "cpu"
                     else "threefry2x32")
-        except Exception:
-            return  # backend not up yet — retry at the next key
+        except Exception as e:
+            # backend not up yet.  When the caller is about to CREATE
+            # a key (required=True), a key born under the default
+            # threefry impl would mix with rbg keys after a later
+            # successful latch — the exact mixing the once-latch
+            # exists to prevent (ADVICE r3) — so raise instead of
+            # materializing one.  Key-free callers (seed(ctx=None)
+            # just stores an int) pass required=False and defer.
+            if not required:
+                return
+            from .base import MXNetError
+            raise MXNetError(
+                "cannot pick MXTPU_PRNG_IMPL=auto before a jax "
+                "backend is initialized; initialize the backend (any "
+                "device op) or set MXTPU_PRNG_IMPL explicitly") from e
     if impl not in ("rbg", "unsafe_rbg", "threefry2x32"):
         raise ValueError(
             f"MXTPU_PRNG_IMPL={impl!r}: expected auto, threefry2x32, "
@@ -84,7 +97,9 @@ def _ensure_prng_impl():
 def seed(seed_state: int, ctx: Optional[Context] = None):
     """Reset the RNG. ``ctx=None`` reseeds every context (parity: 'all')."""
     global _keys
-    _ensure_prng_impl()
+    # the all-contexts path stores only an int — no key is created, so
+    # a not-yet-initialized backend must not make seed-at-startup fail
+    _ensure_prng_impl(required=ctx is not None and ctx != "all")
     if ctx is None or ctx == "all":
         _keys = {"__seed__": int(seed_state)}
     else:

@@ -30,6 +30,7 @@ Run it in the background at session start:
     python tools/chip_hunt.py --log-dir bench_logs/r3 &
 """
 import argparse
+import collections
 import datetime
 import json
 import os
@@ -74,11 +75,16 @@ def jobs(log_dir):
         # the driver-visible headline: the job is done only when the
         # bert_base (not merely bert_small) chip series exists; a CPU
         # fallback says "degraded".
+        # ok_pattern anchored to the line start: the emitted JSON now
+        # EMBEDS a latest_committed_onchip pointer whose inner metric
+        # string would otherwise false-positive this round's check
+        # against a previous round's committed record
         ("bench", [sys.executable, "bench.py"], 3300,
          {"MXTPU_BENCH_BUDGET": "3000",
           "MXTPU_BENCH_ACQUIRE_TIMEOUT": "120",
           "MXTPU_BENCH_LOG_DIR": log_dir},
-         r"bert_base_pretrain_samples_per_sec_per_chip", r"degraded"),
+         r'(?m)^\{"metric": "bert_base_pretrain_samples_per_sec_per_chip"',
+         r"degraded"),
         # on-chip numerics WITHOUT the flash tests: isolates the r3
         # rc=-11 segfault from flash-kernel coverage
         ("on_tpu_core",
@@ -226,8 +232,11 @@ def main():
 
     log_dir = os.path.join(REPO, args.log_dir)
     os.makedirs(log_dir, exist_ok=True)
-    attempts = {name: 0 for name, *_ in jobs(args.log_dir)}
-    real_fails = {name: 0 for name, *_ in jobs(args.log_dir)}
+    # defaultdicts: jobs.json is re-read every cycle and may introduce
+    # NEW names mid-hunt — a plain dict keyed at startup would KeyError
+    # and kill the whole multi-hour hunter
+    attempts = collections.defaultdict(int)
+    real_fails = collections.defaultdict(int)
 
     def pending_jobs():
         return [j for j in jobs(args.log_dir)
