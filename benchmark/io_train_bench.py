@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""End-to-end training WITH real IO in the loop vs compute-only.
+
+VERDICT r3 weak #5: native decode peaks ~585 img/s while resnet50
+INFERENCE alone consumes ~2082 img/s on-chip — but no measurement
+existed of training throughput with the record-read → JPEG decode →
+augment → batch pipeline actually feeding the step.  This bench:
+
+  1. times the train step with a PRELOADED batch (compute-only);
+  2. times the same step pulling every batch from ImageRecordIter
+     (native C++ decode stage + prefetch) — the IO-in-loop number;
+  3. sweeps the decode pool (preprocess_threads) to find where the
+     pipeline stops starving the step on this host.
+
+Reference analog: ``iter_image_recordio_2.cc`` exists precisely to
+keep accelerators fed (SURVEY.md §2.4).
+
+    python benchmark/io_train_bench.py [--model resnet50_v1] [--batch 64]
+"""
+import argparse
+import json
+import os as _os
+import sys as _sys
+import tempfile
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import numpy as np
+
+from benchmark._timing import slope
+from benchmark.decode_bench import make_rec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50_v1")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--records", type=int, default=1024)
+    p.add_argument("--threads", default="2,4,8")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        _os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    _os.environ.setdefault("MXTPU_NATIVE_IMAGE", "1")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import ImageRecordIter
+
+    on_tpu = bool(mx.num_tpus())
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    plat = "tpu" if on_tpu else "cpu"
+    b, s = args.batch, args.size
+    model = args.model
+    n_rec = args.records
+    if not on_tpu:
+        # CPU smoke: small enough to finish in ~a minute, same code path
+        b, s, n_rec, model = 8, 64, 128, "resnet18_v1"
+
+    net = getattr(vision, model)(classes=10)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01}, kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def step(x, y):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(b)
+        return loss
+
+    rng = np.random.RandomState(0)
+    x0 = nd.array(rng.rand(b, 3, s, s).astype("f4"), ctx=ctx)
+    y0 = nd.array(rng.randint(0, 10, b).astype("f4"), ctx=ctx)
+    step(x0, y0).wait_to_read()            # compile
+
+    # 1. compute-only: preloaded batch, chained slope timing
+    def window(n):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(n):
+            out = step(x0, y0).reshape((-1,))[0:1]
+            acc = out if acc is None else acc + out * 1e-30
+        float(np.asarray(acc.asnumpy()).ravel()[0])
+        return time.perf_counter() - t0
+
+    per_step = slope(window, 4)
+    compute_sps = b / per_step
+    print(json.dumps({"metric": "train_compute_only_img_per_sec",
+                      "model": model, "batch": b, "size": s,
+                      "img_per_sec": round(compute_sps, 1),
+                      "platform": plat}), flush=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = make_rec(tmp, n_rec, s + 32)
+
+        def epoch_sps(threads):
+            it = ImageRecordIter(
+                path_imgrec=rec, data_shape=(3, s, s), batch_size=b,
+                resize=s + 16, rand_crop=True, rand_mirror=True,
+                preprocess_threads=threads, prefetch_buffer=4,
+                shuffle=False)
+            # warm: pull two batches + step so decode-thread spin-up
+            # and first-batch latency stay out of the timed epoch
+            for i, batch in enumerate(it):
+                step(batch.data[0].as_in_context(ctx),
+                     batch.label[0].as_in_context(ctx)).wait_to_read()
+                if i >= 1:
+                    break
+            it.reset()
+            seen = 0
+            t0 = time.perf_counter()
+            last = None
+            for batch in it:
+                x = batch.data[0].as_in_context(ctx)
+                y = batch.label[0].as_in_context(ctx)
+                last = step(x, y)
+                seen += b
+            float(np.asarray(last.asnumpy()).ravel()[0])
+            return seen / (time.perf_counter() - t0)
+
+        # 2. IO in the loop at the default pool, 3. pool scaling sweep
+        for threads in [int(t) for t in args.threads.split(",")]:
+            sps = epoch_sps(threads)
+            print(json.dumps(
+                {"metric": "train_with_io_img_per_sec", "model": model,
+                 "batch": b, "size": s, "threads": threads,
+                 "img_per_sec": round(sps, 1),
+                 "vs_compute_only": round(sps / compute_sps, 3),
+                 "platform": plat}), flush=True)
+
+        # decode-only ceiling at the largest pool (no training step);
+        # same two-batch warm as the train rows so spin-up stays out
+        # of the window
+        threads = max(int(t) for t in args.threads.split(","))
+        it = ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, s, s), batch_size=b,
+            resize=s + 16, rand_crop=True, rand_mirror=True,
+            preprocess_threads=threads, prefetch_buffer=4)
+        for i, _batch in enumerate(it):
+            if i >= 1:
+                break
+        it.reset()
+        seen = 0
+        t0 = time.perf_counter()
+        for batch in it:
+            seen += b
+        dt = time.perf_counter() - t0
+        print(json.dumps(
+            {"metric": "decode_only_img_per_sec", "threads": threads,
+             "size": s, "img_per_sec": round(seen / dt, 1),
+             "platform": plat}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,9 +166,10 @@ class QuantizedDense:
         if self._flatten and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
         xq, x_scale = _quantize_act(x, self._calib)
-        # int8 matmul on the MXU; accumulate in int32 then rescale
-        out = nd.dot(xq.astype("int32"), self.wq.astype("int32"),
-                     transpose_b=True).astype("float32")
+        # s8×s8 matmul with s32 accumulation on the MXU (nd.dot emits
+        # preferred_element_type=s32 for int8 operands — upcasting
+        # the operands would bypass the int8 hardware path)
+        out = nd.dot(xq, self.wq, transpose_b=True).astype("float32")
         out = out * self._w_scale_nd * x_scale
         if self.bias is not None:
             out = out + self.bias
@@ -217,9 +218,9 @@ class QuantizedConv:
 
     def __call__(self, x):
         xq, x_scale = _quantize_act(x, self._calib)
-        out = nd.Convolution(xq.astype("int32"),
-                             self.wq.astype("int32"),
-                             no_bias=True, **self._kwargs)
+        # s8 operands straight into the conv: s32 accumulation is
+        # emitted by the op itself (MXU int8 path)
+        out = nd.Convolution(xq, self.wq, no_bias=True, **self._kwargs)
         out = out.astype("float32") * self._w_scale_nd * x_scale
         if self.bias is not None:
             out = out + self.bias.reshape((1, -1, 1, 1))

@@ -18,6 +18,7 @@ import numpy as np
 from jax import lax
 
 from .registry import register, alias
+from .tensor import _int8_acc
 
 # ---------------------------------------------------------------------------
 # fully connected / dense — reference fully_connected.cc
@@ -211,10 +212,14 @@ def convolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
     dilate = tuple(dilate) if dilate else (1,) * k
     pad = tuple(pad) if pad else (0,) * k
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(k))
+    # int8×int8 convs accumulate in int32 (MXU-native quantized path;
+    # reference quantized_conv) — shared rule with dot/batch_dot
+    pref = _int8_acc(data, weight)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=num_group)
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=pref)
     if not no_bias:
         bias = rest[0]
         out = out + jnp.reshape(bias, (1, -1) + (1,) * k)

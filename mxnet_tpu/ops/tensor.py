@@ -270,6 +270,16 @@ def argmax_channel(data):
 # ---------------------------------------------------------------------------
 
 
+def _int8_acc(a, b):
+    """int8×int8 contractions accumulate in int32 (the MXU-native
+    quantized path, reference quantized_dot/quantized_conv semantics):
+    the HLO must carry s8 operands with an s32 result — upcasting the
+    OPERANDS to s32 first would both overflow-differ from the
+    reference and miss the MXU int8 units."""
+    return (jnp.int32 if a.dtype == jnp.int8 and b.dtype == jnp.int8
+            else None)
+
+
 @register("dot", num_inputs=2)
 def dot(a, b, *, transpose_a=False, transpose_b=False):
     """MXNet dot: contract LAST axis of a with FIRST axis of b."""
@@ -277,7 +287,8 @@ def dot(a, b, *, transpose_a=False, transpose_b=False):
         a = jnp.transpose(a)
     if transpose_b:
         b = jnp.transpose(b)
-    return jnp.tensordot(a, b, axes=1)
+    return jnp.tensordot(a, b, axes=1,
+                         preferred_element_type=_int8_acc(a, b))
 
 
 @register("batch_dot", num_inputs=2)
@@ -286,7 +297,7 @@ def batch_dot(a, b, *, transpose_a=False, transpose_b=False):
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b)
+    return jnp.matmul(a, b, preferred_element_type=_int8_acc(a, b))
 
 
 @register("linalg_gemm2", num_inputs=2)

@@ -71,6 +71,19 @@ def test_worker_crash_is_detected_not_hung():
     assert "SURVIVOR_NO_ERROR" not in res.stdout
 
 
+def test_composed_dp_tp_pp_training_step():
+    """dp×tp×pp in ONE compiled training step on the 2-proc × 8-dev
+    pod shape, int8-compressed gradient exchange on the dp axis, loss
+    parity vs a single-device reference (VERDICT r3 next #8)."""
+    res = _run_launcher(2, "dist_worker_composed.py", timeout=420)
+    sys.stderr.write(res.stdout[-2000:] + res.stderr[-2000:])
+    assert res.returncode == 0
+    for r in range(2):
+        assert f"COMPOSED_I8_WIRE_OK rank={r}" in res.stdout
+        assert f"COMPOSED_PARITY_OK rank={r}" in res.stdout
+        assert f"COMPOSED_OK rank={r}/2" in res.stdout
+
+
 def test_two_process_four_device_mesh():
     """2 procs x 4 virtual devices: ONE mesh composing the
     cross-process (DCN-analog) and in-process (ICI-analog) axes;
