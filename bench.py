@@ -351,13 +351,18 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
     sps = batch_size / slope
     # analytic MFU: fwd+bwd ≈ 6 * non-embedding-params * tokens, plus
     # attention 12 * L * H * S^2 per sample (fwd+bwd); embedding
-    # lookups are gathers, not matmuls, so exclude those tables
+    # LOOKUPS are gathers, not matmuls, so those tables stay out of
+    # n_params — but the tied-weight MLM decode (m masked positions ×
+    # hidden @ hidden × vocab) IS a real MXU matmul over that same
+    # table and standard MFU accounting (PaLM-style) counts it:
+    # 6 * m * hidden * vocab ≈ 2.8 GFLOP/sample for bert_base
     n_params = sum(
         int(np.prod(p.shape))
         for name, p in model.collect_params().items()
         if "embed" not in name)
     flops_per_sample = 6 * n_params * seq_len \
-        + 12 * layers * hidden * seq_len * seq_len
+        + 12 * layers * hidden * seq_len * seq_len \
+        + 6 * num_masked * hidden * vocab
     mfu = sps * flops_per_sample / _V5E_PEAK_FLOPS
     _record("bert_pretrain", platform="tpu" if on_tpu else "cpu",
             builder=builder_name, batch_size=batch_size,

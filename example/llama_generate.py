@@ -47,6 +47,9 @@ def main():
     p.add_argument("--steps", type=int, default=120)
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--new-tokens", type=int, default=8)
+    p.add_argument("--per-step", action="store_true",
+                   help="use the one-dispatch-per-token decode loop "
+                        "instead of the fused whole-loop program")
     args = p.parse_args()
 
     ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
@@ -76,22 +79,30 @@ def main():
           f"tokens/sec)")
     assert last < first, "loss did not improve"
 
-    # generate continuations and score them against the true walk
+    # generate continuations and score them against the true walk.
+    # Default = generate_fused: prefill + the whole decode loop as ONE
+    # compiled program (the TPU serving shape — the per-step path pays
+    # one host round trip per token, ~30-40 ms through a tunnel).
+    gen = net.generate if args.per_step else net.generate_fused
     prompts = make_batch(rng, 4, 4, args.vocab)
+    gen(nd.array(prompts, ctx=ctx),
+        max_new_tokens=args.new_tokens).wait_to_read()  # compile
     t0 = time.time()
-    out = net.generate(nd.array(prompts, ctx=ctx),
-                       max_new_tokens=args.new_tokens).asnumpy()
+    out = gen(nd.array(prompts, ctx=ctx),
+              max_new_tokens=args.new_tokens).asnumpy()
     gen_tps = 4 * args.new_tokens / (time.time() - t0)
     correct = total = 0
     for row in out.astype(int):
         for i in range(4, len(row)):
             total += 1
             correct += int(row[i] == (3 * row[i - 1] + 1) % args.vocab)
+    path = "per-step" if args.per_step else "fused"
     print(f"greedy continuation follows the walk "
-          f"{correct}/{total} steps ({gen_tps:.1f} tokens/sec decode)")
-    sampled = net.generate(nd.array(prompts, ctx=ctx),
-                           max_new_tokens=args.new_tokens,
-                           temperature=0.8, top_k=5, seed=1).asnumpy()
+          f"{correct}/{total} steps ({gen_tps:.1f} tokens/sec decode, "
+          f"{path} path)")
+    sampled = gen(nd.array(prompts, ctx=ctx),
+                  max_new_tokens=args.new_tokens,
+                  temperature=0.8, top_k=5, seed=1).asnumpy()
     print("sampled:", sampled[0].astype(int).tolist())
 
 

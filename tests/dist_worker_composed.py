@@ -26,15 +26,20 @@ Run via ``tools/launch.py -n 2 python tests/dist_worker_composed.py``.
 import os
 import sys
 
-_flags = " ".join(
-    f for f in os.environ.get("XLA_FLAGS", "").split()
-    if "host_platform_device_count" not in f)
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+if __name__ == "__main__":
+    # worker-script mode only: a LIBRARY import (dryrun_multichip
+    # reuses _composed_step) must not stomp the host process's
+    # XLA_FLAGS/JAX_PLATFORMS
+    _flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -86,17 +91,22 @@ def _pipelined_local_loss(w_loc, x_loc, y_loc):
 
 
 def _composed_step(w_loc, x_loc, y_loc):
-    """loss + int8-compressed-dp SGD update, one program."""
+    """loss + int8-compressed-dp SGD update, one program.
+
+    dp size comes from the MESH (lax.axis_size) rather than module
+    constants, so dryrun_multichip can reuse this function on a
+    different mesh shape without patching module state."""
     import jax.numpy as jnp
     import jax.lax as lax
     from mxnet_tpu.parallel import collectives
 
+    dp = lax.axis_size("dp")
     w2 = w_loc[0]                     # strip the sharded pp dim
     loss, g = jax.value_and_grad(_pipelined_local_loss)(
         w2, x_loc, y_loc)
-    g_avg = collectives.quantized_psum(g, "dp") / DP
+    g_avg = collectives.quantized_psum(g, "dp") / dp
     w_new = w2 - LR * g_avg
-    loss_mean = lax.psum(loss, "dp") / DP
+    loss_mean = lax.psum(loss, "dp") / dp
     return loss_mean, w_new[None]
 
 
