@@ -61,6 +61,10 @@ def main():
     p.add_argument("--block-sweep", action="store_true",
                    help="sweep (block_q, block_k) for the flash bwd at "
                         "each seq (the s>=1024 tuning lever)")
+    p.add_argument("--windows", default="",
+                   help="comma list of sliding-window widths to time "
+                        "per causal seq (flash banded vs XLA banded — "
+                        "the O(S·W) block-skip claim)")
     args = p.parse_args()
 
     import jax
@@ -136,6 +140,30 @@ def main():
                    "auto_vs_xla": round(tgx / t_auto[1], 3)}
             rows.append(row)
             print(json.dumps({"crossover_row": row}), flush=True)
+
+            if causal and args.windows:
+                for w in [int(x) for x in args.windows.split(",")
+                          if x and int(x) < s]:
+                    fw = jax.jit(lambda q, k, v: fa.flash_attention(
+                        q, k, v, causal=True, window=w))
+                    xw = jax.jit(lambda q, k, v: _sdpa_xla(
+                        q, k, v, None, scale, True, window=w))
+                    np.testing.assert_allclose(
+                        np.asarray(fw(q, k, v)),
+                        np.asarray(xw(q, k, v)), rtol=tol, atol=tol)
+                    twf = _slope_time(lambda: fw(q, k, v), args.iters)
+                    twx = _slope_time(lambda: xw(q, k, v), args.iters)
+                    print(json.dumps(
+                        {"window_row": {"seq": s, "window": w,
+                                        "flash_banded_ms":
+                                            round(twf, 3),
+                                        "xla_banded_ms":
+                                            round(twx, 3),
+                                        "flash_vs_full_causal":
+                                            round(tf / twf, 3),
+                                        "xla_vs_flash_banded":
+                                            round(twx / twf, 3)}}),
+                        flush=True)
 
             if args.block_sweep:
                 for bq, bk in ((128, 128), (128, 256), (256, 128),

@@ -42,19 +42,27 @@ class RMSNormBlock(HybridBlock):
 
 class _LlamaAttention(HybridBlock):
     def __init__(self, units, num_heads, num_kv_heads, rope_base,
-                 attn_impl="sdpa", sp_axis="sp", **kwargs):
+                 attn_impl="sdpa", sp_axis="sp", sliding_window=None,
+                 **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} % num_heads {num_heads}")
         if num_heads % num_kv_heads:
             raise MXNetError("num_heads must be a multiple of "
                              "num_kv_heads (GQA groups)")
+        if sliding_window is not None and attn_impl == "ring":
+            raise MXNetError(
+                "sliding_window with attn_impl='ring' is not "
+                "supported: the band already caps per-query compute "
+                "at O(W) — use the sdpa/flash path, or ring WITHOUT "
+                "a window for full-causal sequence parallelism")
         self._h = num_heads
         self._kv = num_kv_heads
         self._d = units // num_heads
         self._base = rope_base
         self._impl = attn_impl
         self._sp_axis = sp_axis
+        self._window = sliding_window
         with self.name_scope():
             self.q_proj = nn.Dense(num_heads * self._d, flatten=False,
                                    use_bias=False, in_units=units,
@@ -83,7 +91,8 @@ class _LlamaAttention(HybridBlock):
         v = self.v_proj(x).reshape((b, s, kv, d))
         nd._cache_update(cache_k, k, offset=0, out=cache_k)
         nd._cache_update(cache_v, v, offset=0, out=cache_v)
-        out = nd.dot_product_attention(q, k, v, causal=True)
+        out = nd.dot_product_attention(q, k, v, causal=True,
+                                       window=self._window)
         return self.o_proj(out.reshape((b, s, h * d)))
 
     def step(self, x, cache_k, cache_v, offset, mask):
@@ -123,7 +132,8 @@ class _LlamaAttention(HybridBlock):
                                          causal=True)
         else:
             # GQA is native in the attention op (grouped einsum)
-            out = F.dot_product_attention(q, k, v, causal=True)
+            out = F.dot_product_attention(q, k, v, causal=True,
+                                          window=self._window)
         return self.o_proj(out.reshape((b, s, h * d)))
 
 
@@ -150,13 +160,15 @@ class _LlamaMLP(HybridBlock):
 
 class _LlamaLayer(HybridBlock):
     def __init__(self, units, hidden, num_heads, num_kv_heads,
-                 rope_base, attn_impl, sp_axis="sp", **kwargs):
+                 rope_base, attn_impl, sp_axis="sp",
+                 sliding_window=None, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.input_norm = RMSNormBlock(units, prefix="innorm_")
             self.attn = _LlamaAttention(units, num_heads, num_kv_heads,
                                         rope_base, attn_impl,
                                         sp_axis=sp_axis,
+                                        sliding_window=sliding_window,
                                         prefix="attn_")
             self.post_norm = RMSNormBlock(units, prefix="postnorm_")
             self.mlp = _LlamaMLP(units, hidden, prefix="mlp_")
@@ -178,11 +190,13 @@ class _LlamaLayer(HybridBlock):
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size, units, hidden, num_layers, num_heads,
                  num_kv_heads=None, rope_base=10000.0,
-                 attn_impl="sdpa", sp_axis="sp", **kwargs):
+                 attn_impl="sdpa", sp_axis="sp", sliding_window=None,
+                 **kwargs):
         super().__init__(**kwargs)
         num_kv_heads = num_kv_heads or num_heads
         self._units = units
         self.vocab_size = vocab_size
+        self.sliding_window = sliding_window
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units,
                                       prefix="embed_")
@@ -191,6 +205,7 @@ class LlamaModel(HybridBlock):
                 layer = _LlamaLayer(units, hidden, num_heads,
                                     num_kv_heads, rope_base, attn_impl,
                                     sp_axis=sp_axis,
+                                    sliding_window=sliding_window,
                                     prefix=f"layer{i}_")
                 self.register_child(layer, f"layer{i}")
                 self.layers.append(layer)
@@ -277,8 +292,15 @@ class LlamaForCausalLM(HybridBlock):
         # per-step path) or a 0-d NDArray (the fused on-device
         # generation loop carries it through lax.scan).
         off = offset if isinstance(offset, nd.NDArray) else float(offset)
-        mask = (nd.arange(max_len, ctx=token.context)
-                <= off).reshape((1, 1, 1, max_len))
+        pos = nd.arange(max_len, ctx=token.context)
+        mask = pos <= off
+        w = self.model.sliding_window
+        if w is not None:
+            # sliding window at decode: only the last W cache entries
+            # are live — (off-W, off], same band the prefill kernels
+            # apply, so train/prefill/decode agree exactly
+            mask = mask * (pos > off - float(w))
+        mask = mask.reshape((1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.step(x, ck, cv, offset, mask)
         h = self.model.final_norm(x)
@@ -464,6 +486,15 @@ _LLAMA_SPECS = {
     "llama3_8b": dict(units=4096, hidden=14336, num_layers=32,
                       num_heads=32, num_kv_heads=8,
                       rope_base=500000.0),
+    # Mistral-style sliding-window test config: band of 32 positions —
+    # the kernels skip out-of-band blocks, O(S·W) attention
+    "mistral_tiny": dict(units=64, hidden=176, num_layers=2,
+                         num_heads=4, num_kv_heads=2,
+                         rope_base=10000.0, sliding_window=32),
+    # Mistral-7B-v0.1 geometry (sliding_window=4096)
+    "mistral_7b": dict(units=4096, hidden=14336, num_layers=32,
+                       num_heads=32, num_kv_heads=8,
+                       rope_base=10000.0, sliding_window=4096),
 }
 
 

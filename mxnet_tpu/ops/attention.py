@@ -28,7 +28,17 @@ def flash_dispatch_count() -> int:
     return _FLASH_DISPATCHES
 
 
-def _sdpa_xla(q, k, v, mask, scale, causal):
+def _causal_band(s_q, s_k, window):
+    """Causal mask, optionally banded: query i keeps keys in
+    (i+off-window, i+off] with off = s_k - s_q (sliding window)."""
+    cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+    if window is not None:
+        cm &= ~jnp.tril(jnp.ones((s_q, s_k), bool),
+                        k=s_k - s_q - int(window))
+    return cm
+
+
+def _sdpa_xla(q, k, v, mask, scale, causal, window=None):
     """Reference XLA path: (B, S, H, D) layout.
 
     Grouped-query attention is native: when K/V carry fewer heads than
@@ -48,7 +58,7 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
         logits = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k) * scale
         neg = jnp.asarray(-1e30, logits.dtype)
         if causal:
-            cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+            cm = _causal_band(s_q, s_k, window)
             logits = jnp.where(cm[None, None, None], logits, neg)
         if mask is not None:
             m = mask.astype(bool)
@@ -69,7 +79,7 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
     neg = jnp.asarray(-1e30, logits.dtype)
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
-        cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        cm = _causal_band(s_q, s_k, window)
         logits = jnp.where(cm[None, None], logits, neg)
     if mask is not None:
         logits = jnp.where(mask.astype(bool), logits, neg)
@@ -81,14 +91,34 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
 @register("dot_product_attention", num_inputs=None)
 def dot_product_attention(query, key, value, *rest, num_heads=1,
                           scale=None, causal=False, use_mask=False,
-                          flash=True):
+                          flash=True, window=None):
     """Fused multi-head SDPA.
 
     Inputs are (batch, seq, num_heads, head_dim); optional boolean mask
     (batch, 1|num_heads, seq_q, seq_k) as a 4th input when use_mask.
-    Returns (batch, seq, num_heads, head_dim).
+    ``window`` applies a sliding-window band to the causal mask
+    (Mistral-style; requires causal=True).  Returns (batch, seq,
+    num_heads, head_dim).
     """
     mask = rest[0] if use_mask and rest else None
+    if window is not None:
+        # validate HERE so the XLA fallback cannot silently produce
+        # uniform-attention garbage (window=0 clears the whole causal
+        # mask) while the flash path raises for the identical call
+        from ..base import MXNetError
+        if not causal:
+            raise MXNetError("dot_product_attention: window= requires "
+                             "causal=True (sliding window is a banded "
+                             "causal mask)")
+        if int(window) <= 0:
+            raise MXNetError("dot_product_attention: window must be "
+                             f"positive, got {window}")
+        if int(window) >= key.shape[1]:
+            # band wider than the keys = plain causal: clamp BEFORE the
+            # path choice so the measured flash-vs-XLA policy still
+            # applies (forcing flash here would pick the slower kernel
+            # exactly in the XLA-wins range)
+            window = None
     d = query.shape[-1]
     s = scale if scale is not None else 1.0 / np.sqrt(d)
     from .flash_attention import _as_key_padding
@@ -101,9 +131,12 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
         # normalize the documented 2-D key-padding form for the XLA
         # path too (the shape RULE lives only in _as_key_padding)
         mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
+    # a sliding window always prefers the kernel: block-skip makes it
+    # O(S·W) while the XLA path still materializes the S×S band
+    preferred = (window is not None
+                 or _flash_preferred(query.shape[1], key.shape[1]))
     if flash and (mask is None or kmask is not None) \
-            and _flash_viable(query, key) \
-            and _flash_preferred(query.shape[1], key.shape[1]):
+            and _flash_viable(query, key) and preferred:
         # dispatch evidence: incremented at TRACE time, so a nonzero
         # count proves the compiled program contains the Pallas kernel
         # (bench asserts this instead of hoping — VERDICT r2 weak #2)
@@ -119,8 +152,8 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
             key = jnp.repeat(key, rep, axis=2)
             value = jnp.repeat(value, rep, axis=2)
         return flash_attention(query, key, value, kmask=kmask, scale=s,
-                               causal=causal)
-    return _sdpa_xla(query, key, value, mask, s, causal)
+                               causal=causal, window=window)
+    return _sdpa_xla(query, key, value, mask, s, causal, window=window)
 
 
 def _flash_preferred(s_q, s_k):

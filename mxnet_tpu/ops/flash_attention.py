@@ -50,7 +50,8 @@ _INTERPRET = bool(os.environ.get("MXTPU_FLASH_INTERPRET"))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                num_k_blocks, causal_offset, emit_lse, with_kmask):
+                num_k_blocks, causal_offset, emit_lse, with_kmask,
+                window=None):
     """One (batch*head, q-block, k-block) grid step.
 
     The k-block loop lives in the GRID (innermost dim, sequential on TPU)
@@ -102,8 +103,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + np.int32(causal_offset) >= k_pos, s,
-                          -1e30)
+            keep = q_pos + np.int32(causal_offset) >= k_pos
+            if window is not None:
+                # sliding window (Mistral-style band): query i attends
+                # keys in (i+offset-W, i+offset]
+                keep &= k_pos > q_pos + np.int32(causal_offset - window)
+            s = jnp.where(keep, s, -1e30)
         if with_kmask:
             # key-padding mask row for this (batch, k-block): keep=True
             s = jnp.where(kmask_ref[...][:1] > 0, s, -1e30)
@@ -141,6 +146,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         visible = (q_idx * np.int32(block_q)
                    + np.int32(block_q - 1 + causal_offset)
                    >= kb * np.int32(block_k))
+        if window is not None:
+            # band's other edge: block dead once its LAST key falls at
+            # or below the FIRST query's window floor — with offset>=0
+            # every row still attends >= 1 key (its own diagonal), so
+            # the skip stays division-safe.  This is what makes sliding
+            # window O(S·W): only ~W/block_k + 1 k-blocks per q-block
+            # survive, independent of S.
+            visible &= (kb * np.int32(block_k) + np.int32(block_k - 1)
+                        > q_idx * np.int32(block_q)
+                        + np.int32(causal_offset - window))
         pl.when(visible)(_accum)
     else:
         _accum()
@@ -197,7 +212,7 @@ def _unfold(x, b, h, s, d):
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
-                      kmask=None):
+                      kmask=None, window=None):
     """q,k,v: (B, S, H, D) → (out (B, S, H, D), lse (B*H, S_q, 128) or
     None when ``want_lse=False`` — the inference path skips the LSE
     output entirely rather than writing HBM it will discard).
@@ -231,7 +246,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
                                num_k_blocks=num_k_blocks,
                                causal_offset=s_k - s_q,
                                emit_lse=want_lse,
-                               with_kmask=kmask is not None)
+                               with_kmask=kmask is not None,
+                               window=window)
     zero, q_spec, k_spec = _blocked_specs(d, bq, bk)
     lse_spec = pl.BlockSpec((None, bq, _LANE),
                             lambda i, j, kb: (i, j, zero(i)))
@@ -261,7 +277,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, want_lse=True,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
-               scale, causal, num_k_blocks, causal_offset, with_kmask):
+               scale, causal, num_k_blocks, causal_offset, with_kmask,
+               window=None):
     from jax.experimental import pallas as pl
 
     rest = list(rest)
@@ -299,6 +316,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
             k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             mask = q_pos + np.int32(causal_offset) >= k_pos
+            if window is not None:
+                mask &= k_pos > q_pos + np.int32(causal_offset - window)
             s_m = jnp.where(mask, s, -1e30)
         else:
             s_m = s
@@ -327,6 +346,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         visible = (q_idx * np.int32(block_q)
                    + np.int32(block_q - 1 + causal_offset)
                    >= kb * np.int32(block_k))
+        if window is not None:
+            visible &= (kb * np.int32(block_k) + np.int32(block_k - 1)
+                        > q_idx * np.int32(block_q)
+                        + np.int32(causal_offset - window))
         pl.when(visible)(_accum)
     else:
         _accum()
@@ -337,7 +360,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
-                scale, causal, num_q_blocks, causal_offset, with_kmask):
+                scale, causal, num_q_blocks, causal_offset, with_kmask,
+                window=None):
     from jax.experimental import pallas as pl
 
     rest = list(rest)
@@ -373,6 +397,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
             k_pos = kb * np.int32(block_k) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             mask = q_pos + np.int32(causal_offset) >= k_pos
+            if window is not None:
+                mask &= k_pos > q_pos + np.int32(causal_offset - window)
             s_m = jnp.where(mask, s, -1e30)
         else:
             s_m = s
@@ -403,6 +429,12 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
         visible = (qb * np.int32(block_q)
                    + np.int32(block_q - 1 + causal_offset)
                    >= kb * np.int32(block_k))
+        if window is not None:
+            # band floor: this k-block is past every window when its
+            # last key <= the q-block's first query's floor
+            visible &= (kb * np.int32(block_k) + np.int32(block_k - 1)
+                        > qb * np.int32(block_q)
+                        + np.int32(causal_offset - window))
         pl.when(visible)(_accum)
     else:
         _accum()
@@ -414,7 +446,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
-                      kmask=None):
+                      kmask=None, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -457,7 +489,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           num_k_blocks=num_k_blocks,
                           causal_offset=causal_offset,
-                          with_kmask=kmask is not None),
+                          with_kmask=kmask is not None,
+                          window=window),
         grid=(b * h, num_q_blocks, num_k_blocks),
         in_specs=dq_in_specs,
         out_specs=q_spec,
@@ -484,7 +517,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           num_q_blocks=num_q_blocks,
                           causal_offset=causal_offset,
-                          with_kmask=kmask is not None),
+                          with_kmask=kmask is not None,
+                          window=window),
         grid=(b * h, num_k_blocks, num_q_blocks),
         in_specs=dkv_in_specs,
         out_specs=[kk_spec, kk_spec],
@@ -501,27 +535,28 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, kmask, scale, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, kmask, scale, causal, window):
     # primal (inference) path: no LSE output at all
     out, _ = _flash_fwd_pallas(q, k, v, scale, causal, want_lse=False,
-                               kmask=kmask)
+                               kmask=kmask, window=window)
     return out
 
 
-def _flash_fwd(q, k, v, kmask, scale, causal):
-    out, lse = _flash_fwd_pallas(q, k, v, scale, causal, kmask=kmask)
+def _flash_fwd(q, k, v, kmask, scale, causal, window):
+    out, lse = _flash_fwd_pallas(q, k, v, scale, causal, kmask=kmask,
+                                 window=window)
     # residual holds ONE lane of the lane-replicated LSE: the full
     # (BH, S, 128) copy would cost 128x the HBM across the fwd→bwd
     # interval on exactly the long-context runs flash exists for
     return out, (q, k, v, out, lse[:, :, :1], kmask)
 
 
-def _flash_bwd(scale, causal, res, g):
+def _flash_bwd(scale, causal, window, res, g):
     q, k, v, out, lse1, kmask = res
     lse = jnp.broadcast_to(lse1, lse1.shape[:2] + (_LANE,))
     dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
-                                   kmask=kmask)
+                                   kmask=kmask, window=window)
     return dq, dk, dv, None
 
 
@@ -574,15 +609,33 @@ def _as_key_padding(mask, batch=None, s_k=None, s_q=None):
 
 
 def flash_attention(q, k, v, mask=None, scale=None, causal=False,
-                    kmask=None):
+                    kmask=None, window=None):
     """Flash attention; (B, S, H, D) in/out.
 
     Key-padding masks ((B, 1, 1, S_k) or (B, S_k)) run INSIDE the
     kernels (fwd and both bwd passes); general query-dependent masks
     fall back to the XLA path.  Dispatchers that already normalized the
-    mask pass ``kmask`` directly (avoids a second conversion)."""
+    mask pass ``kmask`` directly (avoids a second conversion).
+
+    ``window``: sliding-window (banded causal, Mistral-style) width —
+    query i attends keys (i+off-W, i+off].  Requires ``causal=True``.
+    The kernels SKIP out-of-band blocks, so compute is O(S·W) instead
+    of O(S²) — the long-context shape ring attention composes with."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    if window is not None:
+        window = int(window)
+        if not causal:
+            from ..base import MXNetError
+            raise MXNetError(
+                "flash_attention: window= requires causal=True "
+                "(sliding window is a banded CAUSAL mask)")
+        if window <= 0:
+            from ..base import MXNetError
+            raise MXNetError(f"flash_attention: window must be "
+                             f"positive, got {window}")
+        if window >= k.shape[1]:
+            window = None             # band wider than keys = causal
     if kmask is None and mask is not None:
         kmask = _as_key_padding(mask, batch=q.shape[0], s_k=k.shape[1],
                                 s_q=q.shape[1])
@@ -591,5 +644,6 @@ def flash_attention(q, k, v, mask=None, scale=None, causal=False,
             # pre-kernel behavior (ambiguous B==S_q 2-D masks raise
             # inside _as_key_padding instead)
             from .attention import _sdpa_xla
-            return _sdpa_xla(q, k, v, mask, scale, causal)
-    return _flash(q, k, v, kmask, float(scale), bool(causal))
+            return _sdpa_xla(q, k, v, mask, scale, causal,
+                             window=window)
+    return _flash(q, k, v, kmask, float(scale), bool(causal), window)

@@ -259,6 +259,131 @@ class TestFlashOnChip:
                                    rtol=2e-2, atol=2e-2)
 
 
+class TestSlidingWindow:
+    """Mistral-style banded causal attention: kernels skip out-of-band
+    blocks (O(S·W) compute); oracle is the banded XLA mask."""
+
+    @pytest.mark.parametrize("w", [32, 128, 200])
+    def test_fwd_matches_banded_oracle(self, interpret, w):
+        q, k, v = _rand_qkv(2, 256, 2, 64, seed=41)
+        got = fa_mod.flash_attention(q, k, v, causal=True, window=w)
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True, window=w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
+
+    def test_window_wider_than_seq_is_causal(self, interpret):
+        q, k, v = _rand_qkv(1, 128, 2, 64, seed=42)
+        got = fa_mod.flash_attention(q, k, v, causal=True, window=4096)
+        want = fa_mod.flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_bwd_matches_banded_oracle(self, interpret):
+        q, k, v = _rand_qkv(1, 256, 2, 64, seed=43)
+        rng = np.random.RandomState(44)
+        ct = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
+
+        def lf(q, k, v):
+            return (fa_mod.flash_attention(q, k, v, causal=True,
+                                           window=128) * ct).sum()
+
+        def lx(q, k, v):
+            return (_sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True,
+                              window=128) * ct).sum()
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(lx, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gx):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=_tol(5e-5),
+                atol=_tol(5e-5), err_msg=f"d{name} (window)")
+
+    def test_bwd_out_of_band_keys_zero_grad(self, interpret):
+        """In SELF-attention every key has at least one in-band query,
+        so exact-zero dK is only observable in cross-attention: with
+        s_q=128, s_k=256 (offset=128) and W=64, key j is attended by
+        queries [j-128, j-128+W-1] ∩ [0,127] — empty for j < 65.
+        Those keys must get EXACTLY zero dK/dV, and the rest must
+        match the banded oracle."""
+        rng = np.random.RandomState(48)
+        q = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+        k = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
+        v = jnp.asarray(rng.randn(1, 256, 2, 64).astype("f"))
+        ct = jnp.asarray(rng.randn(1, 128, 2, 64).astype("f"))
+
+        def lf(q, k, v):
+            return (fa_mod.flash_attention(q, k, v, causal=True,
+                                           window=64) * ct).sum()
+
+        def lx(q, k, v):
+            return (_sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True,
+                              window=64) * ct).sum()
+
+        gf = jax.grad(lf, argnums=(1, 2))(q, k, v)
+        gx = jax.grad(lx, argnums=(1, 2))(q, k, v)
+        for name, a, b in zip(("dk", "dv"), gf, gx):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=_tol(5e-5),
+                atol=_tol(5e-5), err_msg=name)
+            np.testing.assert_array_equal(np.asarray(a)[0, :65], 0.0)
+            assert np.abs(np.asarray(a)[0, 65:]).max() > 0
+
+    def test_window_with_key_padding(self, interpret):
+        q, k, v = _rand_qkv(2, 128, 2, 64, seed=45)
+        vlen = np.asarray([50, 128])
+        mask = jnp.asarray(
+            (np.arange(128)[None] < vlen[:, None])
+            [:, None, None, :].astype("f"))
+        got = fa_mod.flash_attention(q, k, v, mask=mask, causal=True,
+                                     window=64)
+        # oracle: banded causal + padding mask composed
+        from mxnet_tpu.ops.attention import _causal_band
+        band = _causal_band(128, 128, 64)
+        full = mask.astype(bool) & band[None, None]
+        want = _sdpa_xla(q, k, v, full.astype("float32"),
+                         1 / np.sqrt(64), False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
+
+    def test_window_requires_causal(self, interpret):
+        from mxnet_tpu.base import MXNetError
+        q, k, v = _rand_qkv(1, 128, 2, 64)
+        with pytest.raises(MXNetError, match="causal"):
+            fa_mod.flash_attention(q, k, v, window=64)
+        from mxnet_tpu.ops.attention import dot_product_attention
+        with pytest.raises(MXNetError, match="causal"):
+            dot_product_attention(q, k, v, window=64)
+
+    def test_dispatch_prefers_flash_for_window(self, interpret,
+                                               monkeypatch):
+        """A banded call takes the kernel even at seqs where the
+        full-causal policy picks XLA (band = O(S·W) in the kernel,
+        still O(S²) HBM on the XLA path)."""
+        from mxnet_tpu.ops import attention as attn
+        q, k, v = _rand_qkv(1, 256, 2, 64, seed=46)
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "256")
+        before = attn.flash_dispatch_count()
+        out = attn.dot_product_attention(q, k, v, causal=True,
+                                         window=128)
+        assert attn.flash_dispatch_count() == before + 1
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True,
+                         window=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
+
+    @pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128)])
+    def test_window_nondefault_blocks(self, interpret, monkeypatch,
+                                      bq, bk):
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_Q", str(bq))
+        monkeypatch.setenv("MXTPU_FLASH_BLOCK_K", str(bk))
+        q, k, v = _rand_qkv(1, 256, 2, 64, seed=47)
+        got = fa_mod.flash_attention(q, k, v, causal=True, window=100)
+        want = _sdpa_xla(q, k, v, None, 1 / np.sqrt(64), True,
+                         window=100)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=_tol(2e-5), atol=_tol(2e-5))
+
+
 class TestFlashSelection:
     def test_auto_policy_crossover(self, monkeypatch):
         """Auto mode: flash below the measured XLA-win window, XLA
