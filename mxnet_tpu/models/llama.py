@@ -239,10 +239,17 @@ class LlamaForCausalLM(HybridBlock):
                                         in_units=model._units,
                                         prefix="head_")
 
+    def _head_weight(self, ctx):
+        """The (V, U) LM-head matrix — the tied embedding or the
+        untied head's Dense weight (one place for the branch: shared by
+        hybrid_forward, _head, and the chunked loss)."""
+        return (self.model.embed.weight.data(ctx) if self._tied
+                else self.lm_head.weight.data(ctx))
+
     def hybrid_forward(self, F, tokens):
         h = self.model(tokens)
         if self._tied:
-            w = self.model.embed.weight.data(h.context)
+            w = self._head_weight(h.context)
             b, s, u = h.shape
             return F.dot(h.reshape((b * s, u)), w,
                          transpose_b=True).reshape(
@@ -264,8 +271,8 @@ class LlamaForCausalLM(HybridBlock):
         """LM-head projection shared by full-forward and decode paths."""
         from .. import ndarray as nd
         if self._tied:
-            w = self.model.embed.weight.data(h.context)
-            return nd.dot(h.reshape((-1, self.model._units)), w,
+            return nd.dot(h.reshape((-1, self.model._units)),
+                          self._head_weight(h.context),
                           transpose_b=True)
         return self.lm_head(h).reshape((-1, self.model.vocab_size))
 
@@ -464,10 +471,30 @@ class LlamaForCausalLM(HybridBlock):
                  jnp.asarray(float(temperature or 1.0), jnp.float32))
         return NDArray(out, ctx=ctx)
 
-    def loss(self, tokens):
-        """Next-token cross-entropy over ``tokens`` (B, S) → scalar."""
+    def loss(self, tokens, vocab_chunk=None):
+        """Next-token cross-entropy over ``tokens`` (B, S) → scalar.
+
+        ``vocab_chunk`` (or automatically at vocab ≥ 32768) streams
+        the LM head through ``chunked_softmax_ce``: the (B·S, V)
+        logits tensor — 16.8 GB f32 at Llama-3-8B b8 s4096, over a
+        v5e's HBM — is never materialized; activation memory is
+        O(B·S·chunk) with the slab recomputed in backward."""
         from .. import ndarray as nd
         from ..gluon.loss import SoftmaxCrossEntropyLoss
+        v = self.model.vocab_size
+        if vocab_chunk is None and v >= 32768:
+            vocab_chunk = 8192
+        if vocab_chunk:
+            h = self.model(tokens)                     # (B, S, U)
+            u = self.model._units
+            hid = nd.slice_axis(h, axis=1, begin=0,
+                                end=-1).reshape((-1, u))
+            labels = nd.slice_axis(tokens, axis=1, begin=1,
+                                   end=None).reshape((-1,))
+            per_row = nd.chunked_softmax_ce(
+                hid, self._head_weight(h.context), labels,
+                chunk=int(vocab_chunk))
+            return per_row.mean()
         logits = self(tokens)
         sce = SoftmaxCrossEntropyLoss()
         b, s, v = logits.shape

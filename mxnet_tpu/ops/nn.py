@@ -189,6 +189,68 @@ def softmax_cross_entropy(data, label):
     return -jnp.sum(picked)
 
 
+@register("chunked_softmax_ce", num_inputs=3)
+def chunked_softmax_ce(hidden, weight, label, *, chunk=8192):
+    """Streaming large-vocab cross-entropy: per-row
+    ``logsumexp(h @ Wᵀ) - (h @ Wᵀ)[label]`` WITHOUT materializing the
+    (N, V) logits.
+
+    The reference (and the naive ``loss`` path) computes full logits
+    then softmax CE — at Llama-3-8B vocab (128256), batch 8 × seq 4096
+    that is a 16.8 GB f32 activation, over a v5e's entire HBM.  Here a
+    ``lax.scan`` walks W in (chunk, U) slabs keeping only the running
+    (max, sumexp, label-logit) carry, and ``jax.checkpoint`` on the
+    slab body makes the BACKWARD recompute each slab's logits instead
+    of saving them — peak activation O(N·chunk), compute unchanged
+    (one extra fwd pass for the remat, the standard trade).
+
+    hidden (N, U); weight (V, U) — the tied embedding or LM-head
+    matrix (gradients flow to both inputs); label (N,) int.  Returns
+    per-row loss (N,), f32.
+    """
+    n, u = hidden.shape
+    v = weight.shape[0]
+    chunk = int(min(chunk, v))
+    n_chunks = -(-v // chunk)
+    # re-balance so the slabs tile V with minimal padding: the naive
+    # ceil split pads up to chunk-1 rows (2816 at Llama-3's 128256 /
+    # 8192 — a ~2 GB padded weight copy each step); ceil(v/n_chunks)
+    # pads < n_chunks rows (usually 0: 128256 → 16 slabs of 8016)
+    chunk = -(-v // n_chunks)
+    pad = n_chunks * chunk - v
+    w = jnp.pad(weight, ((0, pad), (0, 0))) if pad else weight
+    w = w.reshape(n_chunks, chunk, u)
+    lbl = label.astype(jnp.int32)
+
+    @jax.checkpoint
+    def slab(carry, wc_i):
+        m, s, lab = carry
+        wc, i = wc_i
+        logits = jnp.dot(hidden, wc.T,
+                         preferred_element_type=jnp.float32)
+        if pad:
+            # padded vocab rows must not enter the normalizer
+            col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            logits = jnp.where(i * chunk + col < v, logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=1)
+        idx = lbl - i * chunk
+        in_range = (idx >= 0) & (idx < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None],
+            axis=1)[:, 0]
+        lab = lab + jnp.where(in_range, picked, 0.0)
+        return (m_new, s, lab), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, lab), _ = jax.lax.scan(
+        slab, init, (w, jnp.arange(n_chunks, dtype=jnp.int32)))
+    return m + jnp.log(s) - lab
+
+
 # ---------------------------------------------------------------------------
 # convolution — reference convolution.cc / deconvolution.cc
 # ---------------------------------------------------------------------------
