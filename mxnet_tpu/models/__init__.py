@@ -11,6 +11,9 @@ from . import ssd
 from .ssd import SSD, ssd_tiny, MultiBoxLoss
 from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
                     llama_tiny, llama3_8b)
+from . import hf_loader
+from .hf_loader import (read_safetensors, write_safetensors,
+                        load_hf_llama, export_hf_llama)
 from . import nmt
 from .nmt import (TransformerNMT, BeamSearchScorer, BeamSearchSampler,
                   get_nmt, nmt_tiny, transformer_en_de_512)
@@ -25,7 +28,9 @@ from .pose import (SimplePose, PoseHeatmapLoss, PCKMetric,
 from . import rcnn
 from .rcnn import FasterRCNN, FasterRCNNLoss, faster_rcnn_tiny
 
-__all__ = ["ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
+__all__ = ["hf_loader", "read_safetensors", "write_safetensors",
+           "load_hf_llama", "export_hf_llama",
+           "ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
            "bert_small", "bert_large", "get_bert", "forecast",
            "DeepAR", "TransformerForecaster", "llama", "LlamaModel",

@@ -1,0 +1,274 @@
+"""HuggingFace safetensors checkpoint I/O for the Llama/Mistral family.
+
+The modern ecosystem analog of the dmlc ``.params`` reader
+(``ndarray/legacy_io.py``, reference ``src/ndarray/ndarray.cc`` save
+format): real Llama/Mistral weights ship as HF *safetensors* shards,
+and a framework that cannot ingest them strands its model zoo.  Pure
+stdlib + numpy/ml_dtypes — no safetensors package dependency.
+
+Format (https spec, stable since v0.3): 8-byte LE u64 header length,
+UTF-8 JSON header mapping tensor name → {dtype, shape, data_offsets},
+then one contiguous byte buffer.  Offsets are relative to the buffer.
+
+RoPE convention: HF Llama applies *rotate-half* (NeoX-style: pairs are
+(i, i+d/2)); this framework's ``rope`` op rotates ADJACENT pairs
+(GPT-J-style: (2i, 2i+1)).  With the per-head row permutation
+P[2i]=i, P[2i+1]=i+d/2 applied to W_q/W_k, the identities
+``rope_adj(P·x) == P·rope_neox(x)`` and ``(P·q)ᵀ(P·k) == qᵀk`` make
+attention outputs bit-for-bit equivalent — checked by
+``tests/test_hf_loader.py::test_rope_permutation_identity``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["read_safetensors", "write_safetensors", "load_hf_llama",
+           "export_hf_llama"]
+
+_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def _bf16():
+    import ml_dtypes
+    return ml_dtypes.bfloat16
+
+
+def _np_dtype(st_dtype):
+    if st_dtype == "BF16":
+        return _bf16()
+    try:
+        return _DTYPES[st_dtype]
+    except KeyError:
+        raise MXNetError(f"safetensors dtype {st_dtype!r} unsupported")
+
+
+def _st_dtype(arr):
+    if arr.dtype == _bf16():
+        return "BF16"
+    for name, dt in _DTYPES.items():
+        if arr.dtype == dt:
+            return name
+    raise MXNetError(f"cannot write dtype {arr.dtype} to safetensors")
+
+
+def read_safetensors(path):
+    """path → {name: np.ndarray} (zero-copy views onto one mmap)."""
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+    buf = np.memmap(path, dtype=np.uint8, mode="r", offset=8 + hlen)
+    out = {}
+    for name, spec in header.items():
+        if name == "__metadata__":
+            continue
+        dt = _np_dtype(spec["dtype"])
+        lo, hi = spec["data_offsets"]
+        out[name] = np.frombuffer(
+            buf[lo:hi], dtype=dt).reshape(spec["shape"])
+    return out
+
+
+def write_safetensors(path, tensors, metadata=None):
+    """{name: array-like} → one .safetensors file (sorted names,
+    contiguous buffer — the canonical layout)."""
+    header = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v)
+                                  for k, v in metadata.items()}
+    blobs = []
+    off = 0
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        nbytes = arr.nbytes
+        header[name] = {"dtype": _st_dtype(arr),
+                        "shape": list(arr.shape),
+                        "data_offsets": [off, off + nbytes]}
+        blobs.append(arr)
+        off += nbytes
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for arr in blobs:
+            f.write(arr.tobytes())
+
+
+def _shard_paths(path):
+    """A file, a sharded index json, or a directory → ordered shards."""
+    if os.path.isdir(path):
+        idx = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(idx):
+            return _shard_paths(idx)
+        one = os.path.join(path, "model.safetensors")
+        if os.path.exists(one):
+            return [one]
+        shards = sorted(
+            os.path.join(path, p) for p in os.listdir(path)
+            if p.endswith(".safetensors"))
+        if shards:
+            return shards
+        raise MXNetError(f"no .safetensors files under {path}")
+    if path.endswith(".index.json"):
+        with open(path) as f:
+            idx = json.load(f)
+        d = os.path.dirname(path)
+        return [os.path.join(d, p)
+                for p in sorted(set(idx["weight_map"].values()))]
+    return [path]
+
+
+def _rope_perm(d):
+    """NeoX(half-split) → adjacent-pair row order for one head."""
+    p = np.empty(d, np.int64)
+    p[0::2] = np.arange(d // 2)
+    p[1::2] = np.arange(d // 2) + d // 2
+    return p
+
+
+def _permute_qk(w, n_heads, d, invert=False):
+    """Permute per-head rows of a (n_heads*d, U) projection between
+    the HF rotate-half and this framework's adjacent-pair RoPE."""
+    w = np.asarray(w).reshape(n_heads, d, -1)
+    p = _rope_perm(d)
+    if invert:
+        inv = np.empty_like(p)
+        inv[p] = np.arange(d)
+        p = inv
+    return w[:, p].reshape(n_heads * d, -1)
+
+
+def _name_map(net):
+    """our param name → (hf name, kind) for a LlamaForCausalLM."""
+    model = net.model
+    ours = {}
+    for name in net.collect_params():
+        if name.endswith("embed_weight"):
+            ours[name] = ("model.embed_tokens.weight", "plain")
+        elif name.endswith("finalnorm_gamma"):
+            ours[name] = ("model.norm.weight", "plain")
+        elif name.endswith("head_weight"):
+            ours[name] = ("lm_head.weight", "plain")
+        else:
+            import re
+            m = re.search(r"layer(\d+)_(\w+)$", name)
+            if not m:
+                raise MXNetError(f"unmapped param {name!r}")
+            i, tail = int(m.group(1)), m.group(2)
+            hf = f"model.layers.{i}."
+            kind = "plain"
+            if tail == "innorm_gamma":
+                hf += "input_layernorm.weight"
+            elif tail == "postnorm_gamma":
+                hf += "post_attention_layernorm.weight"
+            elif tail == "attn_q_weight":
+                hf += "self_attn.q_proj.weight"
+                kind = "q"
+            elif tail == "attn_k_weight":
+                hf += "self_attn.k_proj.weight"
+                kind = "k"
+            elif tail == "attn_v_weight":
+                hf += "self_attn.v_proj.weight"
+            elif tail == "attn_o_weight":
+                hf += "self_attn.o_proj.weight"
+            elif tail == "mlp_gate_weight":
+                hf += "mlp.gate_proj.weight"
+            elif tail == "mlp_up_weight":
+                hf += "mlp.up_proj.weight"
+            elif tail == "mlp_down_weight":
+                hf += "mlp.down_proj.weight"
+            else:
+                raise MXNetError(f"unmapped param {name!r}")
+            ours[name] = (hf, kind)
+    return ours
+
+
+def load_hf_llama(net, path, ctx=None, dtype="float32",
+                  strict=True):
+    """Load HF Llama/Mistral safetensors weights into a
+    ``LlamaForCausalLM`` (single file, sharded index, or directory).
+
+    Tied-embedding models (Llama-3.2 style) may omit ``lm_head.weight``
+    in the checkpoint; untied nets require it.  ``strict`` errors on
+    missing/unused checkpoint tensors (rotary ``inv_freq`` buffers are
+    always ignored — they are derived, not parameters).
+    """
+    from .. import nd
+
+    tensors = {}
+    for shard in _shard_paths(path):
+        tensors.update(read_safetensors(shard))
+    attn = net.model.layers[0].attn
+    h, kv, d = attn._h, attn._kv, attn._d
+    used = set()
+    nmap = _name_map(net)
+    for name, param in net.collect_params().items():
+        hf_name, kind = nmap[name]
+        if hf_name not in tensors:
+            raise MXNetError(
+                f"checkpoint missing {hf_name!r} (for {name!r})")
+        arr = np.asarray(tensors[hf_name], np.float32)
+        if kind == "q":
+            arr = _permute_qk(arr, h, d)
+        elif kind == "k":
+            arr = _permute_qk(arr, kv, d)
+        if tuple(arr.shape) != tuple(param.shape):
+            raise MXNetError(
+                f"{hf_name!r} shape {arr.shape} != {name!r} "
+                f"shape {param.shape}")
+        param.set_data(nd.array(arr.astype(dtype, copy=False),
+                                ctx=ctx))
+        used.add(hf_name)
+    # a TIED net maps no param to lm_head.weight (there is no head
+    # child); a checkpoint that nevertheless ships one is only
+    # loadable if that head IS the embedding — an untied checkpoint
+    # loaded into a tied net would otherwise silently drop its head
+    if getattr(net, "_tied", False) and "lm_head.weight" in tensors \
+            and "lm_head.weight" not in used:
+        head = np.asarray(tensors["lm_head.weight"], np.float32)
+        emb = np.asarray(tensors["model.embed_tokens.weight"],
+                         np.float32)
+        if head.shape != emb.shape or not np.allclose(head, emb):
+            raise MXNetError(
+                "checkpoint has an UNTIED lm_head.weight but the net "
+                "was built with tie_embeddings=True — rebuild with "
+                "tie_embeddings=False or the head would be silently "
+                "replaced by the embedding")
+        used.add("lm_head.weight")
+    if strict:
+        extra = {t for t in tensors
+                 if t not in used and "rotary_emb" not in t}
+        if extra:
+            raise MXNetError(
+                f"checkpoint tensors with no destination: "
+                f"{sorted(extra)[:8]}{'...' if len(extra) > 8 else ''}")
+    return net
+
+
+def export_hf_llama(net, path, dtype=np.float32, metadata=None):
+    """Write a ``LlamaForCausalLM``'s weights as ONE HF-layout
+    safetensors file (inverse of :func:`load_hf_llama`, q/k rows
+    permuted back to rotate-half order)."""
+    attn = net.model.layers[0].attn
+    h, kv, d = attn._h, attn._kv, attn._d
+    out = {}
+    nmap = _name_map(net)
+    for name, param in net.collect_params().items():
+        hf_name, kind = nmap[name]
+        arr = param.data().asnumpy().astype(dtype)
+        if kind == "q":
+            arr = _permute_qk(arr, h, d, invert=True)
+        elif kind == "k":
+            arr = _permute_qk(arr, kv, d, invert=True)
+        out[hf_name] = arr
+    write_safetensors(path, out, metadata=metadata or
+                      {"format": "pt", "producer": "mxnet_tpu"})
