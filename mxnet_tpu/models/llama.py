@@ -77,10 +77,16 @@ class _LlamaAttention(HybridBlock):
                                    in_units=num_heads * self._d,
                                    prefix="o_")
 
-    def prefill(self, x, cache_k, cache_v):
+    def prefill(self, x, cache_k, cache_v, perm=None):
         """Batched prompt pass: full-sequence causal attention that
         also writes K/V for every prompt position into the caches —
-        one program instead of S sequential steps."""
+        one program instead of S sequential steps.
+
+        A cache SHORTER than the prompt is the rolling (sliding-
+        window) buffer: slot j must hold the newest absolute position
+        p ≡ j (mod C), so the prompt TAIL is written through the
+        ``perm`` slot permutation (built ONCE by the caller — it
+        depends only on (S, C), not the layer)."""
         from .. import ndarray as nd
         b, s = x.shape[0], x.shape[1]
         h, kv, d = self._h, self._kv, self._d
@@ -89,16 +95,25 @@ class _LlamaAttention(HybridBlock):
         k = nd.rope(self.k_proj(x).reshape((b, s, kv, d)),
                     base=self._base)
         v = self.v_proj(x).reshape((b, s, kv, d))
-        nd._cache_update(cache_k, k, offset=0, out=cache_k)
-        nd._cache_update(cache_v, v, offset=0, out=cache_v)
+        if perm is None:
+            nd._cache_update(cache_k, k, offset=0, out=cache_k)
+            nd._cache_update(cache_v, v, offset=0, out=cache_v)
+        else:
+            nd._cache_update(cache_k, nd.take(k, perm, axis=1),
+                             offset=0, out=cache_k)
+            nd._cache_update(cache_v, nd.take(v, perm, axis=1),
+                             offset=0, out=cache_v)
         out = nd.dot_product_attention(q, k, v, causal=True,
                                        window=self._window)
         return self.o_proj(out.reshape((b, s, h * d)))
 
-    def step(self, x, cache_k, cache_v, offset, mask):
+    def step(self, x, cache_k, cache_v, offset, mask, slot=None):
         """Incremental decode: x (B, 1, units), caches
-        (B, max_len, KV, D) written in place at ``offset``; ``mask``
-        is the shared key-validity mask built once per decode_step."""
+        (B, C, KV, D) written in place; ``mask`` is the shared
+        key-validity mask built once per decode_step.  ``offset`` is
+        the ABSOLUTE position (drives RoPE); ``slot`` is the cache
+        write index — ``offset % C`` for a rolling sliding-window
+        buffer, defaulting to ``offset`` for the classic cache."""
         from .. import ndarray as nd
         b = x.shape[0]
         h, kv, d = self._h, self._kv, self._d
@@ -108,8 +123,10 @@ class _LlamaAttention(HybridBlock):
                       offset=offset, base=self._base)
         v_t = self.v_proj(x).reshape((b, 1, kv, d))
         # dynamic-offset scatter: one compiled program for every step
-        nd._cache_update(cache_k, k_t, offset=offset, out=cache_k)
-        nd._cache_update(cache_v, v_t, offset=offset, out=cache_v)
+        if slot is None:
+            slot = offset
+        nd._cache_update(cache_k, k_t, offset=slot, out=cache_k)
+        nd._cache_update(cache_v, v_t, offset=slot, out=cache_v)
         # GQA is native in dot_product_attention: the unrepeated cache
         # is attended directly (no (B, max_len, H, D) materialization)
         out = nd.dot_product_attention(q, cache_k, cache_v, mask,
@@ -177,13 +194,14 @@ class _LlamaLayer(HybridBlock):
         x = x + self.attn(self.input_norm(x))
         return x + self.mlp(self.post_norm(x))
 
-    def prefill(self, x, cache_k, cache_v):
-        x = x + self.attn.prefill(self.input_norm(x), cache_k, cache_v)
+    def prefill(self, x, cache_k, cache_v, perm=None):
+        x = x + self.attn.prefill(self.input_norm(x), cache_k, cache_v,
+                                  perm=perm)
         return x + self.mlp(self.post_norm(x))
 
-    def step(self, x, cache_k, cache_v, offset, mask):
+    def step(self, x, cache_k, cache_v, offset, mask, slot=None):
         x = x + self.attn.step(self.input_norm(x), cache_k, cache_v,
-                               offset, mask)
+                               offset, mask, slot=slot)
         return x + self.mlp(self.post_norm(x))
 
 
@@ -256,13 +274,32 @@ class LlamaForCausalLM(HybridBlock):
                              (b, s, self.model.vocab_size))
         return self.lm_head(h)
 
-    def init_cache(self, batch_size, max_len, ctx=None):
-        """Preallocate per-layer KV caches (B, max_len, KV, D)."""
+    def _rolling_cache_len(self, max_len, rolling):
+        """Cache length for (max_len, rolling) — ONE place for the
+        rolling policy, shared by init_cache and generate_fused."""
+        if not rolling:
+            return max_len
+        w = self.model.sliding_window
+        if w is None:
+            raise MXNetError(
+                "rolling=True requires a model with sliding_window "
+                "set (Mistral-style)")
+        return min(int(w), max_len)
+
+    def init_cache(self, batch_size, max_len, ctx=None, rolling=False):
+        """Preallocate per-layer KV caches (B, C, KV, D).
+
+        ``rolling=True`` (sliding-window models only) allocates the
+        Mistral rolling buffer: C = min(sliding_window, max_len), so
+        decode memory is O(W) regardless of generation length —
+        positions wrap via ``offset % C`` and out-of-window entries
+        are overwritten exactly when they leave the band."""
         from .. import ndarray as nd
+        cache_len = self._rolling_cache_len(max_len, rolling)
         caches = []
         for layer in self.model.layers:
             a = layer.attn
-            shp = (batch_size, max_len, a._kv, a._d)
+            shp = (batch_size, cache_len, a._kv, a._d)
             caches.append((nd.zeros(shp, ctx=ctx),
                            nd.zeros(shp, ctx=ctx)))
         return caches
@@ -279,9 +316,22 @@ class LlamaForCausalLM(HybridBlock):
     def prefill(self, tokens, caches):
         """Batched prompt pass filling the caches; returns the LAST
         position's logits (B, vocab)."""
+        import numpy as np
+        from .. import ndarray as nd
         x = self.model.embed(tokens)
+        s = tokens.shape[1]
+        c = caches[0][0].shape[1]
+        perm = None
+        if s > c:
+            # rolling buffer shorter than the prompt: slot j holds the
+            # newest position p ≡ j (mod C); one permutation for ALL
+            # layers (it depends only on (S, C))
+            start = s - c
+            perm = nd.array(
+                (start + (np.arange(c) - start) % c).astype("f4"),
+                ctx=tokens.context)
         for layer, (ck, cv) in zip(self.model.layers, caches):
-            x = layer.prefill(x, ck, cv)
+            x = layer.prefill(x, ck, cv, perm=perm)
         h = self.model.final_norm(x)
         return self._head(h[:, -1:])
 
@@ -300,34 +350,52 @@ class LlamaForCausalLM(HybridBlock):
         # generation loop carries it through lax.scan).
         off = offset if isinstance(offset, nd.NDArray) else float(offset)
         pos = nd.arange(max_len, ctx=token.context)
-        mask = pos <= off
         w = self.model.sliding_window
-        if w is not None:
-            # sliding window at decode: only the last W cache entries
-            # are live — (off-W, off], same band the prefill kernels
-            # apply, so train/prefill/decode agree exactly
-            mask = mask * (pos > off - float(w))
+        slot = None
+        if w is not None and max_len <= int(w):
+            # ROLLING buffer (cache holds exactly the window): slot
+            # j's absolute position is off - ((off - j) mod C), always
+            # inside (off-C, off] — every WRITTEN slot is valid.
+            # Validity is just "written": j <= off, or everything once
+            # the buffer has wrapped (off >= C).
+            c = float(max_len)
+            slot = off % c
+            # validity is just "slot written yet": pos <= off covers
+            # both regimes — after the buffer wraps (off >= c) it is
+            # all-true, which is exactly right (every slot then holds
+            # a position inside the window)
+            mask = pos <= off
+        else:
+            mask = pos <= off
+            if w is not None:
+                # classic full cache + sliding window: only the last W
+                # entries are live — (off-W, off], same band the
+                # prefill kernels apply
+                mask = mask * (pos > off - float(w))
         mask = mask.reshape((1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
-            x = layer.step(x, ck, cv, offset, mask)
+            x = layer.step(x, ck, cv, offset, mask, slot=slot)
         h = self.model.final_norm(x)
         return self._head(h)
 
     def generate(self, tokens, max_new_tokens, temperature=0.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, rolling=False):
         """Autoregressive generation with a KV cache.
 
         tokens: (B, S) prompt NDArray.  Greedy when ``temperature=0``;
         otherwise softmax sampling with optional top-k truncation.
         Each step reuses ONE compiled program — positions ride the
         dynamic rope offset and the cache mask, so nothing recompiles
-        as the sequence grows.  Returns (B, S + max_new_tokens).
+        as the sequence grows.  ``rolling=True`` (sliding-window
+        models) bounds cache memory at O(W) via the rolling buffer.
+        Returns (B, S + max_new_tokens).
         """
         import numpy as np
         from .. import ndarray as nd
         b, s = tokens.shape
         max_len = s + max_new_tokens
-        caches = self.init_cache(b, max_len, ctx=tokens.context)
+        caches = self.init_cache(b, max_len, ctx=tokens.context,
+                                 rolling=rolling)
         rng = np.random.RandomState(seed)
         out_tokens = [tokens.asnumpy()]
         logits = self.prefill(tokens, caches)  # one batched program
@@ -356,7 +424,7 @@ class LlamaForCausalLM(HybridBlock):
                         ctx=tokens.context)
 
     def generate_fused(self, tokens, max_new_tokens, temperature=0.0,
-                       top_k=0, seed=0):
+                       top_k=0, seed=0, rolling=False):
         """Whole-generation as ONE compiled program.
 
         Same contract as :meth:`generate`, but prefill + every decode
@@ -393,12 +461,14 @@ class LlamaForCausalLM(HybridBlock):
         kk = min(int(top_k), self.model.vocab_size) \
             if (top_k and sample) else 0
 
+        cache_len = self._rolling_cache_len(max_len, rolling)
         cache_shapes = []
         for layer in self.model.layers:
             a = layer.attn
-            cache_shapes.append((b, max_len, a._kv, a._d))
+            cache_shapes.append((b, cache_len, a._kv, a._d))
 
-        key = (b, s, max_new_tokens, sample, kk, str(tokens.dtype))
+        key = (b, s, max_new_tokens, sample, kk, rolling,
+               str(tokens.dtype))
         cache = getattr(self, "_gen_fused_cache", None)
         if cache is None:
             cache = self._gen_fused_cache = {}

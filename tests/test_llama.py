@@ -619,3 +619,55 @@ class TestChunkedCE:
             pytest.skip("memory_analysis unavailable on this backend")
         assert mc.temp_size_in_bytes < mf.temp_size_in_bytes, (
             mc.temp_size_in_bytes, mf.temp_size_in_bytes)
+
+
+class TestRollingCache:
+    """Mistral rolling KV buffer: decode memory O(W) regardless of
+    generation length; parity with the full-length cache."""
+
+    def _mnet(self):
+        from mxnet_tpu.models import get_llama
+        net = LlamaForCausalLM(get_llama("mistral_tiny", vocab_size=V))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def test_cache_is_window_sized(self):
+        net = self._mnet()
+        caches = net.init_cache(2, 100, rolling=True)
+        assert caches[0][0].shape == (2, 32, 2, 16)   # C == W == 32
+        full = net.init_cache(2, 100)
+        assert full[0][0].shape[1] == 100
+
+    def test_rolling_requires_window(self):
+        from mxnet_tpu.base import MXNetError
+        net = _net()                    # full-causal llama_tiny
+        with pytest.raises(MXNetError, match="sliding_window"):
+            net.init_cache(2, 64, rolling=True)
+        with pytest.raises(MXNetError, match="sliding_window"):
+            net.generate_fused(_tokens(b=1, s=4), 4, rolling=True)
+
+    def test_generate_parity_across_wrap(self):
+        """40 new tokens on a W=32 buffer: positions wrap the ring,
+        and greedy output must equal the full-cache path exactly."""
+        net = self._mnet()
+        toks = _tokens(seed=30, b=2, s=8)
+        full = net.generate(toks, 40).asnumpy()
+        roll = net.generate(toks, 40, rolling=True).asnumpy()
+        np.testing.assert_array_equal(roll, full)
+
+    def test_prompt_longer_than_window(self):
+        """Prefill with S=40 > W=32 writes the prompt TAIL through
+        the slot permutation; continued decode must match the
+        full-cache path."""
+        net = self._mnet()
+        toks = _tokens(seed=31, b=2, s=40)
+        full = net.generate(toks, 12).asnumpy()
+        roll = net.generate(toks, 12, rolling=True).asnumpy()
+        np.testing.assert_array_equal(roll, full)
+
+    def test_generate_fused_rolling(self):
+        net = self._mnet()
+        toks = _tokens(seed=32, b=2, s=8)
+        full = net.generate_fused(toks, 40).asnumpy()
+        roll = net.generate_fused(toks, 40, rolling=True).asnumpy()
+        np.testing.assert_array_equal(roll, full)
