@@ -13,7 +13,8 @@ from .llama import (LlamaModel, LlamaForCausalLM, get_llama,
                     llama_tiny, llama3_8b)
 from . import hf_loader
 from .hf_loader import (read_safetensors, write_safetensors,
-                        load_hf_llama, export_hf_llama)
+                        load_hf_llama, export_hf_llama,
+                        load_hf_bert, export_hf_bert)
 from . import nmt
 from .nmt import (TransformerNMT, BeamSearchScorer, BeamSearchSampler,
                   get_nmt, nmt_tiny, transformer_en_de_512)
@@ -29,7 +30,8 @@ from . import rcnn
 from .rcnn import FasterRCNN, FasterRCNNLoss, faster_rcnn_tiny
 
 __all__ = ["hf_loader", "read_safetensors", "write_safetensors",
-           "load_hf_llama", "export_hf_llama",
+           "load_hf_llama", "export_hf_llama", "load_hf_bert",
+           "export_hf_bert",
            "ssd", "SSD", "ssd_tiny", "MultiBoxLoss",
            "bert", "BERTModel", "BERTForPretrain", "bert_base",
            "bert_small", "bert_large", "get_bert", "forecast",

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import struct
 
 import numpy as np
@@ -29,7 +30,7 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["read_safetensors", "write_safetensors", "load_hf_llama",
-           "export_hf_llama"]
+           "export_hf_llama", "load_hf_bert", "export_hf_bert"]
 
 _DTYPES = {
     "F64": np.float64, "F32": np.float32, "F16": np.float16,
@@ -192,6 +193,51 @@ def _name_map(net):
     return ours
 
 
+def _read_all(path):
+    tensors = {}
+    for shard in _shard_paths(path):
+        tensors.update(read_safetensors(shard))
+    return tensors
+
+
+def _assign_params(net, nmap, tensors, ctx, dtype, strict,
+                   transform=None):
+    """Shared load core for every family: missing-check (strict=False
+    SKIPS missing params, keeping their initialization — the
+    forgiving-load convention for partial checkpoints like pooler-less
+    MLM exports), per-kind transform, shape check, set_data.  Returns
+    the set of checkpoint names consumed."""
+    from .. import nd
+
+    used = set()
+    for name, param in net.collect_params().items():
+        hf_name, kind = nmap[name]
+        if hf_name not in tensors:
+            if not strict:
+                continue
+            raise MXNetError(
+                f"checkpoint missing {hf_name!r} (for {name!r})")
+        arr = np.asarray(tensors[hf_name], np.float32)
+        if transform is not None:
+            arr = transform(kind, arr)
+        if tuple(arr.shape) != tuple(param.shape):
+            raise MXNetError(
+                f"{hf_name!r} shape {arr.shape} != {name!r} "
+                f"shape {param.shape}")
+        param.set_data(nd.array(arr.astype(dtype, copy=False),
+                                ctx=ctx))
+        used.add(hf_name)
+    return used
+
+
+def _check_extras(tensors, used, ignore):
+    extra = {t for t in tensors if t not in used and not ignore(t)}
+    if extra:
+        raise MXNetError(
+            f"checkpoint tensors with no destination: "
+            f"{sorted(extra)[:8]}{'...' if len(extra) > 8 else ''}")
+
+
 def load_hf_llama(net, path, ctx=None, dtype="float32",
                   strict=True):
     """Load HF Llama/Mistral safetensors weights into a
@@ -200,34 +246,22 @@ def load_hf_llama(net, path, ctx=None, dtype="float32",
     Tied-embedding models (Llama-3.2 style) may omit ``lm_head.weight``
     in the checkpoint; untied nets require it.  ``strict`` errors on
     missing/unused checkpoint tensors (rotary ``inv_freq`` buffers are
-    always ignored — they are derived, not parameters).
+    always ignored — they are derived, not parameters); strict=False
+    skips missing params (they keep their initialization).
     """
-    from .. import nd
-
-    tensors = {}
-    for shard in _shard_paths(path):
-        tensors.update(read_safetensors(shard))
+    tensors = _read_all(path)
     attn = net.model.layers[0].attn
     h, kv, d = attn._h, attn._kv, attn._d
-    used = set()
-    nmap = _name_map(net)
-    for name, param in net.collect_params().items():
-        hf_name, kind = nmap[name]
-        if hf_name not in tensors:
-            raise MXNetError(
-                f"checkpoint missing {hf_name!r} (for {name!r})")
-        arr = np.asarray(tensors[hf_name], np.float32)
+
+    def transform(kind, arr):
         if kind == "q":
-            arr = _permute_qk(arr, h, d)
-        elif kind == "k":
-            arr = _permute_qk(arr, kv, d)
-        if tuple(arr.shape) != tuple(param.shape):
-            raise MXNetError(
-                f"{hf_name!r} shape {arr.shape} != {name!r} "
-                f"shape {param.shape}")
-        param.set_data(nd.array(arr.astype(dtype, copy=False),
-                                ctx=ctx))
-        used.add(hf_name)
+            return _permute_qk(arr, h, d)
+        if kind == "k":
+            return _permute_qk(arr, kv, d)
+        return arr
+
+    used = _assign_params(net, _name_map(net), tensors, ctx, dtype,
+                          strict, transform)
     # a TIED net maps no param to lm_head.weight (there is no head
     # child); a checkpoint that nevertheless ships one is only
     # loadable if that head IS the embedding — an untied checkpoint
@@ -245,12 +279,7 @@ def load_hf_llama(net, path, ctx=None, dtype="float32",
                 "replaced by the embedding")
         used.add("lm_head.weight")
     if strict:
-        extra = {t for t in tensors
-                 if t not in used and "rotary_emb" not in t}
-        if extra:
-            raise MXNetError(
-                f"checkpoint tensors with no destination: "
-                f"{sorted(extra)[:8]}{'...' if len(extra) > 8 else ''}")
+        _check_extras(tensors, used, lambda t: "rotary_emb" in t)
     return net
 
 
@@ -270,5 +299,96 @@ def export_hf_llama(net, path, dtype=np.float32, metadata=None):
         elif kind == "k":
             arr = _permute_qk(arr, kv, d, invert=True)
         out[hf_name] = arr
+    write_safetensors(path, out, metadata=metadata or
+                      {"format": "pt", "producer": "mxnet_tpu"})
+
+
+# ---------------------------------------------------------------------------
+# BERT (HF bert-base layout) — the flagship family
+# ---------------------------------------------------------------------------
+
+_BERT_LAYER_TABLE = {
+    "multiheadattention0_query_weight": "attention.self.query.weight",
+    "multiheadattention0_query_bias": "attention.self.query.bias",
+    "multiheadattention0_key_weight": "attention.self.key.weight",
+    "multiheadattention0_key_bias": "attention.self.key.bias",
+    "multiheadattention0_value_weight": "attention.self.value.weight",
+    "multiheadattention0_value_bias": "attention.self.value.bias",
+    "multiheadattention0_out_weight": "attention.output.dense.weight",
+    "multiheadattention0_out_bias": "attention.output.dense.bias",
+    "positionwiseffn0_ffn1_weight": "intermediate.dense.weight",
+    "positionwiseffn0_ffn1_bias": "intermediate.dense.bias",
+    "positionwiseffn0_ffn2_weight": "output.dense.weight",
+    "positionwiseffn0_ffn2_bias": "output.dense.bias",
+    "layernorm0_gamma": "attention.output.LayerNorm.weight",
+    "layernorm0_beta": "attention.output.LayerNorm.bias",
+    "layernorm1_gamma": "output.LayerNorm.weight",
+    "layernorm1_beta": "output.LayerNorm.bias",
+}
+
+
+def _bert_name_map(net):
+    """our param name → HF name for a BERTModel (post-LN encoder:
+    layernorm0 is the post-attention norm, layernorm1 the post-FFN —
+    matching attention.output.LayerNorm / output.LayerNorm)."""
+    out = {}
+    for name in net.collect_params():
+        m = re.search(r"enc_layer(\d+)_(\w+)$", name)
+        if m:
+            i, tail = int(m.group(1)), m.group(2)
+            if tail not in _BERT_LAYER_TABLE:
+                raise MXNetError(f"unmapped BERT param {name!r}")
+            out[name] = (f"encoder.layer.{i}."
+                         + _BERT_LAYER_TABLE[tail])
+        elif name.endswith("position_embed"):
+            out[name] = "embeddings.position_embeddings.weight"
+        elif name.endswith("word_embed_weight"):
+            out[name] = "embeddings.word_embeddings.weight"
+        elif name.endswith("type_embed_weight"):
+            out[name] = "embeddings.token_type_embeddings.weight"
+        elif name.endswith("layernorm0_gamma"):
+            out[name] = "embeddings.LayerNorm.weight"
+        elif name.endswith("layernorm0_beta"):
+            out[name] = "embeddings.LayerNorm.bias"
+        elif name.endswith("pooler_weight"):
+            out[name] = "pooler.dense.weight"
+        elif name.endswith("pooler_bias"):
+            out[name] = "pooler.dense.bias"
+        else:
+            raise MXNetError(f"unmapped BERT param {name!r}")
+    return out
+
+
+def load_hf_bert(net, path, ctx=None, dtype="float32", strict=True):
+    """Load HF ``bert-base``-layout safetensors into a ``BERTModel``.
+
+    Accepts checkpoints with or without the ``bert.`` task-model
+    prefix (BertModel vs BertForPreTraining exports); task heads
+    (``cls.*``) are ignored.  ``strict=False`` additionally skips
+    MISSING params (e.g. pooler-less MLM exports keep the net's
+    initialized pooler).  Shapes must already match — run one forward
+    first so deferred shapes are resolved.
+    """
+    tensors = _read_all(path)
+    # normalize the task-model prefix away
+    if any(t.startswith("bert.") for t in tensors):
+        tensors = {(t[5:] if t.startswith("bert.") else t): v
+                   for t, v in tensors.items()}
+    nmap = {k: (v, "plain") for k, v in _bert_name_map(net).items()}
+    used = _assign_params(net, nmap, tensors, ctx, dtype, strict)
+    if strict:
+        _check_extras(tensors, used,
+                      lambda t: t.startswith("cls.")
+                      or "position_ids" in t)
+    return net
+
+
+def export_hf_bert(net, path, dtype=np.float32, metadata=None):
+    """Write a ``BERTModel`` as HF bert-base-layout safetensors
+    (inverse of :func:`load_hf_bert`)."""
+    nmap = _bert_name_map(net)
+    out = {}
+    for name, param in net.collect_params().items():
+        out[nmap[name]] = param.data().asnumpy().astype(dtype)
     write_safetensors(path, out, metadata=metadata or
                       {"format": "pt", "producer": "mxnet_tpu"})

@@ -217,3 +217,114 @@ class TestHFRoundtrip:
         np.testing.assert_allclose(
             net2(toks).asnumpy(), net(toks).asnumpy(),
             rtol=0.1, atol=0.2)   # bf16 storage tolerance
+
+
+class TestBertHF:
+    def _bert(self, dropout=0.0):
+        from mxnet_tpu.models import bert_small
+        net = bert_small(vocab_size=V, max_length=32, dropout=dropout)
+        net.initialize(mx.init.Xavier())
+        # resolve deferred shapes before export
+        with mx.autograd.pause():
+            net(nd.zeros((1, 8)), nd.zeros((1, 8)), None)
+        return net
+
+    def test_roundtrip_forward_identical(self, tmp_path):
+        from mxnet_tpu.models import export_hf_bert, load_hf_bert
+        net = self._bert()
+        rng = np.random.RandomState(5)
+        toks = nd.array(rng.randint(0, V, (2, 12)).astype("f4"))
+        types = nd.array(rng.randint(0, 2, (2, 12)).astype("f4"))
+        seq, pooled = net(toks, types, None)
+        p = str(tmp_path / "bert.safetensors")
+        export_hf_bert(net, p)
+        net2 = self._bert()
+        load_hf_bert(net2, p)
+        seq2, pooled2 = net2(toks, types, None)
+        np.testing.assert_allclose(seq2.asnumpy(), seq.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(pooled2.asnumpy(),
+                                   pooled.asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_bert_prefix_accepted(self, tmp_path):
+        """BertForPreTraining exports carry a bert. prefix and cls.*
+        heads — both must be handled."""
+        from mxnet_tpu.models import export_hf_bert, load_hf_bert
+        net = self._bert()
+        p = str(tmp_path / "bert.safetensors")
+        export_hf_bert(net, p)
+        tensors = {("bert." + k): v
+                   for k, v in read_safetensors(p).items()}
+        tensors["cls.predictions.bias"] = np.zeros(V, "f4")
+        pp = str(tmp_path / "pretrain.safetensors")
+        write_safetensors(pp, tensors)
+        load_hf_bert(self._bert(), pp)
+
+    def test_cross_implementation_parity_vs_transformers(self,
+                                                         tmp_path):
+        """THE external anchor: our BERT forward vs HuggingFace
+        transformers' BertModel with IDENTICAL weights (loaded through
+        the exported safetensors).  A wrong name mapping, norm order,
+        gelu variant, or head split would all fail here."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from mxnet_tpu.models import export_hf_bert
+
+        net = self._bert()
+        p = str(tmp_path / "bert.safetensors")
+        export_hf_bert(net, p)
+
+        cfg = transformers.BertConfig(
+            vocab_size=V, hidden_size=256, num_hidden_layers=4,
+            num_attention_heads=4, intermediate_size=1024,
+            max_position_embeddings=32, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, hidden_act="gelu")
+        hfm = transformers.BertModel(cfg, add_pooling_layer=True)
+        sd = {k: torch.tensor(np.asarray(v))
+              for k, v in read_safetensors(p).items()}
+        missing, unexpected = hfm.load_state_dict(sd, strict=False)
+        # position_ids buffer may be "missing" (it's derived); nothing
+        # we exported may be unexpected
+        assert not unexpected, unexpected
+        assert all("position_ids" in m for m in missing), missing
+        hfm.eval()
+
+        rng = np.random.RandomState(6)
+        ids = rng.randint(0, V, (2, 12))
+        tt = rng.randint(0, 2, (2, 12))
+        with torch.no_grad():
+            out = hfm(input_ids=torch.tensor(ids),
+                      token_type_ids=torch.tensor(tt))
+        seq, pooled = net(nd.array(ids.astype("f4")),
+                          nd.array(tt.astype("f4")), None)
+        np.testing.assert_allclose(
+            seq.asnumpy(), out.last_hidden_state.numpy(),
+            rtol=5e-4, atol=5e-4)
+        np.testing.assert_allclose(
+            pooled.asnumpy(), out.pooler_output.numpy(),
+            rtol=5e-4, atol=5e-4)
+
+    def test_poolerless_checkpoint_strict_false(self, tmp_path):
+        """MLM-only exports (add_pooling_layer=False) lack pooler.*;
+        strict=False keeps the net's initialized pooler instead of
+        refusing the checkpoint (r4 review finding)."""
+        from mxnet_tpu.models import export_hf_bert, load_hf_bert
+        net = self._bert()
+        p = str(tmp_path / "bert.safetensors")
+        export_hf_bert(net, p)
+        tensors = {k: v for k, v in read_safetensors(p).items()
+                   if not k.startswith("pooler.")}
+        pp = str(tmp_path / "nopool.safetensors")
+        write_safetensors(pp, tensors)
+        with pytest.raises(MXNetError, match="missing"):
+            load_hf_bert(self._bert(), pp)          # strict default
+        net2 = self._bert()
+        load_hf_bert(net2, pp, strict=False)
+        rng = np.random.RandomState(9)
+        toks = nd.array(rng.randint(0, V, (2, 8)).astype("f4"))
+        types = nd.array(rng.randint(0, 2, (2, 8)).astype("f4"))
+        seq, _ = net(toks, types, None)
+        seq2, _ = net2(toks, types, None)
+        np.testing.assert_allclose(seq2.asnumpy(), seq.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
