@@ -274,6 +274,17 @@ class LlamaForCausalLM(HybridBlock):
                              (b, s, self.model.vocab_size))
         return self.lm_head(h)
 
+    @staticmethod
+    def _check_cache_dtype(dtype):
+        """KV caches must be FLOAT: an integer cache dtype truncates
+        every K/V write via _cache_update's cast-on-store (the
+        historical int32-leak bug) and generates garbage silently."""
+        import jax.numpy as jnp
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            raise MXNetError(
+                f"KV cache dtype must be floating, got {dtype!r} "
+                "(an int cache truncates every K/V write)")
+
     def _rolling_cache_len(self, max_len, rolling):
         """Cache length for (max_len, rolling) — ONE place for the
         rolling policy, shared by init_cache and generate_fused."""
@@ -286,22 +297,29 @@ class LlamaForCausalLM(HybridBlock):
                 "set (Mistral-style)")
         return min(int(w), max_len)
 
-    def init_cache(self, batch_size, max_len, ctx=None, rolling=False):
+    def init_cache(self, batch_size, max_len, ctx=None, rolling=False,
+                   dtype="float32"):
         """Preallocate per-layer KV caches (B, C, KV, D).
 
         ``rolling=True`` (sliding-window models only) allocates the
         Mistral rolling buffer: C = min(sliding_window, max_len), so
         decode memory is O(W) regardless of generation length —
         positions wrap via ``offset % C`` and out-of-window entries
-        are overwritten exactly when they leave the band."""
+        are overwritten exactly when they leave the band.
+
+        ``dtype="bfloat16"`` halves cache HBM (and decode-time cache
+        bandwidth — the dominant traffic at batch 1): K/V writes cast
+        on store, attention math still accumulates f32 (mixed-dtype
+        dots promote)."""
         from .. import ndarray as nd
+        self._check_cache_dtype(dtype)
         cache_len = self._rolling_cache_len(max_len, rolling)
         caches = []
         for layer in self.model.layers:
             a = layer.attn
             shp = (batch_size, cache_len, a._kv, a._d)
-            caches.append((nd.zeros(shp, ctx=ctx),
-                           nd.zeros(shp, ctx=ctx)))
+            caches.append((nd.zeros(shp, ctx=ctx, dtype=dtype),
+                           nd.zeros(shp, ctx=ctx, dtype=dtype)))
         return caches
 
     def _head(self, h):
@@ -379,7 +397,8 @@ class LlamaForCausalLM(HybridBlock):
         return self._head(h)
 
     def generate(self, tokens, max_new_tokens, temperature=0.0,
-                 top_k=0, seed=0, rolling=False):
+                 top_k=0, seed=0, rolling=False,
+                 cache_dtype="float32"):
         """Autoregressive generation with a KV cache.
 
         tokens: (B, S) prompt NDArray.  Greedy when ``temperature=0``;
@@ -395,7 +414,7 @@ class LlamaForCausalLM(HybridBlock):
         b, s = tokens.shape
         max_len = s + max_new_tokens
         caches = self.init_cache(b, max_len, ctx=tokens.context,
-                                 rolling=rolling)
+                                 rolling=rolling, dtype=cache_dtype)
         rng = np.random.RandomState(seed)
         out_tokens = [tokens.asnumpy()]
         logits = self.prefill(tokens, caches)  # one batched program
@@ -424,7 +443,8 @@ class LlamaForCausalLM(HybridBlock):
                         ctx=tokens.context)
 
     def generate_fused(self, tokens, max_new_tokens, temperature=0.0,
-                       top_k=0, seed=0, rolling=False):
+                       top_k=0, seed=0, rolling=False,
+                       cache_dtype="float32"):
         """Whole-generation as ONE compiled program.
 
         Same contract as :meth:`generate`, but prefill + every decode
@@ -461,6 +481,7 @@ class LlamaForCausalLM(HybridBlock):
         kk = min(int(top_k), self.model.vocab_size) \
             if (top_k and sample) else 0
 
+        self._check_cache_dtype(cache_dtype)
         cache_len = self._rolling_cache_len(max_len, rolling)
         cache_shapes = []
         for layer in self.model.layers:
@@ -468,7 +489,7 @@ class LlamaForCausalLM(HybridBlock):
             cache_shapes.append((b, cache_len, a._kv, a._d))
 
         key = (b, s, max_new_tokens, sample, kk, rolling,
-               str(tokens.dtype))
+               str(cache_dtype), str(tokens.dtype))
         cache = getattr(self, "_gen_fused_cache", None)
         if cache is None:
             cache = self._gen_fused_cache = {}
@@ -476,12 +497,14 @@ class LlamaForCausalLM(HybridBlock):
         if fn is None:
             def traced(param_vals, tok_val, key_data, temp_val):
                 with block_mod.tracing_scope(params, param_vals):
-                    # caches hold activations: always the f32 compute
-                    # dtype (int tokens once leaked int32 caches here,
-                    # truncating every K/V write)
+                    # caches hold activations in the declared cache
+                    # dtype (a FLOAT dtype — int tokens once leaked
+                    # int32 caches here, truncating every K/V write;
+                    # bf16 halves decode cache bandwidth)
+                    cdt = jnp.dtype(cache_dtype)
                     shells = [
-                        (NDArray(jnp.zeros(shp, jnp.float32), ctx=ctx),
-                         NDArray(jnp.zeros(shp, jnp.float32), ctx=ctx))
+                        (NDArray(jnp.zeros(shp, cdt), ctx=ctx),
+                         NDArray(jnp.zeros(shp, cdt), ctx=ctx))
                         for shp in cache_shapes]
                     toks = NDArray(tok_val, ctx=ctx)
                     logits0 = self.prefill(toks, shells)._data

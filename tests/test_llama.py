@@ -671,3 +671,47 @@ class TestRollingCache:
         full = net.generate_fused(toks, 40).asnumpy()
         roll = net.generate_fused(toks, 40, rolling=True).asnumpy()
         np.testing.assert_array_equal(roll, full)
+
+
+class TestBF16Cache:
+    """bf16 KV caches halve decode cache bandwidth; numerics stay
+    within bf16 storage tolerance of the f32 cache."""
+
+    def test_decode_logits_close(self):
+        net = _net()
+        toks = _tokens(seed=40, b=2, s=10)
+        c32 = net.init_cache(2, 10)
+        c16 = net.init_cache(2, 10, dtype="bfloat16")
+        assert "bfloat16" in str(c16[0][0].dtype)
+        l32 = np.stack(
+            [net.decode_step(toks[:, i:i + 1], c32, i).asnumpy()
+             for i in range(10)], axis=1)
+        l16 = np.stack(
+            [net.decode_step(toks[:, i:i + 1], c16, i).asnumpy()
+             for i in range(10)], axis=1)
+        # logits are O(1); bf16 K/V storage error propagates ~linearly
+        np.testing.assert_allclose(l16, l32, rtol=0.1, atol=0.15)
+
+    def test_generate_fused_bf16_cache_runs(self):
+        net = _net()
+        toks = _tokens(seed=41, b=2, s=8)
+        out = net.generate_fused(toks, 8, cache_dtype="bfloat16")
+        assert out.shape == (2, 16)
+        full = net.generate_fused(toks, 8).asnumpy()
+        got = out.asnumpy()
+        # index 9 is the first token whose logits READ the bf16 cache
+        # (index 8 comes from prefill's fresh f32 k/v): it must agree,
+        # and late-sequence drift from accumulated bf16 noise flipping
+        # a near-tie argmax is bounded, not unconstrained
+        np.testing.assert_array_equal(got[:, :10], full[:, :10])
+        mismatches = int((got != full).sum())
+        assert mismatches <= 4, (mismatches, got, full)
+
+    def test_int_cache_dtype_rejected(self):
+        from mxnet_tpu.base import MXNetError
+        net = _net()
+        with pytest.raises(MXNetError, match="floating"):
+            net.init_cache(2, 8, dtype="int32")
+        with pytest.raises(MXNetError, match="floating"):
+            net.generate_fused(_tokens(b=1, s=4), 4,
+                               cache_dtype="int32")
