@@ -442,6 +442,59 @@ class LlamaForCausalLM(HybridBlock):
         return nd.array(np.concatenate(out_tokens, axis=1),
                         ctx=tokens.context)
 
+    def generate_beam(self, tokens, max_new_tokens, beam_size=4,
+                      eos_id=None, alpha=1.0):
+        """Beam-search generation over the KV-cache decoder.
+
+        Reuses the generic :class:`~.nmt.BeamSearchSampler` (reference
+        GluonNLP beam search): the flat beam axis is batch·beam, the
+        per-layer caches are the reordered states, and the prompt is
+        prefilled once per beam.  ``eos_id=None`` disables early stop
+        (all beams run the full ``max_new_tokens``).  Returns
+        ``(sequences (B, beam, S+<=N), scores (B, beam))`` sorted
+        best-first, sequences INCLUDING the prompt.
+        """
+        import numpy as np
+        from .. import ndarray as nd
+        from .nmt import BeamSearchSampler, BeamSearchScorer
+
+        b, s = tokens.shape
+        k = int(beam_size)
+        max_len = s + max_new_tokens
+        # prefill ONCE per batch row, then replicate the filled caches
+        # per beam (row-major repeat matches the sampler's i*k+j flat
+        # layout) — K-fold less prompt compute than prefilling B*K
+        # identical rows
+        caches_b = self.init_cache(b, max_len, ctx=tokens.context)
+        self.prefill(tokens, caches_b)
+        caches = [(nd.repeat(ck, repeats=k, axis=0),
+                   nd.repeat(cv, repeats=k, axis=0))
+                  for ck, cv in caches_b]
+        last = nd.repeat(tokens[:, -1:], repeats=k, axis=0)
+
+        def decoder(tok, step_idx, states):
+            # step 0 re-writes position s-1 with the same K/V (a
+            # no-op) and reproduces the prefill logits — so the
+            # sampler's uniform "decode from the start token" contract
+            # needs no special first step
+            lg = self.decode_step(tok, states, s - 1 + step_idx)
+            return nd.log_softmax(lg, axis=-1), states
+
+        sampler = BeamSearchSampler(
+            beam_size=k,
+            eos_id=-1 if eos_id is None else int(eos_id),
+            scorer=BeamSearchScorer(alpha=alpha),
+            max_length=max_new_tokens + 1)
+        samples, scores, lens = sampler(decoder, last, caches, b)
+        # samples begin with the (repeated) last prompt token: splice
+        # the full prompt in front of the continuation
+        samp = samples.asnumpy().astype(np.int64)[:, :, 1:]
+        prompt = tokens.asnumpy().astype(np.int64)
+        out = np.concatenate(
+            [np.repeat(prompt[:, None], k, axis=1), samp], axis=2)
+        return (nd.array(out.astype("f4"), ctx=tokens.context),
+                scores)
+
     def generate_fused(self, tokens, max_new_tokens, temperature=0.0,
                        top_k=0, seed=0, rolling=False,
                        cache_dtype="float32"):

@@ -715,3 +715,66 @@ class TestBF16Cache:
         with pytest.raises(MXNetError, match="floating"):
             net.generate_fused(_tokens(b=1, s=4), 4,
                                cache_dtype="int32")
+
+
+class TestBeamSearch:
+    def test_beam1_matches_greedy(self):
+        """A single beam with no length penalty IS greedy decoding."""
+        net = _net()
+        toks = _tokens(seed=50, b=2, s=6)
+        greedy = net.generate(toks, 8).asnumpy()
+        seqs, scores = net.generate_beam(toks, 8, beam_size=1,
+                                         alpha=0.0)
+        np.testing.assert_array_equal(seqs.asnumpy()[:, 0], greedy)
+
+    def test_beam_scores_are_true_logprobs(self):
+        """At alpha=0 the reported score must equal the model's actual
+        sum of per-token log-probs for the returned sequence —
+        re-scored independently by teacher forcing.  (Best-of-K >=
+        greedy is NOT asserted: beam search is inadmissible and may
+        prune the greedy path.)"""
+        net = _net()
+        toks = _tokens(seed=51, b=1, s=6)
+        n = 6
+        seqs, scores = net.generate_beam(toks, n, beam_size=3,
+                                         alpha=0.0)
+        full = seqs.asnumpy().astype(np.int64)[0]     # (3, 12)
+        logits = net(nd.array(full.astype("f4"))).asnumpy()
+        logp = logits - \
+            np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                   .sum(-1, keepdims=True)) - logits.max(-1,
+                                                         keepdims=True)
+        for j in range(3):
+            want = sum(logp[j, 5 + t, full[j, 6 + t]]
+                       for t in range(n))
+            np.testing.assert_allclose(float(scores.asnumpy()[0, j]),
+                                       want, rtol=1e-3, atol=1e-3)
+
+    def test_beams_distinct_and_sorted(self):
+        net = _net()
+        toks = _tokens(seed=52, b=1, s=6)
+        seqs, scores = net.generate_beam(toks, 8, beam_size=4)
+        sc = scores.asnumpy()[0]
+        assert (np.diff(sc) <= 1e-6).all(), sc      # best-first
+        rows = {tuple(r) for r in seqs.asnumpy()[0].astype(int)}
+        assert len(rows) > 1                        # real alternatives
+
+    def test_eos_stops_early(self):
+        net = _net()
+        toks = _tokens(seed=53, b=1, s=6)
+        # pick the greedy first token as EOS: the strongest beam hits
+        # it immediately and must FINISH there — the returned width
+        # shrinks well below prompt+max and the EOS token appears
+        greedy = int(net.generate(toks, 1).asnumpy()[0, -1])
+        seqs, scores = net.generate_beam(toks, 8, beam_size=2,
+                                         eos_id=greedy)
+        out = seqs.asnumpy().astype(int)
+        # the top-probability step-0 candidate IS the EOS: some
+        # returned beam must have finished right there — continuation
+        # [EOS, pad...] where the sampler pads with eos_id (surviving
+        # beams legitimately run to full length, so the WIDTH may
+        # still be prompt+max)
+        early = [(out[0, j, 6] == greedy
+                  and (out[0, j, 7:] == greedy).all())
+                 for j in range(out.shape[1])]
+        assert any(early), out
