@@ -25,7 +25,7 @@ import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
-from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+from mxnet_tpu.models import LlamaForCausalLM, get_llama
 
 
 def make_batch(rng, batch, seq_len, vocab):
@@ -50,10 +50,24 @@ def main():
     p.add_argument("--per-step", action="store_true",
                    help="use the one-dispatch-per-token decode loop "
                         "instead of the fused whole-loop program")
+    p.add_argument("--config", default="llama_tiny",
+                   help="llama_tiny | mistral_tiny (sliding window) "
+                        "| ... (see models.get_llama)")
+    p.add_argument("--beam", type=int, default=0,
+                   help="also decode with beam search at this width")
     args = p.parse_args()
 
     ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
-    net = LlamaForCausalLM(llama_tiny(vocab_size=args.vocab))
+    net = LlamaForCausalLM(get_llama(args.config,
+                                     vocab_size=args.vocab))
+    w = net.model.sliding_window
+    if w is not None and args.seq_len <= w:
+        # a sliding-window config demo must actually CROSS the window,
+        # or the banded kernels are never active and the run proves
+        # nothing about them
+        args.seq_len = w + 16
+        print(f"# {args.config}: sliding_window={w} — raising "
+              f"--seq-len to {args.seq_len} so the band is active")
     net.initialize(mx.init.Xavier(), ctx=ctx)
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
@@ -104,6 +118,14 @@ def main():
                   max_new_tokens=args.new_tokens,
                   temperature=0.8, top_k=5, seed=1).asnumpy()
     print("sampled:", sampled[0].astype(int).tolist())
+
+    if args.beam:
+        seqs, scores = net.generate_beam(
+            nd.array(prompts, ctx=ctx),
+            max_new_tokens=args.new_tokens, beam_size=args.beam)
+        print(f"beam-{args.beam} best:",
+              seqs.asnumpy()[0, 0].astype(int).tolist(),
+              f"(score {float(scores.asnumpy()[0, 0]):.3f})")
 
 
 if __name__ == "__main__":
