@@ -64,9 +64,21 @@ def _st_dtype(arr):
 
 def read_safetensors(path):
     """path → {name: np.ndarray} (zero-copy views onto one mmap)."""
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
         (hlen,) = struct.unpack("<Q", f.read(8))
-        header = json.loads(f.read(hlen).decode("utf-8"))
+        if hlen > size - 8:
+            # a bogus header length (e.g. another format's magic read
+            # as a u64) must fail loudly, not as a MemoryError from a
+            # multi-exabyte read
+            raise MXNetError(
+                f"{path}: not a safetensors file (header length "
+                f"{hlen} exceeds file size {size})")
+        try:
+            header = json.loads(f.read(hlen).decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise MXNetError(
+                f"{path}: not a safetensors file ({e})") from e
     buf = np.memmap(path, dtype=np.uint8, mode="r", offset=8 + hlen)
     out = {}
     for name, spec in header.items():

@@ -781,6 +781,22 @@ def save(fname: str, data):
         pairs = list(data.items())
     else:
         pairs = [("", d) for d in data]
+    if fname.endswith(".safetensors"):
+        # ecosystem interop by extension: any {name: NDArray} dict
+        # round-trips with HF tooling (unnamed entries get list
+        # indices, matching torch.save-style exports)
+        from ..models.hf_loader import write_safetensors
+        named = {}
+        for i, (name, arr) in enumerate(pairs):
+            key = name or str(i)
+            if key in named:
+                raise MXNetError(
+                    f"save: duplicate tensor name {key!r} after "
+                    "index substitution — a tensor would be "
+                    "silently dropped")
+            named[key] = arr.asnumpy()
+        write_safetensors(fname, named)
+        return
     with open(fname, "wb") as f:
         f.write(_MAGIC)
         f.write(struct.pack("<q", len(pairs)))
@@ -834,6 +850,15 @@ def _load_stream(f, what: str):
 
 
 def load(fname: str):
+    if fname.endswith(".safetensors"):
+        # sniff first: a native/legacy checkpoint misnamed
+        # .safetensors keeps the native loader's error contract
+        with open(fname, "rb") as f:
+            magic = f.read(8)
+        if magic != _MAGIC:
+            from ..models.hf_loader import read_safetensors
+            return {name: array(np.asarray(a), dtype=a.dtype)
+                    for name, a in read_safetensors(fname).items()}
     with open(fname, "rb") as f:
         return _load_stream(f, fname)
 

@@ -412,3 +412,60 @@ def test_cache_hit_dispatch_does_no_tracing():
     r2 = invoke(op, [a], k=5.0)
     assert len(traces) == n_after_first + 1
     np.testing.assert_allclose(r2.asnumpy(), 6.0)
+
+
+def test_save_load_safetensors_by_extension(tmp_path):
+    """A .safetensors filename routes nd.save/load through the HF
+    codec: dict and list forms round-trip (bf16 included), and the
+    file is readable by any safetensors implementation."""
+    import ml_dtypes
+    rng = np.random.RandomState(0)
+    p = str(tmp_path / "x.safetensors")
+    data = {"a": nd.array(rng.rand(3, 4).astype("f4")),
+            "b": nd.array(np.arange(5).astype("f4")).astype(
+                "bfloat16")}
+    nd.save(p, data)
+    back = nd.load(p)
+    assert set(back) == {"a", "b"}
+    np.testing.assert_array_equal(back["a"].asnumpy(),
+                                  data["a"].asnumpy())
+    assert "bfloat16" in str(back["b"].dtype)
+    from mxnet_tpu.models import read_safetensors
+    raw = read_safetensors(p)
+    assert raw["a"].dtype == np.float32
+    assert raw["b"].dtype == ml_dtypes.bfloat16
+    # list form gets index names
+    p2 = str(tmp_path / "y.safetensors")
+    nd.save(p2, [nd.array(np.ones(2, "f4"))])
+    assert "0" in nd.load(p2)
+
+
+def test_safetensors_edge_cases(tmp_path):
+    """Collision after index substitution raises (silent drop was the
+    r4 review finding); a native checkpoint misnamed .safetensors
+    still loads; garbage raises MXNetError, not MemoryError."""
+    from mxnet_tpu.base import MXNetError
+    p = str(tmp_path / "c.safetensors")
+    with pytest.raises(MXNetError, match="duplicate"):
+        nd.save(p, {"1": nd.array(np.ones(2, "f4")),
+                    "": nd.array(np.zeros(3, "f4"))})
+    # native-format bytes under a .safetensors name: sniffed, loaded
+    pn = str(tmp_path / "native.safetensors")
+    arrs = {"w": nd.array(np.arange(4).astype("f4"))}
+    import mxnet_tpu.ndarray.ndarray as nmod
+    with open(pn, "wb") as f:
+        pass
+    # write via the NATIVE path by using a non-safetensors name first
+    pn2 = str(tmp_path / "native.bin")
+    nd.save(pn2, arrs)
+    import shutil
+    shutil.copy(pn2, pn)
+    back = nd.load(pn)
+    np.testing.assert_array_equal(back["w"].asnumpy(),
+                                  arrs["w"].asnumpy())
+    # garbage content fails loudly
+    pg = str(tmp_path / "garbage.safetensors")
+    with open(pg, "wb") as f:
+        f.write(b"\xff" * 64)
+    with pytest.raises(MXNetError, match="safetensors"):
+        nd.load(pg)
