@@ -778,3 +778,36 @@ class TestBeamSearch:
                   and (out[0, j, 7:] == greedy).all())
                  for j in range(out.shape[1])]
         assert any(early), out
+
+
+def test_amp_bf16_banded_flash_trains(monkeypatch):
+    """The on-chip Mistral pretrain path: bf16 AMP + the BANDED flash
+    kernel (128-aligned seq > window) — dispatch proof + finite,
+    decreasing loss.  A latent bf16/band dtype bug here would burn a
+    chip window."""
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.models import get_llama
+    from mxnet_tpu.ops import attention as attn
+    from mxnet_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    amp.init(target_dtype="bfloat16")
+    try:
+        net = LlamaForCausalLM(get_llama("mistral_tiny",
+                                         vocab_size=64))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 5e-3})
+        rng = np.random.RandomState(0)
+        toks = nd.array(rng.randint(0, 64, (2, 128)).astype("f"))
+        fb = attn.flash_dispatch_count()
+        losses = []
+        for _ in range(4):
+            with autograd.record():
+                loss = net.loss(toks)
+            loss.backward()
+            trainer.step(2)
+            losses.append(float(loss.asnumpy()))
+        assert attn.flash_dispatch_count() > fb
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    finally:
+        amp._deinit()
