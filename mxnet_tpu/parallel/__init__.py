@@ -23,7 +23,7 @@ Public surface:
 """
 from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
-from .collectives import quantized_psum
+from .collectives import quantized_psum, vocab_parallel_softmax_ce
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_value_and_grad
@@ -45,7 +45,8 @@ def moe_param_rule(ep_axis="ep", inner=None):
 
     return rule
 
-__all__ = ["moe_param_rule", "pipeline_apply",
+__all__ = ["vocab_parallel_softmax_ce",
+           "moe_param_rule", "pipeline_apply",
            "pipeline_value_and_grad",
            "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
            "collectives", "DataParallelTrainer", "ring_attention",

@@ -19,7 +19,8 @@ from functools import partial
 from ..base import MXNetError
 from .mesh import current_mesh
 
-__all__ = ["psum", "pmean", "all_gather", "ppermute", "all_to_all",
+__all__ = ["vocab_parallel_softmax_ce",
+           "psum", "pmean", "all_gather", "ppermute", "all_to_all",
            "allreduce", "quantized_psum", "twobit_psum"]
 
 
@@ -241,3 +242,46 @@ def twobit_psum(x, axis_name, *, threshold=0.5, residual=None):
     new_residual = g - codes.astype(g.dtype) * jnp.asarray(
         threshold, g.dtype)
     return summed.astype(x.dtype), new_residual
+
+
+def vocab_parallel_softmax_ce(hidden, w_local, label, axis_name):
+    """Megatron-style vocab-parallel cross-entropy (inside shard_map).
+
+    The tensor-parallel LM head shards the (V, U) projection over
+    ``axis_name`` by vocab rows; each rank computes its LOCAL logits
+    slab (N, V/tp) and the softmax normalizer is assembled with ONE
+    pmax + psum pair — the full (N, V) logits never exist on any
+    device and the wire carries only (N,)-sized rows.  The label
+    logit comes from whichever rank owns the label's row (everyone
+    else contributes an exact zero).  Differentiable through the
+    collectives (the vjp of psum is broadcast; the max subtraction
+    cancels analytically), so dW stays sharded and dH is exact.
+
+    hidden (N, U); w_local (V_local, U) — ranks tile the vocab in
+    order (rank i owns rows [i·V_local, (i+1)·V_local)); label (N,)
+    int.  Returns per-row loss (N,), f32.
+
+    Reference analog: the kvstore sharded softmax has no upstream
+    equivalent — this is the TPU-idiomatic replacement for replicating
+    the full head on every data-parallel worker (SURVEY.md §7 P6).
+    """
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    i = lax.axis_index(axis_name)
+    v_local = w_local.shape[0]
+    logits = jnp.dot(hidden, w_local.T,
+                     preferred_element_type=jnp.float32)
+    m = lax.pmax(lax.stop_gradient(logits).max(axis=1), axis_name)
+    lbl = label.astype(jnp.int32)
+    idx = lbl - i * jnp.int32(v_local)
+    in_range = (idx >= 0) & (idx < v_local)
+    picked = jnp.take_along_axis(
+        logits, jnp.clip(idx, 0, v_local - 1)[:, None], axis=1)[:, 0]
+    # ONE collective for both reductions: the normalizer partial sums
+    # and the label-logit contributions ride the same psum (a second
+    # psum would add a full collective latency per loss evaluation)
+    s, lab = lax.psum(
+        jnp.stack([jnp.exp(logits - m[:, None]).sum(axis=1),
+                   jnp.where(in_range, picked, 0.0)]), axis_name)
+    return m + jnp.log(s) - lab
