@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a hunter log dir into BASELINE-ready markdown rows.
+
+Reads bench_report_*.json (bench.py stage records), the per-job logs'
+machine-readable JSON lines (crossover_row / window_row / block_sweep /
+llm decode rows / int8 rows / io rows), and summary.jsonl provenance;
+prints a markdown table + source pointers.  Meant for the end-of-round
+BASELINE harvest: every number printed carries its file:line-free
+provenance (file + started timestamp) so rows stay auditable.
+
+    python tools/harvest_bench.py [--log-dir bench_logs/r4]
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_reports(log_dir):
+    rows = []
+    for path in sorted(glob.glob(
+            os.path.join(log_dir, "bench_report_*.json"))):
+        try:
+            with open(path) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for e in rep.get("entries", []):
+            if e.get("stage") == "bert_pretrain" and \
+                    e.get("platform") == "tpu":
+                rows.append((rep.get("started"), os.path.basename(path),
+                             e))
+    return rows
+
+
+def json_lines(log_dir, name):
+    path = os.path.join(log_dir, f"{name}.log")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log-dir", default="bench_logs/r4")
+    args = p.parse_args()
+    d = os.path.join(REPO, args.log_dir)
+
+    print(f"# Harvest of {args.log_dir}\n")
+    bert = bench_reports(d)
+    if bert:
+        print("## bert_pretrain (chip rows)\n")
+        print("| started | report | builder | batch | seq | bulk | "
+              "samples/s | mfu | step ms | flash |")
+        print("|---|---|---|---|---|---|---|---|---|---|")
+        for started, path, e in bert:
+            print(f"| {started} | {path} | {e.get('builder')} | "
+                  f"{e.get('batch_size')} | {e.get('seq_len')} | "
+                  f"{e.get('bulked_steps')} | "
+                  f"{e.get('samples_per_sec')} | {e.get('mfu')} | "
+                  f"{e.get('avg_step_ms')} | "
+                  f"{e.get('flash_dispatches')} |")
+        print()
+
+    for job, keys in (
+            ("attention_bench", ("crossover_row", "window_row",
+                                 "auto_select_ok")),
+            ("attention_blocks", ("block_sweep",)),
+            ("llm_decode_bench", ("metric", "summary")),
+            ("int8_bench", ("metric", "summary")),
+            ("io_train_bench", ("metric", "summary")),
+            ("resnet50_bench", ("metric", "images_per_sec")),
+            ("bert_ablation", ("bert_ablation",)),
+            ("bert_phases", ("full_step",))):
+        lines = json_lines(d, job)
+        if not lines:
+            continue
+        print(f"## {job}\n")
+        for obj in lines:
+            if any(k in obj for k in keys):
+                print(json.dumps(obj))
+        print()
+
+    summary = os.path.join(d, "summary.jsonl")
+    if os.path.exists(summary):
+        print("## provenance (summary.jsonl ok-attempts)\n")
+        with open(summary) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("ok"):
+                    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
