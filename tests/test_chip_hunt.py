@@ -1,0 +1,287 @@
+"""Staged-probe + blackout-diagnostics contract (VERDICT r4 next #2).
+
+The r4 hunt produced 65 indistinguishable timeout lines; the staged
+probe must instead name the stage every failure died in, and the hunter
+must aggregate a blackout case file.  Reference analog: dmlc logging's
+failure-context discipline (SURVEY.md §5 config/flags row).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench
+from tools import chip_hunt
+
+
+def test_parse_full_success_is_tpu():
+    out = "\n".join([
+        "STAGE:import_jax:BEGIN", "STAGE:import_jax:OK:0.01",
+        "STAGE:client_init:BEGIN", "STAGE:client_init:OK:1.50",
+        "PLATFORM:axon", "NDEV:1",
+        "STAGE:compile:BEGIN", "STAGE:compile:OK:3.20",
+        "STAGE:transfer:BEGIN", "STAGE:transfer:OK:0.10",
+        "STAGE:execute:BEGIN", "STAGE:execute:OK:0.05",
+        "STAGE:fetch:BEGIN", "STAGE:fetch:OK:0.02",
+        "VALUE:0.5",
+    ])
+    r = bench._parse_probe_output(out, rc=0)
+    assert r["platform"] == "tpu"          # axon IS the chip platform
+    assert r["hung_stage"] is None
+    assert r["stage"] == "fetch"
+    assert r["ndev"] == 1 and r["value_ok"] is True
+
+
+def test_parse_client_init_hang_names_stage():
+    # hard parent kill (rc=-1): only BEGIN marker for the hung stage
+    out = ("STAGE:import_jax:BEGIN\nSTAGE:import_jax:OK:0.00\n"
+           "STAGE:client_init:BEGIN\n")
+    r = bench._parse_probe_output(out, rc=-1)
+    assert r["platform"] == "unreachable"
+    assert r["hung_stage"] == "client_init"
+    assert r["stage"] == "import_jax"
+
+
+def test_parse_child_alarm_timeout_names_stage():
+    out = ("STAGE:import_jax:BEGIN\nSTAGE:import_jax:OK:0.00\n"
+           "STAGE:client_init:BEGIN\nSTAGE:client_init:OK:2.00\n"
+           "PLATFORM:axon\nNDEV:1\n"
+           "STAGE:compile:BEGIN\nSTAGE:compile:TIMEOUT\n")
+    r = bench._parse_probe_output(out, rc=3)
+    assert r["platform"] == "unreachable"   # enumerated but can't run
+    assert r["hung_stage"] == "compile"
+
+
+def test_parse_cpu_platform_stays_cpu():
+    out = "\n".join([
+        "STAGE:import_jax:BEGIN", "STAGE:import_jax:OK:0.01",
+        "STAGE:client_init:BEGIN", "STAGE:client_init:OK:0.10",
+        "PLATFORM:cpu", "NDEV:1",
+        "STAGE:compile:BEGIN", "STAGE:compile:OK:0.20",
+        "STAGE:transfer:BEGIN", "STAGE:transfer:OK:0.01",
+        "STAGE:execute:BEGIN", "STAGE:execute:OK:0.01",
+        "STAGE:fetch:BEGIN", "STAGE:fetch:OK:0.01",
+        "VALUE:0.5",
+    ])
+    assert bench._parse_probe_output(out, rc=0)["platform"] == "cpu"
+
+
+def test_parse_enumerate_without_execute_not_tpu():
+    """A chip that enumerates but cannot execute must NOT open a
+    window — jobs would all burn their timeouts."""
+    out = ("STAGE:import_jax:BEGIN\nSTAGE:import_jax:OK:0.00\n"
+           "STAGE:client_init:BEGIN\nSTAGE:client_init:OK:1.00\n"
+           "PLATFORM:axon\nNDEV:1\nSTAGE:compile:BEGIN\n")
+    r = bench._parse_probe_output(out, rc=-1)
+    assert r["platform"] == "unreachable"
+    assert r["hung_stage"] == "compile"
+
+
+def test_blackout_report_histogram(tmp_path):
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": "import_jax"},
+        {"ts": "t2", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": "import_jax"},
+        {"ts": "t3", "kind": "probe_long", "platform": "unreachable",
+         "hung_stage": "compile", "stage": "client_init"},
+        {"ts": "t4", "kind": "cpu_control", "ok": True, "secs": 2.0},
+        {"ts": "t5", "kind": "host_state",
+         "relay_ports": [{"port": 48271, "ok": True},
+                         {"port": 2024, "ok": True}]},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert rep["probe_count"] == 3
+    assert rep["failure_histogram"] == {"hung:client_init": 2,
+                                        "hung:compile": 1}
+    assert rep["cpu_control_ok"] == 1
+    assert rep["relay_port_checks"] == {"ok": 2, "total": 2}
+    # dominant-stage diagnosis names client_init and exonerates the
+    # local stack
+    assert "client_init" in rep["diagnosis"]
+    assert "pool-side starvation" in rep["diagnosis"]
+
+
+def test_blackout_report_window_seen(tmp_path):
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+        {"ts": "t2", "kind": "probe", "platform": "tpu",
+         "hung_stage": None, "stage": "fetch"},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert rep["failure_histogram"]["reachable"] == 1
+    assert "reachable" in rep["diagnosis"]
+
+
+def test_parse_malformed_marker_lines_skipped():
+    """Library noise or an interleaved flush must not raise out of the
+    parser and kill the hours-long hunter loop."""
+    out = ("STAGE:compile\n"            # too few fields
+           "STAGE:client_init:OK:notafloat\n"
+           "NDEV:oops\n"
+           "VALUE:nan-ish:extra\n"
+           "STAGE:import_jax:BEGIN\nSTAGE:import_jax:OK:0.00\n"
+           "STAGE:client_init:BEGIN\n")
+    r = bench._parse_probe_output(out, rc=-1)
+    assert r["platform"] == "unreachable"
+    assert r["hung_stage"] == "client_init"
+
+
+def test_parse_cpu_enumerate_without_execute_is_unreachable():
+    """PLATFORM:cpu proves enumeration only — if the pipeline then
+    fails, classifying 'cpu' would mask a broken local stack."""
+    out = ("STAGE:import_jax:BEGIN\nSTAGE:import_jax:OK:0.00\n"
+           "STAGE:client_init:BEGIN\nSTAGE:client_init:OK:0.10\n"
+           "PLATFORM:cpu\nNDEV:1\n"
+           "STAGE:compile:BEGIN\nSTAGE:compile:TIMEOUT\n")
+    r = bench._parse_probe_output(out, rc=3)
+    assert r["platform"] == "unreachable"
+    assert r["hung_stage"] == "compile"
+
+
+def test_blackout_report_recent_dark_after_early_window(tmp_path):
+    """One early window must not pin the diagnosis to 'reachable'
+    through a later multi-hour blackout."""
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "tpu",
+         "hung_stage": None, "stage": "fetch"},
+        {"ts": "t2", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+        {"ts": "t3", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert rep["trailing_dark_probes"] == 2
+    assert "currently dark for 2" in rep["diagnosis"]
+
+
+def test_blackout_report_stale_cpu_pass_does_not_mask_fault(tmp_path):
+    """Only the MOST RECENT cpu control speaks for the stack now."""
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+        {"ts": "t2", "kind": "cpu_control", "ok": True},
+        {"ts": "t3", "kind": "cpu_control", "ok": False,
+         "tail": "disk full"},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert "LOCAL FAULT" in rep["diagnosis"]
+    assert "pool-side starvation" not in rep["diagnosis"]
+
+
+def test_blackout_report_relay_down_diagnosis(tmp_path):
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+        {"ts": "t2", "kind": "host_state",
+         "relay_ports": [{"port": 48271, "ok": False, "err": "refused"},
+                         {"port": 2024, "ok": False, "err": "refused"}]},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert "relay port CLOSED" in rep["diagnosis"]
+
+
+def test_blackout_report_cpu_fallback_bucket(tmp_path):
+    """An honest PLATFORM:cpu probe means the plugin fell away — the
+    most diagnostic signal there is; it must not be binned as a hang."""
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "cpu",
+         "hung_stage": None, "stage": "fetch"},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert rep["failure_histogram"] == {"cpu_fallback": 1}
+    assert "plugin not registering" in rep["diagnosis"]
+
+
+def test_probe_child_rolling_deadline():
+    """The child arms ONE rolling deadline (remaining usable time at
+    each stage boundary), not fixed per-stage slices — a fast early
+    stage must roll its unused budget into later stages so a slow
+    grant is not misclassified as unreachable."""
+    code = bench._PROBE_CHILD.format(usable=145)
+    assert "USABLE - (time.monotonic() - T0)" in code
+    # and the whole child self-deadline sits under the parent's kill
+    assert "USABLE = 145" in code
+
+
+def test_probe_platform_ex_entrypoint_returns():
+    """End-to-end through the real subprocess path (tiny deadline): the
+    full entry point — child spawn, partial-output recovery, logging —
+    must return a dict, not raise.  (A unit-tested parser with a broken
+    entry point shipped once; never again.)"""
+    res = bench.probe_platform_ex(8)
+    assert res["platform"] in ("tpu", "cpu", "unreachable")
+    assert set(res) >= {"stage", "hung_stage", "stages", "rc", "secs",
+                        "error_tail"}
+
+
+def test_blackout_report_local_fault_diagnosis(tmp_path):
+    """All cpu controls failing is the strongest local-fault signal —
+    it must surface in the diagnosis and veto 'pool-side starvation'."""
+    rows = [
+        {"ts": "t1", "kind": "probe", "platform": "unreachable",
+         "hung_stage": "client_init", "stage": None},
+        {"ts": "t2", "kind": "host_state",
+         "relay_ports": [{"port": 48271, "ok": True},
+                         {"port": 2024, "ok": True}]},
+        {"ts": "t3", "kind": "cpu_control", "ok": False,
+         "tail": "ImportError"},
+    ]
+    with open(tmp_path / "probes.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    chip_hunt.update_blackout_report(str(tmp_path))
+    rep = json.load(open(tmp_path / "blackout_report.json"))
+    assert "LOCAL FAULT" in rep["diagnosis"]
+    assert "pool-side starvation" not in rep["diagnosis"]
+    assert rep["cpu_control_total"] == 1
+
+
+def test_host_state_smoke():
+    st = chip_hunt.host_state()
+    assert "relay_ports" in st and len(st["relay_ports"]) == 2
+    for chk in st["relay_ports"]:
+        assert "ok" in chk
+
+
+def test_cpu_control_probe_passes():
+    """The local-stack control must pass on this host (it pins the cpu
+    backend via jax.config, dodging the axon re-registration)."""
+    ctl = chip_hunt.cpu_control_probe(timeout=240)
+    assert ctl["ok"], ctl
+
+
+@pytest.mark.tpu
+def test_staged_probe_on_chip():
+    res = bench.probe_platform_ex(300)
+    assert res["platform"] == "tpu", res
+    assert res["value_ok"] is True
