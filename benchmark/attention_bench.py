@@ -126,7 +126,10 @@ def main():
             tx = _slope_time(lambda: xla_f(q, k, v), args.iters)
             tgf = _slope_time(lambda: flash_g(q, k, v), args.iters)
             tgx = _slope_time(lambda: xla_g(q, k, v), args.iters)
-            picked = _flash_preferred(s, s)
+            # mirror the real dispatch decision (batch/heads feed the
+            # HBM score-tensor budget) or the recorded auto row could
+            # measure a path dot_product_attention would not take
+            picked = _flash_preferred(s, s, batch=b, heads=h)
             t_auto = (tf if picked else tx, tgf if picked else tgx)
             row = {"seq": s, "causal": causal,
                    "fwd_flash_ms": round(tf, 3),

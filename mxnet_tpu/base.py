@@ -17,6 +17,10 @@ class MXNetError(RuntimeError):
     """Error raised by the framework (parity with mxnet.base.MXNetError)."""
 
 
+# platforms already warned about by on_accelerator (warn once per name)
+_WARNED_PLATFORMS: set = set()
+
+
 def on_accelerator() -> bool:
     """True when jax's default backend is the TPU chip.
 
@@ -30,10 +34,25 @@ def on_accelerator() -> bool:
     """
     import jax
     try:
-        return jax.default_backend() not in (
-            "cpu", "gpu", "cuda", "rocm", "metal")
+        plat = jax.default_backend()
     except Exception:
         return False
+    if plat in ("cpu", "gpu", "cuda", "rocm", "metal"):
+        return False
+    if plat not in ("tpu", "axon") and plat not in _WARNED_PLATFORMS:
+        # denylist consequence (ADVICE r4): an unknown NON-TPU plugin
+        # ('neuron', 'xpu', ...) is treated as TPU here and will
+        # hard-fail in Mosaic/Pallas lowering — warn once so the
+        # resulting error is attributable
+        _WARNED_PLATFORMS.add(plat)
+        import warnings
+        warnings.warn(
+            f"on_accelerator: unrecognized PJRT platform {plat!r} "
+            f"treated as TPU; Mosaic/Pallas kernels will be enabled "
+            f"and will fail if this is not a TPU "
+            f"(set MXTPU_DISABLE_FLASH=1 to keep XLA paths)",
+            stacklevel=2)
+    return True
 
 
 numeric_types = (float, int, np.generic)

@@ -62,8 +62,12 @@ def _st_dtype(arr):
     raise MXNetError(f"cannot write dtype {arr.dtype} to safetensors")
 
 
-def read_safetensors(path):
-    """path → {name: np.ndarray} (zero-copy views onto one mmap)."""
+def read_safetensors(path, return_metadata=False):
+    """path → {name: np.ndarray} (zero-copy views onto one mmap).
+
+    With ``return_metadata=True`` returns ``(tensors, metadata_dict)``
+    where metadata is the header's ``__metadata__`` entry ({} if
+    absent)."""
     size = os.path.getsize(path)
     with open(path, "rb") as f:
         (hlen,) = struct.unpack("<Q", f.read(8))
@@ -86,8 +90,26 @@ def read_safetensors(path):
             continue
         dt = _np_dtype(spec["dtype"])
         lo, hi = spec["data_offsets"]
+        # a truncated or malformed shard must keep the MXNetError
+        # contract the header checks establish — not surface as a raw
+        # ValueError from np.frombuffer, or silently alias overlapping
+        # views (ADVICE r4)
+        if not (0 <= lo <= hi <= buf.size):
+            raise MXNetError(
+                f"{path}: tensor {name!r} data_offsets [{lo}, {hi}) "
+                f"out of bounds for {buf.size}-byte data section "
+                f"(truncated or malformed shard?)")
+        want = (np.dtype(dt).itemsize
+                * int(np.prod(spec["shape"], dtype=np.int64)))
+        if hi - lo != want:
+            raise MXNetError(
+                f"{path}: tensor {name!r} data_offsets span "
+                f"{hi - lo} bytes but dtype {spec['dtype']} × shape "
+                f"{spec['shape']} needs {want}")
         out[name] = np.frombuffer(
             buf[lo:hi], dtype=dt).reshape(spec["shape"])
+    if return_metadata:
+        return out, header.get("__metadata__", {}) or {}
     return out
 
 

@@ -784,8 +784,12 @@ def save(fname: str, data):
     if fname.endswith(".safetensors"):
         # ecosystem interop by extension: any {name: NDArray} dict
         # round-trips with HF tooling (unnamed entries get list
-        # indices, matching torch.save-style exports)
+        # indices, matching torch.save-style exports).  A saved LIST is
+        # marked in __metadata__ so load() can reconstruct it without
+        # guessing from key patterns — a foreign or explicit dict with
+        # digit keys must stay a dict.
         from ..models.hf_loader import write_safetensors
+        was_list = not isinstance(data, (NDArray, dict))
         named = {}
         for i, (name, arr) in enumerate(pairs):
             key = name or str(i)
@@ -795,7 +799,9 @@ def save(fname: str, data):
                     "index substitution — a tensor would be "
                     "silently dropped")
             named[key] = arr.asnumpy()
-        write_safetensors(fname, named)
+        write_safetensors(fname, named,
+                          metadata={"mxtpu_format": "list"}
+                          if was_list else None)
         return
     with open(fname, "wb") as f:
         f.write(_MAGIC)
@@ -857,8 +863,22 @@ def load(fname: str):
             magic = f.read(8)
         if magic != _MAGIC:
             from ..models.hf_loader import read_safetensors
-            return {name: array(np.asarray(a), dtype=a.dtype)
-                    for name, a in read_safetensors(fname).items()}
+            raw, meta = read_safetensors(fname, return_metadata=True)
+            loaded = {name: array(np.asarray(a), dtype=a.dtype)
+                      for name, a in raw.items()}
+            # save(list) stores unnamed entries under keys "0","1",...
+            # (the safetensors format has no list notion) and stamps
+            # __metadata__; reconstruct the list only on that marker so
+            # the documented round-trip holds (ADVICE r4) while foreign
+            # or explicit digit-keyed dicts stay dicts
+            if meta.get("mxtpu_format") == "list":
+                try:
+                    idx = sorted(int(k) for k in loaded)
+                except ValueError:
+                    return loaded
+                if idx == list(range(len(loaded))):
+                    return [loaded[str(i)] for i in idx]
+            return loaded
     with open(fname, "rb") as f:
         return _load_stream(f, fname)
 

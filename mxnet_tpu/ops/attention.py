@@ -101,6 +101,12 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     num_heads, head_dim).
     """
     mask = rest[0] if use_mask and rest else None
+    # NOTE: flash=True is a REQUEST, not a guarantee — the measured
+    # crossover policy (_flash_preferred) may still route mid-range
+    # sequences to XLA SDPA when that path benched faster, unless the
+    # estimated S×S score tensor would blow the HBM budget.  Set
+    # MXTPU_FLASH_MODE=always to force the kernel (or =never for XLA);
+    # MXTPU_FLASH_XLA_FROM/_UNTIL tune the crossover window.
     if window is not None:
         # validate HERE so the XLA fallback cannot silently produce
         # uniform-attention garbage (window=0 clears the whole causal
@@ -134,7 +140,9 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     # a sliding window always prefers the kernel: block-skip makes it
     # O(S·W) while the XLA path still materializes the S×S band
     preferred = (window is not None
-                 or _flash_preferred(query.shape[1], key.shape[1]))
+                 or _flash_preferred(query.shape[1], key.shape[1],
+                                     batch=query.shape[0],
+                                     heads=query.shape[2]))
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key) and preferred:
         # dispatch evidence: incremented at TRACE time, so a nonzero
@@ -156,7 +164,7 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     return _sdpa_xla(query, key, value, mask, s, causal, window=window)
 
 
-def _flash_preferred(s_q, s_k):
+def _flash_preferred(s_q, s_k, batch=1, heads=1):
     """Measured flash-vs-XLA crossover policy (VERDICT r3 #4: a hand
     kernel must win or step aside, the cuDNN-fast-path pattern).
 
@@ -165,7 +173,12 @@ def _flash_preferred(s_q, s_k):
     two-pass backward loses 0.60-0.67x at seq 2048.  Auto policy:
       * seq ≤ MXTPU_FLASH_XLA_FROM (default 2048, exclusive): flash —
         it wins or ties, and skips the S×S HBM materialization;
-      * the measured XLA-win window [FROM, UNTIL): XLA SDPA;
+      * the measured XLA-win window [FROM, UNTIL): XLA SDPA — UNLESS
+        the estimated f32 score tensor (batch·heads·s_q·s_k·4B, the
+        thing XLA materializes and flash doesn't) exceeds
+        MXTPU_FLASH_XLA_MAX_SCORE_GB (default 2 GiB, ~1/8 of v5e's
+        16 GiB HBM): a policy tuned at small batch must not OOM a
+        large-batch run that explicitly asked for flash (ADVICE r4);
       * seq ≥ MXTPU_FLASH_XLA_UNTIL (default 4096): flash regardless —
         XLA's O(S²) score tensor becomes the HBM bottleneck there
         (b4·h8·4096² f32 scores alone are 2.1 GiB), which is the case
@@ -182,7 +195,11 @@ def _flash_preferred(s_q, s_k):
     s = max(s_q, s_k)
     xla_from = int(os.environ.get("MXTPU_FLASH_XLA_FROM", "2048"))
     xla_until = int(os.environ.get("MXTPU_FLASH_XLA_UNTIL", "4096"))
-    return s < xla_from or s >= xla_until
+    if s < xla_from or s >= xla_until:
+        return True
+    score_gb = batch * heads * s_q * s_k * 4 / 2**30
+    max_gb = float(os.environ.get("MXTPU_FLASH_XLA_MAX_SCORE_GB", "2"))
+    return score_gb > max_gb
 
 
 def _flash_viable(q, k):

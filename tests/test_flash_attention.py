@@ -398,6 +398,38 @@ class TestFlashSelection:
         # cross-attention uses the max of the two lengths
         assert not _flash_preferred(128, 2048)
 
+    def test_xla_window_yields_to_hbm_budget(self, monkeypatch):
+        """Inside the measured XLA-win window the policy must still
+        fall back to flash when the f32 score tensor it would
+        materialize exceeds the HBM budget (ADVICE r4: a policy tuned
+        at small batch must not OOM a large-batch flash=True caller).
+        b32·h12·2048² f32 = 6 GiB > the 2 GiB default budget."""
+        from mxnet_tpu.ops.attention import _flash_preferred
+        monkeypatch.delenv("MXTPU_FLASH_MODE", raising=False)
+        assert not _flash_preferred(2048, 2048, batch=1, heads=8)
+        assert _flash_preferred(2048, 2048, batch=32, heads=12)
+        # budget is env-tunable
+        monkeypatch.setenv("MXTPU_FLASH_XLA_MAX_SCORE_GB", "0.1")
+        assert _flash_preferred(2048, 2048, batch=1, heads=8)
+
+    def test_unknown_platform_warns_once(self, monkeypatch):
+        """The on_accelerator denylist treats unknown PJRT platforms as
+        TPU (so new tunnel spellings keep the kernels on) — but must
+        warn once so the eventual Mosaic failure is attributable
+        (ADVICE r4)."""
+        import warnings
+        import jax
+        import mxnet_tpu.base as base
+        monkeypatch.setattr(jax, "default_backend", lambda: "neuron")
+        monkeypatch.setattr(base, "_WARNED_PLATFORMS", set())
+        with pytest.warns(UserWarning, match="neuron"):
+            assert base.on_accelerator()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")      # second call: silent
+            assert base.on_accelerator()
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert not base.on_accelerator()
+
     def test_mode_env_overrides(self, monkeypatch):
         from mxnet_tpu.ops.attention import _flash_preferred
         monkeypatch.setenv("MXTPU_FLASH_MODE", "never")

@@ -51,6 +51,41 @@ class TestSafetensorsCodec:
                 np.asarray(back[k], "f4"),
                 np.asarray(tensors[k], "f4"))
 
+    def test_truncated_shard_raises_mxneterror(self, tmp_path):
+        """Offsets past the data section (truncated download) must keep
+        the MXNetError contract, not surface a raw numpy ValueError
+        (ADVICE r4)."""
+        from mxnet_tpu.base import MXNetError
+        p = str(tmp_path / "t.safetensors")
+        write_safetensors(p, {"x": np.arange(64, dtype="f4")})
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[:-32])          # chop the tail of the data
+        with pytest.raises(MXNetError, match="out of bounds"):
+            read_safetensors(p)
+
+    def test_offset_span_dtype_shape_mismatch_raises(self, tmp_path):
+        """A span that doesn't match dtype×shape (malformed header)
+        raises MXNetError instead of reshaping garbage or aliasing an
+        overlapping view."""
+        import json as _json
+        from mxnet_tpu.base import MXNetError
+        p = str(tmp_path / "t.safetensors")
+        write_safetensors(p, {"x": np.arange(8, dtype="f4"),
+                              "y": np.arange(8, dtype="f4")})
+        raw = open(p, "rb").read()
+        (hlen,) = struct.unpack("<Q", raw[:8])
+        hdr = _json.loads(raw[8:8 + hlen])
+        hdr["y"]["data_offsets"] = [0, 32]       # overlaps x's bytes
+        hdr["y"]["shape"] = [16]                 # span no longer fits
+        hj = _json.dumps(hdr, separators=(",", ":")).encode()
+        with open(p, "wb") as f:
+            f.write(struct.pack("<Q", len(hj)))
+            f.write(hj)
+            f.write(raw[8 + hlen:])
+        with pytest.raises(MXNetError, match="needs"):
+            read_safetensors(p)
+
     def test_header_is_spec_layout(self, tmp_path):
         """First 8 bytes LE u64 header length, then JSON — readable by
         any other safetensors implementation."""
