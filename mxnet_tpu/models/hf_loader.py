@@ -138,6 +138,65 @@ def write_safetensors(path, tensors, metadata=None):
             f.write(arr.tobytes())
 
 
+def _plan_shards(sizes, max_shard_bytes):
+    """Greedy sorted-name packing of {name: nbytes} into shard groups;
+    a single tensor larger than ``max_shard_bytes`` gets its own shard
+    (tensors are never split).  Returns a list of name lists."""
+    groups, cur, cur_bytes = [], [], 0
+    for name in sorted(sizes):
+        nb = int(sizes[name])
+        if cur and cur_bytes + nb > max_shard_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(name)
+        cur_bytes += nb
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def write_safetensors_sharded(dir_path, tensors, max_shard_bytes,
+                              metadata=None, materialize=None):
+    """Write ``tensors`` as HF-layout shards under ``dir_path``:
+    ``model-0000i-of-0000n.safetensors`` + ``model.safetensors.index.json``
+    (the layout :func:`_shard_paths` consumes).  Returns the index path.
+
+    STREAMING form: pass ``tensors`` as ``{name: (shape, dtype)}`` with
+    ``materialize(name) -> np.ndarray`` — each tensor is materialized
+    only while its shard is being written and dropped after, so peak
+    host memory is one shard, not the model (the big-model save path;
+    ``llama_spmd.save_llama_stacked`` gathers device shards this way).
+    """
+    os.makedirs(dir_path, exist_ok=True)
+    if materialize is None:
+        tensors = {k: np.ascontiguousarray(v)
+                   for k, v in tensors.items()}
+        sizes = {k: v.nbytes for k, v in tensors.items()}
+        fetch = tensors.__getitem__
+    else:
+        sizes = {k: int(np.prod(shape, dtype=np.int64))
+                 * np.dtype(dt).itemsize
+                 for k, (shape, dt) in tensors.items()}
+        fetch = materialize
+    groups = _plan_shards(sizes, max_shard_bytes)
+    n = len(groups)
+    weight_map, total = {}, 0
+    for i, names in enumerate(groups, start=1):
+        shard = f"model-{i:05d}-of-{n:05d}.safetensors"
+        group = {name: fetch(name) for name in names}
+        write_safetensors(os.path.join(dir_path, shard), group,
+                          metadata=metadata)
+        for name in names:
+            weight_map[name] = shard
+            total += sizes[name]
+        del group
+    idx_path = os.path.join(dir_path, "model.safetensors.index.json")
+    with open(idx_path, "w") as f:
+        json.dump({"metadata": {"total_size": total},
+                   "weight_map": weight_map}, f, indent=1)
+    return idx_path
+
+
 def _shard_paths(path):
     """A file, a sharded index json, or a directory → ordered shards."""
     if os.path.isdir(path):
@@ -317,10 +376,13 @@ def load_hf_llama(net, path, ctx=None, dtype="float32",
     return net
 
 
-def export_hf_llama(net, path, dtype=np.float32, metadata=None):
+def export_hf_llama(net, path, dtype=np.float32, metadata=None,
+                    max_shard_bytes=None):
     """Write a ``LlamaForCausalLM``'s weights as ONE HF-layout
     safetensors file (inverse of :func:`load_hf_llama`, q/k rows
-    permuted back to rotate-half order)."""
+    permuted back to rotate-half order).  With ``max_shard_bytes``,
+    ``path`` is a DIRECTORY and the weights are written as HF-style
+    shards + index via :func:`write_safetensors_sharded`."""
     attn = net.model.layers[0].attn
     h, kv, d = attn._h, attn._kv, attn._d
     out = {}
@@ -333,8 +395,11 @@ def export_hf_llama(net, path, dtype=np.float32, metadata=None):
         elif kind == "k":
             arr = _permute_qk(arr, kv, d, invert=True)
         out[hf_name] = arr
-    write_safetensors(path, out, metadata=metadata or
-                      {"format": "pt", "producer": "mxnet_tpu"})
+    meta = metadata or {"format": "pt", "producer": "mxnet_tpu"}
+    if max_shard_bytes is not None:
+        return write_safetensors_sharded(path, out, max_shard_bytes,
+                                         metadata=meta)
+    write_safetensors(path, out, metadata=meta)
 
 
 # ---------------------------------------------------------------------------

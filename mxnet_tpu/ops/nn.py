@@ -243,9 +243,19 @@ def chunked_softmax_ce(hidden, weight, label, *, chunk=8192):
         lab = lab + jnp.where(in_range, picked, 0.0)
         return (m_new, s, lab), None
 
-    init = (jnp.full((n,), -jnp.inf, jnp.float32),
-            jnp.zeros((n,), jnp.float32),
-            jnp.zeros((n,), jnp.float32))
+    # tie the init carry's device-varying type to the inputs: under
+    # shard_map (pipeline/tensor parallel callers) the loop output
+    # varies over the manual axes hidden/label vary over, and lax.scan
+    # requires carry-in and carry-out types to match — a fresh
+    # replicated constant would not.  The where (not hidden*0, which
+    # is NaN for an inf/NaN element and would contaminate EVERY row's
+    # loss) is exactly 0 for any input while still inheriting the
+    # varying type; int label*0 is always 0.
+    tie = (jnp.where(jnp.isfinite(hidden[0, 0]), 0.0, 0.0)
+           + lbl[0] * 0).astype(jnp.float32)
+    init = (jnp.full((n,), -jnp.inf, jnp.float32) + tie,
+            jnp.zeros((n,), jnp.float32) + tie,
+            jnp.zeros((n,), jnp.float32) + tie)
     (m, s, lab), _ = jax.lax.scan(
         slab, init, (w, jnp.arange(n_chunks, dtype=jnp.int32)))
     return m + jnp.log(s) - lab

@@ -114,12 +114,34 @@ def _structural_fn_key(fn):
     return key, captured
 
 
-def _validate_and_place(fname, stacked_params, x, n_microbatches,
-                        mesh, axis, y=None):
-    """Shared arg validation + param placement for the pipeline entry
-    points.  Returns (mesh, n_stages, params placed on P(axis))."""
+def _resolve_specs(stacked_params, param_specs, axis):
+    """Per-leaf PartitionSpecs: default P(axis); a caller-supplied
+    pytree (matching stacked_params' structure) lets individual leaves
+    carry EXTRA mesh axes — e.g. P('pp', 'tp') column-parallel layer
+    weights, composing pipeline with tensor parallelism.  Every spec
+    must keep ``axis`` on the leading (stage) dim."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    if param_specs is None:
+        return jax.tree_util.tree_map(lambda _: P(axis),
+                                      stacked_params)
+    def _check(_, s):
+        if not len(s) or s[0] != axis:
+            raise MXNetError(
+                f"param_specs leaf {s} must shard the leading stage "
+                f"dim over {axis!r}")
+
+    jax.tree_util.tree_map(_check, stacked_params, param_specs)
+    return param_specs
+
+
+def _validate_and_place(fname, stacked_params, x, n_microbatches,
+                        mesh, axis, y=None, param_specs=None):
+    """Shared arg validation + param placement for the pipeline entry
+    points.  Returns (mesh, n_stages, placed params, specs)."""
+    import jax
+    from jax.sharding import NamedSharding
 
     mesh = mesh if mesh is not None else current_mesh()
     if axis not in mesh.axis_names:
@@ -138,20 +160,25 @@ def _validate_and_place(fname, stacked_params, x, n_microbatches,
     if y is not None and y.shape[0] != x.shape[0]:
         raise MXNetError(
             f"{fname}: y batch {y.shape[0]} != x batch {x.shape[0]}")
+    specs = _resolve_specs(stacked_params, param_specs, axis)
     params = jax.tree_util.tree_map(
-        lambda l: jax.device_put(l, NamedSharding(mesh, P(axis))),
-        stacked_params)
-    return mesh, n, params
+        lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+        stacked_params, specs)
+    return mesh, n, params, specs
 
 
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
-                   mesh=None, axis="pp"):
+                   mesh=None, axis="pp", param_specs=None):
     """Apply ``n_stages`` homogeneous stages as a GPipe pipeline.
 
     stage_fn(params_i, x_mb) -> y_mb (same shape as x_mb);
     stacked_params: pytree whose leaves have leading dim n_stages
     (sharded over ``axis``); x: (batch, ...) jax array — split into
     ``n_microbatches`` along dim 0.  Returns (batch, ...).
+    ``param_specs`` (optional pytree of PartitionSpecs) lets leaves
+    carry extra mesh axes — e.g. ``P('pp', 'tp')`` tensor-parallel
+    weights, with ``stage_fn`` issuing the matching ``tp``
+    collectives.
 
     The jitted executable is cached per (mesh, axis, stage_fn, shapes).
     """
@@ -160,24 +187,24 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    mesh, n, params = _validate_and_place(
+    mesh, n, params, specs = _validate_and_place(
         "pipeline_apply", stacked_params, x, n_microbatches, mesh,
-        axis)
+        axis, param_specs=param_specs)
     leaves = jax.tree_util.tree_leaves(stacked_params)
     fn_key, captured = _structural_fn_key(stage_fn)
     key = (mesh, axis, fn_key, n_microbatches,
-           tuple(l.shape for l in leaves), x.shape, str(x.dtype))
+           tuple(l.shape for l in leaves), x.shape, str(x.dtype),
+           tuple(str(s) for s in jax.tree_util.tree_leaves(
+               specs, is_leaf=lambda s: isinstance(s, P))))
     entry = _EXEC_CACHE.get(key)
     fn = entry[0] if entry is not None else None
     if fn is None:
-        pspec = P(axis)
         rspec = P()
         body = shard_map(
             partial(_local_schedule, stage_fn=stage_fn, axis=axis,
                     n_microbatches=n_microbatches),
             mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: pspec,
-                                             stacked_params), rspec),
+            in_specs=(specs, rspec),
             out_specs=rspec)
 
         def run(params, xb):
@@ -286,7 +313,8 @@ def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
 
 
 def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
-                            n_microbatches, mesh=None, axis="pp"):
+                            n_microbatches, mesh=None, axis="pp",
+                            param_specs=None):
     """1F1B pipeline training step: mean loss + stacked param grads.
 
     stage_fn(params_i, x_mb) -> y_mb (same shape); loss_fn(out_mb,
@@ -294,7 +322,11 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     with leading dim n_stages sharded over ``axis``; x, y: (batch,
     ...) split into ``n_microbatches`` along dim 0.  Returns
     ``(loss, grads)`` with ``grads`` shaped/sharded like
-    ``stacked_params`` — feed them to any optimizer.
+    ``stacked_params`` — feed them to any optimizer.  ``param_specs``
+    (optional pytree of PartitionSpecs) composes tensor parallelism
+    into the pipeline: leaves may shard extra mesh axes (e.g.
+    ``P('pp', 'tp')``) with ``stage_fn``/``loss_fn`` issuing the
+    matching collectives; grads come back in the same layout.
 
     Compared with differentiating :func:`pipeline_apply`, the explicit
     1F1B schedule bounds in-flight activation memory by pipeline depth
@@ -305,31 +337,28 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    mesh, n, params = _validate_and_place(
+    mesh, n, params, specs = _validate_and_place(
         "pipeline_value_and_grad", stacked_params, x, n_microbatches,
-        mesh, axis, y=y)
+        mesh, axis, y=y, param_specs=param_specs)
     leaves = jax.tree_util.tree_leaves(stacked_params)
     sfn_key, s_cap = _structural_fn_key(stage_fn)
     lfn_key, l_cap = _structural_fn_key(loss_fn)
     key = ("1f1b", mesh, axis, sfn_key, lfn_key, n_microbatches,
            tuple(l.shape for l in leaves),
            tuple(str(l.dtype) for l in leaves),
-           x.shape, str(x.dtype), y.shape, str(y.dtype))
+           x.shape, str(x.dtype), y.shape, str(y.dtype),
+           tuple(str(s) for s in jax.tree_util.tree_leaves(
+               specs, is_leaf=lambda s: isinstance(s, P))))
     entry = _EXEC_CACHE.get(key)
     fn = entry[0] if entry is not None else None
     if fn is None:
-        pspec = P(axis)
         rspec = P()
         body = shard_map(
             partial(_local_1f1b, stage_fn=stage_fn, loss_fn=loss_fn,
                     axis=axis, n_microbatches=n_microbatches),
             mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: pspec,
-                                             stacked_params),
-                      rspec, rspec),
-            out_specs=(rspec,
-                       jax.tree_util.tree_map(lambda _: pspec,
-                                              stacked_params)))
+            in_specs=(specs, rspec, rspec),
+            out_specs=(rspec, specs))
 
         def run(params, xb, yb):
             mb = xb.shape[0] // n_microbatches

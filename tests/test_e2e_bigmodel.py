@@ -1,0 +1,186 @@
+"""End-to-end big-model integration (VERDICT r4 next #3).
+
+ONE flow proving the seams fit: a sharded synthetic Llama-style
+safetensors checkpoint → streamed SHARDED onto an 8-virtual-device
+tp×pp mesh (``jax.make_array_from_callback``; no full-model host
+materialization) → forward parity vs the Gluon net loaded from the
+SAME checkpoint → 3 fused 1F1B fine-tune steps with
+``chunked_softmax_ce`` (loss decreases) → resharded save → reload
+round-trip parity.
+
+Reference analog: upstream's checkpoint + model-parallel pieces were
+never composed either (SURVEY.md §2.3); this is the BASELINE config #5
+serving story at test scale.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import llama_spmd
+from mxnet_tpu.models.hf_loader import (export_hf_llama, load_hf_llama,
+                                        read_safetensors)
+from mxnet_tpu.models.llama import LlamaForCausalLM, get_llama
+
+L, TP, PP = 4, 2, 4          # 4 decoder layers, one per pp stage
+V, B, S = 256, 8, 16
+HEADS, KV = 4, 2
+
+
+def _make_net(seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = LlamaForCausalLM(
+        get_llama("llama_tiny", vocab_size=V, num_layers=L))
+    net.initialize(mx.init.Xavier())
+    # materialize params (deferred init) with one forward
+    net(nd.array(np.zeros((1, 4), "f4")))
+    return net
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """A SHARDED synthetic checkpoint written by the export path."""
+    d = tmp_path_factory.mktemp("llama_ckpt")
+    net = _make_net()
+    # small cap -> several shards; proves the index path end to end
+    export_hf_llama(net, str(d), max_shard_bytes=96 * 1024)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.make_mesh({"tp": TP, "pp": PP})
+
+
+@pytest.fixture(scope="module")
+def loaded(ckpt_dir, mesh):
+    return llama_spmd.load_llama_stacked(
+        ckpt_dir, mesh, num_heads=HEADS, num_kv_heads=KV,
+        rope_base=10000.0)
+
+
+class TestShardedCheckpoint:
+    def test_index_and_multiple_shards(self, ckpt_dir):
+        idx = json.load(open(
+            os.path.join(ckpt_dir, "model.safetensors.index.json")))
+        shards = set(idx["weight_map"].values())
+        assert len(shards) >= 3, shards
+        # every shard parses standalone and the map is complete
+        names = set()
+        for s in shards:
+            names |= set(read_safetensors(
+                os.path.join(ckpt_dir, s)))
+        assert names == set(idx["weight_map"])
+        sizes = [os.path.getsize(os.path.join(ckpt_dir, s))
+                 for s in shards]
+        assert sum(sizes) > idx["metadata"]["total_size"]  # + headers
+
+    def test_load_places_sharded_not_replicated(self, loaded, mesh):
+        params, specs, config = loaded
+        assert config["num_layers"] == L and config["vocab"] == V
+        q = params["layers"]["q"]
+        assert q.shape == (L, HEADS * config["head_dim"],
+                           config["units"])
+        # each device holds ONE stage's tp column shard — 1/(PP*TP) of
+        # the stacked tensor, the no-host-materialization contract
+        shard = q.addressable_shards[0]
+        assert shard.data.shape == (L // PP,
+                                    HEADS * config["head_dim"] // TP,
+                                    config["units"])
+        assert "tp" in str(q.sharding.spec) \
+            and "pp" in str(q.sharding.spec)
+        down = params["layers"]["down"]
+        assert down.addressable_shards[0].data.shape == (
+            L // PP, config["units"], config["hidden"] // TP)
+
+
+class TestParityAndTraining:
+    def test_pipeline_forward_matches_gluon(self, ckpt_dir, loaded,
+                                            mesh):
+        """The tp×pp pipeline forward must equal the Gluon net loaded
+        from the SAME sharded checkpoint — this is the seam test: HF
+        names, RoPE permutation, stacking, tp collectives, pipeline
+        schedule all have to agree for these numbers to match."""
+        params, specs, config = loaded
+        net = LlamaForCausalLM(
+            get_llama("llama_tiny", vocab_size=V, num_layers=L))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1, 4), "f4")))
+        load_hf_llama(net, ckpt_dir)
+        toks = np.random.RandomState(1).randint(0, V, (B, S))
+        ref = net(nd.array(toks.astype("f4"))).asnumpy()
+        got = np.asarray(llama_spmd.forward_logits(
+            params, toks, config, mesh, specs))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_three_finetune_steps_loss_decreases(self, loaded, mesh):
+        params, specs, config = loaded
+        toks = np.random.RandomState(2).randint(0, V, (B, S))
+        losses = []
+        for _ in range(3):
+            loss, params = llama_spmd.train_step(
+                params, toks, config, mesh, specs, lr=0.05,
+                vocab_chunk=64)
+            losses.append(float(np.asarray(loss)))
+        assert all(np.isfinite(v) for v in losses), losses
+        assert losses[2] < losses[0], losses
+        # updates kept the sharded stacked layout
+        q = params["layers"]["q"]
+        assert "tp" in str(q.sharding.spec) \
+            and "pp" in str(q.sharding.spec)
+
+    def test_resharded_save_round_trip(self, loaded, mesh, tmp_path):
+        """Train → reshard-save → reload BOTH ways (spmd + Gluon):
+        forward parity proves the inverse RoPE permutation and shard
+        layout survive the round trip."""
+        params, specs, config = loaded
+        toks = np.random.RandomState(3).randint(0, V, (B, S))
+        loss, params = llama_spmd.train_step(
+            params, toks, config, mesh, specs, lr=0.05, vocab_chunk=64)
+        out_dir = str(tmp_path / "resaved")
+        llama_spmd.save_llama_stacked(params, out_dir, config,
+                                      max_shard_bytes=96 * 1024)
+        logits_trained = np.asarray(llama_spmd.forward_logits(
+            params, toks, config, mesh, specs))
+        # reload into the spmd form
+        params2, specs2, config2 = llama_spmd.load_llama_stacked(
+            out_dir, mesh, num_heads=HEADS, num_kv_heads=KV)
+        logits_reloaded = np.asarray(llama_spmd.forward_logits(
+            params2, toks, config2, mesh, specs2))
+        np.testing.assert_allclose(logits_reloaded, logits_trained,
+                                   rtol=2e-5, atol=2e-5)
+        # and into the user-facing Gluon net (HF-compatible layout)
+        net = LlamaForCausalLM(
+            get_llama("llama_tiny", vocab_size=V, num_layers=L))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1, 4), "f4")))
+        load_hf_llama(net, out_dir)
+        ref = net(nd.array(toks.astype("f4"))).asnumpy()
+        np.testing.assert_allclose(ref, logits_trained,
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestChunkedCEInsidePipeline:
+    def test_loss_matches_full_softmax_reference(self, loaded, mesh):
+        """The pipelined chunked-CE loss equals a plain full-logits CE
+        computed from the pipeline's own forward — the streaming scan
+        changes memory, not math."""
+        params, specs, config = loaded
+        toks = np.random.RandomState(4).randint(0, V, (B, S))
+        loss, _ = llama_spmd.train_step(
+            params, toks, config, mesh, specs, lr=0.0, vocab_chunk=64)
+        logits = np.asarray(llama_spmd.forward_logits(
+            params, toks, config, mesh, specs))[:, :-1]
+        labels = toks[:, 1:]
+        lse = np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1)) \
+            + logits.max(-1)
+        picked = np.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0]
+        ref = float((lse - picked).mean())
+        np.testing.assert_allclose(float(np.asarray(loss)), ref,
+                                   rtol=1e-5)
