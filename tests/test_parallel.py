@@ -599,6 +599,93 @@ class TestVocabParallelCE:
         np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
                                    rtol=2e-4, atol=1e-6)
 
+    def test_unified_entry_parity_matrix(self):
+        """VERDICT r4 #4: ONE entry point (`chunked_softmax_ce`) whose
+        {1-dev, tp=2} × {chunked, full} variants all agree with the
+        full-softmax reference — values AND grads (dH, dW)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from mxnet_tpu.ops.nn import chunked_softmax_ce
+        from mxnet_tpu.parallel import collectives
+
+        mesh = parallel.make_mesh({"tp": 2})
+        rng = np.random.RandomState(1)
+        n, u, v = 16, 12, 64
+        h = jnp.asarray(rng.randn(n, u).astype("f4"))
+        w = jnp.asarray(rng.randn(v, u).astype("f4") * 0.3)
+        lbl = jnp.asarray(rng.randint(0, v, (n,)).astype("f4"))
+
+        def ref_loss(h, w, lbl):
+            lp = jax.nn.log_softmax(h @ w.T, axis=-1)
+            return -jnp.take_along_axis(
+                lp, lbl.astype("int32")[:, None], 1).mean()
+
+        def tp_loss(chunk):
+            def fn(h, w, lbl):
+                return shard_map(
+                    lambda h_, w_, l_: chunked_softmax_ce(
+                        h_, w_, l_, chunk=chunk, axis_name="tp"),
+                    mesh=mesh, in_specs=(P(), P("tp", None), P()),
+                    out_specs=P(), check_vma=False)(h, w, lbl).mean()
+            return fn
+
+        variants = {
+            "1dev_chunked": lambda h, w, l: chunked_softmax_ce(
+                h, w, l, chunk=8).mean(),
+            "1dev_full": lambda h, w, l: chunked_softmax_ce(
+                h, w, l, chunk=v).mean(),
+            "tp2_chunked": tp_loss(8),       # multi-slab inside shard
+            "tp2_full": tp_loss(v),          # single local slab
+            "tp2_via_vocab_parallel": lambda h, w, l: shard_map(
+                lambda h_, w_, l_:
+                collectives.vocab_parallel_softmax_ce(
+                    h_, w_, l_, "tp", chunk=8),
+                mesh=mesh, in_specs=(P(), P("tp", None), P()),
+                out_specs=P(), check_vma=False)(h, w, l).mean(),
+        }
+        want = float(ref_loss(h, w, lbl))
+        rh, rw = jax.grad(ref_loss, argnums=(0, 1))(h, w, lbl)
+        for name, fn in variants.items():
+            got = float(jax.jit(fn)(h, w, lbl))
+            np.testing.assert_allclose(got, want, rtol=1e-5,
+                                       err_msg=name)
+            gh, gw = jax.jit(jax.grad(fn, argnums=(0, 1)))(h, w, lbl)
+            np.testing.assert_allclose(np.asarray(gh), np.asarray(rh),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=name)
+            np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=name)
+
+    def test_unified_tp_chunked_no_full_logits(self):
+        """tp × chunked keeps BOTH bounds: no (N, V) and no
+        (N, V/tp) tensor in the lowered HLO — only (N, chunk) slabs."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from mxnet_tpu.ops.nn import chunked_softmax_ce
+
+        mesh = parallel.make_mesh({"tp": 2})
+        # n deliberately != v/(tp*chunk): the positive (n, chunk)
+        # assertion below must pin the LOGITS slab, not coincidentally
+        # match the (n_chunks, chunk, u) weight reshape
+        n, u, v, chunk = 7, 4, 4096, 256
+        h = jnp.ones((n, u), jnp.float32)
+        w = jnp.ones((v, u), jnp.float32)
+        lbl = jnp.zeros((n,), jnp.float32)
+        fn = jax.jit(shard_map(
+            lambda h_, w_, l_: chunked_softmax_ce(
+                h_, w_, l_, chunk=chunk, axis_name="tp"),
+            mesh=mesh, in_specs=(P(), P("tp", None), P()),
+            out_specs=P(), check_vma=False))
+        txt = fn.lower(h, w, lbl).as_text()
+        assert f"{n}x{v}" not in txt, "full logits materialized"
+        assert f"{n}x{v // 2}" not in txt, "full LOCAL slab materialized"
+        assert f"{n}x{chunk}" in txt     # the streamed slab exists
+
     def test_no_full_logits_anywhere(self):
         """The lowered program must not contain an (N, V) f32 tensor —
         the whole point of the vocab split."""
