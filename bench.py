@@ -162,6 +162,17 @@ def _latest_committed_onchip():
             "metric": "bert_base_pretrain_samples_per_sec_per_chip",
             "value": hit["samples_per_sec"],
             "mfu": hit.get("mfu"),
+            "mfu_v1": hit.get("mfu_v1"),
+            # records written before r5 carry a bare "mfu": r3's was
+            # computed under the v1 definition, r4-code's under v2.
+            # The "bulked_steps" key discriminates them — it was added
+            # to records by the same r4 change that switched the
+            # definition — so an untagged record is labeled by the
+            # code generation that wrote it, keeping the series
+            # definition-stable (VERDICT r4 next #6)
+            "mfu_accounting": hit.get(
+                "mfu_accounting",
+                "v2" if "bulked_steps" in hit else "v1"),
             "batch_size": hit.get("batch_size"),
             "bulked_steps": hit.get("bulked_steps"),
         }
@@ -516,22 +527,32 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
         int(np.prod(p.shape))
         for name, p in model.collect_params().items()
         if "embed" not in name)
-    flops_per_sample = 6 * n_params * seq_len \
-        + 12 * layers * hidden * seq_len * seq_len \
-        + 6 * num_masked * hidden * vocab
-    mfu = sps * flops_per_sample / _V5E_PEAK_FLOPS
+    # MFU accounting versions (definition-stable per VERDICT r4 weak
+    # #1 / next #6 — a target must never be approached by
+    # redefinition):
+    #   v1 (r3): 6·params·tokens + attention 12·L·H·S² — no MLM term
+    #   v2 (r4): v1 + the tied-weight MLM decode matmul
+    #            6·m·hidden·vocab (PaLM-style; +4.1% on bert_base)
+    # BOTH are always recorded; the 0.35 gate (set at r2) is judged
+    # under v1.
+    flops_v1 = (6 * n_params * seq_len
+                + 12 * layers * hidden * seq_len * seq_len)
+    flops_v2 = flops_v1 + 6 * num_masked * hidden * vocab
+    mfu_v1 = sps * flops_v1 / _V5E_PEAK_FLOPS
+    mfu = sps * flops_v2 / _V5E_PEAK_FLOPS
     _record("bert_pretrain", platform="tpu" if on_tpu else "cpu",
             builder=builder_name, batch_size=batch_size,
             seq_len=seq_len, steps=steps, total_s=round(dt, 3),
             avg_step_ms=round(slope * 1e3, 2),
             naive_step_ms=round(naive * 1e3, 2),
             samples_per_sec=round(sps, 2), mfu=round(mfu, 4),
+            mfu_v1=round(mfu_v1, 4), mfu_accounting="v2",
             flash_dispatches=flash_hits, scan_layers=scan_layers,
             remat=remat, bulked_steps=bulk)
     if on_tpu and flash_hits == 0:
         _log(f"WARNING: {builder_name} compiled WITHOUT the flash "
              "kernel (0 flash dispatches) — MFU claims assume it")
-    return sps, mfu, flash_hits
+    return sps, mfu, flash_hits, mfu_v1
 
 
 def bench_mlp_train(batch_size=512, steps=30, warmup=5):
@@ -714,8 +735,9 @@ def main():
                        heads=4)
             metric = "bert_small_pretrain_samples_per_sec_cpu_smoke"
         _log("stage 2: " + metric)
-        sps, mfu, fl = bench_bert_pretrain(**cfg)
-        extra = {"mfu": round(mfu, 4), "flash_active": fl > 0} \
+        sps, mfu, fl, mfu_v1 = bench_bert_pretrain(**cfg)
+        extra = {"mfu": round(mfu, 4), "mfu_v1": round(mfu_v1, 4),
+                 "mfu_accounting": "v2", "flash_active": fl > 0} \
             if on_tpu else {"degraded": "tpu unreachable; cpu backend"}
         _set_result(metric, sps, **extra)
         _log(f"stage 2 done: {sps:.1f} samples/sec")
@@ -830,7 +852,7 @@ def main():
                      f"(batch {bs}, seq {seq}, "
                      f"bulk={bulk_cfg or 'auto'})")
                 try:
-                    sps, mfu, fl = _one_config()
+                    sps, mfu, fl, mfu_v1 = _one_config()
                 except Exception as e:
                     # the r3 b256 attempt died on ONE transient axon
                     # remote-compile HTTP 500 and was never retried
@@ -844,14 +866,17 @@ def main():
                     _record("bert_base_retry", error=repr(e),
                             batch_size=bs, seq_len=seq)
                     time.sleep(30)
-                    sps, mfu, fl = _one_config()
+                    sps, mfu, fl, mfu_v1 = _one_config()
                 _log(f"stage 3 batch {bs} seq {seq}: {sps:.1f} "
-                     f"samples/sec, mfu={mfu:.3f}, flash={fl}")
+                     f"samples/sec, mfu={mfu:.3f} (v1 {mfu_v1:.3f}), "
+                     f"flash={fl}")
                 if seq == 128 and (best is None or sps > best[0]):
                     best = (sps, mfu, bs)
                     _set_result(
                         "bert_base_pretrain_samples_per_sec_per_chip",
-                        sps, mfu=round(mfu, 4), batch_size=bs,
+                        sps, mfu=round(mfu, 4),
+                        mfu_v1=round(mfu_v1, 4), mfu_accounting="v2",
+                        batch_size=bs,
                         flash_active=fl > 0, scan_layers=scan)
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
