@@ -91,6 +91,13 @@ def test_llama_generate_smoke():
     assert "tokens/sec decode" in res.stdout
 
 
+def test_llama_spmd_finetune_smoke():
+    res = _run([os.path.join("example", "llama_spmd_finetune.py"),
+                "--steps", "2", "--seq", "16", "--batch", "4"])
+    assert res.returncode == 0
+    assert "resharded save" in res.stdout
+
+
 def test_actor_critic_smoke():
     res = _run([os.path.join("example", "actor_critic.py"),
                 "--episodes", "80"])
