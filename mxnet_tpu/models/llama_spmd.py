@@ -69,15 +69,18 @@ def _open_views(path):
 
 
 def _stacked_specs(tp_axis, pp_axis):
+    """Leaf layout is (pp_stages, layers_per_stage, *tensor_dims):
+    the stage dim shards over pp (the pipeline contract), the
+    within-stage layer dim stays local, tp shards the Megatron dim."""
     from jax.sharding import PartitionSpec as P
     out = {}
     for name, (_, kind) in _LAYER_TABLE.items():
         if kind == "col":
-            out[name] = P(pp_axis, tp_axis, None)
+            out[name] = P(pp_axis, None, tp_axis, None)
         elif kind == "row":
-            out[name] = P(pp_axis, None, tp_axis)
+            out[name] = P(pp_axis, None, None, tp_axis)
         else:
-            out[name] = P(pp_axis, None)
+            out[name] = P(pp_axis, None, None)
     return out
 
 
@@ -128,10 +131,11 @@ def load_llama_stacked(path, mesh, num_heads, num_kv_heads,
             f"{num_kv_heads}*{d} — wrong num_heads/num_kv_heads?")
     tp = mesh.shape[tp_axis]
     pp = mesh.shape[pp_axis]
-    if pp != n_layers:
+    if n_layers % pp:
         raise MXNetError(
-            f"mesh {pp_axis}={pp} must equal num_layers={n_layers} "
-            "(one decoder layer per pipeline stage)")
+            f"num_layers={n_layers} not divisible by mesh "
+            f"{pp_axis}={pp} (stages must hold equal layer blocks)")
+    lpp = n_layers // pp
     for what, val in (("num_heads", num_heads),
                       ("num_kv_heads", num_kv_heads),
                       ("hidden", hidden)):
@@ -151,27 +155,35 @@ def load_llama_stacked(path, mesh, num_heads, num_kv_heads,
     for name, (suffix, kind) in _LAYER_TABLE.items():
         per_layer = [views[f"model.layers.{i}.{suffix}"]
                      for i in range(n_layers)]
-        shape = (n_layers,) + per_layer[0].shape
+        # (pp, layers_per_stage, *tensor): global layer id is
+        # stage * lpp + j — stage blocks are contiguous layer runs,
+        # the GPipe assignment parallel.planning._layer_stage uses
+        shape = (pp, lpp) + per_layer[0].shape
         sharding = NamedSharding(mesh, specs[name])
         perm = perms.get(name)
 
         def cb(index, per_layer=per_layer, perm=perm):
-            ls = index[0]
-            rest = index[1:]
-            slabs = []
-            for l in range(ls.start or 0,
-                           ls.stop if ls.stop is not None
-                           else len(per_layer)):
-                v = per_layer[l]
-                if perm is not None:
-                    rows = perm[rest[0]]
-                    slab = v[rows]
-                    if len(rest) > 1:
-                        slab = slab[(slice(None),) + tuple(rest[1:])]
-                else:
-                    slab = v[tuple(rest)]
-                slabs.append(np.asarray(slab, dtype))
-            return np.stack(slabs)
+            ss, js = index[0], index[1]
+            rest = index[2:]
+            stages = []
+            for stg in range(ss.start or 0,
+                             ss.stop if ss.stop is not None else pp):
+                slabs = []
+                for j in range(js.start or 0,
+                               js.stop if js.stop is not None
+                               else lpp):
+                    v = per_layer[stg * lpp + j]
+                    if perm is not None:
+                        rows = perm[rest[0]]
+                        slab = v[rows]
+                        if len(rest) > 1:
+                            slab = slab[(slice(None),)
+                                        + tuple(rest[1:])]
+                    else:
+                        slab = v[tuple(rest)]
+                    slabs.append(np.asarray(slab, dtype))
+                stages.append(np.stack(slabs))
+            return np.stack(stages)
 
         layers[name] = jax.make_array_from_callback(shape, sharding,
                                                     cb)
@@ -187,7 +199,8 @@ def load_llama_stacked(path, mesh, num_heads, num_kv_heads,
             np.asarray(views["lm_head.weight"], dtype), repl)
     params = {"layers": layers, "embed": embed,
               "final_norm": final_norm, "head": head}
-    config = dict(num_layers=n_layers, units=units, hidden=hidden,
+    config = dict(num_layers=n_layers, layers_per_stage=lpp,
+                  units=units, hidden=hidden,
                   vocab=vocab, head_dim=d, num_heads=num_heads,
                   num_kv_heads=num_kv_heads, rope_base=rope_base)
     return params, specs, config
@@ -201,45 +214,63 @@ def _rms(x, gamma, eps):
 
 
 def make_stage_fn(config, tp_axis="tp", eps=1e-5):
-    """Functional decoder layer for the pipeline: matches the Gluon
+    """Functional decoder STAGE for the pipeline: a block of
+    ``layers_per_stage`` decoder layers, each matching the Gluon
     ``_LlamaLayer`` math exactly (RMSNorm eps 1e-5, adjacent-pair
     RoPE, GQA SDPA, SwiGLU), with Megatron tp: q/k/v/gate/up consume
     their column shard locally (heads split over tp — GQA groups stay
     aligned because ``tp | num_kv_heads``), o/down row-parallel
-    partials closed by ONE ``lax.psum`` each."""
+    partials closed by ONE ``lax.psum`` each.  Stage leaves arrive as
+    ``(layers_per_stage, ...)`` local blocks (the pipeline strips the
+    pp-sharded stage dim); the layer loop is unrolled — XLA sees a
+    static chain, the TPU-friendly form."""
+    h, kv, d = (config["num_heads"], config["num_kv_heads"],
+                config["head_dim"])
+    base = config["rope_base"]
+
+    # NB: the returned closure must capture only scalars and
+    # module-level functions — pipeline._capture_key keys opaque
+    # objects by id, so a per-call inner function would defeat the
+    # pipeline executable cache and recompile every step.
+    def stage(local, x):
+        # layers_per_stage derived from the leaves themselves: a
+        # config/array mismatch is then impossible
+        lpp = next(iter(local.values())).shape[0]
+        for j in range(lpp):
+            x = _decoder_layer({k: v[j] for k, v in local.items()},
+                               x, h, kv, d, base, eps, tp_axis)
+        return x
+
+    return stage
+
+
+def _decoder_layer(lp, x, h, kv, d, base, eps, tp_axis):
+    """One decoder layer on its local tp shards (module-level so the
+    pipeline executable cache keys it stably)."""
     import jax.numpy as jnp
     from jax import lax
 
     from ..ops.attention import dot_product_attention, rope
 
-    h, kv, d = (config["num_heads"], config["num_kv_heads"],
-                config["head_dim"])
-    base = config["rope_base"]
-
-    def stage(local, x):
-        tp = lax.axis_size(tp_axis) if tp_axis else 1
-        b, s = x.shape[0], x.shape[1]
-        hl, kvl = h // tp, kv // tp
-        hx = _rms(x, local["innorm"], eps)
-        q = rope(jnp.dot(hx, local["q"].T).reshape(b, s, hl, d),
-                 base=base)
-        k = rope(jnp.dot(hx, local["k"].T).reshape(b, s, kvl, d),
-                 base=base)
-        v = jnp.dot(hx, local["v"].T).reshape(b, s, kvl, d)
-        att = dot_product_attention(q, k, v, causal=True)
-        o_part = jnp.dot(att.reshape(b, s, hl * d), local["o"].T)
-        if tp_axis:
-            o_part = lax.psum(o_part, tp_axis)
-        x = x + o_part
-        hx = _rms(x, local["postnorm"], eps)
-        gate = jnp.dot(hx, local["gate"].T)
-        up = jnp.dot(hx, local["up"].T)
-        dn = jnp.dot(_silu(gate) * up, local["down"].T)
-        if tp_axis:
-            dn = lax.psum(dn, tp_axis)
-        return x + dn
-
-    return stage
+    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    b, s = x.shape[0], x.shape[1]
+    hl, kvl = h // tp, kv // tp
+    hx = _rms(x, lp["innorm"], eps)
+    q = rope(jnp.dot(hx, lp["q"].T).reshape(b, s, hl, d), base=base)
+    k = rope(jnp.dot(hx, lp["k"].T).reshape(b, s, kvl, d), base=base)
+    v = jnp.dot(hx, lp["v"].T).reshape(b, s, kvl, d)
+    att = dot_product_attention(q, k, v, causal=True)
+    o_part = jnp.dot(att.reshape(b, s, hl * d), lp["o"].T)
+    if tp_axis:
+        o_part = lax.psum(o_part, tp_axis)
+    x = x + o_part
+    hx = _rms(x, lp["postnorm"], eps)
+    gate = jnp.dot(hx, lp["gate"].T)
+    up = jnp.dot(hx, lp["up"].T)
+    dn = jnp.dot(_silu(gate) * up, lp["down"].T)
+    if tp_axis:
+        dn = lax.psum(dn, tp_axis)
+    return x + dn
 
 
 def _silu(x):
@@ -339,6 +370,9 @@ def save_llama_stacked(params, dir_path, config, max_shard_bytes,
     :func:`load_llama_stacked`'s contract)."""
     h, kv, d = (config["num_heads"], config["num_kv_heads"],
                 config["head_dim"])
+    # layers_per_stage derived from the arrays (not config) so a
+    # hand-built or stale config cannot silently mis-index layers
+    lpp = next(iter(params["layers"].values())).shape[1]
     sources = {}                      # hf name -> (kind, array, layer)
     for name, (suffix, _) in _LAYER_TABLE.items():
         for i in range(config["num_layers"]):
@@ -350,14 +384,17 @@ def save_llama_stacked(params, dir_path, config, max_shard_bytes,
         sources["lm_head.weight"] = (None, params["head"], None)
 
     def shape_of(kind, arr, layer):
-        return tuple(arr.shape[1:] if layer is not None else arr.shape)
+        return tuple(arr.shape[2:] if layer is not None else arr.shape)
 
     specs = {nm: (shape_of(*src), dtype)
              for nm, src in sources.items()}
 
     def materialize(nm):
         kind, arr, layer = sources[nm]
-        a = np.asarray(arr[layer] if layer is not None else arr, dtype)
+        # stacked layout is (stage, layer_in_stage, ...): global layer
+        # i lives at [i // lpp, i % lpp]
+        a = np.asarray(arr[layer // lpp, layer % lpp]
+                       if layer is not None else arr, dtype)
         if kind == "q":
             a = _permute_qk(a, h, d, invert=True).astype(dtype)
         elif kind == "k":

@@ -82,20 +82,21 @@ class TestShardedCheckpoint:
     def test_load_places_sharded_not_replicated(self, loaded, mesh):
         params, specs, config = loaded
         assert config["num_layers"] == L and config["vocab"] == V
+        assert config["layers_per_stage"] == L // PP
         q = params["layers"]["q"]
-        assert q.shape == (L, HEADS * config["head_dim"],
+        assert q.shape == (PP, L // PP, HEADS * config["head_dim"],
                            config["units"])
         # each device holds ONE stage's tp column shard — 1/(PP*TP) of
         # the stacked tensor, the no-host-materialization contract
         shard = q.addressable_shards[0]
-        assert shard.data.shape == (L // PP,
+        assert shard.data.shape == (1, L // PP,
                                     HEADS * config["head_dim"] // TP,
                                     config["units"])
         assert "tp" in str(q.sharding.spec) \
             and "pp" in str(q.sharding.spec)
         down = params["layers"]["down"]
         assert down.addressable_shards[0].data.shape == (
-            L // PP, config["units"], config["hidden"] // TP)
+            1, L // PP, config["units"], config["hidden"] // TP)
 
 
 class TestParityAndTraining:
@@ -162,6 +163,49 @@ class TestParityAndTraining:
         ref = net(nd.array(toks.astype("f4"))).asnumpy()
         np.testing.assert_allclose(ref, logits_trained,
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestMultiLayerStages:
+    def test_two_layers_per_stage_parity_and_training(self, tmp_path):
+        """Real-model depth: 8 layers over 4 stages (2 layers/stage,
+        the llama3-8b 32/4 shape at test scale).  Forward parity vs
+        the Gluon net + a training step that decreases the loss."""
+        d = str(tmp_path / "deep")
+        np.random.seed(7)
+        mx.random.seed(7)
+        net = LlamaForCausalLM(
+            get_llama("llama_tiny", vocab_size=V, num_layers=8))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1, 4), "f4")))
+        export_hf_llama(net, d, max_shard_bytes=256 * 1024)
+        mesh = parallel.make_mesh({"tp": TP, "pp": PP})
+        params, specs, config = llama_spmd.load_llama_stacked(
+            d, mesh, num_heads=HEADS, num_kv_heads=KV)
+        assert config["layers_per_stage"] == 2
+        toks = np.random.RandomState(8).randint(0, V, (B, S))
+        ref = net(nd.array(toks.astype("f4"))).asnumpy()
+        got = np.asarray(llama_spmd.forward_logits(
+            params, toks, config, mesh, specs))
+        np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+        l0, params = llama_spmd.train_step(
+            params, toks, config, mesh, specs, lr=0.05, vocab_chunk=64)
+        l1, params = llama_spmd.train_step(
+            params, toks, config, mesh, specs, lr=0.05, vocab_chunk=64)
+        assert float(np.asarray(l1)) < float(np.asarray(l0))
+
+    def test_indivisible_layers_raise(self, mesh, tmp_path):
+        """A 3-layer checkpoint cannot tile pp=4 stages — the loader
+        must say so instead of silently dropping/duplicating layers."""
+        from mxnet_tpu.base import MXNetError
+        d = str(tmp_path / "odd")
+        net = LlamaForCausalLM(
+            get_llama("llama_tiny", vocab_size=V, num_layers=3))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1, 4), "f4")))
+        export_hf_llama(net, d, max_shard_bytes=256 * 1024)
+        with pytest.raises(MXNetError, match="not divisible"):
+            llama_spmd.load_llama_stacked(
+                d, mesh, num_heads=HEADS, num_kv_heads=KV)
 
 
 class TestChunkedCEInsidePipeline:
