@@ -3,8 +3,11 @@
 Headline metric (BASELINE.md north star): **BERT-base pretraining
 samples/sec/chip** — MLM+NSP step through the fused SPMD trainer on a
 single-chip mesh, matmuls in bfloat16 via AMP (the MXU-native path).
-``vs_baseline`` stays 1.0: BASELINE.md records "published": {} — no
-verifiable reference numbers exist, so the series is self-relative.
+``vs_baseline``: BASELINE.md records "published": {} — no verifiable
+reference numbers exist, so the series is self-relative: 1.0 for a
+fresh series point, the real ratio when the metric matches the latest
+committed on-chip record, and 0.0 (+note) for degraded runs where no
+comparison exists (VERDICT r4 weak #4).
 
 Hang-proofing (VERDICT r1 weak #1):
 - device acquisition happens in a SUBPROCESS with a hard deadline, so a
@@ -105,14 +108,30 @@ def _record(stage, **payload):
 
 def _set_result(metric, value, unit="samples/sec", **extra):
     with _lock:
+        ptr = _state.get("onchip_ptr")
+        # vs_baseline semantics (VERDICT r4 weak #4): 1.0 was
+        # self-referential for degraded smokes.  Now: a degraded run
+        # reports 0.0 + a note (no comparison exists); an on-chip run
+        # whose metric matches the latest COMMITTED on-chip record
+        # reports the real ratio against it; otherwise 1.0
+        # (self-relative series start, per BASELINE "published": {}).
+        if "degraded" in extra:
+            vs = 0.0
+            extra.setdefault("vs_baseline_note",
+                             "degraded run; no baseline comparison")
+        elif ptr and ptr.get("metric") == metric and ptr.get("value"):
+            vs = round(float(value) / float(ptr["value"]), 4)
+            extra.setdefault("vs_baseline_note",
+                             "vs latest committed on-chip series")
+        else:
+            vs = 1.0
         _state["result"] = {
             "metric": metric,
             "value": round(float(value), 2),
             "unit": unit,
-            "vs_baseline": 1.0,
+            "vs_baseline": vs,
             **extra,
         }
-        ptr = _state.get("onchip_ptr")
         if ptr:
             _state["result"]["latest_committed_onchip"] = ptr
 

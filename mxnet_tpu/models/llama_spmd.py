@@ -91,23 +91,26 @@ def load_llama_stacked(path, mesh, num_heads, num_kv_heads,
 
     Returns ``(params, specs, config)``:
 
-    * ``params["layers"]`` — dict of STACKED ``(L, ...)`` jax arrays,
-      stage axis sharded over ``pp_axis``, Megatron col/row over
-      ``tp_axis``; each device shard is built by
-      ``jax.make_array_from_callback`` reading ONLY its own byte range
-      from the checkpoint mmap (q/k rows pass through the rotate-half →
-      adjacent-pair RoPE permutation lazily, per shard).
+    * ``params["layers"]`` — dict of STACKED
+      ``(pp_stages, layers_per_stage, ...)`` jax arrays: the stage axis
+      is sharded over ``pp_axis``, the within-stage layer axis is local,
+      and Megatron col/row sharding rides ``tp_axis``; each device shard
+      is built by ``jax.make_array_from_callback`` reading ONLY its own
+      byte range from the checkpoint mmap (q/k rows pass through the
+      rotate-half → adjacent-pair RoPE permutation lazily, per shard).
+      Global layer id = stage * layers_per_stage + local index.
     * ``params["embed"]``, ``params["final_norm"]``, ``params["head"]``
       — replicated (``head`` is None for tied checkpoints; use the
       embedding).
     * ``specs`` — the PartitionSpec pytree for ``params["layers"]``
       (feed to ``pipeline_value_and_grad(param_specs=...)``).
-    * ``config`` — dict(num_layers, units, hidden, vocab, head_dim,
-      num_heads, num_kv_heads, rope_base) inferred from shapes.
+    * ``config`` — dict(num_layers, layers_per_stage, units, hidden,
+      vocab, head_dim, num_heads, num_kv_heads, rope_base) inferred
+      from shapes.
 
-    Requires ``mesh.shape[pp_axis] == num_layers`` (one decoder layer
-    per stage — the homogeneous-stage pipeline contract) and
-    ``tp | num_kv_heads``.
+    Requires ``mesh.shape[pp_axis]`` to DIVIDE ``num_layers`` (each
+    stage runs ``num_layers / pp`` consecutive decoder layers — the
+    homogeneous-stage pipeline contract) and ``tp | num_kv_heads``.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,307 @@
+"""Worker: ONE dp×tp×sp×pp training step on a 2-proc × 8-device mesh.
+
+VERDICT r4 L5 row: dp/tp/sp/ep/pp are exercised separately and
+dp×tp×pp composes (``dist_worker_composed.py``); the remaining gap was
+sequence parallelism composed with the rest.  This worker runs ALL FOUR
+dense-model axes in one compiled shard_map program on the pod shape —
+dp=2 crossing the process boundary (DCN-analog), tp=2 / sp=2 / pp=2
+in-process (ICI-analog), 16 devices total:
+
+  * 2 pipeline stages over ``pp`` with a GPipe microbatch ring
+    (``lax.ppermute`` carries activations stage-to-stage);
+  * each stage is a Megatron-style attention block: q/k/v projections
+    column-sharded over ``tp`` (one head per tp member), out-projection
+    row-sharded with a ``psum`` restoring the activation;
+  * the attention itself runs SEQUENCE-SHARDED: every device holds
+    S/sp of the sequence and K/V blocks travel the ``sp`` ring
+    (``_ring_attention_local`` — the same online-softmax body the
+    long-context path uses, here composed INSIDE a pipeline stage);
+  * per-dp-shard gradients exchanged with the INT8-wire
+    ``quantized_psum`` over ``dp``, then an SGD update — all inside
+    one shard_map.
+
+Asserted against a single-device reference running the same math with
+plain (non-ring) softmax attention: step-1 loss is exact to fp32
+accumulation-order tolerance (compression touches only the update),
+the 3-step trajectory tracks and decreases, and the LOWERED program
+carries i8 on the dp wire plus collective-permutes for the sp/pp rings.
+
+Reference analog: there is none — upstream MXNet has no sequence
+parallelism (SURVEY.md §5 long-context row lists it as a required
+first-class capability of the rebuild); the dp wire matches
+dist_sync_device + gradient compression (SURVEY.md §2.3).
+Run via ``tools/launch.py -n 2 python tests/dist_worker_composed4.py``.
+"""
+import os
+import sys
+
+if __name__ == "__main__":
+    _flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: F401  joins the MXTPU_DIST_* rendezvous
+from mxnet_tpu.parallel.ring_attention import _ring_attention_local
+
+F = 8          # model width
+HEADS = 2
+D = 4          # head dim (HEADS * D == F)
+PP = 2
+TP = 2         # shards HEADS
+SP = 2         # shards the sequence
+DP = 2
+SEQ = 8        # global sequence; S/sp = 4 per device
+BATCH = 8      # global; per-dp shard 4 → 2 microbatches of 2
+LR = 0.05
+SCALE = 1.0 / np.sqrt(D)
+
+
+def _attn_stage(x_in, wq, wk, wv, wo):
+    """One tp-sharded attention block with sp-ring attention inside.
+
+    Runs INSIDE shard_map.  x_in: (mb, S/sp, F) — replicated over tp,
+    sharded over sp.  wq/wk/wv: (F, HEADS*D/TP) this member's head
+    columns; wo: (HEADS*D/TP, F) the matching out-proj rows.
+    """
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    mb, sl, _ = x_in.shape
+    q = (x_in @ wq).reshape(mb, sl, -1, D)
+    k = (x_in @ wk).reshape(mb, sl, -1, D)
+    v = (x_in @ wv).reshape(mb, sl, -1, D)
+    # K/V ride the sp ring; each device keeps its Q shard (online
+    # softmax, O(S/sp) memory) — composed inside the pipeline stage
+    o = _ring_attention_local(q, k, v, "sp", SCALE)
+    y = o.reshape(mb, sl, -1) @ wo        # partial over tp rows
+    y = lax.psum(y, "tp")                 # Megatron row-parallel join
+    return jnp.tanh(y)
+
+
+def _pipelined_local_loss(ws, x_loc, y_loc):
+    """This device's loss through the tp×sp-sharded 2-stage pipeline.
+
+    pp/tp/sp collectives only — dp stays un-reduced so per-shard grads
+    exist for the compressed exchange.  ws: tuple of per-stage local
+    shards, each leaf (F, ·) with the pp dim already stripped."""
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    n = lax.axis_size("pp")
+    p = lax.axis_index("pp")
+    m = n                             # microbatches = stages
+    mb = x_loc.shape[0] // m
+    sl = x_loc.shape[1]
+    xs = x_loc.reshape(m, mb, sl, F)
+    ys = y_loc.reshape(m, mb, sl, F)
+    carry = jnp.zeros((mb, sl, F), x_loc.dtype)
+    outs = jnp.zeros((m, mb, sl, F), x_loc.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for r in range(m + n - 1):
+        mb_idx = r - p
+        active = (mb_idx >= 0) & (mb_idx < m)
+        x_in = jnp.where(p == 0, xs[min(r, m - 1)], carry)
+        h = _attn_stage(x_in, *ws)
+        out = jnp.where(active, h, carry)
+        slot = min(max(r - (n - 1), 0), m - 1)
+        outs = outs.at[slot].set(
+            jnp.where(active & (p == n - 1), out, outs[slot]))
+        carry = lax.ppermute(out, "pp", perm)
+    # local seq shard mean → global mean over the sp ring (equal
+    # shard sizes, so the global mean is the mean of local means)
+    loss_sp = ((outs - ys) ** 2).mean()
+    loss_seq = lax.psum(loss_sp, "sp") / lax.axis_size("sp")
+    loss_local = jnp.where(p == n - 1, loss_seq, 0.0)
+    return lax.psum(loss_local, "pp")
+
+
+def _lossgrad(ws, x_loc, y_loc):
+    """Per-dp-shard loss and gradient — the DIFFERENTIATED region.
+
+    Runs under ``check_vma=True``: weights are REPLICATED over sp
+    while activations are sequence-sharded, so a sound backward must
+    sum the other sp members' contributions into each member's
+    gradient.  vma tracking transposes the loss-path psums correctly
+    and ``gs`` comes out as the full gradient, identical on every sp
+    member — verified against a single-device reference at ratio 1.0.
+    (Under ``check_vma=False`` every forward psum transposes to
+    another psum and the gradient comes out axis-size-times too large
+    — measured exactly 8x on a tp2×sp2×pp2 probe — which is why the
+    update lives in a separate non-differentiated region instead.)
+
+    Outputs carry a leading dp axis so the per-dp-shard values leave
+    this vma-checked region as honestly dp-varying arrays.
+    """
+    import jax
+
+    ws2 = tuple(w[0] for w in ws)     # strip the sharded pp dim
+    loss, gs = jax.value_and_grad(_pipelined_local_loss)(
+        ws2, x_loc, y_loc)
+    return loss[None], tuple(g[None][None] for g in gs)
+
+
+def _update(ws, loss_dp, gs_dp):
+    """int8-compressed-dp gradient exchange + SGD — NOT differentiated,
+    so ``check_vma=False`` is sound here; ``quantized_psum``'s
+    all_gather tail cannot be vma-inferred as replicated (no
+    varying→invariant cast exists, correctly), which is the other
+    reason the step is split into two shard_map regions under one jit.
+    """
+    import jax
+    import jax.lax as lax
+    from mxnet_tpu.parallel import collectives
+
+    dp = lax.axis_size("dp")
+    gs_avg = tuple(
+        collectives.quantized_psum(g[0, 0], "dp") / dp for g in gs_dp)
+    ws_new = tuple(
+        (w[0] - LR * g)[None] for w, g in zip(ws, gs_avg))
+    loss_mean = lax.psum(loss_dp[0], "dp") / dp
+    return loss_mean, ws_new
+
+
+def _reference(w0, x, y, steps):
+    """Single-device: same math, plain softmax attention, exact SGD."""
+    import jax.numpy as jnp
+
+    def loss_fn(ws):
+        wq, wk, wv, wo = ws
+        h = jnp.asarray(x)
+        for s in range(PP):
+            b, sq, _ = h.shape
+            q = (h @ wq[s]).reshape(b, sq, HEADS, D)
+            k = (h @ wk[s]).reshape(b, sq, HEADS, D)
+            v = (h @ wv[s]).reshape(b, sq, HEADS, D)
+            scr = jnp.einsum("bqhd,bkhd->bhqk", q, k) * SCALE
+            a = jax.nn.softmax(scr, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", a, v)
+            h = jnp.tanh(o.reshape(b, sq, HEADS * D) @ wo[s])
+        return ((h - jnp.asarray(y)) ** 2).mean()
+
+    ws = tuple(jnp.asarray(w) for w in w0)
+    losses = []
+    for _ in range(steps):
+        loss, gs = jax.value_and_grad(loss_fn)(ws)
+        losses.append(float(loss))
+        ws = tuple(w - LR * g for w, g in zip(ws, gs))
+    return losses
+
+
+def main():
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    rank = jax.process_index()
+    assert jax.process_count() == 2
+    assert len(jax.local_devices()) == 8
+    devs = np.array(sorted(
+        jax.devices(), key=lambda d: (d.process_index, d.id)))
+    devs = devs.reshape(DP, TP, SP, PP)
+    for r in range(DP):
+        assert all(d.process_index == r for d in devs[r].ravel()), \
+            "dp must be the cross-process axis"
+    mesh = Mesh(devs, ("dp", "tp", "sp", "pp"))
+
+    rng = np.random.RandomState(0)
+    # head-major column layout: tp's contiguous column block == that
+    # member's heads, matching the reference reshape (B,S,HEADS,D)
+    wq0 = (rng.rand(PP, F, HEADS * D).astype("f") - 0.5) * 0.8
+    wk0 = (rng.rand(PP, F, HEADS * D).astype("f") - 0.5) * 0.8
+    wv0 = (rng.rand(PP, F, HEADS * D).astype("f") - 0.5) * 0.8
+    wo0 = (rng.rand(PP, HEADS * D, F).astype("f") - 0.5) * 0.8
+    x_np = rng.rand(BATCH, SEQ, F).astype("f")
+    y_np = np.tanh(rng.rand(BATCH, SEQ, F).astype("f"))
+
+    col_spec = P("pp", None, "tp")    # q/k/v projections: head columns
+    row_spec = P("pp", "tp", None)    # out projection: head rows
+    x_spec = P("dp", "sp", None)      # (batch, seq, feat)
+    w_specs = (col_spec, col_spec, col_spec, row_spec)
+
+    half = BATCH // DP
+    gws = tuple(
+        multihost_utils.host_local_array_to_global_array(w, mesh, s)
+        for w, s in zip((wq0, wk0, wv0, wo0), w_specs))
+    gx = multihost_utils.host_local_array_to_global_array(
+        x_np[rank * half:(rank + 1) * half], mesh, x_spec)
+    gy = multihost_utils.host_local_array_to_global_array(
+        y_np[rank * half:(rank + 1) * half], mesh, x_spec)
+
+    # per-dp-shard loss/grads cross between the two regions with an
+    # explicit leading dp axis (see _lossgrad/_update docstrings)
+    loss_dp_spec = P("dp")
+    g_dp_specs = tuple(P("dp", *s) for s in w_specs)
+    lossgrad = shard_map(
+        _lossgrad, mesh=mesh,
+        in_specs=(w_specs, x_spec, x_spec),
+        out_specs=(loss_dp_spec, g_dp_specs), check_vma=True)
+    update = shard_map(
+        _update, mesh=mesh,
+        in_specs=(w_specs, loss_dp_spec, g_dp_specs),
+        out_specs=(P(), w_specs), check_vma=False)
+
+    def _composed_step(ws, x, y):
+        loss_dp, gs_dp = lossgrad(ws, x, y)
+        return update(ws, loss_dp, gs_dp)
+
+    step = jax.jit(_composed_step)
+
+    import re
+    txt = step.lower(gws, gx, gy).as_text()
+    assert re.search(r"all_to_all[^\n]*i8", txt) or \
+        re.search(r"all_gather[^\n]*i8", txt), \
+        "no i8-carrying collective in the composed program"
+    # the sp K/V ring and the pp activation ring both lower to
+    # collective-permute; the composed program must carry them
+    assert len(re.findall(r"collective.permute", txt)) >= 2, \
+        "composed program lost its sp/pp rings"
+    print(f"COMPOSED4_WIRES_OK rank={rank}", flush=True)
+
+    ref_losses = _reference((wq0, wk0, wv0, wo0), x_np, y_np, 3)
+    losses = []
+    for _ in range(3):
+        loss, gws = step(gws, gx, gy)
+        losses.append(float(np.asarray(loss.addressable_data(0))))
+
+    # step 1: compression only affects the UPDATE — loss is exact to
+    # fp32 accumulation-order tolerance (ring online-softmax vs plain)
+    np.testing.assert_allclose(losses[0], ref_losses[0], rtol=1e-5)
+    for a, b in zip(losses[1:], ref_losses[1:]):
+        np.testing.assert_allclose(a, b, rtol=0.1)
+    assert losses[-1] < losses[0], losses
+
+    # the invariant behind the sp-psum: identical (deterministic int8)
+    # updates on every sp member ⇒ weight replicas along sp must be
+    # BIT-identical after training, or they desync a little more each
+    # step (caught by an instrumented review probe before the fix)
+    for leaf in gws:
+        by_coord = {}
+        for sh in leaf.addressable_shards:
+            d = sh.device
+            coord = tuple(int(i) for i in
+                          np.argwhere(mesh.devices == d)[0])
+            by_coord[coord] = np.asarray(sh.data)
+        for coord, data in by_coord.items():
+            if coord[2] == 0:
+                other = by_coord.get(
+                    (coord[0], coord[1], 1, coord[3]))
+                if other is not None:
+                    np.testing.assert_array_equal(data, other)
+    print(f"COMPOSED4_SP_REPLICA_SYNC_OK rank={rank}", flush=True)
+    print(f"COMPOSED4_PARITY_OK rank={rank} losses="
+          f"{[round(v, 5) for v in losses]}", flush=True)
+    print(f"COMPOSED4_OK rank={rank}/2", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

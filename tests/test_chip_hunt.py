@@ -285,3 +285,38 @@ def test_staged_probe_on_chip():
     res = bench.probe_platform_ex(300)
     assert res["platform"] == "tpu", res
     assert res["value_ok"] is True
+
+
+def test_vs_baseline_semantics():
+    """VERDICT r4 weak #4: a degraded smoke must not imply a comparison
+    that isn't there.  The three branches of bench._set_result: 0.0 +
+    note for degraded runs, a real ratio when the metric matches the
+    latest committed on-chip record, 1.0 for a fresh series point."""
+    orig = dict(bench._state)
+    try:
+        bench._state.pop("onchip_ptr", None)
+        bench._set_result("m_cpu_smoke", 10.0, degraded="tpu unreachable")
+        r = bench._state["result"]
+        assert r["vs_baseline"] == 0.0
+        assert "no baseline comparison" in r["vs_baseline_note"]
+
+        bench._state["onchip_ptr"] = {
+            "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+            "value": 800.0}
+        bench._set_result(
+            "bert_base_pretrain_samples_per_sec_per_chip", 1000.0,
+            mfu=0.35)
+        r = bench._state["result"]
+        assert r["vs_baseline"] == 1.25
+        assert r["latest_committed_onchip"]["value"] == 800.0
+
+        # metric-match guard: a DIFFERENT metric (e.g. a cpu smoke)
+        # must NOT be ratioed against the committed on-chip record
+        bench._set_result("bert_small_pretrain_samples_per_sec_cpu_smoke",
+                          26.9, degraded="tpu unreachable; cpu backend")
+        assert bench._state["result"]["vs_baseline"] == 0.0
+        bench._set_result("some_other_metric", 5.0)
+        assert bench._state["result"]["vs_baseline"] == 1.0
+    finally:
+        bench._state.clear()
+        bench._state.update(orig)

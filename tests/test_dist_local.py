@@ -84,6 +84,23 @@ def test_composed_dp_tp_pp_training_step():
         assert f"COMPOSED_OK rank={r}/2" in res.stdout
 
 
+def test_composed4_dp_tp_sp_pp_training_step():
+    """ALL FOUR dense-model axes — dp×tp×sp×pp — in ONE compiled
+    training step on the 2-proc × 8-dev pod shape: ring attention on
+    the sp axis INSIDE Megatron-tp attention stages inside a GPipe pp
+    schedule, int8 gradient wire on the cross-process dp axis; loss
+    parity vs a single-device plain-softmax reference (VERDICT r4 L5:
+    sp composed with the rest)."""
+    res = _run_launcher(2, "dist_worker_composed4.py", timeout=420)
+    sys.stderr.write(res.stdout[-2000:] + res.stderr[-2000:])
+    assert res.returncode == 0
+    for r in range(2):
+        assert f"COMPOSED4_WIRES_OK rank={r}" in res.stdout
+        assert f"COMPOSED4_PARITY_OK rank={r}" in res.stdout
+        assert f"COMPOSED4_SP_REPLICA_SYNC_OK rank={r}" in res.stdout
+        assert f"COMPOSED4_OK rank={r}/2" in res.stdout
+
+
 def test_two_process_four_device_mesh():
     """2 procs x 4 virtual devices: ONE mesh composing the
     cross-process (DCN-analog) and in-process (ICI-analog) axes;

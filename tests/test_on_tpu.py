@@ -54,7 +54,11 @@ def test_flash_attention_matches_sdpa_on_chip():
     ref = np.asarray(_sdpa_xla(jnp.asarray(q), jnp.asarray(k),
                                jnp.asarray(v), None,
                                1.0 / np.sqrt(64), False))
-    np.testing.assert_allclose(flash, ref, rtol=2e-2, atol=2e-3)
+    # atol grounded in hardware measurement (r5 window, 2026-08-01):
+    # online-softmax vs plain-softmax accumulation order leaves a max
+    # |diff| of 2.6e-3 over 65536 f32 elements (3 violations at the
+    # old 2e-3, all at near-zero outputs where rtol is meaningless)
+    np.testing.assert_allclose(flash, ref, rtol=2e-2, atol=3e-3)
 
 
 def test_train_step_converges_on_chip():
