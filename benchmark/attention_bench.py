@@ -129,7 +129,8 @@ def main():
             # mirror the real dispatch decision (batch/heads feed the
             # HBM score-tensor budget) or the recorded auto row could
             # measure a path dot_product_attention would not take
-            picked = _flash_preferred(s, s, batch=b, heads=h)
+            picked = _flash_preferred(s, s, batch=b, heads=h,
+                                      causal=causal)
             t_auto = (tf if picked else tx, tgf if picked else tgx)
             row = {"seq": s, "causal": causal,
                    "fwd_flash_ms": round(tf, 3),

@@ -157,26 +157,47 @@ def main():
                          optimizer="adam", loss_mode="nsp_only"),
     }
     rows = {}
-    for name in args.variants.split(","):
-        if name not in variants:
+    todo = [n for n in args.variants.split(",") if n]
+    # drift control (r5 window: no_dropout/sgd measured 46-86 ms
+    # SLOWER than base, which is not a plausible chip-compute delta;
+    # suspicion is tunnel/measurement drift between variants): re-run
+    # base LAST so the summary can bound how much the environment
+    # moved over the job's lifetime.  A delta row is only trustworthy
+    # within ~the observed drift.
+    if "base" in todo and len(todo) > 1:
+        todo.append("base_recheck")
+    for name in todo:
+        key = "base" if name == "base_recheck" else name
+        if key not in variants:
             print(json.dumps({"warn": f"unknown variant {name}"}),
                   flush=True)
             continue
         try:
             rows[name] = run_variant(name, cfg, steps=args.steps,
-                                     **variants[name])
+                                     **variants[key])
         except Exception as e:
             print(json.dumps({"variant": name,
                               "error": repr(e)[:300]}), flush=True)
+    # if the first base run died, the recheck run IS a valid base —
+    # use it rather than discarding a full chip-window measurement
+    if "base" not in rows and "base_recheck" in rows:
+        rows["base"] = rows.pop("base_recheck")
     if "base" in rows:
         base = rows["base"]["step_ms"]
         deltas = {n: round(base - r["step_ms"], 2)
-                  for n, r in rows.items() if n != "base"}
-        print(json.dumps({"summary": "bert_ablation",
-                          "base_step_ms": base,
-                          "savings_ms_vs_base": deltas,
-                          "platform": rows["base"]["platform"]}),
-              flush=True)
+                  for n, r in rows.items()
+                  if n not in ("base", "base_recheck")}
+        summary = {"summary": "bert_ablation",
+                   "base_step_ms": base,
+                   "savings_ms_vs_base": deltas,
+                   "platform": rows["base"]["platform"]}
+        if "base_recheck" in rows:
+            drift = round(rows["base_recheck"]["step_ms"] - base, 2)
+            summary["base_recheck_step_ms"] = \
+                rows["base_recheck"]["step_ms"]
+            summary["drift_ms"] = drift
+            summary["deltas_trustworthy"] = abs(drift) < 5.0
+        print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

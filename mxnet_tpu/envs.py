@@ -32,13 +32,34 @@ def _reg(name, typ, default, doc, mxnet_alias=""):
 
 _reg("MXTPU_ENGINE_TYPE", str, "",
      "Set to 'NaiveEngine' for synchronous per-op execution "
-     "(debugging/determinism).", "MXNET_ENGINE_TYPE")
+     "(debugging/determinism). Read ONCE at the first op dispatch "
+     "(cached on the hot path) — set it before running any op, not "
+     "mid-process.", "MXNET_ENGINE_TYPE")
 _reg("MXTPU_TEST_ON_TPU", bool, False,
      "Run the test suite against the real TPU chip instead of the "
      "8-device CPU mesh.")
 _reg("MXTPU_DISABLE_FLASH", bool, False,
      "Disable the Pallas flash-attention kernel (use the XLA SDPA "
      "path everywhere).")
+_reg("MXTPU_FLASH_MODE", str, "auto",
+     "Flash-vs-XLA attention dispatch: auto (measured crossover "
+     "policy), always (flash whenever viable), never.")
+_reg("MXTPU_FLASH_XLA_FROM", int, 512,
+     "CAUSAL attention: sequence length from which auto mode prefers "
+     "XLA SDPA over the flash kernel (r5 on-chip crossover; the "
+     "kernel's two-pass backward loses from here up).")
+_reg("MXTPU_FLASH_XLA_FROM_NONCAUSAL", int, 2048,
+     "NON-causal attention: sequence length from which auto mode "
+     "prefers XLA SDPA (r5 on-chip crossover — flash holds through "
+     "1024 without a causal mask).")
+_reg("MXTPU_FLASH_XLA_UNTIL", int, 4096,
+     "Sequence length from which auto mode returns to the flash "
+     "kernel regardless: XLA's O(S^2) score tensor becomes the HBM "
+     "bottleneck.")
+_reg("MXTPU_FLASH_XLA_MAX_SCORE_GB", float, 2.0,
+     "HBM budget (GiB) for the f32 score tensor XLA SDPA would "
+     "materialize; auto mode falls back to flash above it even "
+     "inside the XLA-win window.")
 _reg("MXTPU_PRNG_IMPL", str, "auto",
      "Key implementation for mx.random: auto (rbg on accelerator "
      "backends — the hardware-friendly analog of the reference's "
@@ -87,3 +108,29 @@ def get(name: str):
     if var.type is bool:
         return raw not in ("", "0", "false", "False")
     return var.type(raw)
+
+
+def to_markdown():
+    """Render the registry as the docs/env_vars.md table (the doc's
+    'Generated from' claim is kept true by regenerating via
+    ``python -m mxnet_tpu.envs > docs/env_vars.md``)."""
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated from `mxnet_tpu/envs.py` (the typed registry; parity:",
+        "the reference's `MXNET_*` env-var page). `MXNET_*` aliases are",
+        "honoured as fallbacks where the reference had the same knob.",
+        "",
+        "| Variable | Type | Default | MXNet alias | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for var in _REGISTRY.values():
+        alias = f"`{var.mxnet_alias}`" if var.mxnet_alias else "—"
+        doc = " ".join(str(var.doc).split())
+        lines.append(f"| `{var.name}` | {var.type.__name__} | "
+                     f"`{var.default}` | {alias} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    print(to_markdown(), end="")

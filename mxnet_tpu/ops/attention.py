@@ -137,12 +137,18 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
         # normalize the documented 2-D key-padding form for the XLA
         # path too (the shape RULE lives only in _as_key_padding)
         mask = mask.reshape(mask.shape[0], 1, 1, mask.shape[1])
-    # a sliding window always prefers the kernel: block-skip makes it
-    # O(S·W) while the XLA path still materializes the S×S band
+    # a sliding window prefers the kernel: block-skip makes it O(S·W)
+    # while the XLA path masks a full S×S band — measured r5 window
+    # (bench_logs/r5/attention_bench.log): flash banded 3.9x faster at
+    # seq 512/w256 and 6.6x at 1024/w256, par at 2048/w1024.  The one
+    # contrary row (2048/w256, XLA 2.8x) contradicts the kernel's own
+    # linear-in-seq scaling from the 1024/w256 row by ~4x and is
+    # queued for re-measure before it may move this policy.
     preferred = (window is not None
                  or _flash_preferred(query.shape[1], key.shape[1],
                                      batch=query.shape[0],
-                                     heads=query.shape[2]))
+                                     heads=query.shape[2],
+                                     causal=causal))
     if flash and (mask is None or kmask is not None) \
             and _flash_viable(query, key) and preferred:
         # dispatch evidence: incremented at TRACE time, so a nonzero
@@ -164,15 +170,27 @@ def dot_product_attention(query, key, value, *rest, num_heads=1,
     return _sdpa_xla(query, key, value, mask, s, causal, window=window)
 
 
-def _flash_preferred(s_q, s_k, batch=1, heads=1):
+def _flash_preferred(s_q, s_k, batch=1, heads=1, causal=False):
     """Measured flash-vs-XLA crossover policy (VERDICT r3 #4: a hand
     kernel must win or step aside, the cuDNN-fast-path pattern).
 
-    r3 on-chip evidence (bench_logs/r3/attention_bench.log, two windows
-    4 h apart): flash ≥ parity with XLA SDPA at seq 128-1024, but the
-    two-pass backward loses 0.60-0.67x at seq 2048.  Auto policy:
-      * seq ≤ MXTPU_FLASH_XLA_FROM (default 2048, exclusive): flash —
-        it wins or ties, and skips the S×S HBM materialization;
+    r5 on-chip evidence (bench_logs/r5/attention_bench.log, v5e,
+    post-block-skip — supersedes the r3 table), combined fwd+bwd
+    time, xla/flash total-time ratios:
+
+      seq     causal          non-causal
+      128     0.98 (par)      1.06 (par)
+      512     0.66 (XLA)      1.59 (flash)
+      1024    0.49 (XLA)      0.98 (par)
+      2048    0.52 (XLA)      0.35 (XLA)
+
+    The crossover is CAUSALITY-DEPENDENT: causal XLA wins from 512
+    (the kernel's two-pass backward loses, and causal block-skip only
+    helps its forward), while non-causal flash holds through 1024.
+    Auto policy:
+      * seq < FROM — MXTPU_FLASH_XLA_FROM (causal, default 512) /
+        MXTPU_FLASH_XLA_FROM_NONCAUSAL (default 2048): flash — it wins
+        or ties, and skips the S×S HBM materialization;
       * the measured XLA-win window [FROM, UNTIL): XLA SDPA — UNLESS
         the estimated f32 score tensor (batch·heads·s_q·s_k·4B, the
         thing XLA materializes and flash doesn't) exceeds
@@ -183,23 +201,26 @@ def _flash_preferred(s_q, s_k, batch=1, heads=1):
         XLA's O(S²) score tensor becomes the HBM bottleneck there
         (b4·h8·4096² f32 scores alone are 2.1 GiB), which is the case
         flash exists for.
-    The r4 causal block-skip + tunable block sizes are expected to move
-    FROM upward; the on-chip bench re-measures the table each window.
-    MXTPU_FLASH_MODE=always|never overrides (auto is the default).
+    The on-chip bench re-measures the table each chip window; update
+    the FROM defaults only from a fresh bench_logs/rN/attention_bench
+    table.  MXTPU_FLASH_MODE=always|never overrides (auto default).
     """
-    mode = os.environ.get("MXTPU_FLASH_MODE", "auto").lower()
+    from .. import envs
+    mode = envs.get("MXTPU_FLASH_MODE").lower()
     if mode == "always":
         return True
     if mode == "never":
         return False
     s = max(s_q, s_k)
-    xla_from = int(os.environ.get("MXTPU_FLASH_XLA_FROM", "2048"))
-    xla_until = int(os.environ.get("MXTPU_FLASH_XLA_UNTIL", "4096"))
+    # defaults live in the envs registry (ONE source of truth — the
+    # generated docs/env_vars.md advertises exactly what runs here)
+    xla_from = envs.get("MXTPU_FLASH_XLA_FROM" if causal
+                        else "MXTPU_FLASH_XLA_FROM_NONCAUSAL")
+    xla_until = envs.get("MXTPU_FLASH_XLA_UNTIL")
     if s < xla_from or s >= xla_until:
         return True
     score_gb = batch * heads * s_q * s_k * 4 / 2**30
-    max_gb = float(os.environ.get("MXTPU_FLASH_XLA_MAX_SCORE_GB", "2"))
-    return score_gb > max_gb
+    return score_gb > envs.get("MXTPU_FLASH_XLA_MAX_SCORE_GB")
 
 
 def _flash_viable(q, k):

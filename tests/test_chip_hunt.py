@@ -320,3 +320,17 @@ def test_vs_baseline_semantics():
     finally:
         bench._state.clear()
         bench._state.update(orig)
+
+
+def test_is_oom_both_spellings():
+    """HBM OOM arrives as RESOURCE_EXHAUSTED from a local PJRT client
+    but as INTERNAL HTTP 500 '...Ran out of memory...' through the
+    axon remote-compile relay (r5 window, b256 case) — both must be
+    classed permanent, or the bench burns retries on unfixable
+    programs."""
+    assert bench._is_oom(Exception("RESOURCE_EXHAUSTED: allocating"))
+    assert bench._is_oom(Exception(
+        "INTERNAL: http://127.0.0.1:8083/remote_compile: HTTP 500: "
+        "... Ran out of memory in memory space hbm. Used 22.48G"))
+    assert not bench._is_oom(Exception("DEADLINE_EXCEEDED: timeout"))
+    assert not bench._is_oom(Exception("UNAVAILABLE: channel down"))

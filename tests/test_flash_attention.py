@@ -356,9 +356,10 @@ class TestSlidingWindow:
 
     def test_dispatch_prefers_flash_for_window(self, interpret,
                                                monkeypatch):
-        """A banded call takes the kernel even at seqs where the
-        full-causal policy picks XLA (band = O(S·W) in the kernel,
-        still O(S²) HBM on the XLA path)."""
+        """A banded call takes the kernel even at seqs where the dense
+        policy picks XLA — r5 on-chip table: flash banded is 3.9x
+        faster at seq 512/w256 and 6.6x at 1024/w256 (the band is
+        O(S·W) in the kernel, a masked S×S on the XLA path)."""
         from mxnet_tpu.ops import attention as attn
         q, k, v = _rand_qkv(1, 256, 2, 64, seed=46)
         monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "256")
@@ -388,10 +389,20 @@ class TestFlashSelection:
     def test_auto_policy_crossover(self, monkeypatch):
         """Auto mode: flash below the measured XLA-win window, XLA
         inside it, flash again where the S² score tensor would blow
-        HBM (bench_logs/r3/attention_bench.log crossover)."""
+        HBM.  The r5 table (bench_logs/r5/attention_bench.log, fwd+bwd
+        totals) makes the crossover causality-dependent: causal XLA
+        wins from 512; non-causal flash holds through 1024."""
         from mxnet_tpu.ops.attention import _flash_preferred
         monkeypatch.delenv("MXTPU_FLASH_MODE", raising=False)
-        assert _flash_preferred(128, 128)
+        # causal: XLA from 512
+        assert _flash_preferred(128, 128, causal=True)
+        assert _flash_preferred(256, 256, causal=True)
+        assert not _flash_preferred(512, 512, causal=True)
+        assert not _flash_preferred(1024, 1024, causal=True)
+        assert not _flash_preferred(2048, 2048, causal=True)
+        assert _flash_preferred(4096, 4096, causal=True)
+        # non-causal: flash through 1024, XLA from 2048
+        assert _flash_preferred(512, 512)
         assert _flash_preferred(1024, 1024)
         assert not _flash_preferred(2048, 2048)
         assert _flash_preferred(4096, 4096)
@@ -440,22 +451,30 @@ class TestFlashSelection:
     def test_window_env_tunable(self, monkeypatch):
         from mxnet_tpu.ops.attention import _flash_preferred
         monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "1024")
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM_NONCAUSAL", "1024")
         monkeypatch.setenv("MXTPU_FLASH_XLA_UNTIL", "8192")
+        assert not _flash_preferred(1024, 1024, causal=True)
         assert not _flash_preferred(1024, 1024)
+        assert _flash_preferred(8192, 8192, causal=True)
         assert _flash_preferred(8192, 8192)
 
     def test_dispatch_respects_policy(self, interpret, monkeypatch):
         """dot_product_attention at a policy-excluded seq takes the
-        XLA path (no flash dispatch counted)."""
+        XLA path (no flash dispatch counted); causal and non-causal
+        calls read their own FROM knobs."""
         from mxnet_tpu.ops import attention as attn
         q, k, v = _rand_qkv(1, 256, 2, 64)
         monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "256")
         before = attn.flash_dispatch_count()
-        attn.dot_product_attention(q, k, v)
+        attn.dot_product_attention(q, k, v, causal=True)
         assert attn.flash_dispatch_count() == before
-        monkeypatch.delenv("MXTPU_FLASH_XLA_FROM")
+        # the causal FROM does not touch non-causal dispatch (its own
+        # knob defaults to 2048, so seq 256 stays on the kernel)
         attn.dot_product_attention(q, k, v)
         assert attn.flash_dispatch_count() == before + 1
+        monkeypatch.delenv("MXTPU_FLASH_XLA_FROM")
+        attn.dot_product_attention(q, k, v, causal=True)
+        assert attn.flash_dispatch_count() == before + 2
 
     @pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 256)])
     def test_block_size_env_numerics(self, interpret, monkeypatch,
