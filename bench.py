@@ -437,9 +437,14 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
         from mxnet_tpu.gluon.block import HybridBlock
 
         builder = getattr(models, builder_name)
+        # MXTPU_BENCH_FUSED_CE=1: skip the tied decode matmul and fuse
+        # decode+CE (chunked_softmax_ce_bias) — the r5 ablation put the
+        # decoded-logits MLM head at 18.6 ms of an 81.3 ms b64 step
+        fused_ce = os.environ.get("MXTPU_BENCH_FUSED_CE") == "1"
         inner = models.BERTForPretrain(
             builder(vocab_size=vocab, max_length=seq_len, dropout=0.1,
-                    remat=remat, scan_layers=scan_layers))
+                    remat=remat, scan_layers=scan_layers),
+            decode_mlm=not fused_ce)
 
         # full-length sequences need no padding mask; passing
         # valid_length=None keeps attention on the Pallas FLASH path
@@ -460,11 +465,19 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
         b, m = batch_size, num_masked
 
         def loss_fn(outs, label):
-            mlm_scores, nsp_scores = outs
             mlm_labels = label[:, :m].reshape((-1,))
             nsp_labels = label[:, m]
-            return sce(mlm_scores, mlm_labels).mean() + \
-                sce(nsp_scores, nsp_labels).mean()
+            if fused_ce:
+                h2, nsp_scores, word_w, mlm_bias = outs
+                ce_chunk = int(os.environ.get(
+                    "MXTPU_BENCH_CE_CHUNK", "8192"))
+                mlm = nd.chunked_softmax_ce_bias(
+                    h2, word_w, mlm_bias, mlm_labels,
+                    chunk=ce_chunk).mean()
+            else:
+                mlm_scores, nsp_scores = outs
+                mlm = sce(mlm_scores, mlm_labels).mean()
+            return mlm + sce(nsp_scores, nsp_labels).mean()
 
         mesh = parallel.make_mesh({"dp": 1}, devices=[ctx.device])
         # fuse_step: fwd+bwd+optimizer in ONE program (verified

@@ -57,6 +57,16 @@ def main():
     from mxnet_tpu.io import ImageRecordIter
 
     on_tpu = bool(mx.num_tpus())
+    if not on_tpu and not args.cpu and \
+            _os.environ.get("MXTPU_IO_BENCH_REQUIRE_TPU") == "1":
+        # hunter contract: an intermittent axon init failure must read
+        # as TRANSIENT (the word "unreachable" below) so the retry does
+        # not count against the job's real-failure cap — r5 burned two
+        # attempts on runs that silently measured the CPU backend
+        print(json.dumps({"error": "tpu unreachable in this process "
+                          "(UNAVAILABLE); refusing to measure the cpu "
+                          "backend under a tpu contract"}), flush=True)
+        raise SystemExit(1)
     ctx = mx.tpu() if on_tpu else mx.cpu()
     plat = "tpu" if on_tpu else "cpu"
     b, s = args.batch, args.size

@@ -72,11 +72,22 @@ class BERTModel(HybridBlock):
 
 
 class BERTForPretrain(HybridBlock):
-    """MLM + NSP pretraining heads over BERTModel."""
+    """MLM + NSP pretraining heads over BERTModel.
 
-    def __init__(self, bert: BERTModel, **kwargs):
+    ``decode_mlm=False`` skips the tied decode matmul and returns the
+    pre-decode MLM hidden plus the tied weight and bias instead of
+    logits, so the caller can fuse decode+CE with
+    ``nd.chunked_softmax_ce_bias`` — the (B·M, V) logits (156 MB at
+    bert_base b64/m20) are then never materialized.  The r5 on-chip
+    ablation measured the decoded-logits MLM head at 18.6 ms of an
+    81.3 ms step, far above its ~1 ms of matmul FLOPs — the gap is
+    logits HBM traffic, which the fused path removes.
+    """
+
+    def __init__(self, bert: BERTModel, decode_mlm=True, **kwargs):
         super().__init__(**kwargs)
         units = bert._units
+        self._decode_mlm = bool(decode_mlm)
         with self.name_scope():
             self.bert = bert
             self.mlm_dense = nn.Dense(units, activation=None,
@@ -100,10 +111,13 @@ class BERTForPretrain(HybridBlock):
         # the weight's buffer holds the trace-time tracer, so gradients
         # flow to the embedding from both uses
         word_w = self.bert.word_embed.weight.data(h.context)
-        mlm_scores = F.dot(
-            h.reshape((-1, h.shape[-1])),
-            word_w, transpose_b=True) + mlm_bias
         nsp_scores = self.nsp_classifier(pooled)
+        h2 = h.reshape((-1, h.shape[-1]))
+        if not self._decode_mlm:
+            # fused-CE contract: (hidden, nsp, tied weight, bias) —
+            # feed the first/last two to chunked_softmax_ce_bias
+            return h2, nsp_scores, word_w, mlm_bias
+        mlm_scores = F.dot(h2, word_w, transpose_b=True) + mlm_bias
         return mlm_scores, nsp_scores
 
 

@@ -189,6 +189,18 @@ def softmax_cross_entropy(data, label):
     return -jnp.sum(picked)
 
 
+@register("chunked_softmax_ce_bias", num_inputs=4)
+def chunked_softmax_ce_bias(hidden, weight, bias, label, *, chunk=8192,
+                            axis_name=None):
+    """:func:`chunked_softmax_ce` with a per-vocab-row logit bias —
+    the BERT-style tied decode (``h @ Wᵀ + b``); the bias streams
+    through the same slabs and receives gradients (it is the THIRD
+    tape input — num_inputs=4 — so ``b.grad`` is real).  Under
+    ``axis_name`` (tp mode) pass this rank's bias shard (V/tp,)."""
+    return _chunked_ce_impl(hidden, weight, label, bias=bias,
+                            chunk=chunk, axis_name=axis_name)
+
+
 @register("chunked_softmax_ce", num_inputs=3)
 def chunked_softmax_ce(hidden, weight, label, *, chunk=8192,
                        axis_name=None):
@@ -220,8 +232,17 @@ def chunked_softmax_ce(hidden, weight, label, *, chunk=8192,
 
     hidden (N, U); weight (V, U) — the tied embedding or LM-head
     matrix (gradients flow to both inputs); label (N,) int, GLOBAL
-    vocab ids in both modes.  Returns per-row loss (N,), f32.
+    vocab ids in both modes.  For a per-vocab logit bias (BERT tied
+    decode) use :func:`chunked_softmax_ce_bias` — bias is
+    deliberately NOT a kwarg here: on the registered 3-input op a
+    keyword tensor would ride the static-attr path and silently drop
+    its gradient.  Returns per-row loss (N,), f32.
     """
+    return _chunked_ce_impl(hidden, weight, label, bias=None,
+                            chunk=chunk, axis_name=axis_name)
+
+
+def _chunked_ce_impl(hidden, weight, label, *, bias, chunk, axis_name):
     n, u = hidden.shape
     v = weight.shape[0]
     chunk = int(min(chunk, v))
@@ -234,6 +255,11 @@ def chunked_softmax_ce(hidden, weight, label, *, chunk=8192,
     pad = n_chunks * chunk - v
     w = jnp.pad(weight, ((0, pad), (0, 0))) if pad else weight
     w = w.reshape(n_chunks, chunk, u)
+    has_bias = bias is not None
+    if has_bias:
+        bvec = bias.astype(jnp.float32)
+        bvec = jnp.pad(bvec, (0, pad)) if pad else bvec
+        bslabs = bvec.reshape(n_chunks, chunk)
     lbl = label.astype(jnp.int32)
     if axis_name is not None:
         # weight is this rank's vocab shard: translate the GLOBAL
@@ -244,9 +270,14 @@ def chunked_softmax_ce(hidden, weight, label, *, chunk=8192,
     @jax.checkpoint
     def slab(carry, wc_i):
         m, s, lab = carry
-        wc, i = wc_i
+        if has_bias:
+            wc, bc, i = wc_i
+        else:
+            wc, i = wc_i
         logits = jnp.dot(hidden, wc.T,
                          preferred_element_type=jnp.float32)
+        if has_bias:
+            logits = logits + bc[None, :]
         if pad:
             # padded vocab rows must not enter the normalizer
             col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -275,8 +306,9 @@ def chunked_softmax_ce(hidden, weight, label, *, chunk=8192,
     init = (jnp.full((n,), -jnp.inf, jnp.float32) + tie,
             jnp.zeros((n,), jnp.float32) + tie,
             jnp.zeros((n,), jnp.float32) + tie)
-    (m, s, lab), _ = jax.lax.scan(
-        slab, init, (w, jnp.arange(n_chunks, dtype=jnp.int32)))
+    idxs = jnp.arange(n_chunks, dtype=jnp.int32)
+    xs = (w, bslabs, idxs) if has_bias else (w, idxs)
+    (m, s, lab), _ = jax.lax.scan(slab, init, xs)
     if axis_name is not None:
         # Megatron assembly across the vocab shards: rescale each
         # rank's online stats to the global max, then ONE fused psum

@@ -450,7 +450,16 @@ def main():
         pending = [j for j in pending_jobs()
                    if real_fails[j[0]] < MAX_ATTEMPTS]
         if not pending:
-            break
+            if args.once:
+                break       # --once contract: probe, run, exit
+            # don't exit — jobs.json is re-read every cycle and the
+            # builder adds jobs mid-hunt (r5: the queue drained twice
+            # while new MFU experiments were being authored); idle at
+            # the probe cadence until new work or the deadline
+            log(f"queue drained; idling {args.interval:.0f}s "
+                "(jobs.json is re-read each cycle)")
+            time.sleep(args.interval)
+            continue
         # every LONG_PROBE_EVERY-th blackout cycle stretches the probe
         # deadline to LONG_PROBE_TIMEOUT in case grants are merely
         # slow, not absent
