@@ -863,11 +863,19 @@ def main():
                 else:
                     _log("MXTPU_BENCH_SWEEP selected nothing; "
                          "running full sweep")
-        # scan-over-layers (default on): ONE compiled layer body
-        # instead of 12 — the 1-core bench host pays >30 min to compile
-        # the unrolled fused step, longer than chip windows last.
-        # MXTPU_BENCH_SCAN=0 restores the unrolled program (same math).
-        scan = os.environ.get("MXTPU_BENCH_SCAN", "1") != "0"
+        # MXTPU_BENCH_SCAN picks the layer-stacking strategy; the
+        # default is UNROLLED since r5: the same-window A/B measured
+        # the scanned program at 786.8 sps vs 956.9 unrolled (b64 — a
+        # 17% steady-state tax from the scan carry blocking
+        # cross-layer fusion), and the axon remote compiler makes the
+        # unrolled compile cheap (~90 s incl. warmup vs >30 min
+        # host-side XLA, the original reason scan was the default).
+        # Any truthy value (1/true/yes) restores the scanned program
+        # (same math; right for quick iteration or giant depths).
+        scan = os.environ.get("MXTPU_BENCH_SCAN", "0").lower() \
+            not in ("0", "", "false", "no")
+        _log(f"stage 3 layer stacking: "
+             f"{'scan' if scan else 'unrolled'}")
         for bs, seq, bulk_cfg in sweep:
             remaining = budget - (time.monotonic() - _T0)
             # seq-512 steps cost ~4-8x a seq-128 step plus a larger
