@@ -17,9 +17,6 @@ import time
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))
-# hard override: the image pins JAX_PLATFORMS=axon, and this bench
-# is host-side only (the chip plays no part in decode throughput)
-_os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
@@ -72,6 +69,12 @@ def main():
     p.add_argument("--threads", type=int, default=4)
     args = p.parse_args()
 
+    # hard override: the image pins JAX_PLATFORMS=axon, and this bench
+    # is host-side only (the chip plays no part in decode throughput).
+    # MAIN-ONLY on purpose: io_train_bench imports make_rec from this
+    # module, and a module-level pin silently forced ITS training loop
+    # onto the cpu backend for three r5 hunter attempts in a row
+    _os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
     from mxnet_tpu import _native

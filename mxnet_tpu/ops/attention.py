@@ -174,36 +174,31 @@ def _flash_preferred(s_q, s_k, batch=1, heads=1, causal=False):
     """Measured flash-vs-XLA crossover policy (VERDICT r3 #4: a hand
     kernel must win or step aside, the cuDNN-fast-path pattern).
 
-    r5 on-chip evidence (bench_logs/r5/attention_bench.log, v5e,
-    post-block-skip — supersedes the r3 table), combined fwd+bwd
-    time, xla/flash total-time ratios:
+    r5 on-chip evidence, v5e.  The standalone kernel-vs-XLA microbench
+    (bench_logs/r5/attention_bench{,2}.log) showed a mixed, noisy,
+    causality-dependent table — but the IN-MODEL A/B settled it:
+    BERT-base b64 s128, identical math, same window, honest-slope —
+    flash kernel 956.9 samples/sec vs XLA SDPA **1535.3** (MFU 0.53
+    v1; bench_logs/r5/bench_xlaattn.log).  A Pallas custom-call is a
+    fusion BARRIER: standalone timings miss that XLA fuses the qkv
+    projections, scaling, residual and dropout INTO its attention
+    when it owns the whole graph.  So inside XLA's comfortable regime
+    the compiler wins, and the kernel's domain is what XLA cannot do:
 
-      seq     causal          non-causal
-      128     0.98 (par)      1.06 (par)
-      512     0.66 (XLA)      1.59 (flash)
-      1024    0.49 (XLA)      0.98 (par)
-      2048    0.52 (XLA)      0.35 (XLA)
+      * sliding-window/banded attention (O(S·W) vs a masked S×S —
+        measured 1.1-6.6x, handled by the caller before this policy);
+      * score tensors beyond the HBM budget — batch·heads·s_q·s_k·4B
+        over MXTPU_FLASH_XLA_MAX_SCORE_GB (default 2 GiB, ~1/8 of
+        v5e HBM): flash, or the XLA path OOMs (ADVICE r4);
+      * seq ≥ MXTPU_FLASH_XLA_UNTIL (default 4096): flash regardless
+        (b4·h8·4096² f32 scores alone are 2.1 GiB).
 
-    The crossover is CAUSALITY-DEPENDENT: causal XLA wins from 512
-    (the kernel's two-pass backward loses, and causal block-skip only
-    helps its forward), while non-causal flash holds through 1024.
-    Auto policy:
-      * seq < FROM — MXTPU_FLASH_XLA_FROM (causal, default 512) /
-        MXTPU_FLASH_XLA_FROM_NONCAUSAL (default 2048): flash — it wins
-        or ties, and skips the S×S HBM materialization;
-      * the measured XLA-win window [FROM, UNTIL): XLA SDPA — UNLESS
-        the estimated f32 score tensor (batch·heads·s_q·s_k·4B, the
-        thing XLA materializes and flash doesn't) exceeds
-        MXTPU_FLASH_XLA_MAX_SCORE_GB (default 2 GiB, ~1/8 of v5e's
-        16 GiB HBM): a policy tuned at small batch must not OOM a
-        large-batch run that explicitly asked for flash (ADVICE r4);
-      * seq ≥ MXTPU_FLASH_XLA_UNTIL (default 4096): flash regardless —
-        XLA's O(S²) score tensor becomes the HBM bottleneck there
-        (b4·h8·4096² f32 scores alone are 2.1 GiB), which is the case
-        flash exists for.
-    The on-chip bench re-measures the table each chip window; update
-    the FROM defaults only from a fresh bench_logs/rN/attention_bench
-    table.  MXTPU_FLASH_MODE=always|never overrides (auto default).
+    MXTPU_FLASH_XLA_FROM (causal) / MXTPU_FLASH_XLA_FROM_NONCAUSAL
+    keep their "prefer flash below this seq" meaning for tuning but
+    both now default to 0 — XLA everywhere the three rules above
+    don't hand the kernel the job.  Update only from an IN-MODEL
+    same-window A/B (microbench cells vary 2-3x run-to-run here).
+    MXTPU_FLASH_MODE=always|never overrides (auto default).
     """
     from .. import envs
     mode = envs.get("MXTPU_FLASH_MODE").lower()

@@ -302,17 +302,21 @@ def test_encoder_remat_numerics_identical():
     np.testing.assert_allclose(base, rem, rtol=1e-5, atol=1e-6)
 
 
-def test_remat_with_flash_kernel_fused_step():
-    """The seq-512 chip config's exact composition: jax.checkpoint'd
-    encoder layers whose attention runs the Pallas flash custom_vjp,
-    inside the fused trainer — must compile, train, and actually
-    dispatch flash (interpret mode stands in for the chip)."""
+def test_remat_with_flash_kernel_fused_step(monkeypatch):
+    """Long-context composition: jax.checkpoint'd encoder layers whose
+    attention runs the Pallas flash custom_vjp, inside the fused
+    trainer — must compile, train, and actually dispatch flash
+    (interpret mode stands in for the chip).  The default policy now
+    routes ordinary seqs to XLA (the r5 in-model A/B), so the kernel
+    path is pinned explicitly — this is the program a beyond-HBM
+    sequence length would build."""
     from mxnet_tpu import parallel, models
     from mxnet_tpu.ops import flash_attention as fa
     from mxnet_tpu.ops import attention as attn
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
     from mxnet_tpu.gluon.block import HybridBlock
 
+    monkeypatch.setenv("MXTPU_FLASH_MODE", "always")
     old = fa._INTERPRET
     fa._INTERPRET = True
     try:

@@ -232,7 +232,8 @@ class TestFlashInterpret:
 class TestFlashDispatch:
     def test_op_dispatches_to_flash(self, interpret, monkeypatch):
         """dot_product_attention must route through the kernel when
-        viable."""
+        the policy hands it the job (pinned here — the r5 default
+        sends ordinary seqs to XLA)."""
         calls = []
         real = fa_mod._flash_fwd_pallas
 
@@ -241,6 +242,7 @@ class TestFlashDispatch:
             return real(*a, **kw)
 
         monkeypatch.setattr(fa_mod, "_flash_fwd_pallas", spy)
+        monkeypatch.setenv("MXTPU_FLASH_MODE", "always")
         from mxnet_tpu.ops.attention import dot_product_attention
         q, k, v = _rand_qkv(1, 128, 2, 64)
         dot_product_attention(q, k, v)
@@ -387,27 +389,31 @@ class TestSlidingWindow:
 
 class TestFlashSelection:
     def test_auto_policy_crossover(self, monkeypatch):
-        """Auto mode: flash below the measured XLA-win window, XLA
-        inside it, flash again where the S² score tensor would blow
-        HBM.  The r5 table (bench_logs/r5/attention_bench.log, fwd+bwd
-        totals) makes the crossover causality-dependent: causal XLA
-        wins from 512; non-causal flash holds through 1024."""
+        """Auto mode, r5 IN-MODEL policy: XLA SDPA everywhere it can —
+        the Pallas custom-call is a fusion barrier (bert_base b64 s128
+        measured 956.9 flash vs 1535.3 XLA samples/sec) — and the
+        kernel keeps the jobs XLA can't do: seq >= UNTIL, score
+        tensors beyond the HBM budget (and windowed attention, routed
+        before this policy).  The FROM knobs still carve out a
+        prefer-flash band when set."""
         from mxnet_tpu.ops.attention import _flash_preferred
         monkeypatch.delenv("MXTPU_FLASH_MODE", raising=False)
-        # causal: XLA from 512
-        assert _flash_preferred(128, 128, causal=True)
-        assert _flash_preferred(256, 256, causal=True)
-        assert not _flash_preferred(512, 512, causal=True)
-        assert not _flash_preferred(1024, 1024, causal=True)
-        assert not _flash_preferred(2048, 2048, causal=True)
+        # defaults: XLA at every ordinary seq, causal or not
+        for s in (128, 256, 512, 1024, 2048):
+            assert not _flash_preferred(s, s, causal=True), s
+            assert not _flash_preferred(s, s), s
+        # ...flash again where XLA's O(S^2) scores become the problem
         assert _flash_preferred(4096, 4096, causal=True)
-        # non-causal: flash through 1024, XLA from 2048
-        assert _flash_preferred(512, 512)
-        assert _flash_preferred(1024, 1024)
-        assert not _flash_preferred(2048, 2048)
         assert _flash_preferred(4096, 4096)
         # cross-attention uses the max of the two lengths
         assert not _flash_preferred(128, 2048)
+        assert _flash_preferred(128, 4096)
+        # the tuning knobs retain their prefer-flash-below meaning
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "512")
+        assert _flash_preferred(256, 256, causal=True)
+        assert not _flash_preferred(256, 256)      # own knob unset
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM_NONCAUSAL", "512")
+        assert _flash_preferred(256, 256)
 
     def test_xla_window_yields_to_hbm_budget(self, monkeypatch):
         """Inside the measured XLA-win window the policy must still
@@ -459,21 +465,22 @@ class TestFlashSelection:
         assert _flash_preferred(8192, 8192)
 
     def test_dispatch_respects_policy(self, interpret, monkeypatch):
-        """dot_product_attention at a policy-excluded seq takes the
-        XLA path (no flash dispatch counted); causal and non-causal
-        calls read their own FROM knobs."""
+        """Default dispatch is the XLA path (no flash count) for both
+        causal and non-causal ordinary seqs; each FROM knob carves its
+        own prefer-flash band back out."""
         from mxnet_tpu.ops import attention as attn
         q, k, v = _rand_qkv(1, 256, 2, 64)
-        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "256")
         before = attn.flash_dispatch_count()
         attn.dot_product_attention(q, k, v, causal=True)
-        assert attn.flash_dispatch_count() == before
-        # the causal FROM does not touch non-causal dispatch (its own
-        # knob defaults to 2048, so seq 256 stays on the kernel)
         attn.dot_product_attention(q, k, v)
-        assert attn.flash_dispatch_count() == before + 1
-        monkeypatch.delenv("MXTPU_FLASH_XLA_FROM")
+        assert attn.flash_dispatch_count() == before
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM", "512")
         attn.dot_product_attention(q, k, v, causal=True)
+        assert attn.flash_dispatch_count() == before + 1
+        attn.dot_product_attention(q, k, v)      # own knob unset
+        assert attn.flash_dispatch_count() == before + 1
+        monkeypatch.setenv("MXTPU_FLASH_XLA_FROM_NONCAUSAL", "512")
+        attn.dot_product_attention(q, k, v)
         assert attn.flash_dispatch_count() == before + 2
 
     @pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 256)])
