@@ -105,19 +105,29 @@ def main():
         # CPU smoke: small enough to finish in ~a minute, same code path
         b, s, n_rec, model = 8, 64, 128, "resnet18_v1"
 
-    net = getattr(vision, model)(classes=10)
-    net.initialize(mx.init.Xavier(), ctx=ctx)
-    net.hybridize()
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.01}, kvstore=None)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
-    def step(x, y):
-        with autograd.record():
-            loss = loss_fn(net(x), y).mean()
-        loss.backward()
-        trainer.step(b)
-        return loss
+    def make_step():
+        """Fresh net + trainer + step closure.  Rebuilt per OOM
+        retry: an async OOM surfaces at the sync point AFTER
+        backward/step dispatches built on the failed computation, so
+        the old net's params hold poisoned arrays that would re-raise
+        at the next sync no matter how small the new batch is."""
+        net = getattr(vision, model)(classes=10)
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01}, kvstore=None)
+
+        def step(x, y, bsz):
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(bsz)
+            return loss
+        return step
+
+    step = make_step()
 
     def decode_epoch_rate(rec_path, size, threads, prefetch=4):
         """Warm 2 batches, reset, time one epoch of pure decode.
@@ -138,21 +148,47 @@ def main():
         return seen / (time.perf_counter() - t0), seen
 
     rng = np.random.RandomState(0)
-    x0 = nd.array(rng.rand(b, 3, s, s).astype("f4"), ctx=ctx)
-    y0 = nd.array(rng.randint(0, 10, b).astype("f4"), ctx=ctx)
-    step(x0, y0).wait_to_read()            # compile
+    # eager-autograd resnet50 train at b64 s224 sits at the edge of
+    # v5e HBM (r5 attempt 4 OOMed mid-slope): halve the batch on
+    # RESOURCE_EXHAUSTED — the feed-the-chip question this bench
+    # answers does not depend on the exact batch size
+    per_step = None
+    first_try = True
+    for b_try in (b, b // 2, b // 4):
+        if b_try < 1:
+            break
+        try:
+            if not first_try:
+                step = make_step()     # discard poisoned params
+            first_try = False
+            x0 = nd.array(rng.rand(b_try, 3, s, s).astype("f4"),
+                          ctx=ctx)
+            y0 = nd.array(rng.randint(0, 10, b_try).astype("f4"),
+                          ctx=ctx)
+            step(x0, y0, b_try).wait_to_read()     # compile
 
-    # 1. compute-only: preloaded batch, chained slope timing
-    def window(n):
-        t0 = time.perf_counter()
-        acc = None
-        for _ in range(n):
-            out = step(x0, y0).reshape((-1,))[0:1]
-            acc = out if acc is None else acc + out * 1e-30
-        float(np.asarray(acc.asnumpy()).ravel()[0])
-        return time.perf_counter() - t0
+            # 1. compute-only: preloaded batch, chained slope timing
+            def window(n):
+                t0 = time.perf_counter()
+                acc = None
+                for _ in range(n):
+                    out = step(x0, y0, b_try).reshape((-1,))[0:1]
+                    acc = out if acc is None else acc + out * 1e-30
+                float(np.asarray(acc.asnumpy()).ravel()[0])
+                return time.perf_counter() - t0
 
-    per_step = slope(window, 4)
+            per_step = slope(window, 4)
+            b = b_try
+            break
+        except Exception as e:
+            r = repr(e)
+            if "RESOURCE_EXHAUSTED" not in r \
+                    and "Ran out of memory" not in r:
+                raise
+            print(json.dumps({"warn": "train step OOM at batch "
+                              f"{b_try}; halving"}), flush=True)
+    if per_step is None:
+        raise RuntimeError("train step OOMed at every tried batch")
     compute_sps = b / per_step
     print(json.dumps({"metric": "train_compute_only_img_per_sec",
                       "model": model, "batch": b, "size": s,
@@ -172,7 +208,8 @@ def main():
             # and first-batch latency stay out of the timed epoch
             for i, batch in enumerate(it):
                 step(batch.data[0].as_in_context(ctx),
-                     batch.label[0].as_in_context(ctx)).wait_to_read()
+                     batch.label[0].as_in_context(ctx),
+                     b).wait_to_read()
                 if i >= 1:
                     break
             it.reset()
@@ -182,14 +219,31 @@ def main():
             for batch in it:
                 x = batch.data[0].as_in_context(ctx)
                 y = batch.label[0].as_in_context(ctx)
-                last = step(x, y)
+                last = step(x, y, b)
                 seen += b
             float(np.asarray(last.asnumpy()).ravel()[0])
             return seen / (time.perf_counter() - t0)
 
-        # 2. IO in the loop at the default pool, 3. pool scaling sweep
+        # 2. IO in the loop at the default pool, 3. pool scaling sweep.
+        # Guarded: this phase keeps several in-flight batches' device
+        # arrays alive (async dispatch, no per-step sync), so its peak
+        # HBM exceeds phase 1's single resident pair — an OOM here
+        # must not discard the rows already measured
         for threads in [int(t) for t in args.threads.split(",")]:
-            sps = epoch_sps(threads)
+            try:
+                sps = epoch_sps(threads)
+            except Exception as e:
+                r = repr(e)
+                if "RESOURCE_EXHAUSTED" not in r \
+                        and "Ran out of memory" not in r:
+                    raise
+                print(json.dumps(
+                    {"warn": "io-in-loop OOM at batch "
+                     f"{b} threads {threads}; params poisoned — "
+                     "skipping remaining train-with-io rows"}),
+                    flush=True)
+                step = make_step()     # fresh params for any later use
+                break
             print(json.dumps(
                 {"metric": "train_with_io_img_per_sec", "model": model,
                  "batch": b, "size": s, "threads": threads,
