@@ -249,11 +249,16 @@ def main():
     # the bill
     parts = 3 * (rows["qkv_ffn"]["ms"] + rows["attention"]["ms"]) \
         + rows["embed"]["ms"] + rows["mlm_head"]["ms"] * 3
+    import jax as _jax
     print(json.dumps({
         "summary": "bert_phases", "config": cfg,
         "full_step_ms": rows["full_step"]["ms"],
         "modeled_parts_ms": round(parts, 3),
         "unexplained_ms": round(rows["full_step"]["ms"] - parts, 3),
+        # platform stamped so the hunter's fail_pattern can refuse a
+        # CPU-fallback run masquerading as chip evidence
+        "platform": ("cpu" if _jax.default_backend() == "cpu"
+                     else "tpu"),
         "note": "modeled = 3x(qkv_ffn+attention) fwd-bwd scaling + "
                 "embed + 3x mlm_head; the gap is optimizer, "
                 "layernorms, residual traffic, and dispatch",
