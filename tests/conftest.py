@@ -35,19 +35,49 @@ def _seeded():
     yield
 
 
+def needs_devices(n=8):
+    """Runtime skip for tests that build an n-device mesh — the
+    on-chip tier (MXTPU_TEST_ON_TPU=1) runs on ONE real chip, where
+    the CPU-virtual-mesh tests must skip rather than fail.  Mixed
+    modules call this inside individual tests; all-mesh modules use
+    ``pytestmark = pytest.mark.needs_mesh`` instead."""
+    import jax
+    have = len(jax.devices())
+    if have < n:
+        pytest.skip(f"needs {n} devices (have {have})")
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "tpu: needs the real TPU chip")
     config.addinivalue_line("markers", "slow: long-running")
+    config.addinivalue_line(
+        "markers",
+        "needs_mesh(n=8): whole module/test needs an n-device mesh — "
+        "auto-skipped on backends with fewer devices")
 
 
 def pytest_collection_modifyitems(config, items):
-    if os.environ.get("MXTPU_TEST_ON_TPU"):
-        return
-    skip_tpu = pytest.mark.skip(
-        reason="needs real TPU (set MXTPU_TEST_ON_TPU=1)")
-    for item in items:
-        if "tpu" in item.keywords:
-            item.add_marker(skip_tpu)
+    on_tpu = bool(os.environ.get("MXTPU_TEST_ON_TPU"))
+    if not on_tpu:
+        skip_tpu = pytest.mark.skip(
+            reason="needs real TPU (set MXTPU_TEST_ON_TPU=1)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip_tpu)
+    # needs_mesh gating runs in BOTH tiers (the CPU tier always has 8
+    # virtual devices, so it only ever bites on-chip); device count is
+    # read lazily so collection without any mesh-marked test never
+    # initializes a backend
+    marked = [it for it in items if "needs_mesh" in it.keywords]
+    if marked:
+        import jax
+        have = len(jax.devices())
+        for item in marked:
+            m = item.get_closest_marker("needs_mesh")
+            n = m.args[0] if m.args else m.kwargs.get("n", 8)
+            if have < n:
+                item.add_marker(pytest.mark.skip(
+                    reason=f"needs {n}-device mesh (have {have})"))
 
 
 def pjrt_include_dir():

@@ -18,6 +18,9 @@ import os
 import numpy as np
 import pytest
 
+# every test here builds the 8-device virtual mesh — auto-skip on fewer
+pytestmark = pytest.mark.needs_mesh(8)
+
 import mxnet_tpu as mx
 from mxnet_tpu import nd, parallel
 from mxnet_tpu.models import llama_spmd

@@ -107,9 +107,19 @@ def test_llama3_8b_geometry():
     assert 7.5e9 < n < 8.6e9, f"llama3_8b has {n/1e9:.2f}B params"
 
 
+def _needs_devices(n):
+    """Skip on backends with fewer devices (the on-chip suite runs on
+    ONE real chip; mesh tests are the CPU-virtual-mesh tier)."""
+    import jax
+    have = len(jax.devices())
+    if have < n:
+        pytest.skip(f"needs {n} devices (have {have})")
+
+
 def test_ring_attention_impl_on_mesh():
     """Long-context path: sequence-parallel ring attention over the
     8-device CPU mesh inside the model forward."""
+    _needs_devices(8)
     from mxnet_tpu import parallel
     mesh = parallel.make_mesh({"sp": 8})
     parallel.set_mesh(mesh)
@@ -139,6 +149,7 @@ def test_ring_attention_impl_on_mesh():
 def test_ring_attention_gradients_flow():
     """The ring path must be on the tape: attention projections get
     non-zero gradients (was silently zero before the invoke routing)."""
+    _needs_devices(8)
     from mxnet_tpu import parallel
     mesh = parallel.make_mesh({"sp": 8})
     parallel.set_mesh(mesh)
@@ -160,6 +171,7 @@ def test_ring_attention_gradients_flow():
 
 
 def test_ring_attention_hybridize_raises_clearly():
+    _needs_devices(8)
     from mxnet_tpu import parallel
     mesh = parallel.make_mesh({"sp": 8})
     parallel.set_mesh(mesh)
@@ -179,6 +191,7 @@ def test_ring_attention_variant_cache_no_collision():
     not share a compiled executable (the engine jit-cache keys by op
     name, so each (mesh, scale, causal, restore) variant needs its own
     OpDef name)."""
+    _needs_devices(8)
     from mxnet_tpu import parallel
     from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
     mesh = parallel.make_mesh({"sp": 8})
@@ -233,6 +246,7 @@ def test_rope_offset_dynamic_no_recompile():
 def test_ring_attention_gqa_matches_dense():
     """GQA path: unrepeated KV heads through the ring kernel must match
     dense SDPA over explicitly repeated K/V."""
+    _needs_devices(8)
     from mxnet_tpu import parallel
     from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
     mesh = parallel.make_mesh({"sp": 8})
@@ -256,6 +270,7 @@ def test_ring_attention_exec_cached_across_calls():
     """Regression: the jitted shard_map must be cached per variant —
     a fresh shard_map(partial(...)) per call retraces every invocation
     (~200x measured on the training hot loop)."""
+    _needs_devices(8)
     import importlib
     from mxnet_tpu import parallel
     # parallel re-exports the ring_attention FUNCTION; get the module
@@ -367,6 +382,7 @@ class TestLlama8BShardingPlan:
     to learn whether they fit a v5e."""
 
     def test_8b_plan_fits_v5e_hbm(self):
+        _needs_devices(8)
         from mxnet_tpu import parallel
         net = LlamaForCausalLM(llama3_8b(), tie_embeddings=False)
         mesh = parallel.make_mesh({"tp": 4, "pp": 2})
@@ -389,6 +405,7 @@ class TestLlama8BShardingPlan:
     def test_llama_rule_trains_tiny_tp(self):
         """The SAME rule drives a real TP trainer step at tiny scale:
         losses finite, weights stay sharded across the step."""
+        _needs_devices(8)
         from mxnet_tpu import parallel
         from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
 

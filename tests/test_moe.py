@@ -4,6 +4,9 @@ built here as a first-class ``ep`` mesh axis)."""
 import numpy as np
 import pytest
 
+# every test here builds the 8-device virtual mesh — auto-skip on fewer
+pytestmark = pytest.mark.needs_mesh(8)
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
 from mxnet_tpu.gluon.contrib.nn import MoEFFN

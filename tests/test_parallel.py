@@ -8,6 +8,9 @@ single-device eager training bit-for-bit (same math, same init).
 import numpy as np
 import pytest
 
+# every test here builds the 8-device virtual mesh — auto-skip on fewer
+pytestmark = pytest.mark.needs_mesh(8)
+
 import mxnet_tpu as mx
 from mxnet_tpu import nd, parallel
 from mxnet_tpu.gluon import nn, Trainer

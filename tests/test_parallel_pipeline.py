@@ -2,8 +2,12 @@
 reference's only pp analog is the manual model-parallel LSTM example;
 GPipe coverage lives in tests/test_parallel.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx  # noqa: F401  (backend/env setup via conftest)
+
+# every test here builds the 8-device virtual mesh — auto-skip on fewer
+pytestmark = pytest.mark.needs_mesh(8)
 
 
 class Test1F1B:
