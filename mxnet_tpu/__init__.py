@@ -92,6 +92,7 @@ from . import checkpoint as model  # mx.model.save_checkpoint parity
 from . import operator
 from . import contrib
 from . import rtc
+from . import analysis
 
 __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
@@ -100,4 +101,5 @@ __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "metric", "io", "test_utils", "kvstore", "kv", "parallel",
            "symbol", "sym", "module", "mod", "recordio", "image",
            "models", "profiler", "monitor", "runtime", "envs",
-           "callback", "checkpoint", "model", "operator", "contrib"]
+           "callback", "checkpoint", "model", "operator", "contrib",
+           "analysis"]

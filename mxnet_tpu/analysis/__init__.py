@@ -1,0 +1,51 @@
+"""``mxnet_tpu.analysis``: static analysis over the rebuild's three
+contract surfaces (the Relay lesson — an IR pays for itself through the
+passes you run over it):
+
+* **graph passes** (MXL1xx) over the Symbol IR — cycles, duplicate
+  names, dead nodes, and a shape/dtype contract validator that
+  abstract-evaluates every node via ``jax.eval_shape`` (no device time);
+* **registry passes** (MXL2xx) over every ``OpDef`` — arity /
+  scalar-attr / namespace-symmetry / cache-key contracts;
+* **source passes** (MXL3xx, Python ``ast``) — host-sync and
+  retrace-storm hazards in user code before any device time is spent;
+* **runtime pass** (MXL4xx) — observed jit-cache key blowup via
+  ``engine.cache_info()``.
+
+CLI: ``tools/mxlint.py`` (exits nonzero on error-severity findings, so
+it gates CI).  Rules are documented in ``docs/static_analysis.md``.
+"""
+from .findings import (Finding, Severity, RULES, rule_severity,
+                       filter_findings, format_findings)
+from .graph_passes import analyze_symbol, analyze_graph_json, node_path
+from .registry_passes import analyze_registry, analyze_opdef
+from .source_passes import analyze_source, analyze_file, analyze_paths
+from .runtime import analyze_cache
+from .corpus import builtin_symbols, traced_model_symbols, model_corpus
+
+__all__ = [
+    "Finding", "Severity", "RULES", "rule_severity", "filter_findings",
+    "format_findings",
+    "analyze_symbol", "analyze_graph_json", "node_path",
+    "analyze_registry", "analyze_opdef",
+    "analyze_source", "analyze_file", "analyze_paths",
+    "analyze_cache",
+    "builtin_symbols", "traced_model_symbols", "model_corpus",
+    "self_check",
+]
+
+
+def self_check(full: bool = False, check_shapes: bool = True):
+    """Run the registry passes over every registered op and the graph
+    passes over the shipped model corpus.
+
+    Returns ``(findings, ok)`` where ``ok`` means zero error-severity
+    findings — the CI gate ``tools/mxlint.py --self-check`` enforces.
+    """
+    findings = list(analyze_registry())
+    for name, sym, shapes in model_corpus(full=full):
+        findings.extend(analyze_symbol(sym, shapes=shapes,
+                                       check_shapes=check_shapes,
+                                       name=name))
+    ok = not any(f.severity == Severity.ERROR for f in findings)
+    return findings, ok

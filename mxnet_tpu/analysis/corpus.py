@@ -1,0 +1,99 @@
+"""Lint corpus: the shipped graphs mxlint gates CI against.
+
+Two sources:
+
+* hand-built symbols exercising the classic layer mix (MLP; conv +
+  BatchNorm aux-state graph), fast enough for every CI run;
+* traced model symbols — gluon model-zoo vision nets and the
+  ``mxnet_tpu.models`` families — obtained through the same
+  ``block(sym.var(...))`` seam ``HybridBlock.export`` uses, so the linted
+  graph is byte-for-byte the graph a user would serialize.
+
+Every entry is ``(name, Symbol, input_shapes)`` where the shapes feed the
+MXL105 contract validator.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["builtin_symbols", "traced_model_symbols", "model_corpus"]
+
+
+def builtin_symbols() -> List[Tuple[str, object, Dict[str, tuple]]]:
+    from .. import symbol as sym
+
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"),
+                           sym.var("fc1_bias"), num_hidden=64, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                           num_hidden=10, name="fc2")
+    mlp = sym.softmax(h, name="softmax")
+
+    x = sym.var("img")
+    c = sym.Convolution(x, sym.var("conv1_weight"), sym.var("conv1_bias"),
+                        kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    bn = sym.BatchNorm(c, sym.var("bn1_gamma"), sym.var("bn1_beta"),
+                       sym.var("bn1_mean"), sym.var("bn1_var"),
+                       name="bn1")
+    a = sym.Activation(bn, act_type="relu", name="relu_c")
+    p = sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool1")
+    f = sym.flatten(p, name="flat")
+    out = sym.FullyConnected(f, sym.var("fco_weight"),
+                             sym.var("fco_bias"), num_hidden=10,
+                             name="fc_out")
+    convnet = sym.softmax(out, name="prob")
+
+    grouped = sym.Group([mlp, sym.FullyConnected(
+        data, sym.var("aux_weight"), sym.var("aux_bias"),
+        num_hidden=4, name="aux_head")])
+
+    return [("mlp", mlp, {"data": (2, 784)}),
+            ("convnet_bn", convnet, {"img": (2, 3, 8, 8)}),
+            ("mlp_group", grouped, {"data": (2, 784)})]
+
+
+def _trace(net, *input_shapes, names=None) -> Tuple[object, Dict]:
+    """Initialize a HybridBlock and trace it to a Symbol (export seam)."""
+    import mxnet_tpu as mx
+    from .. import symbol as sym
+    net.initialize(mx.init.Xavier())
+    names = names or (["data"] if len(input_shapes) == 1 else
+                      [f"data{i}" for i in range(len(input_shapes))])
+    out = net(*[sym.var(n) for n in names])
+    return out, dict(zip(names, input_shapes))
+
+
+def traced_model_symbols(full: bool = False) \
+        -> Iterator[Tuple[str, object, Dict[str, tuple]]]:
+    """Traced symbols for the shipped model zoo.
+
+    The default set keeps tier-1 CI fast; ``full=True`` adds more
+    families (``tools/mxlint.py --models`` uses it).  The
+    ``mxnet_tpu.models`` transformer families (BERT/Llama/NMT/SSD) read
+    ``x.shape`` inside ``hybrid_forward`` — imperative-only, like the
+    reference — so they have no Symbol form to lint; their graphs are
+    covered imperatively by their own test files.
+    """
+    from ..gluon.model_zoo import get_model
+
+    net = get_model("resnet18_v1", classes=10, thumbnail=True)
+    yield ("zoo:resnet18_v1",) + _trace(net, (1, 3, 32, 32))
+
+    if not full:
+        return
+
+    net = get_model("alexnet", classes=10)
+    yield ("zoo:alexnet",) + _trace(net, (1, 3, 224, 224))
+
+    net = get_model("mobilenet0.25", classes=10)
+    yield ("zoo:mobilenet0.25",) + _trace(net, (1, 3, 224, 224))
+
+
+def model_corpus(full: bool = False) \
+        -> List[Tuple[str, object, Dict[str, tuple]]]:
+    out = list(builtin_symbols())
+    out.extend(traced_model_symbols(full=full))
+    return out

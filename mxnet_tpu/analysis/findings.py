@@ -1,0 +1,103 @@
+"""Finding/rule model shared by every mxlint pass family.
+
+A finding is one diagnostic: a stable rule ID (``MXL...``), a severity,
+a human message, and an anchor — ``file:line`` for source passes, a
+``graph:`` node path for graph passes, ``op:`` / ``cache:`` for the
+registry and runtime passes.  Severities gate the CLI exit code: only
+``error`` findings fail a build; ``warning``/``info`` inform.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+__all__ = ["Severity", "Finding", "RULES", "rule_severity",
+           "filter_findings", "format_findings"]
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    _ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+    @classmethod
+    def at_least(cls, sev: str, floor: str) -> bool:
+        return cls._ORDER[sev] <= cls._ORDER[floor]
+
+
+#: rule ID -> (default severity, one-line title).  IDs are stable API:
+#: docs/static_analysis.md documents each one; suppression comments and
+#: CI configs reference them by ID.
+RULES = {
+    # -- graph passes (MXL1xx) ------------------------------------------
+    "MXL101": (Severity.ERROR, "cycle in symbol graph"),
+    "MXL102": (Severity.ERROR, "duplicate node name"),
+    "MXL103": (Severity.WARNING, "dead node unreachable from any head"),
+    "MXL104": (Severity.WARNING, "unused variable input"),
+    "MXL105": (Severity.ERROR, "shape/dtype contract violation"),
+    "MXL106": (Severity.ERROR, "unknown operator"),
+    "MXL107": (Severity.ERROR, "node arity mismatch vs op registry"),
+    "MXL108": (Severity.WARNING, "unknown static attr on node"),
+    "MXL109": (Severity.INFO, "input shape unknown; node not validated"),
+    "MXL110": (Severity.ERROR, "malformed graph JSON"),
+    # -- registry passes (MXL2xx) ---------------------------------------
+    "MXL201": (Severity.ERROR,
+               "fcompute arity inconsistent with num_inputs+scalar_attrs"),
+    "MXL202": (Severity.ERROR,
+               "scalar_attrs do not name the trailing fcompute params"),
+    "MXL203": (Severity.ERROR, "scalar_ref_input out of bounds"),
+    "MXL204": (Severity.ERROR, "num_outputs inconsistent with fcompute"),
+    "MXL205": (Severity.ERROR, "nd/sym namespace exposure asymmetric"),
+    "MXL206": (Severity.WARNING,
+               "unhashable default attr (jit-cache key degradation)"),
+    "MXL207": (Severity.ERROR, "alias target not registered"),
+    # -- source passes (MXL3xx) -----------------------------------------
+    "MXL301": (Severity.WARNING, "device sync inside training loop"),
+    "MXL302": (Severity.WARNING, "device sync inside hybrid_forward"),
+    "MXL303": (Severity.WARNING,
+               "per-step-varying static attr (recompile per value)"),
+    # -- runtime passes (MXL4xx) ----------------------------------------
+    "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
+}
+
+
+def rule_severity(rule: str) -> str:
+    return RULES[rule][0]
+
+
+class Finding:
+    """One diagnostic."""
+
+    __slots__ = ("rule", "severity", "message", "location")
+
+    def __init__(self, rule: str, message: str,
+                 location: str = "", severity: Optional[str] = None):
+        if rule not in RULES:
+            raise KeyError(f"unknown mxlint rule {rule!r}")
+        self.rule = rule
+        self.severity = severity or RULES[rule][0]
+        self.message = message
+        self.location = location
+
+    def __repr__(self):
+        return (f"Finding({self.rule}, {self.severity}, "
+                f"{self.location!r}, {self.message!r})")
+
+    def format(self) -> str:
+        loc = f"{self.location}: " if self.location else ""
+        return f"{loc}{self.severity.upper()} {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "message": self.message, "location": self.location}
+
+
+def filter_findings(findings: Iterable[Finding],
+                    disable: Iterable[str] = ()) -> List[Finding]:
+    disable = set(disable)
+    return [f for f in findings if f.rule not in disable]
+
+
+def format_findings(findings: Iterable[Finding]) -> str:
+    return "\n".join(f.format() for f in findings)

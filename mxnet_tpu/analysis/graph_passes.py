@@ -1,0 +1,331 @@
+"""Graph passes over the Symbol IR (rules MXL1xx).
+
+These are the Relay-style "static passes over the framework IR": every
+check runs on the pure-Python ``_Node`` DAG (or its JSON serialization)
+with NO device execution.  The shape/dtype contract validator abstract-
+evaluates each node through ``jax.eval_shape`` via the same
+``_propagate_shapes`` walk ``infer_shape`` uses, so a graph that lints
+clean is guaranteed to bind clean for the validated shapes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+__all__ = ["analyze_symbol", "analyze_graph_json", "node_path"]
+
+
+def _frontend_custom_ops():
+    """Ops whose symbol nodes are built by frontend glue (Dropout adds the
+    RNG key input at eval time, BatchNorm appends mode flags): their node
+    arity/attrs intentionally differ from the raw OpDef."""
+    from ..ndarray import _CUSTOM
+    return set(_CUSTOM)
+
+
+def node_path(node, limit: int = 8) -> str:
+    """Human diagnostic path: a chain of node names from a graph input to
+    ``node`` (first-input chain), e.g. ``data -> fc0 -> relu1``."""
+    chain = [node]
+    cur = node
+    seen = {id(node)}
+    while cur.inputs:
+        cur = cur.inputs[0][0]
+        if id(cur) in seen:  # cyclic graph: stop rather than loop
+            break
+        seen.add(id(cur))
+        chain.append(cur)
+    names = [n.name for n in reversed(chain)]
+    if len(names) > limit:
+        names = names[:2] + ["..."] + names[-(limit - 3):]
+    return " -> ".join(names)
+
+
+def _collect_nodes(heads) -> List:
+    """All nodes reachable from ``heads`` — cycle-safe (plain visited-set
+    walk; no ordering guarantee on cyclic graphs)."""
+    seen = set()
+    order = []
+    stack = list(heads)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        order.append(node)
+        for inp, _ in node.inputs:
+            stack.append(inp)
+    return order
+
+
+def _find_cycle(heads) -> Optional[List]:
+    """Three-color DFS; returns the cycle's nodes in order, or None.
+    A back edge targets a GRAY node, which by construction sits on the
+    current DFS stack — the cycle is the stack suffix from it."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[int, int] = {}
+
+    for head in heads:
+        if color.get(id(head), WHITE) != WHITE:
+            continue
+        stack = [(head, iter(head.inputs))]
+        color[id(head)] = GRAY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for inp, _ in it:
+                c = color.get(id(inp), WHITE)
+                if c == GRAY:
+                    k = next(i for i, (fn, _) in enumerate(stack)
+                             if fn is inp)
+                    return [fn for fn, _ in stack[k:]]
+                if c == WHITE:
+                    color[id(inp)] = GRAY
+                    stack.append((inp, iter(inp.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                color[id(node)] = BLACK
+                stack.pop()
+    return None
+
+
+def _registry_node_checks(nodes, anchor: str) -> List[Finding]:
+    from ..ops.registry import get_op, _signature_facts
+    out: List[Finding] = []
+    custom = _frontend_custom_ops()
+    varkw_by_op: Dict[str, bool] = {}
+    for node in nodes:
+        if node.op is None or node.op in custom:
+            continue
+        try:
+            op = get_op(node.op)
+        except KeyError:
+            out.append(Finding(
+                "MXL106", f"node {node.name!r} uses unregistered operator "
+                f"{node.op!r}", f"{anchor}:{node_path(node)}"))
+            continue
+        if op.num_inputs is not None and len(node.inputs) != op.num_inputs:
+            out.append(Finding(
+                "MXL107", f"node {node.name!r} ({node.op}) has "
+                f"{len(node.inputs)} inputs; registry declares "
+                f"{op.num_inputs}", f"{anchor}:{node_path(node)}"))
+        known = set(op.attr_names) | set(op.scalar_attrs)
+        has_varkw = varkw_by_op.get(node.op)
+        if has_varkw is None:
+            facts = _signature_facts(op.fcompute)
+            # introspection failure means we cannot judge attrs: skip
+            has_varkw = True if facts is None else facts[2]
+            varkw_by_op[node.op] = has_varkw
+        if not has_varkw:
+            for attr in node.attrs:
+                if attr not in known:
+                    out.append(Finding(
+                        "MXL108",
+                        f"node {node.name!r} ({node.op}) carries attr "
+                        f"{attr!r} unknown to the op (known: "
+                        f"{sorted(known)})",
+                        f"{anchor}:{node_path(node)}"))
+    return out
+
+
+def _shape_checks(sym, shapes: Optional[dict], anchor: str) -> List[Finding]:
+    from ..symbol.symbol import _propagate_shapes, _topo
+    out: List[Finding] = []
+    shapes = dict(shapes or {})
+    # honor var(shape=) hints exactly like infer_shape does (inside
+    # _propagate_shapes); afterwards report what could not be validated
+    out_shapes: Dict[Tuple[int, int], tuple] = {}
+    errored = set()
+
+    def on_err(node, ins, exc):
+        errored.add(id(node))
+        msg = str(exc).strip().splitlines()
+        msg = msg[0] if msg else type(exc).__name__
+        if len(msg) > 220:
+            msg = msg[:220] + "..."
+        in_desc = ", ".join("?" if s is None else str(tuple(s))
+                            for s in ins)
+        out.append(Finding(
+            "MXL105", f"node {node.name!r} ({node.op}) rejects input "
+            f"shapes [{in_desc}]: {msg}", f"{anchor}:{node_path(node)}"))
+
+    _propagate_shapes(sym, shapes, on_node_error=on_err,
+                      out_shapes=out_shapes)
+
+    unvalidated = []
+    for node in _topo(sym._head_nodes()):
+        if node.op is None or id(node) in errored:
+            continue
+        if (id(node), 0) not in out_shapes:
+            unvalidated.append(node.name)
+    if unvalidated:
+        shown = unvalidated[:6]
+        more = f" (+{len(unvalidated) - 6} more)" \
+            if len(unvalidated) > 6 else ""
+        out.append(Finding(
+            "MXL109", "input shapes unknown; nodes not shape-validated: "
+            + ", ".join(shown) + more, anchor))
+    return out
+
+
+def analyze_symbol(sym, shapes: Optional[dict] = None,
+                   check_shapes: bool = True,
+                   name: str = "graph") -> List[Finding]:
+    """Run every graph pass over a Symbol.
+
+    ``shapes``: optional {input_name: shape} for the contract validator
+    (``var(shape=)`` hints are honored automatically).  Returns findings;
+    an empty list means the graph lints clean.
+    """
+    heads = sym._head_nodes()
+    findings: List[Finding] = []
+
+    cyc = _find_cycle(heads)
+    if cyc is not None:
+        path = " -> ".join(n.name for n in cyc) + f" -> {cyc[0].name}"
+        findings.append(Finding(
+            "MXL101", f"cycle: {path}", f"{name}:{cyc[0].name}"))
+
+    nodes = _collect_nodes(heads)
+    by_name: Dict[str, int] = {}
+    for n in nodes:
+        by_name[n.name] = by_name.get(n.name, 0) + 1
+    for nm, cnt in sorted(by_name.items()):
+        if cnt > 1:
+            findings.append(Finding(
+                "MXL102", f"{cnt} nodes share the name {nm!r}; "
+                "save/load and arg binding key on unique names",
+                f"{name}:{nm}"))
+
+    findings.extend(_registry_node_checks(nodes, name))
+
+    # abstract evaluation only makes sense on a structurally sound DAG
+    structural_errors = any(f.rule in ("MXL101", "MXL106", "MXL107")
+                            for f in findings)
+    if check_shapes and not structural_errors:
+        findings.extend(_shape_checks(sym, shapes, name))
+    return findings
+
+
+def analyze_graph_json(json_str: str, shapes: Optional[dict] = None,
+                       check_shapes: bool = True,
+                       name: str = "<json>") -> List[Finding]:
+    """Lint a serialized graph (``Symbol.tojson`` / ``save`` output).
+
+    Runs the structural passes directly on the node table — so cycles and
+    dangling references that ``load_json`` itself would choke on are
+    reported as findings, not exceptions — then (if sound) loads the
+    Symbol and runs the registry/shape passes.
+    """
+    findings: List[Finding] = []
+    try:
+        data = json.loads(json_str)
+        nodes = data["nodes"]
+        heads = data["heads"]
+    except (ValueError, KeyError, TypeError) as e:
+        return [Finding("MXL110", f"unparseable graph JSON: {e}", name)]
+    if not isinstance(nodes, list) or not isinstance(heads, list):
+        return [Finding("MXL110", "'nodes' and 'heads' must be lists",
+                        name)]
+
+    def _ref_index(ref):
+        """Node index of an [idx, out_idx, version] edge ref, or None."""
+        if isinstance(ref, (list, tuple)) and ref and \
+                isinstance(ref[0], int) and 0 <= ref[0] < n:
+            return ref[0]
+        return None
+
+    n = len(nodes)
+    edges: List[List[int]] = []
+    for i, jn in enumerate(nodes):
+        if not isinstance(jn, dict):
+            findings.append(Finding(
+                "MXL110", f"node entry {i} is not an object: {jn!r}",
+                f"{name}:node{i}"))
+            edges.append([])
+            continue
+        ins = []
+        for ref in jn.get("inputs", []):
+            j = _ref_index(ref)
+            if j is None:
+                findings.append(Finding(
+                    "MXL110", f"node {jn.get('name', i)!r} has a bad "
+                    f"input ref {ref!r}", f"{name}:node{i}"))
+            else:
+                ins.append(j)
+        edges.append(ins)
+    for ref in heads:
+        if _ref_index(ref) is None:
+            findings.append(Finding(
+                "MXL110", f"bad head ref {ref!r}", name))
+    if any(f.rule == "MXL110" for f in findings):
+        return findings
+
+    # cycle over the index graph (serialized graphs may be hand-edited)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = [WHITE] * n
+    cyc_at = None
+    for s in range(n):
+        if color[s] != WHITE:
+            continue
+        stack = [(s, iter(edges[s]))]
+        color[s] = GRAY
+        while stack and cyc_at is None:
+            i, it = stack[-1]
+            advanced = False
+            for j in it:
+                if color[j] == GRAY:
+                    cyc_at = j
+                    break
+                if color[j] == WHITE:
+                    color[j] = GRAY
+                    stack.append((j, iter(edges[j])))
+                    advanced = True
+                    break
+            if cyc_at is None and not advanced:
+                color[i] = BLACK
+                stack.pop()
+        if cyc_at is not None:
+            break
+    if cyc_at is not None:
+        findings.append(Finding(
+            "MXL101", f"cycle through node "
+            f"{nodes[cyc_at].get('name', cyc_at)!r}",
+            f"{name}:{nodes[cyc_at].get('name', cyc_at)}"))
+        return findings  # load_json would recurse into the cycle
+
+    # reachability from heads
+    reach = set()
+    stack = [ref[0] for ref in heads]
+    while stack:
+        i = stack.pop()
+        if i in reach:
+            continue
+        reach.add(i)
+        stack.extend(edges[i])
+    for i, jn in enumerate(nodes):
+        if i in reach:
+            continue
+        if jn.get("op", "null") == "null":
+            findings.append(Finding(
+                "MXL104", f"variable {jn.get('name', i)!r} is not consumed "
+                "by any head", f"{name}:{jn.get('name', i)}"))
+        else:
+            findings.append(Finding(
+                "MXL103", f"node {jn.get('name', i)!r} "
+                f"({jn.get('op')}) is unreachable from every head",
+                f"{name}:{jn.get('name', i)}"))
+
+    from ..symbol.symbol import load_json
+    try:
+        sym = load_json(json_str)
+    except Exception as e:
+        findings.append(Finding(
+            "MXL110", f"load_json failed: {e}", name))
+        return findings
+    findings.extend(analyze_symbol(sym, shapes=shapes,
+                                   check_shapes=check_shapes, name=name))
+    return findings

@@ -1,0 +1,110 @@
+"""Registry passes over every OpDef (rules MXL2xx).
+
+``registry.register()`` enforces the signature contracts at registration
+time; mxlint re-runs the same checks offline (catching OpDefs built by
+hand or monkeypatched in tests) and adds the checks registration cannot
+do cheaply: nd/sym namespace symmetry, alias integrity, best-effort
+``num_outputs`` verification against literal tuple returns, and
+unhashable default attrs (which silently degrade the jit-cache key to
+the recursive ``_freeze`` path or duplicate cache entries).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Optional
+
+from .findings import Finding
+
+__all__ = ["analyze_registry", "analyze_opdef"]
+
+
+def _tuple_return_len(fcompute) -> Optional[int]:
+    """If every ``return`` in fcompute is a tuple literal of one
+    consistent length, return that length; None when undecidable
+    (helpers, conditionals returning names, lambdas, partials)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fcompute))
+        tree = ast.parse(src)
+    except (TypeError, OSError, SyntaxError, IndentationError, ValueError):
+        return None
+    fns = [n for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    if len(fns) != 1:
+        return None
+    returns = [n for n in ast.walk(fns[0]) if isinstance(n, ast.Return)]
+    if not returns:
+        return None
+    lengths = set()
+    for r in returns:
+        if not isinstance(r.value, ast.Tuple):
+            return None
+        if any(isinstance(e, ast.Starred) for e in r.value.elts):
+            return None
+        lengths.add(len(r.value.elts))
+    return lengths.pop() if len(lengths) == 1 else None
+
+
+def analyze_opdef(op, anchor: Optional[str] = None) -> List[Finding]:
+    """MXL201-204/206 for one OpDef."""
+    from ..ops.registry import validate_opdef
+    anchor = anchor or f"op:{op.name}"
+    out: List[Finding] = []
+    kind_to_rule = {"arity": "MXL201", "scalar_attrs": "MXL202",
+                    "scalar_ref_input": "MXL203", "num_outputs": "MXL204"}
+    for kind, problem in validate_opdef(op):
+        out.append(Finding(kind_to_rule[kind], problem, anchor))
+
+    n_ret = _tuple_return_len(op.fcompute)
+    if n_ret is not None and op.num_outputs not in (-1, n_ret) \
+            and n_ret > 1:
+        out.append(Finding(
+            "MXL204", f"fcompute returns a {n_ret}-tuple on every path "
+            f"but num_outputs={op.num_outputs}", anchor))
+
+    try:
+        sig = inspect.signature(op.fcompute)
+    except (TypeError, ValueError):
+        return out
+    for p in sig.parameters.values():
+        if p.default is inspect.Parameter.empty:
+            continue
+        try:
+            hash(p.default)
+        except TypeError:
+            out.append(Finding(
+                "MXL206", f"default {p.name}={p.default!r} is unhashable: "
+                "every call pays the recursive _freeze key path (or "
+                "duplicates jit-cache entries per call site)", anchor))
+    return out
+
+
+def analyze_registry() -> List[Finding]:
+    """Run every registry pass over the live op registry."""
+    from ..ops.registry import _ALIASES, _REGISTRY
+    findings: List[Finding] = []
+    for name in sorted(_REGISTRY):
+        findings.extend(analyze_opdef(_REGISTRY[name]))
+
+    for alias_name, target in sorted(_ALIASES.items()):
+        if target not in _REGISTRY:
+            findings.append(Finding(
+                "MXL207", f"alias {alias_name!r} targets unregistered op "
+                f"{target!r}", f"op:{alias_name}"))
+
+    # nd/sym namespace symmetry: the reference codegens both frontends
+    # from one registry; an op visible in only one namespace breaks
+    # hybridize (imperative call works, symbolic trace AttributeErrors)
+    from .. import ndarray as nd_mod
+    from .. import symbol as sym_mod
+    from ..ops.registry import list_ops
+    for name in list_ops():
+        in_nd = hasattr(nd_mod, name)
+        in_sym = hasattr(sym_mod, name)
+        if in_nd != in_sym:
+            where = "nd only" if in_nd else "sym only"
+            findings.append(Finding(
+                "MXL205", f"op {name!r} exposed in {where}; hybridized "
+                "blocks need both namespaces", f"op:{name}"))
+    return findings

@@ -1,0 +1,51 @@
+"""Runtime-hazard pass (rule MXL4xx): jit-cache key blowup.
+
+The static source pass (MXL303) predicts retrace storms; this pass
+*observes* them: after running a workload, ``engine.cache_info()`` shows
+how many distinct executables each op compiled.  An op with many cache
+entries whose keys differ only in the values of one or two attrs is
+recompiling per value — the attr should ride the dynamic-scalar path
+(``scalar_attrs``) or be hoisted to a constant.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding
+
+__all__ = ["analyze_cache"]
+
+
+def analyze_cache(threshold: int = 8) -> List[Finding]:
+    """Flag ops whose jit-cache entry count exceeds ``threshold``.
+
+    Shape/dtype-driven re-specialization also multiplies entries (that is
+    healthy and unavoidable), so the message names the varying attrs when
+    the blowup is attributable to attr values — the actionable case.
+    """
+    from .. import engine
+    info = engine.cache_info()
+    findings: List[Finding] = []
+    for name, sigs in sorted(info["ops"].items()):
+        if len(sigs) <= threshold:
+            continue
+        # which attr names take multiple distinct values across entries?
+        values_by_attr = {}
+        for sig in sigs:
+            try:
+                items = list(sig)
+            except TypeError:
+                items = []
+            for kv in items:
+                if isinstance(kv, tuple) and len(kv) == 2:
+                    values_by_attr.setdefault(kv[0], set()).add(kv[1])
+        varying = sorted(a for a, vals in values_by_attr.items()
+                         if len(vals) > 1)
+        detail = (f"; attr(s) {', '.join(varying)} vary across entries — "
+                  "candidates for scalar_attrs") if varying else \
+            " (distinct attr signatures; check call sites)"
+        findings.append(Finding(
+            "MXL401", f"op {name!r} holds {len(sigs)} compiled cache "
+            f"entries (threshold {threshold}){detail}",
+            f"cache:{name}"))
+    return findings

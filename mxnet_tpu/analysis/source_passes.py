@@ -1,0 +1,271 @@
+"""Source passes (rules MXL3xx): retrace / host-sync hazard detection.
+
+The Julia→TPU compiler paper's lesson applied at authoring time: on an
+XLA target the expensive mistakes are *host synchronization inside the
+step loop* (``asnumpy()`` forces a device round-trip per iteration,
+serializing the async engine) and *Python-scalar static attrs that vary
+per step* (every new value re-traces and re-compiles the kernel — the
+"retrace storm").  Both are visible in the AST without running anything.
+
+Heuristics are deliberately scoped to keep the signal high:
+
+* MXL301 fires only inside loops that look like training loops (the loop
+  body calls ``backward``/``step`` or opens ``autograd.record()``).
+* MXL302 fires for syncs anywhere inside a ``hybrid_forward`` body —
+  hybridized tracing turns these into per-call retraces or outright
+  tracer errors.
+* MXL303 fires when a registered op is called inside a loop with a
+  *static* attr (keyword-only in the fcompute signature) whose value
+  references a name the loop itself changes — the jit cache keys on the
+  value, so each step compiles a fresh executable.  The fix is usually
+  declaring the attr in ``scalar_attrs``.
+
+Suppress any rule on a line with ``# mxlint: disable=MXL301`` (comma-
+separated IDs) or every rule with a bare ``# mxlint: disable``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Set
+
+from .findings import Finding
+
+__all__ = ["analyze_source", "analyze_file", "analyze_paths"]
+
+_SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read", "item", "tolist"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+_OP_NAMESPACES = {"nd", "F", "sym", "ndarray", "symbol"}
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+
+
+def _attr_chain(node) -> List[str]:
+    """['mx', 'nd', 'zeros'] for mx.nd.zeros; [] when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _is_sync_call(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _SYNC_METHODS:
+        return f".{call.func.attr}()"
+    return None
+
+
+def _is_cast_sync(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name) and \
+            call.func.id in _CAST_BUILTINS and len(call.args) == 1 and \
+            not isinstance(call.args[0], ast.Constant):
+        return f"{call.func.id}(...)"
+    return None
+
+
+def _training_markers(loop) -> bool:
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("backward", "step"):
+                return True
+            chain = _attr_chain(sub.func)
+            if chain and chain[-1] == "record":
+                return True
+    return False
+
+
+def _loop_varying_names(loop) -> Set[str]:
+    """Names the loop changes: induction targets + assignment targets in
+    the body (these are the candidates for per-step attr values)."""
+    names: Set[str] = set()
+
+    def targets_of(t):
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+
+    if isinstance(loop, ast.For):
+        targets_of(loop.target)
+    for sub in ast.walk(loop):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+            tgts = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in tgts:
+                targets_of(t)
+        elif isinstance(sub, ast.AugAssign):
+            targets_of(sub.target)
+    return names
+
+
+def _get_op(opname: str):
+    try:
+        from ..ops.registry import get_op
+        return get_op(opname)
+    except Exception:
+        return None
+
+
+class _SourceVisitor(ast.NodeVisitor):
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._loops: List[dict] = []       # {training, varying}
+        self._hybrid_depth = 0
+
+    # -- helpers ---------------------------------------------------------
+    def _loc(self, node) -> str:
+        return f"{self.filename}:{node.lineno}"
+
+    def _in_training_loop(self) -> bool:
+        return any(l["training"] for l in self._loops)
+
+    def _varying(self) -> Set[str]:
+        out: Set[str] = set()
+        for l in self._loops:
+            out |= l["varying"]
+        return out
+
+    # -- structure -------------------------------------------------------
+    def _visit_loop(self, node):
+        self._loops.append({"training": _training_markers(node),
+                            "varying": _loop_varying_names(node)})
+        self.generic_visit(node)
+        self._loops.pop()
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_FunctionDef(self, node):
+        if node.name == "hybrid_forward":
+            self._hybrid_depth += 1
+            # a fresh function body is not part of the enclosing loop
+            saved, self._loops = self._loops, []
+            self.generic_visit(node)
+            self._loops = saved
+            self._hybrid_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- calls -----------------------------------------------------------
+    def visit_Call(self, node):
+        sync = _is_sync_call(node)
+        if sync is not None:
+            if self._hybrid_depth:
+                self.findings.append(Finding(
+                    "MXL302", f"{sync} inside hybrid_forward: breaks or "
+                    "retraces the hybridized graph; compute on-device and "
+                    "sync outside the block", self._loc(node)))
+            elif self._in_training_loop():
+                self.findings.append(Finding(
+                    "MXL301", f"{sync} inside a training loop forces a "
+                    "host sync every step; accumulate on-device and sync "
+                    "once per epoch/log interval", self._loc(node)))
+        elif self._in_training_loop():
+            # cast-syncs are only flagged in training loops; inside
+            # hybrid_forward int()/float() legitimately fold shapes and
+            # would be all noise
+            cast = _is_cast_sync(node)
+            if cast is not None:
+                self.findings.append(Finding(
+                    "MXL301", f"{cast} on an array inside a training loop "
+                    "is an implicit device sync (host scalar "
+                    "conversion)", self._loc(node)))
+
+        if self._loops:
+            self._check_per_step_attrs(node)
+        self.generic_visit(node)
+
+    def _check_per_step_attrs(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        if len(chain) < 2 or chain[-2] not in _OP_NAMESPACES:
+            return
+        op = _get_op(chain[-1])
+        if op is None or not node.keywords:
+            return
+        varying = self._varying()
+        if not varying:
+            return
+        static_attrs = set(op.attr_names) - set(op.scalar_attrs)
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg not in static_attrs:
+                continue
+            if isinstance(kw.value, ast.Constant):
+                continue
+            used = {n.id for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Name)}
+            hit = used & varying
+            if hit:
+                self.findings.append(Finding(
+                    "MXL303", f"{chain[-1]}(..., {kw.arg}=...) passes a "
+                    f"per-step value ({', '.join(sorted(hit))}) as a "
+                    "STATIC attr: the jit cache keys on it, recompiling "
+                    "every iteration; declare it in scalar_attrs or hoist "
+                    "it out of the loop", self._loc(node)))
+
+
+def _apply_suppressions(findings: List[Finding], text: str) -> List[Finding]:
+    lines = text.splitlines()
+    out = []
+    for f in findings:
+        try:
+            lineno = int(f.location.rsplit(":", 1)[1])
+            line = lines[lineno - 1]
+        except (IndexError, ValueError):
+            out.append(f)
+            continue
+        m = _DISABLE_RE.search(line)
+        if m is None:
+            out.append(f)
+            continue
+        if m.group(1) is None:
+            continue  # bare disable: every rule
+        ids = {s.strip() for s in m.group(1).split(",")}
+        if f.rule not in ids:
+            out.append(f)
+    return out
+
+
+def analyze_source(text: str, filename: str = "<string>") -> List[Finding]:
+    """Lint one Python source text."""
+    try:
+        tree = ast.parse(text, filename=filename)
+    except SyntaxError:
+        # not our diagnostic to own — report nothing; CI's own syntax
+        # gates catch it
+        return []
+    v = _SourceVisitor(filename)
+    v.visit(tree)
+    return _apply_suppressions(v.findings, text)
+
+
+def analyze_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return analyze_source(f.read(), filename=path)
+
+
+def analyze_paths(paths, exts=(".py",)) -> List[Finding]:
+    """Walk files/directories; lint every matching source file."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in sorted(dirs)
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(tuple(exts)):
+                        findings.extend(
+                            analyze_file(os.path.join(root, fn)))
+        elif p.endswith(".json"):
+            from .graph_passes import analyze_graph_json
+            with open(p, encoding="utf-8") as f:
+                findings.extend(analyze_graph_json(f.read(), name=p))
+        else:
+            findings.extend(analyze_file(p))
+    return findings

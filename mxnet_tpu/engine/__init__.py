@@ -26,7 +26,8 @@ import threading
 import weakref
 from typing import Any, Callable, Dict, Tuple
 
-__all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size"]
+__all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
+           "cache_info", "cache_size", "clear_cache"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -146,9 +147,40 @@ def cache_size() -> int:
     return len(_jit_cache)
 
 
+def cache_info() -> dict:
+    """Introspect the jit-cache and live-buffer tracking.
+
+    Returns ``{"size", "live_buffers", "engine", "ops"}`` where ``ops``
+    maps op name -> list of attr signatures (one per cached executable;
+    ``()`` for the attr-less fast path).  mxlint's runtime-hazard report
+    reads this to surface cache-key blowup: one op accumulating many
+    entries that differ only in a numeric attr value is the retrace-storm
+    signature (the fix is usually ``scalar_attrs``).
+    """
+    per_op: Dict[str, list] = {}
+    with _lock:
+        keys = list(_jit_cache)
+    for key in keys:
+        if isinstance(key, str):
+            per_op.setdefault(key, []).append(())
+        else:
+            name, attrs = key
+            per_op.setdefault(name, []).append(attrs)
+    return {"size": len(keys), "live_buffers": len(_live),
+            "engine": "NaiveEngine" if is_naive() else "ThreadedEngine",
+            "ops": per_op}
+
+
 def clear_cache():
     with _lock:
         _jit_cache.clear()
+
+
+def _reset_naive():
+    """Forget the cached engine-type choice so the next ``is_naive()``
+    re-reads the env vars — for tests that flip MXTPU_ENGINE_TYPE."""
+    global _NAIVE
+    _NAIVE = None
 
 
 _bulk_size = 0

@@ -737,6 +737,29 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
+    def lint(self, *input_shapes, names=None):
+        """Run the mxlint graph passes (``mxnet_tpu.analysis``) over this
+        block's traced graph — the same ``block(sym.var(...))`` seam
+        ``export()`` serializes — without executing anything on device.
+
+        ``input_shapes`` (optional) enables the MXL105 shape/dtype
+        contract validator; ``names`` overrides the default input names
+        (``data`` / ``data0..N``).  Returns the list of findings (empty
+        = clean).  Imperative-only blocks (those reading ``x.shape``
+        inside ``hybrid_forward``) cannot be traced and raise, exactly
+        as ``export()`` would fail for them.
+        """
+        from .. import analysis
+        from .. import symbol as sym_mod
+        n = max(len(input_shapes), 1)
+        names = list(names) if names else (
+            ["data"] if n == 1 else [f"data{i}" for i in range(n)])
+        out = self(*[sym_mod.var(nm) for nm in names])
+        shapes = dict(zip(names, input_shapes)) if input_shapes else None
+        return analysis.analyze_symbol(
+            out, shapes=shapes, check_shapes=bool(input_shapes),
+            name=self.name or type(self).__name__)
+
     def export(self, path, epoch=0, remove_amp_cast=True):
         """Export (parity: HybridBlock.export): writes
         ``path-symbol.json`` (the traced graph — load with

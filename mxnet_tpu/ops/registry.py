@@ -18,7 +18,8 @@ from __future__ import annotations
 import inspect
 from typing import Callable, Dict, Optional, Sequence
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias",
+           "validate_opdef"]
 
 
 class OpDef:
@@ -90,16 +91,91 @@ _REGISTRY: Dict[str, OpDef] = {}
 _ALIASES: Dict[str, str] = {}
 
 
+def _signature_facts(fcompute: Callable):
+    """(positional param names, has *args, has **kwargs), or None when the
+    callable defeats introspection (C builtins)."""
+    try:
+        sig = inspect.signature(fcompute)
+    except (TypeError, ValueError):
+        return None
+    params = list(sig.parameters.values())
+    pos = [p.name for p in params
+           if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    has_varpos = any(p.kind == p.VAR_POSITIONAL for p in params)
+    has_varkw = any(p.kind == p.VAR_KEYWORD for p in params)
+    return pos, has_varpos, has_varkw
+
+
+def validate_opdef(op: OpDef):
+    """Contract checks between an OpDef and its fcompute signature.
+
+    Returns a list of ``(kind, message)`` violations (empty = valid),
+    where ``kind`` is one of ``"arity"``, ``"scalar_attrs"``,
+    ``"scalar_ref_input"``, ``"num_outputs"`` — a stable tag the static
+    analyzer maps to its rule IDs (never dispatch on the prose).
+    ``register()`` raises on any; ``mxnet_tpu.analysis`` re-runs the same
+    checks offline so hand-built / monkeypatched OpDefs are caught by
+    mxlint too.
+    """
+    problems = []
+    if op.num_outputs == 0 or op.num_outputs < -1:
+        problems.append((
+            "num_outputs",
+            f"num_outputs must be >= 1 (or -1 for dynamic), got "
+            f"{op.num_outputs}"))
+    ns = len(op.scalar_attrs)
+    if ns and op.scalar_ref_input is not None:
+        if op.num_inputs is not None and not \
+                (0 <= op.scalar_ref_input < op.num_inputs):
+            problems.append((
+                "scalar_ref_input",
+                f"scalar_ref_input={op.scalar_ref_input} out of bounds "
+                f"for num_inputs={op.num_inputs}"))
+    facts = _signature_facts(op.fcompute)
+    if facts is None:
+        return problems
+    pos, has_varpos, _ = facts
+    if not has_varpos:
+        # scalar attrs bind POSITIONALLY after the tensor inputs: the
+        # trailing positional params must carry exactly these names, or
+        # scalar_defaults lookup and named-input mapping silently miss
+        if ns:
+            trailing = tuple(pos[len(pos) - ns:]) if len(pos) >= ns else ()
+            if trailing != tuple(op.scalar_attrs):
+                problems.append((
+                    "scalar_attrs",
+                    f"scalar_attrs {tuple(op.scalar_attrs)} must name the "
+                    f"trailing positional params, got {trailing}"))
+        if op.num_inputs is not None and len(pos) != op.num_inputs + ns:
+            problems.append((
+                "arity",
+                f"fcompute has {len(pos)} positional params; expected "
+                f"num_inputs ({op.num_inputs}) + scalar_attrs ({ns})"))
+    return problems
+
+
 def register(name: str, num_inputs: Optional[int] = 1, num_outputs: int = 1,
              scalar_attrs: Sequence[str] = (), wrap_ctx: bool = False,
              scalar_ref_input: Optional[int] = 0):
-    """Decorator: register ``fcompute`` as operator ``name``."""
+    """Decorator: register ``fcompute`` as operator ``name``.
+
+    Fails fast on contract violations (see ``validate_opdef``): a bad
+    ``scalar_ref_input`` or a ``scalar_attrs`` name that does not match
+    the fcompute signature would otherwise surface much later as a wrong
+    value silently bound to the wrong parameter.
+    """
 
     def deco(fn: Callable) -> Callable:
         if name in _REGISTRY:
             raise ValueError(f"op {name!r} registered twice")
-        _REGISTRY[name] = OpDef(name, fn, num_inputs, num_outputs,
-                                scalar_attrs, wrap_ctx, scalar_ref_input)
+        op = OpDef(name, fn, num_inputs, num_outputs,
+                   scalar_attrs, wrap_ctx, scalar_ref_input)
+        problems = validate_opdef(op)
+        if problems:
+            raise ValueError(
+                f"op {name!r} registration invalid: "
+                + "; ".join(msg for _, msg in problems))
+        _REGISTRY[name] = op
         return fn
 
     return deco

@@ -181,8 +181,12 @@ _SCALAR_BIN = {
 }
 
 for _name, _fn in _SCALAR_BIN.items():
+    # the positional param carrying the dynamic scalar must be NAMED
+    # "scalar" to match scalar_attrs (register() enforces this: the
+    # frontend maps scalar kwargs/defaults to positions by name)
     register(_name, num_inputs=1, scalar_attrs=("scalar",))(
-        functools.partial(lambda x, s, _f=None: _f(x, s), _f=_fn))
+        functools.partial(lambda x, scalar, _f=None: _f(x, scalar),
+                          _f=_fn))
 
 
 # ---------------------------------------------------------------------------

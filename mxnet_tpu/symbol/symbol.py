@@ -508,14 +508,23 @@ def _param_shape_rules():
 _PARAM_SHAPE_RULES = _param_shape_rules()
 
 
-def _propagate_shapes(sym, shapes):
+def _propagate_shapes(sym, shapes, on_node_error=None, out_shapes=None):
     """Walk the graph in topo order, inferring unknown var shapes via the
-    param rules and node output shapes via jax.eval_shape per node."""
+    param rules and node output shapes via jax.eval_shape per node.
+
+    ``on_node_error(node, in_shapes, exc)`` is invoked when a node's
+    abstract evaluation raises (shape/dtype contract violation); the
+    default keeps the historical behavior of skipping the node silently.
+    ``out_shapes`` may be a dict to receive the per-(node, output-index)
+    inferred shapes — the static analyzer uses it to tell "skipped
+    because inputs unknown" from "evaluated clean".
+    """
     import jax
     from .. import autograd
     from .. import ndarray as nd_mod
 
-    out_shapes: Dict[Tuple[int, int], tuple] = {}
+    if out_shapes is None:
+        out_shapes: Dict[Tuple[int, int], tuple] = {}
 
     def in_shape(node, i):
         inp, oi = node.inputs[i]
@@ -566,7 +575,9 @@ def _propagate_shapes(sym, shapes):
             for i, r in enumerate(res):
                 out_shapes[(id(node), i)] = tuple(
                     int(d) for d in r.shape)
-        except Exception:
+        except Exception as e:
+            if on_node_error is not None:
+                on_node_error(node, ins, e)
             continue
     return shapes
 

@@ -1,0 +1,390 @@
+"""mxlint static-analyzer tests (tier-1).
+
+Covers the three pass families over their contract surfaces:
+
+* registry passes over every live OpDef + registration fail-fast;
+* graph passes over the shipped model corpus (must lint clean) and a
+  seeded-defect corpus (must be 100% caught);
+* source passes over retrace/sync hazard snippets;
+* the runtime cache pass against engine.cache_info();
+* the CLI ``--self-check`` gate (the tier-1 CI wiring).
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, nd, sym
+from mxnet_tpu import engine
+from mxnet_tpu.ops.registry import OpDef, register, _REGISTRY
+from mxnet_tpu.symbol.symbol import _invoke
+
+
+# ---------------------------------------------------------------------------
+# registry passes
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryPasses:
+    def test_live_registry_lints_clean(self):
+        findings = analysis.analyze_registry()
+        errors = [f for f in findings if f.severity == "error"]
+        assert errors == [], "\n".join(f.format() for f in errors)
+
+    def test_register_rejects_bad_scalar_ref(self):
+        with pytest.raises(ValueError, match="scalar_ref_input"):
+            @register("_mxl_bad_ref", num_inputs=1,
+                      scalar_attrs=("lr",), scalar_ref_input=5)
+            def _bad(x, lr):
+                return x * lr
+        assert "_mxl_bad_ref" not in _REGISTRY
+
+    def test_register_rejects_scalar_name_mismatch(self):
+        with pytest.raises(ValueError, match="scalar_attrs"):
+            @register("_mxl_bad_scal", num_inputs=1,
+                      scalar_attrs=("scalar",))
+            def _bad(x, s):
+                return x * s
+        assert "_mxl_bad_scal" not in _REGISTRY
+
+    def test_register_rejects_arity_mismatch(self):
+        with pytest.raises(ValueError, match="positional"):
+            @register("_mxl_bad_arity", num_inputs=3)
+            def _bad(x, y):
+                return x + y
+        assert "_mxl_bad_arity" not in _REGISTRY
+
+    def test_analyze_opdef_seeded_defects(self):
+        # built directly (bypassing register's fail-fast), the offline
+        # pass must report each contract break
+        def f(x, lr):
+            return x * lr
+
+        op = OpDef("_mxl_hand", f, num_inputs=1, num_outputs=1,
+                   scalar_attrs=("lr",), wrap_ctx=False,
+                   scalar_ref_input=7)
+        rules = {fi.rule for fi in analysis.analyze_opdef(op)}
+        assert "MXL203" in rules
+
+        op = OpDef("_mxl_hand2", f, num_inputs=3, num_outputs=1,
+                   scalar_attrs=(), wrap_ctx=False, scalar_ref_input=0)
+        rules = {fi.rule for fi in analysis.analyze_opdef(op)}
+        assert "MXL201" in rules
+
+    def test_unhashable_default_flagged(self):
+        def f(x, *, taps=[0, 1]):  # noqa: B006 — the defect under test
+            return x
+
+        op = OpDef("_mxl_unhash", f, num_inputs=1, num_outputs=1,
+                   scalar_attrs=(), wrap_ctx=False, scalar_ref_input=0)
+        rules = {fi.rule for fi in analysis.analyze_opdef(op)}
+        assert "MXL206" in rules
+
+
+# ---------------------------------------------------------------------------
+# graph passes
+# ---------------------------------------------------------------------------
+
+
+def _clean_fixture_symbols():
+    """The round-tripped clean corpus: every builtin symbol serialized
+    and reloaded (mirrors test_symbol_module round-trip coverage)."""
+    out = []
+    for name, s, shapes in analysis.builtin_symbols():
+        out.append((name + ":roundtrip", sym.load_json(s.tojson()),
+                    shapes))
+    return out
+
+
+class TestGraphPasses:
+    def test_builtin_corpus_clean(self):
+        for name, s, shapes in analysis.builtin_symbols():
+            findings = analysis.analyze_symbol(s, shapes=shapes,
+                                               name=name)
+            assert findings == [], \
+                "\n".join(f.format() for f in findings)
+
+    def test_roundtripped_corpus_clean(self):
+        for name, s, shapes in _clean_fixture_symbols():
+            findings = analysis.analyze_symbol(s, shapes=shapes,
+                                               name=name)
+            assert findings == [], \
+                "\n".join(f.format() for f in findings)
+
+    def test_model_zoo_symbol_clean(self):
+        for name, s, shapes in analysis.traced_model_symbols():
+            findings = analysis.analyze_symbol(s, shapes=shapes,
+                                               name=name)
+            errors = [f for f in findings if f.severity == "error"]
+            assert errors == [], \
+                "\n".join(f.format() for f in errors)
+
+    # -- seeded defects: 100% must be caught ----------------------------
+    def test_cycle_caught(self):
+        a = sym.var("a")
+        s1 = sym.relu(a, name="n1")
+        s2 = sym.sigmoid(s1, name="n2")
+        # wire the cycle the way a hand-edited graph would
+        s1._outputs[0][0].inputs.append((s2._outputs[0][0], 0))
+        rules = {f.rule for f in analysis.analyze_symbol(s2, name="cyc")}
+        assert "MXL101" in rules
+
+    def test_arity_mismatch_caught(self):
+        bad = _invoke("dot", [sym.var("x")], {})
+        rules = {f.rule for f in analysis.analyze_symbol(bad)}
+        assert rules == {"MXL107"}
+
+    def test_shape_conflict_caught_with_path(self):
+        x, y = sym.var("x"), sym.var("y")
+        h = sym.relu(x, name="pre")
+        d = _invoke("dot", [h, y], {}, name="mm")
+        findings = analysis.analyze_symbol(
+            d, shapes={"x": (2, 3), "y": (2, 3)}, name="g")
+        assert [f.rule for f in findings] == ["MXL105"]
+        # diagnostic carries the node path and the offending shapes
+        assert "x -> pre -> mm" in findings[0].location
+        assert "(2, 3)" in findings[0].message
+
+    def test_broadcast_conflict_caught(self):
+        a, b = sym.var("a"), sym.var("b")
+        s = a + b
+        findings = analysis.analyze_symbol(
+            s, shapes={"a": (2, 3), "b": (4, 5)})
+        assert [f.rule for f in findings] == ["MXL105"]
+
+    def test_unknown_op_and_attr_caught(self):
+        u = _invoke("_mxl_no_such_op", [sym.var("q")], {})
+        assert {f.rule for f in analysis.analyze_symbol(u)} == {"MXL106"}
+        w = _invoke("relu", [sym.var("q")], {"bogus": 1})
+        rules = {f.rule for f in analysis.analyze_symbol(
+            w, check_shapes=False)}
+        assert rules == {"MXL108"}
+
+    def test_duplicate_names_caught(self):
+        q = sym.var("n")
+        r = sym.relu(q, name="n")
+        rules = {f.rule for f in analysis.analyze_symbol(
+            r, check_shapes=False)}
+        assert rules == {"MXL102"}
+
+    def test_hybrid_block_lint(self):
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"))
+        net.add(mx.gluon.nn.Dense(2, in_units=8))
+        net.initialize()
+        assert net.lint((3, 4)) == []
+
+        class Bad(mx.gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.dot(x, x)  # (3, 4) x (3, 4): contract mismatch
+
+        findings = Bad().lint((3, 4))
+        assert [f.rule for f in findings] == ["MXL105"]
+
+    def test_json_cycle_and_dead_nodes_caught(self):
+        a = sym.var("a")
+        r = sym.relu(a, name="r")
+        base = json.loads(r.tojson())
+        ri = next(i for i, n in enumerate(base["nodes"])
+                  if n["name"] == "r")
+
+        cyc = json.loads(r.tojson())
+        cyc["nodes"][ri]["inputs"] = [[ri, 0, 0]]
+        rules = {f.rule
+                 for f in analysis.analyze_graph_json(json.dumps(cyc))}
+        assert "MXL101" in rules
+
+        dead = json.loads(r.tojson())
+        dead["nodes"].append({"op": "null", "name": "orphan",
+                              "attrs": {}, "inputs": [],
+                              "num_outputs": 1})
+        dead["nodes"].append({"op": "sigmoid", "name": "dead1",
+                              "attrs": {},
+                              "inputs": [[len(dead["nodes"]) - 1, 0, 0]],
+                              "num_outputs": 1})
+        rules = {f.rule for f in analysis.analyze_graph_json(
+            json.dumps(dead), check_shapes=False)}
+        assert {"MXL103", "MXL104"} <= rules
+
+        bad = json.loads(r.tojson())
+        bad["nodes"][ri]["inputs"] = [[99, 0, 0]]
+        rules = {f.rule
+                 for f in analysis.analyze_graph_json(json.dumps(bad))}
+        assert rules == {"MXL110"}
+
+
+# ---------------------------------------------------------------------------
+# source passes
+# ---------------------------------------------------------------------------
+
+
+_TRAIN_LOOP = '''
+import mxnet_tpu as mx
+def train(net, data, trainer):
+    for x, y in data:
+        with mx.autograd.record():
+            loss = net(x)
+        loss.backward()
+        trainer.step(1)
+        print(loss.asnumpy())
+        lr = float(loss)
+'''
+
+_HYBRID = '''
+class M:
+    def hybrid_forward(self, F, x):
+        s = x.asnumpy().sum()
+        return F.relu(x)
+'''
+
+_PER_STEP_ATTR = '''
+def gen(F, xs):
+    out = []
+    for t in range(8):
+        out.append(F.rope(xs, offset=t))
+        out.append(F.slice_axis(xs, begin=t, end=None, axis=0))
+    return out
+'''
+
+
+class TestSourcePasses:
+    def test_training_loop_sync_flagged(self):
+        rules = [f.rule for f in analysis.analyze_source(_TRAIN_LOOP)]
+        assert rules.count("MXL301") == 2
+
+    def test_eval_loop_not_flagged(self):
+        src = _TRAIN_LOOP.replace("loss.backward()", "pass") \
+                         .replace("trainer.step(1)", "pass") \
+                         .replace("with mx.autograd.record():",
+                                  "if True:")
+        assert analysis.analyze_source(src) == []
+
+    def test_hybrid_forward_sync_flagged(self):
+        rules = [f.rule for f in analysis.analyze_source(_HYBRID)]
+        assert rules == ["MXL302"]
+
+    def test_per_step_static_attr_flagged_scalar_attr_not(self):
+        findings = analysis.analyze_source(_PER_STEP_ATTR)
+        assert [f.rule for f in findings] == ["MXL303"]
+        assert "slice_axis" in findings[0].message  # rope rides scalar path
+
+    def test_inline_suppression(self):
+        src = _HYBRID.replace(
+            "s = x.asnumpy().sum()",
+            "s = x.asnumpy().sum()  # mxlint: disable=MXL302")
+        assert analysis.analyze_source(src) == []
+        src_all = _HYBRID.replace(
+            "s = x.asnumpy().sum()",
+            "s = x.asnumpy().sum()  # mxlint: disable")
+        assert analysis.analyze_source(src_all) == []
+
+    def test_repo_examples_have_no_errors(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        findings = analysis.analyze_paths(
+            [os.path.join(repo, "example")])
+        errors = [f for f in findings if f.severity == "error"]
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# runtime pass + engine introspection
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimePass:
+    @pytest.fixture(autouse=True)
+    def _preserve_warm_cache(self):
+        """These tests need an empty jit cache; restore the warm entries
+        afterwards so later test files don't pay recompiles."""
+        saved = dict(engine._jit_cache)
+        engine.clear_cache()
+        yield
+        with engine._lock:
+            engine._jit_cache.update(saved)
+
+    def test_cache_info_shape(self):
+        a = nd.ones((2, 2))
+        nd.relu(a).wait_to_read()
+        info = engine.cache_info()
+        assert info["size"] >= 1
+        assert "relu" in info["ops"]
+        assert info["engine"] in ("NaiveEngine", "ThreadedEngine")
+        engine.clear_cache()
+        assert engine.cache_info()["size"] == 0
+
+    def test_cache_blowup_flagged_and_scalar_path_not(self):
+        a = nd.ones((2, 2))
+        # static attr varying per step: one cache entry per value
+        for i in range(6):
+            nd.LeakyReLU(a, act_type="leaky", slope=0.1 * i)
+        # dynamic scalar attrs: ONE entry regardless of value
+        for i in range(6):
+            nd.clip(a, a_min=0.0, a_max=float(i + 1))
+        findings = analysis.analyze_cache(threshold=4)
+        assert [f.rule for f in findings] == ["MXL401"]
+        assert "LeakyReLU" in findings[0].message
+        assert "slope" in findings[0].message
+        assert len(engine.cache_info()["ops"].get("clip", [])) == 1
+
+    def test_reset_naive_rereads_env(self, monkeypatch):
+        engine._reset_naive()
+        monkeypatch.setenv("MXTPU_ENGINE_TYPE", "NaiveEngine")
+        assert engine.is_naive()
+        monkeypatch.delenv("MXTPU_ENGINE_TYPE")
+        engine._reset_naive()
+        assert not engine.is_naive()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_cli():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", "mxlint.py")
+    spec = importlib.util.spec_from_file_location("_mxlint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCLI:
+    def test_self_check_gate_passes(self, capsys):
+        cli = _load_cli()
+        rc = cli.main(["--self-check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 error(s)" in out
+
+    def test_defective_graph_fails_gate(self, tmp_path, capsys):
+        a = sym.var("a")
+        r = sym.relu(a, name="r")
+        data = json.loads(r.tojson())
+        ri = next(i for i, n in enumerate(data["nodes"])
+                  if n["name"] == "r")
+        data["nodes"][ri]["inputs"] = [[ri, 0, 0]]
+        bad = tmp_path / "bad-symbol.json"
+        bad.write_text(json.dumps(data))
+        cli = _load_cli()
+        rc = cli.main([str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "MXL101" in out
+
+    def test_json_format(self, tmp_path, capsys):
+        cli = _load_cli()
+        src = tmp_path / "snippet.py"
+        src.write_text(_HYBRID)
+        rc = cli.main([str(src), "--format", "json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0  # warnings don't fail the default gate
+        assert payload["warnings"] == 1
+        assert payload["findings"][0]["rule"] == "MXL302"
+        # --fail-on warning tightens the gate
+        rc = cli.main([str(src), "--fail-on", "warning"])
+        capsys.readouterr()
+        assert rc == 1

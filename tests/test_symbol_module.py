@@ -65,6 +65,43 @@ class TestSymbol:
         ex.forward(data=nd.ones((2, 10)))
         assert ex.outputs[0].shape == (2, 4)
 
+    def test_json_roundtrip_group_heads(self, tmp_path):
+        """Group-headed graph: head order, shared inputs, and output
+        names survive save/load; the round-trip is a fixed point (the
+        linter's clean-fixture corpus relies on this)."""
+        a = sym.var("a")
+        r1 = sym.relu(a, name="r1")
+        s2 = sym.sigmoid(a, name="s2")
+        both = sym.Group([r1, s2, a])  # op heads + a bare var head
+        fname = str(tmp_path / "group-symbol.json")
+        both.save(fname)
+        back = sym.load(fname)
+        assert back.list_outputs() == both.list_outputs()
+        assert back.list_arguments() == both.list_arguments()
+        assert back.tojson() == both.tojson()
+        ex = back.bind(mx.cpu(), {"a": nd.array([-2.0, 2.0])})
+        outs = ex.forward()
+        assert len(outs) == 3
+        np.testing.assert_allclose(outs[0].asnumpy(), [0.0, 2.0])
+        np.testing.assert_allclose(outs[2].asnumpy(), [-2.0, 2.0])
+
+    def test_json_roundtrip_aux_state_graph(self):
+        """BatchNorm (aux-state) graph: aux classification derives from
+        consuming edges, so it must survive serialization; var attr
+        hints (shape/dtype) round-trip through user_attrs."""
+        x = sym.var("x", shape=(2, 3, 4, 4))
+        bn = sym.BatchNorm(x, sym.var("g"), sym.var("b"),
+                           sym.var("mmean"), sym.var("mvar"), name="bn")
+        out = sym.relu(bn, name="act")
+        back = sym.load_json(out.tojson())
+        assert back.list_auxiliary_states() == ["mmean", "mvar"]
+        assert back.list_arguments() == ["x", "g", "b"]
+        assert back.tojson() == out.tojson()
+        # shape hint survived: infer_shape works with no explicit shapes
+        arg_shapes, out_shapes, aux_shapes = back.infer_shape()
+        assert out_shapes == [(2, 3, 4, 4)]
+        assert aux_shapes == [(3,), (3,)]
+
     def test_compose_symbol_into_symbol(self):
         a = sym.var("x")
         inner = sym.relu(sym.var("y"))

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""mxlint: static analyzer for mxnet_tpu graphs, ops, and user code.
+
+Pass families (rules documented in docs/static_analysis.md):
+
+* graph passes (MXL1xx) over Symbol JSON files — cycles, duplicate
+  names, dead nodes, shape/dtype contract violations (jax.eval_shape,
+  no device execution);
+* registry passes (MXL2xx) over every registered OpDef;
+* source passes (MXL3xx) over Python files — host-sync and
+  retrace-storm hazards;
+* runtime pass (MXL4xx) — jit-cache key blowup, when run in-process
+  after a workload (``mxnet_tpu.analysis.analyze_cache``).
+
+Usage:
+
+    python tools/mxlint.py example/ mymodel-symbol.json  # source+graph
+    python tools/mxlint.py --registry                    # op registry
+    python tools/mxlint.py --models                      # model corpus
+    python tools/mxlint.py --self-check                  # CI gate
+
+Exits 1 when any error-severity finding is produced (``--fail-on
+warning`` tightens the gate), so it can gate CI.  Suppress a rule on one
+line with ``# mxlint: disable=MXL301``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help=".py files/dirs (source passes) and Symbol "
+                    ".json files (graph passes)")
+    ap.add_argument("--registry", action="store_true",
+                    help="run the op-registry passes (MXL2xx)")
+    ap.add_argument("--models", action="store_true",
+                    help="lint the full shipped model corpus (builtin "
+                    "symbols + traced model zoo)")
+    ap.add_argument("--self-check", action="store_true",
+                    help="CI gate: registry passes + fast model corpus; "
+                    "exit nonzero on any error finding")
+    ap.add_argument("--no-shapes", action="store_true",
+                    help="skip the eval_shape contract validator "
+                    "(structural passes only)")
+    ap.add_argument("--fail-on", choices=["error", "warning"],
+                    default="error",
+                    help="lowest severity that fails the run "
+                    "(default: error)")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule IDs to drop, e.g. "
+                    "MXL301,MXL303")
+    ap.add_argument("--format", choices=["text", "json"], default="text",
+                    dest="fmt", help="output format")
+    args = ap.parse_args(argv)
+
+    if not (args.paths or args.registry or args.models or args.self_check):
+        ap.error("nothing to do: give paths and/or --registry/--models/"
+                 "--self-check")
+
+    from mxnet_tpu import analysis
+
+    findings = []
+    check_shapes = not args.no_shapes
+
+    if args.self_check or args.registry:
+        findings.extend(analysis.analyze_registry())
+    if args.self_check or args.models:
+        for name, s, shapes in analysis.model_corpus(full=args.models):
+            findings.extend(analysis.analyze_symbol(
+                s, shapes=shapes, check_shapes=check_shapes, name=name))
+    if args.paths:
+        findings.extend(analysis.analyze_paths(args.paths))
+
+    disable = {r.strip() for r in args.disable.split(",") if r.strip()}
+    findings = analysis.filter_findings(findings, disable)
+    sev_rank = {"error": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: (sev_rank[f.severity], f.rule,
+                                 f.location))
+
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+
+    if args.fmt == "json":
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "errors": n_err, "warnings": n_warn}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"mxlint: {n_err} error(s), {n_warn} warning(s), "
+              f"{len(findings) - n_err - n_warn} info")
+
+    failed = n_err > 0 or (args.fail_on == "warning" and n_warn > 0)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
