@@ -649,7 +649,23 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
         loss.wait_to_read()
         mx.nd.waitall()
         dt = time.perf_counter() - t0
-    return batch_size * steps / dt
+
+        # steady-state optimizer dispatch count: bracket ONE more
+        # trainer.step with the engine's dispatch counter (forward/
+        # backward run before the bracket).  1 on the fused path; ~P
+        # (params) on the per-param loop — the emitted JSON carries it
+        # so a regression back to dispatch-bound updates is visible in
+        # the bench series, not just in tier-1 tests.
+        from mxnet_tpu import engine
+        with autograd.record():
+            out = net(x)
+            l = loss_fn(out, y)
+        l.backward()
+        d0 = engine.cache_info()["dispatches"]
+        trainer.step(batch_size)
+        opt_dispatches = engine.cache_info()["dispatches"] - d0
+        mx.nd.waitall()
+    return batch_size * steps / dt, opt_dispatches
 
 
 def _run_cpu_smoke_subprocess(sub_budget=240):
@@ -764,12 +780,15 @@ def main():
     if not on_tpu:
         try:
             _log("stage 1: MLP trainer bench")
-            sps = bench_mlp_train()
+            sps, opt_disp = bench_mlp_train()
             _record("mlp_train", samples_per_sec=round(sps, 2),
-                    platform=platform)
+                    platform=platform,
+                    optimizer_dispatches_per_step=opt_disp)
             _set_result("mlp_mnist_train_samples_per_sec", sps,
-                        degraded="tpu unreachable; cpu backend")
-            _log(f"stage 1 done: {sps:.1f} samples/sec")
+                        degraded="tpu unreachable; cpu backend",
+                        optimizer_dispatches_per_step=opt_disp)
+            _log(f"stage 1 done: {sps:.1f} samples/sec, "
+                 f"{opt_disp} optimizer dispatch(es)/step")
         except Exception as e:
             traceback.print_exc(file=sys.stderr)
             _record("mlp_train", error=repr(e))

@@ -27,12 +27,21 @@ import weakref
 from typing import Any, Callable, Dict, Tuple
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
-           "cache_info", "cache_size", "clear_cache"]
+           "cache_info", "cache_size", "clear_cache", "reset_counters"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
 # weak set of in-flight jax arrays for waitall()
 _live = weakref.WeakSet()
+
+# dispatch/compile-cache telemetry (surfaced via cache_info()): one
+# "dispatch" = one invoke_compiled call = one XLA executable launch.
+# The fused-optimizer tier-1 test and bench.py's
+# ``optimizer_dispatches_per_step`` read these, so the counters are
+# part of the public introspection contract, not debug scaffolding.
+_hits = 0
+_misses = 0
+_dispatches = 0
 
 
 _NAIVE = None
@@ -58,31 +67,44 @@ def _freeze(v: Any):
     return v
 
 
-def get_compiled(name: str, fcompute: Callable, attrs: dict) -> Callable:
+def get_compiled(name: str, fcompute: Callable, attrs: dict,
+                 donate: Tuple[int, ...] = ()) -> Callable:
     """Return the jitted executable for (op, attrs); compile-once semantics.
 
     This is the moral equivalent of the reference's per-op FCompute lookup +
     engine push: jax.jit re-traces per input shape/dtype/device, which plays
     the role of the per-(shape,dtype,ctx) plan cache in CachedOp.
+
+    ``donate``: positional indices of input arrays whose buffers the
+    executable may reuse for its outputs (``jax.jit(donate_argnums=...)``).
+    The fused multi-tensor optimizer step donates the weight/state buffers
+    so a BERT-sized update does not double live-HBM; callers that donate
+    own the aliasing contract (the donated jax.Array is dead after the
+    call — swap the new buffer in before anything reads the old one).
+    Donating and non-donating callers of the same (op, attrs) get
+    distinct cache entries.
     """
+    global _hits, _misses
     # attr-less ops (the bulk of elemwise traffic) skip the freeze/sort;
-    # hashable attr values skip the recursive _freeze (insertion order
-    # is stable per call site, so at worst a reordered-kwargs caller
-    # duplicates a cache entry for the same compiled fn)
-    if not attrs:
+    # hashable attr values take a SORTED items key so reordered-kwargs
+    # call sites share one cache entry for the same executable
+    if not attrs and not donate:
         key = name
         fn = _jit_cache.get(key)
     else:
         try:
-            key = (name, tuple(attrs.items()))
+            sig = tuple(sorted(attrs.items()))
+            key = (name, sig, tuple(donate)) if donate else (name, sig)
             fn = _jit_cache.get(key)
         except TypeError:
-            key = (name, _freeze(attrs))
+            sig = _freeze(attrs)
+            key = (name, sig, tuple(donate)) if donate else (name, sig)
             fn = _jit_cache.get(key)
     if fn is None:
         with _lock:
             fn = _jit_cache.get(key)
             if fn is None:
+                _misses += 1  # under _lock, like every counter mutation
                 bound = functools.partial(fcompute, **attrs) if attrs else fcompute
                 # ops that orchestrate their own device placement /
                 # inner jit (ring attention's shard_map over a mesh)
@@ -90,8 +112,17 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict) -> Callable:
                 if getattr(fcompute, "_mxtpu_no_jit", False):
                     fn = bound
                 else:
-                    fn = __import__("jax").jit(bound)
+                    jax = __import__("jax")
+                    fn = jax.jit(bound, donate_argnums=tuple(donate)) \
+                        if donate else jax.jit(bound)
                 _jit_cache[key] = fn
+                return fn
+    # += on a module global is not atomic (read-modify-write can lose
+    # increments across threads, e.g. DataLoader workers dispatching
+    # while the main thread trains) and the dispatch counters are an
+    # exact contract for tests/bench — take the lock
+    with _lock:
+        _hits += 1
     return fn
 
 
@@ -110,9 +141,19 @@ def track(arr):
 _profiler_hook = None
 
 
-def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays):
-    """Execute an op through the compile cache. Returns jax array(s)."""
-    fn = get_compiled(name, fcompute, attrs)
+def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
+                    donate: Tuple[int, ...] = ()):
+    """Execute an op through the compile cache. Returns jax array(s).
+
+    ``donate`` flows to :func:`get_compiled` (buffer donation for the
+    fused optimizer path).  NaiveEngine semantics are honored for every
+    entry, donating or not: a donated fused step still blocks per
+    dispatch when ``MXTPU_ENGINE_TYPE=NaiveEngine``.
+    """
+    global _dispatches
+    with _lock:
+        _dispatches += 1
+    fn = get_compiled(name, fcompute, attrs, donate=donate)
     hook = _profiler_hook
     if hook is not None:
         out = hook(name, fn, arrays)
@@ -136,6 +177,10 @@ def waitall():
     """
     import jax
     for arr in list(_live):
+        # a buffer donated to a fused update is deleted the moment its
+        # successor exists — that is normal, not an in-flight error
+        if getattr(arr, "is_deleted", lambda: False)():
+            continue
         try:
             jax.block_until_ready(arr)
         except Exception:
@@ -148,14 +193,17 @@ def cache_size() -> int:
 
 
 def cache_info() -> dict:
-    """Introspect the jit-cache and live-buffer tracking.
+    """Introspect the jit-cache, dispatch counters, and live buffers.
 
-    Returns ``{"size", "live_buffers", "engine", "ops"}`` where ``ops``
-    maps op name -> list of attr signatures (one per cached executable;
-    ``()`` for the attr-less fast path).  mxlint's runtime-hazard report
-    reads this to surface cache-key blowup: one op accumulating many
-    entries that differ only in a numeric attr value is the retrace-storm
-    signature (the fix is usually ``scalar_attrs``).
+    Returns ``{"size", "live_buffers", "engine", "ops", "hits",
+    "misses", "dispatches"}`` where ``ops`` maps op name -> list of attr
+    signatures (one per cached executable; ``()`` for the attr-less fast
+    path).  mxlint's runtime-hazard report reads ``ops`` to surface
+    cache-key blowup: one op accumulating many entries that differ only
+    in a numeric attr value is the retrace-storm signature (the fix is
+    usually ``scalar_attrs``).  ``dispatches`` counts invoke_compiled
+    calls since process start (or :func:`reset_counters`); the fused
+    optimizer step's one-dispatch contract is asserted against it.
     """
     per_op: Dict[str, list] = {}
     with _lock:
@@ -164,16 +212,24 @@ def cache_info() -> dict:
         if isinstance(key, str):
             per_op.setdefault(key, []).append(())
         else:
-            name, attrs = key
+            name, attrs = key[0], key[1]  # (name, sig[, donate])
             per_op.setdefault(name, []).append(attrs)
     return {"size": len(keys), "live_buffers": len(_live),
             "engine": "NaiveEngine" if is_naive() else "ThreadedEngine",
+            "hits": _hits, "misses": _misses, "dispatches": _dispatches,
             "ops": per_op}
 
 
 def clear_cache():
     with _lock:
         _jit_cache.clear()
+
+
+def reset_counters():
+    """Zero the hit/miss/dispatch counters (cache entries untouched)."""
+    global _hits, _misses, _dispatches
+    with _lock:
+        _hits = _misses = _dispatches = 0
 
 
 def _reset_naive():

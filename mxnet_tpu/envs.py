@@ -90,6 +90,12 @@ _reg("MXTPU_ENABLE_X64", bool, False,
      "where the MXU wants bf16/f32. MXNet's float32-default dtype rules "
      "are preserved either way; turn this on for workloads that need "
      "genuine f64/i64 tensors.")
+_reg("MXTPU_FUSED_UPDATE", bool, True,
+     "Route Trainer.step through the fused one-dispatch multi-tensor "
+     "optimizer update (multi_sgd/multi_adam/... with buffer donation) "
+     "when the optimizer supports it. 0 restores the per-parameter "
+     "update loop (numerically identical; ~P dispatches per step for "
+     "P parameters).")
 _reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
      "Accepted for parity; XLA fuses whole graphs at the hybridize "
      "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")

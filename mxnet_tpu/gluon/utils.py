@@ -50,14 +50,31 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
-    """Rescale arrays so that the global 2-norm <= max_norm."""
-    def _norm(array):
-        x = array.reshape((-1,))
-        return nd.dot(x, x)
+    """Rescale arrays (in place) so that the global 2-norm <= max_norm.
+
+    Same-context arrays take the fused ``clip_by_global_norm`` op: the
+    norm reduction over EVERY array and all the scales run as ONE
+    compiled dispatch instead of ~3 ops per array.
+    """
     assert len(arrays) > 0
     ctx = arrays[0].context
-    total_norm = nd.add_n(*[_norm(a).as_in_context(ctx) for a in arrays])
-    total_norm = nd.sqrt(total_norm)
+    if all(a.context == ctx for a in arrays):
+        outs = nd.clip_by_global_norm(*arrays, max_norm=float(max_norm))
+        total_norm = outs[-1]
+        for arr, scaled in zip(arrays, outs[:-1]):
+            arr._set_data(scaled._data)
+    else:
+        # cross-context arrays cannot share one traced program
+        def _norm(array):
+            x = array.reshape((-1,))
+            return nd.dot(x, x)
+        total_norm = nd.add_n(*[_norm(a).as_in_context(ctx)
+                                for a in arrays])
+        total_norm = nd.sqrt(total_norm)
+        scale = max_norm / (total_norm + 1e-8)
+        scale = nd.minimum(scale, nd.ones((1,), ctx=ctx))
+        for arr in arrays:
+            arr *= scale.as_in_context(arr.context)
     if check_isfinite:
         val = float(total_norm.asscalar())
         if not np.isfinite(val):
@@ -65,11 +82,6 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
             warnings.warn(
                 UserWarning("nan or inf is detected. Clipping results will "
                             "be undefined."), stacklevel=2)
-    scale = max_norm / (total_norm + 1e-8)
-    scale = nd.minimum(scale, nd.ones((1,), ctx=ctx))
-    for arr in arrays:
-        arr *= scale.as_in_context(arr.context)
-    if check_isfinite:
         return val
     return total_norm
 

@@ -10,6 +10,18 @@ ride as dynamic 0-d arrays (no recompilation when a scheduler changes them).
 All ops are pure: they RETURN the updated tensors; the frontend writes them
 back via ``out=`` (buffer swap), which is the TPU-native equivalent of the
 reference's in-place kernels.
+
+``rescale_grad`` rides as a DYNAMIC scalar everywhere (scalar_attrs), not
+a static attr: ``Trainer.step`` rewrites it to ``scale/batch_size`` every
+call, so a float in the jit-cache key would retrace per distinct batch
+size (the classic cache-key blowup mxlint MXL401 flags).
+
+The ``multi_*`` family mirrors the reference's fused multi-tensor kernels
+(``src/operator/optimizer_op.cc``): flat lists of (weight, grad, state…)
+in, ALL updated tensors out of ONE traced program, with per-param lr/wd
+stacked into 1-d dynamic arrays.  ``clip_global_norm`` (off at -1) folds
+global-norm gradient clipping into the same program — it needs every
+gradient in one trace, which the per-param ops cannot express.
 """
 from __future__ import annotations
 
@@ -38,8 +50,9 @@ def _row_mask(grad):
     return m.reshape(m.shape + (1,) * (grad.ndim - 1))
 
 
-@register("sgd_update", num_inputs=2, scalar_attrs=("lr", "wd"))
-def sgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
+@register("sgd_update", num_inputs=2,
+          scalar_attrs=("lr", "wd", "rescale_grad"))
+def sgd_update(weight, grad, lr, wd, rescale_grad=1.0, *,
                clip_gradient=-1.0, lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_w = weight - lr * g
@@ -48,10 +61,10 @@ def sgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
     return new_w
 
 
-@register("sgd_mom_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def sgd_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
-                   rescale_grad=1.0, clip_gradient=-1.0,
+@register("sgd_mom_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, wd, rescale_grad=1.0, *,
+                   momentum=0.0, clip_gradient=-1.0,
                    lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - lr * g
@@ -62,18 +75,21 @@ def sgd_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
     return weight + new_mom, new_mom
 
 
-@register("nag_mom_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def nag_mom_update(weight, grad, mom, lr, wd, *, momentum=0.0,
-                   rescale_grad=1.0, clip_gradient=-1.0):
+@register("nag_mom_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2)
+def nag_mom_update(weight, grad, mom, lr, wd, rescale_grad=1.0, *,
+                   momentum=0.0, clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom + g
     return weight - lr * (g + momentum * new_mom), new_mom
 
 
-@register("mp_sgd_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def mp_sgd_update(weight, grad, weight32, lr, wd, *, rescale_grad=1.0,
+# mp ops anchor their scalars on the float32 master weight (not the
+# fp16 input) so lr/wd/rescale keep full precision in the update math
+@register("mp_sgd_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2,
+          scalar_ref_input=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd, rescale_grad=1.0, *,
                   clip_gradient=-1.0, lazy_update=True):
     g = _prep_grad(grad.astype("float32"), rescale_grad, clip_gradient,
                    wd, weight32)
@@ -81,11 +97,12 @@ def mp_sgd_update(weight, grad, weight32, lr, wd, *, rescale_grad=1.0,
     return w32.astype(weight.dtype), w32
 
 
-@register("mp_sgd_mom_update", num_inputs=4, scalar_attrs=("lr", "wd"),
-          num_outputs=3)
-def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, *, momentum=0.0,
-                      rescale_grad=1.0, clip_gradient=-1.0,
-                      lazy_update=True):
+@register("mp_sgd_mom_update", num_inputs=4,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=3,
+          scalar_ref_input=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd,
+                      rescale_grad=1.0, *, momentum=0.0,
+                      clip_gradient=-1.0, lazy_update=True):
     g = _prep_grad(grad.astype("float32"), rescale_grad, clip_gradient,
                    wd, weight32)
     new_mom = momentum * mom - lr * g
@@ -93,10 +110,10 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr, wd, *, momentum=0.0,
     return w32.astype(weight.dtype), new_mom, w32
 
 
-@register("adam_update", num_inputs=4, scalar_attrs=("lr", "wd"),
-          num_outputs=3)
-def adam_update(weight, grad, mean, var, lr, wd, *, beta1=0.9, beta2=0.999,
-                epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
+@register("adam_update", num_inputs=4,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, wd, rescale_grad=1.0, *,
+                beta1=0.9, beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
                 lazy_update=False):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mean = beta1 * mean + (1.0 - beta1) * g
@@ -111,9 +128,9 @@ def adam_update(weight, grad, mean, var, lr, wd, *, beta1=0.9, beta2=0.999,
 
 
 @register("adamw_update", num_inputs=4,
-          scalar_attrs=("lr", "eta", "wd"), num_outputs=3)
-def adamw_update(weight, grad, mean, var, lr, eta, wd, *, beta1=0.9,
-                 beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+          scalar_attrs=("lr", "eta", "wd", "rescale_grad"), num_outputs=3)
+def adamw_update(weight, grad, mean, var, lr, eta, wd, rescale_grad=1.0,
+                 *, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient)
     new_mean = beta1 * mean + (1.0 - beta1) * g
@@ -123,10 +140,10 @@ def adamw_update(weight, grad, mean, var, lr, eta, wd, *, beta1=0.9,
     return w, new_mean, new_var
 
 
-@register("rmsprop_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def rmsprop_update(weight, grad, n, lr, wd, *, gamma1=0.95, epsilon=1e-8,
-                   rescale_grad=1.0, clip_gradient=-1.0,
+@register("rmsprop_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, wd, rescale_grad=1.0, *,
+                   gamma1=0.95, epsilon=1e-8, clip_gradient=-1.0,
                    clip_weights=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_n = gamma1 * n + (1.0 - gamma1) * jnp.square(g)
@@ -136,11 +153,12 @@ def rmsprop_update(weight, grad, n, lr, wd, *, gamma1=0.95, epsilon=1e-8,
     return w, new_n
 
 
-@register("rmspropalex_update", num_inputs=5, scalar_attrs=("lr", "wd"),
-          num_outputs=4)
-def rmspropalex_update(weight, grad, n, g_acc, delta, lr, wd, *, gamma1=0.95,
-                       gamma2=0.9, epsilon=1e-8, rescale_grad=1.0,
-                       clip_gradient=-1.0, clip_weights=-1.0):
+@register("rmspropalex_update", num_inputs=5,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_acc, delta, lr, wd,
+                       rescale_grad=1.0, *, gamma1=0.95, gamma2=0.9,
+                       epsilon=1e-8, clip_gradient=-1.0,
+                       clip_weights=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_n = gamma1 * n + (1.0 - gamma1) * jnp.square(g)
     new_g = gamma1 * g_acc + (1.0 - gamma1) * g
@@ -149,10 +167,10 @@ def rmspropalex_update(weight, grad, n, g_acc, delta, lr, wd, *, gamma1=0.95,
     return weight + new_delta, new_n, new_g, new_delta
 
 
-@register("ftrl_update", num_inputs=4, scalar_attrs=("lr", "wd"),
-          num_outputs=3)
-def ftrl_update(weight, grad, z, n, lr, wd, *, lamda1=0.01, beta=1.0,
-                rescale_grad=1.0, clip_gradient=-1.0):
+@register("ftrl_update", num_inputs=4,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr, wd, rescale_grad=1.0, *,
+                lamda1=0.01, beta=1.0, clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient)
     new_n = n + jnp.square(g)
     sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
@@ -164,17 +182,18 @@ def ftrl_update(weight, grad, z, n, lr, wd, *, lamda1=0.01, beta=1.0,
     return w, new_z, new_n
 
 
-@register("signsgd_update", num_inputs=2, scalar_attrs=("lr", "wd"))
-def signsgd_update(weight, grad, lr, wd, *, rescale_grad=1.0,
+@register("signsgd_update", num_inputs=2,
+          scalar_attrs=("lr", "wd", "rescale_grad"))
+def signsgd_update(weight, grad, lr, wd, rescale_grad=1.0, *,
                    clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     return weight - lr * jnp.sign(g)
 
 
-@register("signum_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def signum_update(weight, grad, mom, lr, wd, *, momentum=0.0,
-                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+@register("signum_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2)
+def signum_update(weight, grad, mom, lr, wd, rescale_grad=1.0, *,
+                  momentum=0.0, clip_gradient=-1.0, wd_lh=0.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - (1.0 - momentum) * g
     w = weight + lr * jnp.sign(new_mom)
@@ -183,19 +202,19 @@ def signum_update(weight, grad, mom, lr, wd, *, momentum=0.0,
     return w, new_mom
 
 
-@register("adagrad_update", num_inputs=3, scalar_attrs=("lr", "wd"),
-          num_outputs=2)
-def adagrad_update(weight, grad, history, lr, wd, *, epsilon=1e-7,
-                   rescale_grad=1.0, clip_gradient=-1.0):
+@register("adagrad_update", num_inputs=3,
+          scalar_attrs=("lr", "wd", "rescale_grad"), num_outputs=2)
+def adagrad_update(weight, grad, history, lr, wd, rescale_grad=1.0, *,
+                   epsilon=1e-7, clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_h = history + jnp.square(g)
     return weight - lr * g / (jnp.sqrt(new_h) + epsilon), new_h
 
 
-@register("adadelta_update", num_inputs=4, scalar_attrs=("wd",),
-          num_outputs=3)
-def adadelta_update(weight, grad, acc_g, acc_delta, wd, *, rho=0.9,
-                    epsilon=1e-5, rescale_grad=1.0, clip_gradient=-1.0):
+@register("adadelta_update", num_inputs=4,
+          scalar_attrs=("wd", "rescale_grad"), num_outputs=3)
+def adadelta_update(weight, grad, acc_g, acc_delta, wd, rescale_grad=1.0,
+                    *, rho=0.9, epsilon=1e-5, clip_gradient=-1.0):
     g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
     new_acc_g = rho * acc_g + (1.0 - rho) * jnp.square(g)
     delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
@@ -204,10 +223,10 @@ def adadelta_update(weight, grad, acc_g, acc_delta, wd, *, rho=0.9,
 
 
 @register("lamb_update_phase1", num_inputs=4,
-          scalar_attrs=("wd", "t"), num_outputs=3)
-def lamb_update_phase1(weight, grad, mean, var, wd, t=1, *, beta1=0.9,
-                       beta2=0.999, epsilon=1e-6, bias_correction=True,
-                       rescale_grad=1.0, clip_gradient=-1.0):
+          scalar_attrs=("wd", "t", "rescale_grad"), num_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, wd, t=1, rescale_grad=1.0,
+                       *, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       bias_correction=True, clip_gradient=-1.0):
     """``t`` (the step count for bias correction) rides as a DYNAMIC
     scalar so a training loop does not recompile phase1 every step."""
     g = _prep_grad(grad, rescale_grad, clip_gradient)
@@ -234,3 +253,235 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr, *,
     if upper_bound > 0:
         trust = jnp.minimum(trust, upper_bound)
     return weight - lr * trust * g_update
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor updates (reference src/operator/optimizer_op.cc
+# multi_sgd_update / multi_mp_sgd_mom_update / multi_sum_sq / multi_lars)
+#
+# Input convention, shared by the whole family: the flat ``*arrays`` list
+# is ``num_weights`` weights, then ``num_weights`` grads, then any state
+# groups (each ``num_weights`` long), then the dynamic per-param scalars
+# ``lrs`` (1-d, len num_weights), ``wds`` (1-d), and the 0-d
+# ``rescale_grad``.  lr/wd/rescale change every step (schedulers, Adam
+# bias correction, Trainer batch-size folding) and therefore MUST be
+# array inputs; only structural knobs (num_weights, momentum, betas,
+# clip bounds) are static attrs.
+# ---------------------------------------------------------------------------
+
+
+def _sum_sq(a):
+    return jnp.sum(jnp.square(a.astype(jnp.float32)))
+
+
+def _global_norm_scale(arrays, max_norm):
+    """(pre-clip global 2-norm, min(1, max_norm/(norm+1e-8))) over ALL
+    arrays, accumulated in float32."""
+    total = _sum_sq(arrays[0])
+    for a in arrays[1:]:
+        total = total + _sum_sq(a)
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(jnp.float32(1.0), max_norm / (norm + 1e-8))
+    return norm, scale
+
+
+def _rescaled_grads(gs, rescale_grad, clip_gradient, clip_global_norm):
+    """grad * rescale, then OPTIONAL global-norm clip (one scale factor
+    computed over ALL grads — expressible only because the whole update
+    is one traced program), then optional per-element clip."""
+    gs = [g * rescale_grad for g in gs]
+    if clip_global_norm > 0:
+        _, scale = _global_norm_scale(gs, jnp.float32(clip_global_norm))
+        gs = [g * scale.astype(g.dtype) for g in gs]
+    if clip_gradient is not None and clip_gradient > 0:
+        gs = [jnp.clip(g, -clip_gradient, clip_gradient) for g in gs]
+    return gs
+
+
+@register("multi_sgd_update", num_inputs=None, num_outputs=-1)
+def multi_sgd_update(*arrays, num_weights, clip_gradient=-1.0,
+                     clip_global_norm=-1.0):
+    """Inputs: n weights, n grads, lrs, wds, rescale_grad.
+    Outputs: n updated weights."""
+    n = num_weights
+    ws, gs = arrays[:n], arrays[n:2 * n]
+    lrs, wds, rescale_grad = arrays[2 * n], arrays[2 * n + 1], \
+        arrays[2 * n + 2]
+    gs = _rescaled_grads(gs, rescale_grad, clip_gradient,
+                         clip_global_norm)
+    return tuple(
+        (w - lrs[j] * (gs[j] + wds[j] * w)).astype(w.dtype)
+        for j, w in enumerate(ws))
+
+
+@register("multi_sgd_mom_update", num_inputs=None, num_outputs=-1)
+def multi_sgd_mom_update(*arrays, num_weights, momentum=0.0,
+                         clip_gradient=-1.0, clip_global_norm=-1.0):
+    """Inputs: n weights, n grads, n momenta, lrs, wds, rescale_grad.
+    Outputs: n updated weights, then n updated momenta."""
+    n = num_weights
+    ws, gs, moms = arrays[:n], arrays[n:2 * n], arrays[2 * n:3 * n]
+    lrs, wds, rescale_grad = arrays[3 * n], arrays[3 * n + 1], \
+        arrays[3 * n + 2]
+    gs = _rescaled_grads(gs, rescale_grad, clip_gradient,
+                         clip_global_norm)
+    new_ws, new_moms = [], []
+    for j, w in enumerate(ws):
+        new_mom = momentum * moms[j] - lrs[j] * (gs[j] + wds[j] * w)
+        new_ws.append((w + new_mom).astype(w.dtype))
+        new_moms.append(new_mom.astype(moms[j].dtype))
+    return tuple(new_ws) + tuple(new_moms)
+
+
+@register("multi_mp_sgd_update", num_inputs=None, num_outputs=-1)
+def multi_mp_sgd_update(*arrays, num_weights, clip_gradient=-1.0,
+                        clip_global_norm=-1.0):
+    """Inputs: n fp16 weights, n grads, n fp32 master weights, lrs, wds,
+    rescale_grad.  Outputs: n updated fp16 weights, n updated masters."""
+    n = num_weights
+    ws, gs, w32s = arrays[:n], arrays[n:2 * n], arrays[2 * n:3 * n]
+    lrs, wds, rescale_grad = arrays[3 * n], arrays[3 * n + 1], \
+        arrays[3 * n + 2]
+    gs = _rescaled_grads([g.astype("float32") for g in gs], rescale_grad,
+                         clip_gradient, clip_global_norm)
+    new_ws, new_w32s = [], []
+    for j, w32 in enumerate(w32s):
+        nw32 = w32 - lrs[j] * (gs[j] + wds[j] * w32)
+        new_ws.append(nw32.astype(ws[j].dtype))
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_w32s)
+
+
+@register("multi_mp_sgd_mom_update", num_inputs=None, num_outputs=-1)
+def multi_mp_sgd_mom_update(*arrays, num_weights, momentum=0.0,
+                            clip_gradient=-1.0, clip_global_norm=-1.0):
+    """Inputs: n fp16 weights, n grads, n fp32 momenta, n fp32 master
+    weights, lrs, wds, rescale_grad.  Outputs: n updated fp16 weights,
+    n momenta, n masters."""
+    n = num_weights
+    ws, gs = arrays[:n], arrays[n:2 * n]
+    moms, w32s = arrays[2 * n:3 * n], arrays[3 * n:4 * n]
+    lrs, wds, rescale_grad = arrays[4 * n], arrays[4 * n + 1], \
+        arrays[4 * n + 2]
+    gs = _rescaled_grads([g.astype("float32") for g in gs], rescale_grad,
+                         clip_gradient, clip_global_norm)
+    new_ws, new_moms, new_w32s = [], [], []
+    for j, w32 in enumerate(w32s):
+        new_mom = momentum * moms[j] - lrs[j] * (gs[j] + wds[j] * w32)
+        nw32 = w32 + new_mom
+        new_ws.append(nw32.astype(ws[j].dtype))
+        new_moms.append(new_mom)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_moms) + tuple(new_w32s)
+
+
+@register("multi_adam_update", num_inputs=None, num_outputs=-1)
+def multi_adam_update(*arrays, num_weights, beta1=0.9, beta2=0.999,
+                      epsilon=1e-8, clip_gradient=-1.0,
+                      clip_global_norm=-1.0):
+    """Fused Adam over n tensors.  Inputs: n weights, n grads, n means,
+    n vars, lrs (bias-corrected per param, computed host-side exactly as
+    the per-param ``Adam.update`` does), wds, rescale_grad.  Outputs:
+    n weights, n means, n vars."""
+    n = num_weights
+    ws, gs = arrays[:n], arrays[n:2 * n]
+    means, variances = arrays[2 * n:3 * n], arrays[3 * n:4 * n]
+    lrs, wds, rescale_grad = arrays[4 * n], arrays[4 * n + 1], \
+        arrays[4 * n + 2]
+    gs = _rescaled_grads(gs, rescale_grad, clip_gradient,
+                         clip_global_norm)
+    new_ws, new_means, new_vars = [], [], []
+    for j, w in enumerate(ws):
+        g = gs[j] + wds[j] * w
+        new_mean = beta1 * means[j] + (1.0 - beta1) * g
+        new_var = beta2 * variances[j] + (1.0 - beta2) * jnp.square(g)
+        new_ws.append(
+            (w - lrs[j] * new_mean / (jnp.sqrt(new_var) + epsilon))
+            .astype(w.dtype))
+        # state dtype preserved (f32 lr/wd would otherwise promote fp16
+        # states, breaking donation aliasing and path equivalence)
+        new_means.append(new_mean.astype(means[j].dtype))
+        new_vars.append(new_var.astype(variances[j].dtype))
+    return tuple(new_ws) + tuple(new_means) + tuple(new_vars)
+
+
+@register("multi_lamb_update", num_inputs=None, num_outputs=-1)
+def multi_lamb_update(*arrays, num_weights, beta1=0.9, beta2=0.999,
+                      epsilon=1e-6, bias_correction=True,
+                      lower_bound=-1.0, upper_bound=-1.0,
+                      clip_gradient=-1.0, clip_global_norm=-1.0):
+    """Fused LAMB (phase1 + per-tensor trust ratio + phase2 in one
+    program).  Inputs: n weights, n grads, n means, n vars, lrs, wds,
+    ts (per-param step counts, 1-d), rescale_grad.  Outputs: n weights,
+    n means, n vars."""
+    n = num_weights
+    ws, gs = arrays[:n], arrays[n:2 * n]
+    means, variances = arrays[2 * n:3 * n], arrays[3 * n:4 * n]
+    lrs, wds, ts, rescale_grad = arrays[4 * n], arrays[4 * n + 1], \
+        arrays[4 * n + 2], arrays[4 * n + 3]
+    gs = _rescaled_grads(gs, rescale_grad, clip_gradient,
+                         clip_global_norm)
+    new_ws, new_means, new_vars = [], [], []
+    for j, w in enumerate(ws):
+        g = gs[j]
+        new_mean = beta1 * means[j] + (1.0 - beta1) * g
+        new_var = beta2 * variances[j] + (1.0 - beta2) * jnp.square(g)
+        m, v = new_mean, new_var
+        if bias_correction:
+            tf = jnp.asarray(ts[j], jnp.float32)
+            m = m / (1.0 - jnp.power(jnp.float32(beta1), tf))
+            v = v / (1.0 - jnp.power(jnp.float32(beta2), tf))
+        update = m / (jnp.sqrt(v) + epsilon) + wds[j] * w
+        r1 = jnp.sqrt(jnp.sum(jnp.square(w)))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(update)))
+        r1c = jnp.where(r1 == 0.0, jnp.ones_like(r1), r1)
+        r2c = jnp.where(r2 == 0.0, jnp.ones_like(r2), r2)
+        trust = jnp.where((r1 > 0.0) & (r2 > 0.0), r1c / r2c,
+                          jnp.ones_like(r1))
+        if lower_bound > 0:
+            trust = jnp.maximum(trust, lower_bound)
+        if upper_bound > 0:
+            trust = jnp.minimum(trust, upper_bound)
+        new_ws.append((w - lrs[j] * trust * update).astype(w.dtype))
+        new_means.append(new_mean.astype(means[j].dtype))
+        new_vars.append(new_var.astype(variances[j].dtype))
+    return tuple(new_ws) + tuple(new_means) + tuple(new_vars)
+
+
+@register("multi_sum_sq", num_inputs=None)
+def multi_sum_sq(*arrays, num_arrays):
+    """Per-array sum of squares, stacked into one 1-d float32 output
+    (reference ``multi_sum_sq``; feeds ``multi_lars``)."""
+    return jnp.stack([_sum_sq(a) for a in arrays[:num_arrays]])
+
+
+@register("multi_lars", num_inputs=4, scalar_attrs=("rescale_grad",))
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, rescale_grad=1.0,
+               *, eta=0.001, eps=1e-8):
+    """LARS layer-wise lr scaling over the stacked norms from
+    ``multi_sum_sq`` (reference ``multi_lars``): where both norms are
+    positive, lr_j *= eta * ||w_j|| / (||g_j|| + wd_j * ||w_j|| + eps)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return lrs * jnp.where((w_norm > 0.0) & (g_norm > 0.0), ratio,
+                           jnp.ones_like(ratio))
+
+
+@register("clip_by_global_norm", num_inputs=None, num_outputs=-1,
+          scalar_attrs=("max_norm",), scalar_ref_input=None)
+def clip_by_global_norm(*arrays):
+    """Scale ALL arrays so their global 2-norm is <= max_norm; returns
+    the scaled arrays followed by the (pre-clip) global norm.  One
+    traced program — the gluon ``clip_global_norm`` util dispatches this
+    once instead of ~3n per-array ops.
+
+    ``max_norm`` rides as the trailing DYNAMIC scalar (variadic ops
+    receive scalar_attrs appended to ``*arrays``): the Trainer fallback
+    clips with a batch-size-dependent bound every step, which must not
+    retrace.  ``scalar_ref_input=None`` stages it as float32 — anchoring
+    on fp16 gradients would overflow any bound > 65504 to inf and
+    silently skip the clip."""
+    *arrs, max_norm = arrays
+    norm, scale = _global_norm_scale(arrs, max_norm.astype(jnp.float32))
+    return tuple((a * scale.astype(a.dtype)) for a in arrs) + (norm,)

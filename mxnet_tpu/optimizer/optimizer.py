@@ -44,8 +44,13 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
-                 param_dict=None, aggregate_num=0):
+                 param_dict=None, aggregate_num=0, clip_global_norm=None):
         self.rescale_grad = rescale_grad
+        # global-norm gradient clipping (max total 2-norm across ALL
+        # params).  Only the fused multi-tensor path can fold it into
+        # the update program; Trainer applies an equivalent pre-update
+        # clip when falling back to the per-param loop.
+        self.clip_global_norm = clip_global_norm
         self.lr = learning_rate if learning_rate is not None else 0.01
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None and learning_rate is not None:
@@ -163,6 +168,37 @@ class Optimizer:
         return -1.0 if self.clip_gradient is None else float(
             self.clip_gradient)
 
+    def _clip_gnorm(self):
+        # getattr: optimizers unpickled from pre-fused checkpoints lack
+        # the attribute
+        v = getattr(self, "clip_global_norm", None)
+        return -1.0 if v is None else float(v)
+
+    # -- fused multi-tensor path ------------------------------------------
+    def fused_update(self, indices, weights, grads, states):
+        """Apply the update for ALL params as ONE compiled dispatch.
+
+        Subclasses with a registered ``multi_*`` op implement this and
+        return True; the base returns False, which sends the caller
+        (``Trainer._update`` via ``Updater.call_fused``) down the
+        per-param loop unchanged.  Implementations must keep the
+        update-count bookkeeping and lr/wd multiplier semantics
+        identical to ``update()`` — the fused and per-param paths are
+        interchangeable step-for-step.
+        """
+        return False
+
+    def _fused_supported(self, weights, grads):
+        """Common eligibility: dense grads, homogeneous precision mode."""
+        if any(getattr(g, "stype", "default") == "row_sparse"
+               for g in grads):
+            return False
+        if self.multi_precision:
+            fp16 = [w.dtype == np.float16 for w in weights]
+            if any(fp16) and not all(fp16):
+                return False
+        return True
+
     def __getstate__(self):
         # param_dict holds live device Parameters (unpicklable and
         # rebindable on load) — Trainer restores it after unpickling
@@ -181,6 +217,39 @@ create = Optimizer.create_optimizer
 def _zeros_like(weight, dtype=None):
     return nd.zeros(weight.shape, ctx=weight.context,
                     dtype=dtype or weight.dtype.name)
+
+
+def _fused_invoke(op_name, nd_inputs, extra_arrays, donate, outs, attrs):
+    """ONE engine dispatch for a multi-tensor optimizer op, with buffer
+    donation and out-buffer write-back.
+
+    ``nd_inputs``: NDArrays in the op's flat layout (weights, grads,
+    state groups); ``extra_arrays``: raw host scalars/vectors appended
+    after them (lrs, wds, rescale_grad — jit stages them, no separate
+    dispatch); ``donate``: positions within the combined array list
+    whose buffers the executable may alias into its outputs (weights +
+    states — NOT grads, whose buffers autograd still owns); ``outs``:
+    NDArrays receiving the op outputs in order.
+
+    Bypasses ``ndarray.invoke`` deliberately: the generic path has no
+    donation concept, and this one call IS the whole optimizer step —
+    the dispatch-count contract (`cache_info()["dispatches"]` +1 per
+    ``Trainer.step``) is asserted in tier-1 tests.
+    """
+    from .. import engine
+    from ..ops.registry import get_op
+    op = get_op(op_name)
+    bufs = [a._data for a in nd_inputs]
+    res = engine.invoke_compiled(op_name, op.fcompute, attrs,
+                                 *bufs, *extra_arrays,
+                                 donate=tuple(donate))
+    if not isinstance(res, tuple):
+        res = (res,)
+    for o, d in zip(outs, res):
+        # the multi ops cast outputs to their input dtypes, so this
+        # swap never needs (and must never take — it would be a second
+        # dispatch) an astype
+        o._set_data(d)
 
 
 @register
@@ -246,6 +315,50 @@ class SGD(Optimizer):
             return (weight32, mom)
         return self.create_state(index, weight)
 
+    def fused_update(self, indices, weights, grads, states):
+        if not self._fused_supported(weights, grads):
+            return False
+        n = len(indices)
+        if n == 0:
+            return True
+        indices = list(indices)
+        self._update_count(indices)
+        lrs = np.asarray(self._get_lrs(indices), np.float32)
+        wds = np.asarray(self._get_wds(indices), np.float32)
+        extra = (lrs, wds, np.float32(self.rescale_grad))
+        attrs = dict(num_weights=n, clip_gradient=self._clip(),
+                     clip_global_norm=self._clip_gnorm())
+        mp = self.multi_precision and weights[0].dtype == np.float16
+        if mp:
+            w32s = [s[0] for s in states]
+            if self.momentum != 0.0:
+                moms = [s[1] for s in states]
+                _fused_invoke(
+                    "multi_mp_sgd_mom_update",
+                    list(weights) + list(grads) + moms + w32s, extra,
+                    tuple(range(n)) + tuple(range(2 * n, 4 * n)),
+                    list(weights) + moms + w32s,
+                    dict(attrs, momentum=self.momentum))
+            else:
+                _fused_invoke(
+                    "multi_mp_sgd_update",
+                    list(weights) + list(grads) + w32s, extra,
+                    tuple(range(n)) + tuple(range(2 * n, 3 * n)),
+                    list(weights) + w32s, attrs)
+        elif self.momentum != 0.0:
+            moms = list(states)
+            _fused_invoke(
+                "multi_sgd_mom_update",
+                list(weights) + list(grads) + moms, extra,
+                tuple(range(n)) + tuple(range(2 * n, 3 * n)),
+                list(weights) + moms,
+                dict(attrs, momentum=self.momentum))
+        else:
+            _fused_invoke(
+                "multi_sgd_update", list(weights) + list(grads), extra,
+                tuple(range(n)), list(weights), attrs)
+        return True
+
 
 @register
 class NAG(Optimizer):
@@ -300,6 +413,38 @@ class Adam(Optimizer):
                        clip_gradient=self._clip(),
                        lazy_update=lazy,
                        out=[weight, mean, var])
+
+    def fused_update(self, indices, weights, grads, states):
+        if not self._fused_supported(weights, grads):
+            return False
+        if self.multi_precision and any(w.dtype == np.float16
+                                        for w in weights):
+            return False  # no fused mp-Adam variant; per-param loop
+        n = len(indices)
+        if n == 0:
+            return True
+        indices = list(indices)
+        self._update_count(indices)
+        # bias-corrected lr per param, same host math as update()
+        lrs = []
+        for i, lr in zip(indices, self._get_lrs(indices)):
+            t = self._index_update_count[i]
+            lrs.append(lr * math.sqrt(1.0 - self.beta2 ** t)
+                       / (1.0 - self.beta1 ** t))
+        means = [s[0] for s in states]
+        variances = [s[1] for s in states]
+        _fused_invoke(
+            "multi_adam_update",
+            list(weights) + list(grads) + means + variances,
+            (np.asarray(lrs, np.float32),
+             np.asarray(self._get_wds(indices), np.float32),
+             np.float32(self.rescale_grad)),
+            tuple(range(n)) + tuple(range(2 * n, 4 * n)),
+            list(weights) + means + variances,
+            dict(num_weights=n, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon, clip_gradient=self._clip(),
+                 clip_global_norm=self._clip_gnorm()))
+        return True
 
 
 @register
@@ -508,6 +653,39 @@ class LAMB(Optimizer):
         nd.lamb_update_phase2(weight, g_update, r1, r2, lr=lr,
                               lower_bound=lb, upper_bound=ub, out=weight)
 
+    def fused_update(self, indices, weights, grads, states):
+        if not self._fused_supported(weights, grads):
+            return False
+        if self.multi_precision and any(w.dtype == np.float16
+                                        for w in weights):
+            return False
+        n = len(indices)
+        if n == 0:
+            return True
+        indices = list(indices)
+        self._update_count(indices)
+        ts = np.asarray([self._index_update_count[i] for i in indices],
+                        np.float32)
+        means = [s[0] for s in states]
+        variances = [s[1] for s in states]
+        lb = -1.0 if self.lower_bound is None else float(self.lower_bound)
+        ub = -1.0 if self.upper_bound is None else float(self.upper_bound)
+        _fused_invoke(
+            "multi_lamb_update",
+            list(weights) + list(grads) + means + variances,
+            (np.asarray(self._get_lrs(indices), np.float32),
+             np.asarray(self._get_wds(indices), np.float32), ts,
+             np.float32(self.rescale_grad)),
+            tuple(range(n)) + tuple(range(2 * n, 4 * n)),
+            list(weights) + means + variances,
+            dict(num_weights=n, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon,
+                 bias_correction=self.bias_correction,
+                 lower_bound=lb, upper_bound=ub,
+                 clip_gradient=self._clip(),
+                 clip_global_norm=self._clip_gnorm()))
+        return True
+
 
 @register
 class Test(Optimizer):
@@ -542,11 +720,28 @@ class Updater:
         grads = grad if isinstance(grad, (list, tuple)) else [grad]
         weights = weight if isinstance(weight, (list, tuple)) else [weight]
         for i, g, w in zip(indices, grads, weights):
-            if i not in self.states:
-                self.states[i] = \
-                    self.optimizer.create_state_multi_precision(i, w)
-                self.states_synced[i] = True
+            self._ensure_state(i, w)
             self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def _ensure_state(self, i, w):
+        if i not in self.states:
+            self.states[i] = \
+                self.optimizer.create_state_multi_precision(i, w)
+            self.states_synced[i] = True
+
+    def call_fused(self, indices, grads, weights):
+        """One-dispatch multi-tensor update via the optimizer's
+        ``fused_update`` hook.  States are created lazily through the
+        SAME ``create_state_multi_precision`` path as ``__call__``, so
+        ``get_states``/``set_states`` serialization is identical
+        whichever path ran.  Returns False when the optimizer has no
+        fused implementation (caller falls back to the per-param loop).
+        """
+        for i, w in zip(indices, weights):
+            self._ensure_state(i, w)
+        states = [self.states[i] for i in indices]
+        return self.optimizer.fused_update(indices, weights, grads,
+                                           states)
 
     def get_states(self, dump_optimizer=False):
         states = {k: _states_to_np(v) for k, v in self.states.items()}
