@@ -534,22 +534,17 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
             bulk = int(os.environ.get("MXTPU_BENCH_BULK", "8")) \
                 if on_tpu else 1
         if bulk > 1:
-            data_k = tuple(nd.array(
-                np.broadcast_to(a.asnumpy()[None],
-                                (bulk,) + a.shape).copy(), ctx=ctx)
-                for a in data)
-            label_k = nd.array(
-                np.broadcast_to(label.asnumpy()[None],
-                                (bulk,) + label.shape).copy(), ctx=ctx)
+            # repeat-mode scan: K steps over this batch as ONE program
+            # input — no host-side (K, B, ...) broadcast materialized
             _log(f"{builder_name}: bulking {bulk} steps/dispatch")
-            dpt.step_multi(data_k, label_k).wait_to_read()  # compile
+            dpt.step_multi(data, label, repeat=bulk).wait_to_read()
 
         def timed_window(n):
             t0 = time.perf_counter()
             last = None
             for _ in range(n):
-                last = dpt.step_multi(data_k, label_k) if bulk > 1 \
-                    else dpt.step(data, label)
+                last = dpt.step_multi(data, label, repeat=bulk) \
+                    if bulk > 1 else dpt.step(data, label)
             val = float(np.asarray(last.asnumpy()).ravel()[-1])
             assert np.isfinite(val)          # cannot return early
             return time.perf_counter() - t0
@@ -632,31 +627,32 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
         y = mx.nd.array(
             np.random.randint(0, 10, batch_size).astype("f4"), ctx=ctx)
 
-        def step():
-            with autograd.record():
-                out = net(x)
-                loss = loss_fn(out, y)
-            loss.backward()
-            trainer.step(batch_size)
-            return loss
-
+        # the hot path is the ONE-dispatch compiled step (tier-1
+        # verified bit-identical to record/backward/step); it falls
+        # back to eager transparently if ineligible
+        cs = trainer.compile_step(net, loss_fn)
         for _ in range(warmup):
-            step()
+            loss = cs.step(x, y, batch_size)
         mx.nd.waitall()
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss = step()
+            loss = cs.step(x, y, batch_size)
         loss.wait_to_read()
         mx.nd.waitall()
         dt = time.perf_counter() - t0
 
-        # steady-state optimizer dispatch count: bracket ONE more
-        # trainer.step with the engine's dispatch counter (forward/
-        # backward run before the bracket).  1 on the fused path; ~P
-        # (params) on the per-param loop — the emitted JSON carries it
-        # so a regression back to dispatch-bound updates is visible in
-        # the bench series, not just in tier-1 tests.
+        # dispatch accounting for the bench series (regressions back to
+        # dispatch-bound stepping must be visible here, not only in
+        # tier-1 tests):
+        # * train_step_dispatches_per_step — the WHOLE step through the
+        #   compiled path (1 = forward+backward+optimizer collapsed);
+        # * optimizer_dispatches_per_step — the eager path's
+        #   optimizer-only count (1 on the PR2 fused path; ~P on the
+        #   per-param loop), PR 2's original series.
         from mxnet_tpu import engine
+        d0 = engine.cache_info()["dispatches"]
+        cs.step(x, y, batch_size)
+        train_dispatches = engine.cache_info()["dispatches"] - d0
         with autograd.record():
             out = net(x)
             l = loss_fn(out, y)
@@ -665,7 +661,7 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
         trainer.step(batch_size)
         opt_dispatches = engine.cache_info()["dispatches"] - d0
         mx.nd.waitall()
-    return batch_size * steps / dt, opt_dispatches
+    return batch_size * steps / dt, opt_dispatches, train_dispatches
 
 
 def _run_cpu_smoke_subprocess(sub_budget=240):
@@ -780,15 +776,18 @@ def main():
     if not on_tpu:
         try:
             _log("stage 1: MLP trainer bench")
-            sps, opt_disp = bench_mlp_train()
+            sps, opt_disp, train_disp = bench_mlp_train()
             _record("mlp_train", samples_per_sec=round(sps, 2),
                     platform=platform,
-                    optimizer_dispatches_per_step=opt_disp)
+                    optimizer_dispatches_per_step=opt_disp,
+                    train_step_dispatches_per_step=train_disp)
             _set_result("mlp_mnist_train_samples_per_sec", sps,
                         degraded="tpu unreachable; cpu backend",
-                        optimizer_dispatches_per_step=opt_disp)
+                        optimizer_dispatches_per_step=opt_disp,
+                        train_step_dispatches_per_step=train_disp)
             _log(f"stage 1 done: {sps:.1f} samples/sec, "
-                 f"{opt_disp} optimizer dispatch(es)/step")
+                 f"{train_disp} train-step dispatch(es)/step, "
+                 f"{opt_disp} optimizer dispatch(es)/eager-step")
         except Exception as e:
             traceback.print_exc(file=sys.stderr)
             _record("mlp_train", error=repr(e))

@@ -233,9 +233,15 @@ class NativeEngine:
         return self._lib.MXTPUEngineVarVersion(self._h, var)
 
     def close(self):
-        if self._h:
-            self._lib.MXTPUEngineFree(self._h)
-            self._h = None
+        # atomic handle swap under the lock: close() is reachable from
+        # a pool's off-thread drain AND from __del__ on the GC thread —
+        # the naive check-then-free raced them into a double
+        # MXTPUEngineFree (observed segfault when several DataLoader
+        # pools were collected while one was still draining)
+        with self._cb_lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.MXTPUEngineFree(h)
 
     def __del__(self):
         try:

@@ -20,7 +20,7 @@ from .findings import (Finding, Severity, RULES, rule_severity,
 from .graph_passes import analyze_symbol, analyze_graph_json, node_path
 from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
-from .runtime import analyze_cache
+from .runtime import analyze_cache, analyze_compiled_steps
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -29,7 +29,7 @@ __all__ = [
     "analyze_symbol", "analyze_graph_json", "node_path",
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
-    "analyze_cache",
+    "analyze_cache", "analyze_compiled_steps",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]

@@ -57,6 +57,10 @@ RULES = {
     "MXL302": (Severity.WARNING, "device sync inside hybrid_forward"),
     "MXL303": (Severity.WARNING,
                "per-step-varying static attr (recompile per value)"),
+    "MXL304": (Severity.WARNING,
+               "per-op training loop without step compilation"),
+    "MXL305": (Severity.WARNING,
+               "CompiledStep silently fell back to the eager path"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
 }

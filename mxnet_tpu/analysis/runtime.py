@@ -1,11 +1,16 @@
-"""Runtime-hazard pass (rule MXL4xx): jit-cache key blowup.
+"""Runtime-hazard passes: observed dispatch/cache pathologies.
 
-The static source pass (MXL303) predicts retrace storms; this pass
-*observes* them: after running a workload, ``engine.cache_info()`` shows
-how many distinct executables each op compiled.  An op with many cache
-entries whose keys differ only in the values of one or two attrs is
-recompiling per value — the attr should ride the dynamic-scalar path
-(``scalar_attrs``) or be hoisted to a constant.
+The static source passes predict hazards; these passes *observe* them
+after a workload ran:
+
+* MXL401 — jit-cache key blowup via ``engine.cache_info()``: an op with
+  many cache entries whose keys differ only in one or two attr values is
+  recompiling per value; the attr should ride the dynamic-scalar path
+  (``scalar_attrs``) or be hoisted to a constant.
+* MXL305 — silent CompiledStep degradation: a training loop that asked
+  for the one-dispatch compiled step but is actually running per-op
+  eager dispatches (non-hybridizable forward, optimizer without a fused
+  program, ...).  The finding carries the recorded fallback reason.
 """
 from __future__ import annotations
 
@@ -13,7 +18,7 @@ from typing import List
 
 from .findings import Finding
 
-__all__ = ["analyze_cache"]
+__all__ = ["analyze_cache", "analyze_compiled_steps"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -49,3 +54,17 @@ def analyze_cache(threshold: int = 8) -> List[Finding]:
             f"entries (threshold {threshold}){detail}",
             f"cache:{name}"))
     return findings
+
+
+def analyze_compiled_steps() -> List[Finding]:
+    """One MXL305 finding per CompiledStep that silently fell back to
+    the eager per-op path this process (``compiled_step.
+    fallback_reports()``).  The explicit ``MXTPU_COMPILED_STEP=0``
+    escape hatch never reports — only surprising degradations do."""
+    from ..gluon import compiled_step as _cs
+    return [
+        Finding("MXL305",
+                f"compiled train step {name!r} silently fell back to "
+                f"the eager per-op path: {reason}",
+                f"step:{name}")
+        for name, reason in _cs.fallback_reports()]

@@ -19,6 +19,15 @@ Heuristics are deliberately scoped to keep the signal high:
   references a name the loop itself changes — the jit cache keys on the
   value, so each step compiles a fresh executable.  The fix is usually
   declaring the attr in ``scalar_attrs``.
+* MXL304 fires for a classic per-op training loop —
+  ``autograd.record()`` + ``.backward()`` + ``.step()`` in one loop
+  body — in a module that never touches step compilation
+  (``Trainer.compile_step`` / ``CompiledStep`` / the SPMD
+  ``DataParallelTrainer``): a hybridize-eligible block there pays one
+  dispatch per op when it could pay one per STEP (docs/compiled_step.md).
+  Its runtime sibling MXL305 (``analyze_compiled_steps``) reports when
+  a CompiledStep was requested but silently fell back to eager, with
+  the recorded reason.
 
 Suppress any rule on a line with ``# mxlint: disable=MXL301`` (comma-
 separated IDs) or every rule with a bare ``# mxlint: disable``.
@@ -38,6 +47,10 @@ _SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read", "item", "tolist"}
 _CAST_BUILTINS = {"float", "int", "bool"}
 _OP_NAMESPACES = {"nd", "F", "sym", "ndarray", "symbol"}
 _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+# any of these names in a module means the author already uses step
+# compilation somewhere — MXL304 stays quiet for the whole file
+_STEP_COMPILE_MARKERS = {"compile_step", "CompiledStep", "step_multi",
+                         "DataParallelTrainer"}
 
 
 def _attr_chain(node) -> List[str]:
@@ -79,6 +92,34 @@ def _training_markers(loop) -> bool:
     return False
 
 
+def _per_op_step_loop(loop) -> bool:
+    """True for the full record+backward+step triple in one loop body —
+    the shape ``Trainer.compile_step`` collapses to one dispatch."""
+    has_record = has_backward = has_step = False
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "backward":
+                    has_backward = True
+                elif f.attr == "step":
+                    has_step = True
+            chain = _attr_chain(f)
+            if chain and chain[-1] == "record":
+                has_record = True
+    return has_record and has_backward and has_step
+
+
+def _module_uses_step_compilation(tree) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and \
+                n.attr in _STEP_COMPILE_MARKERS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _STEP_COMPILE_MARKERS:
+            return True
+    return False
+
+
 def _loop_varying_names(loop) -> Set[str]:
     """Names the loop changes: induction targets + assignment targets in
     the body (these are the candidates for per-step attr values)."""
@@ -111,11 +152,12 @@ def _get_op(opname: str):
 
 
 class _SourceVisitor(ast.NodeVisitor):
-    def __init__(self, filename: str):
+    def __init__(self, filename: str, uses_step_compilation=False):
         self.filename = filename
         self.findings: List[Finding] = []
-        self._loops: List[dict] = []       # {training, varying}
+        self._loops: List[dict] = []       # {training, varying, per_op}
         self._hybrid_depth = 0
+        self._uses_step_compilation = uses_step_compilation
 
     # -- helpers ---------------------------------------------------------
     def _loc(self, node) -> str:
@@ -132,8 +174,21 @@ class _SourceVisitor(ast.NodeVisitor):
 
     # -- structure -------------------------------------------------------
     def _visit_loop(self, node):
+        per_op = False
+        if not self._uses_step_compilation and \
+                not any(l["per_op"] for l in self._loops) and \
+                _per_op_step_loop(node):
+            per_op = True   # flag the OUTERMOST qualifying loop only
+            self.findings.append(Finding(
+                "MXL304", "training loop runs record()+backward()+"
+                "step() per-op: a hybridize-eligible block here pays "
+                "one dispatch per op each step; Trainer.compile_step "
+                "collapses the whole step (and step_multi(K) bulks K "
+                "steps) into ONE dispatch — see docs/compiled_step.md",
+                self._loc(node)))
         self._loops.append({"training": _training_markers(node),
-                            "varying": _loop_varying_names(node)})
+                            "varying": _loop_varying_names(node),
+                            "per_op": per_op})
         self.generic_visit(node)
         self._loops.pop()
 
@@ -240,7 +295,9 @@ def analyze_source(text: str, filename: str = "<string>") -> List[Finding]:
         # not our diagnostic to own — report nothing; CI's own syntax
         # gates catch it
         return []
-    v = _SourceVisitor(filename)
+    v = _SourceVisitor(
+        filename,
+        uses_step_compilation=_module_uses_step_compilation(tree))
     v.visit(tree)
     return _apply_suppressions(v.findings, text)
 

@@ -27,7 +27,8 @@ import weakref
 from typing import Any, Callable, Dict, Tuple
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
-           "cache_info", "cache_size", "clear_cache", "reset_counters"]
+           "cache_info", "cache_size", "clear_cache", "drop_cached",
+           "reset_counters"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -223,6 +224,24 @@ def cache_info() -> dict:
 def clear_cache():
     with _lock:
         _jit_cache.clear()
+
+
+def drop_cached(name: str) -> int:
+    """Evict every cache entry for op ``name``; returns the count.
+
+    Exists for callers whose compiled program BAKES host state that can
+    legitimately change between calls (``gluon.CompiledStep`` bakes the
+    optimizer's static attrs — momentum, betas, clip bounds): when the
+    baked value drifts, the stale executable must be dropped and
+    rebuilt rather than silently applying the old value.  Per-name so a
+    single invalidation cannot flush the whole process's warm cache.
+    """
+    with _lock:
+        stale = [k for k in _jit_cache
+                 if (k == name if isinstance(k, str) else k[0] == name)]
+        for k in stale:
+            del _jit_cache[k]
+    return len(stale)
 
 
 def reset_counters():

@@ -99,13 +99,27 @@ class NativeEnginePool:
             else:
                 # EngineFree drains in-flight jobs before joining, so a
                 # synchronous close() here would block (the executor
-                # contract says wait=False must not); drain off-thread
-                threading.Thread(target=self._engine.close,
-                                 daemon=True).start()
+                # contract says wait=False must not); drain off-thread.
+                # During interpreter finalization Thread.start() HANGS
+                # on its started-event (the new thread never runs), so
+                # close inline there — the pool is idle by then and the
+                # no-block contract is moot.
+                import sys
+                if sys.is_finalizing():
+                    self._engine.close()
+                else:
+                    try:
+                        threading.Thread(target=self._engine.close,
+                                         daemon=True).start()
+                    except RuntimeError:
+                        self._engine.close()
 
     def __del__(self):
+        # synchronous shutdown: a collected pool has no consumer left
+        # to race, and the wait=False drain thread cannot start during
+        # interpreter finalization anyway
         try:
-            self.shutdown(wait=False)
+            self.shutdown(wait=True)
         except Exception:
             pass
 

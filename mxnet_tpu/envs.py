@@ -96,6 +96,22 @@ _reg("MXTPU_FUSED_UPDATE", bool, True,
      "when the optimizer supports it. 0 restores the per-parameter "
      "update loop (numerically identical; ~P dispatches per step for "
      "P parameters).")
+_reg("MXTPU_COMPILED_STEP", bool, True,
+     "Route gluon.CompiledStep (Trainer.compile_step) through the "
+     "one-dispatch compiled train step: forward + backward + the fused "
+     "optimizer update as ONE donated XLA program, with step_multi(K) "
+     "bulking K steps per dispatch. 0 forces the eager "
+     "record/backward/step path (numerically identical; one dispatch "
+     "per op).")
+_reg("MXTPU_PREFETCH_TO_DEVICE", bool, False,
+     "DataLoader default when prefetch_to_device is not passed: stage "
+     "upcoming batches on the device ahead of the consumer so the "
+     "async host->device copy overlaps device execution "
+     "(double-buffered).")
+_reg("MXTPU_PREFETCH_DEPTH", int, 2,
+     "How many batches the DataLoader keeps in flight on the device "
+     "when prefetch-to-device is active (2 = classic double "
+     "buffering).")
 _reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
      "Accepted for parity; XLA fuses whole graphs at the hybridize "
      "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")

@@ -6,6 +6,7 @@ from .parameter import (Parameter, ParameterDict, Constant,
                         DeferredInitializationError)
 from .block import Block, HybridBlock, SymbolBlock, CachedOp
 from .trainer import Trainer
+from .compiled_step import CompiledStep
 from . import nn
 from . import loss
 from . import utils
@@ -14,5 +15,5 @@ from . import rnn
 from . import model_zoo
 
 __all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
-           "SymbolBlock", "CachedOp", "Trainer", "nn", "loss", "utils",
-           "data", "DeferredInitializationError"]
+           "SymbolBlock", "CachedOp", "Trainer", "CompiledStep", "nn",
+           "loss", "utils", "data", "DeferredInitializationError"]

@@ -1,0 +1,644 @@
+"""CompiledStep: the whole Gluon training step as ONE device dispatch.
+
+PR 2 collapsed the optimizer into one dispatch; this module collapses
+the REST of the step.  A hybridized ``HybridBlock`` forward still runs
+one compiled program per op, ``autograd.backward`` replays one vjp per
+recorded node, and only then does the fused optimizer program run — on
+a remote PJRT tunnel every one of those dispatches is a full RPC round
+trip (~30 ms measured), so a 50-op forward is pure overhead.
+``CompiledStep`` traces forward + loss + backward + the optimizer's
+fused multi-tensor program into a single donated XLA executable:
+
+    (params, states, scalars, inputs, label, key)
+        -> (loss, new_params, new_states, aux)
+
+Mechanics (the same seams ``CachedOp`` and ``parallel.trainer`` use):
+
+* the block's imperative forward runs under ``tracing_scope`` (the
+  CachedOp export-trace seam) with parameter buffers swapped for traced
+  values; gradients come from ``jax.value_and_grad`` of the loss SUM —
+  exactly the ones-cotangent ``loss.backward()`` applies;
+* parameter mutation inside forward (BatchNorm running stats) is
+  functionalized by version-drift detection and returned as ``aux``
+  outputs, written back after the dispatch;
+* dropout RNG is a per-step base-key INPUT + the same per-request
+  ``fold_in`` scheme as CachedOp, so masks match the eager hybridized
+  path bit-for-bit and fresh keys never retrace;
+* the optimizer update is the registered ``multi_*`` program from
+  ``Optimizer._fused_plan`` spliced into the trace; its per-step host
+  scalars (lr schedule / wd / Adam bias correction / rescale_grad) ride
+  as ARRAY INPUTS via ``fused_step_scalars`` — schedulers never
+  recompile.  Static attrs (momentum, betas, clip bounds) ARE baked;
+  the plan attrs are re-derived every step and a drift evicts the stale
+  executable (``engine.drop_cached``) instead of applying old values;
+* trainable-weight and optimizer-state buffers are DONATED — a
+  BERT-sized step does not double live HBM.  The donation contract and
+  failure protocol (poisoning after a post-donation failure) mirror the
+  fused optimizer and SPMD trainer;
+* ``step_multi(K)`` bulks K real optimizer steps into one dispatch via
+  ``lax.scan`` with params+states as the carry — K-step schedules, RNG
+  keys, and Adam bias correction are threaded per inner step, so the
+  result is bit-identical to K ``step()`` calls.
+
+Entry point: ``trainer.compile_step(net, loss_fn)``.  The escape hatch
+``MXTPU_COMPILED_STEP=0`` and any ineligibility (non-hybridizable
+forward, optimizer without a fused program, distributed kvstore,
+``grad_req='add'``, …) fall back TRANSPARENTLY to the eager
+record/backward/step path; silent fallbacks are recorded in a module
+registry that mxlint surfaces as MXL305 findings (the finding carries
+the reason).  See docs/compiled_step.md.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from . import block as block_mod
+
+__all__ = ["CompiledStep", "fallback_reports", "clear_fallback_reports"]
+
+
+# -- silent-fallback registry (read by mxlint's MXL305 runtime pass) -------
+_fallback_log: List[Tuple[str, str]] = []
+_fallback_lock = threading.Lock()
+
+
+def fallback_reports() -> List[Tuple[str, str]]:
+    """``[(step_name, reason), ...]`` for every CompiledStep that
+    silently degraded to the eager path this process.  The explicit
+    ``MXTPU_COMPILED_STEP=0`` escape hatch is NOT recorded — the user
+    asked for eager; only surprising degradations are findings."""
+    with _fallback_lock:
+        return list(_fallback_log)
+
+
+def clear_fallback_reports():
+    with _fallback_lock:
+        _fallback_log.clear()
+
+
+def _record_fallback(name: str, reason: str):
+    with _fallback_lock:
+        _fallback_log.append((name, reason))
+
+
+def _flatten_state(state, out: List[NDArray]):
+    """Flat NDArray leaves of an updater state tree (None leaves skipped
+    — they carry no buffer and rebuild positionally)."""
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        out.append(state)
+        return
+    if isinstance(state, (list, tuple)):
+        for s in state:
+            _flatten_state(s, out)
+        return
+    raise MXNetError(f"unsupported optimizer state leaf: {type(state)}")
+
+
+def _rebuild_state(template, leaves_iter):
+    """Rebuild a state tree in the template's structure, drawing leaves
+    (in ``_flatten_state`` order) from ``leaves_iter``."""
+    if template is None:
+        return None
+    if isinstance(template, NDArray):
+        return next(leaves_iter)
+    return tuple(_rebuild_state(t, leaves_iter) for t in template)
+
+
+class CompiledStep:
+    """One-dispatch train step for ``(net, loss_fn, trainer)``.
+
+    Build via ``trainer.compile_step(net, loss_fn)``.  ``step(data,
+    label, batch_size=None)`` runs forward+backward+update as one
+    donated dispatch and returns the (unreduced) loss; ``step_multi``
+    runs K steps per dispatch.  ``last_path`` reports which path the
+    previous call took (``"compiled"`` / ``"eager"``) and
+    ``fallback_reason`` the sticky degradation reason, if any.
+    """
+
+    # atomic (GIL-safe) id mint: the uid lands in the engine cache KEY,
+    # and two steps sharing a name would silently run each other's
+    # traced program
+    _uid = __import__("itertools").count(1)
+
+    def __init__(self, net, loss_fn: Callable, trainer):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.trainer = trainer
+        self.name = f"gluon_train_step_{net.name}_{next(CompiledStep._uid)}"
+        self._setup_done = False
+        self._params = None
+        self._tr_idx: List[int] = []
+        self.fallback_reason: Optional[str] = None
+        self.last_path: Optional[str] = None
+        self._poisoned: Optional[str] = None
+        # trace-time structure (populated while jax traces _core)
+        self._mutated_idx: List[int] = []
+        self._core = None
+        self._core_shape = None
+        self._sig = None
+        self._active_names = {self.name}
+
+    # -- public API -------------------------------------------------------
+    def step(self, data, label, batch_size=None):
+        """ONE training step; returns the loss NDArray (unreduced, like
+        the eager ``loss_fn`` output).  ``batch_size`` defaults to the
+        leading dimension of ``label`` and folds into ``rescale_grad``
+        as a dynamic scalar (parity: ``Trainer.step(batch_size)``)."""
+        from .. import profiler
+        args, label = self._coerce(data, label)
+        if batch_size is None:
+            batch_size = label.shape[0] if label.shape else \
+                args[0].shape[0]
+        with profiler._span(f"CompiledStep[{self.net.name}]",
+                            "compiled_step") as sp:
+            out = self._step_or_fallback(args, label, batch_size)
+            sp.sync(out._data)
+            return out
+
+    def step_multi(self, data, label, batch_size=None, repeat=None):
+        """K optimizer steps as ONE dispatch; returns the (K, ...)
+        per-step losses.
+
+        Without ``repeat``: ``data``/``label`` carry a leading K dim and
+        inner step k consumes slice k.  With ``repeat=K``: single-batch
+        ``data``/``label`` are reused for every inner step WITHOUT
+        materializing K host copies (the batch is an ordinary program
+        input the scan body closes over).  Per-inner-step RNG keys and
+        optimizer scalars (schedules, Adam bias correction) are
+        threaded, so K bulked steps are bit-identical to K ``step()``
+        calls.
+        """
+        from .. import profiler
+        args, label = self._coerce(data, label)
+        if repeat is not None:
+            k_steps = int(repeat)
+            if k_steps <= 0:
+                raise MXNetError(f"repeat must be positive, got {repeat}")
+        else:
+            k_steps = args[0].shape[0]
+            if label.shape[0] != k_steps:
+                raise MXNetError(
+                    f"step_multi: label leading dim {label.shape[0]} != "
+                    f"data leading dim {k_steps}")
+        if batch_size is None:
+            # per-inner-step batch dim, matching step()'s fallback
+            # (label first, then data — never a feature dim)
+            lshape = label.shape if repeat is not None else \
+                label.shape[1:]
+            dshape = args[0].shape if repeat is not None else \
+                args[0].shape[1:]
+            batch_size = lshape[0] if lshape else (
+                dshape[0] if dshape else 1)
+        with profiler._span(f"CompiledStep[{self.net.name}].multi",
+                            "compiled_step_multi") as sp:
+            out = self._step_or_fallback(args, label, batch_size,
+                                         k_steps=k_steps,
+                                         repeat=repeat is not None)
+            sp.sync(out._data)
+            return out
+
+    # -- path selection ---------------------------------------------------
+    def _coerce(self, data, label):
+        from .. import ndarray as nd
+        args = list(data) if isinstance(data, (list, tuple)) else [data]
+        args = [a if isinstance(a, NDArray)
+                else nd.array(np.asarray(a), dtype=np.asarray(a).dtype)
+                for a in args]
+        if not isinstance(label, NDArray):
+            label = nd.array(np.asarray(label),
+                             dtype=np.asarray(label).dtype)
+        return args, label
+
+    def _step_or_fallback(self, args, label, batch_size, k_steps=None,
+                          repeat=False):
+        from .. import envs
+        if self._poisoned is not None:
+            raise MXNetError(
+                "this CompiledStep's weight/optimizer-state buffers were "
+                "donated to a dispatch that failed and are no longer "
+                "valid; rebuild the trainer/step and restore from a "
+                f"checkpoint. Original error: {self._poisoned}")
+        if not envs.get("MXTPU_COMPILED_STEP"):
+            # explicit escape hatch: eager, but NOT a silent fallback
+            return self._eager(args, label, batch_size, k_steps, repeat)
+        if self.fallback_reason is not None:
+            return self._eager(args, label, batch_size, k_steps, repeat)
+        if not self._setup_done:
+            self._setup(args if k_steps is None or repeat
+                        else [a[0] for a in args])
+        reason = self._eligibility()
+        if reason is not None:
+            self._fall_back(reason)
+            return self._eager(args, label, batch_size, k_steps, repeat)
+        try:
+            return self._dispatch(args, label, batch_size, k_steps,
+                                  repeat)
+        except _TraceFallback as e:
+            self._fall_back(str(e))
+            return self._eager(args, label, batch_size, k_steps, repeat)
+
+    def _fall_back(self, reason: str):
+        self.fallback_reason = reason
+        _record_fallback(self.name, reason)
+
+    # -- setup / eligibility ----------------------------------------------
+    def _setup(self, args):
+        from .. import autograd
+        tr = self.trainer
+        params = list(tr._params)
+        if any(p._deferred_init for p in params):
+            # one IMPERATIVE warm-up resolves every deferred shape —
+            # _call_unhybridized, exactly like CachedOp's warm-up, so
+            # the global RNG stream advances by the same draws as the
+            # eager hybridized path's first call (a full net() here
+            # would run CachedOp and consume one extra base key,
+            # desynchronizing dropout masks from the eager path)
+            with autograd.pause():
+                if hasattr(self.net, "_call_unhybridized"):
+                    self.net._call_unhybridized(*args)
+                else:
+                    self.net(*args)
+        self._params = params
+        self._tr_idx = [i for i, p in enumerate(params)
+                        if p.grad_req != "null"]
+        tr._optimizer._set_current_context(0)
+        upd = tr._updaters[0]
+        for i in self._tr_idx:
+            upd._ensure_state(i, params[i].data())
+        self._setup_done = True
+
+    def _eligibility(self) -> Optional[str]:
+        """None when the compiled path may run, else the fallback
+        reason.  Cheap (host-only), re-checked every step so e.g. a
+        kvstore initialized later is still honored."""
+        tr = self.trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._update_on_kvstore:
+            return ("update_on_kvstore=True: server-side updates see "
+                    "one gradient at a time")
+        if tr._kvstore is not None and tr._kvstore.is_distributed:
+            return ("distributed kvstore: gradient exchange happens "
+                    "outside the step program")
+        if tr._compression_params is not None:
+            return "gradient compression configured on the kvstore"
+        if len(tr._contexts) != 1:
+            return (f"{len(tr._contexts)} device contexts (compiled "
+                    "step is single-context; use parallel."
+                    "DataParallelTrainer for SPMD)")
+        if any(p.grad_req == "add" for p in tr._params):
+            return ("grad_req='add': gradient accumulation across "
+                    "backwards has no one-step equivalent")
+        if not self._tr_idx:
+            return "no trainable parameters"
+        from .. import envs
+        if not envs.get("MXTPU_FUSED_UPDATE"):
+            return ("MXTPU_FUSED_UPDATE=0 disables the fused optimizer "
+                    "program the compiled step splices in")
+        # optimizer-capability checks (fused plan / tensor support) run
+        # in _check_sig, which builds the plan ONCE per dispatch anyway
+        return None
+
+    # -- eager path --------------------------------------------------------
+    def _eager(self, args, label, batch_size, k_steps=None, repeat=False):
+        from .. import autograd
+        from .. import ndarray as nd
+        self.last_path = "eager"
+
+        def one(a, l):
+            with autograd.record():
+                out = self.net(*a)
+                loss = self.loss_fn(out, l)
+            autograd.backward([loss])
+            self.trainer.step(batch_size)
+            return loss
+
+        if k_steps is None:
+            return one(args, label)
+        losses = []
+        for k in range(k_steps):
+            a = args if repeat else [x[k] for x in args]
+            l = label if repeat else label[k]
+            losses.append(one(a, l))
+        return nd.stack(*losses)
+
+    # -- compiled path -----------------------------------------------------
+    def _state_leaves(self) -> List[NDArray]:
+        """Fresh each step: ``load_states`` swaps the NDArray objects,
+        so cached leaves would silently update dead buffers."""
+        upd = self.trainer._updaters[0]
+        leaves: List[NDArray] = []
+        for i in self._tr_idx:
+            _flatten_state(upd.states[i], leaves)
+        return leaves
+
+    def _check_sig(self, n_state, n_args):
+        """Build this step's plan (the optimizer's static surface) and
+        evict stale executables when it drifted (momentum/beta/clip/...
+        changes are baked into the trace — correctness over cache
+        warmth).  Also the capability gate: raises ``_TraceFallback``
+        (caught upstream → transparent eager) when the optimizer has no
+        fused program or the tensors are unsupported."""
+        from .. import engine
+        tr = self.trainer
+        opt = tr._optimizer
+        weights = [self._params[i].data() for i in self._tr_idx]
+        upd = tr._updaters[0]
+        if not opt._fused_supported(weights, weights):
+            raise _TraceFallback(
+                "optimizer tensors unsupported by the fused path "
+                "(sparse grads or mixed precision set)")
+        plan = opt._fused_plan(list(self._tr_idx), weights, weights,
+                               [upd.states[i] for i in self._tr_idx])
+        if plan is None:
+            raise _TraceFallback(
+                f"optimizer {type(opt).__name__} has no fused "
+                "multi-tensor program (_fused_plan returned None)")
+        sig = (plan.op_name, tuple(sorted(plan.attrs.items())),
+               n_state, n_args)
+        if self._sig is not None and sig != self._sig:
+            for name in self._active_names:
+                engine.drop_cached(name)
+            self._core = None
+            self._core_shape = None
+        self._sig = sig
+
+    def _dispatch(self, args, label, batch_size, k_steps=None,
+                  repeat=False):
+        import jax
+        import jax.numpy as jnp
+        from .. import engine
+        from .. import random as _rnd
+        tr = self.trainer
+        opt = tr._optimizer
+        ctx = args[0].context
+        params = self._params
+        tr_idx = self._tr_idx
+        n_args = len(args)
+
+        opt.rescale_grad = tr._scale / batch_size
+        opt._set_current_context(0)
+        leaf_nds = self._state_leaves()
+        P, S = len(params), len(leaf_nds)
+        self._check_sig(S, n_args)
+
+        # host bookkeeping snapshot: a pre-dispatch (trace/compile)
+        # failure must rewind counts and the RNG stream so the eager
+        # fallback replays the step identically
+        count_snap = (dict(opt._index_update_count), opt.num_update)
+        key_snap = dict(_rnd._keys)
+        idx = list(tr_idx)
+        if k_steps is None:
+            opt._update_count(idx)
+            scal_rows = [opt.fused_step_scalars(idx)]
+            keys = [_rnd._next_key_nd(ctx)._data]
+        else:
+            scal_rows = []
+            keys = []
+            for _ in range(k_steps):
+                opt._update_count(idx)
+                scal_rows.append(opt.fused_step_scalars(idx))
+                keys.append(_rnd._next_key_nd(ctx)._data)
+        C = len(scal_rows[0])
+        if k_steps is None:
+            scal_vals = list(scal_rows[0])
+            key_vals = [keys[0]]
+        else:
+            scal_vals = [np.stack([np.asarray(r[c]) for r in scal_rows])
+                         for c in range(C)]
+            key_vals = [jnp.stack(keys)]
+
+        core = self._get_core(P, S, C, n_args, ctx)
+        if k_steps is None:
+            pure = self._make_pure(core, P, S, C)
+            name = self.name
+            # donate trainable weights + ALL optimizer state leaves;
+            # frozen params and the (autograd-owned) inputs are not ours
+            # to alias
+            donate = tuple(tr_idx) + tuple(range(P, P + S))
+        else:
+            pure = self._make_pure_k(core, P, S, C, n_args, k_steps,
+                                     repeat)
+            name = f"{self.name}_k{k_steps}" + ("r" if repeat else "")
+            self._active_names.add(name)
+            # the scan carries (and returns) EVERY param, so all of
+            # them may donate
+            donate = tuple(range(P + S))
+
+        flat = [p.data()._data for p in params] \
+            + [s._data for s in leaf_nds] + scal_vals \
+            + [a._data for a in args] + [label._data] + key_vals
+        try:
+            res = engine.invoke_compiled(name, pure, {}, *flat,
+                                         donate=donate)
+        except Exception as e:
+            consumed = any(getattr(v, "is_deleted", lambda: False)()
+                           for v in flat)
+            if consumed:
+                # post-donation failure: the old buffers are gone and
+                # no new ones exist — training state is unrecoverable
+                # (same protocol as the fused optimizer / SPMD trainer)
+                self._poisoned = repr(e)
+                raise MXNetError(
+                    "compiled train step failed AFTER its weight/state "
+                    "buffers were donated; rebuild the trainer and "
+                    "restore from a checkpoint. Original error: "
+                    f"{e!r}") from e
+            # pre-dispatch failure (trace/compile): rewind host state
+            # and let the caller fall back to eager transparently
+            opt._index_update_count.clear()
+            opt._index_update_count.update(count_snap[0])
+            opt.num_update = count_snap[1]
+            _rnd._keys.clear()
+            _rnd._keys.update(key_snap)
+            raise _TraceFallback(
+                f"whole-step trace/compile failed: {e!r}") from e
+
+        self.last_path = "compiled"
+        T = len(tr_idx)
+        if k_steps is None:
+            loss_val = res[0]
+            new_tr = res[1:1 + T]
+            new_leaves = res[1 + T:1 + T + S]
+            aux = res[1 + T + S:]
+            for i, v in zip(self._mutated_idx, aux):
+                params[i].data()._set_data(v)
+            for j, i in enumerate(tr_idx):
+                params[i].data()._set_data(new_tr[j])
+        else:
+            loss_val = res[0]
+            new_all = res[1:1 + P]
+            new_leaves = res[1 + P:1 + P + S]
+            for p, v in zip(params, new_all):
+                p.data()._set_data(v)
+        for s, v in zip(leaf_nds, new_leaves):
+            s._set_data(v)
+        return NDArray(loss_val, ctx=ctx)
+
+    # -- traced functions --------------------------------------------------
+    def _get_core(self, n_params, n_state, n_scal, n_args, ctx):
+        """The pure step body shared by ``step`` and ``step_multi``:
+        (params, state_leaves, scalars, inputs, label, key) ->
+        (loss, new_trainable, new_state_leaves, aux)."""
+        if self._core is not None and \
+                self._core_shape == (n_params, n_state, n_scal, n_args):
+            return self._core
+        net, loss_fn, tr = self.net, self.loss_fn, self.trainer
+        params = self._params
+        tr_idx = list(self._tr_idx)
+        tr_set = set(tr_idx)
+        mutated_idx = self._mutated_idx
+
+        def core(param_vals, state_vals, scal_vals, input_vals,
+                 label_val, key_raw):
+            import jax
+            import jax.numpy as jnp
+            from .. import autograd
+            from .. import random as _rnd
+            from ..ops.registry import get_op
+            opt = tr._optimizer
+            upd = tr._updaters[0]
+            reps = [p.data() for p in params]
+            key_counter = [0]
+
+            def key_provider(_ctx):
+                k = jax.random.fold_in(
+                    jax.random.wrap_key_data(key_raw), key_counter[0])
+                key_counter[0] += 1
+                return NDArray(jax.random.key_data(k), ctx=ctx)
+
+            _rnd._push_key_provider(key_provider)
+            prev = autograd.set_training(True)
+            try:
+                with block_mod.tracing_scope(reps):
+                    def loss_of(tvals):
+                        vers = []
+                        for j, i in enumerate(tr_idx):
+                            reps[i]._buf = tvals[j]
+                        for i, r in enumerate(reps):
+                            if i not in tr_set:
+                                r._buf = param_vals[i]
+                            vers.append(r._version)
+                        shells = [NDArray(v, ctx=ctx)
+                                  for v in input_vals]
+                        out = net(*shells)
+                        l = loss_fn(out, NDArray(label_val, ctx=ctx))
+                        if not isinstance(l, NDArray):
+                            raise MXNetError(
+                                "CompiledStep loss_fn must return a "
+                                f"single NDArray, got {type(l)}")
+                        mutated_idx.clear()
+                        mutated_idx.extend(
+                            i for i, (r, v0) in enumerate(
+                                zip(reps, vers))
+                            if r._version != v0)
+                        aux = tuple(reps[i]._buf for i in mutated_idx)
+                        # grads of the SUM = the ones-cotangent
+                        # loss.backward() applies to an unreduced loss
+                        return jnp.sum(l._data), (l._data, aux)
+
+                    tvals = tuple(param_vals[i] for i in tr_idx)
+                    (_, (loss_val, aux)), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(tvals)
+
+                    # optimizer splice: the SAME multi-tensor program
+                    # fused_update dispatches, with traced tensors and
+                    # the per-step scalars as runtime inputs
+                    w_shells = [NDArray(v, ctx=ctx) for v in tvals]
+                    g_shells = [NDArray(g, ctx=ctx) for g in grads]
+                    leaf_shells = [NDArray(v, ctx=ctx)
+                                   for v in state_vals]
+                    it = iter(leaf_shells)
+                    shadow = [_rebuild_state(upd.states[i], it)
+                              for i in tr_idx]
+                    plan = opt._fused_plan(tr_idx, w_shells, g_shells,
+                                           shadow)
+                    res = get_op(plan.op_name).fcompute(
+                        *[x._data for x in plan.inputs], *scal_vals,
+                        **plan.attrs)
+                    if not isinstance(res, tuple):
+                        res = (res,)
+                    w_pos = {id(x): j for j, x in enumerate(w_shells)}
+                    s_pos = {id(x): j
+                             for j, x in enumerate(leaf_shells)}
+                    new_tr = list(tvals)
+                    new_leaves = list(state_vals)
+                    for k, o in enumerate(plan.outs):
+                        if id(o) in w_pos:
+                            new_tr[w_pos[id(o)]] = res[k]
+                        elif id(o) in s_pos:
+                            new_leaves[s_pos[id(o)]] = res[k]
+            finally:
+                autograd.set_training(prev)
+                _rnd._pop_key_provider()
+            return loss_val, tuple(new_tr), tuple(new_leaves), aux
+
+        self._core = core
+        self._core_shape = (n_params, n_state, n_scal, n_args)
+        return core
+
+    def _make_pure(self, core, P, S, C):
+        def pure(*flat):
+            param_vals = flat[:P]
+            state_vals = flat[P:P + S]
+            scal_vals = flat[P + S:P + S + C]
+            input_vals = flat[P + S + C:-2]
+            label_val, key_raw = flat[-2], flat[-1]
+            loss_val, new_tr, new_leaves, aux = core(
+                param_vals, state_vals, scal_vals, input_vals,
+                label_val, key_raw)
+            return (loss_val,) + new_tr + new_leaves + aux
+        return pure
+
+    def _make_pure_k(self, core, P, S, C, n_args, k_steps, repeat):
+        tr_idx = list(self._tr_idx)
+        mutated_idx = self._mutated_idx
+
+        def pure_k(*flat):
+            from jax import lax
+            param_vals = tuple(flat[:P])
+            state_vals = tuple(flat[P:P + S])
+            scal_k = tuple(flat[P + S:P + S + C])   # each (K, ...)
+            rest = flat[P + S + C:]
+            input_vals = tuple(rest[:n_args])
+            label_val = rest[n_args]
+            keys_k = rest[n_args + 1]
+
+            def body(carry, xs):
+                pv, sv = carry
+                if repeat:
+                    scal, key = xs
+                    iv, lv = input_vals, label_val
+                else:
+                    scal, iv, lv, key = xs
+                loss_val, new_tr, new_leaves, aux = core(
+                    pv, sv, scal, iv, lv, key)
+                pv = list(pv)
+                # forward-mutated (aux) params join the carry so step
+                # k+1 sees step k's BatchNorm running stats; trainable
+                # writes go LAST so a param that is both mutated and
+                # trainable ends on the optimizer's value — the same
+                # precedence step()'s write-back applies
+                for j, i in enumerate(mutated_idx):
+                    pv[i] = aux[j]
+                for j, i in enumerate(tr_idx):
+                    pv[i] = new_tr[j]
+                return (tuple(pv), new_leaves), loss_val
+
+            xs = (scal_k, keys_k) if repeat else \
+                (scal_k, input_vals, label_val, keys_k)
+            (pf, sf), losses = lax.scan(
+                body, (param_vals, state_vals), xs)
+            return (losses,) + pf + sf
+        return pure_k
+
+
+class _TraceFallback(MXNetError):
+    """Internal: compiled-path failure that the eager path can absorb."""

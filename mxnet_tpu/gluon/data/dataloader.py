@@ -8,8 +8,29 @@ is released inside NumPy/decoding), because device placement must stay on
 the main thread with PJRT; the reference's fork-based workers + shared-mem
 NDArray IPC exist to feed GPUs from Python, which XLA's async host→device
 copies already cover.
+
+Pipelining, two stages (both optional, both teleport worker exceptions
+to the consumer at the batch they poisoned):
+
+* sample fetch/decode (the IO-bound stage) runs ``prefetch`` batches
+  ahead on ``engine.pipeline.io_pool`` (the native C++ engine when
+  built, ``MXTPU_NATIVE_IO=0`` falls back to Python threads) — the
+  reference's worker prefetch; ``batchify_fn`` itself runs on the
+  consumer thread, since it creates device arrays.  Dataset
+  ``__getitem__`` should therefore stay host-side (IO / decode /
+  numpy; lazy NDArray views are fine) — dispatching device ops from
+  worker threads is unsupported with PJRT;
+* ``prefetch_to_device`` additionally stages the next
+  ``MXTPU_PREFETCH_DEPTH`` batches onto the device from the CONSUMER
+  thread (PJRT placement must not move off it): the copy is issued
+  asynchronously before the previous batch is consumed, so host→device
+  transfer overlaps device execution — the reference's
+  ``iter_prefetcher.h`` double buffering, rebuilt on XLA's async
+  transfers.
 """
 from __future__ import annotations
+
+from collections import deque
 
 import numpy as np
 
@@ -36,14 +57,33 @@ def default_batchify_fn(data):
 default_mp_batchify_fn = default_batchify_fn
 
 
+def _to_device(batch, ctx):
+    """Issue the (async) host→device copy for every NDArray in a batch."""
+    if isinstance(batch, NDArray):
+        return batch.as_in_context(ctx)
+    if isinstance(batch, (list, tuple)):
+        moved = [_to_device(b, ctx) for b in batch]
+        return moved if isinstance(batch, list) else tuple(moved)
+    return batch
+
+
 class DataLoader:
-    """Loads data from a Dataset and returns mini-batches."""
+    """Loads data from a Dataset and returns mini-batches.
+
+    ``prefetch``: how many batches the worker pool assembles ahead of
+    the consumer (default ``2 * num_workers``; with ``num_workers=0`` a
+    positive value spins up a single io_pool worker so prefetching
+    still overlaps).  ``prefetch_to_device``: a Context (or True for
+    the current context) to double-buffer finished batches onto, so the
+    host→device copy of batch i+1 is in flight while batch i trains;
+    None reads the ``MXTPU_PREFETCH_TO_DEVICE`` default.
+    """
 
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
                  pin_device_id=0, prefetch=None, thread_pool=False,
-                 timeout=120):
+                 timeout=120, prefetch_to_device=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
@@ -72,29 +112,56 @@ class DataLoader:
                 "specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        if prefetch_to_device is None:
+            from ... import envs
+            prefetch_to_device = envs.get("MXTPU_PREFETCH_TO_DEVICE")
+        self._prefetch_ctx = prefetch_to_device
         self._batchify_fn = batchify_fn if batchify_fn is not None \
             else default_batchify_fn
         # worker jobs run on the native C++ engine when built
         # (engine.pipeline.io_pool); ThreadPoolExecutor is the fallback
         from ...engine.pipeline import io_pool
-        self._pool = io_pool(self._num_workers) \
-            if self._num_workers > 0 else None
+        if self._num_workers > 0:
+            self._pool = io_pool(self._num_workers)
+        elif self._prefetch > 0:
+            # explicit prefetch without workers: one pipeline worker
+            # still overlaps batch assembly with consumption
+            self._pool = io_pool(1)
+        else:
+            self._pool = None
 
     def __iter__(self):
+        it = self._iter_batches()
+        ctx = self._prefetch_ctx
+        if ctx:
+            if ctx is True:
+                from ...context import current_context
+                ctx = current_context()
+            from ... import envs
+            depth = max(1, envs.get("MXTPU_PREFETCH_DEPTH"))
+            it = self._iter_device_prefetch(it, ctx, depth)
+        return it
+
+    def _iter_batches(self):
         if self._pool is None:
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
             return
-        # pipelined: submit sample fetches ahead, assemble in order
+        # pipelined: workers fetch/decode the samples (the IO-bound
+        # stage) ahead of the consumer; batchify — which creates DEVICE
+        # arrays — runs on the consumer thread, because concurrent
+        # device_put from pool threads crashes PJRT (placement must
+        # stay on one thread; observed segfault with 2+ pools active)
         def fetch(batch):
-            return self._batchify_fn([self._dataset[i] for i in batch])
+            return [self._dataset[i] for i in batch]
         batches = list(self._batch_sampler)
         futures = []
-        depth = self._num_workers * 2
+        depth = max(1, self._prefetch)
         it = iter(batches)
         for _ in range(min(depth, len(batches))):
             futures.append(self._pool.submit(fetch, next(it)))
-        done = 0
         while futures:
             f = futures.pop(0)
             try:
@@ -102,8 +169,44 @@ class DataLoader:
                 futures.append(self._pool.submit(fetch, nxt))
             except StopIteration:
                 pass
-            yield f.result(timeout=self._timeout)
-            done += 1
+            # a worker exception teleports out of result() here, AT the
+            # batch it poisoned — reference exception-at-sync semantics
+            yield self._batchify_fn(f.result(timeout=self._timeout))
+
+    @staticmethod
+    def _iter_device_prefetch(it, ctx, depth):
+        """Double-buffered device staging: keep ``depth`` batches'
+        host→device copies in flight ahead of the consumer.  Runs on
+        the consumer thread (PJRT placement stays where it must); the
+        overlap comes from the copies being asynchronous."""
+        buf = deque()
+        try:
+            while len(buf) < depth:
+                buf.append(_to_device(next(it), ctx))
+        except StopIteration:
+            pass
+        while buf:
+            # pop BEFORE refilling so at most `depth` batches are ever
+            # device-resident (the documented MXTPU_PREFETCH_DEPTH HBM
+            # budget); the refill copy is still issued before the yield
+            # returns control, so it overlaps the consumer's compute
+            out = buf.popleft()
+            try:
+                buf.append(_to_device(next(it), ctx))
+            except StopIteration:
+                pass
+            yield out
 
     def __len__(self):
         return len(self._batch_sampler)
+
+    def __del__(self):
+        # the loader owns its pool: release the worker threads (and the
+        # native engine, when active) deterministically instead of at
+        # interpreter shutdown
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown(wait=False)
+            except Exception:
+                pass

@@ -154,6 +154,15 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def compile_step(self, net, loss_fn):
+        """A :class:`~mxnet_tpu.gluon.CompiledStep` running
+        ``loss_fn(net(*data), label)`` + backward + THIS trainer's
+        fused optimizer update as ONE donated compiled dispatch
+        (escape hatch ``MXTPU_COMPILED_STEP=0``; transparent eager
+        fallback otherwise — see docs/compiled_step.md)."""
+        from .compiled_step import CompiledStep
+        return CompiledStep(net, loss_fn, self)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads, then apply optimizer scaled by 1/batch_size.
 

@@ -175,18 +175,58 @@ class Optimizer:
         return -1.0 if v is None else float(v)
 
     # -- fused multi-tensor path ------------------------------------------
+    def _fused_plan(self, indices, weights, grads, states):
+        """STRUCTURAL description of the one-dispatch multi-tensor
+        update: which registered ``multi_*`` op to run, its flat tensor
+        input list, donation positions, output write-back targets, and
+        static attrs — everything about the program EXCEPT the per-step
+        dynamic scalars (see :meth:`fused_step_scalars`).
+
+        Returns a :class:`_FusedPlan` or None when this optimizer has
+        no fused program (or these tensors are unsupported — e.g. mixed
+        fp16 without a fused mp variant).  Split out of
+        :meth:`fused_update` so ``gluon.CompiledStep`` can splice the
+        SAME program into its whole-step trace with traced
+        weights/grads/states while the scalars stay runtime inputs.
+        """
+        return None
+
+    def fused_step_scalars(self, indices):
+        """The per-step DYNAMIC arrays appended after the plan's tensor
+        inputs, in the op's trailing-scalar order (lrs, wds, [ts],
+        rescale_grad).  These change every step (schedulers, Adam bias
+        correction, Trainer's batch-size folding) and must ride as
+        array inputs, never as trace constants.  Call AFTER
+        ``_update_count`` has advanced for the step — the values embed
+        the post-increment counts, exactly like ``update()``.
+        """
+        raise NotImplementedError
+
     def fused_update(self, indices, weights, grads, states):
         """Apply the update for ALL params as ONE compiled dispatch.
 
-        Subclasses with a registered ``multi_*`` op implement this and
-        return True; the base returns False, which sends the caller
-        (``Trainer._update`` via ``Updater.call_fused``) down the
-        per-param loop unchanged.  Implementations must keep the
-        update-count bookkeeping and lr/wd multiplier semantics
+        Drives :meth:`_fused_plan` + :meth:`fused_step_scalars` through
+        the engine with buffer donation.  Returns False when no fused
+        program exists, which sends the caller (``Trainer._update`` via
+        ``Updater.call_fused``) down the per-param loop unchanged; the
+        update-count bookkeeping and lr/wd multiplier semantics are
         identical to ``update()`` — the fused and per-param paths are
         interchangeable step-for-step.
         """
-        return False
+        n = len(indices)
+        if n == 0:
+            return True
+        if not self._fused_supported(weights, grads):
+            return False
+        indices = list(indices)
+        plan = self._fused_plan(indices, weights, grads, states)
+        if plan is None:
+            return False
+        self._update_count(indices)
+        _fused_invoke(plan.op_name, plan.inputs,
+                      self.fused_step_scalars(indices), plan.donate,
+                      plan.outs, plan.attrs)
+        return True
 
     def _fused_supported(self, weights, grads):
         """Common eligibility: dense grads, homogeneous precision mode."""
@@ -217,6 +257,27 @@ create = Optimizer.create_optimizer
 def _zeros_like(weight, dtype=None):
     return nd.zeros(weight.shape, ctx=weight.context,
                     dtype=dtype or weight.dtype.name)
+
+
+class _FusedPlan:
+    """One multi-tensor optimizer dispatch, minus its dynamic scalars.
+
+    ``inputs``/``outs`` are NDArrays in the op's flat layout; ``donate``
+    indexes into ``inputs`` (weight/state positions whose buffers the
+    executable may alias); ``attrs`` is the STATIC attr dict — it is
+    also the retrace signature: a consumer that baked these values into
+    a trace (``CompiledStep``) compares attrs across steps and rebuilds
+    when they drift (e.g. a momentum change).
+    """
+
+    __slots__ = ("op_name", "inputs", "donate", "outs", "attrs")
+
+    def __init__(self, op_name, inputs, donate, outs, attrs):
+        self.op_name = op_name
+        self.inputs = inputs
+        self.donate = donate
+        self.outs = outs
+        self.attrs = attrs
 
 
 def _fused_invoke(op_name, nd_inputs, extra_arrays, donate, outs, attrs):
@@ -315,17 +376,8 @@ class SGD(Optimizer):
             return (weight32, mom)
         return self.create_state(index, weight)
 
-    def fused_update(self, indices, weights, grads, states):
-        if not self._fused_supported(weights, grads):
-            return False
+    def _fused_plan(self, indices, weights, grads, states):
         n = len(indices)
-        if n == 0:
-            return True
-        indices = list(indices)
-        self._update_count(indices)
-        lrs = np.asarray(self._get_lrs(indices), np.float32)
-        wds = np.asarray(self._get_wds(indices), np.float32)
-        extra = (lrs, wds, np.float32(self.rescale_grad))
         attrs = dict(num_weights=n, clip_gradient=self._clip(),
                      clip_global_norm=self._clip_gnorm())
         mp = self.multi_precision and weights[0].dtype == np.float16
@@ -333,31 +385,33 @@ class SGD(Optimizer):
             w32s = [s[0] for s in states]
             if self.momentum != 0.0:
                 moms = [s[1] for s in states]
-                _fused_invoke(
+                return _FusedPlan(
                     "multi_mp_sgd_mom_update",
-                    list(weights) + list(grads) + moms + w32s, extra,
+                    list(weights) + list(grads) + moms + w32s,
                     tuple(range(n)) + tuple(range(2 * n, 4 * n)),
                     list(weights) + moms + w32s,
                     dict(attrs, momentum=self.momentum))
-            else:
-                _fused_invoke(
-                    "multi_mp_sgd_update",
-                    list(weights) + list(grads) + w32s, extra,
-                    tuple(range(n)) + tuple(range(2 * n, 3 * n)),
-                    list(weights) + w32s, attrs)
-        elif self.momentum != 0.0:
+            return _FusedPlan(
+                "multi_mp_sgd_update",
+                list(weights) + list(grads) + w32s,
+                tuple(range(n)) + tuple(range(2 * n, 3 * n)),
+                list(weights) + w32s, attrs)
+        if self.momentum != 0.0:
             moms = list(states)
-            _fused_invoke(
+            return _FusedPlan(
                 "multi_sgd_mom_update",
-                list(weights) + list(grads) + moms, extra,
+                list(weights) + list(grads) + moms,
                 tuple(range(n)) + tuple(range(2 * n, 3 * n)),
                 list(weights) + moms,
                 dict(attrs, momentum=self.momentum))
-        else:
-            _fused_invoke(
-                "multi_sgd_update", list(weights) + list(grads), extra,
-                tuple(range(n)), list(weights), attrs)
-        return True
+        return _FusedPlan(
+            "multi_sgd_update", list(weights) + list(grads),
+            tuple(range(n)), list(weights), attrs)
+
+    def fused_step_scalars(self, indices):
+        return (np.asarray(self._get_lrs(indices), np.float32),
+                np.asarray(self._get_wds(indices), np.float32),
+                np.float32(self.rescale_grad))
 
 
 @register
@@ -414,37 +468,32 @@ class Adam(Optimizer):
                        lazy_update=lazy,
                        out=[weight, mean, var])
 
-    def fused_update(self, indices, weights, grads, states):
-        if not self._fused_supported(weights, grads):
-            return False
+    def _fused_plan(self, indices, weights, grads, states):
         if self.multi_precision and any(w.dtype == np.float16
                                         for w in weights):
-            return False  # no fused mp-Adam variant; per-param loop
+            return None  # no fused mp-Adam variant; per-param loop
         n = len(indices)
-        if n == 0:
-            return True
-        indices = list(indices)
-        self._update_count(indices)
+        means = [s[0] for s in states]
+        variances = [s[1] for s in states]
+        return _FusedPlan(
+            "multi_adam_update",
+            list(weights) + list(grads) + means + variances,
+            tuple(range(n)) + tuple(range(2 * n, 4 * n)),
+            list(weights) + means + variances,
+            dict(num_weights=n, beta1=self.beta1, beta2=self.beta2,
+                 epsilon=self.epsilon, clip_gradient=self._clip(),
+                 clip_global_norm=self._clip_gnorm()))
+
+    def fused_step_scalars(self, indices):
         # bias-corrected lr per param, same host math as update()
         lrs = []
         for i, lr in zip(indices, self._get_lrs(indices)):
             t = self._index_update_count[i]
             lrs.append(lr * math.sqrt(1.0 - self.beta2 ** t)
                        / (1.0 - self.beta1 ** t))
-        means = [s[0] for s in states]
-        variances = [s[1] for s in states]
-        _fused_invoke(
-            "multi_adam_update",
-            list(weights) + list(grads) + means + variances,
-            (np.asarray(lrs, np.float32),
-             np.asarray(self._get_wds(indices), np.float32),
-             np.float32(self.rescale_grad)),
-            tuple(range(n)) + tuple(range(2 * n, 4 * n)),
-            list(weights) + means + variances,
-            dict(num_weights=n, beta1=self.beta1, beta2=self.beta2,
-                 epsilon=self.epsilon, clip_gradient=self._clip(),
-                 clip_global_norm=self._clip_gnorm()))
-        return True
+        return (np.asarray(lrs, np.float32),
+                np.asarray(self._get_wds(indices), np.float32),
+                np.float32(self.rescale_grad))
 
 
 @register
@@ -653,29 +702,18 @@ class LAMB(Optimizer):
         nd.lamb_update_phase2(weight, g_update, r1, r2, lr=lr,
                               lower_bound=lb, upper_bound=ub, out=weight)
 
-    def fused_update(self, indices, weights, grads, states):
-        if not self._fused_supported(weights, grads):
-            return False
+    def _fused_plan(self, indices, weights, grads, states):
         if self.multi_precision and any(w.dtype == np.float16
                                         for w in weights):
-            return False
+            return None
         n = len(indices)
-        if n == 0:
-            return True
-        indices = list(indices)
-        self._update_count(indices)
-        ts = np.asarray([self._index_update_count[i] for i in indices],
-                        np.float32)
         means = [s[0] for s in states]
         variances = [s[1] for s in states]
         lb = -1.0 if self.lower_bound is None else float(self.lower_bound)
         ub = -1.0 if self.upper_bound is None else float(self.upper_bound)
-        _fused_invoke(
+        return _FusedPlan(
             "multi_lamb_update",
             list(weights) + list(grads) + means + variances,
-            (np.asarray(self._get_lrs(indices), np.float32),
-             np.asarray(self._get_wds(indices), np.float32), ts,
-             np.float32(self.rescale_grad)),
             tuple(range(n)) + tuple(range(2 * n, 4 * n)),
             list(weights) + means + variances,
             dict(num_weights=n, beta1=self.beta1, beta2=self.beta2,
@@ -684,7 +722,13 @@ class LAMB(Optimizer):
                  lower_bound=lb, upper_bound=ub,
                  clip_gradient=self._clip(),
                  clip_global_norm=self._clip_gnorm()))
-        return True
+
+    def fused_step_scalars(self, indices):
+        return (np.asarray(self._get_lrs(indices), np.float32),
+                np.asarray(self._get_wds(indices), np.float32),
+                np.asarray([self._index_update_count[i] for i in indices],
+                           np.float32),
+                np.float32(self.rescale_grad))
 
 
 @register

@@ -542,11 +542,15 @@ class DataParallelTrainer:
             sp.sync(loss._data)
             return loss
 
-    def step_multi(self, data, label):
+    def step_multi(self, data, label, repeat=None):
         """Run K fused train steps as ONE compiled program.
 
         ``data``: NDArray or tuple of NDArrays shaped (K, B, ...);
         ``label``: (K, B, ...).  Returns the (K,) per-step losses.
+        Alternatively pass SINGLE-batch (B, ...) data with ``repeat=K``
+        to run K steps over the same batch without materializing K host
+        copies (the batch becomes a plain program input the scanned
+        step body reuses — what bench.py's warm-cache bulking needs).
 
         A ``lax.scan`` over the fused step with params + optimizer
         state as the carry — the XLA rebuild of the reference engine's
@@ -561,11 +565,11 @@ class DataParallelTrainer:
         from .. import profiler
         with profiler._span("DataParallelTrainer.step_multi",
                             "spmd_step_multi") as sp:
-            loss = self._step_multi_impl(data, label)
+            loss = self._step_multi_impl(data, label, repeat=repeat)
             sp.sync(loss._data)
             return loss
 
-    def _step_multi_impl(self, data, label):
+    def _step_multi_impl(self, data, label, repeat=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -575,11 +579,18 @@ class DataParallelTrainer:
         from ..ndarray.ndarray import NDArray
 
         args = list(data) if isinstance(data, (list, tuple)) else [data]
-        k_steps = args[0].shape[0]
-        if label.shape[0] != k_steps:
-            raise MXNetError(
-                f"step_multi: label leading dim {label.shape[0]} != "
-                f"data leading dim {k_steps}")
+        repeated = repeat is not None
+        if repeated:
+            k_steps = int(repeat)
+            if k_steps <= 0:
+                raise MXNetError(
+                    f"step_multi: repeat must be positive, got {repeat}")
+        else:
+            k_steps = args[0].shape[0]
+            if label.shape[0] != k_steps:
+                raise MXNetError(
+                    f"step_multi: label leading dim {label.shape[0]} != "
+                    f"data leading dim {k_steps}")
         if not (self._fuse_step and self._rule is not None):
             raise MXNetError("step_multi requires fuse_step=True and "
                              "a fused optimizer rule")
@@ -588,13 +599,14 @@ class DataParallelTrainer:
                              "compression")
 
         # single-step views drive setup/tracing (shapes minus K)
-        args0 = [a[0] for a in args]
+        args0 = args if repeated else [a[0] for a in args]
         if self._params is None:
             self._setup(args0)
         prev = autograd.set_training(True)
         try:
             if self._fwd_bwd is None:
-                self._build_fwd_bwd(args0, label[0])
+                self._build_fwd_bwd(args0,
+                                    label if repeated else label[0])
             if self._full_fn is None:
                 self._build_full_step()
             if self._donation_poisoned is not None:
@@ -631,7 +643,9 @@ class DataParallelTrainer:
                     for _ in range(k_steps)]
             keys_k = jnp.stack(keys)
 
-            batch_k = NamedSharding(self.mesh, P(None, self.dp_axis))
+            batch_k = NamedSharding(
+                self.mesh,
+                P(self.dp_axis) if repeated else P(None, self.dp_axis))
             used = set()
             x_vals = tuple(self._put_cached(a, batch_k, used)
                            for a in args)
@@ -639,9 +653,9 @@ class DataParallelTrainer:
             self._prune_placed(used)
             param_vals = tuple(p.data()._data for p in self._params)
 
-            fn = self._multi_step_cache.get(k_steps)
+            fn = self._multi_step_cache.get((k_steps, repeated))
             if fn is None:
-                fn = self._build_full_step_multi(k_steps)
+                fn = self._build_full_step_multi(k_steps, repeated)
             try:
                 loss_k, new_all_params, new_states = fn(
                     param_vals, self._state_vals(), scalar_k, x_vals,
@@ -715,7 +729,7 @@ class DataParallelTrainer:
             self._placed = {k: h for k, h in self._placed.items()
                             if k in used}
 
-    def _build_full_step_multi(self, k_steps):
+    def _build_full_step_multi(self, k_steps, repeated=False):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -732,7 +746,13 @@ class DataParallelTrainer:
                    label_k, keys_k):
             def body(carry, xs):
                 params, tstates = carry
-                scal_row, inputs, label, key = xs
+                if repeated:
+                    # the batch is a plain program input reused every
+                    # inner step — no K host copies, no scanned axis
+                    scal_row, key = xs
+                    inputs, label = inputs_k, label_k
+                else:
+                    scal_row, inputs, label, key = xs
                 scal = tuple(scal_row[i] for i in range(n_scal))
                 loss, new_params, new_states, aux = full(
                     params, tstates, scal, inputs, label, key)
@@ -743,12 +763,15 @@ class DataParallelTrainer:
                     params[i] = aux[j]
                 return (tuple(params), new_states), loss
 
+            xs = (scalar_k, keys_k) if repeated else \
+                (scalar_k, inputs_k, label_k, keys_k)
             (params_f, tstates_f), losses = lax.scan(
-                body, (param_vals, tstate_vals),
-                (scalar_k, inputs_k, label_k, keys_k))
+                body, (param_vals, tstate_vals), xs)
             return losses, params_f, tstates_f
 
-        batch_k = NamedSharding(self.mesh, P(None, self.dp_axis))
+        batch_k = NamedSharding(
+            self.mesh,
+            P(self.dp_axis) if repeated else P(None, self.dp_axis))
         repl = NamedSharding(self.mesh, P())
         param_shardings, state_shardings = self._sharding_tuples()
         # out-shardings pinned for the same TP-safety reason as
@@ -760,7 +783,7 @@ class DataParallelTrainer:
                           (batch_k,) * self._n_args, batch_k, repl),
             out_shardings=(None, param_shardings, state_shardings),
             donate_argnums=(0, 1))
-        self._multi_step_cache[k_steps] = fn
+        self._multi_step_cache[(k_steps, repeated)] = fn
         return fn
 
     def _sharding_tuples(self):

@@ -249,6 +249,24 @@ def gen(F, xs):
     return out
 '''
 
+# seeded defect: the classic per-op training loop — record + backward +
+# step each iteration, no step compilation anywhere in the module
+_PER_OP_TRAIN_LOOP = '''
+def train(net, trainer, loader, loss_fn):
+    for X, Y in loader:
+        with mx.autograd.record():
+            loss = loss_fn(net(X), Y)
+        loss.backward()
+        trainer.step(X.shape[0])
+'''
+
+_COMPILED_TRAIN_LOOP = '''
+def train(net, trainer, loader, loss_fn):
+    step = trainer.compile_step(net, loss_fn)
+    for X, Y in loader:
+        loss = step.step(X, Y)
+'''
+
 
 class TestSourcePasses:
     def test_training_loop_sync_flagged(self):
@@ -270,6 +288,37 @@ class TestSourcePasses:
         findings = analysis.analyze_source(_PER_STEP_ATTR)
         assert [f.rule for f in findings] == ["MXL303"]
         assert "slice_axis" in findings[0].message  # rope rides scalar path
+
+    def test_per_op_train_loop_flagged_once(self):
+        findings = [f for f in analysis.analyze_source(_PER_OP_TRAIN_LOOP)
+                    if f.rule == "MXL304"]
+        assert len(findings) == 1
+        assert "compile_step" in findings[0].message
+
+    def test_step_compiled_module_not_flagged(self):
+        # the compiled loop itself, and any module that references step
+        # compilation, stays quiet
+        assert not [f for f in analysis.analyze_source(_COMPILED_TRAIN_LOOP)
+                    if f.rule == "MXL304"]
+        mixed = _COMPILED_TRAIN_LOOP + _PER_OP_TRAIN_LOOP.replace(
+            "def train", "def train_eager")
+        assert not [f for f in analysis.analyze_source(mixed)
+                    if f.rule == "MXL304"]
+
+    def test_per_op_loop_without_step_not_flagged(self):
+        # record+backward alone (e.g. gradient inspection, manual
+        # updates) is not the compile_step shape
+        src = _PER_OP_TRAIN_LOOP.replace("trainer.step(X.shape[0])",
+                                         "pass")
+        assert not [f for f in analysis.analyze_source(src)
+                    if f.rule == "MXL304"]
+
+    def test_per_op_loop_suppressible(self):
+        src = _PER_OP_TRAIN_LOOP.replace(
+            "for X, Y in loader:",
+            "for X, Y in loader:  # mxlint: disable=MXL304")
+        assert not [f for f in analysis.analyze_source(src)
+                    if f.rule == "MXL304"]
 
     def test_inline_suppression(self):
         src = _HYBRID.replace(

@@ -528,6 +528,34 @@ class TestStepMulti:
                                        pb.data().asnumpy(),
                                        rtol=1e-4, atol=1e-6, err_msg=ka)
 
+    def test_repeat_matches_sequential_steps_same_batch(self):
+        """repeat=K scans one batch K times — identical to K step()
+        calls on it, with no (K, B, ...) host broadcast materialized
+        (the bench.py warm-cache bulking path)."""
+        from mxnet_tpu import nd
+        rng = np.random.RandomState(2)
+        K, B = 3, 16
+        X = rng.randn(B, 8).astype("f4")
+        Y = (X[..., :1] * 0.5 + 0.1).astype("f4")
+
+        net_a, tr_a = self._mk(seed=7)
+        seq_losses = [float(tr_a.step((nd.array(X),),
+                                      nd.array(Y)).asnumpy())
+                      for _ in range(K)]
+
+        net_b, tr_b = self._mk(seed=7)
+        multi = tr_b.step_multi((nd.array(X),), nd.array(Y), repeat=K)
+        assert multi.shape == (K,)
+        np.testing.assert_allclose(multi.asnumpy(),
+                                   np.asarray(seq_losses),
+                                   rtol=1e-5, atol=1e-6)
+        for (ka, pa), (kb, pb) in zip(
+                sorted(net_a.collect_params().items()),
+                sorted(net_b.collect_params().items())):
+            np.testing.assert_allclose(pa.data().asnumpy(),
+                                       pb.data().asnumpy(),
+                                       rtol=1e-4, atol=1e-6, err_msg=ka)
+
     def test_multi_then_single_continues(self):
         from mxnet_tpu import nd
         rng = np.random.RandomState(1)

@@ -9,8 +9,10 @@ Pass families (rules documented in docs/static_analysis.md):
 * registry passes (MXL2xx) over every registered OpDef;
 * source passes (MXL3xx) over Python files — host-sync and
   retrace-storm hazards;
-* runtime pass (MXL4xx) — jit-cache key blowup, when run in-process
-  after a workload (``mxnet_tpu.analysis.analyze_cache``).
+* runtime passes — jit-cache key blowup (MXL401,
+  ``mxnet_tpu.analysis.analyze_cache``) and silent CompiledStep
+  eager fallbacks (MXL305, ``analyze_compiled_steps``), when run
+  in-process after a workload.
 
 Usage:
 
