@@ -83,6 +83,7 @@ from . import recordio
 from . import image
 from . import models
 from . import profiler
+from . import telemetry
 from . import monitor
 from . import runtime
 from . import envs
@@ -100,6 +101,7 @@ __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "initializer", "init", "lr_scheduler", "optimizer", "gluon",
            "metric", "io", "test_utils", "kvstore", "kv", "parallel",
            "symbol", "sym", "module", "mod", "recordio", "image",
-           "models", "profiler", "monitor", "runtime", "envs",
+           "models", "profiler", "telemetry", "monitor", "runtime",
+           "envs",
            "callback", "checkpoint", "model", "operator", "contrib",
            "analysis"]

@@ -20,7 +20,8 @@ from .findings import (Finding, Severity, RULES, rule_severity,
 from .graph_passes import analyze_symbol, analyze_graph_json, node_path
 from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
-from .runtime import analyze_cache, analyze_compiled_steps
+from .runtime import (analyze_cache, analyze_compiled_steps,
+                      analyze_telemetry)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -29,7 +30,7 @@ __all__ = [
     "analyze_symbol", "analyze_graph_json", "node_path",
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
-    "analyze_cache", "analyze_compiled_steps",
+    "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -47,5 +48,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
         findings.extend(analyze_symbol(sym, shapes=shapes,
                                        check_shapes=check_shapes,
                                        name=name))
+    # telemetry runtime pass (MXL306/307): free in a fresh CI process
+    # (nothing recorded), but a self_check run AFTER a workload in the
+    # same process surfaces steady-state retraces and prefetch stalls
+    findings.extend(analyze_telemetry())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

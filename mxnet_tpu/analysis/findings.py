@@ -61,6 +61,10 @@ RULES = {
                "per-op training loop without step compilation"),
     "MXL305": (Severity.WARNING,
                "CompiledStep silently fell back to the eager path"),
+    "MXL306": (Severity.WARNING,
+               "retrace observed after warm-up (attributed cause)"),
+    "MXL307": (Severity.WARNING,
+               "prefetch stall ratio above threshold (input-bound)"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
 }

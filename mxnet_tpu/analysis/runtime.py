@@ -11,6 +11,11 @@ after a workload ran:
   for the one-dispatch compiled step but is actually running per-op
   eager dispatches (non-hybridizable forward, optimizer without a fused
   program, ...).  The finding carries the recorded fallback reason.
+* MXL306 / MXL307 — telemetry-plane hazards (``analyze_telemetry``):
+  retraces AFTER warm-up (each finding carries the attributed cause —
+  the exact attr/shape/dtype diff from the retrace event) and a
+  prefetch pipeline that stalls the consumer too often (input-bound
+  training masquerading as slow compute).
 """
 from __future__ import annotations
 
@@ -18,7 +23,8 @@ from typing import List
 
 from .findings import Finding
 
-__all__ = ["analyze_cache", "analyze_compiled_steps"]
+__all__ = ["analyze_cache", "analyze_compiled_steps",
+           "analyze_telemetry"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -68,3 +74,56 @@ def analyze_compiled_steps() -> List[Finding]:
                 f"the eager per-op path: {reason}",
                 f"step:{name}")
         for name, reason in _cs.fallback_reports()]
+
+
+def analyze_telemetry(warmup_steps: int = 2,
+                      stall_threshold: float = 0.25) -> List[Finding]:
+    """Telemetry-plane hazards observed by THIS process's run.
+
+    * MXL306 — a ``retrace`` event recorded after ``warmup_steps``
+      train steps: steady-state training should compile NOTHING; the
+      finding carries the attributed cause (which attr/shape/dtype
+      changed, old -> new) so the fix is named, not hunted.
+    * MXL307 — the prefetch pipeline's stall ratio (batches the
+      consumer had to wait for / batches consumed) exceeded
+      ``stall_threshold``: the step time is input-bound and the fix is
+      more workers / deeper prefetch / faster decode, not kernel work.
+
+    Both read the telemetry plane (events ring + metric counters), so
+    the pass is free when nothing was recorded — a fresh process (the
+    ``--self-check`` CI gate) yields no findings.
+    """
+    from .. import telemetry
+    findings: List[Finding] = []
+    for ev in telemetry.events("retrace"):
+        # an event's step field reads "completed steps when emitted":
+        # a retrace DURING step N+1 carries step N (note_step advances
+        # at step END), so the first post-warm-up step's retraces
+        # arrive stamped warmup_steps — strict < keeps them
+        step = ev.get("step", 0)
+        if step < warmup_steps:
+            continue
+        changed = ", ".join(
+            f"{k}: {v[0]} -> {v[1]}"
+            for k, v in sorted(ev.get("changed", {}).items())) \
+            or "unknown"
+        findings.append(Finding(
+            "MXL306",
+            f"op {ev.get('op')!r} retraced during step {step + 1} "
+            f"(after {warmup_steps} warm-up steps); "
+            f"cause={ev.get('cause')}: {changed}",
+            f"retrace:{ev.get('op')}"))
+    ratio = telemetry.prefetch_stall_ratio()
+    if ratio > stall_threshold:
+        snap = telemetry.snapshot()["counters"]
+        findings.append(Finding(
+            "MXL307",
+            f"prefetch stall ratio {ratio:.2f} exceeds "
+            f"{stall_threshold:.2f} "
+            f"({int(snap.get('mxtpu_prefetch_stalls_total', 0))} of "
+            f"{int(snap.get('mxtpu_dataloader_batches_total', 0))} "
+            "batches found the queue dry) — training is input-bound; "
+            "raise num_workers/prefetch or move decode off the "
+            "consumer",
+            "prefetch:stalls"))
+    return findings

@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Tuple
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
            "cache_info", "cache_size", "clear_cache", "drop_cached",
-           "reset_counters"]
+           "reset_counters", "dispatch_count"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -43,6 +43,151 @@ _live = weakref.WeakSet()
 _hits = 0
 _misses = 0
 _dispatches = 0
+
+# -- telemetry plane (PR 4) -------------------------------------------------
+# The engine is the hottest seam in the process, so the telemetry
+# wiring follows a strict pattern: one lazily-bound module ref, one
+# `_switch.enabled` attribute load per dispatch, and ALL structured
+# work (key recompute, aval signatures, event dicts) behind it.
+_telem = None
+# op name -> attr signatures that have compiled (retrace-cause
+# attribution diffs a new signature against the closest prior one)
+_op_attr_sigs: Dict[str, list] = {}
+# cache key -> input (shape, dtype) signatures seen by invoke_compiled;
+# jax.jit re-traces per shape/dtype, so a NEW signature for an existing
+# key is exactly a retrace the cache counters cannot see
+_key_avals: Dict[Any, list] = {}
+_AVAL_HISTORY_CAP = 64
+# attribution state takes its own lock (same reasoning as the counter
+# lock below: DataLoader workers dispatch while the train thread does —
+# an unlocked check-then-append would let two first-time dispatches of
+# the same signature emit a phantom empty-diff retrace event, and the
+# bench/CI contract is that steady state shows ZERO retrace events)
+_attr_lock = threading.Lock()
+
+
+def _telemetry():
+    global _telem
+    if _telem is None:
+        from .. import telemetry
+        _telem = telemetry
+    return _telem
+
+
+# counter objects cached at first use: the registry lookup behind
+# telemetry.counter() takes the metrics lock, which the per-dispatch
+# hot path should not pay twice per call
+_c_dispatch = None
+_c_donated = None
+_c_miss = None
+_c_retrace = None
+
+
+def _counters(t):
+    global _c_dispatch, _c_donated, _c_miss, _c_retrace
+    if _c_dispatch is None:
+        _c_dispatch = t.counter(
+            "mxtpu_engine_dispatches_total",
+            "invoke_compiled calls (XLA executable launches)")
+        _c_donated = t.counter(
+            "mxtpu_donated_dispatches_total",
+            "dispatches that donated input buffers")
+        _c_miss = t.counter("mxtpu_engine_cache_misses_total",
+                            "jit-cache misses (compiles)")
+        _c_retrace = t.counter(
+            "mxtpu_retraces_total",
+            "cache misses attributable to a changed attr/shape/dtype")
+    return _c_dispatch, _c_donated, _c_miss, _c_retrace
+
+
+def _sig_diff(old_sig, new_sig) -> dict:
+    """``{attr: [old, new]}`` for every attr that differs between two
+    frozen signatures (``<absent>`` marks one-sided attrs)."""
+    try:
+        old = dict(old_sig)
+        new = dict(new_sig)
+    except (TypeError, ValueError):
+        return {"signature": [repr(old_sig), repr(new_sig)]}
+    changed = {}
+    for k in set(old) | set(new):
+        ov = old.get(k, "<absent>")
+        nv = new.get(k, "<absent>")
+        if ov != nv:
+            changed[k] = [repr(ov), repr(nv)]
+    return changed
+
+
+def _note_compile(name: str, sig):
+    """Called on every cache miss (telemetry on): if this op compiled
+    before under a DIFFERENT attr signature, emit a ``retrace`` event
+    attributing the exact attrs that changed — the Relay lesson applied
+    to the jit cache (structured provenance over opaque counters)."""
+    best = None
+    with _attr_lock:
+        prior = _op_attr_sigs.setdefault(name, [])
+        if sig in prior:
+            return
+        if prior:
+            for p in prior:
+                d = _sig_diff(p, sig)
+                if best is None or len(d) < len(best):
+                    best = d
+        prior.append(sig)
+    if best:
+        t = _telemetry()
+        _counters(t)[3].inc()
+        t.record_event("retrace", op=name, cause="attrs",
+                       changed=best)
+
+
+def _note_avals(name: str, key, arrays):
+    """Shape/dtype-driven retrace attribution: a new input signature
+    for an already-compiled key means jax.jit re-traced underneath the
+    engine cache.  Emits the old->new diff against the closest seen
+    signature."""
+    aval = tuple(
+        (tuple(getattr(a, "shape", ()) or ()),
+         str(getattr(a, "dtype", type(a).__name__)))
+        for a in arrays)
+    # lock-free fast path: steady state is "signature already seen" —
+    # a plain list read under the GIL is safe against concurrent
+    # appends, and a rare false negative just falls through to the
+    # locked re-check
+    seen = _key_avals.get(key)
+    if seen is not None and aval in seen:
+        return
+    best = None
+    with _attr_lock:
+        seen = _key_avals.setdefault(key, [])
+        if aval in seen:
+            return
+        for prev in seen:
+            changed = {}
+            if len(prev) != len(aval):
+                changed["nargs"] = [len(prev), len(aval)]
+            for i, (o, n) in enumerate(zip(prev, aval)):
+                if o[0] != n[0]:
+                    changed[f"arg{i}.shape"] = [list(o[0]), list(n[0])]
+                if o[1] != n[1]:
+                    changed[f"arg{i}.dtype"] = [o[1], n[1]]
+            # <= : on equally-similar signatures, diff against the most
+            # RECENT one — "what changed since last time" reads better
+            # than a diff vs an arbitrary older entry
+            if best is None or len(changed) <= len(best):
+                best = changed
+        # ALWAYS record the new signature, evicting the oldest at the
+        # cap — refusing to record would make every later dispatch of
+        # signature 65 re-enter this path and emit a phantom retrace
+        # per dispatch, forever
+        seen.append(aval)
+        if len(seen) > _AVAL_HISTORY_CAP:
+            del seen[0]
+    if best:
+        cause = "dtypes" if all(
+            k.endswith(".dtype") for k in best) else "shapes"
+        t = _telemetry()
+        _counters(t)[3].inc()
+        t.record_event("retrace", op=name, cause=cause, changed=best)
 
 
 _NAIVE = None
@@ -68,6 +213,23 @@ def _freeze(v: Any):
     return v
 
 
+def _cache_key(name: str, attrs: dict, donate: Tuple[int, ...]):
+    """``(key, sig)`` for the jit cache.  Attr-less ops (the bulk of
+    elemwise traffic) skip the freeze/sort; hashable attr values take a
+    SORTED items key so reordered-kwargs call sites share one cache
+    entry for the same executable."""
+    if not attrs and not donate:
+        return name, ()
+    try:
+        sig = tuple(sorted(attrs.items()))
+        key = (name, sig, tuple(donate)) if donate else (name, sig)
+        hash(key)
+    except TypeError:
+        sig = _freeze(attrs)
+        key = (name, sig, tuple(donate)) if donate else (name, sig)
+    return key, sig
+
+
 def get_compiled(name: str, fcompute: Callable, attrs: dict,
                  donate: Tuple[int, ...] = ()) -> Callable:
     """Return the jitted executable for (op, attrs); compile-once semantics.
@@ -85,23 +247,19 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict,
     Donating and non-donating callers of the same (op, attrs) get
     distinct cache entries.
     """
+    key, sig = _cache_key(name, attrs, donate)
+    return _get_compiled_keyed(key, sig, name, fcompute, attrs, donate)
+
+
+def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate):
+    """:func:`get_compiled` body with the cache key precomputed —
+    invoke_compiled builds the key once and shares it with the
+    telemetry plane's aval tracking instead of recomputing the
+    attr sort/freeze per dispatch."""
     global _hits, _misses
-    # attr-less ops (the bulk of elemwise traffic) skip the freeze/sort;
-    # hashable attr values take a SORTED items key so reordered-kwargs
-    # call sites share one cache entry for the same executable
-    if not attrs and not donate:
-        key = name
-        fn = _jit_cache.get(key)
-    else:
-        try:
-            sig = tuple(sorted(attrs.items()))
-            key = (name, sig, tuple(donate)) if donate else (name, sig)
-            fn = _jit_cache.get(key)
-        except TypeError:
-            sig = _freeze(attrs)
-            key = (name, sig, tuple(donate)) if donate else (name, sig)
-            fn = _jit_cache.get(key)
+    fn = _jit_cache.get(key)
     if fn is None:
+        compiled_now = False
         with _lock:
             fn = _jit_cache.get(key)
             if fn is None:
@@ -117,7 +275,13 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict,
                     fn = jax.jit(bound, donate_argnums=tuple(donate)) \
                         if donate else jax.jit(bound)
                 _jit_cache[key] = fn
-                return fn
+                compiled_now = True
+        if compiled_now:
+            t = _telem if _telem is not None else _telemetry()
+            if t._switch.enabled:
+                _counters(t)[2].inc()
+                _note_compile(name, sig)
+            return fn
     # += on a module global is not atomic (read-modify-write can lose
     # increments across threads, e.g. DataLoader workers dispatching
     # while the main thread trains) and the dispatch counters are an
@@ -154,15 +318,34 @@ def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
     global _dispatches
     with _lock:
         _dispatches += 1
-    fn = get_compiled(name, fcompute, attrs, donate=donate)
-    hook = _profiler_hook
-    if hook is not None:
-        out = hook(name, fn, arrays)
-    else:
-        out = fn(*arrays)
-    if is_naive():
-        import jax
-        jax.block_until_ready(out)
+    t = _telem if _telem is not None else _telemetry()
+    telem_on = t._switch.enabled
+    key, sig = _cache_key(name, attrs, donate)
+    fn = _get_compiled_keyed(key, sig, name, fcompute, attrs, donate)
+    if telem_on:
+        c_disp, c_don = _counters(t)[:2]
+        c_disp.inc()
+        if donate:
+            c_don.inc()
+        t.record_event("dispatch", op=name)
+        _note_avals(name, key, arrays)
+    try:
+        hook = _profiler_hook
+        if hook is not None:
+            out = hook(name, fn, arrays)
+        else:
+            out = fn(*arrays)
+        if is_naive():
+            import jax
+            jax.block_until_ready(out)
+    except Exception as e:
+        # crash forensics: the ring holds the dispatches/retraces that
+        # led here — dump it (throttled, never raising) and let the
+        # original error propagate untouched
+        if telem_on:
+            t.record_event("error", op=name, error=repr(e)[:500])
+            t.auto_dump(reason=f"invoke_compiled:{name}")
+        raise
     if isinstance(out, tuple):
         for o in out:
             track(o)
@@ -187,6 +370,13 @@ def waitall():
         except Exception:
             # teleported async error: surface it, like WaitForAll would
             raise
+
+
+def dispatch_count() -> int:
+    """Dispatches since process start (or ``reset_counters``) — the
+    cheap accessor for per-step deltas; ``cache_info()`` builds the
+    whole per-op dict, which is too heavy for once-per-step reads."""
+    return _dispatches
 
 
 def cache_size() -> int:
@@ -224,6 +414,10 @@ def cache_info() -> dict:
 def clear_cache():
     with _lock:
         _jit_cache.clear()
+    # attribution history follows the cache it describes
+    with _attr_lock:
+        _op_attr_sigs.clear()
+        _key_avals.clear()
 
 
 def drop_cached(name: str) -> int:
@@ -241,6 +435,10 @@ def drop_cached(name: str) -> int:
                  if (k == name if isinstance(k, str) else k[0] == name)]
         for k in stale:
             del _jit_cache[k]
+    if stale:
+        t = _telem if _telem is not None else _telemetry()
+        if t._switch.enabled:
+            t.record_event("evict", op=name, entries=len(stale))
     return len(stale)
 
 

@@ -115,6 +115,20 @@ _reg("MXTPU_PREFETCH_DEPTH", int, 2,
 _reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
      "Accepted for parity; XLA fuses whole graphs at the hybridize "
      "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")
+_reg("MXTPU_TELEMETRY", bool, True,
+     "Master switch for the runtime telemetry plane (metrics, "
+     "structured events, flight recorder, retrace-cause attribution). "
+     "0 disables all recording; instrumented call sites then pay one "
+     "attribute load per call.")
+_reg("MXTPU_FLIGHT_RECORDER_SIZE", int, 512,
+     "Capacity of the flight-recorder event ring (recent dispatches, "
+     "retraces, fallbacks, prefetch stalls, poison events). Older "
+     "events fall off; the dump records how many were dropped.")
+_reg("MXTPU_TELEMETRY_EXPORT", str, "",
+     "Directory for telemetry artifacts: flight-recorder dumps and "
+     "telemetry.export_metrics() JSONL snapshots. Empty = flight "
+     "dumps go to the system temp dir, metric exports to the cwd "
+     "(explicit paths always win).")
 
 
 def registry():

@@ -152,14 +152,23 @@ class CompiledStep:
         leading dimension of ``label`` and folds into ``rescale_grad``
         as a dynamic scalar (parity: ``Trainer.step(batch_size)``)."""
         from .. import profiler
+        from .. import engine, telemetry
+        import time
         args, label = self._coerce(data, label)
         if batch_size is None:
             batch_size = label.shape[0] if label.shape else \
                 args[0].shape[0]
         with profiler._span(f"CompiledStep[{self.net.name}]",
-                            "compiled_step") as sp:
+                            "compiled_step") as sp, \
+                telemetry.step_owner():
+            t0 = time.perf_counter()
+            d0 = engine.dispatch_count()
             out = self._step_or_fallback(args, label, batch_size)
             sp.sync(out._data)
+            telemetry.record_step(
+                "compiled_step", time.perf_counter() - t0,
+                dispatches=engine.dispatch_count() - d0,
+                examples=batch_size, path=self.last_path)
             return out
 
     def step_multi(self, data, label, batch_size=None, repeat=None):
@@ -196,12 +205,22 @@ class CompiledStep:
                 args[0].shape[1:]
             batch_size = lshape[0] if lshape else (
                 dshape[0] if dshape else 1)
+        from .. import engine, telemetry
+        import time
         with profiler._span(f"CompiledStep[{self.net.name}].multi",
-                            "compiled_step_multi") as sp:
+                            "compiled_step_multi") as sp, \
+                telemetry.step_owner():
+            t0 = time.perf_counter()
+            d0 = engine.dispatch_count()
             out = self._step_or_fallback(args, label, batch_size,
                                          k_steps=k_steps,
                                          repeat=repeat is not None)
             sp.sync(out._data)
+            telemetry.record_step(
+                "compiled_step", time.perf_counter() - t0,
+                dispatches=engine.dispatch_count() - d0,
+                examples=batch_size * k_steps, path=self.last_path,
+                steps=k_steps)
             return out
 
     # -- path selection ---------------------------------------------------
@@ -245,8 +264,13 @@ class CompiledStep:
             return self._eager(args, label, batch_size, k_steps, repeat)
 
     def _fall_back(self, reason: str):
+        from .. import telemetry
         self.fallback_reason = reason
         _record_fallback(self.name, reason)
+        telemetry.counter("mxtpu_fallbacks_total",
+                          "silent compiled->eager degradations").inc()
+        telemetry.record_event("fallback", where="compiled_step",
+                               name=self.name, reason=reason)
 
     # -- setup / eligibility ----------------------------------------------
     def _setup(self, args):
@@ -364,6 +388,26 @@ class CompiledStep:
         sig = (plan.op_name, tuple(sorted(plan.attrs.items())),
                n_state, n_args)
         if self._sig is not None and sig != self._sig:
+            # retrace-cause attribution: the optimizer's static surface
+            # drifted (momentum/beta/clip change) — name the exact
+            # attrs, old -> new, before evicting the stale executable.
+            # The engine cannot see this (the step's cache key carries
+            # no attrs; the drift lives in the traced closure).
+            from .. import telemetry
+            if telemetry.enabled():
+                changed = engine._sig_diff(self._sig[1], sig[1])
+                if self._sig[0] != sig[0]:
+                    changed["op_name"] = [self._sig[0], sig[0]]
+                if self._sig[2:] != sig[2:]:
+                    changed["structure"] = [list(self._sig[2:]),
+                                            list(sig[2:])]
+                telemetry.counter(
+                    "mxtpu_retraces_total",
+                    "cache misses attributable to a changed "
+                    "attr/shape/dtype").inc()
+                telemetry.record_event(
+                    "retrace", op=self.name, cause="attrs",
+                    changed=changed, source="compiled_step")
             for name in self._active_names:
                 engine.drop_cached(name)
             self._core = None
@@ -446,6 +490,16 @@ class CompiledStep:
                 # no new ones exist — training state is unrecoverable
                 # (same protocol as the fused optimizer / SPMD trainer)
                 self._poisoned = repr(e)
+                from .. import telemetry
+                telemetry.counter(
+                    "mxtpu_poisons_total",
+                    "post-donation failures (training state lost)"
+                    ).inc()
+                telemetry.record_event(
+                    "poison", where="compiled_step", name=self.name,
+                    error=repr(e)[:500])
+                telemetry.auto_dump(
+                    reason=f"compiled_step_poisoned:{self.name}")
                 raise MXNetError(
                     "compiled train step failed AFTER its weight/state "
                     "buffers were donated; rebuild the trainer and "

@@ -154,14 +154,38 @@ class DataLoader:
         # arrays — runs on the consumer thread, because concurrent
         # device_put from pool threads crashes PJRT (placement must
         # stay on one thread; observed segfault with 2+ pools active)
+        import time
+        from ... import telemetry
+
         def fetch(batch):
-            return [self._dataset[i] for i in batch]
+            t0 = time.perf_counter()
+            out = [self._dataset[i] for i in batch]
+            # producer-side work time, recorded FROM the worker thread
+            # (the registry lock is the only shared state touched)
+            telemetry.histogram(
+                "mxtpu_dataloader_fetch_seconds",
+                "worker fetch/decode time per batch (s)"
+                ).observe(time.perf_counter() - t0)
+            return out
         batches = list(self._batch_sampler)
         futures = []
         depth = max(1, self._prefetch)
         it = iter(batches)
         for _ in range(min(depth, len(batches))):
             futures.append(self._pool.submit(fetch, next(it)))
+        stall_counter = telemetry.counter(
+            "mxtpu_prefetch_stalls_total",
+            "batches the consumer had to WAIT for (queue was dry)")
+        batch_counter = telemetry.counter(
+            "mxtpu_dataloader_batches_total",
+            "batches consumed through the prefetch pipeline")
+        depth_gauge = telemetry.gauge(
+            "mxtpu_prefetch_queue_depth",
+            "batches in flight in the worker pool")
+        wait_hist = telemetry.histogram(
+            "mxtpu_dataloader_consumer_wait_seconds",
+            "consumer-side wait for the next batch (s)")
+        first = True
         while futures:
             f = futures.pop(0)
             try:
@@ -169,9 +193,28 @@ class DataLoader:
                 futures.append(self._pool.submit(fetch, nxt))
             except StopIteration:
                 pass
+            depth_gauge.set(len(futures))
+            # stall attribution must be decided BEFORE blocking: a
+            # not-yet-done future here means the pipeline failed to
+            # stay ahead of the consumer (input-bound signature).
+            # The FIRST batch is exempt — the consumer arrives the
+            # instant the pipeline was seeded, so batch 1 of every
+            # epoch would read as a stall even in a healthy pipeline
+            stalled = not first and not f.done()
+            first = False
+            t0 = time.perf_counter()
             # a worker exception teleports out of result() here, AT the
             # batch it poisoned — reference exception-at-sync semantics
-            yield self._batchify_fn(f.result(timeout=self._timeout))
+            samples = f.result(timeout=self._timeout)
+            wait = time.perf_counter() - t0
+            wait_hist.observe(wait)
+            batch_counter.inc()
+            if stalled:
+                stall_counter.inc()
+                telemetry.record_event(
+                    "prefetch_stall", wait_s=round(wait, 6),
+                    queue_depth=len(futures))
+            yield self._batchify_fn(samples)
 
     @staticmethod
     def _iter_device_prefetch(it, ctx, depth):
@@ -179,6 +222,11 @@ class DataLoader:
         host→device copies in flight ahead of the consumer.  Runs on
         the consumer thread (PJRT placement stays where it must); the
         overlap comes from the copies being asynchronous."""
+        from ... import telemetry
+        occupancy = telemetry.gauge(
+            "mxtpu_device_staging_occupancy",
+            "batches currently staged on the device ahead of the "
+            "consumer (MXTPU_PREFETCH_DEPTH budget)")
         buf = deque()
         try:
             while len(buf) < depth:
@@ -195,6 +243,7 @@ class DataLoader:
                 buf.append(_to_device(next(it), ctx))
             except StopIteration:
                 pass
+            occupancy.set(len(buf))
             yield out
 
     def __len__(self):

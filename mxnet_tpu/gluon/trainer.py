@@ -70,6 +70,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._states_to_init = False
+        self._fused_decline_reported = False
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -171,12 +172,38 @@ class Trainer:
         recompiles anything (regression-tested via
         ``engine.cache_info()``).
         """
+        import time
+        from .. import engine, telemetry
+        t0 = time.perf_counter()
+        d0 = engine.dispatch_count()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._allreduce_is_identity():
             self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if telemetry.enabled():
+            if telemetry.step_owned():
+                # a whole-step owner (CompiledStep eager fallback) is
+                # on the stack and will do the step/throughput
+                # accounting — record latency + dispatches only, so
+                # nothing double-counts
+                telemetry.histogram(
+                    "mxtpu_trainer_step_seconds",
+                    "Trainer.step (optimizer update) latency (s)"
+                    ).observe(time.perf_counter() - t0)
+                telemetry.gauge(
+                    "mxtpu_trainer_step_dispatches",
+                    "engine dispatches in the most recent Trainer.step"
+                    ).set(engine.dispatch_count() - d0)
+            else:
+                # standalone record/backward/step loop: THIS is the
+                # step owner — advance the global step counter so
+                # retrace events get steady-state stamps (MXL306 would
+                # otherwise read every retrace as warm-up, step 0)
+                telemetry.record_step(
+                    "trainer_step", time.perf_counter() - t0,
+                    dispatches=engine.dispatch_count() - d0)
 
     def _allreduce_is_identity(self):
         """True when push+pull would only copy each gradient to the
@@ -212,8 +239,22 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        if self._fused_eligible() and self._fused_update_all():
-            return
+        if self._fused_eligible():
+            if self._fused_update_all():
+                return
+            # fused path declined (optimizer lacks a fused program /
+            # unsupported tensors): surface the degradation ONCE per
+            # trainer — the per-param loop is ~P dispatches per step
+            from .. import telemetry
+            if not self._fused_decline_reported and telemetry.enabled():
+                self._fused_decline_reported = True
+                telemetry.counter(
+                    "mxtpu_fallbacks_total",
+                    "silent compiled->eager degradations").inc()
+                telemetry.record_event(
+                    "fallback", where="trainer_fused_update",
+                    reason=f"optimizer {type(self._optimizer).__name__} "
+                           "took the per-param update loop")
         if getattr(self._optimizer, "clip_global_norm", None) is not None \
                 and not self._update_on_kvstore:
             self._clip_grads_global_norm()

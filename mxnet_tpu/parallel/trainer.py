@@ -535,11 +535,16 @@ class DataParallelTrainer:
         batch (parity note: this replaces ``split_and_load`` + per-device
         forward + kvstore push/pull with one SPMD program).
         """
-        from .. import profiler
+        import time
+        from .. import profiler, telemetry
         with profiler._span("DataParallelTrainer.step",
-                            "spmd_step") as sp:
+                            "spmd_step") as sp, telemetry.step_owner():
+            t0 = time.perf_counter()
             loss = self._step_impl(data, label)
             sp.sync(loss._data)
+            telemetry.record_step(
+                "spmd_step", time.perf_counter() - t0,
+                examples=self._global_batch(label), path="spmd")
             return loss
 
     def step_multi(self, data, label, repeat=None):
@@ -562,12 +567,42 @@ class DataParallelTrainer:
         so K scanned steps are numerically the K individual steps.
         Requires ``fuse_step=True`` and no gradient compression.
         """
-        from .. import profiler
+        import time
+        from .. import profiler, telemetry
         with profiler._span("DataParallelTrainer.step_multi",
-                            "spmd_step_multi") as sp:
+                            "spmd_step_multi") as sp, \
+                telemetry.step_owner():
+            t0 = time.perf_counter()
             loss = self._step_multi_impl(data, label, repeat=repeat)
             sp.sync(loss._data)
+            k = int(repeat) if repeat is not None else \
+                (label.shape[0] if label.shape else 1)
+            per_step = self._global_batch(label) if repeat is not None \
+                else (label.shape[1] if len(label.shape) > 1 else 1)
+            telemetry.record_step(
+                "spmd_step", time.perf_counter() - t0,
+                examples=per_step * k, path="spmd_multi", steps=k)
             return loss
+
+    @staticmethod
+    def _global_batch(label):
+        """Examples per step for throughput accounting (leading dim of
+        the global-batch label; 1 for scalar labels)."""
+        shape = getattr(label, "shape", ())
+        return shape[0] if shape else 1
+
+    @staticmethod
+    def _record_poison(e, where):
+        """Telemetry for a post-donation failure: event + counter, and
+        a flight-recorder artifact so the dispatch/retrace sequence
+        that led to the lost training state is preserved."""
+        from .. import telemetry
+        telemetry.counter(
+            "mxtpu_poisons_total",
+            "post-donation failures (training state lost)").inc()
+        telemetry.record_event("poison", where=where,
+                               error=repr(e)[:500])
+        telemetry.auto_dump(reason=f"{where}_poisoned")
 
     def _step_multi_impl(self, data, label, repeat=None):
         import jax
@@ -678,6 +713,7 @@ class DataParallelTrainer:
                     _rnd._keys.update(key_snapshot)
                     raise
                 self._donation_poisoned = repr(e)
+                self._record_poison(e, "spmd_step_multi")
                 raise MXNetError(
                     "bulked train step failed AFTER its param/state "
                     "buffers were donated; the trainer is invalid. "
@@ -876,6 +912,7 @@ class DataParallelTrainer:
                     if not consumed:
                         raise
                     self._donation_poisoned = repr(e)
+                    self._record_poison(e, "spmd_step")
                     raise MXNetError(
                         "fused train step failed AFTER its optimizer "
                         "state was donated; the trainer is invalid. "

@@ -20,7 +20,6 @@ up inside XPlane traces.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from collections import defaultdict
@@ -77,7 +76,8 @@ def _record_event(name, cat, start_us, end_us):
 def _maybe_block(out):
     """MXTPU_PROFILE_SYNC=1: block on outputs so spans measure device
     time, not async dispatch."""
-    if os.environ.get("MXTPU_PROFILE_SYNC"):
+    from . import envs
+    if envs.get("MXTPU_PROFILE_SYNC"):
         import jax
         try:
             jax.block_until_ready(out)
@@ -139,18 +139,37 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False, format_="table"):
-    """Aggregate per-op stats as a text table (parity: profiler.dumps)."""
+    """Aggregate per-op stats (parity: profiler.dumps).
+
+    ``format_="table"`` renders the classic fixed-width text table;
+    ``format_="json"`` returns the same aggregates as a JSON object
+    (``{"ops": {name: {calls, total_us, min_us, max_us, avg_us}}}``)
+    for machine consumers.  Unknown formats raise ``MXNetError`` —
+    the parameter was previously accepted and silently ignored.
+    """
+    if format_ not in ("table", "json"):
+        raise MXNetError(
+            f"unknown dumps format {format_!r} (want 'table' or 'json')")
     with _lock:
         events = list(_events)
         if reset:
             _events.clear()
     agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
     for e in events:
+        if "dur" not in e:
+            continue          # instant events carry no span to total
         a = agg[e["name"]]
         a[0] += 1
         a[1] += e["dur"]
         a[2] = min(a[2], e["dur"])
         a[3] = max(a[3], e["dur"])
+    if format_ == "json":
+        return json.dumps({"ops": {
+            name: {"calls": n, "total_us": round(tot, 1),
+                   "min_us": round(mn, 1), "max_us": round(mx, 1),
+                   "avg_us": round(tot / n, 1)}
+            for name, (n, tot, mn, mx) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1])}})
     lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
              f"{'Max(us)':>12}{'Avg(us)':>12}"]
     for name, (n, tot, mn, mx) in sorted(agg.items(),
@@ -163,6 +182,21 @@ def dumps(reset=False, format_="table"):
 def active() -> bool:
     """True while collection runs (cheap guard for call sites)."""
     return _state == "run" and not _paused
+
+
+def _mirror_event(name, args=None):
+    """Telemetry mirror: one instant event in the chrome-trace stream
+    for a structured telemetry event (retrace, prefetch stall, poison),
+    so a single timeline shows op spans AND the telemetry plane's
+    annotations.  Only called while :func:`active`."""
+    if not active():
+        return
+    with _lock:
+        _events.append({"name": name, "ph": "i", "ts": _now_us(),
+                        "pid": 0,
+                        "tid": threading.get_ident() % 100000,
+                        "s": "p", "cat": "telemetry",
+                        "args": dict(args) if args else {}})
 
 
 class _span:

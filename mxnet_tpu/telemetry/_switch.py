@@ -1,0 +1,14 @@
+"""The telemetry master switch, in its own module so both halves of the
+package (metrics, recorder) and external hot paths (engine) can read
+one plain attribute without import cycles.
+
+``enabled`` is initialized from ``MXTPU_TELEMETRY`` once at import;
+``telemetry.enable()``/``disable()`` flip it at runtime.  Hot call
+sites read it as ``_switch.enabled`` — a single attribute load — which
+is the "near-zero cost when disabled" contract.
+"""
+from __future__ import annotations
+
+from .. import envs
+
+enabled: bool = bool(envs.get("MXTPU_TELEMETRY"))

@@ -1,0 +1,213 @@
+"""Flight recorder: a bounded ring of recent runtime events.
+
+The numeric metrics answer "how much"; the flight recorder answers
+"what just happened" — the last N structured events (dispatches,
+retraces, fallbacks, prefetch stalls, poison) so a crash dump carries
+the sequence that led to it, not just final counter values.
+
+* capacity comes from ``MXTPU_FLIGHT_RECORDER_SIZE`` (a ``deque``
+  maxlen — appends stay O(1) and old events fall off the far end);
+* every event also mirrors into the profiler's chrome-trace stream
+  while profiling is active, so ONE timeline shows op spans and
+  telemetry events together;
+* :func:`dump_flight_recorder` writes the ring (plus a metrics
+  snapshot) as a JSON artifact — called automatically when a
+  ``CompiledStep`` poisons or ``engine.invoke_compiled`` raises, and on
+  demand.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, List, Optional
+
+__all__ = ["record_event", "events", "clear_events",
+           "dump_flight_recorder", "auto_dump", "last_dump",
+           "note_step", "current_step"]
+
+_lock = threading.Lock()
+# TWO rings of equal capacity: high-volume timeline events (dispatch,
+# step) would otherwise cycle the ring within a few eager steps and
+# evict exactly the events the forensics exist for — a retrace,
+# fallback, or poison must survive hundreds of subsequent dispatches.
+# events() / dumps merge both by timestamp, so the ONE-timeline view
+# is preserved.
+_RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
+                         "evict", "prefetch_stall"))
+_ring: Optional[Deque[dict]] = None        # high-volume kinds
+_rare: Optional[Deque[dict]] = None        # retained rare kinds
+_dropped = 0          # events pushed out of either ring since clear
+_seq = 0              # monotone tiebreak for same-timestamp merging
+_step = 0             # completed train steps at event-emit time
+_t0 = time.time()
+_last_dump: Optional[str] = None
+_prof = None          # cached profiler module ref for the mirror
+# crash-path dumps are throttled: a test suite that exercises failure
+# teleporting would otherwise write one artifact per provoked error
+_auto_dumps_left = 25
+
+
+def _capacity() -> int:
+    from .. import envs
+    return max(16, envs.get("MXTPU_FLIGHT_RECORDER_SIZE"))
+
+
+def _get_rings():
+    global _ring, _rare
+    if _ring is None:
+        cap = _capacity()
+        _ring = collections.deque(maxlen=cap)
+        _rare = collections.deque(maxlen=cap)
+    return _ring, _rare
+
+
+def note_step() -> int:
+    """Advance the global train-step counter (called once per
+    Trainer/CompiledStep/DataParallelTrainer step, at step END).  An
+    event's ``step`` field therefore reads "completed steps when this
+    happened": a retrace DURING step N+1 carries ``step: N`` —
+    ``analyze_telemetry``'s warm-up filter accounts for that."""
+    global _step
+    with _lock:
+        _step += 1
+        return _step
+
+
+def current_step() -> int:
+    return _step
+
+
+def record_event(kind: str, **fields):
+    """Append one structured event (no-op when telemetry is disabled).
+    ``kind`` is the taxonomy key (``dispatch``, ``retrace``,
+    ``fallback``, ``prefetch_stall``, ``poison``, ``evict``,
+    ``error``); fields must be JSON-serializable.  Rare kinds go to
+    the retained ring so a flood of dispatch events cannot evict
+    them."""
+    from . import _switch
+    if not _switch.enabled:
+        return
+    global _dropped, _seq
+    with _lock:
+        ring, rare = _get_rings()
+        target = rare if kind in _RARE_KINDS else ring
+        _seq += 1
+        ev = {"ts": round(time.time() - _t0, 6), "seq": _seq,
+              "kind": kind, "step": _step}
+        ev.update(fields)
+        if len(target) == target.maxlen:
+            _dropped += 1
+        target.append(ev)
+    # mirror into the chrome-trace stream so profiler timelines show
+    # retraces/stalls inline with op spans (only while profiling runs;
+    # module ref cached so the per-event cost is one attribute check)
+    global _prof
+    try:
+        if _prof is None:
+            from .. import profiler as _p
+            _prof = _p
+        if _prof.active():
+            _prof._mirror_event(f"telemetry:{kind}", fields)
+    except Exception:
+        pass  # a broken mirror must never take down the recorder
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    """Current recorded events (oldest first, both rings merged into
+    one timeline), optionally filtered by kind."""
+    with _lock:
+        ring, rare = _get_rings()
+        evs = sorted(list(ring) + list(rare),
+                     key=lambda e: e["seq"])
+    if kind is not None:
+        evs = [e for e in evs if e["kind"] == kind]
+    return evs
+
+
+def clear_events():
+    """Empty both rings (capacity re-read from the env on next use, so
+    tests can resize it).  The global step counter survives — clearing
+    the window between warm-up and a timed region must not make later
+    events look like warm-up again."""
+    global _ring, _rare, _dropped
+    with _lock:
+        _ring = None
+        _rare = None
+        _dropped = 0
+
+
+def _reset_steps():
+    """Zero the global step counter (test isolation; part of
+    ``telemetry.reset()``)."""
+    global _step
+    with _lock:
+        _step = 0
+
+
+def dump_flight_recorder(path: Optional[str] = None,
+                         reason: str = "on_demand") -> str:
+    """Write the ring + a metrics snapshot as one JSON artifact;
+    returns the path written (also readable via :func:`last_dump`).
+
+    Default location: ``MXTPU_TELEMETRY_EXPORT`` when set (created if
+    missing), else the system temp dir; filename carries pid + a
+    millisecond suffix so concurrent dumps never clobber.
+    """
+    import tempfile
+    from . import metrics
+    from .. import envs
+    if path is None:
+        out_dir = envs.get("MXTPU_TELEMETRY_EXPORT") or \
+            tempfile.gettempdir()
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, "mxtpu_flight_%d_%d.json"
+            % (os.getpid(), int(time.time() * 1e3)))
+    with _lock:
+        ring, rare = _get_rings()
+        evs = sorted(list(ring) + list(rare),
+                     key=lambda e: e["seq"])
+        dropped = _dropped
+        step = _step
+    artifact = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "wall_time": time.time(),
+        "step": step,
+        "dropped_events": dropped,
+        "events": evs,
+        "metrics": metrics.snapshot(),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, path)
+    global _last_dump
+    _last_dump = path
+    return path
+
+
+def auto_dump(reason: str) -> Optional[str]:
+    """Crash-path dump (engine error / CompiledStep poison): same as
+    :func:`dump_flight_recorder` but throttled per process and never
+    raising — forensics must not mask the original failure."""
+    global _auto_dumps_left
+    from . import _switch
+    if not _switch.enabled:
+        return None
+    with _lock:
+        if _auto_dumps_left <= 0:
+            return None
+        _auto_dumps_left -= 1
+    try:
+        return dump_flight_recorder(reason=reason)
+    except Exception:
+        return None
+
+
+def last_dump() -> Optional[str]:
+    """Path of the most recent flight-recorder artifact (or None)."""
+    return _last_dump

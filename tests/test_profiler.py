@@ -1,0 +1,167 @@
+"""Profiler (mxnet_tpu/profiler.py) — direct tier-1 coverage.
+
+Until PR 4 the profiler was only incidentally exercised through
+``test_aux_subsystems.py``; this module owns its contract:
+
+* op spans recorded while ``set_state('run')`` (engine hook wired and
+  unwired), pause/resume gating;
+* ``record_scope`` ranges and ``Marker`` instant events;
+* ``MXTPU_PROFILE_SYNC`` routed through the typed envs registry and
+  actually blocking on outputs;
+* ``dump()`` chrome-trace JSON round-trip;
+* ``dumps()`` aggregate table AND the (previously silently ignored)
+  ``format_="json"`` mode; unknown formats raise.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.fixture(autouse=True)
+def _stopped():
+    """Leave the profiler stopped and drained around every test."""
+    yield
+    profiler.set_state("stop")
+    profiler.resume()
+    with profiler._lock:
+        profiler._events.clear()
+
+
+def _run_some_ops():
+    x = nd.array(np.random.rand(8, 8).astype("f4"))
+    y = nd.dot(x, x) + x
+    y.wait_to_read()
+    return y
+
+
+def test_op_spans_recorded_under_run(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fname)
+    assert profiler.state() == "stop"
+    profiler.set_state("run")
+    assert profiler.state() == "run" and profiler.active()
+    _run_some_ops()
+    profiler.set_state("stop")
+    _run_some_ops()                       # after stop: NOT recorded
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    ops = [e for e in trace["traceEvents"] if e.get("cat") == "operator"]
+    names = {e["name"] for e in ops}
+    assert "dot" in names and "broadcast_add" in names
+    # exactly one run's worth: the post-stop ops did not double it
+    assert sum(1 for e in ops if e["name"] == "dot") == 1
+    for e in ops:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_pause_resume_gate():
+    profiler.set_state("run")
+    profiler.pause()
+    _run_some_ops()
+    assert not profiler.active()
+    profiler.resume()
+    _run_some_ops()
+    profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    # the paused window's ops are absent; the resumed window's present
+    assert table.count("dot") == 1
+
+
+def test_record_scope_and_marker(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.record_scope("my_step"):
+        _run_some_ops()
+    profiler.Marker("hit").mark()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    scopes = [e for e in events if e.get("cat") == "scope"]
+    assert [e["name"] for e in scopes] == ["my_step"]
+    assert scopes[0]["ph"] == "X" and scopes[0]["dur"] > 0
+    markers = [e for e in events if e.get("cat") == "marker"]
+    assert [e["name"] for e in markers] == ["hit"]
+    assert markers[0]["ph"] == "i"
+
+
+def test_profile_sync_env_blocks(monkeypatch):
+    """MXTPU_PROFILE_SYNC=1 (read through the typed envs registry)
+    must block on each op's outputs so spans measure device time."""
+    blocked = []
+    import jax
+    real = jax.block_until_ready
+
+    def spy(out):
+        blocked.append(type(out).__name__)
+        return real(out)
+
+    monkeypatch.setenv("MXTPU_PROFILE_SYNC", "1")
+    monkeypatch.setattr(jax, "block_until_ready", spy)
+    profiler.set_state("run")
+    _run_some_ops()
+    profiler.set_state("stop")
+    assert blocked, "sync mode must block on op outputs"
+    # the registry's bool parsing gates it OFF for '0' (os.environ
+    # truthiness — the old direct read — would treat '0' as on);
+    # no wait_to_read here: the explicit sync would hit the patched
+    # block_until_ready on its own
+    blocked.clear()
+    monkeypatch.setenv("MXTPU_PROFILE_SYNC", "0")
+    profiler.set_state("run")
+    x = nd.array(np.random.rand(4, 4).astype("f4"))
+    nd.dot(x, x)
+    profiler.set_state("stop")
+    assert not blocked
+
+
+def test_dump_chrome_trace_round_trip(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    _run_some_ops()
+    profiler.set_state("stop")
+    profiler.dump()                       # finished=True drains
+    with open(fname) as f:
+        trace = json.load(f)
+    assert trace["displayTimeUnit"] == "ms"
+    assert all({"name", "ph", "ts", "pid"} <= set(e)
+               for e in trace["traceEvents"])
+    # drained: a second dump writes an empty trace
+    profiler.dump()
+    with open(fname) as f:
+        assert json.load(f)["traceEvents"] == []
+
+
+def test_dumps_table_and_json():
+    profiler.set_state("run")
+    _run_some_ops()
+    profiler.Marker("m").mark()           # instant event: no duration
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    header = table.splitlines()[0]
+    for col in ("Name", "Calls", "Total(us)", "Min(us)", "Max(us)",
+                "Avg(us)"):
+        assert col in header
+    assert "dot" in table
+
+    payload = json.loads(profiler.dumps(format_="json"))
+    ops = payload["ops"]
+    assert ops["dot"]["calls"] == 1
+    assert ops["dot"]["total_us"] >= ops["dot"]["min_us"] >= 0
+    assert "m" not in ops                 # markers carry no span
+    # table and json aggregate the SAME events
+    assert set(ops) == {line.split()[0]
+                        for line in table.splitlines()[1:]}
+
+
+def test_dumps_unknown_format_raises():
+    with pytest.raises(MXNetError, match="unknown dumps format"):
+        profiler.dumps(format_="xml")

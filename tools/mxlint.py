@@ -10,9 +10,13 @@ Pass families (rules documented in docs/static_analysis.md):
 * source passes (MXL3xx) over Python files — host-sync and
   retrace-storm hazards;
 * runtime passes — jit-cache key blowup (MXL401,
-  ``mxnet_tpu.analysis.analyze_cache``) and silent CompiledStep
-  eager fallbacks (MXL305, ``analyze_compiled_steps``), when run
-  in-process after a workload.
+  ``mxnet_tpu.analysis.analyze_cache``), silent CompiledStep
+  eager fallbacks (MXL305, ``analyze_compiled_steps``), and the
+  telemetry plane's hazards (``analyze_telemetry``: MXL306
+  post-warm-up retraces with the attributed cause, MXL307 prefetch
+  stall ratio), when run in-process after a workload.
+  ``--self-check`` includes ``analyze_telemetry`` (free in a fresh
+  process; surfaces findings when a workload ran first).
 
 Usage:
 
@@ -79,6 +83,11 @@ def main(argv=None) -> int:
 
     if args.self_check or args.registry:
         findings.extend(analysis.analyze_registry())
+    if args.self_check:
+        # telemetry runtime pass (MXL306/307): no-op in this fresh CLI
+        # process, load-bearing when --self-check runs in-process after
+        # a workload (and it keeps the pass import-checked in CI)
+        findings.extend(analysis.analyze_telemetry())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
