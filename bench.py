@@ -703,6 +703,79 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
         tblock
 
 
+def bench_compile_cache(batch_size=64):
+    """Cold vs warm time-to-first-step through the persistent compile
+    cache (PR 5 acceptance): the COLD phase builds a net + compiled
+    step and pays trace+compile on its first step; the WARM phase
+    simulates a process restart (in-memory engine cache cleared, fresh
+    net/trainer objects) and reaches its first step through
+    ``Trainer.warm_start`` + the on-disk executable cache.  Returns
+    ``{"cold": s, "warm": s, ...}`` — warm must be strictly lower, and
+    the warm phase must perform 0 fresh compiles."""
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    cache_dir = tempfile.mkdtemp(prefix="mxtpu_bench_cc_")
+    prev = os.environ.get("MXTPU_COMPILE_CACHE_DIR")
+    os.environ["MXTPU_COMPILE_CACHE_DIR"] = cache_dir
+    try:
+        loss_fn = gluon.loss.L2Loss()
+
+        def build(prefix):
+            mx.random.seed(0)
+            np.random.seed(0)
+            net = nn.HybridSequential(prefix=prefix)
+            with net.name_scope():
+                net.add(nn.Dense(512, activation="relu", in_units=256),
+                        nn.Dense(256, activation="relu", in_units=512),
+                        nn.Dense(10, in_units=256))
+            net.initialize(mx.init.Xavier())
+            net.hybridize()
+            tr = gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3}, kvstore=None)
+            return net, tr
+
+        x = nd.array(np.random.RandomState(0)
+                     .rand(batch_size, 256).astype("f4"))
+        y = nd.array(np.random.RandomState(1)
+                     .rand(batch_size, 10).astype("f4"))
+
+        engine.clear_cache()
+        engine.reset_counters()
+        t0 = time.perf_counter()
+        net, tr = build("ttfs_cold_")
+        cs = tr.compile_step(net, loss_fn)
+        cs.step(x, y, batch_size).wait_to_read()
+        cold = time.perf_counter() - t0
+        manifest = os.path.join(cache_dir, "step_manifest.json")
+        cs.save_signature(manifest)
+
+        # "fresh process": memory tier emptied, persistent tier kept
+        engine.clear_cache()
+        engine.reset_counters()
+        t0 = time.perf_counter()
+        net2, tr2 = build("ttfs_warm_")
+        cs2 = tr2.warm_start(net2, loss_fn, manifest)
+        cs2.step(x, y, batch_size).wait_to_read()
+        warm = time.perf_counter() - t0
+        info = engine.cache_info()
+        return {"cold": round(cold, 4), "warm": round(warm, 4),
+                "warm_started": bool(cs2.warm_started),
+                "warm_fresh_compiles": info["fresh_compiles"],
+                "persist_hits": info["persist"]["hits"],
+                "compile_seconds_saved":
+                    info["persist"]["seconds_saved"]}
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["MXTPU_COMPILE_CACHE_DIR"] = prev
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def _run_cpu_smoke_subprocess(sub_budget=240):
     """Run the degraded CPU smoke in a CHILD bench.py (so this process
     stays jax-free and can still take the chip path if a window opens
@@ -768,25 +841,30 @@ def main():
 
     if platform != "tpu" and not os.environ.get("MXTPU_BENCH_FORCE_CPU"):
         # chip not answering NOW: bank the CPU smoke immediately in a
-        # subprocess, then spend the WHOLE remaining budget probing —
-        # the r3 failure mode was a probe window of minutes against
-        # chip-contention timescales of hours.
+        # subprocess.  The probe VERDICT is then cached for the run —
+        # r05 burned ~21 min on five sequential 180 s client_init
+        # probes after the first UNREACHABLE verdict, all wedging in
+        # the same place.  One re-probe after a backoff (a wedged relay
+        # rarely un-wedges in seconds) is the most a run may spend; an
+        # honest 'cpu' verdict (the probe RAN and found no accelerator)
+        # is definitive and never re-probed.
         _run_cpu_smoke_subprocess()
-        while True:
-            remaining = budget - (time.monotonic() - _T0)
-            # a TPU attempt needs headroom for compile + two timed
-            # windows; below that, keep the banked smoke
-            if remaining < 420 + acquire_timeout:
-                break
-            time.sleep(min(90.0, remaining))
+        backoff = float(os.environ.get("MXTPU_BENCH_PROBE_BACKOFF",
+                                       "120"))
+        remaining = budget - (time.monotonic() - _T0)
+        if platform == "unreachable" and \
+                remaining >= 420 + acquire_timeout + backoff:
+            _log(f"probe verdict cached ({platform}); ONE re-probe "
+                 f"after {backoff:.0f}s backoff")
+            time.sleep(backoff)
             platform = probe_platform(acquire_timeout)
             tries += 1
             if platform == "tpu":
                 _log(f"chip window opened on probe {tries}")
-                break
         _record("probe_spanned", platform=platform, probes=tries)
         if platform != "tpu":
-            _log("no chip window in budget; emitting banked CPU smoke")
+            _log("no chip window (verdict cached after "
+                 f"{tries} probe(s)); emitting banked CPU smoke")
             _emit_and_exit(0)
 
     if platform == "unreachable":
@@ -816,6 +894,21 @@ def main():
         try:
             _log("stage 1: MLP trainer bench")
             sps, opt_disp, train_disp, tblock = bench_mlp_train()
+            # restart-cost series (PR 5): cold vs warm time-to-first-
+            # step through the persistent compile cache + AOT
+            # warm-start; rides the telemetry block so it survives
+            # stage 2 overwriting the headline metric
+            try:
+                ttfs = bench_compile_cache()
+                tblock["time_to_first_step_seconds"] = ttfs
+                _record("compile_cache_warm_start", **ttfs)
+                _log(f"warm-start: cold {ttfs['cold']:.2f}s -> warm "
+                     f"{ttfs['warm']:.2f}s "
+                     f"({ttfs['warm_fresh_compiles']} fresh compiles "
+                     "warm)")
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                _record("compile_cache_warm_start", error=repr(e))
             # the telemetry block rides EVERY subsequently-emitted
             # result line (stage 2 overwrites the metric, not this),
             # so the trajectory files capture dispatch/retrace/stall
@@ -865,13 +958,16 @@ def main():
 
     # stage 3: the headline — bert_base, TPU only.  (batch, seq) sweep:
     # larger global batches raise MXU utilization, and seq 512 probes
-    # the long-sequence regime.  BERT attention is NON-causal, whose
-    # r5-measured crossover keeps flash through seq 1024 (flash wins
-    # 1.6x at 512) — expect flash_active=true on the 512 rows; each
-    # config compiles fresh, so only sweep while budget remains.  The
-    # headline metric stays the seq-128 series for cross-round
-    # comparability; longer-seq configs are recorded in the report
-    # with their own MFU.
+    # the long-sequence regime.  The FINAL r5 dispatch policy routes
+    # non-causal attention to XLA SDPA until seq 4096
+    # (MXTPU_FLASH_XLA_FROM_NONCAUSAL=0 / MXTPU_FLASH_XLA_UNTIL=4096:
+    # the in-model A/B measured the Pallas custom-call as a fusion
+    # barrier), so flash_active=false is EXPECTED on the seq-512 rows —
+    # the kernel only re-enters for windowed/HBM-exceeding shapes or
+    # seq >= 4096.  Each config compiles fresh, so only sweep while
+    # budget remains.  The headline metric stays the seq-128 series
+    # for cross-round comparability; longer-seq configs are recorded
+    # in the report with their own MFU.
     if on_tpu:
         best = None
         # first entry runs UNBULKED: its program is the one every

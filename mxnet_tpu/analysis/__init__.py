@@ -21,7 +21,7 @@ from .graph_passes import analyze_symbol, analyze_graph_json, node_path
 from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
-                      analyze_telemetry)
+                      analyze_telemetry, analyze_compile_cache)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -31,6 +31,7 @@ __all__ = [
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
+    "analyze_compile_cache",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -52,5 +53,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # (nothing recorded), but a self_check run AFTER a workload in the
     # same process surfaces steady-state retraces and prefetch stalls
     findings.extend(analyze_telemetry())
+    # persistent compile-cache integrity (MXL402): a corrupted cache
+    # dir must fail CI loudly, not surface as silent fresh compiles at
+    # dispatch time (quiet when MXTPU_COMPILE_CACHE_DIR is unset)
+    findings.extend(analyze_compile_cache())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

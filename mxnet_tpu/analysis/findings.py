@@ -67,6 +67,8 @@ RULES = {
                "prefetch stall ratio above threshold (input-bound)"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
+    "MXL402": (Severity.ERROR,
+               "corrupt persistent compile-cache entry"),
 }
 
 

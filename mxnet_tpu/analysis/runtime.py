@@ -24,7 +24,7 @@ from typing import List
 from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
-           "analyze_telemetry"]
+           "analyze_telemetry", "analyze_compile_cache"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -74,6 +74,32 @@ def analyze_compiled_steps() -> List[Finding]:
                 f"the eager per-op path: {reason}",
                 f"step:{name}")
         for name, reason in _cs.fallback_reports()]
+
+
+def analyze_compile_cache() -> List[Finding]:
+    """MXL402 — corrupt entries in the persistent compile cache
+    (``MXTPU_COMPILE_CACHE_DIR``; quiet when the tier is disabled).
+
+    Dispatch-time loads are corruption-TOLERANT (a bad entry falls back
+    to a fresh compile), which is the right production behavior but
+    the wrong CI behavior: silent fallback turns a corrupted cache
+    volume into an invisible cold-start regression.  This pass — and
+    ``tools/mxcache.py verify``, which it mirrors — fails the
+    ``--self-check`` gate loudly instead.  Fingerprint-stale entries
+    (another jax/jaxlib/platform wrote them) are well-formed and not
+    flagged.
+    """
+    from ..engine import persist
+    if not persist.enabled():
+        return []
+    return [
+        Finding("MXL402",
+                f"persistent compile-cache entry {r['file']!r} is "
+                f"corrupt ({r.get('error')}); dispatch would silently "
+                "fall back to a fresh compile — delete it or run "
+                "tools/mxcache.py prune",
+                f"persist:{r['file']}")
+        for r in persist.verify() if not r["ok"]]
 
 
 def analyze_telemetry(warmup_steps: int = 2,

@@ -24,11 +24,13 @@ import functools
 import os
 import threading
 import weakref
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import persist
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
            "cache_info", "cache_size", "clear_cache", "drop_cached",
-           "reset_counters", "dispatch_count"]
+           "reset_counters", "dispatch_count", "aot_compile", "persist"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -43,6 +45,13 @@ _live = weakref.WeakSet()
 _hits = 0
 _misses = 0
 _dispatches = 0
+# compiles served by NO cache tier (memory or persistent).  With the
+# persistent tier on, this is exact (the tiered wrapper counts at the
+# actual lower+compile); with it off, a memory-tier miss is counted at
+# jit creation (the compile follows at first dispatch).  The warm-start
+# acceptance contract ("a warm restart performs 0 fresh compiles") is
+# asserted against this counter.
+_fresh_compiles = 0
 
 # -- telemetry plane (PR 4) -------------------------------------------------
 # The engine is the hottest seam in the process, so the telemetry
@@ -190,6 +199,103 @@ def _note_avals(name: str, key, arrays):
         t.record_event("retrace", op=name, cause=cause, changed=best)
 
 
+def _note_fresh_compile(name: str, seconds: Optional[float] = None):
+    """Count a compile no cache tier served (``seconds`` known only on
+    the AOT path, where the lower+compile is explicit)."""
+    global _fresh_compiles
+    with _lock:
+        _fresh_compiles += 1
+    t = _telem if _telem is not None else _telemetry()
+    if t._switch.enabled:
+        t.counter("mxtpu_fresh_compiles_total",
+                  "XLA compiles served by no cache tier").inc()
+        if seconds is not None:
+            t.histogram("mxtpu_compile_seconds",
+                        "fresh-compile wall clock (s)").observe(seconds)
+
+
+class _TieredFn:
+    """Memory-tier entry backed by the persistent tier (``persist.py``).
+
+    ``jax.jit``'s implicit per-aval retrace+compile is replaced by an
+    EXPLICIT per-aval-signature resolution: persistent tier (reload, no
+    trace) -> fresh AOT ``lower().compile()`` (serialized back to disk).
+    The explicit step is what makes a compiled-executable object exist
+    to serialize — a plain jit call never surfaces one.  Any failure in
+    the AOT/persist path demotes that signature to the plain jit path,
+    so the tier can cost time, never a dispatch.
+    """
+
+    __slots__ = ("name", "persist_name", "_bound", "_donate", "_sig",
+                 "_jitted", "_by_aval", "_rlock")
+
+    def __init__(self, name, bound, donate, sig, persist_name=None):
+        self.name = name
+        self.persist_name = persist_name or name
+        self._bound = bound
+        self._donate = tuple(donate)
+        self._sig = sig
+        self._jitted = None
+        self._by_aval: Dict[Tuple, Callable] = {}
+        self._rlock = threading.Lock()
+
+    def _jit(self):
+        if self._jitted is None:
+            jax = __import__("jax")
+            self._jitted = jax.jit(self._bound,
+                                   donate_argnums=self._donate) \
+                if self._donate else jax.jit(self._bound)
+        return self._jitted
+
+    def _resolve(self, s, arrays):
+        with self._rlock:
+            fn = self._by_aval.get(s)
+            if fn is not None:
+                return fn, "cached"
+            try:
+                fn, src = persist.tiered_compile(
+                    self.persist_name, self._jit(), arrays,
+                    donate=self._donate, sig=self._sig,
+                    op_label=self.name)
+            except Exception as e:
+                # AOT lower/compile rejected these args (weak types,
+                # committed-device quirks, ...): the plain jit path
+                # absorbs anything — dispatch must never break on a
+                # cache-tier optimization
+                t = _telem if _telem is not None else _telemetry()
+                if t._switch.enabled:
+                    t.record_event("persist_error", op=self.name,
+                                   error=f"aot demoted: {e!r}"[:300])
+                fn, src = self._jit(), "jit"
+            self._by_aval[s] = fn
+            return fn, src
+
+    def warm(self, arrays) -> str:
+        """Ensure an executable exists for these avals (arrays or
+        ``ShapeDtypeStruct``s) WITHOUT dispatching.  Returns where it
+        came from: ``cached`` / ``persist`` / ``compiled`` / ``jit``."""
+        return self._resolve(persist.aval_sig(arrays), arrays)[1]
+
+    def __call__(self, *arrays):
+        s = persist.aval_sig(arrays)
+        fn = self._by_aval.get(s)
+        if fn is None:
+            fn = self._resolve(s, arrays)[0]
+        try:
+            return fn(*arrays)
+        except TypeError:
+            # aval drift an AOT executable rejects (e.g. weak-typed
+            # scalar vs the committed one): demote this signature to
+            # the jit path permanently; a genuine arity/type error
+            # re-raises identically from the jit call
+            jit = self._jit()
+            if fn is jit:
+                raise
+            with self._rlock:
+                self._by_aval[s] = jit
+            return jit(*arrays)
+
+
 _NAIVE = None
 
 
@@ -231,7 +337,8 @@ def _cache_key(name: str, attrs: dict, donate: Tuple[int, ...]):
 
 
 def get_compiled(name: str, fcompute: Callable, attrs: dict,
-                 donate: Tuple[int, ...] = ()) -> Callable:
+                 donate: Tuple[int, ...] = (),
+                 persist_name: Optional[str] = None) -> Callable:
     """Return the jitted executable for (op, attrs); compile-once semantics.
 
     This is the moral equivalent of the reference's per-op FCompute lookup +
@@ -246,12 +353,20 @@ def get_compiled(name: str, fcompute: Callable, attrs: dict,
     call — swap the new buffer in before anything reads the old one).
     Donating and non-donating callers of the same (op, attrs) get
     distinct cache entries.
+
+    ``persist_name``: stable identity for the PERSISTENT tier when the
+    in-memory ``name`` is process-scoped (CompiledStep's uid-suffixed
+    step names); defaults to ``name``.  With
+    ``MXTPU_COMPILE_CACHE_DIR`` set, misses return a tiered wrapper
+    that consults the on-disk executable cache before compiling.
     """
     key, sig = _cache_key(name, attrs, donate)
-    return _get_compiled_keyed(key, sig, name, fcompute, attrs, donate)
+    return _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
+                               persist_name=persist_name)
 
 
-def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate):
+def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
+                        persist_name=None, force_tiered=False):
     """:func:`get_compiled` body with the cache key precomputed —
     invoke_compiled builds the key once and shares it with the
     telemetry plane's aval tracking instead of recomputing the
@@ -260,6 +375,7 @@ def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate):
     fn = _jit_cache.get(key)
     if fn is None:
         compiled_now = False
+        plain_jit = False
         with _lock:
             fn = _jit_cache.get(key)
             if fn is None:
@@ -270,13 +386,24 @@ def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate):
                 # must not be wrapped in an outer single-device jit
                 if getattr(fcompute, "_mxtpu_no_jit", False):
                     fn = bound
+                elif force_tiered or persist.enabled():
+                    # tiered wrapper: persistent tier under the memory
+                    # tier; the actual compile (and its fresh-compile
+                    # accounting) happens at per-aval resolution
+                    fn = _TieredFn(name, bound, tuple(donate), sig,
+                                   persist_name)
                 else:
                     jax = __import__("jax")
                     fn = jax.jit(bound, donate_argnums=tuple(donate)) \
                         if donate else jax.jit(bound)
+                    plain_jit = True
                 _jit_cache[key] = fn
                 compiled_now = True
         if compiled_now:
+            if plain_jit:
+                # persist tier off: the compile follows at the first
+                # dispatch of this jit — counted here, where the miss is
+                _note_fresh_compile(name)
             t = _telem if _telem is not None else _telemetry()
             if t._switch.enabled:
                 _counters(t)[2].inc()
@@ -307,13 +434,15 @@ _profiler_hook = None
 
 
 def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
-                    donate: Tuple[int, ...] = ()):
+                    donate: Tuple[int, ...] = (),
+                    persist_name: Optional[str] = None):
     """Execute an op through the compile cache. Returns jax array(s).
 
     ``donate`` flows to :func:`get_compiled` (buffer donation for the
     fused optimizer path).  NaiveEngine semantics are honored for every
     entry, donating or not: a donated fused step still blocks per
     dispatch when ``MXTPU_ENGINE_TYPE=NaiveEngine``.
+    ``persist_name``: see :func:`get_compiled`.
     """
     global _dispatches
     with _lock:
@@ -321,7 +450,8 @@ def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
     t = _telem if _telem is not None else _telemetry()
     telem_on = t._switch.enabled
     key, sig = _cache_key(name, attrs, donate)
-    fn = _get_compiled_keyed(key, sig, name, fcompute, attrs, donate)
+    fn = _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
+                             persist_name=persist_name)
     if telem_on:
         c_disp, c_don = _counters(t)[:2]
         c_disp.inc()
@@ -372,6 +502,30 @@ def waitall():
             raise
 
 
+def aot_compile(name: str, fcompute: Callable, attrs: dict,
+                example_args, donate: Tuple[int, ...] = (),
+                persist_name: Optional[str] = None) -> str:
+    """Warm-start entry: make sure (op, attrs) has a ready executable
+    for ``example_args`` (concrete arrays or ``ShapeDtypeStruct``s)
+    WITHOUT dispatching anything.
+
+    Resolution is the tiered wrapper's: memory -> persistent tier
+    (reload, no trace/compile) -> fresh AOT compile (persisted for the
+    next process).  Returns where the executable came from:
+    ``"cached"`` / ``"persist"`` / ``"compiled"``, or ``"jit"`` when
+    the key already holds a plain jit fn (warm in-process) /
+    ``"uncompilable"`` for ``_mxtpu_no_jit`` ops.
+    """
+    key, sig = _cache_key(name, attrs, donate)
+    fn = _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
+                             persist_name=persist_name,
+                             force_tiered=True)
+    if isinstance(fn, _TieredFn):
+        return fn.warm(example_args)
+    return "uncompilable" if getattr(fcompute, "_mxtpu_no_jit", False) \
+        else "jit"
+
+
 def dispatch_count() -> int:
     """Dispatches since process start (or ``reset_counters``) — the
     cheap accessor for per-step deltas; ``cache_info()`` builds the
@@ -408,19 +562,29 @@ def cache_info() -> dict:
     return {"size": len(keys), "live_buffers": len(_live),
             "engine": "NaiveEngine" if is_naive() else "ThreadedEngine",
             "hits": _hits, "misses": _misses, "dispatches": _dispatches,
+            "fresh_compiles": _fresh_compiles,
+            "persist": {"enabled": persist.enabled(),
+                        "dir": persist.cache_dir() or "",
+                        **persist.counters()},
             "ops": per_op}
 
 
-def clear_cache():
+def clear_cache(persistent: bool = False):
+    """Empty the in-memory jit cache.  ``persistent=True`` also removes
+    every on-disk entry in ``MXTPU_COMPILE_CACHE_DIR`` — the scope is
+    explicit because the persistent tier is exactly the state meant to
+    OUTLIVE a process-level reset."""
     with _lock:
         _jit_cache.clear()
     # attribution history follows the cache it describes
     with _attr_lock:
         _op_attr_sigs.clear()
         _key_avals.clear()
+    if persistent:
+        persist.clear()
 
 
-def drop_cached(name: str) -> int:
+def drop_cached(name: str, persistent: bool = False) -> int:
     """Evict every cache entry for op ``name``; returns the count.
 
     Exists for callers whose compiled program BAKES host state that can
@@ -429,24 +593,31 @@ def drop_cached(name: str) -> int:
     baked value drifts, the stale executable must be dropped and
     rebuilt rather than silently applying the old value.  Per-name so a
     single invalidation cannot flush the whole process's warm cache.
+    ``persistent=True`` extends the eviction to the on-disk tier
+    (entries whose persist name starts with ``name``).
     """
     with _lock:
         stale = [k for k in _jit_cache
                  if (k == name if isinstance(k, str) else k[0] == name)]
         for k in stale:
             del _jit_cache[k]
-    if stale:
+    n_disk = persist.drop(name) if persistent else 0
+    if stale or n_disk:
         t = _telem if _telem is not None else _telemetry()
         if t._switch.enabled:
-            t.record_event("evict", op=name, entries=len(stale))
-    return len(stale)
+            t.record_event("evict", op=name, entries=len(stale),
+                           persistent=n_disk)
+    return len(stale) + n_disk
 
 
 def reset_counters():
-    """Zero the hit/miss/dispatch counters (cache entries untouched)."""
-    global _hits, _misses, _dispatches
+    """Zero the hit/miss/dispatch/fresh-compile counters (cache entries
+    untouched); the persistent tier's hit/miss/saved counters reset
+    with them."""
+    global _hits, _misses, _dispatches, _fresh_compiles
     with _lock:
-        _hits = _misses = _dispatches = 0
+        _hits = _misses = _dispatches = _fresh_compiles = 0
+    persist.reset_counters()
 
 
 def _reset_naive():

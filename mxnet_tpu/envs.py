@@ -41,6 +41,17 @@ _reg("MXTPU_TEST_ON_TPU", bool, False,
 _reg("MXTPU_DISABLE_FLASH", bool, False,
      "Disable the Pallas flash-attention kernel (use the XLA SDPA "
      "path everywhere).")
+_reg("MXTPU_FLASH_BLOCK_Q", int, 0,
+     "Flash-attention query block size (rows per grid step). 0 = the "
+     "measured seq-adaptive default; values that do not divide the "
+     "sequence length fall back to it.")
+_reg("MXTPU_FLASH_BLOCK_K", int, 0,
+     "Flash-attention key block size. 0 = the measured seq-adaptive "
+     "default; non-dividing values fall back to it.")
+_reg("MXTPU_FLASH_INTERPRET", bool, False,
+     "Run the Pallas flash kernel in interpreter mode (any backend; "
+     "slow). Read at import of ops.flash_attention — set before "
+     "importing, or toggle flash_attention._INTERPRET in tests.")
 _reg("MXTPU_FLASH_MODE", str, "auto",
      "Flash-vs-XLA attention dispatch: auto (measured crossover "
      "policy), always (flash whenever viable), never.")
@@ -115,6 +126,17 @@ _reg("MXTPU_PREFETCH_DEPTH", int, 2,
 _reg("MXTPU_EXEC_BULK_EXEC_TRAIN", bool, True,
      "Accepted for parity; XLA fuses whole graphs at the hybridize "
      "seam so bulking is a no-op.", "MXNET_EXEC_BULK_EXEC_TRAIN")
+_reg("MXTPU_COMPILE_CACHE_DIR", str, "",
+     "Directory for the persistent compiled-executable cache (the "
+     "second tier under the engine's in-memory jit cache): compiled "
+     "programs are serialized there and reloaded across process "
+     "restarts, keyed by op/attrs/donation/input-avals plus a "
+     "jax+jaxlib+PJRT-platform fingerprint. Empty (default) disables "
+     "the tier. See docs/compile_cache.md.")
+_reg("MXTPU_COMPILE_CACHE_MAX_BYTES", int, 1 << 30,
+     "Size bound for MXTPU_COMPILE_CACHE_DIR: on insert, "
+     "least-recently-used entries are pruned until the directory fits "
+     "(loads refresh recency).")
 _reg("MXTPU_TELEMETRY", bool, True,
      "Master switch for the runtime telemetry plane (metrics, "
      "structured events, flight recorder, retrace-cause attribution). "

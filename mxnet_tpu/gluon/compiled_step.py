@@ -144,6 +144,22 @@ class CompiledStep:
         self._core_shape = None
         self._sig = None
         self._active_names = {self.name}
+        # persistent-tier identity + AOT warm-start bookkeeping
+        # (docs/compile_cache.md): the engine-cache name above is
+        # uid-suffixed (process-scoped), so persistent entries key on a
+        # STABLE name derived from the net + a structural hash; a
+        # warm-start manifest pins the name recorded at save time so
+        # auto-naming drift cannot orphan the entries
+        self._persist_base: Optional[str] = None
+        self._persist_pinned = False
+        self._struct_hash: Optional[str] = None
+        # set the first time _core actually TRACES in this process — a
+        # persistent-tier hit skips the trace, and with it the
+        # mutated_idx discovery the aux write-back routing needs
+        self._trace_seen = [False]
+        self._dims = None                 # (P, S, C, n_args) at save
+        self._variants = {}               # manifest rows per variant
+        self.warm_started = False
 
     # -- public API -------------------------------------------------------
     def step(self, data, label, batch_size=None):
@@ -222,6 +238,163 @@ class CompiledStep:
                 examples=batch_size * k_steps, path=self.last_path,
                 steps=k_steps)
             return out
+
+    # -- AOT warm-start (docs/compile_cache.md) ---------------------------
+    def save_signature(self, path: str) -> str:
+        """Write this step's warm-start manifest: input avals, donation
+        layout, structural hash, persistent-tier identity, and the aux
+        write-back routing for every compiled variant.  A fresh process
+        (same model/optimizer construction) feeds it to
+        :meth:`warm_start` / ``Trainer.warm_start`` to precompile the
+        whole fused train program before the first batch arrives.
+        Requires at least one successful compiled ``step()`` /
+        ``step_multi()``; returns ``path``."""
+        import json
+        from .. import engine
+        if not self._variants or self._sig is None:
+            raise MXNetError(
+                "save_signature: run at least one successful compiled "
+                "step() first (last_path must be 'compiled')")
+        P, S, C, n_args = self._dims
+        manifest = {
+            "format": 1, "kind": "gluon_compiled_step",
+            "fingerprint": engine.persist.fingerprint(),
+            "net": self.net.name, "loss": type(self.loss_fn).__name__,
+            "persist_base": self._persist_base,
+            "struct_hash": self._struct_hash,
+            "P": P, "S": S, "C": C, "n_args": n_args,
+            "tr_idx": [int(i) for i in self._tr_idx],
+            "mutated_idx": [int(i) for i in self._mutated_idx],
+            "variants": [self._variants[k]
+                         for k in sorted(self._variants)],
+        }
+        tmp = path + f".tmp{__import__('os').getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        __import__("os").replace(tmp, path)
+        return path
+
+    def warm_start(self, path: str) -> bool:
+        """Precompile every variant recorded in a
+        :meth:`save_signature` manifest — persistent-tier reload when
+        the cache dir holds the executables (no trace, no compile), a
+        fresh AOT compile otherwise — so the FIRST batch dispatches a
+        ready program.  Overlap it with DataLoader spin-up for
+        near-zero time-to-first-step across restarts.
+
+        Never raises for a bad/mismatched manifest: returns False (and
+        records a ``warm_start`` telemetry event with the reason), and
+        the step simply compiles on first use as it always did.
+        """
+        import json
+        import numpy as np
+        from .. import engine, telemetry
+        from .. import ndarray as nd
+
+        def _fail(reason):
+            telemetry.record_event("warm_start", name=self.name,
+                                   ok=False, reason=reason)
+            return False
+
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            return _fail(f"unreadable manifest: {e!r}"[:300])
+        if m.get("kind") != "gluon_compiled_step" or \
+                m.get("format") != 1:
+            return _fail("not a gluon_compiled_step manifest")
+        if m.get("fingerprint") != engine.persist.fingerprint():
+            return _fail("environment fingerprint mismatch "
+                         "(jax/jaxlib/platform/salt)")
+        if self._poisoned is not None:
+            return _fail("step is poisoned")
+        try:
+            P, S, C = int(m["P"]), int(m["S"]), int(m["C"])
+            n_args = int(m["n_args"])
+            variants = list(m["variants"])
+            base = m["persist_base"]
+        except (KeyError, TypeError, ValueError) as e:
+            return _fail(f"malformed manifest: {e!r}"[:300])
+        if not variants:
+            return _fail("manifest has no compiled variants")
+
+        # dummy inputs at the recorded avals drive the SAME setup the
+        # first real step would run (deferred-shape resolution included)
+        try:
+            single = min(variants, key=lambda v: bool(v["k_steps"]))
+            avals = engine.persist.sig_from_json(single["avals"])
+            in_avals = avals[P + S + C:P + S + C + n_args]
+            if any(len(a) != 2 for a in in_avals):
+                return _fail("non-array input aval in manifest")
+            shapes = [a[0] for a in in_avals]
+            if single.get("k_steps") and not single.get("repeat"):
+                # a bulked variant's inputs carry the K dim; setup
+                # wants per-step shapes (same slice _step_or_fallback
+                # takes)
+                shapes = [s[1:] for s in shapes]
+            args = [nd.array(np.zeros(s, dtype=np.dtype(a[1])))
+                    for s, a in zip(shapes, in_avals)]
+        except Exception as e:
+            return _fail(f"bad aval record: {e!r}"[:300])
+        try:
+            if not self._setup_done:
+                self._setup(args)
+            reason = self._eligibility()
+            if reason is not None:
+                return _fail(
+                    f"ineligible for the compiled path: {reason}")
+            try:
+                self._check_sig(len(self._state_leaves()), n_args)
+            except _TraceFallback as e:
+                return _fail(str(e))
+            if self._struct_hash != m.get("struct_hash"):
+                return _fail("structural hash mismatch: the manifest "
+                             "describes a different net/optimizer "
+                             "configuration")
+            # adopt the save-time identity: persistent entries were
+            # keyed under it, and gluon auto-naming may have drifted
+            self._persist_base = base
+            self._persist_pinned = True
+            self._mutated_idx[:] = [int(i) for i in m["mutated_idx"]]
+            self._trace_seen[0] = True
+            self._dims = (P, S, C, n_args)
+
+            import jax
+            ctx = self._params[0].data().context if self._params \
+                else None
+            core = self._get_core(P, S, C, n_args, ctx)
+            sources = {}
+            for v in variants:
+                try:
+                    sds = [jax.ShapeDtypeStruct(a[0], np.dtype(a[1]))
+                           for a in engine.persist.sig_from_json(
+                               v["avals"])]
+                except (TypeError, ValueError) as e:
+                    return _fail(f"bad variant avals: {e!r}"[:300])
+                k = v.get("k_steps")
+                if k:
+                    pure = self._make_pure_k(core, P, S, C, n_args,
+                                             int(k),
+                                             bool(v.get("repeat")))
+                else:
+                    pure = self._make_pure(core, P, S, C)
+                name = self.name + v["suffix"]
+                self._active_names.add(name)
+                sources[name] = engine.aot_compile(
+                    name, pure, {}, sds, donate=tuple(v["donate"]),
+                    persist_name=base + v["suffix"])
+                self._variants[(int(k or 0),
+                                bool(v.get("repeat")))] = v
+        except Exception as e:
+            # the never-raises contract: a stale manifest (e.g. wrong
+            # input widths feeding deferred-shape init) degrades to
+            # the cold-compile path, not a crash
+            return _fail(f"warm-start failed: {e!r}"[:300])
+        self.warm_started = True
+        telemetry.record_event("warm_start", name=self.name, ok=True,
+                               sources=sources)
+        return True
 
     # -- path selection ---------------------------------------------------
     def _coerce(self, data, label):
@@ -412,7 +585,19 @@ class CompiledStep:
                 engine.drop_cached(name)
             self._core = None
             self._core_shape = None
+            # a pinned warm-start identity described the PRE-drift
+            # program; re-derive so the persistent tier cannot serve a
+            # stale-attr executable (the attrs live in the hash)
+            self._persist_pinned = False
         self._sig = sig
+        import hashlib
+        self._struct_hash = hashlib.sha256(repr(
+            (sig, tuple((tuple(p.data().shape), str(p.data().dtype))
+                        for p in self._params))).encode()
+            ).hexdigest()[:16]
+        if not self._persist_pinned:
+            self._persist_base = \
+                f"gluon_step_{self.net.name}_{self._struct_hash}"
 
     def _dispatch(self, args, label, batch_size, k_steps=None,
                   repeat=False):
@@ -463,6 +648,7 @@ class CompiledStep:
         if k_steps is None:
             pure = self._make_pure(core, P, S, C)
             name = self.name
+            suffix = ""
             # donate trainable weights + ALL optimizer state leaves;
             # frozen params and the (autograd-owned) inputs are not ours
             # to alias
@@ -470,18 +656,31 @@ class CompiledStep:
         else:
             pure = self._make_pure_k(core, P, S, C, n_args, k_steps,
                                      repeat)
-            name = f"{self.name}_k{k_steps}" + ("r" if repeat else "")
+            suffix = f"_k{k_steps}" + ("r" if repeat else "")
+            name = self.name + suffix
             self._active_names.add(name)
             # the scan carries (and returns) EVERY param, so all of
             # them may donate
             donate = tuple(range(P + S))
+        persist_name = self._persist_base + suffix
 
         flat = [p.data()._data for p in params] \
             + [s._data for s in leaf_nds] + scal_vals \
             + [a._data for a in args] + [label._data] + key_vals
         try:
+            if not self._trace_seen[0] and engine.persist.enabled() \
+                    and engine.persist.contains(
+                        persist_name, (), donate,
+                        engine.persist.aval_sig(flat)):
+                # a persistent-tier hit skips the Python trace, and
+                # with it the mutated_idx discovery (the BatchNorm-aux
+                # write-back routing).  One abstract trace recovers it
+                # — host-only, no compile.  Trace failures land in the
+                # except below exactly like a jit-path trace failure.
+                jax.eval_shape(pure, *flat)
             res = engine.invoke_compiled(name, pure, {}, *flat,
-                                         donate=donate)
+                                         donate=donate,
+                                         persist_name=persist_name)
         except Exception as e:
             consumed = any(getattr(v, "is_deleted", lambda: False)()
                            for v in flat)
@@ -516,6 +715,19 @@ class CompiledStep:
                 f"whole-step trace/compile failed: {e!r}") from e
 
         self.last_path = "compiled"
+        # warm-start manifest row: everything a fresh process needs to
+        # precompile this exact variant before its first batch — built
+        # once per variant, not per step (the aval walk over a
+        # BERT-sized flat list is not free)
+        self._dims = (P, S, C, n_args)
+        vkey = (k_steps or 0, bool(repeat))
+        if vkey not in self._variants:
+            self._variants[vkey] = {
+                "suffix": suffix, "k_steps": k_steps,
+                "repeat": bool(repeat),
+                "donate": [int(i) for i in donate],
+                "avals": engine.persist.sig_to_json(
+                    engine.persist.aval_sig(flat))}
         T = len(tr_idx)
         if k_steps is None:
             loss_val = res[0]
@@ -549,10 +761,12 @@ class CompiledStep:
         tr_idx = list(self._tr_idx)
         tr_set = set(tr_idx)
         mutated_idx = self._mutated_idx
+        trace_seen = self._trace_seen
 
         def core(param_vals, state_vals, scal_vals, input_vals,
                  label_val, key_raw):
             import jax
+            trace_seen[0] = True     # body runs only under a trace
             import jax.numpy as jnp
             from .. import autograd
             from .. import random as _rnd

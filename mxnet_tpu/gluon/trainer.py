@@ -164,6 +164,19 @@ class Trainer:
         from .compiled_step import CompiledStep
         return CompiledStep(net, loss_fn, self)
 
+    def warm_start(self, net, loss_fn, path):
+        """:meth:`compile_step` + AOT precompile from a warm-start
+        manifest (``CompiledStep.save_signature``): with a populated
+        ``MXTPU_COMPILE_CACHE_DIR`` the whole fused train program is
+        reloaded from disk BEFORE the first batch arrives — restart
+        cost becomes O(disk read) instead of O(model compile).  Always
+        returns the CompiledStep; ``.warm_started`` reports whether the
+        precompile succeeded (failure is harmless — the first step
+        compiles as usual).  See docs/compile_cache.md."""
+        step = self.compile_step(net, loss_fn)
+        step.warm_start(path)
+        return step
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads, then apply optimizer scaled by 1/batch_size.
 

@@ -222,7 +222,10 @@ def _flash_viable(q, k):
     """Pallas kernel needs TPU (or interpret mode) + 128-aligned seq
     lens; head_dim only needs 8-alignment — the kernel zero-pads it to
     the 128 lane width, so BERT's d=64 takes the flash path."""
-    if os.environ.get("MXTPU_DISABLE_FLASH"):
+    # through the typed registry so '0'/'false' parse as FALSE (the raw
+    # environ read treated any non-empty string as disabled)
+    from .. import envs
+    if envs.get("MXTPU_DISABLE_FLASH"):
         return False
     from . import flash_attention as fa
     if not fa._INTERPRET:

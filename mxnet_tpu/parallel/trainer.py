@@ -195,6 +195,19 @@ class DataParallelTrainer:
         self._full_fn = None
         self._multi_step_cache = {}
         self._mutated_idx: List[int] = []
+        # persistent-compile-cache plumbing (docs/compile_cache.md):
+        # the fused step dispatches through an EXPLICIT AOT executable
+        # so it can be serialized across restarts; the unjitted step
+        # bodies are kept for the abstract re-trace a persist hit needs
+        # (mutated_idx discovery), and warm-start manifests pin the
+        # save-time identity + record the mesh/sharding layout
+        self._full_exec = None
+        self._multi_exec = {}
+        self._multi_fns = {}
+        self._trace_seen = [False]
+        self._persist_pin: Optional[str] = None
+        self._var_avals = {}
+        self.warm_started = False
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
         if fuse_step and self._rule is None:
             import warnings
@@ -290,8 +303,10 @@ class DataParallelTrainer:
         param_nds = [p.data() for p in params]
         tr_idx = self._tr_idx
         mutated_idx: List[int] = []
+        trace_seen = self._trace_seen
 
         def traced(param_vals, input_vals, label_val, key_raw):
+            trace_seen[0] = True     # body runs only under a trace
             key_counter = [0]
 
             def key_provider(_ctx):
@@ -526,6 +541,293 @@ class DataParallelTrainer:
         self._full_step = jax.jit(
             mapped, donate_argnums=(1, 6) if use_residual else (1,))
 
+    # -- persistent compile cache (docs/compile_cache.md) -----------------
+    def _persist_name(self) -> str:
+        """Stable persistent-tier identity for this trainer's fused
+        step: block name + a hash over everything structural that the
+        compiled program bakes (param shapes/dtypes, trainable set,
+        optimizer class, mesh axes/sizes, dp axis).  A warm-start
+        manifest pins the save-time name (``_persist_pin``) so gluon
+        auto-naming drift cannot orphan on-disk entries."""
+        if self._persist_pin is not None:
+            return self._persist_pin
+        import hashlib
+        parts = (type(self.optimizer).__name__,
+                 tuple((tuple(p.data().shape), str(p.data().dtype))
+                       for p in self._params),
+                 tuple(self._tr_idx),
+                 tuple((str(k), int(v))
+                       for k, v in self.mesh.shape.items()),
+                 self.dp_axis)
+        h = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+        return f"spmd_full_step_{self.block.name}_{h}"
+
+    def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
+        """Resolve the dispatchable for one fused-step variant:
+        persistent tier (reload — no trace, no compile) -> fresh AOT
+        ``lower().compile()`` serialized back to disk.  With the tier
+        disabled (or on any failure) returns ``jitted`` unchanged, so
+        the optimization can cost time, never a step."""
+        from ..engine import persist as _persist
+        if not _persist.enabled():
+            return jitted
+        name = self._persist_name() + suffix
+        try:
+            import jax
+            avals = _persist.aval_sig(vals)
+            if not self._trace_seen[0] and \
+                    _persist.contains(name, (), donate, avals):
+                # a persist hit skips the Python trace, and with it the
+                # mutated_idx discovery (BatchNorm-aux write-back
+                # routing) — one abstract trace recovers it
+                jax.eval_shape(pyfn, *vals)
+            fn, _src = _persist.tiered_compile(
+                name, jitted, vals, donate=donate,
+                op_label=f"spmd_full_step{suffix}")
+            return fn
+        except Exception as e:
+            from .. import telemetry
+            telemetry.record_event(
+                "persist_error", op=f"spmd_full_step{suffix}",
+                error=f"aot demoted: {e!r}"[:300])
+            return jitted
+
+    def _record_variant(self, suffix, vals, k_steps, repeated):
+        """Manifest row for :meth:`save_signature`: the data-dependent
+        avals of one compiled variant (params/optimizer-state avals are
+        re-derived locally at warm-start time)."""
+        from ..engine import persist as _persist
+        from jax import tree_util
+        _pv, _sv, scal, x, y, key = vals
+        self._var_avals[(k_steps or 0, bool(repeated))] = {
+            "suffix": suffix,
+            "k_steps": k_steps, "repeat": bool(repeated),
+            "inputs": _persist.sig_to_json(_persist.aval_sig(x)),
+            "label": _persist.sig_to_json(_persist.aval_sig([y]))[0],
+            "key": _persist.sig_to_json(_persist.aval_sig([key]))[0],
+            "scalars": _persist.sig_to_json(_persist.aval_sig(
+                tree_util.tree_leaves(scal))),
+        }
+
+    def _dispatch_full(self, vals):
+        """One fused-step dispatch through the tiered executable.
+
+        ``_full_exec`` caches ``({aval sig: executable}, jitted)`` —
+        per-signature so an aval drift (e.g. a changed batch size)
+        resolves its OWN executable through the tier (own disk entry,
+        warm restarts for both shapes) instead of raising per step;
+        a signature whose AOT call still fails is demoted to the jit
+        path permanently.  The cache is discarded whenever
+        ``self._full_step`` is rebound (rebuilds, test seams), so the
+        jit attribute stays the source of truth."""
+        from ..engine import persist as _persist
+        jit_fn = self._full_step
+        if (0, False) not in self._var_avals:
+            self._record_variant("", vals, None, False)
+        if not _persist.enabled():
+            return jit_fn(*vals)
+        cached = self._full_exec
+        if cached is None or cached[1] is not jit_fn:
+            cached = ({}, jit_fn)
+            self._full_exec = cached
+        by_sig = cached[0]
+        s = _persist.aval_sig(vals)
+        fn = by_sig.get(s)
+        if fn is None:
+            fn = self._tiered_exec("", jit_fn, self._full_fn, vals,
+                                   (1,))
+            by_sig[s] = fn
+        if fn is jit_fn:
+            return fn(*vals)
+        try:
+            return fn(*vals)
+        except TypeError:
+            by_sig[s] = jit_fn        # cached demotion, not per-step
+            return jit_fn(*vals)
+
+    def save_signature(self, path: str) -> str:
+        """Write the warm-start manifest for this trainer's compiled
+        step variants: mesh axes/sizes, dp axis, per-param sharding
+        layout, aux write-back routing, and the data-dependent input
+        avals.  A fresh process with the same model/optimizer/mesh
+        construction feeds it to :meth:`warm_start` to precompile the
+        fused SPMD program (persistent-tier reload when
+        ``MXTPU_COMPILE_CACHE_DIR`` holds it) before the first batch.
+        Requires at least one successful fused ``step()`` /
+        ``step_multi()``; returns ``path``."""
+        import json
+        import os as _os
+        from ..engine import persist as _persist
+        if not self._var_avals or self._params is None:
+            raise MXNetError(
+                "save_signature: run at least one successful fused "
+                "step() / step_multi() first")
+        shardings = []
+        for p in self._params:
+            try:
+                shardings.append(str(p.data()._data.sharding.spec))
+            except AttributeError:
+                shardings.append("")
+        manifest = {
+            "format": 1, "kind": "spmd_full_step",
+            "fingerprint": _persist.fingerprint(),
+            "persist_name": self._persist_name(),
+            "block": self.block.name,
+            "optimizer": type(self.optimizer).__name__,
+            "mesh": {str(k): int(v)
+                     for k, v in self.mesh.shape.items()},
+            "dp_axis": self.dp_axis,
+            "param_shardings": shardings,
+            "n_args": self._n_args,
+            "tr_idx": [int(i) for i in self._tr_idx],
+            "mutated_idx": [int(i) for i in self._mutated_idx],
+            "variants": [self._var_avals[k]
+                         for k in sorted(self._var_avals)],
+        }
+        tmp = path + f".tmp{_os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        _os.replace(tmp, path)
+        return path
+
+    def warm_start(self, path: str) -> bool:
+        """Precompile the fused step variants recorded in a
+        :meth:`save_signature` manifest before the first batch arrives
+        — a persistent-tier reload when the cache dir holds the
+        executables, a fresh AOT compile otherwise.  Verifies the mesh
+        layout (axis names + sizes), optimizer class, and the
+        structural hash against the manifest; any mismatch (or any
+        error) returns False and the first step compiles as usual.
+        Requires ``fuse_step=True`` with a fused optimizer rule."""
+        import json
+        import numpy as np
+        from .. import autograd, telemetry
+        from ..engine import persist as _persist
+        from .. import ndarray as nd
+
+        def _fail(reason):
+            telemetry.record_event("warm_start", name="spmd_full_step",
+                                   ok=False, reason=reason)
+            return False
+
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            return _fail(f"unreadable manifest: {e!r}"[:300])
+        if m.get("kind") != "spmd_full_step" or m.get("format") != 1:
+            return _fail("not an spmd_full_step manifest")
+        if m.get("fingerprint") != _persist.fingerprint():
+            return _fail("environment fingerprint mismatch "
+                         "(jax/jaxlib/platform/salt)")
+        if not (self._fuse_step and self._rule is not None):
+            return _fail("trainer has no fused step "
+                         "(fuse_step=False or no fused rule)")
+        if self._compression_cfg is not None:
+            return _fail("gradient compression is not covered by "
+                         "warm-start manifests")
+        if self._donation_poisoned is not None:
+            return _fail("trainer is poisoned")
+        mesh_now = {str(k): int(v) for k, v in self.mesh.shape.items()}
+        if mesh_now != m.get("mesh") or \
+                self.dp_axis != m.get("dp_axis"):
+            return _fail(f"mesh layout mismatch: manifest "
+                         f"{m.get('mesh')}/{m.get('dp_axis')!r} vs "
+                         f"current {mesh_now}/{self.dp_axis!r}")
+        if type(self.optimizer).__name__ != m.get("optimizer"):
+            return _fail("optimizer class mismatch")
+        try:
+            variants = list(m["variants"])
+            ref = min(variants, key=lambda v: bool(v["k_steps"]))
+            in_avals = _persist.sig_from_json(ref["inputs"])
+            lbl_aval = _persist.sig_from_json([ref["label"]])[0]
+            shapes = [a[0] for a in in_avals]
+            lbl_shape = lbl_aval[0]
+            if ref.get("k_steps") and not ref.get("repeat"):
+                shapes = [s[1:] for s in shapes]
+                lbl_shape = lbl_shape[1:]
+            args = [nd.array(np.zeros(s, dtype=np.dtype(a[1])))
+                    for s, a in zip(shapes, in_avals)]
+            label = nd.array(np.zeros(
+                lbl_shape, dtype=np.dtype(lbl_aval[1])))
+        except Exception as e:
+            return _fail(f"bad aval record: {e!r}"[:300])
+
+        import jax
+        prev = autograd.set_training(True)
+        try:
+            if self._params is None:
+                self._setup(args)
+            # structural hash must match before adopting the identity —
+            # the hash part of the persist name covers param
+            # shapes/dtypes, trainable set, optimizer, and mesh layout
+            local_hash = self._persist_name().rsplit("_", 1)[-1]
+            if str(m.get("persist_name", "")).rsplit("_", 1)[-1] != \
+                    local_hash:
+                return _fail("structural hash mismatch: the manifest "
+                             "describes a different model/optimizer/"
+                             "mesh configuration")
+            if self._fwd_bwd is None:
+                self._build_fwd_bwd(args, label)
+            if self._full_fn is None:
+                self._build_full_step()
+            # AFTER the builders: _build_fwd_bwd rebinds
+            # self._mutated_idx to a fresh list, which would silently
+            # drop the adopted aux routing (BatchNorm write-backs)
+            self._persist_pin = m["persist_name"]
+            self._mutated_idx[:] = [int(i) for i in m["mutated_idx"]]
+            self._trace_seen[0] = True
+            param_vals = tuple(p.data()._data for p in self._params)
+            state_vals = self._state_vals()
+            for v in variants:
+                try:
+                    x_sds = tuple(
+                        jax.ShapeDtypeStruct(a[0], np.dtype(a[1]))
+                        for a in _persist.sig_from_json(v["inputs"]))
+                    la = _persist.sig_from_json([v["label"]])[0]
+                    y_sds = jax.ShapeDtypeStruct(la[0], np.dtype(la[1]))
+                    ka = _persist.sig_from_json([v["key"]])[0]
+                    k_sds = jax.ShapeDtypeStruct(ka[0], np.dtype(ka[1]))
+                    scal_avals = _persist.sig_from_json(v["scalars"])
+                    scal_sds = [jax.ShapeDtypeStruct(
+                        a[0], np.dtype(a[1])) for a in scal_avals]
+                except (TypeError, ValueError) as e:
+                    return _fail(f"bad variant avals: {e!r}"[:300])
+                k = v.get("k_steps")
+                if k:
+                    kk = (int(k), bool(v.get("repeat")))
+                    vals = (param_vals, state_vals, scal_sds[0],
+                            x_sds, y_sds, k_sds)
+                    fn = self._multi_step_cache.get(kk)
+                    if fn is None:
+                        fn = self._build_full_step_multi(*kk)
+                    call = self._tiered_exec(
+                        v["suffix"], fn, self._multi_fns[kk], vals,
+                        (0, 1))
+                    self._multi_exec[kk] = (
+                        {_persist.aval_sig(vals): call}, fn)
+                else:
+                    vals = (param_vals, state_vals, tuple(scal_sds),
+                            x_sds, y_sds, k_sds)
+                    call = self._tiered_exec(
+                        "", self._full_step, self._full_fn, vals, (1,))
+                    self._full_exec = (
+                        {_persist.aval_sig(vals): call},
+                        self._full_step)
+                self._var_avals[(int(k or 0),
+                                 bool(v.get("repeat")))] = v
+        except Exception as e:
+            # the never-raises contract: a mismatched/stale manifest
+            # (wrong input widths feeding deferred init, a builder
+            # failure, ...) degrades to the cold path, not a crash
+            return _fail(f"warm-start failed: {e!r}"[:300])
+        finally:
+            autograd.set_training(prev)
+        self.warm_started = True
+        telemetry.record_event("warm_start", name="spmd_full_step",
+                               ok=True)
+        return True
+
     # -- public API -------------------------------------------------------
     def step(self, data, label):
         """Run ONE fused SPMD train step; returns the loss NDArray.
@@ -688,13 +990,44 @@ class DataParallelTrainer:
             self._prune_placed(used)
             param_vals = tuple(p.data()._data for p in self._params)
 
-            fn = self._multi_step_cache.get((k_steps, repeated))
+            kk = (k_steps, repeated)
+            fn = self._multi_step_cache.get(kk)
             if fn is None:
                 fn = self._build_full_step_multi(k_steps, repeated)
-            try:
-                loss_k, new_all_params, new_states = fn(
-                    param_vals, self._state_vals(), scalar_k, x_vals,
+            vals = (param_vals, self._state_vals(), scalar_k, x_vals,
                     y_val, keys_k)
+            from ..engine import persist as _persist
+            if kk not in self._var_avals:
+                self._record_variant(
+                    f"_k{k_steps}" + ("r" if repeated else ""), vals,
+                    k_steps, repeated)
+            if _persist.enabled():
+                cached = self._multi_exec.get(kk)
+                if cached is None or cached[1] is not fn:
+                    cached = ({}, fn)
+                    self._multi_exec[kk] = cached
+                sig = _persist.aval_sig(vals)
+                call = cached[0].get(sig)
+                if call is None:
+                    suffix = f"_k{k_steps}" + ("r" if repeated else "")
+                    call = self._tiered_exec(
+                        suffix, fn, self._multi_fns[kk], vals, (0, 1))
+                    cached[0][sig] = call
+            else:
+                cached, sig, call = None, None, fn
+            try:
+                try:
+                    loss_k, new_all_params, new_states = call(*vals)
+                except TypeError:
+                    # aval drift the AOT executable rejects: demote
+                    # THIS signature to the pjit path (cached — not a
+                    # raise per step), which absorbs it by retracing
+                    # exactly as before the persistent tier existed
+                    if call is fn:
+                        raise
+                    if cached is not None:
+                        cached[0][sig] = fn
+                    loss_k, new_all_params, new_states = fn(*vals)
             except Exception as e:
                 # donate_argnums=(0, 1): if the executable consumed
                 # the donated param/state buffers before failing they
@@ -820,6 +1153,9 @@ class DataParallelTrainer:
             out_shardings=(None, param_shardings, state_shardings),
             donate_argnums=(0, 1))
         self._multi_step_cache[(k_steps, repeated)] = fn
+        # the unjitted body backs the persistent tier's abstract
+        # re-trace (mutated_idx recovery on a persist hit)
+        self._multi_fns[(k_steps, repeated)] = full_k
         return fn
 
     def _sharding_tuples(self):
@@ -894,10 +1230,10 @@ class DataParallelTrainer:
                             self._residual_vals = new_res
                     else:
                         loss, new_params, new_states, aux = \
-                            self._full_step(
-                                param_vals, self._state_vals(),
-                                tuple(scalar_vals), x_vals, y_val,
-                                key._data)
+                            self._dispatch_full(
+                                (param_vals, self._state_vals(),
+                                 tuple(scalar_vals), x_vals, y_val,
+                                 key._data))
                 except Exception as e:
                     # donate_argnums=(1,): if the executable consumed
                     # the donated state buffers before failing, they

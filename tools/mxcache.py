@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""mxcache: inspect and maintain the persistent compile cache.
+
+The cache dir (``MXTPU_COMPILE_CACHE_DIR``, or ``--dir``) holds
+serialized compiled executables — the second tier under the engine's
+in-memory jit cache (docs/compile_cache.md).  Subcommands:
+
+    python tools/mxcache.py ls               # one row per entry
+    python tools/mxcache.py verify           # CI gate: exit 1 on
+                                             # corrupt entries
+    python tools/mxcache.py prune            # LRU-evict to the size
+                                             # bound (--max-bytes)
+    python tools/mxcache.py prune --all      # empty the cache
+
+``verify`` checks header structure, payload checksum, and the current
+environment fingerprint (a well-formed entry another jax/jaxlib/
+platform wrote reports as ``stale``, not corrupt).  It is also wired
+into ``tools/mxlint.py --self-check`` (rule MXL402), so a corrupted
+cache dir fails CI loudly instead of surfacing as silent fresh
+compiles at dispatch time.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _dir_of(args) -> str:
+    if args.dir:
+        os.environ["MXTPU_COMPILE_CACHE_DIR"] = args.dir
+        return args.dir
+    from mxnet_tpu import envs
+    d = envs.get("MXTPU_COMPILE_CACHE_DIR")
+    if not d:
+        print("mxcache: no cache dir (set MXTPU_COMPILE_CACHE_DIR or "
+              "pass --dir)", file=sys.stderr)
+        sys.exit(2)
+    return d
+
+
+def cmd_ls(args) -> int:
+    from mxnet_tpu.engine import persist
+    d = _dir_of(args)
+    rows = persist.ls(d)
+    if args.fmt == "json":
+        print(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        print(f"{d}: empty")
+        return 0
+    now = time.time()
+    total = 0
+    print(f"{'OP':40} {'KIND':7} {'SIZE':>10} {'AGE':>8} "
+          f"{'COMPILE_S':>9}  FILE")
+    for r in rows:
+        total += r["bytes"]
+        age = now - r["mtime"]
+        age_s = f"{age / 3600:.1f}h" if age > 3600 else f"{age:.0f}s"
+        if r.get("ok"):
+            print(f"{str(r.get('op'))[:40]:40} {str(r.get('kind')):7} "
+                  f"{r['bytes']:>10} {age_s:>8} "
+                  f"{r.get('compile_seconds') or 0:>9.2f}  {r['file']}")
+        else:
+            print(f"{'<CORRUPT>':40} {'-':7} {r['bytes']:>10} "
+                  f"{age_s:>8} {'-':>9}  {r['file']}  "
+                  f"({r.get('error')})")
+    print(f"-- {len(rows)} entries, {total / 2**20:.1f} MiB in {d}")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    from mxnet_tpu.engine import persist
+    d = _dir_of(args)
+    rows = persist.verify(d)
+    bad = [r for r in rows if not r["ok"]]
+    stale = [r for r in rows if r["ok"] and r.get("stale")]
+    if args.fmt == "json":
+        print(json.dumps({"entries": rows, "corrupt": len(bad),
+                          "stale": len(stale)}, indent=2))
+    else:
+        for r in bad:
+            print(f"CORRUPT {r['file']}: {r.get('error')}")
+        for r in stale:
+            print(f"stale   {r['file']} (other jax/platform "
+                  "fingerprint)")
+        print(f"mxcache verify: {len(rows)} entries, {len(bad)} "
+              f"corrupt, {len(stale)} stale in {d}")
+    return 1 if bad else 0
+
+
+def cmd_prune(args) -> int:
+    from mxnet_tpu.engine import persist
+    d = _dir_of(args)
+    if args.all:
+        n = persist.clear(d)
+        print(f"mxcache: removed all {n} entries from {d}")
+        return 0
+    limit = args.max_bytes if args.max_bytes is not None \
+        else persist.max_bytes()
+    n = persist.prune(limit, d)
+    print(f"mxcache: pruned {n} LRU entries (bound {limit} bytes) "
+          f"in {d}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxcache", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default="",
+                    help="cache directory (default: "
+                    "MXTPU_COMPILE_CACHE_DIR)")
+    ap.add_argument("--format", choices=["text", "json"],
+                    default="text", dest="fmt")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("ls", help="list entries")
+    sub.add_parser("verify",
+                   help="integrity check; exit 1 on corruption")
+    p = sub.add_parser("prune", help="LRU-evict to the size bound")
+    p.add_argument("--max-bytes", type=int, default=None,
+                   help="override MXTPU_COMPILE_CACHE_MAX_BYTES")
+    p.add_argument("--all", action="store_true",
+                   help="remove every entry")
+    args = ap.parse_args(argv)
+    return {"ls": cmd_ls, "verify": cmd_verify,
+            "prune": cmd_prune}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

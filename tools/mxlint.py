@@ -88,6 +88,10 @@ def main(argv=None) -> int:
         # process, load-bearing when --self-check runs in-process after
         # a workload (and it keeps the pass import-checked in CI)
         findings.extend(analysis.analyze_telemetry())
+        # persistent compile-cache integrity (MXL402, the CI face of
+        # tools/mxcache.py verify): corruption fails the gate loudly
+        # instead of degrading dispatch into silent fresh compiles
+        findings.extend(analysis.analyze_compile_cache())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
