@@ -233,10 +233,66 @@ def _latest_committed_onchip():
     return best
 
 
+def _memory_block(params=None):
+    """The per-stage ``memory`` block: the observatory's report —
+    per-program peak/temp/argument bytes, donation savings, collective
+    traffic, live census.  Never raises; {} when nothing harvested
+    (telemetry off)."""
+    try:
+        from mxnet_tpu import telemetry
+        return telemetry.memory.report(params=params)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
+def _apply_memory_gate(result) -> int:
+    """Opt-in regression gate (MXTPU_BENCH_MAX_PEAK_BYTES): when any
+    harvested program's per-device peak exceeds the bound, stamp a
+    failed ``memory_gate`` block on the result and return exit code 1.
+    Inert unless the env is set AND this process ran a workload (the
+    jax-free banked-smoke parent must not import mxnet_tpu here)."""
+    try:
+        if "mxnet_tpu" not in sys.modules:
+            return 0
+        from mxnet_tpu import envs, telemetry
+        limit = envs.get("MXTPU_BENCH_MAX_PEAK_BYTES")
+        if not limit:
+            return 0
+        progs = telemetry.memory.programs()
+        if not progs:
+            # a gate with nothing to measure (MXTPU_TELEMETRY=0, or no
+            # harvested programs) must not read as green silently
+            result["memory_gate"] = {
+                "limit_bytes": int(limit), "max_peak_bytes": 0,
+                "program": "", "failed": False, "no_data": True}
+            _log("MEMORY GATE: MXTPU_BENCH_MAX_PEAK_BYTES is set but "
+                 "no programs were harvested (telemetry off?) — gate "
+                 "did not measure anything")
+            return 0
+        worst_bytes, worst_name = 0, ""
+        for name, rec in progs.items():
+            peak = rec.get("peak_bytes") or 0
+            if peak > worst_bytes:
+                worst_bytes, worst_name = peak, name
+        failed = worst_bytes > limit
+        result["memory_gate"] = {
+            "limit_bytes": int(limit), "max_peak_bytes": worst_bytes,
+            "program": worst_name, "failed": failed}
+        if failed:
+            _log(f"MEMORY GATE FAILED: {worst_name} peak "
+                 f"{worst_bytes} > {limit} bytes")
+        return 1 if failed else 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 0
+
+
 def _emit_and_exit(code=0):
     with _lock:
         if not _state["emitted"]:
             _state["emitted"] = True
+            code = code or _apply_memory_gate(_state["result"])
             print(json.dumps(_state["result"]), flush=True)
     os._exit(code)
 
@@ -614,7 +670,12 @@ def bench_bert_pretrain(builder_name, vocab, batch_size, seq_len,
                     _tsnap["histograms"].get("mxtpu_spmd_step_seconds"),
                 "retrace_events": _tm.events("retrace"),
                 "prefetch_stall_ratio": round(
-                    _tm.prefetch_stall_ratio(), 4)})
+                    _tm.prefetch_stall_ratio(), 4)},
+            # SPMD device-side accounting: per-program peaks plus the
+            # per-collective bytes-per-step table (the dp gradient
+            # all-reduce) — the evidence the ZeRO/quantized-collective
+            # roadmap items will be accepted against
+            memory=_memory_block(params=model.collect_params()))
     if on_tpu and flash_hits == 0:
         _log(f"WARNING: {builder_name} compiled WITHOUT the flash "
              "kernel (0 flash dispatches) — MFU claims assume it")
@@ -677,6 +738,10 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
             "prefetch_stall_ratio": round(
                 telemetry.prefetch_stall_ratio(), 4),
             "retrace_events": telemetry.events("retrace"),
+            # the observatory's device-side view: per-program
+            # peak/temp/argument bytes, donation-saved bytes (the
+            # donated train step must show > 0), live HBM census
+            "memory": _memory_block(params=net.collect_params()),
         }
 
         # dispatch accounting for the bench series (regressions back to

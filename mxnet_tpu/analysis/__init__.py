@@ -21,7 +21,8 @@ from .graph_passes import analyze_symbol, analyze_graph_json, node_path
 from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
-                      analyze_telemetry, analyze_compile_cache)
+                      analyze_telemetry, analyze_compile_cache,
+                      analyze_memory)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -31,7 +32,7 @@ __all__ = [
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
-    "analyze_compile_cache",
+    "analyze_compile_cache", "analyze_memory",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -57,5 +58,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # dir must fail CI loudly, not surface as silent fresh compiles at
     # dispatch time (quiet when MXTPU_COMPILE_CACHE_DIR is unset)
     findings.extend(analyze_compile_cache())
+    # memory-observatory pass (MXL308/309): quiet in a fresh CI
+    # process; after an in-process workload it surfaces non-donated
+    # updated buffers and large replicated tensors
+    findings.extend(analyze_memory())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

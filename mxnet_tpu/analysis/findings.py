@@ -65,6 +65,12 @@ RULES = {
                "retrace observed after warm-up (attributed cause)"),
     "MXL307": (Severity.WARNING,
                "prefetch stall ratio above threshold (input-bound)"),
+    "MXL308": (Severity.WARNING,
+               "large updated buffer not in the donate tuple "
+               "(double-buffered in HBM)"),
+    "MXL309": (Severity.WARNING,
+               "large tensor fully replicated across a multi-device "
+               "mesh"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
     "MXL402": (Severity.ERROR,

@@ -24,7 +24,8 @@ from typing import List
 from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
-           "analyze_telemetry", "analyze_compile_cache"]
+           "analyze_telemetry", "analyze_compile_cache",
+           "analyze_memory"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -100,6 +101,75 @@ def analyze_compile_cache() -> List[Finding]:
                 "tools/mxcache.py prune",
                 f"persist:{r['file']}")
         for r in persist.verify() if not r["ok"]]
+
+
+def analyze_memory(large_buffer_bytes: int = 8 << 20,
+                   replicated_bytes: int = 64 << 20) -> List[Finding]:
+    """Memory-observatory hazards observed by THIS process's run
+    (``telemetry.memory`` — free when nothing was harvested, so the
+    ``--self-check`` CI gate stays quiet in a fresh process).
+
+    * MXL308 — a harvested program takes an input of at least
+      ``large_buffer_bytes`` whose identical aval also flows OUT (the
+      updated-buffer signature: weights in, new weights out) without
+      that input being in the donate tuple: the step double-buffers the
+      tensor in HBM for no reason.  The check consumes output avals for
+      donated inputs first, so a properly donated twin never shadows a
+      non-donated one.
+    * MXL309 — a registered param layout (``DataParallelTrainer``
+      registers its post-placement tree) holds a tensor of at least
+      ``replicated_bytes`` fully replicated across a multi-device
+      mesh — the exact misuse a sharding rule (``param_sharding``)
+      exists to prevent; N copies of an embedding table is the
+      canonical case.
+    """
+    from ..telemetry import memory as mem
+    from collections import Counter
+    findings: List[Finding] = []
+    for name, rec in sorted(mem.programs().items()):
+        out_avals = rec.get("out_avals")
+        if not out_avals:
+            continue            # persist reloads carry no output avals
+        outs = Counter(tuple(a) for a in out_avals)
+        donated = set(rec.get("donated_idx") or ())
+        in_avals = rec.get("in_avals") or ()
+        # donated inputs claim their output twins first
+        for j in donated:
+            if j < len(in_avals) and outs.get(tuple(in_avals[j]), 0):
+                outs[tuple(in_avals[j])] -= 1
+        for j, aval in enumerate(in_avals):
+            if j in donated:
+                continue
+            nb = mem._aval_entry_bytes(aval)
+            if nb < large_buffer_bytes:
+                continue
+            key = tuple(aval)
+            if outs.get(key, 0) > 0:
+                outs[key] -= 1
+                shape = aval[0] if len(aval) == 2 else ()
+                findings.append(Finding(
+                    "MXL308",
+                    f"program {name!r}: input #{j} "
+                    f"(shape {list(shape)}, {nb} bytes) flows out "
+                    "updated but is not in the donate tuple — the "
+                    "step holds old AND new copies in HBM; add it to "
+                    "donate_argnums / the fused plan's donate tuple",
+                    f"memory:{name}"))
+    for tname, tree in sorted(mem.param_trees().items()):
+        if tree.get("mesh_size", 1) <= 1:
+            continue
+        for row in tree.get("params", ()):
+            if row["nbytes"] >= replicated_bytes and row["replicated"]:
+                findings.append(Finding(
+                    "MXL309",
+                    f"{tname}: param {row['name']!r} "
+                    f"({row['nbytes']} bytes, shape {row['shape']}) is "
+                    f"fully replicated across a "
+                    f"{tree['mesh_size']}-device mesh — "
+                    f"{tree['mesh_size']}x the HBM for one tensor; "
+                    "give it a param_sharding rule",
+                    f"memory:{tname}:{row['name']}"))
+    return findings
 
 
 def analyze_telemetry(warmup_steps: int = 2,

@@ -29,13 +29,18 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from . import persist
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
-           "cache_info", "cache_size", "clear_cache", "drop_cached",
-           "reset_counters", "dispatch_count", "aot_compile", "persist"]
+           "cache_info", "cache_size", "live_bytes", "live_arrays",
+           "clear_cache",
+           "drop_cached", "reset_counters", "dispatch_count",
+           "aot_compile", "persist"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
-# weak set of in-flight jax arrays for waitall()
-_live = weakref.WeakSet()
+# weak map of in-flight jax arrays for waitall() / the live-buffer
+# census, keyed by id: jax arrays are UNHASHABLE (like numpy), so a
+# WeakSet.add would raise TypeError on every buffer and track nothing
+_live: "weakref.WeakValueDictionary[int, Any]" = \
+    weakref.WeakValueDictionary()
 
 # dispatch/compile-cache telemetry (surfaced via cache_info()): one
 # "dispatch" = one invoke_compiled call = one XLA executable launch.
@@ -386,10 +391,18 @@ def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
                 # must not be wrapped in an outer single-device jit
                 if getattr(fcompute, "_mxtpu_no_jit", False):
                     fn = bound
-                elif force_tiered or persist.enabled():
+                elif force_tiered or persist.enabled() or donate \
+                        or persist_name is not None:
                     # tiered wrapper: persistent tier under the memory
                     # tier; the actual compile (and its fresh-compile
-                    # accounting) happens at per-aval resolution
+                    # accounting) happens at per-aval resolution.
+                    # Donating and persist-named entries (the fused
+                    # optimizer step, CompiledStep) go tiered even
+                    # with the persistent tier OFF: the explicit
+                    # lower().compile() is what gives the memory
+                    # observatory an executable to harvest, and these
+                    # step-class programs are exactly the ones whose
+                    # HBM footprint matters
                     fn = _TieredFn(name, bound, tuple(donate), sig,
                                    persist_name)
                 else:
@@ -418,13 +431,31 @@ def _get_compiled_keyed(key, sig, name, fcompute, attrs, donate,
     return fn
 
 
+_tracer_cls = None
+
+
 def track(arr):
-    """Register an output buffer so waitall() can find it."""
+    """Register an output buffer so waitall() can find it.  Tracers
+    (op calls inside a jax trace — CompiledStep's core, hybridized
+    forwards) are NOT buffers and must stay out: blocking or size-
+    probing one later would raise ConcretizationTypeError."""
+    global _tracer_cls
+    if _tracer_cls is None:
+        from jax.core import Tracer
+        _tracer_cls = Tracer
+    if isinstance(arr, _tracer_cls):
+        return arr
     try:
-        _live.add(arr)
+        _live[id(arr)] = arr
     except TypeError:
         pass
     return arr
+
+
+def live_arrays() -> list:
+    """Snapshot of the live tracked buffers (shared by ``waitall``,
+    :func:`live_bytes`, and ``telemetry.memory.census``)."""
+    return list(_live.values())
 
 
 # profiler interception point — the reference wires its profiler inside
@@ -490,7 +521,7 @@ def waitall():
     Parity: ``mx.nd.waitall()`` → ``Engine::WaitForAll``.
     """
     import jax
-    for arr in list(_live):
+    for arr in live_arrays():
         # a buffer donated to a fused update is deleted the moment its
         # successor exists — that is normal, not an in-flight error
         if getattr(arr, "is_deleted", lambda: False)():
@@ -537,11 +568,28 @@ def cache_size() -> int:
     return len(_jit_cache)
 
 
+def live_bytes() -> int:
+    """Logical bytes of the live tracked buffers — the cheap always-on
+    census form (``cache_info()["live_bytes"]``).  Donated/deleted
+    buffers are skipped, the same guard :func:`waitall` applies; for
+    per-device attribution use ``telemetry.memory.census()``."""
+    total = 0
+    for arr in live_arrays():
+        try:
+            if arr.is_deleted():
+                continue
+            total += int(arr.nbytes)
+        except Exception:
+            continue
+    return total
+
+
 def cache_info() -> dict:
     """Introspect the jit-cache, dispatch counters, and live buffers.
 
-    Returns ``{"size", "live_buffers", "engine", "ops", "hits",
-    "misses", "dispatches"}`` where ``ops`` maps op name -> list of attr
+    Returns ``{"size", "live_buffers", "live_bytes", "engine", "ops",
+    "hits", "misses", "dispatches", "memory", ...}`` where ``ops`` maps
+    op name -> list of attr
     signatures (one per cached executable; ``()`` for the attr-less fast
     path).  mxlint's runtime-hazard report reads ``ops`` to surface
     cache-key blowup: one op accumulating many entries that differ only
@@ -559,13 +607,16 @@ def cache_info() -> dict:
         else:
             name, attrs = key[0], key[1]  # (name, sig[, donate])
             per_op.setdefault(name, []).append(attrs)
+    t = _telem if _telem is not None else _telemetry()
     return {"size": len(keys), "live_buffers": len(_live),
+            "live_bytes": live_bytes(),
             "engine": "NaiveEngine" if is_naive() else "ThreadedEngine",
             "hits": _hits, "misses": _misses, "dispatches": _dispatches,
             "fresh_compiles": _fresh_compiles,
             "persist": {"enabled": persist.enabled(),
                         "dir": persist.cache_dir() or "",
                         **persist.counters()},
+            "memory": t.memory.cache_info_block(),
             "ops": per_op}
 
 

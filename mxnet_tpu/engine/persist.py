@@ -251,7 +251,10 @@ def _write_entry(path: str, header: dict, payload: bytes):
 
 
 def _read_entry(path: str, want_payload: bool = True):
-    """(header, payload) — raises on ANY malformation (callers catch)."""
+    """(header, payload, payload_bytes) — raises on ANY malformation
+    (callers catch).  ``payload_bytes`` is the frame's recorded payload
+    length, reported even when ``want_payload=False`` so ls/verify get
+    serialized-executable sizes without a second open+parse."""
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
             raise ValueError("bad magic")
@@ -264,14 +267,14 @@ def _read_entry(path: str, want_payload: bool = True):
             raise ValueError("truncated header json")
         header = json.loads(blob)
         if not want_payload:
-            return header, None
+            return header, None, n_payload
         payload = f.read(n_payload)
         if len(payload) != n_payload:
             raise ValueError("truncated payload")
         if hashlib.sha256(payload).hexdigest() != \
                 header.get("payload_sha256"):
             raise ValueError("payload checksum mismatch")
-        return header, payload
+        return header, payload, n_payload
 
 
 def contains(persist_name: str, sig, donate, avals) -> bool:
@@ -302,7 +305,7 @@ def fetch(persist_name: str, sig, donate, avals,
             _note_miss(persist_name)
         return None
     try:
-        header, payload = _read_entry(path)
+        header, payload, _ = _read_entry(path)
         if header.get("fingerprint") != fingerprint() or \
                 header.get("format") != 1:
             raise ValueError("fingerprint/format mismatch")
@@ -351,10 +354,12 @@ def _deserialize(header: dict, payload: bytes, donate):
 
 def save_compiled(persist_name: str, sig, donate, avals, jitted,
                   compiled, compile_seconds: float,
-                  example_args=None) -> bool:
+                  example_args=None, memory=None) -> bool:
     """Serialize ``compiled`` (fallback: ``jax.export`` of ``jitted``)
     into the cache dir.  Never raises; returns True when an entry was
-    written."""
+    written.  ``memory``: the observatory's harvest record for this
+    program — a compact slice is embedded in the entry header so
+    ``tools/mxcache.py ls`` can show per-entry peak bytes offline."""
     if not enabled():
         return False
     payload, kind = None, None
@@ -392,6 +397,16 @@ def save_compiled(persist_name: str, sig, donate, avals, jitted,
         "created": time.time(),
         "payload_sha256": hashlib.sha256(payload).hexdigest(),
     }
+    if memory:
+        header["memory"] = {
+            k: memory.get(k)
+            for k in ("peak_bytes", "argument_bytes", "output_bytes",
+                      "temp_bytes", "generated_code_bytes",
+                      "donation_saved_bytes", "flops",
+                      "collective_wire_bytes", "analytic",
+                      # per-kind table: a persist reload reuses it so
+                      # the warm-start path never re-renders HLO text
+                      "collectives")}
     try:
         os.makedirs(cache_dir(), exist_ok=True)
         path = _entry_path(
@@ -414,18 +429,40 @@ def tiered_compile(persist_name: str, jitted, args, donate=(),
 
     ``args`` may be concrete arrays or ``ShapeDtypeStruct``s.  Returns
     ``(callable, source)`` with source ``"persist"`` or ``"compiled"``.
+
+    This is also THE harvest seam of the memory observatory
+    (``telemetry.memory``): the explicit ``lower().compile()`` is what
+    makes a compiled-executable object exist, and both branches — a
+    reload and a fresh compile — hand it to ``harvest_compiled`` for
+    per-program memory/FLOPs/collective accounting (never-raises,
+    inert under ``MXTPU_TELEMETRY=0``).
     """
+    from ..telemetry import memory as _mem
     avals = aval_sig(args)
     hit = fetch(persist_name, sig, donate, avals)
     if hit is not None:
+        _mem.harvest_compiled(op_label or persist_name, hit[0],
+                              args=args, donate=donate,
+                              source="persist",
+                              cached_memory=hit[1].get("memory"))
         return hit[0], "persist"
     t0 = time.perf_counter()
-    compiled = jitted.lower(*args).compile()
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
     dt = time.perf_counter() - t0
     from . import _note_fresh_compile
     _note_fresh_compile(op_label or persist_name, dt)
+    try:
+        from jax import tree_util as _tu
+        out_avals = _tu.tree_leaves(lowered.out_info)
+    except Exception:
+        out_avals = None
+    mem_rec = _mem.harvest_compiled(op_label or persist_name, compiled,
+                                    args=args, donate=donate,
+                                    out_avals=out_avals,
+                                    source="fresh")
     save_compiled(persist_name, sig, donate, avals, jitted, compiled,
-                  dt, example_args=args)
+                  dt, example_args=args, memory=mem_rec)
     return compiled, "compiled"
 
 
@@ -440,16 +477,22 @@ def _entries(directory: Optional[str] = None) -> List[str]:
 
 
 def ls(directory: Optional[str] = None) -> List[dict]:
-    """One dict per entry (corrupt entries flagged, never raised)."""
+    """One dict per entry (corrupt entries flagged, never raised).
+    ``payload_bytes`` is the serialized-executable size alone;
+    ``memory`` (when the writer harvested it) carries the program's
+    peak/argument/donation byte accounting for offline inspection."""
     out = []
     for path in _entries(directory):
         row = {"file": os.path.basename(path),
                "bytes": os.path.getsize(path),
+               "payload_bytes": None,
                "mtime": os.path.getmtime(path)}
         try:
-            header, _ = _read_entry(path, want_payload=False)
+            header, _, n_payload = _read_entry(path, want_payload=False)
+            row["payload_bytes"] = n_payload
             row.update(op=header.get("op"), kind=header.get("kind"),
                        compile_seconds=header.get("compile_seconds"),
+                       memory=header.get("memory"),
                        ok=True)
         except Exception as e:
             row.update(ok=False, error=repr(e)[:200])
@@ -465,9 +508,10 @@ def verify(directory: Optional[str] = None) -> List[dict]:
     out = []
     for path in _entries(directory):
         row = {"file": os.path.basename(path), "ok": True,
-               "stale": False}
+               "stale": False, "payload_bytes": None}
         try:
-            header, _ = _read_entry(path)
+            header, _, n_payload = _read_entry(path)
+            row["payload_bytes"] = n_payload
             if header.get("fingerprint") != fingerprint():
                 row["stale"] = True
         except Exception as e:
@@ -527,7 +571,7 @@ def drop(name: str, directory: Optional[str] = None) -> int:
         if not base.startswith(want):
             continue
         try:
-            header, _ = _read_entry(path, want_payload=False)
+            header, _, _ = _read_entry(path, want_payload=False)
             op = header.get("op", "")
         except Exception:
             op = name                     # corrupt + name-prefixed: drop

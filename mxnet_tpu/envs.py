@@ -151,6 +151,15 @@ _reg("MXTPU_TELEMETRY_EXPORT", str, "",
      "telemetry.export_metrics() JSONL snapshots. Empty = flight "
      "dumps go to the system temp dir, metric exports to the cwd "
      "(explicit paths always win).")
+_reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
+     "How many programs (sorted by peak per-device bytes) "
+     "telemetry.memory.report(), tools/mxmem.py, and bench.py's "
+     "memory block include.")
+_reg("MXTPU_BENCH_MAX_PEAK_BYTES", int, 0,
+     "Opt-in bench.py memory regression gate: when any harvested "
+     "program's per-device peak footprint exceeds this many bytes, "
+     "the emitted JSON line carries a failed memory_gate block and "
+     "bench.py exits 1. 0 (default) disables the gate.")
 
 
 def registry():

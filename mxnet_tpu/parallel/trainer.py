@@ -177,6 +177,7 @@ class DataParallelTrainer:
         self._fwd_bwd = None
         self._fused_update = None
         self._full_step = None
+        self._full_donate = (1,)
         # fuse_step=True compiles forward+backward+optimizer into ONE
         # program (optimizer states donated), removing the gradient
         # round-trip through HBM between the two phases; requires a
@@ -287,6 +288,13 @@ class DataParallelTrainer:
         _flatten(self._states, flat)
         for s in flat:
             s._set_data(jax.device_put(s._data, repl))
+        # the observatory's MXL309 input: the final param layout on
+        # this mesh (a big tensor left fully replicated across a >1-
+        # device mesh is the misuse the sharding planner must prevent)
+        from .. import telemetry
+        telemetry.memory.note_param_tree(
+            f"spmd:{self.block.name}", self._params, mesh=self.mesh,
+            dp_axis=self.dp_axis)
 
     # -- phase A: fused forward+backward ---------------------------------
     def _build_fwd_bwd(self, args, label):
@@ -538,8 +546,12 @@ class DataParallelTrainer:
             check_vma=False)
         # donate optimizer state and (2bit) residuals — both are dead
         # the moment their successors exist
+        # the observatory harvest + persist-entry hash must see the
+        # SAME donate tuple the jit bakes, or the residual buffers
+        # read as non-donated (false MXL308, understated savings)
+        self._full_donate = (1, 6) if use_residual else (1,)
         self._full_step = jax.jit(
-            mapped, donate_argnums=(1, 6) if use_residual else (1,))
+            mapped, donate_argnums=self._full_donate)
 
     # -- persistent compile cache (docs/compile_cache.md) -----------------
     def _persist_name(self) -> str:
@@ -565,12 +577,13 @@ class DataParallelTrainer:
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
         """Resolve the dispatchable for one fused-step variant:
         persistent tier (reload — no trace, no compile) -> fresh AOT
-        ``lower().compile()`` serialized back to disk.  With the tier
-        disabled (or on any failure) returns ``jitted`` unchanged, so
-        the optimization can cost time, never a step."""
+        ``lower().compile()`` (serialized back to disk when the tier is
+        on).  The explicit AOT step runs even with the persistent tier
+        OFF: it costs nothing over the jit path's implicit first-call
+        compile and gives the memory observatory an executable to
+        harvest.  On any failure returns ``jitted`` unchanged, so the
+        tier can cost time, never a step."""
         from ..engine import persist as _persist
-        if not _persist.enabled():
-            return jitted
         name = self._persist_name() + suffix
         try:
             import jax
@@ -624,8 +637,6 @@ class DataParallelTrainer:
         jit_fn = self._full_step
         if (0, False) not in self._var_avals:
             self._record_variant("", vals, None, False)
-        if not _persist.enabled():
-            return jit_fn(*vals)
         cached = self._full_exec
         if cached is None or cached[1] is not jit_fn:
             cached = ({}, jit_fn)
@@ -635,7 +646,7 @@ class DataParallelTrainer:
         fn = by_sig.get(s)
         if fn is None:
             fn = self._tiered_exec("", jit_fn, self._full_fn, vals,
-                                   (1,))
+                                   self._full_donate)
             by_sig[s] = fn
         if fn is jit_fn:
             return fn(*vals)
@@ -810,7 +821,8 @@ class DataParallelTrainer:
                     vals = (param_vals, state_vals, tuple(scal_sds),
                             x_sds, y_sds, k_sds)
                     call = self._tiered_exec(
-                        "", self._full_step, self._full_fn, vals, (1,))
+                        "", self._full_step, self._full_fn, vals,
+                        self._full_donate)
                     self._full_exec = (
                         {_persist.aval_sig(vals): call},
                         self._full_step)
@@ -1001,20 +1013,17 @@ class DataParallelTrainer:
                 self._record_variant(
                     f"_k{k_steps}" + ("r" if repeated else ""), vals,
                     k_steps, repeated)
-            if _persist.enabled():
-                cached = self._multi_exec.get(kk)
-                if cached is None or cached[1] is not fn:
-                    cached = ({}, fn)
-                    self._multi_exec[kk] = cached
-                sig = _persist.aval_sig(vals)
-                call = cached[0].get(sig)
-                if call is None:
-                    suffix = f"_k{k_steps}" + ("r" if repeated else "")
-                    call = self._tiered_exec(
-                        suffix, fn, self._multi_fns[kk], vals, (0, 1))
-                    cached[0][sig] = call
-            else:
-                cached, sig, call = None, None, fn
+            cached = self._multi_exec.get(kk)
+            if cached is None or cached[1] is not fn:
+                cached = ({}, fn)
+                self._multi_exec[kk] = cached
+            sig = _persist.aval_sig(vals)
+            call = cached[0].get(sig)
+            if call is None:
+                suffix = f"_k{k_steps}" + ("r" if repeated else "")
+                call = self._tiered_exec(
+                    suffix, fn, self._multi_fns[kk], vals, (0, 1))
+                cached[0][sig] = call
             try:
                 try:
                     loss_k, new_all_params, new_states = call(*vals)

@@ -34,6 +34,7 @@ from .metrics import (Counter, Gauge, Histogram, counter, gauge,
 from .recorder import (record_event, events, clear_events,
                        dump_flight_recorder, auto_dump, last_dump,
                        note_step, current_step)
+from . import memory
 
 __all__ = [
     "enabled", "enable", "disable", "reset",
@@ -43,7 +44,7 @@ __all__ = [
     "record_event", "events", "clear_events", "dump_flight_recorder",
     "auto_dump", "last_dump", "note_step", "current_step",
     "record_step", "step_owner", "step_owned",
-    "prefetch_stall_ratio", "export_metrics",
+    "prefetch_stall_ratio", "export_metrics", "memory",
 ]
 
 #: dispatch-count boundaries for the per-step dispatch histogram: the
@@ -66,13 +67,15 @@ def disable():
 
 
 def reset():
-    """Zero every metric, empty the event ring, and rewind the global
-    step counter (test isolation / per-run bench hygiene).  Instrument
+    """Zero every metric, empty the event ring, rewind the global
+    step counter, and forget the memory observatory's harvested
+    programs (test isolation / per-run bench hygiene).  Instrument
     identities survive."""
     from . import recorder
     reset_metrics()
     clear_events()
     recorder._reset_steps()
+    memory.reset()
 
 
 import threading as _threading

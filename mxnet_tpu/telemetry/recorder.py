@@ -36,7 +36,8 @@ _lock = threading.Lock()
 # events() / dumps merge both by timestamp, so the ONE-timeline view
 # is preserved.
 _RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
-                         "evict", "prefetch_stall"))
+                         "evict", "prefetch_stall", "oom_risk",
+                         "mem_analysis_unavailable"))
 _ring: Optional[Deque[dict]] = None        # high-volume kinds
 _rare: Optional[Deque[dict]] = None        # retained rare kinds
 _dropped = 0          # events pushed out of either ring since clear

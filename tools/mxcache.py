@@ -59,21 +59,33 @@ def cmd_ls(args) -> int:
         return 0
     now = time.time()
     total = 0
-    print(f"{'OP':40} {'KIND':7} {'SIZE':>10} {'AGE':>8} "
-          f"{'COMPILE_S':>9}  FILE")
+    total_payload = 0
+    print(f"{'OP':40} {'KIND':7} {'SIZE':>10} {'PAYLOAD':>10} "
+          f"{'PEAK':>12} {'AGE':>8} {'COMPILE_S':>9}  FILE")
     for r in rows:
         total += r["bytes"]
+        total_payload += r.get("payload_bytes") or 0
         age = now - r["mtime"]
         age_s = f"{age / 3600:.1f}h" if age > 3600 else f"{age:.0f}s"
+        payload = r.get("payload_bytes")
+        payload_s = str(payload) if payload is not None else "-"
         if r.get("ok"):
+            # per-entry device-memory view: the writer embedded the
+            # observatory's harvest (peak bytes) in the entry header
+            mem = r.get("memory") or {}
+            peak = mem.get("peak_bytes")
+            peak_s = str(peak) if peak is not None else "-"
             print(f"{str(r.get('op'))[:40]:40} {str(r.get('kind')):7} "
-                  f"{r['bytes']:>10} {age_s:>8} "
+                  f"{r['bytes']:>10} {payload_s:>10} {peak_s:>12} "
+                  f"{age_s:>8} "
                   f"{r.get('compile_seconds') or 0:>9.2f}  {r['file']}")
         else:
             print(f"{'<CORRUPT>':40} {'-':7} {r['bytes']:>10} "
-                  f"{age_s:>8} {'-':>9}  {r['file']}  "
-                  f"({r.get('error')})")
-    print(f"-- {len(rows)} entries, {total / 2**20:.1f} MiB in {d}")
+                  f"{payload_s:>10} {'-':>12} {age_s:>8} {'-':>9}  "
+                  f"{r['file']}  ({r.get('error')})")
+    print(f"-- {len(rows)} entries, {total / 2**20:.1f} MiB "
+          f"({total_payload / 2**20:.1f} MiB serialized executables) "
+          f"in {d}")
     return 0
 
 
@@ -83,17 +95,28 @@ def cmd_verify(args) -> int:
     rows = persist.verify(d)
     bad = [r for r in rows if not r["ok"]]
     stale = [r for r in rows if r["ok"] and r.get("stale")]
+    # per-entry serialized-executable sizes + the total: the numbers a
+    # cache-size pruning decision needs (MXTPU_COMPILE_CACHE_MAX_BYTES
+    # bounds FILE bytes; payload bytes show where they go)
+    total_payload = sum(r.get("payload_bytes") or 0 for r in rows)
     if args.fmt == "json":
         print(json.dumps({"entries": rows, "corrupt": len(bad),
-                          "stale": len(stale)}, indent=2))
+                          "stale": len(stale),
+                          "total_payload_bytes": total_payload},
+                         indent=2))
     else:
         for r in bad:
             print(f"CORRUPT {r['file']}: {r.get('error')}")
         for r in stale:
             print(f"stale   {r['file']} (other jax/platform "
                   "fingerprint)")
+        for r in rows:
+            if r["ok"] and not r.get("stale"):
+                print(f"ok      {r['file']} "
+                      f"({r.get('payload_bytes') or 0} payload bytes)")
         print(f"mxcache verify: {len(rows)} entries, {len(bad)} "
-              f"corrupt, {len(stale)} stale in {d}")
+              f"corrupt, {len(stale)} stale, "
+              f"{total_payload} serialized-executable bytes in {d}")
     return 1 if bad else 0
 
 

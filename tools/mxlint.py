@@ -11,12 +11,15 @@ Pass families (rules documented in docs/static_analysis.md):
   retrace-storm hazards;
 * runtime passes — jit-cache key blowup (MXL401,
   ``mxnet_tpu.analysis.analyze_cache``), silent CompiledStep
-  eager fallbacks (MXL305, ``analyze_compiled_steps``), and the
+  eager fallbacks (MXL305, ``analyze_compiled_steps``), the
   telemetry plane's hazards (``analyze_telemetry``: MXL306
   post-warm-up retraces with the attributed cause, MXL307 prefetch
-  stall ratio), when run in-process after a workload.
-  ``--self-check`` includes ``analyze_telemetry`` (free in a fresh
-  process; surfaces findings when a workload ran first).
+  stall ratio), and the memory observatory's (``analyze_memory``:
+  MXL308 large updated buffer outside the donate tuple, MXL309
+  large tensor replicated across a multi-device mesh), when run
+  in-process after a workload.  ``--self-check`` includes
+  ``analyze_telemetry``/``analyze_memory`` (free in a fresh
+  process; surface findings when a workload ran first).
 
 Usage:
 
@@ -92,6 +95,9 @@ def main(argv=None) -> int:
         # tools/mxcache.py verify): corruption fails the gate loudly
         # instead of degrading dispatch into silent fresh compiles
         findings.extend(analysis.analyze_compile_cache())
+        # memory-observatory pass (MXL308/309): free in a fresh CLI
+        # process, load-bearing after an in-process workload
+        findings.extend(analysis.analyze_memory())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
