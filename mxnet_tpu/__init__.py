@@ -90,6 +90,7 @@ from . import envs
 from . import callback
 from . import checkpoint
 from . import checkpoint as model  # mx.model.save_checkpoint parity
+from . import elastic
 from . import operator
 from . import contrib
 from . import rtc
@@ -104,4 +105,4 @@ __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "models", "profiler", "telemetry", "monitor", "runtime",
            "envs",
            "callback", "checkpoint", "model", "operator", "contrib",
-           "analysis"]
+           "analysis", "elastic"]

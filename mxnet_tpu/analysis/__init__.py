@@ -22,7 +22,7 @@ from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_telemetry, analyze_compile_cache,
-                      analyze_memory)
+                      analyze_memory, analyze_elasticity)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -32,7 +32,7 @@ __all__ = [
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
-    "analyze_compile_cache", "analyze_memory",
+    "analyze_compile_cache", "analyze_memory", "analyze_elasticity",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -62,5 +62,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # process; after an in-process workload it surfaces non-donated
     # updated buffers and large replicated tensors
     findings.extend(analyze_memory())
+    # elasticity pass (MXL501 runtime form / MXL502): quiet in a fresh
+    # process; after an in-process workload it surfaces long
+    # unprotected runs and corrupt/torn checkpoints this process wrote
+    findings.extend(analyze_elasticity())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

@@ -75,6 +75,12 @@ RULES = {
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
     "MXL402": (Severity.ERROR,
                "corrupt persistent compile-cache entry"),
+    # -- elasticity passes (MXL5xx) -------------------------------------
+    "MXL501": (Severity.WARNING,
+               "long training loop with no CheckpointManager in scope "
+               "(a failure loses the whole run)"),
+    "MXL502": (Severity.ERROR,
+               "corrupt or torn elastic checkpoint"),
 }
 
 

@@ -25,7 +25,7 @@ from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
            "analyze_telemetry", "analyze_compile_cache",
-           "analyze_memory"]
+           "analyze_memory", "analyze_elasticity"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -169,6 +169,62 @@ def analyze_memory(large_buffer_bytes: int = 8 << 20,
                     f"{tree['mesh_size']}x the HBM for one tensor; "
                     "give it a param_sharding rule",
                     f"memory:{tname}:{row['name']}"))
+    return findings
+
+
+def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
+    """Elastic-plane hazards (docs/elasticity.md).
+
+    * MXL501 (runtime form of the source pass) — at least ``min_steps``
+      train steps ran in THIS process and no
+      ``elastic.CheckpointManager`` was ever constructed: a preemption
+      or post-donation dispatch failure at step N loses all N steps.
+      Reads ``telemetry.current_step()``, so a fresh CI process (the
+      ``--self-check`` gate) yields nothing.
+    * MXL502 (the CI face of ``tools/mxckpt.py verify``) — integrity of
+      every checkpoint directory this process saved into, plus
+      ``MXTPU_CHECKPOINT_DIR`` when set: a committed checkpoint whose
+      manifest or shard hashes fail is an ERROR (restore would refuse
+      it — the retention window is silently thinner than configured); a
+      torn ``.tmp-step-*`` dir is only a WARNING (a crash artifact or
+      an in-flight write; ``mxckpt.py prune`` clears it).
+    """
+    from .. import envs, telemetry
+    from ..elastic import manager as _mgr
+    from .findings import Severity
+    findings: List[Finding] = []
+    steps = telemetry.current_step()
+    if steps >= min_steps and _mgr.managers_created() == 0:
+        findings.append(Finding(
+            "MXL501", f"{steps} train steps ran in this process and no "
+            "elastic.CheckpointManager was ever constructed — a "
+            "preemption or post-donation dispatch failure now loses "
+            "the whole run; see docs/elasticity.md",
+            "elastic:no-manager"))
+    dirs = set(_mgr.known_dirs())
+    env_dir = str(envs.get("MXTPU_CHECKPOINT_DIR") or "").strip()
+    if env_dir:
+        dirs.add(env_dir)
+    for d in sorted(dirs):
+        for row in _mgr.verify_dir(d):
+            if row["ok"]:
+                continue
+            if row.get("partial"):
+                findings.append(Finding(
+                    "MXL502", f"torn checkpoint write {row['path']!r} "
+                    "(crash artifact or in-flight writer); "
+                    "tools/mxckpt.py prune clears it",
+                    f"ckpt:{row['path']}",
+                    severity=Severity.WARNING))
+            else:
+                findings.append(Finding(
+                    "MXL502", f"checkpoint step {row['step']} at "
+                    f"{row['path']!r} fails integrity: "
+                    f"{'; '.join(row['errors'])[:300]} — restore "
+                    "would refuse it, so the retention window is "
+                    "thinner than configured; keep more steps or "
+                    "delete the corrupt dir",
+                    f"ckpt:{row['path']}"))
     return findings
 
 

@@ -29,6 +29,16 @@ Heuristics are deliberately scoped to keep the signal high:
   a CompiledStep was requested but silently fell back to eager, with
   the recorded reason.
 
+* MXL501 fires for a training loop that dispatches ``step``/
+  ``step_multi`` at least ``_CKPT_LOOP_MIN_STEPS`` times (a statically
+  known ``range`` bound, or an unbounded ``while True``) in a module
+  that never references a checkpointing surface
+  (``CheckpointManager`` / ``OrbaxCheckpoint`` / ``save_checkpoint``):
+  one preemption or post-donation dispatch failure loses the whole
+  run — docs/elasticity.md.  Its runtime sibling (``analyze_
+  elasticity``) reports when N steps actually RAN in-process and no
+  manager was ever constructed.
+
 Suppress any rule on a line with ``# mxlint: disable=MXL301`` (comma-
 separated IDs) or every rule with a bare ``# mxlint: disable``.
 """
@@ -51,6 +61,14 @@ _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
 # compilation somewhere — MXL304 stays quiet for the whole file
 _STEP_COMPILE_MARKERS = {"compile_step", "CompiledStep", "step_multi",
                          "DataParallelTrainer"}
+# any of these in a module means checkpointing is wired up somewhere —
+# MXL501 stays quiet for the whole file ("a CheckpointManager is in
+# scope"); `recover` counts because calling it requires a manager
+_CKPT_MARKERS = {"CheckpointManager", "OrbaxCheckpoint",
+                 "save_checkpoint", "recover"}
+#: statically-known step counts below this never fire MXL501 — short
+#: smoke/debug loops are not "a run worth checkpointing"
+_CKPT_LOOP_MIN_STEPS = 100
 
 
 def _attr_chain(node) -> List[str]:
@@ -120,6 +138,42 @@ def _module_uses_step_compilation(tree) -> bool:
     return False
 
 
+def _module_uses_checkpointing(tree) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and n.attr in _CKPT_MARKERS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _CKPT_MARKERS:
+            return True
+    return False
+
+
+def _loop_trip_count(loop) -> Optional[float]:
+    """Statically-known iteration count for MXL501.
+
+    ``for _ in range(<const>...)`` -> the exact count;
+    ``while True`` with no ``break`` -> inf;
+    anything else (data loaders, dynamic bounds) -> None (unknown —
+    never fires, keeping the pass quiet on short smoke loops whose
+    bound we cannot see).
+    """
+    if isinstance(loop, ast.While):
+        if isinstance(loop.test, ast.Constant) and loop.test.value:
+            if any(isinstance(n, ast.Break) for n in ast.walk(loop)):
+                return None
+            return float("inf")
+        return None
+    it = loop.iter if isinstance(loop, ast.For) else None
+    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) and \
+            it.func.id == "range" and not it.keywords and \
+            all(isinstance(a, ast.Constant) and
+                isinstance(a.value, int) for a in it.args):
+        try:
+            return float(len(range(*(a.value for a in it.args))))
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
 def _loop_varying_names(loop) -> Set[str]:
     """Names the loop changes: induction targets + assignment targets in
     the body (these are the candidates for per-step attr values)."""
@@ -152,12 +206,14 @@ def _get_op(opname: str):
 
 
 class _SourceVisitor(ast.NodeVisitor):
-    def __init__(self, filename: str, uses_step_compilation=False):
+    def __init__(self, filename: str, uses_step_compilation=False,
+                 uses_checkpointing=False):
         self.filename = filename
         self.findings: List[Finding] = []
         self._loops: List[dict] = []       # {training, varying, per_op}
         self._hybrid_depth = 0
         self._uses_step_compilation = uses_step_compilation
+        self._uses_checkpointing = uses_checkpointing
 
     # -- helpers ---------------------------------------------------------
     def _loc(self, node) -> str:
@@ -188,7 +244,9 @@ class _SourceVisitor(ast.NodeVisitor):
                 self._loc(node)))
         self._loops.append({"training": _training_markers(node),
                             "varying": _loop_varying_names(node),
-                            "per_op": per_op})
+                            "per_op": per_op,
+                            "count": _loop_trip_count(node),
+                            "ckpt_fired": False})
         self.generic_visit(node)
         self._loops.pop()
 
@@ -235,7 +293,49 @@ class _SourceVisitor(ast.NodeVisitor):
 
         if self._loops:
             self._check_per_step_attrs(node)
+            self._check_unckpt_loop(node)
         self.generic_visit(node)
+
+    def _check_unckpt_loop(self, node: ast.Call):
+        """MXL501: this step call's loop nest runs >= the threshold
+        (statically known) and the module never references a
+        checkpointing surface."""
+        if self._uses_checkpointing:
+            return
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and
+                f.attr in ("step", "step_multi")):
+            return
+        chain = _attr_chain(f)
+        if len(chain) >= 2 and chain[-2] in ("env", "environment"):
+            return          # gym-convention env.step(): not a trainer
+        if any(l["ckpt_fired"] for l in self._loops):
+            return          # one finding per loop nest
+        total = 1.0
+        known = False
+        for l in self._loops:
+            if l["count"] is not None:
+                total *= l["count"]
+                known = True
+        if f.attr == "step_multi":
+            # a constant repeat=K (the bulked-step API) multiplies
+            # the dispatched step count
+            for kw in node.keywords:
+                if kw.arg == "repeat" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, int):
+                    total *= max(1, kw.value.value)
+        if not known or total < _CKPT_LOOP_MIN_STEPS:
+            return
+        self._loops[0]["ckpt_fired"] = True
+        n = "unbounded" if total == float("inf") else f"~{int(total)}"
+        self.findings.append(Finding(
+            "MXL501", f"training loop dispatches .{f.attr}() {n} "
+            "times with no CheckpointManager in scope: one preemption "
+            "or post-donation dispatch failure loses the whole run; "
+            "wrap the loop with elastic.CheckpointManager (save "
+            "periodically, recover(manager) on poison) — see "
+            "docs/elasticity.md", self._loc(node)))
 
     def _check_per_step_attrs(self, node: ast.Call):
         chain = _attr_chain(node.func)
@@ -297,7 +397,8 @@ def analyze_source(text: str, filename: str = "<string>") -> List[Finding]:
         return []
     v = _SourceVisitor(
         filename,
-        uses_step_compilation=_module_uses_step_compilation(tree))
+        uses_step_compilation=_module_uses_step_compilation(tree),
+        uses_checkpointing=_module_uses_checkpointing(tree))
     v.visit(tree)
     return _apply_suppressions(v.findings, text)
 

@@ -51,38 +51,41 @@ def load_checkpoint(prefix, epoch):
 
 
 class OrbaxCheckpoint:
-    """Async sharded checkpointing over orbax (TPU-native backend).
+    """Orbax-style array-dict checkpointing (TPU-native backend).
 
     Saves/restores a dict of NDArrays (e.g. ``block.collect_params()``
-    data + trainer states); sharded jax arrays are written shard-wise per
-    host and re-sharded on restore.  Falls back with a clear error when
-    orbax is unavailable.
+    data + trainer states).  The store is the elastic shard format
+    (``elastic.manager.write_arrays``): each save commits via temp-dir
+    + rename (a crash never leaves a half-written checkpoint visible)
+    and every shard carries its sha256 — ``load`` rejects partial or
+    corrupt content with a clear ``MXNetError`` instead of loading
+    garbage.  For whole-trainer state (optimizer, RNG, step counters,
+    mesh layout) use :class:`mxnet_tpu.elastic.CheckpointManager`,
+    which this class is a thin array-only wrapper over.
     """
 
     def __init__(self, directory):
-        try:
-            import orbax.checkpoint as ocp
-        except ImportError as e:
-            raise MXNetError(
-                "orbax-checkpoint is not available in this "
-                "environment") from e
-        self._ocp = ocp
         self.directory = os.path.abspath(directory)
-        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
 
     def save(self, step: int, arrays: Dict[str, NDArray], force=True):
-        tree = {k: v._data for k, v in arrays.items()}
-        path = os.path.join(self.directory, str(step))
-        self._ckptr.save(path, tree, force=force)
-        return path
+        from .elastic import manager as _mgr
+        path = self._path(step)
+        if os.path.exists(path) and not force:
+            raise MXNetError(
+                f"checkpoint step {step} already exists at {path} "
+                "(pass force=True to overwrite)")
+        return _mgr.write_arrays(
+            path, {k: (v._data if isinstance(v, NDArray) else v)
+                   for k, v in arrays.items()},
+            extra={"step": int(step)})
 
     def load(self, step: int, ctx=None) -> Dict[str, NDArray]:
-        path = os.path.join(self.directory, str(step))
-        tree = self._ckptr.restore(path)
-        out = {}
-        for k, v in tree.items():
-            out[k] = nd.array(v)
-        return out
+        from .elastic import manager as _mgr
+        _manifest, hosts = _mgr.read_arrays(self._path(step))
+        return {k: nd.array(v, ctx=ctx) for k, v in hosts.items()}
 
     def load_into(self, step: int, params) -> None:
         """Restore directly into a ParameterDict (buffer swap keeps

@@ -63,6 +63,7 @@ def export_stablehlo(block, example_inputs, path: str) -> int:
     if not example_inputs:
         raise MXNetError("export_stablehlo needs example inputs")
     fn = _functionalize(block, example_inputs)
+    import jax.export  # not an attr of the bare package on jax 0.4.x
     exported = jax.export.export(jax.jit(fn))(
         *[a._data for a in example_inputs])
     blob = exported.serialize()
@@ -109,6 +110,7 @@ def load_stablehlo_jax(path: str):
     import numpy as np
 
     _, blob = _read(path)
+    import jax.export  # not an attr of the bare package on jax 0.4.x
     exported = jax.export.deserialize(blob)
 
     def run(*arrays):

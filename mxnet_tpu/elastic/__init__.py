@@ -1,0 +1,41 @@
+"""``mxnet_tpu.elastic``: the fault-tolerance plane.
+
+Production training dies three ways the hot path alone cannot answer:
+a dispatch fails after its donated buffers were consumed (the trainer
+used to be permanently poisoned), the process is preempted (nothing
+durable existed to resume from), and a restart lands on a different
+chip count (warm start used to hard-fail to a cold start).  This
+package closes all three:
+
+* :class:`CheckpointManager` (:mod:`.manager`) — atomic, async,
+  integrity-checked checkpoints of params + optimizer state + RNG +
+  step counters, with bounded retention;
+* ``trainer.recover(manager)`` — a poisoned
+  ``DataParallelTrainer``/``CompiledStep`` rebuilds its donated
+  buffers from the last committed checkpoint and trains on;
+* :mod:`.reshard` — checkpoint/live array redistribution across mesh
+  changes (arXiv:2112.01075), so an 8-chip checkpoint restores onto 4
+  chips (or 1) exactly;
+* :mod:`.faults` — deterministic fault injection
+  (``MXTPU_FAULT_INJECT``) hooked into the real dispatch and
+  checkpoint-commit paths, so every recovery path above is exercised
+  by the tier-1 CPU suite.
+
+See docs/elasticity.md.
+"""
+from __future__ import annotations
+
+from . import faults
+from . import reshard
+
+__all__ = ["CheckpointManager", "faults", "manager", "reshard"]
+
+
+def __getattr__(name):
+    # manager pulls in ndarray/telemetry; keep package import light so
+    # engine can import .faults without a cycle
+    if name in ("CheckpointManager", "manager"):
+        import importlib
+        mod = importlib.import_module(".manager", __name__)
+        return mod if name == "manager" else mod.CheckpointManager
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

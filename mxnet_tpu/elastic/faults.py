@@ -1,0 +1,280 @@
+"""Deterministic fault injection for the elastic training plane.
+
+A recovery path that is never exercised is a recovery path that does
+not work.  This module turns every failure mode the elastic subsystem
+claims to survive into a knob the tier-1 CPU suite can pull on demand:
+
+``MXTPU_FAULT_INJECT`` holds a ``;``-separated list of fault specs::
+
+    point[:qualifier[,qualifier...]]
+
+    dispatch:step=7            # raise before the step-7 dispatch runs
+    dispatch_post:nth=2        # 2nd dispatch: consume the donated
+                               # buffers (what TPU donation does), then
+                               # raise -> the poison protocol fires
+    checkpoint_write:nth=2     # crash while writing the 2nd shard
+    host_copy                  # fail the device->host snapshot copy
+
+Injection points (the hooks live on the real code paths, not in test
+shims):
+
+* ``dispatch`` — engine ``invoke_compiled`` / the SPMD trainer's fused
+  dispatch, BEFORE the executable runs: buffers stay alive, so this is
+  the transient-failure shape the bounded-retry path must absorb.
+* ``dispatch_post`` — same seam, but the donated input buffers are
+  deleted first (simulating executable-consumed donation, which the
+  CPU backend never does on its own): the caller's consumed-probe sees
+  dead buffers and the poison/recover protocol must engage.
+* ``checkpoint_write`` — inside the checkpoint writer, between shard
+  writes and before the commit rename: the temp dir must be left
+  uncommitted and the previous checkpoint must stay authoritative.
+* ``host_copy`` — the device->host copy of the checkpoint snapshot.
+
+Qualifiers: ``nth=N`` fires on the Nth arrival at the point (1-based,
+default 1); ``step=N`` fires on the first arrival at or after global
+train step N (``telemetry.current_step()``); ``times=K`` repeats the
+fault K times (default 1).  Every spec is one-shot by default so a
+retry/recovery can succeed deterministically.
+
+The module is import-light (no jax) and costs one module-attribute
+read (``_active``) per hook when no fault is configured.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["FaultError", "FaultSpec", "configure", "configure_from_env",
+           "clear", "active", "fired", "maybe_fire", "on_dispatch",
+           "POINTS"]
+
+#: the injection points wired into the runtime (unknown points parse —
+#: forward compatibility — but are reported by :func:`configure`)
+POINTS = ("dispatch", "dispatch_post", "checkpoint_write", "host_copy")
+
+
+class FaultError(RuntimeError):
+    """An injected fault (subclasses RuntimeError so the transient-
+    failure retry classifier treats it like a real runtime error)."""
+
+
+class FaultSpec:
+    __slots__ = ("point", "nth", "step", "times", "fired_count")
+
+    def __init__(self, point: str, nth: Optional[int] = None,
+                 step: Optional[int] = None, times: int = 1):
+        self.point = point
+        self.nth = nth
+        self.step = step
+        self.times = times
+        self.fired_count = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.fired_count >= self.times
+
+    def __repr__(self):
+        quals = []
+        if self.nth is not None:
+            quals.append(f"nth={self.nth}")
+        if self.step is not None:
+            quals.append(f"step={self.step}")
+        if self.times != 1:
+            quals.append(f"times={self.times}")
+        return self.point + (":" + ",".join(quals) if quals else "")
+
+
+_lock = threading.Lock()
+_specs: List[FaultSpec] = []
+_counts: Dict[str, int] = {}
+_fired: List[str] = []
+#: fast-path flag: hooks read this one attribute and return when False
+_active = False
+
+
+def _parse(text: str) -> List[FaultSpec]:
+    specs: List[FaultSpec] = []
+    for raw in text.replace("\n", ";").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        point, _, qual = raw.partition(":")
+        point = point.strip()
+        kw: Dict[str, int] = {}
+        for q in qual.split(","):
+            q = q.strip()
+            if not q:
+                continue
+            k, _, v = q.partition("=")
+            k = k.strip()
+            if k not in ("nth", "step", "times") or not v.strip():
+                raise ValueError(
+                    f"bad fault qualifier {q!r} in {raw!r} "
+                    "(expected nth=N, step=N, or times=K)")
+            kw[k] = int(v)
+        specs.append(FaultSpec(point, nth=kw.get("nth"),
+                               step=kw.get("step"),
+                               times=kw.get("times", 1)))
+    return specs
+
+
+def configure(text: Optional[str]) -> int:
+    """Install the fault plan from ``text`` (the ``MXTPU_FAULT_INJECT``
+    grammar); ``None``/empty clears it.  Returns the spec count.
+    Arrival counters and the fired log reset with each configure."""
+    global _active
+    specs = _parse(text) if text else []
+    unknown = [s.point for s in specs if s.point not in POINTS]
+    if unknown:
+        # unknown points still parse (forward compatibility) but can
+        # never fire — a silent typo would make a recovery drill pass
+        # vacuously, so say so loudly
+        import warnings
+        warnings.warn(
+            f"MXTPU_FAULT_INJECT: unknown fault point(s) {unknown} "
+            f"will never fire (known: {', '.join(POINTS)})",
+            RuntimeWarning, stacklevel=2)
+    with _lock:
+        _specs[:] = specs
+        _counts.clear()
+        _fired.clear()
+        _active = bool(specs)
+    return len(specs)
+
+
+def configure_from_env() -> int:
+    """(Re-)read ``MXTPU_FAULT_INJECT`` from the environment.
+
+    A malformed spec disables injection with a warning instead of
+    raising: this runs at ``import mxnet_tpu``, and a typo'd drill
+    knob must never brick every process that imports the library.
+    Explicit :func:`configure` calls still raise on bad grammar."""
+    try:
+        from .. import envs
+        text = envs.get("MXTPU_FAULT_INJECT")
+    except Exception:
+        text = os.environ.get("MXTPU_FAULT_INJECT", "")
+    try:
+        return configure(text)
+    except ValueError as e:
+        import warnings
+        warnings.warn(
+            f"MXTPU_FAULT_INJECT ignored — {e}", RuntimeWarning,
+            stacklevel=2)
+        configure(None)
+        return 0
+
+
+def clear():
+    configure(None)
+
+
+def active() -> bool:
+    """Any un-exhausted fault spec armed?"""
+    return _active
+
+
+def fired() -> List[str]:
+    """Repr of every spec that has fired this configuration."""
+    with _lock:
+        return list(_fired)
+
+
+def _current_step() -> int:
+    try:
+        from .. import telemetry
+        return telemetry.current_step()
+    except Exception:
+        return 0
+
+
+def _check(point: str) -> Optional[FaultSpec]:
+    """Count an arrival at ``point``; return the spec that should fire
+    now (consuming one of its ``times``), else None."""
+    global _active
+    with _lock:
+        if not _specs:
+            return None
+        n = _counts.get(point, 0) + 1
+        _counts[point] = n
+        hit = None
+        for s in _specs:
+            if s.point != point or s.exhausted:
+                continue
+            if s.nth is not None and n != s.nth:
+                continue
+            if s.step is not None and _current_step() < s.step:
+                continue
+            hit = s
+            break
+        if hit is not None:
+            hit.fired_count += 1
+            _fired.append(repr(hit))
+        if all(s.exhausted for s in _specs):
+            _active = False
+        return hit
+
+
+def _raise(spec: FaultSpec, point: str, **info):
+    try:
+        from .. import telemetry
+        telemetry.record_event("fault_injected", point=point,
+                               spec=repr(spec), **info)
+        telemetry.counter(
+            "mxtpu_faults_injected_total",
+            "faults fired by the MXTPU_FAULT_INJECT plan").inc()
+    except Exception:
+        pass
+    raise FaultError(f"injected fault at {point!r} ({spec!r})")
+
+
+def maybe_fire(point: str, **info):
+    """Raise :class:`FaultError` when a spec for ``point`` is due.
+    Near-zero when no plan is configured (guard on :data:`_active`
+    before calling for the hot paths)."""
+    if not _active:
+        return
+    spec = _check(point)
+    if spec is not None:
+        _raise(spec, point, **info)
+
+
+def on_dispatch(op: str, arrays=(), donate=None):
+    """The engine/trainer dispatch hook.
+
+    ``dispatch`` raises with every buffer intact (pre-donation: the
+    retry path may transparently re-dispatch).  ``dispatch_post``
+    deletes the donated input buffers FIRST — exactly what a TPU
+    executable consuming its donated arguments leaves behind — so the
+    caller's consumed-probe finds dead buffers and the poison protocol
+    engages.
+
+    ``donate`` selects which ``arrays`` a ``dispatch_post`` drill
+    consumes: a tuple of indices (the engine passes its real donate
+    tuple — an EMPTY tuple means a non-donating op, and the drill must
+    not touch buffers the caller still owns), or ``None`` when
+    ``arrays`` is already the pre-filtered donated set (the SPMD
+    trainer call sites).
+    """
+    if not _active:
+        return
+    spec = _check("dispatch")
+    if spec is not None:
+        _raise(spec, "dispatch", op=op)
+    spec = _check("dispatch_post")
+    if spec is not None:
+        targets = list(arrays) if donate is None else \
+            [arrays[i] for i in donate if 0 <= i < len(arrays)]
+        for a in targets:
+            try:
+                a.delete()
+            except Exception:
+                pass
+        _raise(spec, "dispatch_post", op=op)
+
+
+# arm from the environment at import: fault plans are a process-level
+# choice (like MXTPU_ENGINE_TYPE), and reading here keeps the hooks
+# free of env lookups
+configure_from_env()

@@ -27,12 +27,13 @@ import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from . import persist
+from ..elastic import faults as _faults
 
 __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
            "cache_info", "cache_size", "live_bytes", "live_arrays",
            "clear_cache",
            "drop_cached", "reset_counters", "dispatch_count",
-           "aot_compile", "persist"]
+           "aot_compile", "persist", "retrying_call"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -464,6 +465,62 @@ def live_arrays() -> list:
 _profiler_hook = None
 
 
+# -- transient-failure retry (docs/elasticity.md) ---------------------------
+# A remote PJRT tunnel hiccup or a device-side transient should not
+# reach the poison protocol when the dispatch can simply run again.
+# Retry is only SAFE while every input buffer is still alive — once a
+# donated argument was consumed, re-invoking would read dead memory —
+# so the probe gates every attempt.  Opt-in via MXTPU_DISPATCH_RETRIES
+# (default 0: semantics identical to the pre-elastic engine).
+
+def _retry_policy():
+    from .. import envs
+    return (int(envs.get("MXTPU_DISPATCH_RETRIES")),
+            float(envs.get("MXTPU_DISPATCH_BACKOFF_MS")))
+
+
+def _retryable_error(e: Exception) -> bool:
+    """Transient-shaped errors only: runtime/IO failures.  Program
+    errors (TypeError/ValueError: aval drift, bad arity — the tiered
+    wrapper's own demotion protocol keys on TypeError) and our own
+    MXNetError diagnostics re-raise immediately."""
+    from ..base import MXNetError
+    if isinstance(e, MXNetError):
+        return False
+    return isinstance(e, (RuntimeError, OSError))
+
+
+def retrying_call(call, probe_arrays, op: str):
+    """Run ``call()`` under the bounded-retry + exponential-backoff
+    policy.  ``probe_arrays``: the input buffers whose deletion marks
+    the dispatch as post-donation (never retried).  Shared by
+    ``invoke_compiled`` and the SPMD trainer's fused dispatch."""
+    import time as _time
+    attempt = 0
+    retries = backoff_ms = None
+    while True:
+        try:
+            return call()
+        except Exception as e:
+            if retries is None:
+                retries, backoff_ms = _retry_policy()
+            if attempt >= retries or not _retryable_error(e) or any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in probe_arrays):
+                raise
+            attempt += 1
+            t = _telem if _telem is not None else _telemetry()
+            if t._switch.enabled:
+                t.counter(
+                    "mxtpu_dispatch_retries_total",
+                    "transient dispatch failures absorbed by retry"
+                    ).inc()
+                t.record_event("dispatch_retry", op=op,
+                               attempt=attempt,
+                               error=repr(e)[:300])
+            _time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1000.0)
+
+
 def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
                     donate: Tuple[int, ...] = (),
                     persist_name: Optional[str] = None):
@@ -490,12 +547,22 @@ def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
             c_don.inc()
         t.record_event("dispatch", op=name)
         _note_avals(name, key, arrays)
-    try:
+    def _run():
+        if _faults._active:
+            # deterministic fault injection (docs/elasticity.md):
+            # "dispatch" raises pre-execution with buffers alive — a
+            # one-shot spec is absorbed by the retry loop around this
+            # thunk; "dispatch_post" consumes the donated buffers
+            # first, so the caller's poison protocol engages exactly
+            # as on real hardware
+            _faults.on_dispatch(name, arrays, donate)
         hook = _profiler_hook
         if hook is not None:
-            out = hook(name, fn, arrays)
-        else:
-            out = fn(*arrays)
+            return hook(name, fn, arrays)
+        return fn(*arrays)
+
+    try:
+        out = retrying_call(_run, arrays, name)
         if is_naive():
             import jax
             jax.block_until_ready(out)

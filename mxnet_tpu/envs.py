@@ -151,6 +151,30 @@ _reg("MXTPU_TELEMETRY_EXPORT", str, "",
      "telemetry.export_metrics() JSONL snapshots. Empty = flight "
      "dumps go to the system temp dir, metric exports to the cwd "
      "(explicit paths always win).")
+_reg("MXTPU_DISPATCH_RETRIES", int, 0,
+     "Bounded retry for TRANSIENT dispatch failures (runtime/IO "
+     "errors with every input buffer still alive): how many times the "
+     "engine re-invokes a failed executable before surfacing the "
+     "error. 0 (default) disables retry. Post-donation failures "
+     "(consumed buffers) are never retried — they take the "
+     "poison/recover protocol. See docs/elasticity.md.")
+_reg("MXTPU_DISPATCH_BACKOFF_MS", float, 50.0,
+     "Base backoff between dispatch retries, in milliseconds; "
+     "attempt k sleeps base * 2^(k-1).")
+_reg("MXTPU_FAULT_INJECT", str, "",
+     "Deterministic fault-injection plan for the elastic subsystem "
+     "(';'-separated 'point[:nth=N|step=N|times=K]' specs; points: "
+     "dispatch, dispatch_post, checkpoint_write, host_copy). Read at "
+     "import of mxnet_tpu.elastic.faults; tests reconfigure via "
+     "faults.configure(). Empty (default) injects nothing. See "
+     "docs/elasticity.md.")
+_reg("MXTPU_CHECKPOINT_KEEP", int, 3,
+     "Default retention for elastic.CheckpointManager: committed "
+     "checkpoints beyond the newest N are pruned after each commit.")
+_reg("MXTPU_CHECKPOINT_DIR", str, "",
+     "Default checkpoint directory for tools/mxckpt.py and the mxlint "
+     "elastic integrity pass (MXL502); CheckpointManager itself takes "
+     "an explicit directory.")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

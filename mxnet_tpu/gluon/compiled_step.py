@@ -396,6 +396,48 @@ class CompiledStep:
                                sources=sources)
         return True
 
+    # -- elastic protocol (docs/elasticity.md) ----------------------------
+    def _elastic_export(self):
+        """Checkpoint payload (``elastic.CheckpointManager``): the
+        trainer's params + optimizer-state leaves + counters, plus
+        this step's persistent-tier identity so a restored process can
+        warm-start under the same name."""
+        payload = self.trainer._elastic_export()
+        payload["persist_name"] = self._persist_base
+        return payload
+
+    def _elastic_restore(self, payload):
+        self.trainer._elastic_restore(payload)
+        self._poisoned = None
+
+    def recover(self, manager, step: Optional[int] = None) -> int:
+        """Rebuild the donated weight/optimizer-state buffers from the
+        last committed checkpoint (or ``step``) and clear the poison
+        latch — after this the step dispatches again.  Safe on a
+        healthy step too (plain restore).  Returns the restored step.
+        Recovery FORKS the timeline: checkpoints newer than the
+        restored step are invalidated, so a later crash can never
+        resume from the abandoned run."""
+        import time
+        from .. import telemetry
+        t0 = time.perf_counter()
+        was_poisoned = self._poisoned is not None
+        restored = manager.restore(step=step, into=self,
+                                   invalidate_newer=True)
+        dt = time.perf_counter() - t0
+        telemetry.counter("mxtpu_recoveries_total",
+                          "checkpoint recoveries (poisoned or "
+                          "explicit)").inc()
+        telemetry.histogram(
+            "mxtpu_recovery_seconds",
+            "time to rebuild trainer state from the last committed "
+            "checkpoint (s)").observe(dt)
+        telemetry.record_event("recovery", where="compiled_step",
+                               name=self.name, step=restored,
+                               seconds=round(dt, 4),
+                               poisoned=was_poisoned)
+        return restored
+
     # -- path selection ---------------------------------------------------
     def _coerce(self, data, label):
         from .. import ndarray as nd
@@ -415,8 +457,9 @@ class CompiledStep:
             raise MXNetError(
                 "this CompiledStep's weight/optimizer-state buffers were "
                 "donated to a dispatch that failed and are no longer "
-                "valid; rebuild the trainer/step and restore from a "
-                f"checkpoint. Original error: {self._poisoned}")
+                "valid; call recover(manager) to restore from the last "
+                "committed checkpoint (docs/elasticity.md). "
+                f"Original error: {self._poisoned}")
         if not envs.get("MXTPU_COMPILED_STEP"):
             # explicit escape hatch: eager, but NOT a silent fallback
             return self._eager(args, label, batch_size, k_steps, repeat)
@@ -701,8 +744,9 @@ class CompiledStep:
                     reason=f"compiled_step_poisoned:{self.name}")
                 raise MXNetError(
                     "compiled train step failed AFTER its weight/state "
-                    "buffers were donated; rebuild the trainer and "
-                    "restore from a checkpoint. Original error: "
+                    "buffers were donated; call recover(manager) to "
+                    "restore from the last committed checkpoint "
+                    "(docs/elasticity.md). Original error: "
                     f"{e!r}") from e
             # pre-dispatch failure (trace/compile): rewind host state
             # and let the caller fall back to eager transparently

@@ -324,6 +324,84 @@ class Trainer:
             if grads:
                 _cgn(grads, max_norm / rescale, check_isfinite=False)
 
+    # -- elastic protocol (docs/elasticity.md) ----------------------------
+    def _elastic_export(self):
+        """Checkpoint payload for ``elastic.CheckpointManager``: every
+        parameter (incl. aux/BatchNorm stats), the updater's
+        optimizer-state leaves, and the update counters."""
+        from .compiled_step import _flatten_state
+        opt = self._optimizer
+        params = []
+        for p in self._params:
+            params.append((p.name, p.data()._data, "()"))
+        states = []
+        upd = self._updaters[0]
+        for i, p in enumerate(self._params):
+            st = upd.states.get(i)
+            if st is None:
+                continue
+            leaves = []
+            _flatten_state(st, leaves)
+            for j, leaf in enumerate(leaves):
+                states.append((i, j, leaf._data))
+        step = max(opt._index_update_count.values(),
+                   default=int(opt.num_update))
+        return {
+            "kind": "gluon", "step": int(step),
+            "optimizer": type(opt).__name__,
+            "update_counts": dict(opt._index_update_count),
+            "num_update": int(opt.num_update),
+            "mesh": None, "dp_axis": None, "persist_name": None,
+            "params": params, "states": states, "residuals": [],
+        }
+
+    def _elastic_restore(self, payload):
+        import jax
+        import numpy as _np
+        from .compiled_step import _flatten_state
+        from ..elastic.manager import align_params
+        aligned = align_params([p.name for p in self._params],
+                               payload["params"])
+        for p, (host, _spec) in zip(self._params, aligned):
+            if tuple(host.shape) != tuple(p.data().shape):
+                raise MXNetError(
+                    f"checkpoint param {p.name!r} has shape "
+                    f"{tuple(host.shape)}, trainer expects "
+                    f"{tuple(p.data().shape)}")
+            arr = _np.asarray(host)
+            # every context replica, not just the primary — a stale
+            # copy would diverge permanently on the next step
+            for d in p.list_data():
+                d._set_data(jax.device_put(arr, d.context.device))
+        for i, j, host in payload["states"]:
+            p = self._params[i]
+            replicas = p.list_data()
+            # one updater per context (step() pairs updater k with
+            # replica k): every copy of the state must be restored or
+            # the replicas diverge on the next step
+            for k, upd in enumerate(self._updaters):
+                upd._ensure_state(i, replicas[min(k, len(replicas) - 1)])
+                leaves = []
+                _flatten_state(upd.states[i], leaves)
+                if j >= len(leaves):
+                    raise MXNetError(
+                        f"checkpoint optimizer-state leaf ({i},{j}) "
+                        "out of range (optimizer class mismatch?)")
+                leaves[j]._set_data(jax.device_put(
+                    _np.asarray(host), leaves[j].context.device))
+        opt = self._optimizer
+        counts = {int(k): int(v)
+                  for k, v in (payload.get("update_counts") or
+                               {}).items()}
+        # _index_update_count is an alias into the per-device dict of
+        # whichever context stepped last — rewind EVERY device's copy
+        # or multi-context Adam resumes with skewed bias-correction t
+        for dev_counts in opt._all_index_update_counts.values():
+            dev_counts.clear()
+            dev_counts.update(counts)
+        opt.num_update = int(payload.get("num_update",
+                                         payload["step"]))
+
     def save_states(self, fname):
         assert self._optimizer is not None
         if not self._kv_initialized:

@@ -255,7 +255,8 @@ def _decoder_layer(lp, x, h, kv, d, base, eps, tp_axis):
 
     from ..ops.attention import dot_product_attention, rope
 
-    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    from ..parallel._compat import axis_size
+    tp = axis_size(tp_axis) if tp_axis else 1
     b, s = x.shape[0], x.shape[1]
     hl, kvl = h // tp, kv // tp
     hx = _rms(x, lp["innorm"], eps)
@@ -352,9 +353,13 @@ def train_step(params, tokens, config, mesh, specs, *, lr=1e-2,
     stage = make_stage_fn(config, tp_axis=tp_axis, eps=eps)
     loss_fn = make_chunked_loss(params, config, tp_axis=tp_axis,
                                 vocab_chunk=vocab_chunk, eps=eps)
+    # tp is closed by psums (row-parallel projections + chunked CE):
+    # declare it so replicated leaves (norm weights) get true
+    # replicated grads back, not per-device partials
     loss, grads = pipeline_value_and_grad(
         stage, params["layers"], x, jnp.asarray(tokens, jnp.int32),
-        loss_fn, m, mesh=mesh, axis=pp_axis, param_specs=specs)
+        loss_fn, m, mesh=mesh, axis=pp_axis, param_specs=specs,
+        grad_reduce_axes=(tp_axis,))
     new_layers = jax.tree_util.tree_map(
         lambda p, g: p - lr * g, params["layers"], grads)
     return loss, {**params, "layers": new_layers}

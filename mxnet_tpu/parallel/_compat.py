@@ -1,0 +1,61 @@
+"""jax version-compatibility seams for the parallel package."""
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions.
+
+    jax >= 0.6 exposes ``jax.shard_map(..., check_vma=)``; 0.4.x only
+    has ``jax.experimental.shard_map.shard_map(..., check_rep=)``.
+    The 0.4.x replication checker is NOT the same check: without the
+    varying-type system (``pvary`` annotations) its static inference
+    false-positives on valid multi-axis programs (e.g. a pp-sharded
+    pipeline body whose outputs it cannot prove tp-replicated), so the
+    optional validation is disabled there — jax >= 0.6 keeps the real
+    ``check_vma`` typing.
+    """
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _rep
+        return _rep(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_rep=False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+
+
+def pvary(x, axis_names):
+    """``lax.pvary`` across jax versions.
+
+    Pre-0.6 jax has no varying-type system — inside ``shard_map`` every
+    value is already per-device, so the marker is an identity there.
+    """
+    import jax.lax as lax
+    pv = getattr(lax, "pvary", None)
+    return pv(x, axis_names) if pv is not None else x
+
+
+def pre_vma():
+    """True on jax without the varying-type system (< 0.6).
+
+    There, ``shard_map`` manual-mode autodiff transposes ``lax.psum``
+    to ``psum(ct)`` unconditionally: the REPLICATED seed cotangent
+    crossing a loss-closing psum once multiplies every gradient by the
+    axis size (exactly once — downstream cotangents are varying, for
+    which psum-of-ct IS the chain rule).  Callers that know their
+    collective structure divide that factor back out (see
+    ``pipeline_value_and_grad(grad_reduce_axes=...)``).
+    """
+    import jax.lax as lax
+    return not hasattr(lax, "pvary")
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` across jax versions.
+
+    0.4.x has no ``lax.axis_size``; there ``psum(1, axis)`` inside
+    shard_map constant-folds to the same static int.
+    """
+    import jax.lax as lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -66,7 +66,7 @@ def allreduce(values, axis="dp", mesh=None, op="sum"):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from ._compat import shard_map
 
     from ..ndarray.ndarray import NDArray
 
@@ -140,13 +140,15 @@ def quantized_psum(x, axis_name, *, bits=8):
     import jax.numpy as jnp
     import jax.lax as lax
 
+    from ._compat import axis_size
+
     if bits != 8:
         raise MXNetError(f"quantized_psum: bits must be 8, got {bits}")
     qmax = float(2 ** (bits - 1) - 1)
 
     @jax.custom_vjp
     def _qpsum(v):
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         flat = v.reshape(-1).astype(jnp.float32)
         padded = flat.size + ((-flat.size) % n)
         if padded != flat.size:
@@ -183,7 +185,8 @@ def quantized_psum(x, axis_name, *, bits=8):
         pcast = getattr(lax, "pcast", None)
         if pcast is not None:
             return (pcast(ct, (axis_name,), to="varying"),)
-        return (lax.pvary(ct, (axis_name,)),)
+        from ._compat import pvary
+        return (pvary(ct, (axis_name,)),)
 
     _qpsum.defvjp(_fwd, _bwd)
     return _qpsum(x)
@@ -212,7 +215,8 @@ def twobit_psum(x, axis_name, *, threshold=0.5, residual=None):
     import jax.numpy as jnp
     import jax.lax as lax
 
-    n = lax.axis_size(axis_name)
+    from ._compat import axis_size
+    n = axis_size(axis_name)
     g = x if residual is None else x + residual
     codes = jnp.where(g >= threshold, 1,
                       jnp.where(g <= -threshold, -1, 0)).astype(jnp.int8)
@@ -336,7 +340,8 @@ def sharded_weight_update(param, grad, states, update_fn, axis_name):
     import jax.numpy as jnp
     import jax.lax as lax
 
-    n = lax.axis_size(axis_name)
+    from ._compat import axis_size
+    n = axis_size(axis_name)
     flat = grad.reshape(-1).astype(jnp.float32)
     size = flat.size
     pad = (-size) % n

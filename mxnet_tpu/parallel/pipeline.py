@@ -38,7 +38,8 @@ def _local_schedule(params, xs, *, stage_fn, axis, n_microbatches):
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis)
+    from ._compat import axis_size
+    n = axis_size(axis)
     p = lax.axis_index(axis)
     m = n_microbatches
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -184,7 +185,7 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh, n, params, specs = _validate_and_place(
@@ -226,7 +227,7 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
 
 
 def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
-                n_microbatches):
+                n_microbatches, grad_fix=None):
     """Per-device 1F1B schedule (runs inside shard_map).
 
     Interleaved one-forward-one-backward over ``R = m + 2(n-1)``
@@ -249,7 +250,8 @@ def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis)
+    from ._compat import axis_size
+    n = axis_size(axis)
     p = lax.axis_index(axis)
     m = n_microbatches
     local = jax.tree_util.tree_map(lambda a: a[0], params)
@@ -307,6 +309,19 @@ def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
     # loss lives on the last stage; grads are per-stage (stay sharded)
     # and return in the PARAM dtype (f32 accumulation is internal)
     loss = lax.psum(loss_acc, axis) / m
+    if grad_fix is not None:
+        # tensor-parallel closure (grad_reduce_axes): a leaf replicated
+        # over a reduce axis came back as per-device PARTIALS — psum
+        # restores the replication its out_spec claims; on pre-vma jax
+        # every leaf additionally carries the seed-crossing psum
+        # factor (see _compat.pre_vma), divided back out here
+        psum_axes, scale = grad_fix
+        gl, td = jax.tree_util.tree_flatten(grad_acc)
+        gl = [lax.psum(g, ax) if ax else g
+              for g, ax in zip(gl, psum_axes)]
+        if scale != 1:
+            gl = [g / scale for g in gl]
+        grad_acc = jax.tree_util.tree_unflatten(td, gl)
     grads = jax.tree_util.tree_map(
         lambda g, a: (g[None] / m).astype(a.dtype), grad_acc, local)
     return loss, grads
@@ -314,7 +329,7 @@ def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
 
 def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
                             n_microbatches, mesh=None, axis="pp",
-                            param_specs=None):
+                            param_specs=None, grad_reduce_axes=None):
     """1F1B pipeline training step: mean loss + stacked param grads.
 
     stage_fn(params_i, x_mb) -> y_mb (same shape); loss_fn(out_mb,
@@ -327,6 +342,14 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     into the pipeline: leaves may shard extra mesh axes (e.g.
     ``P('pp', 'tp')``) with ``stage_fn``/``loss_fn`` issuing the
     matching collectives; grads come back in the same layout.
+    ``grad_reduce_axes`` names the NON-pipeline mesh axes those
+    collectives close with ``psum`` (e.g. ``('tp',)`` for row-parallel
+    projections + a tp-reduced loss): with it set, a param replicated
+    over such an axis gets its per-device partial grads psummed back
+    to true replication (a trained norm weight would otherwise hold
+    DIVERGENT replicas — undefined on gather), and on pre-vma jax the
+    seed-crossing psum factor (``_compat.pre_vma``) is divided out so
+    grads match the unsharded reference exactly.
 
     Compared with differentiating :func:`pipeline_apply`, the explicit
     1F1B schedule bounds in-flight activation memory by pipeline depth
@@ -334,7 +357,7 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     per microbatch per stage (the jax.checkpoint trade).
     """
     import jax
-    from jax import shard_map
+    from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh, n, params, specs = _validate_and_place(
@@ -343,19 +366,47 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     leaves = jax.tree_util.tree_leaves(stacked_params)
     sfn_key, s_cap = _structural_fn_key(stage_fn)
     lfn_key, l_cap = _structural_fn_key(loss_fn)
+    # falsy entries mean "no extra axis" (e.g. a pp-only model passes
+    # its tp_axis=None straight through) — filter them rather than
+    # crash on mesh.shape[None]
+    reduce_axes = tuple(a for a in (grad_reduce_axes or ()) if a)
     key = ("1f1b", mesh, axis, sfn_key, lfn_key, n_microbatches,
            tuple(l.shape for l in leaves),
            tuple(str(l.dtype) for l in leaves),
            x.shape, str(x.dtype), y.shape, str(y.dtype),
+           reduce_axes,
            tuple(str(s) for s in jax.tree_util.tree_leaves(
                specs, is_leaf=lambda s: isinstance(s, P))))
     entry = _EXEC_CACHE.get(key)
     fn = entry[0] if entry is not None else None
     if fn is None:
         rspec = P()
+        grad_fix = None
+        if reduce_axes:
+            from ._compat import pre_vma
+
+            def _mentioned(spec):
+                out = set()
+                for e in tuple(spec or ()):
+                    if e is None:
+                        continue
+                    out.update(e if isinstance(e, tuple) else (e,))
+                return out
+
+            spec_leaves = jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda s: isinstance(s, P))
+            psum_axes = tuple(
+                tuple(a for a in reduce_axes if a not in _mentioned(s))
+                for s in spec_leaves)
+            scale = 1
+            if pre_vma():
+                for a in reduce_axes:
+                    scale *= int(mesh.shape[a])
+            grad_fix = (psum_axes, scale)
         body = shard_map(
             partial(_local_1f1b, stage_fn=stage_fn, loss_fn=loss_fn,
-                    axis=axis, n_microbatches=n_microbatches),
+                    axis=axis, n_microbatches=n_microbatches,
+                    grad_fix=grad_fix),
             mesh=mesh,
             in_specs=(specs, rspec, rspec),
             out_specs=(rspec, specs))

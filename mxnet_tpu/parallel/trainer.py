@@ -262,6 +262,9 @@ class DataParallelTrainer:
         if any(p._deferred_init for p in params):
             with autograd.pause():
                 self.block._call_unhybridized(*args)
+        self._finish_setup(params)
+
+    def _finish_setup(self, params):
         self._params = params
         self._trainable = [p.grad_req != "null" for p in params]
         self._tr_idx = [i for i, t in enumerate(self._trainable) if t]
@@ -271,23 +274,49 @@ class DataParallelTrainer:
             for i, p in enumerate(params)]
         self._shard_params()
 
+    def _ensure_setup_for_restore(self):
+        """Checkpoint restore may land BEFORE the first batch (a fresh
+        process resuming on a possibly different mesh): initialize the
+        param/state plumbing without a batch.  Deferred shapes cannot
+        be resolved batch-free — the caller must build the net with
+        explicit in_units/in_channels (or run one step first)."""
+        if self._params is not None:
+            return
+        params = list(self.block.collect_params().values())
+        if any(p._deferred_init for p in params):
+            raise MXNetError(
+                "cannot restore a checkpoint into a trainer whose "
+                "parameter shapes are still deferred; build the block "
+                "with explicit input sizes or run one step before "
+                "restoring")
+        self._finish_setup(params)
+
     def _shard_params(self):
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..elastic import reshard as _reshard
 
         repl = NamedSharding(self.mesh, P())
+        holders: List[NDArray] = []
+        targets = []
         for p in self._params:
             d = p.data()
             spec = None
             if self._param_sharding is not None:
                 spec = self._param_sharding(p.name, d.shape)
-            sharding = NamedSharding(self.mesh, spec) if spec is not None \
-                else repl
-            d._set_data(jax.device_put(d._data, sharding))
+            holders.append(d)
+            targets.append(NamedSharding(self.mesh, spec)
+                           if spec is not None else repl)
         flat: List[NDArray] = []
         _flatten(self._states, flat)
-        for s in flat:
-            s._set_data(jax.device_put(s._data, repl))
+        holders.extend(flat)
+        targets.extend(repl for _ in flat)
+        # live -> live layout move (elastic.reshard, arXiv:2112.01075):
+        # one compiled identity program when source and target cover
+        # the same device set, the runtime transfer engine otherwise
+        moved = _reshard.redistribute([h._data for h in holders],
+                                      targets)
+        for h, a in zip(holders, moved):
+            h._set_data(a)
         # the observatory's MXL309 input: the final param layout on
         # this mesh (a big tensor left fully replicated across a >1-
         # device mesh is the misuse the sharding planner must prevent)
@@ -481,7 +510,7 @@ class DataParallelTrainer:
         import jax.numpy as jnp
         import jax.lax as lax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from ._compat import shard_map
         from .collectives import quantized_psum, twobit_psum
 
         rule = self._rule
@@ -573,6 +602,20 @@ class DataParallelTrainer:
                  self.dp_axis)
         h = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
         return f"spmd_full_step_{self.block.name}_{h}"
+
+    def _struct_hash(self) -> str:
+        """Mesh-size-independent structural identity: optimizer class,
+        param shapes/dtypes, trainable set, dp-axis name.  The reshard
+        warm-start path compares THIS (the persist-name hash bakes the
+        mesh sizes, which legitimately differ across a reshard) so a
+        manifest from a different model can never be adopted."""
+        import hashlib
+        parts = (type(self.optimizer).__name__,
+                 tuple((tuple(p.data().shape), str(p.data().dtype))
+                       for p in self._params),
+                 tuple(self._tr_idx),
+                 self.dp_axis)
+        return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
         """Resolve the dispatchable for one fused-step variant:
@@ -683,6 +726,7 @@ class DataParallelTrainer:
             "format": 1, "kind": "spmd_full_step",
             "fingerprint": _persist.fingerprint(),
             "persist_name": self._persist_name(),
+            "struct": self._struct_hash(),
             "block": self.block.name,
             "optimizer": type(self.optimizer).__name__,
             "mesh": {str(k): int(v)
@@ -740,11 +784,22 @@ class DataParallelTrainer:
         if self._donation_poisoned is not None:
             return _fail("trainer is poisoned")
         mesh_now = {str(k): int(v) for k, v in self.mesh.shape.items()}
+        resharded = False
         if mesh_now != m.get("mesh") or \
                 self.dp_axis != m.get("dp_axis"):
-            return _fail(f"mesh layout mismatch: manifest "
-                         f"{m.get('mesh')}/{m.get('dp_axis')!r} vs "
-                         f"current {mesh_now}/{self.dp_axis!r}")
+            # mesh-CHANGE restart (ROADMAP item 5): same axis names +
+            # dp axis but different sizes is no longer a hard reject —
+            # the step re-AOTs on the new mesh before the first batch
+            # (the persist identity hashes the mesh, so the persistent
+            # tier keys fresh entries for the new layout; params/state
+            # reshard at checkpoint-restore time)
+            saved = m.get("mesh") or {}
+            if self.dp_axis != m.get("dp_axis") or \
+                    set(saved) != set(mesh_now):
+                return _fail(f"mesh layout mismatch: manifest "
+                             f"{m.get('mesh')}/{m.get('dp_axis')!r} vs "
+                             f"current {mesh_now}/{self.dp_axis!r}")
+            resharded = True
         if type(self.optimizer).__name__ != m.get("optimizer"):
             return _fail("optimizer class mismatch")
         try:
@@ -763,6 +818,13 @@ class DataParallelTrainer:
                 lbl_shape, dtype=np.dtype(lbl_aval[1])))
         except Exception as e:
             return _fail(f"bad aval record: {e!r}"[:300])
+        if resharded:
+            ndp = int(mesh_now.get(self.dp_axis, 1))
+            if any(s and s[0] % ndp
+                   for s in list(shapes) + [lbl_shape]):
+                return _fail(
+                    f"global batch does not divide the new dp size "
+                    f"{ndp}; cannot reshard the input layout")
 
         import jax
         prev = autograd.set_training(True)
@@ -771,10 +833,20 @@ class DataParallelTrainer:
                 self._setup(args)
             # structural hash must match before adopting the identity —
             # the hash part of the persist name covers param
-            # shapes/dtypes, trainable set, optimizer, and mesh layout
-            local_hash = self._persist_name().rsplit("_", 1)[-1]
-            if str(m.get("persist_name", "")).rsplit("_", 1)[-1] != \
-                    local_hash:
+            # shapes/dtypes, trainable set, optimizer, and mesh layout.
+            # A resharded warm start keeps its LOCAL identity (the
+            # saved hash bakes the old mesh, and the new mesh must key
+            # its own persistent entries — re-AOT, not reuse), so THERE
+            # the mesh-independent struct hash carries the "manifest
+            # describes this model" invariant instead
+            if resharded:
+                if m.get("struct") != self._struct_hash():
+                    return _fail(
+                        "structural hash mismatch: the manifest "
+                        "describes a different model/optimizer "
+                        "configuration (reshard path)")
+            elif str(m.get("persist_name", "")).rsplit("_", 1)[-1] \
+                    != self._persist_name().rsplit("_", 1)[-1]:
                 return _fail("structural hash mismatch: the manifest "
                              "describes a different model/optimizer/"
                              "mesh configuration")
@@ -785,7 +857,8 @@ class DataParallelTrainer:
             # AFTER the builders: _build_fwd_bwd rebinds
             # self._mutated_idx to a fresh list, which would silently
             # drop the adopted aux routing (BatchNorm write-backs)
-            self._persist_pin = m["persist_name"]
+            if not resharded:
+                self._persist_pin = m["persist_name"]
             self._mutated_idx[:] = [int(i) for i in m["mutated_idx"]]
             self._trace_seen[0] = True
             param_vals = tuple(p.data()._data for p in self._params)
@@ -837,8 +910,166 @@ class DataParallelTrainer:
             autograd.set_training(prev)
         self.warm_started = True
         telemetry.record_event("warm_start", name="spmd_full_step",
-                               ok=True)
+                               ok=True, resharded=resharded)
         return True
+
+    # -- elastic protocol (docs/elasticity.md) ----------------------------
+    def _elastic_export(self):
+        """Everything ``elastic.CheckpointManager`` persists for this
+        trainer: params (incl. frozen/BatchNorm aux), optimizer-state
+        leaves, compression residuals, update counters, mesh layout +
+        per-param sharding specs, and the warm-start persist
+        identity."""
+        if self._params is None:
+            raise MXNetError(
+                "nothing to checkpoint yet: run a step (or restore) "
+                "before save()")
+        from ..elastic import reshard as _reshard
+        opt = self.optimizer
+        params = []
+        for p in self._params:
+            d = p.data()
+            try:
+                spec = _reshard.spec_to_str(d._data.sharding.spec)
+            except AttributeError:
+                spec = "()"
+            params.append((p.name, d._data, spec))
+        states = []
+        for i in self._tr_idx:
+            leaves: List[NDArray] = []
+            _flatten(self._states[i], leaves)
+            for j, leaf in enumerate(leaves):
+                states.append((i, j, leaf._data))
+        step = max(opt._index_update_count.values(),
+                   default=int(opt.num_update))
+        return {
+            "kind": "spmd", "step": int(step),
+            "optimizer": type(opt).__name__,
+            "update_counts": dict(opt._index_update_count),
+            "num_update": int(opt.num_update),
+            "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
+            "dp_axis": self.dp_axis,
+            "persist_name": self._persist_name(),
+            "params": params, "states": states,
+            "residuals": list(self._residual_vals or ()),
+        }
+
+    def _elastic_restore(self, payload):
+        """Apply a checkpoint payload: params + optimizer state land
+        on THIS trainer's mesh (the reshard path when the checkpoint
+        was saved on a different mesh — fp32-exact, the layout move
+        never touches element values), counters and poison state are
+        rewound, and the placement cache is dropped."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .. import telemetry
+        from ..elastic import reshard as _reshard
+
+        self._ensure_setup_for_restore()
+        mesh_now = {str(k): int(v) for k, v in self.mesh.shape.items()}
+        saved_mesh = payload.get("mesh") or {}
+        resharded = bool(saved_mesh) and saved_mesh != mesh_now
+        repl = NamedSharding(self.mesh, P())
+
+        from ..elastic.manager import align_params
+        aligned = align_params([p.name for p in self._params],
+                               payload["params"])
+        plans = {}
+        for p, (host, spec_str) in zip(self._params, aligned):
+            d = p.data()
+            if tuple(host.shape) != tuple(d.shape):
+                raise MXNetError(
+                    f"checkpoint param {p.name!r} has shape "
+                    f"{tuple(host.shape)}, trainer expects "
+                    f"{tuple(d.shape)}")
+            # target layout = this trainer's sharding rule on the
+            # CURRENT mesh (same derivation as _shard_params)
+            spec = None
+            if self._param_sharding is not None:
+                spec = self._param_sharding(p.name, d.shape)
+            target = NamedSharding(self.mesh, spec) \
+                if spec is not None else repl
+            if resharded:
+                plans[p.name] = _reshard.plan(
+                    host.shape, _reshard.spec_from_str(spec_str),
+                    saved_mesh, spec if spec is not None else P(),
+                    mesh_now)
+            d._set_data(_reshard.place(np.asarray(host), self.mesh,
+                                       spec if spec is not None
+                                       else P()))
+        for i, j, host in payload["states"]:
+            if not (0 <= i < len(self._states)) or \
+                    self._states[i] is None:
+                raise MXNetError(
+                    f"checkpoint optimizer-state leaf ({i},{j}) has "
+                    "no slot in this trainer (optimizer mismatch?)")
+            leaves: List[NDArray] = []
+            _flatten(self._states[i], leaves)
+            if j >= len(leaves):
+                raise MXNetError(
+                    f"checkpoint optimizer-state leaf ({i},{j}) out "
+                    "of range (optimizer class mismatch?)")
+            leaves[j]._set_data(jax.device_put(np.asarray(host), repl))
+        residuals = payload.get("residuals") or []
+        if self._compression_cfg is not None:
+            if not residuals or resharded:
+                # restart error feedback at zero (rebuilt lazily by
+                # the compressed step): either the checkpoint predates
+                # the first compressed step — keeping this process's
+                # abandoned-timeline residuals would diverge from an
+                # uninterrupted run — or the replica count changed and
+                # per-REPLICA state has no exact mapping
+                self._residual_vals = None
+            else:
+                res_dp = NamedSharding(self.mesh, P(self.dp_axis))
+                self._residual_vals = tuple(
+                    jax.device_put(np.asarray(h), res_dp)
+                    for h in residuals)
+        opt = self.optimizer
+        counts = {int(k): int(v)
+                  for k, v in (payload.get("update_counts") or
+                               {}).items()}
+        # rewind every per-device count dict, not just the alias the
+        # last _set_current_context left behind
+        for dev_counts in opt._all_index_update_counts.values():
+            dev_counts.clear()
+            dev_counts.update(counts)
+        opt.num_update = int(payload.get("num_update",
+                                         payload["step"]))
+        self._donation_poisoned = None
+        self._placed = {}
+        if resharded:
+            telemetry.record_event(
+                "reshard", where="spmd_restore",
+                saved_mesh=saved_mesh, mesh=mesh_now,
+                moves={k: v for k, v in list(plans.items())[:8] if v})
+
+    def recover(self, manager, step: Optional[int] = None) -> int:
+        """Rebuild this trainer's donated buffers from the last
+        committed checkpoint (or ``step``) and clear the poison latch —
+        the recovery half of the donation-failure protocol.  Safe to
+        call on a healthy trainer too (plain restore).  Returns the
+        restored step.  Recovery FORKS the timeline: checkpoints newer
+        than the restored step are invalidated, so a later crash can
+        never resume from the abandoned run."""
+        import time
+        from .. import telemetry
+        t0 = time.perf_counter()
+        was_poisoned = self._donation_poisoned is not None
+        restored = manager.restore(step=step, into=self,
+                                   invalidate_newer=True)
+        dt = time.perf_counter() - t0
+        telemetry.counter("mxtpu_recoveries_total",
+                          "checkpoint recoveries (poisoned or "
+                          "explicit)").inc()
+        telemetry.histogram(
+            "mxtpu_recovery_seconds",
+            "time to rebuild trainer state from the last committed "
+            "checkpoint (s)").observe(dt)
+        telemetry.record_event("recovery", where="spmd",
+                               step=restored, seconds=round(dt, 4),
+                               poisoned=was_poisoned)
+        return restored
 
     # -- public API -------------------------------------------------------
     def step(self, data, label):
@@ -962,8 +1193,9 @@ class DataParallelTrainer:
                 raise MXNetError(
                     "this trainer's optimizer state was donated to a "
                     "fused step that failed and is no longer valid; "
-                    "rebuild the trainer and restore from a "
-                    "checkpoint. Original error: "
+                    "call recover(manager) to restore from the last "
+                    "committed checkpoint (docs/elasticity.md). "
+                    "Original error: "
                     f"{self._donation_poisoned}")
 
             opt = self.optimizer
@@ -1024,9 +1256,16 @@ class DataParallelTrainer:
                 call = self._tiered_exec(
                     suffix, fn, self._multi_fns[kk], vals, (0, 1))
                 cached[0][sig] = call
-            try:
+            from .. import engine
+            from ..elastic import faults as _faults
+            probe = list(param_vals) + [v for vals in self._state_vals()
+                                        for v in vals]
+
+            def _go():
+                if _faults._active:
+                    _faults.on_dispatch("spmd_step_multi", probe)
                 try:
-                    loss_k, new_all_params, new_states = call(*vals)
+                    return call(*vals)
                 except TypeError:
                     # aval drift the AOT executable rejects: demote
                     # THIS signature to the pjit path (cached — not a
@@ -1036,7 +1275,11 @@ class DataParallelTrainer:
                         raise
                     if cached is not None:
                         cached[0][sig] = fn
-                    loss_k, new_all_params, new_states = fn(*vals)
+                    return fn(*vals)
+
+            try:
+                loss_k, new_all_params, new_states = \
+                    engine.retrying_call(_go, probe, "spmd_step_multi")
             except Exception as e:
                 # donate_argnums=(0, 1): if the executable consumed
                 # the donated param/state buffers before failing they
@@ -1058,8 +1301,9 @@ class DataParallelTrainer:
                 self._record_poison(e, "spmd_step_multi")
                 raise MXNetError(
                     "bulked train step failed AFTER its param/state "
-                    "buffers were donated; the trainer is invalid. "
-                    "Rebuild it and restore from a checkpoint. "
+                    "buffers were donated; the trainer is invalid "
+                    "until recover(manager) restores the last "
+                    "committed checkpoint (docs/elasticity.md). "
                     f"Original error: {e!r}") from e
             # success: commit the K update-count advances
             for _ in range(k_steps):
@@ -1225,24 +1469,52 @@ class DataParallelTrainer:
                     raise MXNetError(
                         "this trainer's optimizer state was donated to "
                         "a fused step that failed and is no longer "
-                        "valid; rebuild the trainer and restore "
-                        "parameters/optimizer state from a checkpoint. "
+                        "valid; call recover(manager) to restore "
+                        "parameters/optimizer state from the last "
+                        "committed checkpoint (docs/elasticity.md). "
                         f"Original error: {self._donation_poisoned}")
-                try:
+                from .. import engine
+                from ..elastic import faults as _faults
+                state_flat = [v for vals in self._state_vals()
+                              for v in vals]
+                # everything _full_donate hands to the executable: the
+                # compressed step donates the 2bit error-feedback
+                # residuals (argnum 6) alongside the optimizer state,
+                # and a plain-SGD run has ONLY residuals as donated
+                # state — the poison probe must see them too
+                donated_flat = state_flat + (
+                    list(self._residual_vals)
+                    if compressed and self._residual_vals else [])
+
+                def _go():
+                    # the fault hook sits INSIDE the retried thunk so
+                    # a one-shot "dispatch" fault is absorbed exactly
+                    # like a real transient; "dispatch_post" consumes
+                    # the donated state first -> poison protocol
+                    if _faults._active:
+                        _faults.on_dispatch("spmd_full_step",
+                                            donated_flat)
                     if compressed:
-                        (loss, new_params, new_states, aux,
-                         new_res) = self._full_step(
+                        return self._full_step(
                             param_vals, self._state_vals(),
                             tuple(scalar_vals), x_vals, y_val,
                             key._data, self._residual_vals or ())
+                    return self._dispatch_full(
+                        (param_vals, self._state_vals(),
+                         tuple(scalar_vals), x_vals, y_val,
+                         key._data))
+
+                try:
+                    if compressed:
+                        (loss, new_params, new_states, aux,
+                         new_res) = engine.retrying_call(
+                            _go, donated_flat, "spmd_full_step")
                         if new_res:
                             self._residual_vals = new_res
                     else:
                         loss, new_params, new_states, aux = \
-                            self._dispatch_full(
-                                (param_vals, self._state_vals(),
-                                 tuple(scalar_vals), x_vals, y_val,
-                                 key._data))
+                            engine.retrying_call(
+                                _go, donated_flat, "spmd_full_step")
                 except Exception as e:
                     # donate_argnums=(1,): if the executable consumed
                     # the donated state buffers before failing, they
@@ -1253,15 +1525,16 @@ class DataParallelTrainer:
                     # buffers alive and must NOT brick the trainer.
                     consumed = any(
                         getattr(v, "is_deleted", lambda: False)()
-                        for vals in self._state_vals() for v in vals)
+                        for v in donated_flat)
                     if not consumed:
                         raise
                     self._donation_poisoned = repr(e)
                     self._record_poison(e, "spmd_step")
                     raise MXNetError(
                         "fused train step failed AFTER its optimizer "
-                        "state was donated; the trainer is invalid. "
-                        "Rebuild it and restore from a checkpoint. "
+                        "state was donated; the trainer is invalid "
+                        "until recover(manager) restores the last "
+                        "committed checkpoint (docs/elasticity.md). "
                         f"Original error: {e!r}") from e
             else:
                 loss, grads, aux = self._fwd_bwd(param_vals, x_vals,

@@ -44,6 +44,7 @@ if __name__ == "__main__":
 import numpy as np
 
 import mxnet_tpu as mx  # noqa: F401  joins the MXTPU_DIST_* rendezvous
+from mxnet_tpu.parallel._compat import axis_size as _axis_size
 
 H = 8          # feature width
 PP = 4         # pipeline stages
@@ -62,7 +63,7 @@ def _pipelined_local_loss(w_loc, x_loc, y_loc):
     import jax.numpy as jnp
     import jax.lax as lax
 
-    n = lax.axis_size("pp")
+    n = _axis_size("pp")
     p = lax.axis_index("pp")
     m = n                             # microbatches = stages
     mb = x_loc.shape[0] // m
@@ -100,7 +101,7 @@ def _composed_step(w_loc, x_loc, y_loc):
     import jax.lax as lax
     from mxnet_tpu.parallel import collectives
 
-    dp = lax.axis_size("dp")
+    dp = _axis_size("dp")
     w2 = w_loc[0]                     # strip the sharded pp dim
     loss, g = jax.value_and_grad(_pipelined_local_loss)(
         w2, x_loc, y_loc)
@@ -131,7 +132,7 @@ def _reference(w0, x, y, steps):
 
 def main():
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from jax.experimental import multihost_utils
 

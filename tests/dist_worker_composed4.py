@@ -50,6 +50,7 @@ if __name__ == "__main__":
 import numpy as np
 
 import mxnet_tpu as mx  # noqa: F401  joins the MXTPU_DIST_* rendezvous
+from mxnet_tpu.parallel._compat import axis_size as _axis_size
 from mxnet_tpu.parallel.ring_attention import _ring_attention_local
 
 F = 8          # model width
@@ -96,7 +97,7 @@ def _pipelined_local_loss(ws, x_loc, y_loc):
     import jax.numpy as jnp
     import jax.lax as lax
 
-    n = lax.axis_size("pp")
+    n = _axis_size("pp")
     p = lax.axis_index("pp")
     m = n                             # microbatches = stages
     mb = x_loc.shape[0] // m
@@ -119,7 +120,7 @@ def _pipelined_local_loss(ws, x_loc, y_loc):
     # local seq shard mean → global mean over the sp ring (equal
     # shard sizes, so the global mean is the mean of local means)
     loss_sp = ((outs - ys) ** 2).mean()
-    loss_seq = lax.psum(loss_sp, "sp") / lax.axis_size("sp")
+    loss_seq = lax.psum(loss_sp, "sp") / _axis_size("sp")
     loss_local = jnp.where(p == n - 1, loss_seq, 0.0)
     return lax.psum(loss_local, "pp")
 
@@ -160,7 +161,7 @@ def _update(ws, loss_dp, gs_dp):
     import jax.lax as lax
     from mxnet_tpu.parallel import collectives
 
-    dp = lax.axis_size("dp")
+    dp = _axis_size("dp")
     gs_avg = tuple(
         collectives.quantized_psum(g[0, 0], "dp") / dp for g in gs_dp)
     ws_new = tuple(
@@ -197,7 +198,7 @@ def _reference(w0, x, y, steps):
 
 
 def main():
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from jax.experimental import multihost_utils
 

@@ -35,7 +35,7 @@ import mxnet_tpu as mx  # noqa: F401  joins the MXTPU_DIST_* rendezvous
 
 def main():
     import jax.lax as lax
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from jax.experimental import multihost_utils
 

@@ -488,12 +488,52 @@ def test_spmd_warm_start_records_and_checks_mesh(cache_dir, tmp_path):
     assert _fresh_compiles() == 0
     np.testing.assert_array_equal(l1, l2_)
 
-    # a mismatched mesh must be rejected (the layout is baked into the
-    # serialized executable)
+    # a mesh-SIZE change is no longer a hard reject: the manifest's
+    # avals re-AOT on the new layout (reshard + re-AOT, fresh compiles
+    # expected — the serialized executable baked the OLD mesh and must
+    # not be reused; docs/elasticity.md)
     from conftest import needs_devices
     needs_devices(2)
     net3, dpt3 = _spmd("cc_spmd_c_", n_dev=2)
-    assert dpt3.warm_start(manifest) is False
+    assert dpt3.warm_start(manifest) is True
+    assert _fresh_compiles() > 0, \
+        "a resharded warm start must re-AOT, never adopt the old " \
+        "mesh's executable"
+    l3 = dpt3.step(X, Y).asnumpy()
+    np.testing.assert_array_equal(l1, l3)
+
+    # a different AXIS STRUCTURE (dp axis missing from the manifest's
+    # mesh) is still a hard reject
+    net4, dpt4 = _spmd("cc_spmd_d_", n_dev=2)
+    m2 = dict(m)
+    m2["mesh"] = {"tp": 1}
+    bad = str(tmp_path / "spmd_bad_mesh.json")
+    open(bad, "w").write(json.dumps(m2))
+    assert dpt4.warm_start(bad) is False
+
+    # a resharded manifest from a DIFFERENT model is also rejected —
+    # the persist-name hash bakes mesh sizes so it cannot carry the
+    # check across a reshard; the mesh-independent struct hash does
+    net4b, dpt4b = _spmd("cc_spmd_db_", n_dev=2)
+    m3 = dict(m)
+    m3["struct"] = "0" * 16
+    bad_struct = str(tmp_path / "spmd_bad_struct.json")
+    open(bad_struct, "w").write(json.dumps(m3))
+    assert dpt4b.warm_start(bad_struct) is False
+
+    # the manifest round-trips the NEW layout: after the resharded
+    # process re-saves its signature, a second restart on that mesh
+    # warm-starts with 0 fresh compiles (docs/elasticity.md)
+    manifest2 = str(tmp_path / "spmd2.json")
+    dpt3.save_signature(manifest2)
+    assert json.loads(open(manifest2).read())["mesh"] == {"dp": 2}
+    _restart()
+    net5, dpt5 = _spmd("cc_spmd_e_", n_dev=2)
+    assert dpt5.warm_start(manifest2) is True
+    assert _fresh_compiles() == 0
+    l5 = dpt5.step(X, Y).asnumpy()
+    assert _fresh_compiles() == 0
+    np.testing.assert_array_equal(l1, l5)
 
 
 def test_spmd_warm_start_batchnorm_aux(cache_dir, tmp_path):

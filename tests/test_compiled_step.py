@@ -202,6 +202,7 @@ def test_compiled_matches_eager_mlp_dropout(optname, opt_kw):
     _assert_same(_states_np(tr_a), _states_np(tr_b))
 
 
+@pytest.mark.slow
 def test_compiled_matches_eager_model_zoo_convnet():
     """Model-zoo conv net (BatchNorm everywhere): 5 compiled steps match
     eager including the running-stat AUX updates flowing through the

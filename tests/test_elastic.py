@@ -1,0 +1,1051 @@
+"""Elastic training plane (docs/elasticity.md).
+
+Tier-1 coverage for the fault-tolerance subsystem — every recovery
+path is exercised, not merely shipped:
+
+* atomic async sharded checkpointing (``elastic.CheckpointManager``):
+  temp-dir + rename commit, per-shard sha256 integrity, bounded
+  retention, RNG-stream round trip, async double buffering;
+* deterministic fault injection (``MXTPU_FAULT_INJECT`` grammar /
+  ``elastic.faults``) hooked into the real dispatch and
+  checkpoint-commit paths;
+* the fault matrix: dispatch failure pre-donation (bounded retry
+  absorbs it / surfaces it without poisoning), dispatch failure
+  post-donation (poison → ``recover()`` → training resumes
+  bit-identical to an uninterrupted run, on both the gluon
+  ``CompiledStep`` and the SPMD ``DataParallelTrainer``),
+  checkpoint-write crash and host-copy failure (previous checkpoint
+  stays authoritative, the manager survives);
+* mesh-change restore: an 8-device dp checkpoint restores onto 4 (and
+  1) with exact fp32 param/optimizer-state equality, then trains on;
+* ``OrbaxCheckpoint`` atomicity + corrupt-reject, the
+  ``tools/mxckpt.py`` CLI, and the MXL501/MXL502 lint passes.
+"""
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.elastic import CheckpointManager, faults
+from mxnet_tpu.elastic import manager as emgr
+from mxnet_tpu.gluon import nn, Trainer
+from mxnet_tpu.gluon.loss import L2Loss
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No fault plan — and no checkpoint-dir registration — leaks
+    between tests (or out of this module: the MXL501/502 runtime pass
+    reads the process-global registry, so a deliberately corrupted
+    tmp checkpoint here must not fail a later ``--self-check``)."""
+    faults.clear()
+    yield
+    faults.clear()
+    emgr._reset_registry()
+
+
+def _mlp(seed=7, prefix=None):
+    np.random.seed(seed)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    return net
+
+
+def _batch(n=16):
+    x = np.random.RandomState(0).rand(n, 8).astype("float32")
+    y = np.random.RandomState(1).rand(n, 4).astype("float32")
+    return nd.array(x), nd.array(y)
+
+
+def _params_of(net):
+    return {n_: p.data().asnumpy() for n_, p in
+            net.collect_params().items()}
+
+
+def _assert_params_equal(a, b):
+    assert len(a) == len(b)
+    for ka, kb in zip(sorted(a), sorted(b)):
+        np.testing.assert_array_equal(a[ka], b[kb],
+                                      err_msg=f"{ka} vs {kb}")
+
+
+# ---------------------------------------------------------------------------
+# fault-injection grammar
+# ---------------------------------------------------------------------------
+
+
+def test_fault_grammar():
+    n = faults.configure(
+        "dispatch:step=7; checkpoint_write:nth=2,times=3; host_copy")
+    assert n == 3 and faults.active()
+    assert faults.configure(None) == 0 and not faults.active()
+    # a typo'd point parses (forward compatibility) but warns loudly:
+    # it can never fire, so a silent drill would pass vacuously
+    with pytest.warns(RuntimeWarning, match="unknown fault point"):
+        faults.configure("dispach:nth=1")
+    faults.clear()
+    with pytest.raises(ValueError, match="bad fault qualifier"):
+        faults.configure("dispatch:bogus=1")
+    with pytest.raises(ValueError):
+        faults.configure("dispatch:nth=")
+
+
+def test_fault_nth_times_one_shot():
+    faults.configure("checkpoint_write:nth=2")
+    faults.maybe_fire("checkpoint_write")          # 1st arrival: no
+    with pytest.raises(faults.FaultError):
+        faults.maybe_fire("checkpoint_write")      # 2nd: fires
+    faults.maybe_fire("checkpoint_write")          # one-shot: spent
+    assert not faults.active()
+    assert faults.fired() == ["checkpoint_write:nth=2"]
+
+    faults.configure("host_copy:times=2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.maybe_fire("host_copy")
+    faults.maybe_fire("host_copy")                 # times=2 spent
+    assert not faults.active()
+
+
+def test_fault_env_configuration(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "host_copy;dispatch:nth=3")
+    assert faults.configure_from_env() == 2
+    assert faults.active()
+
+    # a malformed spec must NOT brick `import mxnet_tpu` (this runs at
+    # module import): injection is disabled with a warning instead
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "dispatch:badqual=1")
+    with pytest.warns(RuntimeWarning, match="MXTPU_FAULT_INJECT"):
+        assert faults.configure_from_env() == 0
+    assert not faults.active()
+    # explicit configure() keeps strict grammar
+    with pytest.raises(ValueError, match="bad fault qualifier"):
+        faults.configure("dispatch:badqual=1")
+
+
+# ---------------------------------------------------------------------------
+# array store: write_arrays / read_arrays / OrbaxCheckpoint
+# ---------------------------------------------------------------------------
+
+
+def test_write_read_arrays_roundtrip(tmp_path):
+    arrays = {"w": np.arange(12, dtype="f4").reshape(3, 4),
+              "b": np.ones(3, dtype="f8")}
+    path = emgr.write_arrays(str(tmp_path / "ck"), arrays)
+    manifest, back = emgr.read_arrays(path)
+    assert manifest["kind"] == "mxtpu_array_dict"
+    for k in arrays:
+        np.testing.assert_array_equal(arrays[k], back[k])
+        assert arrays[k].dtype == back[k].dtype
+
+
+def test_read_arrays_rejects_corruption(tmp_path):
+    path = emgr.write_arrays(str(tmp_path / "ck"),
+                             {"w": np.ones(4, dtype="f4")})
+    shard = glob.glob(os.path.join(path, "shards", "*.npy"))[0]
+    with open(shard, "wb") as f:
+        f.write(b"not an npy payload")
+    with pytest.raises(MXNetError, match="sha256"):
+        emgr.read_arrays(path)
+    # a missing manifest (torn write) is refused too
+    os.remove(os.path.join(path, "manifest.json"))
+    with pytest.raises(MXNetError, match="manifest"):
+        emgr.read_arrays(path)
+
+
+def test_write_arrays_crash_leaves_previous_committed(tmp_path):
+    target = str(tmp_path / "ck")
+    emgr.write_arrays(target, {"w": np.zeros(4, dtype="f4")})
+    faults.configure("checkpoint_write:nth=1")
+    with pytest.raises(faults.FaultError):
+        emgr.write_arrays(target, {"w": np.ones(4, dtype="f4")})
+    # the crash never touched the committed dir: old content survives
+    _m, back = emgr.read_arrays(target)
+    np.testing.assert_array_equal(back["w"], np.zeros(4, dtype="f4"))
+
+
+def test_orbax_checkpoint_atomic_and_corrupt_reject(tmp_path):
+    from mxnet_tpu.checkpoint import OrbaxCheckpoint
+    net = _mlp(seed=1)
+    ob = OrbaxCheckpoint(str(tmp_path / "orbax"))
+    arrays = {k: p.data() for k, p in net.collect_params().items()}
+    ob.save(3, arrays)
+    back = ob.load(3)
+    for k in arrays:
+        np.testing.assert_array_equal(arrays[k].asnumpy(),
+                                      back[k].asnumpy())
+    with pytest.raises(MXNetError, match="force=True"):
+        ob.save(3, arrays, force=False)
+    ob.save(3, arrays)                       # force=True default: ok
+
+    # load_into swaps buffers in place
+    net2 = _mlp(seed=2, prefix=net.prefix)
+    ob.load_into(3, net2.collect_params())
+    _assert_params_equal(_params_of(net), _params_of(net2))
+
+    # corrupt shard -> clear MXNetError, never garbage
+    shard = glob.glob(str(tmp_path / "orbax" / "3" / "shards" /
+                          "*.npy"))[0]
+    with open(shard, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(MXNetError, match="sha256"):
+        ob.load(3)
+    with pytest.raises(MXNetError, match="no checkpoint"):
+        ob.load(99)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager on the gluon Trainer
+# ---------------------------------------------------------------------------
+
+
+def _gluon_trainer(seed=7, prefix=None):
+    net = _mlp(seed=seed, prefix=prefix)
+    tr = Trainer(net.collect_params(), "adam",
+                 {"learning_rate": 0.01}, kvstore=None)
+    return net, tr
+
+
+def _gluon_steps(net, tr, k, x, y):
+    from mxnet_tpu import autograd
+    loss = None
+    for _ in range(k):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        tr.step(x.shape[0])
+    return loss
+
+
+def test_manager_roundtrip_bit_identical(tmp_path):
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    _gluon_steps(net, tr, 3, x, y)
+    step = m.save()
+    assert m.steps() == [step] and m.latest_step() == step
+    want = _params_of(net)
+    opt = tr._optimizer
+    want_nu = opt.num_update
+
+    _gluon_steps(net, tr, 2, x, y)           # diverge past the save
+    assert m.restore() == step
+    _assert_params_equal(want, _params_of(net))
+    assert opt.num_update == want_nu
+    # training continues bit-identically vs. an uninterrupted twin
+    loss_a = _gluon_steps(net, tr, 2, x, y)
+    net_b, tr_b = _gluon_trainer()
+    loss_b = _gluon_steps(net_b, tr_b, 5, x, y)
+    np.testing.assert_array_equal(loss_a.asnumpy(), loss_b.asnumpy())
+    _assert_params_equal(_params_of(net), _params_of(net_b))
+
+
+def test_manager_rng_stream_roundtrip(tmp_path):
+    _net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    mx.random.seed(123)
+    mx.nd.random.uniform(shape=(4,))          # advance the stream
+    m.save(step=1)
+    a = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    mx.nd.random.uniform(shape=(4,))          # diverge
+    m.restore()
+    b = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_manager_retention_and_verify(tmp_path):
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr, keep=2,
+                          async_save=False)
+    for _ in range(4):
+        _gluon_steps(net, tr, 1, x, y)
+        m.save()
+    assert len(m.steps()) == 2                # bounded retention
+    rows = m.verify()
+    assert all(r["ok"] for r in rows)
+    with pytest.raises(MXNetError, match="keep must be"):
+        CheckpointManager(str(tmp_path / "bad"), keep=0)
+
+
+def test_manager_async_save_and_failed_write(tmp_path):
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save()
+    m.wait()                                  # commits cleanly
+    assert len(m.steps()) == 1
+
+    # a write that dies mid-shard: wait() surfaces it, the previous
+    # checkpoint stays authoritative, and the NEXT save still works
+    _gluon_steps(net, tr, 1, x, y)
+    faults.configure("checkpoint_write:nth=1")
+    m.save()
+    with pytest.raises(MXNetError, match="checkpoint write failed"):
+        m.wait()
+    assert m.last_error is not None
+    assert len(m.steps()) == 1
+    rows = m.verify()
+    # every COMMITTED checkpoint is intact; the crashed write shows up
+    # as a torn temp dir (prune clears it), never as a committed step
+    assert all(r["ok"] for r in rows if not r.get("partial"))
+    assert any(r.get("partial") for r in rows)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(block=True)
+    assert len(m.steps()) == 2
+    m.prune()
+    assert not any(r.get("partial") for r in m.verify())
+    m.close()
+
+
+def test_restore_drains_inflight_async_write(tmp_path):
+    """restore() must not race the writer thread: an in-flight async
+    save commits (or fails) BEFORE the restore target is chosen and
+    before ``invalidate_newer`` deletes newer steps — otherwise the
+    abandoned timeline's write could land as the newest checkpoint
+    after the invalidation."""
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr)
+    _gluon_steps(net, tr, 1, x, y)
+    st = m.save()                    # async: writer still in flight
+    assert m.restore() == st         # drained, not "no checkpoint"
+    assert m.steps() == [st]
+    m.close()
+
+
+def test_restore_syncs_all_per_context_updaters(tmp_path):
+    """A multi-context Trainer keeps one updater per context (step()
+    pairs updater k with replica k); restore() must reinstate EVERY
+    copy of the optimizer state or the replicas silently diverge on
+    the next step."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.compiled_step import _flatten_state
+
+    devs = [mx.cpu(0), mx.cpu(1)]
+    np.random.seed(7)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier(), ctx=devs)
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9}, kvstore=None)
+    xs = [nd.array(np.random.RandomState(0).rand(8, 8)
+                   .astype("float32"), ctx=d) for d in devs]
+    ys = [nd.array(np.random.RandomState(1).rand(8, 4)
+                   .astype("float32"), ctx=d) for d in devs]
+
+    def one_step():
+        with autograd.record():
+            losses = [((net(x) - y) ** 2).mean()
+                      for x, y in zip(xs, ys)]
+        autograd.backward(losses)
+        tr.step(8)
+
+    def leaves_of(upd):
+        out = []
+        for i in sorted(upd.states):
+            ls = []
+            _flatten_state(upd.states[i], ls)
+            out.extend(a.asnumpy() for a in ls)
+        return out
+
+    one_step()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    m.save()
+    want = leaves_of(tr._updaters[0])
+    assert want
+    want_params = {k: p.data().asnumpy()
+                   for k, p in net.collect_params().items()}
+    one_step()                       # both updaters + replicas drift
+    m.restore()
+    assert len(tr._updaters) == 2
+    for upd in tr._updaters:
+        got = leaves_of(upd)
+        assert len(got) == len(want)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+    for k, p in net.collect_params().items():
+        for rep in p.list_data():    # EVERY context replica restored
+            np.testing.assert_array_equal(want_params[k],
+                                          rep.asnumpy())
+    # the per-DEVICE update counts all rewind too (the optimizer's
+    # _index_update_count is an alias into the last-stepped device's
+    # dict; a stale copy skews Adam bias-correction t per replica)
+    for dev_counts in tr._optimizer._all_index_update_counts.values():
+        assert all(v == 1 for v in dev_counts.values()), dev_counts
+
+
+def test_manager_host_copy_failure_previous_authoritative(tmp_path):
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save()
+    want = _params_of(net)
+    _gluon_steps(net, tr, 1, x, y)
+    faults.configure("host_copy:nth=1")
+    with pytest.raises(faults.FaultError):
+        m.save()
+    # restore serves the last COMMITTED state
+    m.restore()
+    _assert_params_equal(want, _params_of(net))
+
+
+def test_force_overwrite_atomic_and_heal(tmp_path):
+    """The ``force=True`` overwrite swaps through ``step-N.old``; a
+    crash between the two renames (only the ``.old`` left on disk)
+    heals back to the previous checkpoint as authoritative, and a
+    completed swap's leftover ``.old`` is dropped — so the "a crash at
+    ANY point leaves the previous checkpoint authoritative" guarantee
+    covers the overwrite path too."""
+    import shutil
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    _gluon_steps(net, tr, 1, x, y)
+    step = m.save()
+    want = _params_of(net)
+    final = emgr._step_dir(m.directory, step)
+
+    # crash between rename(final -> old) and rename(tmp -> final):
+    # only the demoted previous checkpoint survives
+    os.rename(final, final + ".old")
+    rows = emgr.ls_dir(m.directory)              # every entry heals
+    assert [r["step"] for r in rows] == [step]
+    assert os.path.isdir(final)
+    assert not os.path.exists(final + ".old")
+    _gluon_steps(net, tr, 1, x, y)               # diverge
+    m.restore(step=step)
+    _assert_params_equal(want, _params_of(net))
+
+    # completed swap (both present): the leftover .old is dropped
+    shutil.copytree(final, final + ".old")
+    assert [r["step"] for r in emgr.verify_dir(m.directory)] == [step]
+    assert not os.path.exists(final + ".old")
+
+    # the overwrite itself commits cleanly and leaves no residue
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=step, force=True)
+    assert m.steps() == [step]
+    assert not os.path.exists(final + ".old")
+    assert all(r["ok"] for r in m.verify())
+
+
+def test_rollback_forks_timeline(tmp_path):
+    """Rolling back to an earlier step forks the timeline: a plain
+    ``restore`` keeps the newer checkpoints for inspection but later
+    periodic saves OVERWRITE them as the new run's step counter
+    catches up (previously the colliding save died silently on the
+    writer thread), and ``recover``'s ``invalidate_newer`` deletes
+    them outright so a later crash can never resume from the
+    abandoned run."""
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr, keep=5,
+                          async_save=False)
+    for s in (1, 2, 3):
+        _gluon_steps(net, tr, 1, x, y)
+        m.save(step=s)
+    assert m.steps() == [1, 2, 3]
+    old_created = json.load(open(os.path.join(
+        emgr._step_dir(m.directory, 2), "manifest.json")))["created"]
+
+    # plain restore: newer dirs stay, but the new timeline's save at
+    # step 2 supersedes the abandoned one instead of raising
+    m.restore(step=1)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=2)
+    assert m.steps() == [1, 2, 3]
+    new_created = json.load(open(os.path.join(
+        emgr._step_dir(m.directory, 2), "manifest.json")))["created"]
+    assert new_created > old_created
+
+    # invalidate_newer (what recover() passes): abandoned dirs gone
+    m.restore(step=1, invalidate_newer=True)
+    assert m.steps() == [1]
+    # ... and the new timeline saves land with no collision at all
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=2)
+    assert m.steps() == [1, 2]
+
+
+def test_retention_prefers_new_timeline_after_rollback(tmp_path):
+    """Retention orders by COMMIT recency, not step number: after a
+    plain rollback restore, the new timeline's low-numbered saves are
+    newer commits than the abandoned high-numbered checkpoints — they
+    must survive the prune, and the abandoned steps age out."""
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr, keep=3,
+                          async_save=False)
+    for s in (10, 20, 30):
+        _gluon_steps(net, tr, 1, x, y)
+        m.save(step=s)
+    assert m.steps() == [10, 20, 30]
+
+    m.restore(step=10)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=11)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=12)
+    # the new timeline's saves survive; the oldest COMMITS (10, 20)
+    # were pruned, not the lowest step numbers (11, 12)
+    assert m.steps() == [11, 12, 30]
+    _gluon_steps(net, tr, 1, x, y)
+    m.save(step=13)
+    # one more save and the abandoned step-30 ages out entirely
+    assert m.steps() == [11, 12, 13]
+    m.close()
+
+
+def test_restore_rejects_shape_and_model_mismatch(tmp_path):
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=tr,
+                          async_save=False)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save()
+
+    other = nn.HybridSequential()
+    with other.name_scope():
+        other.add(nn.Dense(5, in_units=3))
+    other.initialize()
+    tr2 = Trainer(other.collect_params(), "adam",
+                  {"learning_rate": 0.01}, kvstore=None)
+    with pytest.raises(MXNetError, match="different model"):
+        m.restore(into=tr2)
+    with pytest.raises(MXNetError, match="no committed checkpoint"):
+        CheckpointManager(str(tmp_path / "empty"),
+                          trainer=tr).restore()
+
+
+def test_align_params_name_drift_positional():
+    payload = [("a_w", np.ones(2), "()"), ("a_b", np.zeros(2), "()")]
+    # same names: exact match, any order
+    out = emgr.align_params(["a_b", "a_w"], payload)
+    np.testing.assert_array_equal(out[0][0], np.zeros(2))
+    # drifted prefixes: positional (collect_params order is stable)
+    out = emgr.align_params(["b_w", "b_b"], payload)
+    np.testing.assert_array_equal(out[0][0], np.ones(2))
+    with pytest.raises(MXNetError, match="different model"):
+        emgr.align_params(["x", "y", "z"], payload)
+
+
+# ---------------------------------------------------------------------------
+# engine dispatch retry (transient-failure classification)
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_retry_absorbs_transient(monkeypatch):
+    from mxnet_tpu import engine, telemetry
+    monkeypatch.setenv("MXTPU_DISPATCH_RETRIES", "2")
+    monkeypatch.setenv("MXTPU_DISPATCH_BACKOFF_MS", "1")
+    telemetry.reset()
+    x = nd.array(np.ones(4, dtype="f4"))
+    faults.configure("dispatch:nth=1")
+    out = engine.invoke_compiled("el_retry", lambda a: a * 2.0, {},
+                                 x._data)
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, 2.0))
+    assert faults.fired() == ["dispatch:nth=1"]
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_dispatch_retries_total", 0) >= 1
+
+
+def test_dispatch_retry_disabled_by_default():
+    from mxnet_tpu import engine
+    x = nd.array(np.ones(4, dtype="f4"))
+    faults.configure("dispatch:nth=1")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        engine.invoke_compiled("el_retry0", lambda a: a * 2.0, {},
+                               x._data)
+    # the failure did not poison anything: the next dispatch works
+    out = engine.invoke_compiled("el_retry0", lambda a: a * 2.0, {},
+                                 x._data)
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, 2.0))
+
+
+def test_retry_never_reinvokes_after_donation(monkeypatch):
+    """A post-donation failure must NOT be retried even with retries
+    armed — the donated buffers are dead; re-invoking would read dead
+    memory.  The consumed-probe gates the retry."""
+    from mxnet_tpu import engine
+    monkeypatch.setenv("MXTPU_DISPATCH_RETRIES", "5")
+    monkeypatch.setenv("MXTPU_DISPATCH_BACKOFF_MS", "1")
+    x = nd.array(np.ones(4, dtype="f4"))
+    faults.configure("dispatch_post:nth=1")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        engine.invoke_compiled("el_retry_post",
+                               lambda a: a * 2.0, {}, x._data,
+                               donate=(0,))
+    # exactly one firing: no retry consumed a second arrival
+    assert faults.fired() == ["dispatch_post:nth=1"]
+    assert x._data.is_deleted()
+
+
+def test_retryable_error_classification():
+    from mxnet_tpu.engine import _retryable_error
+    assert _retryable_error(RuntimeError("socket reset"))
+    assert _retryable_error(OSError("tunnel down"))
+    assert _retryable_error(faults.FaultError("injected"))
+    assert not _retryable_error(TypeError("aval drift"))
+    assert not _retryable_error(ValueError("bad arity"))
+    assert not _retryable_error(MXNetError("our own diagnostic"))
+
+
+# ---------------------------------------------------------------------------
+# poison -> recover: gluon CompiledStep
+# ---------------------------------------------------------------------------
+
+
+def _compiled_step(seed=3, prefix=None):
+    from mxnet_tpu.gluon.compiled_step import CompiledStep
+    net = _mlp(seed=seed, prefix=prefix)
+    tr = Trainer(net.collect_params(), "adam",
+                 {"learning_rate": 0.01}, kvstore=None)
+    return net, CompiledStep(net, L2Loss(), tr)
+
+
+def test_compiled_step_poison_recover_parity(tmp_path):
+    x, y = _batch()
+    bs = x.shape[0]
+
+    # uninterrupted reference
+    net_a, cs_a = _compiled_step()
+    losses_a = [cs_a.step(x, y, bs).asnumpy() for _ in range(6)]
+
+    # faulted run: save @3, poison @4, recover, finish
+    net_b, cs_b = _compiled_step()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs_b,
+                          async_save=False)
+    losses_b = [cs_b.step(x, y, bs).asnumpy() for _ in range(3)]
+    m.save()
+    faults.configure("dispatch_post")
+    with pytest.raises(MXNetError, match="recover"):
+        cs_b.step(x, y, bs)
+    faults.clear()
+    # permanently-poisoned behavior is GONE only through recover():
+    # until then the latch still refuses to train on dead buffers
+    with pytest.raises(MXNetError, match="recover"):
+        cs_b.step(x, y, bs)
+    restored = cs_b.recover(m)
+    assert restored == 3
+    losses_b += [cs_b.step(x, y, bs).asnumpy() for _ in range(3)]
+
+    for la, lb in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(la, lb)
+    _assert_params_equal(_params_of(net_a), _params_of(net_b))
+
+
+def test_compiled_step_recover_emits_telemetry(tmp_path):
+    from mxnet_tpu import telemetry
+    x, y = _batch()
+    net, cs = _compiled_step()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    cs.step(x, y, x.shape[0])
+    m.save()
+    telemetry.reset()
+    cs.recover(m)                      # healthy recover: plain restore
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("mxtpu_recoveries_total") == 1
+    evs = telemetry.events("recovery")
+    assert evs and evs[-1]["where"] == "compiled_step"
+    assert telemetry.snapshot()["histograms"][
+        "mxtpu_recovery_seconds"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# poison -> recover: SPMD DataParallelTrainer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mesh8():
+    from conftest import needs_devices
+    needs_devices(8)
+    return parallel.make_mesh({"dp": 8})
+
+
+def _spmd(mesh, seed=7, fuse=True, prefix=None):
+    net = _mlp(seed=seed, prefix=prefix)
+    dpt = parallel.DataParallelTrainer(
+        net, L2Loss(), "adam", {"learning_rate": 0.01}, mesh=mesh,
+        fuse_step=fuse)
+    return net, dpt
+
+
+def test_spmd_poison_recover_parity(mesh8, tmp_path):
+    x, y = _batch()
+
+    mx.random.seed(11)
+    net_a, dpt_a = _spmd(mesh8)
+    losses_a = [dpt_a.step(x, y).asnumpy() for _ in range(6)]
+
+    mx.random.seed(11)
+    net_b, dpt_b = _spmd(mesh8)
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                          async_save=False)
+    losses_b = [dpt_b.step(x, y).asnumpy() for _ in range(3)]
+    m.save()
+    faults.configure("dispatch_post")
+    with pytest.raises(MXNetError, match="recover"):
+        dpt_b.step(x, y)
+    faults.clear()
+    assert dpt_b._donation_poisoned is not None
+    with pytest.raises(MXNetError, match="recover"):
+        dpt_b.step(x, y)               # still latched until recover()
+    dpt_b.recover(m)
+    assert dpt_b._donation_poisoned is None
+    losses_b += [dpt_b.step(x, y).asnumpy() for _ in range(3)]
+
+    for la, lb in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(la, lb)
+    _assert_params_equal(_params_of(net_a), _params_of(net_b))
+
+
+def test_spmd_pre_donation_failure_does_not_poison(mesh8, monkeypatch):
+    x, y = _batch()
+    net, dpt = _spmd(mesh8)
+    dpt.step(x, y)
+    # no retries armed: the pre-donation fault surfaces, but every
+    # buffer is alive — the trainer is NOT poisoned and trains on
+    faults.configure("dispatch")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        dpt.step(x, y)
+    assert dpt._donation_poisoned is None
+    loss = dpt.step(x, y)
+    assert np.isfinite(loss.asnumpy()).all()
+
+    # with retries armed the same fault is absorbed transparently
+    monkeypatch.setenv("MXTPU_DISPATCH_RETRIES", "2")
+    monkeypatch.setenv("MXTPU_DISPATCH_BACKOFF_MS", "1")
+    faults.configure("dispatch")
+    loss = dpt.step(x, y)
+    assert np.isfinite(loss.asnumpy()).all()
+    assert faults.fired() == ["dispatch"]
+
+
+def test_spmd_step_multi_poison_recover(mesh8, tmp_path):
+    x, y = _batch()
+    mx.random.seed(5)
+    net_a, dpt_a = _spmd(mesh8)
+    dpt_a.step_multi(x, y, repeat=2)
+    la = dpt_a.step_multi(x, y, repeat=2).asnumpy()
+
+    mx.random.seed(5)
+    net_b, dpt_b = _spmd(mesh8)
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                          async_save=False)
+    dpt_b.step_multi(x, y, repeat=2)
+    m.save()
+    faults.configure("dispatch_post")
+    with pytest.raises(MXNetError, match="recover"):
+        dpt_b.step_multi(x, y, repeat=2)
+    faults.clear()
+    dpt_b.recover(m)
+    lb = dpt_b.step_multi(x, y, repeat=2).asnumpy()
+    np.testing.assert_array_equal(la, lb)
+    _assert_params_equal(_params_of(net_a), _params_of(net_b))
+
+
+def test_spmd_compressed_residuals_roundtrip(mesh8, tmp_path):
+    """The 2-bit error-feedback residuals are checkpoint state: a
+    same-mesh restore reinstates them and recovery stays on the
+    uninterrupted trajectory (fused reductions: tiny float slack)."""
+    x, y = _batch()
+
+    def build(seed=7):
+        net = _mlp(seed=seed)
+        return net, parallel.DataParallelTrainer(
+            net, L2Loss(), "sgd", {"learning_rate": 0.05},
+            mesh=mesh8, fuse_step=True,
+            compression={"type": "2bit", "threshold": 0.5})
+
+    mx.random.seed(21)
+    net_a, dpt_a = build()
+    for _ in range(6):
+        loss_a = dpt_a.step(x, y)
+
+    mx.random.seed(21)
+    net_b, dpt_b = build()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                          async_save=False)
+    for _ in range(3):
+        dpt_b.step(x, y)
+    assert dpt_b._residual_vals          # error feedback is live state
+    m.save()
+    faults.configure("dispatch_post")
+    with pytest.raises(MXNetError, match="recover"):
+        dpt_b.step(x, y)
+    faults.clear()
+    dpt_b.recover(m)
+    assert dpt_b._residual_vals is not None
+    for _ in range(3):
+        loss_b = dpt_b.step(x, y)
+    np.testing.assert_allclose(loss_a.asnumpy(), loss_b.asnumpy(),
+                               rtol=0, atol=1e-6)
+    pa, pb = _params_of(net_a), _params_of(net_b)
+    for ka, kb in zip(sorted(pa), sorted(pb)):
+        np.testing.assert_allclose(pa[ka], pb[kb], rtol=2e-6,
+                                   atol=1e-6, err_msg=f"{ka} vs {kb}")
+
+
+# ---------------------------------------------------------------------------
+# mesh-change restore (arXiv:2112.01075 — reshard on restore)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_change_restore_exact(mesh8, tmp_path):
+    """An 8-device dp checkpoint restores onto 4 and 1 devices with
+    exact fp32 param/optimizer-state equality, then trains on."""
+    x, y = _batch()
+    net_a, dpt_a = _spmd(mesh8)
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_a,
+                          async_save=False)
+    for _ in range(4):
+        dpt_a.step(x, y)
+    m.save()
+    want_params = _params_of(net_a)
+
+    def _state_leaves(dpt):
+        out = []
+        for i in dpt._tr_idx:
+            leaves = []
+            from mxnet_tpu.parallel.trainer import _flatten
+            _flatten(dpt._states[i], leaves)
+            out.append([np.asarray(l._data) for l in leaves])
+        return out
+
+    want_states = _state_leaves(dpt_a)
+    want_nu = dpt_a.optimizer.num_update
+
+    for ndev in (4, 1):
+        mesh_t = parallel.make_mesh({"dp": ndev})
+        net_b, dpt_b = _spmd(mesh_t, seed=99)    # different init
+        mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                                async_save=False)
+        assert mgr.restore() == 4
+        _assert_params_equal(want_params, _params_of(net_b))
+        got_states = _state_leaves(dpt_b)
+        for wl, gl in zip(want_states, got_states):
+            for w, g in zip(wl, gl):
+                np.testing.assert_array_equal(w, g)
+        assert dpt_b.optimizer.num_update == want_nu
+        loss = dpt_b.step(x, y)                  # trains on new mesh
+        assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_restore_before_first_batch(mesh8, tmp_path):
+    """A fresh process restores BEFORE any step ran (explicit input
+    sizes resolve shapes batch-free); deferred shapes raise clearly."""
+    x, y = _batch()
+    net_a, dpt_a = _spmd(mesh8)
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_a,
+                          async_save=False)
+    dpt_a.step(x, y)
+    m.save()
+
+    net_b, dpt_b = _spmd(parallel.make_mesh({"dp": 4}), seed=99)
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                            async_save=False)
+    mgr.restore()                                # no step yet
+    _assert_params_equal(_params_of(net_a), _params_of(net_b))
+
+    deferred = nn.HybridSequential()
+    with deferred.name_scope():
+        deferred.add(nn.Dense(4))                # no in_units
+    deferred.initialize()
+    dpt_c = parallel.DataParallelTrainer(
+        deferred, L2Loss(), "adam", {"learning_rate": 0.01},
+        mesh=parallel.make_mesh({"dp": 4}), fuse_step=True)
+    with pytest.raises(MXNetError, match="deferred"):
+        mgr.restore(into=dpt_c)
+
+
+def test_redistribute_live_exact(mesh8):
+    """Both legs of ``reshard.redistribute`` (the live -> live move
+    ``_shard_params`` routes through) are fp32-exact: the one-program
+    same-device-set path (replicated <-> dp-sharded on the 8-device
+    mesh) and the cross-device-set ``device_put`` path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.elastic import reshard
+
+    rng = np.random.RandomState(3)
+    host = [rng.randn(8, 4).astype("float32"),
+            rng.randn(16).astype("float32")]
+    repl = NamedSharding(mesh8, P())
+    dp = NamedSharding(mesh8, P("dp"))
+
+    live = [jax.device_put(h, repl) for h in host]
+    moved = reshard.redistribute(live, [dp, dp])   # same device set
+    for m_, h in zip(moved, host):
+        assert m_.sharding.spec == P("dp")
+        np.testing.assert_array_equal(np.asarray(m_), h)
+    back = reshard.redistribute(moved, [repl, repl])
+    for b, h in zip(back, host):
+        assert b.sharding.spec == P()
+        np.testing.assert_array_equal(np.asarray(b), h)
+
+    # cross-device-set leg: single-device source onto the mesh layout
+    one = jax.device_put(host[0], jax.devices("cpu")[0])
+    out, = reshard.redistribute([one], [dp])
+    assert out.sharding.spec == P("dp")
+    np.testing.assert_array_equal(np.asarray(out), host[0])
+
+    assert reshard.redistribute([], []) == []
+
+
+def test_reshard_plan_and_spec_strings():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.elastic import reshard
+
+    assert reshard.spec_from_str("()") == P()
+    assert reshard.spec_from_str("PartitionSpec('dp',)") == P("dp")
+    assert reshard.spec_from_str("('dp', None)") == P("dp", None)
+    assert reshard.spec_to_str(P("dp", None)) == "('dp', None)"
+    # tuple entry: one dim sharded over SEVERAL mesh axes
+    assert reshard.spec_from_str("(('dp', 'tp'), None)") == \
+        P(("dp", "tp"), None)
+    assert reshard.spec_from_str(
+        reshard.spec_to_str(P(("dp", "tp")))) == P(("dp", "tp"))
+    with pytest.raises(MXNetError, match="unparseable"):
+        reshard.spec_from_str("nonsense")
+    with pytest.raises(MXNetError, match="unparseable"):
+        reshard.spec_from_str("(1, 2)")
+
+    # sharded dim shrinking 8 -> 4: gather then re-slice
+    steps = reshard.plan((16, 4), P("dp"), {"dp": 8}, P("dp"),
+                         {"dp": 4})
+    assert steps == ["all_gather(dim=0, dp:8)", "slice(dim=0, dp:4)"]
+    # replicated -> replicated across a size change: pure re-placement
+    steps = reshard.plan((16, 4), P(), {"dp": 8}, P(), {"dp": 4})
+    assert steps == ["replicate(dp:4)"]
+    # identical layout: no-op
+    assert reshard.plan((16, 4), P("dp"), {"dp": 8}, P("dp"),
+                        {"dp": 8}) == []
+
+
+# ---------------------------------------------------------------------------
+# mxckpt CLI
+# ---------------------------------------------------------------------------
+
+
+def test_mxckpt_cli(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import mxckpt
+
+    d = str(tmp_path / "ck")
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(d, trainer=tr, keep=10, async_save=False)
+    for _ in range(3):
+        _gluon_steps(net, tr, 1, x, y)
+        m.save()
+    os.makedirs(os.path.join(d, ".tmp-step-00000042-1"))
+
+    assert mxckpt.main(["--dir", d, "ls"]) == 0
+    out = capsys.readouterr().out
+    assert "3 checkpoint(s)" in out and "1 torn" in out
+
+    assert mxckpt.main(["--dir", d, "verify"]) == 0
+    capsys.readouterr()
+
+    # shard-hash mismatch -> exit 1
+    shard = glob.glob(os.path.join(d, "step-*", "shards", "*.npy"))[0]
+    with open(shard, "wb") as f:
+        f.write(b"junk")
+    assert mxckpt.main(["--dir", d, "verify"]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+
+    assert mxckpt.main(["--dir", d, "--format", "json",
+                        "verify"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["corrupt"] == 1 and payload["torn"] == 1
+
+    assert mxckpt.main(["--dir", d, "prune", "--keep", "1"]) == 0
+    capsys.readouterr()
+    assert len(emgr.ls_dir(d)) == 1            # torn dir removed too
+
+    assert mxckpt.main(["--dir", d, "prune", "--all"]) == 0
+    assert emgr.ls_dir(d) == []
+
+
+# ---------------------------------------------------------------------------
+# lint: MXL501 (source + runtime) / MXL502
+# ---------------------------------------------------------------------------
+
+
+def test_mxl501_source_pass():
+    from mxnet_tpu.analysis import analyze_source
+
+    fire = """
+for epoch in range(10):
+    for b in range(50):
+        trainer.step(x, y)
+"""
+    assert [f.rule for f in analyze_source(fire)] == ["MXL501"]
+    unbounded = "while True:\n    dpt.step(x, y)\n"
+    assert any(f.rule == "MXL501" for f in analyze_source(unbounded))
+    # statically small, unknown bounds, a manager in scope, or a
+    # suppression comment: all quiet
+    assert not analyze_source("for i in range(20):\n"
+                              "    trainer.step(x, y)\n")
+    assert not analyze_source("for b in loader:\n"
+                              "    trainer.step(x, y)\n")
+    # gym-convention RL rollout: not a training loop
+    assert not analyze_source("for t in range(500):\n"
+                              "    obs, r = env.step(action)\n")
+    assert not analyze_source(
+        "m = CheckpointManager(d)\n"
+        "for i in range(500):\n    dpt.step(x, y)\n")
+    assert not analyze_source(
+        "for i in range(500):\n"
+        "    dpt.step(x, y)  # mxlint: disable=MXL501\n")
+    # step_multi's constant repeat=K multiplies the count
+    multi = "for i in range(20):\n" \
+            "    dpt.step_multi(x, y, repeat=8)\n"
+    assert any(f.rule == "MXL501" for f in analyze_source(multi))
+
+
+def test_mxl502_runtime_pass(tmp_path, monkeypatch):
+    from mxnet_tpu.analysis import analyze_elasticity
+
+    d = str(tmp_path / "ck")
+    x, y = _batch()
+    net, tr = _gluon_trainer()
+    m = CheckpointManager(d, trainer=tr, async_save=False)
+    _gluon_steps(net, tr, 1, x, y)
+    m.save()
+    monkeypatch.setenv("MXTPU_CHECKPOINT_DIR", d)
+    assert not [f for f in analyze_elasticity()
+                if f.location.startswith(f"ckpt:{d}")]
+
+    shard = glob.glob(os.path.join(d, "step-*", "shards", "*.npy"))[0]
+    with open(shard, "wb") as f:
+        f.write(b"junk")
+    bad = [f for f in analyze_elasticity() if f.rule == "MXL502"
+           and f.location.startswith("ckpt:" + d)]
+    assert bad and bad[0].severity == "error"
+
+    os.makedirs(os.path.join(d, ".tmp-step-00000099-1"))
+    torn = [f for f in analyze_elasticity() if f.rule == "MXL502"
+            and "torn" in f.message]
+    assert torn and torn[0].severity == "warning"

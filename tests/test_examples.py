@@ -64,6 +64,7 @@ def test_distributed_training_two_workers():
     assert res.stdout.count("final loss") == 2
 
 
+@pytest.mark.slow
 def test_word_lm_smoke():
     res = _run([os.path.join("example", "word_lm.py"), "--steps", "40"])
     assert res.returncode == 0
@@ -77,6 +78,7 @@ def test_dcgan_smoke():
     assert "images/sec" in res.stdout
 
 
+@pytest.mark.slow
 def test_ssd_train_smoke():
     res = _run([os.path.join("example", "ssd_train.py"),
                 "--steps", "12", "--batch-size", "4"])
@@ -105,6 +107,7 @@ def test_actor_critic_smoke():
     assert "avg reward" in res.stdout
 
 
+@pytest.mark.slow
 def test_int8_inference_smoke():
     res = _run([os.path.join("example", "int8_inference.py"),
                 "--train-steps", "24"], timeout=420)

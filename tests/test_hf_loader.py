@@ -296,6 +296,7 @@ class TestBertHF:
         write_safetensors(pp, tensors)
         load_hf_bert(self._bert(), pp)
 
+    @pytest.mark.slow
     def test_cross_implementation_parity_vs_transformers(self,
                                                          tmp_path):
         """THE external anchor: our BERT forward vs HuggingFace

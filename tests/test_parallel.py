@@ -149,7 +149,7 @@ def test_quantized_psum_accuracy_and_grad():
     exact psum; straight-through gradient equals the psum vjp."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
     from mxnet_tpu import parallel
     sm = shard_map
@@ -430,7 +430,7 @@ class TestGradientCompressionInTrainer:
         checked in the lowered program, not inferred from numerics."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import collectives
 
@@ -594,7 +594,7 @@ class TestVocabParallelCE:
     def test_matches_single_device_and_grads(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import collectives
 
@@ -636,7 +636,7 @@ class TestVocabParallelCE:
         full-softmax reference — values AND grads (dH, dW)."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.ops.nn import chunked_softmax_ce
         from mxnet_tpu.parallel import collectives
@@ -697,7 +697,7 @@ class TestVocabParallelCE:
         sharded alongside the vocab rows}."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.ops.nn import chunked_softmax_ce_bias
 
@@ -778,7 +778,7 @@ class TestVocabParallelCE:
         (N, V/tp) tensor in the lowered HLO — only (N, chunk) slabs."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.ops.nn import chunked_softmax_ce
 
@@ -805,7 +805,7 @@ class TestVocabParallelCE:
         the whole point of the vocab split."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import collectives
 
@@ -833,7 +833,7 @@ class TestShardedWeightUpdate:
     def _run(self, n_params_shape, dp=4, steps=3):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import collectives as C
 
@@ -908,7 +908,7 @@ class TestShardedWeightUpdate:
         import jax
         import jax.numpy as jnp
         import re
-        from jax import shard_map
+        from mxnet_tpu.parallel._compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mxnet_tpu.parallel import collectives as C
 

@@ -64,6 +64,25 @@ class Test1F1B:
             np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
                                        rtol=1e-4, atol=1e-5)
 
+    def test_falsy_grad_reduce_axes_is_pp_only(self):
+        """A pp-only model passes its tp_axis=None straight through
+        (llama_spmd.train_step does): falsy entries must be filtered,
+        not crash, and the result must match the plain pp call."""
+        from mxnet_tpu import parallel
+        from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad
+        params, X, Y, stage, loss_fn = self._setup(n=4, m=4)
+        mesh = parallel.make_mesh({"pp": 4})
+        loss, grads = pipeline_value_and_grad(
+            stage, params, X, Y, loss_fn, n_microbatches=4, mesh=mesh,
+            grad_reduce_axes=(None,))
+        ref_loss, ref_grads = pipeline_value_and_grad(
+            stage, params, X, Y, loss_fn, n_microbatches=4, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(loss),
+                                      np.asarray(ref_loss))
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_array_equal(np.asarray(g),
+                                          np.asarray(rg))
+
     def test_more_microbatches_than_stages(self):
         from mxnet_tpu import parallel
         from mxnet_tpu.parallel.pipeline import pipeline_value_and_grad

@@ -82,6 +82,7 @@ class TestPCK:
 
 
 class TestConvergence:
+    @pytest.mark.slow
     def test_learns_square_corners(self):
         np.random.seed(0)
         mx.random.seed(0)

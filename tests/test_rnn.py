@@ -185,6 +185,7 @@ class TestLayers:
         w = layer.collect_params()[layer.prefix + "l0_i2h_weight"]
         assert np.abs(w.grad().asnumpy()).sum() > 0
 
+    @pytest.mark.slow
     def test_layer_trains(self):
         """An LSTM regressor learns a simple sum-over-time target."""
         from mxnet_tpu.gluon import nn, Trainer, loss as gloss

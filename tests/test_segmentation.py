@@ -130,6 +130,7 @@ class TestUpSampling:
 
 
 class TestConvergence:
+    @pytest.mark.slow
     def test_fcn_learns_blobs(self):
         np.random.seed(0)
         mx.random.seed(0)

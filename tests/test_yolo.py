@@ -130,6 +130,7 @@ class TestDecode:
 
 
 class TestConvergence:
+    @pytest.mark.slow
     def test_learns_bright_square(self):
         np.random.seed(0)
         mx.random.seed(0)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""mxckpt: inspect and maintain elastic checkpoint directories.
+
+A checkpoint dir (``MXTPU_CHECKPOINT_DIR``, or ``--dir``) holds the
+committed ``step-N/`` dirs an ``elastic.CheckpointManager`` writes —
+one hashed ``.npy`` shard per tensor plus a ``manifest.json`` — and,
+after a crash mid-write, torn ``.tmp-step-N-pid/`` dirs the atomic
+commit never renamed (docs/elasticity.md).  Subcommands:
+
+    python tools/mxckpt.py ls                # one row per checkpoint
+    python tools/mxckpt.py verify            # CI gate: exit 1 on
+                                             # shard-hash mismatch
+    python tools/mxckpt.py prune --keep 3    # drop old steps + every
+                                             # torn temp dir
+
+``verify`` re-reads every shard and checks its sha256 against the
+manifest — exactly what ``CheckpointManager.restore`` enforces, so a
+checkpoint that verifies here restores there.  It is also wired into
+``tools/mxlint.py --self-check`` (rule MXL502), so a corrupt
+checkpoint volume fails CI loudly instead of surfacing as a refused
+restore during the next incident.  Torn temp dirs report but do not
+fail ``verify`` (they are crash artifacts the commit protocol already
+kept out of the committed set); ``prune`` removes them.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _dir_of(args) -> str:
+    if args.dir:
+        return args.dir
+    from mxnet_tpu import envs
+    d = envs.get("MXTPU_CHECKPOINT_DIR")
+    if not d:
+        print("mxckpt: no checkpoint dir (set MXTPU_CHECKPOINT_DIR or "
+              "pass --dir)", file=sys.stderr)
+        sys.exit(2)
+    return d
+
+
+def cmd_ls(args) -> int:
+    from mxnet_tpu.elastic import manager
+    d = _dir_of(args)
+    rows = manager.ls_dir(d)
+    if args.fmt == "json":
+        print(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        print(f"{d}: empty")
+        return 0
+    now = time.time()
+    print(f"{'STEP':>8} {'SHARDS':>6} {'BYTES':>12} {'TRAINER':8} "
+          f"{'OPTIMIZER':12} {'MESH':16} {'AGE':>8}  PATH")
+    for r in rows:
+        if r.get("partial"):
+            print(f"{'<TORN>':>8} {'-':>6} {'-':>12} {'-':8} {'-':12} "
+                  f"{'-':16} {'-':>8}  {r['path']}  ({r.get('error')})")
+            continue
+        if not r.get("ok"):
+            print(f"{r['step']:>8} {'-':>6} {'-':>12} {'-':8} {'-':12} "
+                  f"{'-':16} {'-':>8}  {r['path']}  "
+                  f"(CORRUPT: {r.get('error')})")
+            continue
+        age = now - (r.get("created") or now)
+        age_s = f"{age / 3600:.1f}h" if age > 3600 else f"{age:.0f}s"
+        mesh = r.get("mesh")
+        mesh_s = "x".join(f"{k}:{v}" for k, v in mesh.items()) \
+            if mesh else "-"
+        print(f"{r['step']:>8} {r['shards']:>6} {r['bytes']:>12} "
+              f"{str(r.get('trainer')):8} "
+              f"{str(r.get('optimizer'))[:12]:12} {mesh_s:16} "
+              f"{age_s:>8}  {r['path']}")
+    n_torn = sum(1 for r in rows if r.get("partial"))
+    n_bad = sum(1 for r in rows if not r.get("partial")
+                and not r.get("ok"))
+    print(f"-- {len(rows) - n_torn} checkpoint(s), {n_bad} corrupt, "
+          f"{n_torn} torn temp dir(s) in {d}")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    from mxnet_tpu.elastic import manager
+    d = _dir_of(args)
+    rows = manager.verify_dir(d, step=args.step)
+    bad = [r for r in rows if not r["ok"] and not r.get("partial")]
+    torn = [r for r in rows if r.get("partial")]
+    if args.fmt == "json":
+        print(json.dumps({"entries": rows, "corrupt": len(bad),
+                          "torn": len(torn)}, indent=2))
+    else:
+        for r in bad:
+            print(f"CORRUPT step {r['step']} {r['path']}: "
+                  f"{'; '.join(r['errors'])}")
+        for r in torn:
+            print(f"torn    {r['path']} (uncommitted write; "
+                  "prune removes it)")
+        for r in rows:
+            if r["ok"]:
+                print(f"ok      step {r['step']} {r['path']}")
+        print(f"mxckpt verify: {len(rows) - len(torn)} checkpoint(s), "
+              f"{len(bad)} corrupt, {len(torn)} torn in {d}")
+    return 1 if bad else 0
+
+
+def cmd_prune(args) -> int:
+    from mxnet_tpu.elastic import manager
+    d = _dir_of(args)
+    if args.keep is None:
+        from mxnet_tpu import envs
+        args.keep = int(envs.get("MXTPU_CHECKPOINT_KEEP"))
+    n = manager.prune_dir(d, 0 if args.all else args.keep)
+    what = "all checkpoints + torn temp dirs" if args.all else \
+        f"beyond the newest {args.keep} (+ torn temp dirs)"
+    print(f"mxckpt: removed {n} dir(s) ({what}) in {d}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxckpt", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default="",
+                    help="checkpoint directory (default: "
+                    "MXTPU_CHECKPOINT_DIR)")
+    ap.add_argument("--format", choices=["text", "json"],
+                    default="text", dest="fmt")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("ls", help="list committed checkpoints + torn "
+                   "temp dirs")
+    p = sub.add_parser("verify",
+                       help="re-hash every shard; exit 1 on mismatch")
+    p.add_argument("--step", type=int, default=None,
+                   help="verify one step only (default: all)")
+    p = sub.add_parser("prune", help="drop old checkpoints and torn "
+                       "temp dirs")
+    p.add_argument("--keep", type=int, default=None,
+                   help="committed steps to retain (default: "
+                   "MXTPU_CHECKPOINT_KEEP)")
+    p.add_argument("--all", action="store_true",
+                   help="remove every checkpoint")
+    args = ap.parse_args(argv)
+    return {"ls": cmd_ls, "verify": cmd_verify,
+            "prune": cmd_prune}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,12 +14,14 @@ Pass families (rules documented in docs/static_analysis.md):
   eager fallbacks (MXL305, ``analyze_compiled_steps``), the
   telemetry plane's hazards (``analyze_telemetry``: MXL306
   post-warm-up retraces with the attributed cause, MXL307 prefetch
-  stall ratio), and the memory observatory's (``analyze_memory``:
+  stall ratio), the memory observatory's (``analyze_memory``:
   MXL308 large updated buffer outside the donate tuple, MXL309
-  large tensor replicated across a multi-device mesh), when run
-  in-process after a workload.  ``--self-check`` includes
-  ``analyze_telemetry``/``analyze_memory`` (free in a fresh
-  process; surface findings when a workload ran first).
+  large tensor replicated across a multi-device mesh), and the
+  elastic plane's (``analyze_elasticity``: MXL501 long run with no
+  CheckpointManager, MXL502 corrupt/torn checkpoint — the CI face
+  of ``tools/mxckpt.py verify``), when run in-process after a
+  workload.  ``--self-check`` includes all of them (free in a
+  fresh process; surface findings when a workload ran first).
 
 Usage:
 
@@ -98,6 +100,11 @@ def main(argv=None) -> int:
         # memory-observatory pass (MXL308/309): free in a fresh CLI
         # process, load-bearing after an in-process workload
         findings.extend(analysis.analyze_memory())
+        # elasticity pass (MXL501 runtime form / MXL502, the CI face
+        # of tools/mxckpt.py verify): free in a fresh CLI process
+        # unless MXTPU_CHECKPOINT_DIR points at a checkpoint volume,
+        # which then gets a full integrity sweep
+        findings.extend(analysis.analyze_elasticity())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
