@@ -812,6 +812,60 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
             if mgr is not None:
                 mgr.close()
             _sh.rmtree(ckdir, ignore_errors=True)
+
+        # training-health plane cost (docs/observability.md): the same
+        # steady loop with the in-graph stats + K=10 sampling vs. the
+        # plane compiled OUT entirely.  Each config retraces once on
+        # the flip (warm-up) and is timed over the best of 3 repeats
+        # so CPU scheduling noise doesn't fake a regression; the
+        # target is <1% at the default K=10.
+        health_every = 10
+        hloops, hreps = max(steps, 100), 3
+
+        def _timed_loop():
+            best = float("inf")
+            for _ in range(hreps):
+                t0 = time.perf_counter()
+                for _ in range(hloops):
+                    hl = cs.step(x, y, batch_size)
+                hl.wait_to_read()
+                mx.nd.waitall()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        henv = {k: os.environ.get(k)
+                for k in ("MXTPU_HEALTH", "MXTPU_HEALTH_EVERY")}
+        try:
+            os.environ["MXTPU_HEALTH"] = "0"
+            for _ in range(3):
+                cs.step(x, y, batch_size)
+            mx.nd.waitall()
+            dt_off = _timed_loop()
+            os.environ["MXTPU_HEALTH"] = "1"
+            os.environ["MXTPU_HEALTH_EVERY"] = str(health_every)
+            for _ in range(3):
+                cs.step(x, y, batch_size)
+            mx.nd.waitall()
+            dt_on = _timed_loop()
+        finally:
+            for k, v in henv.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        hrep = telemetry.health.report()
+        howner = next(iter((hrep.get("owners") or {}).values()), {})
+        hist = howner.get("history") or []
+        tblock["health"] = {
+            "sampling_every": health_every,
+            "steps_timed": hloops,
+            "overhead_ratio": round(max(0.0, dt_on / dt_off - 1.0), 4),
+            "target_ratio": 0.01,
+            "samples": howner.get("samples", 0),
+            "anomalies": len(howner.get("anomalies") or []),
+            "last_sample": hist[-1] if hist else None,
+            "last_verdict": howner.get("last_verdict"),
+        }
     return batch_size * steps / dt, opt_dispatches, train_dispatches, \
         tblock
 

@@ -22,7 +22,8 @@ from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_telemetry, analyze_compile_cache,
-                      analyze_memory, analyze_elasticity)
+                      analyze_memory, analyze_elasticity,
+                      analyze_health)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -33,6 +34,7 @@ __all__ = [
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
     "analyze_compile_cache", "analyze_memory", "analyze_elasticity",
+    "analyze_health",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -66,5 +68,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # process; after an in-process workload it surfaces long
     # unprotected runs and corrupt/torn checkpoints this process wrote
     findings.extend(analyze_elasticity())
+    # training-health pass (MXL312, the runtime sibling of MXL311):
+    # quiet in a fresh process; after an in-process workload it
+    # surfaces recorded numerics anomalies and the last verdict
+    findings.extend(analyze_health())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

@@ -71,6 +71,12 @@ RULES = {
     "MXL309": (Severity.WARNING,
                "large tensor fully replicated across a multi-device "
                "mesh"),
+    "MXL311": (Severity.WARNING,
+               "per-step host scalar read of the loss/metric in a "
+               "training loop (use the sampled health plane)"),
+    "MXL312": (Severity.WARNING,
+               "training-health anomalies recorded in this process "
+               "(divergence risk; runtime sibling of MXL311)"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
     "MXL402": (Severity.ERROR,

@@ -25,7 +25,7 @@ from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
            "analyze_telemetry", "analyze_compile_cache",
-           "analyze_memory", "analyze_elasticity"]
+           "analyze_memory", "analyze_elasticity", "analyze_health"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -225,6 +225,43 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
                     "thinner than configured; keep more steps or "
                     "delete the corrupt dir",
                     f"ckpt:{row['path']}"))
+    return findings
+
+
+def analyze_health() -> List[Finding]:
+    """MXL312 — the runtime sibling of the MXL311 source rule
+    (docs/observability.md, Training health).
+
+    Reads the health plane's per-owner sentinels: an owner whose run
+    recorded anomalies (nonfinite gradients, loss spikes, grad-norm
+    explosions, update-ratio collapse) gets one WARNING finding
+    carrying the anomaly census and the last verdict, so a CI
+    ``--self-check`` run AFTER an in-process workload fails visibly
+    instead of letting a diverging configuration land.  Free in a
+    fresh process (no sentinels — the CI gate stays quiet).
+    """
+    from ..telemetry import health as _health
+    findings: List[Finding] = []
+    for where, sent in sorted(_health.sentinels().items()):
+        snap = sent.snapshot()
+        anomalies = snap.get("anomalies") or []
+        if not anomalies:
+            continue
+        kinds = {}
+        for a in anomalies:
+            kinds[a.get("anomaly")] = kinds.get(a.get("anomaly"), 0) + 1
+        census = ", ".join(f"{k} x{v}" for k, v in sorted(kinds.items()))
+        v = snap.get("last_verdict")
+        verdict = f"; last verdict: {v['kind']} at step " \
+                  f"{v.get('step')}" if v else ""
+        findings.append(Finding(
+            "MXL312",
+            f"{where}: {len(anomalies)} training-health anomalies "
+            f"over {snap.get('samples', 0)} samples ({census})"
+            f"{verdict} — the run's numerics are suspect; see the "
+            "health_anomaly events (tools/mxhealth.py) and consider "
+            "MXTPU_HEALTH_ACTION=skip|rollback",
+            f"health:{where}"))
     return findings
 
 

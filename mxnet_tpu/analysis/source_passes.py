@@ -19,6 +19,16 @@ Heuristics are deliberately scoped to keep the signal high:
   references a name the loop itself changes — the jit cache keys on the
   value, so each step compiles a fresh executable.  The fix is usually
   declaring the attr in ``scalar_attrs``.
+* MXL311 specializes MXL301 for the most common offender: a per-step
+  host scalar read of the LOSS or a metric (``loss.item()``,
+  ``float(loss)``, ``loss.asnumpy()``, ``metric.get()``-feeding reads)
+  inside a detected train loop.  Beyond the per-step device sync, the
+  read is redundant — the training-health plane already computes the
+  loss (plus grad/update norms and nonfinite counts) INSIDE the
+  compiled step and samples it every ``MXTPU_HEALTH_EVERY`` steps
+  (``telemetry.health``, docs/observability.md).  When the receiver
+  names a loss/metric the finding is MXL311 (with the pointer),
+  otherwise MXL301 as before.
 * MXL304 fires for a classic per-op training loop —
   ``autograd.record()`` + ``.backward()`` + ``.step()`` in one loop
   body — in a module that never touches step compilation
@@ -55,6 +65,10 @@ __all__ = ["analyze_source", "analyze_file", "analyze_paths"]
 
 _SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read", "item", "tolist"}
 _CAST_BUILTINS = {"float", "int", "bool"}
+# receivers that look like a loss/metric value: the MXL311
+# specialization (per-step scalarization of the training signal the
+# sampled health plane already provides)
+_LOSS_NAME_RE = re.compile(r"loss|metric|perplexity", re.I)
 _OP_NAMESPACES = {"nd", "F", "sym", "ndarray", "symbol"}
 _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([A-Z0-9,\s]+))?")
 # any of these names in a module means the author already uses step
@@ -88,6 +102,18 @@ def _is_sync_call(call: ast.Call) -> Optional[str]:
             call.func.attr in _SYNC_METHODS:
         return f".{call.func.attr}()"
     return None
+
+
+def _names_loss(node) -> bool:
+    """Does this expression reference a name/attribute that reads like
+    a loss or metric value?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and _LOSS_NAME_RE.search(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and \
+                _LOSS_NAME_RE.search(n.attr):
+            return True
+    return False
 
 
 def _is_cast_sync(call: ast.Call) -> Optional[str]:
@@ -276,20 +302,47 @@ class _SourceVisitor(ast.NodeVisitor):
                     "retraces the hybridized graph; compute on-device and "
                     "sync outside the block", self._loc(node)))
             elif self._in_training_loop():
-                self.findings.append(Finding(
-                    "MXL301", f"{sync} inside a training loop forces a "
-                    "host sync every step; accumulate on-device and sync "
-                    "once per epoch/log interval", self._loc(node)))
+                if _names_loss(node.func.value):
+                    # MXL311 specializes MXL301: the receiver is the
+                    # loss/metric itself, and the health plane already
+                    # carries that signal out of the compiled step
+                    self.findings.append(Finding(
+                        "MXL311", f"{sync} reads the loss/metric to "
+                        "the host EVERY step: a per-step device sync, "
+                        "and redundant — the training-health plane "
+                        "computes loss/grad-norm/nonfinite stats "
+                        "inside the compiled step and samples them "
+                        "every MXTPU_HEALTH_EVERY steps "
+                        "(telemetry.health, docs/observability.md); "
+                        "drop the read or consume the sampled plane",
+                        self._loc(node)))
+                else:
+                    self.findings.append(Finding(
+                        "MXL301", f"{sync} inside a training loop "
+                        "forces a host sync every step; accumulate "
+                        "on-device and sync once per epoch/log "
+                        "interval", self._loc(node)))
         elif self._in_training_loop():
             # cast-syncs are only flagged in training loops; inside
             # hybrid_forward int()/float() legitimately fold shapes and
             # would be all noise
             cast = _is_cast_sync(node)
             if cast is not None:
-                self.findings.append(Finding(
-                    "MXL301", f"{cast} on an array inside a training loop "
-                    "is an implicit device sync (host scalar "
-                    "conversion)", self._loc(node)))
+                if _names_loss(node.args[0]):
+                    self.findings.append(Finding(
+                        "MXL311", f"{cast} converts the loss/metric "
+                        "to a host scalar EVERY step: a per-step "
+                        "device sync, and redundant — the training-"
+                        "health plane computes loss/grad-norm/"
+                        "nonfinite stats inside the compiled step and "
+                        "samples them every MXTPU_HEALTH_EVERY steps "
+                        "(telemetry.health, docs/observability.md)",
+                        self._loc(node)))
+                else:
+                    self.findings.append(Finding(
+                        "MXL301", f"{cast} on an array inside a "
+                        "training loop is an implicit device sync "
+                        "(host scalar conversion)", self._loc(node)))
 
         if self._loops:
             self._check_per_step_attrs(node)

@@ -29,6 +29,10 @@ shims):
   writes and before the commit rename: the temp dir must be left
   uncommitted and the previous checkpoint must stay authoritative.
 * ``host_copy`` — the device->host copy of the checkpoint snapshot.
+* ``nonfinite_grad`` — corrupts instead of crashing: the step plants a
+  NaN in its input batch, so the compiled program produces a nonfinite
+  loss/gradients and the health plane's detection, skip gate, and
+  rollback paths are exercised (docs/observability.md).
 
 Qualifiers: ``nth=N`` fires on the Nth arrival at the point (1-based,
 default 1); ``step=N`` fires on the first arrival at or after global
@@ -47,11 +51,12 @@ from typing import Dict, List, Optional
 
 __all__ = ["FaultError", "FaultSpec", "configure", "configure_from_env",
            "clear", "active", "fired", "maybe_fire", "on_dispatch",
-           "POINTS"]
+           "nonfinite_due", "POINTS"]
 
 #: the injection points wired into the runtime (unknown points parse —
 #: forward compatibility — but are reported by :func:`configure`)
-POINTS = ("dispatch", "dispatch_post", "checkpoint_write", "host_copy")
+POINTS = ("dispatch", "dispatch_post", "checkpoint_write", "host_copy",
+          "nonfinite_grad")
 
 
 class FaultError(RuntimeError):
@@ -238,6 +243,32 @@ def maybe_fire(point: str, **info):
     spec = _check(point)
     if spec is not None:
         _raise(spec, point, **info)
+
+
+def nonfinite_due(op: str = "") -> bool:
+    """The ``nonfinite_grad`` point: unlike the raising points, this
+    fault CORRUPTS rather than crashes — when a spec is due the step
+    stacks plant a NaN in the input batch (``telemetry.health.
+    poison_inputs``), which propagates to a nonfinite loss and
+    gradients inside the unchanged compiled program (same shapes, no
+    retrace).  The drill that proves the health plane's nonfinite
+    detection, skip gate, and rollback end to end.  Returns True when
+    the step should poison its inputs."""
+    if not _active:
+        return False
+    spec = _check("nonfinite_grad")
+    if spec is None:
+        return False
+    try:
+        from .. import telemetry
+        telemetry.record_event("fault_injected", point="nonfinite_grad",
+                               spec=repr(spec), op=op)
+        telemetry.counter(
+            "mxtpu_faults_injected_total",
+            "faults fired by the MXTPU_FAULT_INJECT plan").inc()
+    except Exception:
+        pass
+    return True
 
 
 def on_dispatch(op: str, arrays=(), donate=None):

@@ -164,7 +164,8 @@ _reg("MXTPU_DISPATCH_BACKOFF_MS", float, 50.0,
 _reg("MXTPU_FAULT_INJECT", str, "",
      "Deterministic fault-injection plan for the elastic subsystem "
      "(';'-separated 'point[:nth=N|step=N|times=K]' specs; points: "
-     "dispatch, dispatch_post, checkpoint_write, host_copy). Read at "
+     "dispatch, dispatch_post, checkpoint_write, host_copy, "
+     "nonfinite_grad). Read at "
      "import of mxnet_tpu.elastic.faults; tests reconfigure via "
      "faults.configure(). Empty (default) injects nothing. See "
      "docs/elasticity.md.")
@@ -175,6 +176,34 @@ _reg("MXTPU_CHECKPOINT_DIR", str, "",
      "Default checkpoint directory for tools/mxckpt.py and the mxlint "
      "elastic integrity pass (MXL502); CheckpointManager itself takes "
      "an explicit directory.")
+_reg("MXTPU_HEALTH", bool, True,
+     "Training-health plane: compute loss/grad-norm/update-norm/"
+     "nonfinite statistics INSIDE the compiled train step (extra "
+     "scalar outputs of the same single dispatch) and watch them with "
+     "the host sentinel. 0 removes the stats from the traced program "
+     "entirely; also inert whenever MXTPU_TELEMETRY=0. See "
+     "docs/observability.md (Training health).")
+_reg("MXTPU_HEALTH_EVERY", int, 10,
+     "Health sampling period K: the device health vector is read back "
+     "to the host every K train steps (the read is the plane's only "
+     "host sync; K=10 measured <1% step-time overhead on the CPU "
+     "smoke — bench.py's health block). K=1 samples every step.")
+_reg("MXTPU_HEALTH_ACTION", str, "warn",
+     "What a health verdict does: 'warn' records events only; 'skip' "
+     "bakes an in-graph nonfinite gate into the step (a step whose "
+     "gradients carry NaN/Inf writes the OLD params/state back out — "
+     "the poisoned update becomes a no-op); 'rollback' drives "
+     "recover(manager) to the last committed checkpoint on a "
+     "nonfinite or sustained-divergence verdict (attach "
+     "owner.health_manager). Part of the traced program: flipping it "
+     "retraces once, with attribution.")
+_reg("MXTPU_HEALTH_WINDOW", int, 64,
+     "Rolling-window length (in samples) for the health sentinel's "
+     "loss/grad-norm/update-ratio baselines.")
+_reg("MXTPU_HEALTH_PATIENCE", int, 3,
+     "Consecutive anomalous health samples before the sentinel "
+     "escalates to a 'divergence' verdict (the rollback trigger for "
+     "non-NaN divergence).")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

@@ -160,6 +160,14 @@ class CompiledStep:
         self._dims = None                 # (P, S, C, n_args) at save
         self._variants = {}               # manifest rows per variant
         self.warm_started = False
+        # training-health plane (telemetry.health): the spec describes
+        # the extra in-graph stats vector the traced program returns
+        # (None = plane off, program unchanged); the counter drives
+        # MXTPU_HEALTH_EVERY sampling; health_manager arms the
+        # rollback action (recover(manager) on a bad verdict)
+        self._health_spec = None
+        self._health_count = 0
+        self.health_manager = None
 
     # -- public API -------------------------------------------------------
     def step(self, data, label, batch_size=None):
@@ -363,7 +371,6 @@ class CompiledStep:
             import jax
             ctx = self._params[0].data().context if self._params \
                 else None
-            core = self._get_core(P, S, C, n_args, ctx)
             sources = {}
             for v in variants:
                 try:
@@ -373,10 +380,15 @@ class CompiledStep:
                 except (TypeError, ValueError) as e:
                     return _fail(f"bad variant avals: {e!r}"[:300])
                 k = v.get("k_steps")
+                hon = bool(v.get("health_out"))
+                core = self._get_core(P, S, C, n_args, ctx,
+                                      health_on=hon)
                 if k:
-                    pure = self._make_pure_k(core, P, S, C, n_args,
-                                             int(k),
-                                             bool(v.get("repeat")))
+                    pure = self._make_pure_k(
+                        core, P, S, C, n_args, int(k),
+                        bool(v.get("repeat")), health_on=hon,
+                        with_due=hon and
+                        str(v["suffix"]).endswith("_hs"))
                 else:
                     pure = self._make_pure(core, P, S, C)
                 name = self.name + v["suffix"]
@@ -385,7 +397,7 @@ class CompiledStep:
                     name, pure, {}, sds, donate=tuple(v["donate"]),
                     persist_name=base + v["suffix"])
                 self._variants[(int(k or 0),
-                                bool(v.get("repeat")))] = v
+                                bool(v.get("repeat")), hon)] = v
         except Exception as e:
             # the never-raises contract: a stale manifest (e.g. wrong
             # input widths feeding deferred-shape init) degrades to
@@ -601,8 +613,16 @@ class CompiledStep:
             raise _TraceFallback(
                 f"optimizer {type(opt).__name__} has no fused "
                 "multi-tensor program (_fused_plan returned None)")
+        # the health plane's layout + skip gate are baked into the
+        # traced program (extra outputs), so they belong to the sig:
+        # flipping MXTPU_HEALTH* evicts + retraces ONCE, attributed
+        from .. import telemetry
+        hspec = telemetry.health.build_spec(
+            self.net.name,
+            [self._params[i].name for i in self._tr_idx])
+        hsig = hspec.signature() if hspec is not None else None
         sig = (plan.op_name, tuple(sorted(plan.attrs.items())),
-               n_state, n_args)
+               n_state, n_args, hsig)
         if self._sig is not None and sig != self._sig:
             # retrace-cause attribution: the optimizer's static surface
             # drifted (momentum/beta/clip change) — name the exact
@@ -614,9 +634,16 @@ class CompiledStep:
                 changed = engine._sig_diff(self._sig[1], sig[1])
                 if self._sig[0] != sig[0]:
                     changed["op_name"] = [self._sig[0], sig[0]]
-                if self._sig[2:] != sig[2:]:
-                    changed["structure"] = [list(self._sig[2:]),
-                                            list(sig[2:])]
+                if self._sig[2:4] != sig[2:4]:
+                    changed["structure"] = [list(self._sig[2:4]),
+                                            list(sig[2:4])]
+                if self._sig[4] != sig[4]:
+                    def _hlabel(h):
+                        if h is None:
+                            return "off"
+                        return "on(skip-gate)" if h[2] else "on"
+                    changed["health"] = [_hlabel(self._sig[4]),
+                                         _hlabel(sig[4])]
                 telemetry.counter(
                     "mxtpu_retraces_total",
                     "cache misses attributable to a changed "
@@ -628,11 +655,17 @@ class CompiledStep:
                 engine.drop_cached(name)
             self._core = None
             self._core_shape = None
+            # the recorded manifest rows describe the PRE-drift
+            # programs (output arity included) — a save_signature
+            # after the drift must re-record, or a warm start would
+            # compile a variant whose unpack contradicts the config
+            self._variants.clear()
             # a pinned warm-start identity described the PRE-drift
             # program; re-derive so the persistent tier cannot serve a
             # stale-attr executable (the attrs live in the hash)
             self._persist_pinned = False
         self._sig = sig
+        self._health_spec = hspec
         import hashlib
         self._struct_hash = hashlib.sha256(repr(
             (sig, tuple((tuple(p.data().shape), str(p.data().dtype))
@@ -661,6 +694,17 @@ class CompiledStep:
         P, S = len(params), len(leaf_nds)
         self._check_sig(S, n_args)
 
+        from ..elastic import faults as _faults
+        if _faults._active and _faults.nonfinite_due(self.name):
+            # the nonfinite drill: a NaN planted in the batch reaches
+            # the loss/gradients through the UNCHANGED compiled program
+            # (same shapes — no retrace, no extra dispatch).  AFTER
+            # _check_sig: its _TraceFallback (-> eager replay with the
+            # ORIGINAL args) must not consume the one-shot spec and
+            # report a drill that never happened
+            from .. import telemetry as _tm
+            args = _tm.health.poison_inputs(args, ctx)
+
         # host bookkeeping snapshot: a pre-dispatch (trace/compile)
         # failure must rewind counts and the RNG stream so the eager
         # fallback replays the step identically
@@ -687,29 +731,56 @@ class CompiledStep:
                          for c in range(C)]
             key_vals = [jnp.stack(keys)]
 
-        core = self._get_core(P, S, C, n_args, ctx)
+        # health-plane variant selection (docs/observability.md): a
+        # SAMPLED dispatch runs the "_hs" program variant that also
+        # returns the in-graph stats vector; un-sampled steps run a
+        # program byte-identical to a health-off build (a dynamic
+        # branch would force the gradient tensors to materialize as
+        # cond operands EVERY step — measured as a multi-%% fusion
+        # barrier).  The skip gate reads the stats every step, so
+        # skip mode bakes them into the base variant instead.
+        hs = self._health_spec
+        k_real = 1 if k_steps is None else k_steps
+        sampled = False
+        if hs is not None:
+            from .. import telemetry as _tm
+            sampled = bool(_tm.health.due_flags(
+                self._health_count, k_real).any())
+        health_on = hs is not None and (hs.skip or sampled)
+        hsuffix = "_hs" if (health_on and not hs.skip) else ""
+        # a bulked sampled variant carries per-inner-step due flags so
+        # only boundary steps pay the stat reductions (a K>=EVERY bulk
+        # selects _hs on every dispatch)
+        with_due = bool(hsuffix) and k_steps is not None
+
+        core = self._get_core(P, S, C, n_args, ctx, health_on)
         if k_steps is None:
             pure = self._make_pure(core, P, S, C)
-            name = self.name
-            suffix = ""
+            suffix = hsuffix
             # donate trainable weights + ALL optimizer state leaves;
             # frozen params and the (autograd-owned) inputs are not ours
             # to alias
             donate = tuple(tr_idx) + tuple(range(P, P + S))
         else:
             pure = self._make_pure_k(core, P, S, C, n_args, k_steps,
-                                     repeat)
-            suffix = f"_k{k_steps}" + ("r" if repeat else "")
-            name = self.name + suffix
-            self._active_names.add(name)
+                                     repeat, health_on=health_on,
+                                     with_due=with_due)
+            suffix = f"_k{k_steps}" + ("r" if repeat else "") + hsuffix
             # the scan carries (and returns) EVERY param, so all of
             # them may donate
             donate = tuple(range(P + S))
+        name = self.name + suffix
+        if suffix:
+            self._active_names.add(name)
         persist_name = self._persist_base + suffix
 
         flat = [p.data()._data for p in params] \
             + [s._data for s in leaf_nds] + scal_vals \
             + [a._data for a in args] + [label._data] + key_vals
+        if with_due:
+            from .. import telemetry as _tm
+            flat.append(jnp.asarray(_tm.health.due_flags(
+                self._health_count, k_steps)))
         try:
             if not self._trace_seen[0] and engine.persist.enabled() \
                     and engine.persist.contains(
@@ -764,15 +835,18 @@ class CompiledStep:
         # once per variant, not per step (the aval walk over a
         # BERT-sized flat list is not free)
         self._dims = (P, S, C, n_args)
-        vkey = (k_steps or 0, bool(repeat))
+        vkey = (k_steps or 0, bool(repeat), health_on)
         if vkey not in self._variants:
             self._variants[vkey] = {
                 "suffix": suffix, "k_steps": k_steps,
-                "repeat": bool(repeat),
+                "repeat": bool(repeat), "health_out": health_on,
                 "donate": [int(i) for i in donate],
                 "avals": engine.persist.sig_to_json(
                     engine.persist.aval_sig(flat))}
         T = len(tr_idx)
+        health_out = None
+        if health_on:
+            health_out, res = res[-1], res[:-1]
         if k_steps is None:
             loss_val = res[0]
             new_tr = res[1:1 + T]
@@ -790,15 +864,32 @@ class CompiledStep:
                 p.data()._set_data(v)
         for s, v in zip(leaf_nds, new_leaves):
             s._set_data(v)
+        if health_on:
+            from .. import telemetry as _tm
+            _tm.health.sample_owner(
+                self, self.name, hs, health_out, k_real)
+        elif hs is not None:
+            # un-sampled variant: keep the cadence counter moving so
+            # the next sampled step lands on the K boundary
+            self._health_count += k_real
         return NDArray(loss_val, ctx=ctx)
 
     # -- traced functions --------------------------------------------------
-    def _get_core(self, n_params, n_state, n_scal, n_args, ctx):
+    def _get_core(self, n_params, n_state, n_scal, n_args, ctx,
+                  health_on=False):
         """The pure step body shared by ``step`` and ``step_multi``:
         (params, state_leaves, scalars, inputs, label, key) ->
-        (loss, new_trainable, new_state_leaves, aux)."""
+        (loss, new_trainable, new_state_leaves, aux, health).
+
+        ``health_on`` bakes the health-plane stats into THIS variant
+        of the program (docs/observability.md): sampling is variant
+        SELECTION, not a dynamic branch — a conditional would force
+        XLA to materialize the gradient tensors (cond operands) on
+        every step, a measured fusion barrier, whereas the un-sampled
+        variant here stays byte-identical to a health-off build."""
         if self._core is not None and \
-                self._core_shape == (n_params, n_state, n_scal, n_args):
+                self._core_shape == (n_params, n_state, n_scal, n_args,
+                                     health_on):
             return self._core
         net, loss_fn, tr = self.net, self.loss_fn, self.trainer
         params = self._params
@@ -806,9 +897,10 @@ class CompiledStep:
         tr_set = set(tr_idx)
         mutated_idx = self._mutated_idx
         trace_seen = self._trace_seen
+        hspec = self._health_spec if health_on else None
 
         def core(param_vals, state_vals, scal_vals, input_vals,
-                 label_val, key_raw):
+                 label_val, key_raw, due=None):
             import jax
             trace_seen[0] = True     # body runs only under a trace
             import jax.numpy as jnp
@@ -887,13 +979,40 @@ class CompiledStep:
                             new_tr[w_pos[id(o)]] = res[k]
                         elif id(o) in s_pos:
                             new_leaves[s_pos[id(o)]] = res[k]
+                    health_vec = None
+                    if hspec is not None:
+                        from .. import telemetry as _tm
+                        # `due` is None except in the bulked sampled
+                        # variant, where per-inner-step flags gate the
+                        # reductions (a K>=EVERY bulk would otherwise
+                        # pay the stats on every inner step)
+                        health_vec = _tm.health.compute(
+                            hspec, loss_val, tvals, grads,
+                            tuple(new_tr), due=due)
+                        if hspec.skip:
+                            # in-graph skip: a nonfinite step writes
+                            # the PRE-step values back out — the old
+                            # values are still readable here even
+                            # though the buffers are donated (aliasing
+                            # is the compiler's problem, not ours)
+                            _gate = _tm.health.gate
+                            new_tr = list(_gate(health_vec, new_tr,
+                                                tvals))
+                            new_leaves = list(_gate(
+                                health_vec, new_leaves, state_vals))
+                            aux = _gate(
+                                health_vec, aux,
+                                tuple(param_vals[i]
+                                      for i in mutated_idx))
             finally:
                 autograd.set_training(prev)
                 _rnd._pop_key_provider()
-            return loss_val, tuple(new_tr), tuple(new_leaves), aux
+            return (loss_val, tuple(new_tr), tuple(new_leaves), aux,
+                    health_vec)
 
         self._core = core
-        self._core_shape = (n_params, n_state, n_scal, n_args)
+        self._core_shape = (n_params, n_state, n_scal, n_args,
+                            health_on)
         return core
 
     def _make_pure(self, core, P, S, C):
@@ -903,13 +1022,20 @@ class CompiledStep:
             scal_vals = flat[P + S:P + S + C]
             input_vals = flat[P + S + C:-2]
             label_val, key_raw = flat[-2], flat[-1]
-            loss_val, new_tr, new_leaves, aux = core(
+            loss_val, new_tr, new_leaves, aux, health_vec = core(
                 param_vals, state_vals, scal_vals, input_vals,
                 label_val, key_raw)
-            return (loss_val,) + new_tr + new_leaves + aux
+            out = (loss_val,) + new_tr + new_leaves + aux
+            # the health vector rides as the LAST output so the aux
+            # slice stays positional (its length is only known after
+            # the trace populated mutated_idx)
+            if health_vec is not None:
+                out = out + (health_vec,)
+            return out
         return pure
 
-    def _make_pure_k(self, core, P, S, C, n_args, k_steps, repeat):
+    def _make_pure_k(self, core, P, S, C, n_args, k_steps, repeat,
+                     health_on=False, with_due=False):
         tr_idx = list(self._tr_idx)
         mutated_idx = self._mutated_idx
 
@@ -922,16 +1048,20 @@ class CompiledStep:
             input_vals = tuple(rest[:n_args])
             label_val = rest[n_args]
             keys_k = rest[n_args + 1]
+            due_k = rest[n_args + 2] if with_due else None
 
             def body(carry, xs):
                 pv, sv = carry
+                due = None
+                if with_due:
+                    *xs, due = xs
                 if repeat:
                     scal, key = xs
                     iv, lv = input_vals, label_val
                 else:
                     scal, iv, lv, key = xs
-                loss_val, new_tr, new_leaves, aux = core(
-                    pv, sv, scal, iv, lv, key)
+                loss_val, new_tr, new_leaves, aux, health_vec = core(
+                    pv, sv, scal, iv, lv, key, due)
                 pv = list(pv)
                 # forward-mutated (aux) params join the carry so step
                 # k+1 sees step k's BatchNorm running stats; trainable
@@ -942,13 +1072,20 @@ class CompiledStep:
                     pv[i] = aux[j]
                 for j, i in enumerate(tr_idx):
                     pv[i] = new_tr[j]
-                return (tuple(pv), new_leaves), loss_val
+                ys = loss_val if health_vec is None else \
+                    (loss_val, health_vec)
+                return (tuple(pv), new_leaves), ys
 
             xs = (scal_k, keys_k) if repeat else \
                 (scal_k, input_vals, label_val, keys_k)
-            (pf, sf), losses = lax.scan(
+            if with_due:
+                xs = xs + (due_k,)
+            (pf, sf), ys = lax.scan(
                 body, (param_vals, state_vals), xs)
-            return (losses,) + pf + sf
+            if health_on:
+                losses, healths = ys       # healths: (K, n_slots)
+                return (losses,) + pf + sf + (healths,)
+            return (ys,) + pf + sf
         return pure_k
 
 

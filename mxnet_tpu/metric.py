@@ -117,6 +117,7 @@ class EvalMetric:
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
+        self.nonfinite_updates = 0
 
     def reset_local(self):
         self.num_inst = 0
@@ -141,6 +142,16 @@ class EvalMetric:
         return list(zip(name, value))
 
     def _inc(self, metric, num):
+        # NaN-robustness: one nonfinite batch (a NaN loss from a bad
+        # sample, an overflowed fp16 sum) must not permanently corrupt
+        # a running metric — sum_metric += nan is forever.  The batch
+        # is dropped from the accumulation and COUNTED instead
+        # (``nonfinite_updates``), so the health plane / logs can see
+        # how many updates were rejected.
+        if not (math.isfinite(metric) and math.isfinite(num)):
+            self.nonfinite_updates = \
+                getattr(self, "nonfinite_updates", 0) + 1
+            return
         self.sum_metric += metric
         self.num_inst += num
         self.global_sum_metric += metric
@@ -337,6 +348,7 @@ class F1(EvalMetric):
         self.num_inst = 0
         self.global_sum_metric = 0.0
         self.global_num_inst = 0
+        self.nonfinite_updates = 0
         if hasattr(self, "metrics"):
             self.metrics.reset_stats()
 
@@ -377,6 +389,7 @@ class MCC(EvalMetric):
         self.num_inst = 0
         self.global_sum_metric = 0.0
         self.global_num_inst = 0
+        self.nonfinite_updates = 0
         if hasattr(self, "_metrics"):
             self._metrics.reset_stats()
 

@@ -209,6 +209,16 @@ class DataParallelTrainer:
         self._persist_pin: Optional[str] = None
         self._var_avals = {}
         self.warm_started = False
+        # training-health plane (telemetry.health): spec of the extra
+        # in-graph stats vector the fused step returns (None = off);
+        # _health_built_sig records the config the current programs
+        # bake so an env flip rebuilds them (with attribution) instead
+        # of mis-unpacking outputs; health_manager arms the rollback
+        # action
+        self._health_spec = None
+        self._health_built_sig = None
+        self._health_count = 0
+        self.health_manager = None
         self._rule = _FUSED_RULES.get(type(self.optimizer).__name__)
         if fuse_step and self._rule is None:
             import warnings
@@ -290,6 +300,50 @@ class DataParallelTrainer:
                 "with explicit input sizes or run one step before "
                 "restoring")
         self._finish_setup(params)
+
+    def _refresh_health(self):
+        """(Re)build the health spec when the ``MXTPU_HEALTH*`` config
+        the compiled programs bake drifted.  A flip after programs were
+        built evicts them (they return a different output arity) with
+        an attributed ``retrace`` event — the same correctness-over-
+        cache-warmth rule as ``CompiledStep._check_sig``."""
+        from .. import telemetry
+        cfg = telemetry.health.trace_signature()
+        if cfg == self._health_built_sig:
+            return
+        spec = telemetry.health.build_spec(
+            self.block.name,
+            [self._params[i].name for i in self._tr_idx]) \
+            if cfg is not None else None
+        if self._health_built_sig != cfg and (
+                self._full_fn is not None or
+                self._full_step is not None):
+            if telemetry.enabled():
+                def _lbl(c):
+                    if c is None:
+                        return "off"
+                    return "on(skip-gate)" if c[2] else "on"
+                telemetry.counter(
+                    "mxtpu_retraces_total",
+                    "cache misses attributable to a changed "
+                    "attr/shape/dtype").inc()
+                telemetry.record_event(
+                    "retrace", op="spmd_full_step", cause="attrs",
+                    changed={"health": [
+                        _lbl(self._health_built_sig), _lbl(cfg)]},
+                    source="spmd_trainer")
+            self._full_step = None
+            self._full_fn = None
+            self._full_exec = None
+            self._multi_step_cache.clear()
+            self._multi_fns.clear()
+            self._multi_exec.clear()
+            # recorded manifest rows bake the old call signature (the
+            # due-flag "extra" entry) — stale rows would make every
+            # warm start in the new config fail over to cold compile
+            self._var_avals.clear()
+        self._health_spec = spec
+        self._health_built_sig = cfg
 
     def _shard_params(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -464,16 +518,32 @@ class DataParallelTrainer:
         n_scalars = len(rule.scalars(opt, 0, 1))
         tr_idx = self._tr_idx
         traced = self._traced_fn
+        hspec = self._health_spec
+        mutated_idx = self._mutated_idx
 
         def full(param_vals, tstate_vals, scalar_vals, input_vals,
-                 label_val, key_raw):
+                 label_val, key_raw, due=None):
             loss, grads, aux = traced(param_vals, input_vals, label_val,
                                       key_raw)
             new_params, new_states = _apply_rule(
                 rule, opt, len(tr_idx), n_scalars,
                 lambda j: param_vals[tr_idx[j]], tstate_vals, grads,
                 scalar_vals)
-            return loss, new_params, new_states, aux
+            if hspec is None:
+                return loss, new_params, new_states, aux
+            # in-graph health stats (telemetry.health): the gradients
+            # here are already GLOBAL (the loss is a global-batch
+            # mean), so grad_norm is the cross-replica norm for free;
+            # `due` gates the reductions to sampled steps
+            from ..telemetry import health as _health
+            old_tr = tuple(param_vals[i] for i in tr_idx)
+            hvec = _health.compute(hspec, loss, old_tr, grads,
+                                   new_params, due=due)
+            if hspec.skip:
+                new_params, new_states, aux = _health.gate_update(
+                    hvec, new_params, old_tr, new_states, tstate_vals,
+                    aux, tuple(param_vals[i] for i in mutated_idx))
+            return loss, new_params, new_states, aux, hvec
 
         self._full_fn = full          # unjitted: reused by step_multi
         batch = NamedSharding(self.mesh, P(self.dp_axis))
@@ -484,12 +554,17 @@ class DataParallelTrainer:
         # out shardings pinned for the same reason as the two-phase
         # update: a TP rule must not let XLA silently re-shard weights
         # between steps (and donation aliasing needs stable layouts)
+        out_shardings = (None, tr_param_shardings, state_shardings,
+                         None)
+        in_shardings = (param_shardings, state_shardings, None,
+                        (batch,) * self._n_args, batch, repl)
+        if hspec is not None:
+            out_shardings = out_shardings + (None,)
+            in_shardings = in_shardings + (None,)   # the due flag
         self._full_step = jax.jit(
             full,
-            in_shardings=(param_shardings, state_shardings, None,
-                          (batch,) * self._n_args, batch, repl),
-            out_shardings=(None, tr_param_shardings, state_shardings,
-                           None),
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
             donate_argnums=(1,))
 
     def _build_full_step_compressed(self):
@@ -524,9 +599,11 @@ class DataParallelTrainer:
         axis = self.dp_axis
         n_dp = int(self.mesh.shape[axis])
         use_residual = ctype == "2bit"
+        hspec = self._health_spec
+        mutated_idx = self._mutated_idx
 
         def full(param_vals, tstate_vals, scalar_vals, input_vals,
-                 label_val, key_raw, residual_vals):
+                 label_val, key_raw, residual_vals, due=None):
             dev_key = jax.random.key_data(jax.random.fold_in(
                 jax.random.wrap_key_data(key_raw),
                 lax.axis_index(axis)))
@@ -549,8 +626,29 @@ class DataParallelTrainer:
                 tuple(red_grads), scalar_vals)
             loss = lax.pmean(loss, axis)
             aux = tuple(lax.pmean(a, axis) for a in aux)
+            new_residuals = tuple(new_residuals)
+            if hspec is None:
+                return loss, new_params, new_states, aux, \
+                    new_residuals
+            # health over the REDUCED (post-exchange) gradients — the
+            # values the update actually applies, identical on every
+            # device, so the vector replicates cleanly
+            from ..telemetry import health as _health
+            old_tr = tuple(param_vals[i] for i in tr_idx)
+            hvec = _health.compute(hspec, loss, old_tr,
+                                   tuple(red_grads), new_params,
+                                   due=due)
+            if hspec.skip:
+                new_params, new_states, aux = _health.gate_update(
+                    hvec, new_params, old_tr, new_states, tstate_vals,
+                    aux, tuple(param_vals[i] for i in mutated_idx))
+                if new_residuals:
+                    # a skipped step must not keep the poisoned
+                    # error-feedback either
+                    new_residuals = _health.gate(
+                        hvec, new_residuals, residual_vals)
             return loss, new_params, new_states, aux, \
-                tuple(new_residuals)
+                new_residuals, hvec
 
         if use_residual and self._residual_vals is None:
             repl_dp = NamedSharding(self.mesh, P(axis))
@@ -568,10 +666,15 @@ class DataParallelTrainer:
         # even though every device computes the identical sum — the
         # P() out_specs are mathematically sound (update inputs are
         # bit-identical across the axis)
+        out_specs = (repl, repl, repl, repl, res_spec)
+        in_specs = (repl, repl, repl, batch, batch, repl, res_spec)
+        if hspec is not None:
+            out_specs = out_specs + (repl,)
+            in_specs = in_specs + (repl,)           # the due flag
         mapped = shard_map(
             full, mesh=self.mesh,
-            in_specs=(repl, repl, repl, batch, batch, repl, res_spec),
-            out_specs=(repl, repl, repl, repl, res_spec),
+            in_specs=in_specs,
+            out_specs=out_specs,
             check_vma=False)
         # donate optimizer state and (2bit) residuals — both are dead
         # the moment their successors exist
@@ -593,13 +696,17 @@ class DataParallelTrainer:
         if self._persist_pin is not None:
             return self._persist_pin
         import hashlib
+        from .. import telemetry
         parts = (type(self.optimizer).__name__,
                  tuple((tuple(p.data().shape), str(p.data().dtype))
                        for p in self._params),
                  tuple(self._tr_idx),
                  tuple((str(k), int(v))
                        for k, v in self.mesh.shape.items()),
-                 self.dp_axis)
+                 self.dp_axis,
+                 # health config is baked into the program's output
+                 # arity — a flip must key fresh persistent entries
+                 telemetry.health.trace_signature())
         h = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
         return f"spmd_full_step_{self.block.name}_{h}"
 
@@ -610,11 +717,13 @@ class DataParallelTrainer:
         mesh sizes, which legitimately differ across a reshard) so a
         manifest from a different model can never be adopted."""
         import hashlib
+        from .. import telemetry
         parts = (type(self.optimizer).__name__,
                  tuple((tuple(p.data().shape), str(p.data().dtype))
                        for p in self._params),
                  tuple(self._tr_idx),
-                 self.dp_axis)
+                 self.dp_axis,
+                 telemetry.health.trace_signature())
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
@@ -654,8 +763,8 @@ class DataParallelTrainer:
         re-derived locally at warm-start time)."""
         from ..engine import persist as _persist
         from jax import tree_util
-        _pv, _sv, scal, x, y, key = vals
-        self._var_avals[(k_steps or 0, bool(repeated))] = {
+        scal, x, y, key = vals[2], vals[3], vals[4], vals[5]
+        row = {
             "suffix": suffix,
             "k_steps": k_steps, "repeat": bool(repeated),
             "inputs": _persist.sig_to_json(_persist.aval_sig(x)),
@@ -664,6 +773,12 @@ class DataParallelTrainer:
             "scalars": _persist.sig_to_json(_persist.aval_sig(
                 tree_util.tree_leaves(scal))),
         }
+        if len(vals) > 6:
+            # trailing extras (the health plane's due flag): recorded
+            # so warm_start can rebuild the exact call signature
+            row["extra"] = _persist.sig_to_json(
+                _persist.aval_sig(list(vals[6:])))
+        self._var_avals[(k_steps or 0, bool(repeated))] = row
 
     def _dispatch_full(self, vals):
         """One fused-step dispatch through the tiered executable.
@@ -831,6 +946,10 @@ class DataParallelTrainer:
         try:
             if self._params is None:
                 self._setup(args)
+            # the manifest's executables were compiled under SOME
+            # health config; adopt the current one before building so
+            # the first step doesn't immediately evict the warm start
+            self._refresh_health()
             # structural hash must match before adopting the identity —
             # the hash part of the persist name covers param
             # shapes/dtypes, trainable set, optimizer, and mesh layout.
@@ -877,11 +996,18 @@ class DataParallelTrainer:
                         a[0], np.dtype(a[1])) for a in scal_avals]
                 except (TypeError, ValueError) as e:
                     return _fail(f"bad variant avals: {e!r}"[:300])
+                try:
+                    extra_sds = tuple(
+                        jax.ShapeDtypeStruct(a[0], np.dtype(a[1]))
+                        for a in _persist.sig_from_json(
+                            v.get("extra") or []))
+                except (TypeError, ValueError) as e:
+                    return _fail(f"bad variant avals: {e!r}"[:300])
                 k = v.get("k_steps")
                 if k:
                     kk = (int(k), bool(v.get("repeat")))
                     vals = (param_vals, state_vals, scal_sds[0],
-                            x_sds, y_sds, k_sds)
+                            x_sds, y_sds, k_sds) + extra_sds
                     fn = self._multi_step_cache.get(kk)
                     if fn is None:
                         fn = self._build_full_step_multi(*kk)
@@ -892,7 +1018,7 @@ class DataParallelTrainer:
                         {_persist.aval_sig(vals): call}, fn)
                 else:
                     vals = (param_vals, state_vals, tuple(scal_sds),
-                            x_sds, y_sds, k_sds)
+                            x_sds, y_sds, k_sds) + extra_sds
                     call = self._tiered_exec(
                         "", self._full_step, self._full_fn, vals,
                         self._full_donate)
@@ -1182,6 +1308,17 @@ class DataParallelTrainer:
         args0 = args if repeated else [a[0] for a in args]
         if self._params is None:
             self._setup(args0)
+        self._refresh_health()
+        hs = self._health_spec
+        health_out = None
+        from ..elastic import faults as _faults2
+        if _faults2._active and _faults2.nonfinite_due(
+                "spmd_step_multi"):
+            # poisons the leading element: inner step 0 of a sliced
+            # bulk; with repeat= the single shared batch poisons
+            # EVERY inner step
+            from .. import telemetry as _tm
+            args = _tm.health.poison_inputs(args)
         prev = autograd.set_training(True)
         try:
             if self._fwd_bwd is None:
@@ -1240,6 +1377,12 @@ class DataParallelTrainer:
                 fn = self._build_full_step_multi(k_steps, repeated)
             vals = (param_vals, self._state_vals(), scalar_k, x_vals,
                     y_val, keys_k)
+            if hs is not None:
+                # per-inner-step sampling flags (K,): gate the
+                # in-graph health reductions inside the scan
+                from .. import telemetry as _tm
+                vals = vals + (jnp.asarray(_tm.health.due_flags(
+                    self._health_count, k_steps)),)
             from ..engine import persist as _persist
             if kk not in self._var_avals:
                 self._record_variant(
@@ -1278,8 +1421,13 @@ class DataParallelTrainer:
                     return fn(*vals)
 
             try:
-                loss_k, new_all_params, new_states = \
-                    engine.retrying_call(_go, probe, "spmd_step_multi")
+                out = engine.retrying_call(_go, probe,
+                                           "spmd_step_multi")
+                if hs is not None:
+                    loss_k, new_all_params, new_states, health_out = \
+                        out
+                else:
+                    loss_k, new_all_params, new_states = out
             except Exception as e:
                 # donate_argnums=(0, 1): if the executable consumed
                 # the donated param/state buffers before failing they
@@ -1315,6 +1463,11 @@ class DataParallelTrainer:
         for p, v in zip(self._params, new_all_params):
             p.data()._set_data(v)
         self._write_states(new_states)
+        if hs is not None and health_out is not None:
+            from .. import telemetry as _tm
+            _tm.health.sample_owner(
+                self, f"spmd:{self.block.name}", hs, health_out,
+                k_steps)
         return NDArray(loss_k, ctx=args[0].context)
 
     def _put_cached(self, a, sharding, used):
@@ -1360,36 +1513,57 @@ class DataParallelTrainer:
         full = self._full_fn
         tr_idx = self._tr_idx
         mutated_idx = self._mutated_idx
+        has_health = self._health_spec is not None
         # same count _build_full_step derives as n_scalars per param
         n_scal = len(self._rule.scalars(self.optimizer, 0, 1)) \
             * len(tr_idx)
 
         def full_k(param_vals, tstate_vals, scalar_k, inputs_k,
-                   label_k, keys_k):
+                   label_k, keys_k, due_k=None):
             def body(carry, xs):
                 params, tstates = carry
+                due = None
                 if repeated:
                     # the batch is a plain program input reused every
                     # inner step — no K host copies, no scanned axis
-                    scal_row, key = xs
+                    if has_health:
+                        scal_row, key, due = xs
+                    else:
+                        scal_row, key = xs
                     inputs, label = inputs_k, label_k
+                elif has_health:
+                    scal_row, inputs, label, key, due = xs
                 else:
                     scal_row, inputs, label, key = xs
                 scal = tuple(scal_row[i] for i in range(n_scal))
-                loss, new_params, new_states, aux = full(
-                    params, tstates, scal, inputs, label, key)
+                out = full(params, tstates, scal, inputs, label, key,
+                           due) if has_health else \
+                    full(params, tstates, scal, inputs, label, key)
+                if has_health:
+                    loss, new_params, new_states, aux, hvec = out
+                else:
+                    loss, new_params, new_states, aux = out
                 params = list(params)
                 for j, i in enumerate(tr_idx):
                     params[i] = new_params[j]
                 for j, i in enumerate(mutated_idx):
                     params[i] = aux[j]
-                return (tuple(params), new_states), loss
+                ys = (loss, hvec) if has_health else loss
+                return (tuple(params), new_states), ys
 
-            xs = (scalar_k, keys_k) if repeated else \
-                (scalar_k, inputs_k, label_k, keys_k)
-            (params_f, tstates_f), losses = lax.scan(
+            if repeated:
+                xs = (scalar_k, keys_k, due_k) if has_health else \
+                    (scalar_k, keys_k)
+            else:
+                xs = (scalar_k, inputs_k, label_k, keys_k, due_k) \
+                    if has_health else \
+                    (scalar_k, inputs_k, label_k, keys_k)
+            (params_f, tstates_f), ys = lax.scan(
                 body, (param_vals, tstate_vals), xs)
-            return losses, params_f, tstates_f
+            if has_health:
+                losses, healths = ys       # healths: (K, n_slots)
+                return losses, params_f, tstates_f, healths
+            return ys, params_f, tstates_f
 
         batch_k = NamedSharding(
             self.mesh,
@@ -1399,11 +1573,16 @@ class DataParallelTrainer:
         # out-shardings pinned for the same TP-safety reason as
         # _build_full_step (weights must not silently re-shard
         # between steps; donation aliasing needs stable layouts)
+        out_shardings = (None, param_shardings, state_shardings)
+        in_shardings = (param_shardings, state_shardings, None,
+                        (batch_k,) * self._n_args, batch_k, repl)
+        if has_health:
+            out_shardings = out_shardings + (None,)
+            in_shardings = in_shardings + (None,)   # the due flags
         fn = jax.jit(
             full_k,
-            in_shardings=(param_shardings, state_shardings, None,
-                          (batch_k,) * self._n_args, batch_k, repl),
-            out_shardings=(None, param_shardings, state_shardings),
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
             donate_argnums=(0, 1))
         self._multi_step_cache[(k_steps, repeated)] = fn
         # the unjitted body backs the persistent tier's abstract
@@ -1427,6 +1606,14 @@ class DataParallelTrainer:
         args = list(data) if isinstance(data, (list, tuple)) else [data]
         if self._params is None:
             self._setup(args)
+        self._refresh_health()
+        from ..elastic import faults as _faults
+        if _faults._active and _faults.nonfinite_due("spmd_step"):
+            # the nonfinite drill: a NaN planted in the batch reaches
+            # the loss/gradients through the UNCHANGED compiled
+            # program (same shapes — no retrace)
+            from .. import telemetry as _tm
+            args = _tm.health.poison_inputs(args)
         if self._fwd_bwd is None:
             prev = autograd.set_training(True)
             try:
@@ -1435,6 +1622,8 @@ class DataParallelTrainer:
                 autograd.set_training(prev)
 
         use_full = self._fuse_step and self._rule is not None
+        hs = self._health_spec
+        health_out = None
         prev = autograd.set_training(True)
         try:
             batch = NamedSharding(self.mesh, P(self.dp_axis))
@@ -1486,6 +1675,14 @@ class DataParallelTrainer:
                     list(self._residual_vals)
                     if compressed and self._residual_vals else [])
 
+                hextra = ()
+                if hs is not None:
+                    # the dynamic sampling flag (0-d f32): gates the
+                    # in-graph health reductions without retracing
+                    from .. import telemetry as _tm
+                    hextra = (_tm.health.due_flags(
+                        self._health_count, 1)[0],)
+
                 def _go():
                     # the fault hook sits INSIDE the retried thunk so
                     # a one-shot "dispatch" fault is absorbed exactly
@@ -1498,23 +1695,25 @@ class DataParallelTrainer:
                         return self._full_step(
                             param_vals, self._state_vals(),
                             tuple(scalar_vals), x_vals, y_val,
-                            key._data, self._residual_vals or ())
+                            key._data, self._residual_vals or (),
+                            *hextra)
                     return self._dispatch_full(
                         (param_vals, self._state_vals(),
                          tuple(scalar_vals), x_vals, y_val,
-                         key._data))
+                         key._data) + hextra)
 
                 try:
+                    out = engine.retrying_call(
+                        _go, donated_flat, "spmd_full_step")
+                    if hs is not None:
+                        health_out, out = out[-1], out[:-1]
                     if compressed:
-                        (loss, new_params, new_states, aux,
-                         new_res) = engine.retrying_call(
-                            _go, donated_flat, "spmd_full_step")
+                        loss, new_params, new_states, aux, new_res = \
+                            out
                         if new_res:
                             self._residual_vals = new_res
                     else:
-                        loss, new_params, new_states, aux = \
-                            engine.retrying_call(
-                                _go, donated_flat, "spmd_full_step")
+                        loss, new_params, new_states, aux = out
                 except Exception as e:
                     # donate_argnums=(1,): if the executable consumed
                     # the donated state buffers before failing, they
@@ -1548,6 +1747,10 @@ class DataParallelTrainer:
             for i, v in zip(self._tr_idx, new_params):
                 self._params[i].data()._set_data(v)
             self._write_states(new_states)
+            if hs is not None and health_out is not None:
+                from .. import telemetry as _tm
+                _tm.health.sample_owner(
+                    self, f"spmd:{self.block.name}", hs, health_out, 1)
             return NDArray(loss, ctx=args[0].context)
 
         # write mutated aux state (BatchNorm running stats) back

@@ -35,6 +35,7 @@ from .recorder import (record_event, events, clear_events,
                        dump_flight_recorder, auto_dump, last_dump,
                        note_step, current_step)
 from . import memory
+from . import health
 
 __all__ = [
     "enabled", "enable", "disable", "reset",
@@ -44,7 +45,7 @@ __all__ = [
     "record_event", "events", "clear_events", "dump_flight_recorder",
     "auto_dump", "last_dump", "note_step", "current_step",
     "record_step", "step_owner", "step_owned",
-    "prefetch_stall_ratio", "export_metrics", "memory",
+    "prefetch_stall_ratio", "export_metrics", "memory", "health",
 ]
 
 #: dispatch-count boundaries for the per-step dispatch histogram: the
@@ -76,6 +77,7 @@ def reset():
     clear_events()
     recorder._reset_steps()
     memory.reset()
+    health.reset()
 
 
 import threading as _threading

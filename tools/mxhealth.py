@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""mxhealth: render the training-health plane's report.
+
+The health plane (``mxnet_tpu.telemetry.health``) computes loss /
+grad-norm / update-norm / nonfinite statistics INSIDE the compiled
+train step (extra outputs of the same single dispatch), samples them
+every ``MXTPU_HEALTH_EVERY`` steps, and watches them with a host
+sentinel that emits retained ``health_anomaly`` events with subtree
+attribution.  This tool renders that data three ways:
+
+    python tools/mxhealth.py smoke               # run a tiny
+                                                 # in-process train
+                                                 # loop, then report
+    python tools/mxhealth.py render report.json  # render a saved
+                                                 # health.dump_report()
+                                                 # artifact (also
+                                                 # accepts a flight-
+                                                 # recorder dump)
+    python tools/mxhealth.py --self-check        # CI gate: the smoke
+                                                 # must produce a
+                                                 # non-empty health
+                                                 # table
+    # live process: from tools.mxhealth import render
+    #               print(render(telemetry.health.report()))
+
+The report shows, per step owner: the rolling health table (last N
+samples — step, loss, grad norm, mean update ratio, nonfinite count,
+anomalies), the anomaly log with subtree attribution, and the last
+sentinel verdict.  ``render`` exits 1 on a malformed artifact so the
+gate fails loudly.  See docs/observability.md (Training health).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+# NOTE: no JAX_PLATFORMS mutation at import time — render() is
+# documented for import into LIVE training processes (same rule as
+# tools/mxmem.py); the CLI entry point pins the backend instead.
+
+
+def render(rep: dict, last: int = 12) -> str:
+    """Text rendering of a ``telemetry.health.report()`` dict."""
+    from mxnet_tpu.telemetry import health
+    return health.render_table(rep, last=last)
+
+
+def _events_view(artifact: dict) -> dict:
+    """Project a flight-recorder dump onto the health-report shape:
+    the retained ``health_anomaly`` events become per-owner anomaly
+    logs (no rolling table — the dump carries events, not samples)."""
+    owners = {}
+    for ev in artifact.get("events", []):
+        if ev.get("kind") != "health_anomaly":
+            continue
+        w = ev.get("where", "?")
+        o = owners.setdefault(w, {"where": w, "samples": 0,
+                                  "subtrees": [], "history": [],
+                                  "anomalies": [],
+                                  "last_verdict": None})
+        o["anomalies"].append({
+            "step": ev.get("step"), "anomaly": ev.get("anomaly"),
+            "subtrees": ev.get("subtrees") or [],
+            "detail": ev.get("detail", "")})
+    gauges = (artifact.get("metrics") or {}).get("gauges") or {}
+    return {"kind": "mxtpu_health_report",
+            "enabled": True,
+            "every": "?", "action": "?",
+            "owners": owners,
+            "last_loss": gauges.get("mxtpu_health_loss"),
+            "last_grad_norm": gauges.get("mxtpu_health_grad_norm")}
+
+
+def cmd_render(args) -> int:
+    try:
+        with open(args.report) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"mxhealth: cannot read {args.report}: {e}",
+              file=sys.stderr)
+        return 1
+    if not isinstance(rep, dict):
+        print(f"mxhealth: {args.report} is not a JSON object",
+              file=sys.stderr)
+        return 1
+    if rep.get("kind") == "mxtpu_health_report":
+        pass
+    elif "events" in rep:
+        # a flight-recorder dump: show its retained health events
+        rep = _events_view(rep)
+    else:
+        print(f"mxhealth: {args.report} is neither a health report "
+              "(health.dump_report) nor a flight-recorder dump",
+              file=sys.stderr)
+        return 1
+    try:
+        if args.fmt == "json":
+            print(json.dumps(rep, indent=2, default=str))
+        else:
+            print(render(rep))
+    except (KeyError, TypeError, ValueError) as e:
+        print(f"mxhealth: malformed artifact: {e!r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    """Tiny in-process train loop with sampling forced to K=1 so the
+    CLI demonstrates (and ``--self-check`` gates) the live path end to
+    end: compiled gluon step -> in-graph stats -> sentinel -> report.
+    Exits 1 when the health table comes back empty — a silent health
+    plane is exactly the regression this gate exists to catch."""
+    os.environ["MXTPU_HEALTH_EVERY"] = "1"
+    os.environ.setdefault("MXTPU_HEALTH", "1")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, telemetry
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", in_units=32),
+                gluon.nn.Dense(8, in_units=64))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=None)
+    cs = tr.compile_step(net, gluon.loss.L2Loss())
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(16, 32).astype("f4"))
+    y = nd.array(rng.rand(16, 8).astype("f4"))
+    for _ in range(args.steps):
+        loss = cs.step(x, y, 16)
+    loss.wait_to_read()
+
+    rep = telemetry.health.report()
+    if args.out:
+        telemetry.health.dump_report(args.out)
+        print(f"report written to {args.out}", file=sys.stderr)
+    if args.fmt == "json":
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep))
+    rows = sum(len(o.get("history") or [])
+               for o in (rep.get("owners") or {}).values())
+    if rows == 0:
+        print("mxhealth: SELF-CHECK FAILED — the smoke run produced "
+              "an empty health table (plane disabled or the step "
+              "stack stopped splicing the stats)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="mxhealth", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--format", choices=["text", "json"],
+                    default="text", dest="fmt")
+    ap.add_argument("--self-check", action="store_true",
+                    help="CI gate: run the smoke and fail on an empty "
+                    "health table")
+    sub = ap.add_subparsers(dest="cmd")
+    p = sub.add_parser("render", help="render a saved health report "
+                       "or flight-recorder dump")
+    p.add_argument("report", help="JSON from health.dump_report() or "
+                   "dump_flight_recorder()")
+    p = sub.add_parser("smoke",
+                       help="run a tiny train loop, then report")
+    p.add_argument("--out", default="",
+                   help="also dump the report JSON here")
+    p.add_argument("--steps", type=int, default=12)
+    args = ap.parse_args(argv)
+    if args.cmd is None:
+        if not args.self_check:
+            ap.error("nothing to do: give a subcommand or "
+                     "--self-check")
+        args.out, args.steps = "", 12
+        return cmd_smoke(args)
+    return {"render": cmd_render, "smoke": cmd_smoke}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

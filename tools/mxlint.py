@@ -105,6 +105,10 @@ def main(argv=None) -> int:
         # unless MXTPU_CHECKPOINT_DIR points at a checkpoint volume,
         # which then gets a full integrity sweep
         findings.extend(analysis.analyze_elasticity())
+        # training-health pass (MXL312, runtime sibling of MXL311):
+        # free in a fresh CLI process, surfaces recorded numerics
+        # anomalies after an in-process workload
+        findings.extend(analysis.analyze_health())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
