@@ -943,6 +943,102 @@ def bench_compile_cache(batch_size=64):
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_serving(prompt_len=8, slots=4, max_new=8, n_requests=8,
+                  vocab=256):
+    """Serving-plane smoke (docs/serving.md): continuously batched
+    decode over one llama_tiny bucket.  Emits tokens/sec, time-to-
+    first-token {cold, warm, warm_fresh_compiles} through the
+    persistent compile cache + ``Server.warm_start`` (the PR 5
+    acceptance counter applied to serving), p50/p99 per-request
+    latency, and mean batch occupancy."""
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, telemetry
+    from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+    from mxnet_tpu.serving import Server
+
+    cache_dir = tempfile.mkdtemp(prefix="mxtpu_bench_srv_")
+    prev = os.environ.get("MXTPU_COMPILE_CACHE_DIR")
+    os.environ["MXTPU_COMPILE_CACHE_DIR"] = cache_dir
+    try:
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = LlamaForCausalLM(llama_tiny(vocab_size=vocab))
+        net.initialize(mx.init.Xavier())
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, vocab, rng.randint(
+            2, prompt_len + 1)).astype("f4")
+            for _ in range(n_requests)]
+
+        # COLD: fresh engine, empty persistent tier — the first token
+        # pays trace + compile of the bucket's prefill+decode programs
+        engine.clear_cache()
+        engine.reset_counters()
+        srv = Server(net, buckets=[(slots, prompt_len)],
+                     max_new_tokens=max_new)
+        first = srv.submit(prompts[0])
+        srv.step()
+        cold_ttft = first.first_token_t - first.submit_t
+        reqs = [first] + [srv.submit(p) for p in prompts[1:]]
+        # only tokens produced INSIDE the timed window count toward
+        # the rate (the TTFT step above already generated a couple)
+        pre_tokens = sum(len(r.generated) for r in reqs)
+        t0 = time.perf_counter()
+        occ = []
+        # same wedge guard as Server.run(), kept inline so occupancy
+        # can be sampled per round
+        for _ in range(16 + n_requests * (max_new + 2)):
+            if not (srv.sched.active_requests()
+                    or srv.sched.queue_depth()):
+                break
+            occ.append(srv.sched.occupancy())
+            srv.step()
+        else:
+            raise RuntimeError("serving bench failed to drain")
+        drain = time.perf_counter() - t0
+        tokens = sum(len(r.generated) for r in reqs) - pre_tokens
+        manifest = os.path.join(cache_dir, "serving_manifest.json")
+        srv.save_signature(manifest)
+        hist = telemetry.histogram(
+            "mxtpu_serving_request_seconds",
+            "submit -> completion per-request latency (s)")
+
+        # WARM: "process restart" — memory tier emptied, persistent
+        # tier + manifest kept; warm_start precompiles every bucket
+        # variant so the first token performs 0 fresh compiles
+        engine.clear_cache()
+        engine.reset_counters()
+        srv2 = Server(net, buckets=[(slots, prompt_len)],
+                      max_new_tokens=max_new)
+        warm_ok = srv2.warm_start(manifest)
+        r2 = srv2.submit(prompts[0])
+        srv2.step()
+        warm_ttft = r2.first_token_t - r2.submit_t
+        info = engine.cache_info()
+        return {
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / drain, 2) if drain else None,
+            "time_to_first_token_seconds": {
+                "cold": round(cold_ttft, 4),
+                "warm": round(warm_ttft, 4),
+                "warm_fresh_compiles": info["fresh_compiles"]},
+            "warm_started": bool(warm_ok),
+            "request_latency_seconds": {
+                "p50": hist.quantile(0.5), "p99": hist.quantile(0.99),
+                "count": hist.summary()["count"]},
+            "batch_occupancy_mean":
+                round(sum(occ) / len(occ), 4) if occ else None,
+            "steady_state": srv.stats()["buckets"],
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["MXTPU_COMPILE_CACHE_DIR"] = prev
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def _run_cpu_smoke_subprocess(sub_budget=240):
     """Run the degraded CPU smoke in a CHILD bench.py (so this process
     stays jax-free and can still take the chip path if a window opens
@@ -1076,6 +1172,22 @@ def main():
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 _record("compile_cache_warm_start", error=repr(e))
+            # serving-plane smoke (docs/serving.md): tokens/sec, TTFT
+            # cold->warm through Server.warm_start, p50/p99 request
+            # latency, batch occupancy — rides the telemetry block
+            try:
+                sblock = bench_serving()
+                tblock["serving"] = sblock
+                _record("serving", **sblock)
+                ttft = sblock["time_to_first_token_seconds"]
+                _log(f"serving: {sblock['tokens_per_sec']} tok/s, "
+                     f"ttft cold {ttft['cold']:.2f}s -> warm "
+                     f"{ttft['warm']:.2f}s "
+                     f"({ttft['warm_fresh_compiles']} fresh compiles "
+                     "warm)")
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                _record("serving", error=repr(e))
             # the telemetry block rides EVERY subsequently-emitted
             # result line (stage 2 overwrites the metric, not this),
             # so the trajectory files capture dispatch/retrace/stall

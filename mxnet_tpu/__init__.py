@@ -91,6 +91,7 @@ from . import callback
 from . import checkpoint
 from . import checkpoint as model  # mx.model.save_checkpoint parity
 from . import elastic
+from . import serving
 from . import operator
 from . import contrib
 from . import rtc
@@ -105,4 +106,4 @@ __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "models", "profiler", "telemetry", "monitor", "runtime",
            "envs",
            "callback", "checkpoint", "model", "operator", "contrib",
-           "analysis", "elastic"]
+           "analysis", "elastic", "serving"]

@@ -23,7 +23,7 @@ from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_telemetry, analyze_compile_cache,
                       analyze_memory, analyze_elasticity,
-                      analyze_health)
+                      analyze_health, analyze_serving)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -34,7 +34,7 @@ __all__ = [
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
     "analyze_compile_cache", "analyze_memory", "analyze_elasticity",
-    "analyze_health",
+    "analyze_health", "analyze_serving",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -72,5 +72,9 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # quiet in a fresh process; after an in-process workload it
     # surfaces recorded numerics anomalies and the last verdict
     findings.extend(analyze_health())
+    # serving pass (MXL601 runtime twin): quiet in a fresh process;
+    # after in-process serving traffic it surfaces buckets that kept
+    # compiling in steady state (the zero-retrace contract)
+    findings.extend(analyze_serving())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

@@ -87,6 +87,11 @@ RULES = {
                "(a failure loses the whole run)"),
     "MXL502": (Severity.ERROR,
                "corrupt or torn elastic checkpoint"),
+    # -- serving passes (MXL6xx) ----------------------------------------
+    "MXL601": (Severity.WARNING,
+               "per-request prefill/decode loop without the serving "
+               "plane (per-request compile hazard; runtime form: a "
+               "serving bucket kept compiling in steady state)"),
 }
 
 

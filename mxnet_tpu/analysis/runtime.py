@@ -25,7 +25,8 @@ from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
            "analyze_telemetry", "analyze_compile_cache",
-           "analyze_memory", "analyze_elasticity", "analyze_health"]
+           "analyze_memory", "analyze_elasticity", "analyze_health",
+           "analyze_serving"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -262,6 +263,39 @@ def analyze_health() -> List[Finding]:
             "health_anomaly events (tools/mxhealth.py) and consider "
             "MXTPU_HEALTH_ACTION=skip|rollback",
             f"health:{where}"))
+    return findings
+
+
+def analyze_serving() -> List[Finding]:
+    """MXL601 runtime twin (docs/serving.md): steady-state compile
+    accounting per serving bucket.
+
+    Every live ``serving.Server`` brackets each dispatch of an
+    already-compiled bucket variant with ``engine.compile_counts()``;
+    a nonzero steady-state miss or fresh-compile count means the
+    bucket's programs kept compiling AFTER they existed — an aval or
+    shape leaked into the decode path (the exact hazard fixed bucket
+    shapes exist to prevent).  Free in a fresh process (no servers —
+    the ``--self-check`` CI gate stays quiet).
+    """
+    from ..serving import servers
+    findings: List[Finding] = []
+    for srv in servers():
+        for bucket, stats in sorted(srv.stats()["buckets"].items()):
+            steady = stats.get("steady_dispatches", 0)
+            misses = stats.get("steady_misses", 0)
+            fresh = stats.get("steady_fresh_compiles", 0)
+            if not steady or not (misses or fresh):
+                continue
+            findings.append(Finding(
+                "MXL601",
+                f"{srv.name}: bucket {bucket} compiled "
+                f"{misses} cache miss(es) / {fresh} fresh compile(s) "
+                f"across {steady} steady-state dispatches — decode "
+                "must reuse ONE program per bucket; something varies "
+                "a shape/dtype per step (see docs/serving.md, "
+                "'Zero-retrace contract')",
+                f"serving:{srv.name}:{bucket}"))
     return findings
 
 

@@ -39,6 +39,19 @@ Heuristics are deliberately scoped to keep the signal high:
   a CompiledStep was requested but silently fell back to eager, with
   the recorded reason.
 
+* MXL601 fires for a model-zoo ``prefill``/``decode_step``/
+  ``generate`` call inside a loop — the per-request serving shape —
+  in a module that never references the serving plane (``Server`` /
+  ``KVCachePool`` / ``BucketScheduler`` / a ``serving`` import): each
+  request pays its own prefill and per-op decode dispatches, and each
+  unseen prompt length compiles fresh programs (docs/serving.md).
+  Exempt: a model's own ``self.<method>`` loop, a loop-induction
+  receiver (``for layer in self.layers``), and ``prefill``/
+  ``decode_step`` in a ``range()`` loop (position stepping — the
+  incremental-decode implementation, not a request loop).  Its
+  runtime twin (``analyze_serving``) reports a serving bucket that
+  kept compiling in steady state.
+
 * MXL501 fires for a training loop that dispatches ``step``/
   ``step_multi`` at least ``_CKPT_LOOP_MIN_STEPS`` times (a statically
   known ``range`` bound, or an unbounded ``while True``) in a module
@@ -80,6 +93,19 @@ _STEP_COMPILE_MARKERS = {"compile_step", "CompiledStep", "step_multi",
 # scope"); `recover` counts because calling it requires a manager
 _CKPT_MARKERS = {"CheckpointManager", "OrbaxCheckpoint",
                  "save_checkpoint", "recover"}
+# any of these in a module means the serving plane is in scope —
+# MXL601 stays quiet for the whole file (the author already batches
+# the decode path).  NOT `warm_start`: that name is shared with the
+# PR 5 TRAINING warm start, and a train script using it can still
+# loop per-request generate() — the exact hazard this rule exists for
+_SERVING_MARKERS = {"Server", "serving", "KVCachePool",
+                    "BucketScheduler"}
+# model-zoo decode-contract calls that, inside a request loop, pay a
+# per-request prefill + T per-op decode dispatches (and a fresh
+# compile per UNSEEN prompt length) — the shape Server's fixed
+# buckets amortize
+_SERVING_CALLS = {"prefill", "decode_step", "generate",
+                  "generate_fused"}
 #: statically-known step counts below this never fire MXL501 — short
 #: smoke/debug loops are not "a run worth checkpointing"
 _CKPT_LOOP_MIN_STEPS = 100
@@ -173,6 +199,25 @@ def _module_uses_checkpointing(tree) -> bool:
     return False
 
 
+def _module_uses_serving(tree) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) and n.attr in _SERVING_MARKERS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _SERVING_MARKERS:
+            return True
+        # `from mxnet_tpu.serving import Server` binds ast.alias
+        # nodes, not Names — an import alone is already "the serving
+        # plane is in scope"
+        if isinstance(n, ast.ImportFrom):
+            if "serving" in (n.module or "") or any(
+                    a.name in _SERVING_MARKERS for a in n.names):
+                return True
+        elif isinstance(n, ast.Import):
+            if any("serving" in a.name for a in n.names):
+                return True
+    return False
+
+
 def _loop_trip_count(loop) -> Optional[float]:
     """Statically-known iteration count for MXL501.
 
@@ -233,13 +278,14 @@ def _get_op(opname: str):
 
 class _SourceVisitor(ast.NodeVisitor):
     def __init__(self, filename: str, uses_step_compilation=False,
-                 uses_checkpointing=False):
+                 uses_checkpointing=False, uses_serving=False):
         self.filename = filename
         self.findings: List[Finding] = []
         self._loops: List[dict] = []       # {training, varying, per_op}
         self._hybrid_depth = 0
         self._uses_step_compilation = uses_step_compilation
         self._uses_checkpointing = uses_checkpointing
+        self._uses_serving = uses_serving
 
     # -- helpers ---------------------------------------------------------
     def _loc(self, node) -> str:
@@ -268,11 +314,24 @@ class _SourceVisitor(ast.NodeVisitor):
                 "collapses the whole step (and step_multi(K) bulks K "
                 "steps) into ONE dispatch — see docs/compiled_step.md",
                 self._loc(node)))
+        induction: Set[str] = set()
+        range_loop = False
+        if isinstance(node, ast.For):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    induction.add(n.id)
+            it = node.iter
+            range_loop = (isinstance(it, ast.Call) and
+                          isinstance(it.func, ast.Name) and
+                          it.func.id == "range")
         self._loops.append({"training": _training_markers(node),
                             "varying": _loop_varying_names(node),
                             "per_op": per_op,
                             "count": _loop_trip_count(node),
-                            "ckpt_fired": False})
+                            "ckpt_fired": False,
+                            "serving_fired": False,
+                            "induction": induction,
+                            "range_loop": range_loop})
         self.generic_visit(node)
         self._loops.pop()
 
@@ -347,7 +406,56 @@ class _SourceVisitor(ast.NodeVisitor):
         if self._loops:
             self._check_per_step_attrs(node)
             self._check_unckpt_loop(node)
+            self._check_unserved_loop(node)
         self.generic_visit(node)
+
+    def _check_unserved_loop(self, node: ast.Call):
+        """MXL601: a model-zoo ``prefill``/``decode_step``/``generate``
+        call inside a loop — the per-request serving shape — in a
+        module that never touches the serving plane (``Server`` /
+        bucketed warm path).  Each request pays a fresh prefill, T
+        per-op decode dispatches, and a NEW compile per unseen prompt
+        length; ``serving.Server`` amortizes all three into fixed
+        bucket programs (the serving sibling of MXL304).  A model's
+        own ``self.<method>`` implementation is exempt — generate()'s
+        internal decode loop is the implementation, not a request
+        loop."""
+        if self._uses_serving:
+            return
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and
+                f.attr in _SERVING_CALLS):
+            return
+        chain = _attr_chain(f)
+        if chain and chain[0] == "self":
+            return
+        if chain and any(chain[0] in l["induction"]
+                         for l in self._loops):
+            # the receiver IS the thing being iterated (`for layer in
+            # self.layers: layer.prefill(...)`) — submodule plumbing
+            # inside a model implementation, not a request loop
+            return
+        if f.attr in ("prefill", "decode_step") and \
+                self._loops[-1]["range_loop"]:
+            # `for i in range(S): net.decode_step(tok, caches, i)` is
+            # the incremental-decode IMPLEMENTATION shape — one
+            # sequence, stepping positions — not a request loop
+            # (requests iterate a collection of prompts; whole-request
+            # calls like generate() stay flagged in any loop)
+            return
+        if any(l["serving_fired"] for l in self._loops):
+            return          # one finding per loop nest
+        self._loops[0]["serving_fired"] = True
+        self.findings.append(Finding(
+            "MXL601", f".{f.attr}() inside a request loop without the "
+            "serving plane in scope: every request pays its own "
+            "prefill + per-op decode dispatches, and each UNSEEN "
+            "prompt length compiles fresh programs; serving.Server "
+            "batches requests into fixed (slots, prompt_len) buckets "
+            "— one compiled prefill + one compiled decode program "
+            "each, zero steady-state retraces, warm-startable via "
+            "save_signature/warm_start — see docs/serving.md",
+            self._loc(node)))
 
     def _check_unckpt_loop(self, node: ast.Call):
         """MXL501: this step call's loop nest runs >= the threshold
@@ -451,7 +559,8 @@ def analyze_source(text: str, filename: str = "<string>") -> List[Finding]:
     v = _SourceVisitor(
         filename,
         uses_step_compilation=_module_uses_step_compilation(tree),
-        uses_checkpointing=_module_uses_checkpointing(tree))
+        uses_checkpointing=_module_uses_checkpointing(tree),
+        uses_serving=_module_uses_serving(tree))
     v.visit(tree)
     return _apply_suppressions(v.findings, text)
 

@@ -14,20 +14,25 @@ trained parameters baked in as constants and writes a bundle holding
   checked calls).
 
 The two sections both embed the module (so the bundle is ~2x the
-module size, weights included); large pure-C deployments can strip the
-jax blob by rewriting the bundle with ``n_blob = 0``.
+module size, weights included); large pure-C deployments strip the jax
+blob with :func:`strip_jax_blob`, which rewrites the bundle with
+``n_blob = 0`` (``read_stablehlo`` still serves the raw module;
+``load_stablehlo_jax`` then raises a clear ``MXNetError``).
 
     mx.deploy.export_stablehlo(net, example, "model.mxshlo")
     run = mx.deploy.load_stablehlo_jax("model.mxshlo")   # python
     code = mx.deploy.read_stablehlo("model.mxshlo")      # C / PJRT
+    mx.deploy.strip_jax_blob("model.mxshlo")             # C-only, ~2x smaller
 """
 from __future__ import annotations
 
+import os
 import struct
 
 from .base import MXNetError
 
-__all__ = ["export_stablehlo", "load_stablehlo_jax", "read_stablehlo"]
+__all__ = ["export_stablehlo", "load_stablehlo_jax", "read_stablehlo",
+           "strip_jax_blob"]
 
 _MAGIC = b"MXTPUSHLO2"
 
@@ -103,6 +108,32 @@ def read_stablehlo(path: str) -> bytes:
     return _read(path, want_blob=False)[0]
 
 
+def strip_jax_blob(path: str) -> int:
+    """Rewrite the bundle WITHOUT its jax-export section (``n_blob =
+    0``): pure-C deployments keep only the raw StableHLO module the
+    PJRT C ABI consumes, halving the artifact.  Atomic (temp file +
+    rename — a crash never leaves a torn bundle) and idempotent.
+    Returns the number of bytes saved.  ``read_stablehlo`` is
+    unaffected; ``load_stablehlo_jax`` on a stripped bundle raises a
+    clear ``MXNetError``."""
+    code, _ = _read(path, want_blob=False)
+    before = os.path.getsize(path)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QQ", len(code), 0))
+            f.write(code)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)      # a failed write must not leak .tmp*
+        except OSError:
+            pass
+        raise
+    return before - os.path.getsize(path)
+
+
 def load_stablehlo_jax(path: str):
     """Load the bundle as a Python callable (in-process consumer;
     returns a list of numpy arrays)."""
@@ -110,6 +141,12 @@ def load_stablehlo_jax(path: str):
     import numpy as np
 
     _, blob = _read(path)
+    if not blob:
+        raise MXNetError(
+            f"{path} carries no jax-export blob (stripped via "
+            "strip_jax_blob for pure-C deployment); only "
+            "read_stablehlo / the PJRT C ABI can consume it — "
+            "re-export with export_stablehlo for in-process use")
     import jax.export  # not an attr of the bare package on jax 0.4.x
     exported = jax.export.deserialize(blob)
 

@@ -54,7 +54,8 @@ from . import faults
 
 __all__ = ["CheckpointManager", "ls_dir", "verify_dir", "prune_dir",
            "managers_created", "known_dirs", "write_arrays",
-           "read_arrays", "align_params"]
+           "read_arrays", "align_params", "timed_recover",
+           "record_recovery"]
 
 FORMAT = 1
 _STEP_RE = re.compile(r"^step-(\d{8})$")
@@ -213,6 +214,121 @@ def _npy_bytes(host: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
+# -- shared shard IO ---------------------------------------------------------
+# ONE writer/reader pair for hashed .npy shard dirs, shared by
+# CheckpointManager._write/_load_checkpoint AND write_arrays/
+# read_arrays (the store under checkpoint.OrbaxCheckpoint) — the same
+# fault hooks, hashing, atomic-manifest, and integrity checks apply to
+# both formats because they ARE the same format (different manifest
+# kinds).
+
+def _write_shard(tmp: str, shards: List[dict], name: str, arr,
+                 kind: str = "array", index=None, leaf=None,
+                 spec=None) -> None:
+    """Append one hashed ``.npy`` shard under ``tmp/shards`` and its
+    manifest record to ``shards`` (fault points ``host_copy`` /
+    ``checkpoint_write`` fire here for every writer)."""
+    if faults._active:
+        faults.maybe_fire("host_copy", name=name)
+    host = np.asarray(arr)
+    data = _npy_bytes(host)
+    fname = f"shards/{len(shards):03d}.npy"
+    if faults._active:
+        faults.maybe_fire("checkpoint_write", name=name)
+    with open(os.path.join(tmp, fname), "wb") as f:
+        f.write(data)
+    shards.append({
+        "file": fname, "kind": kind, "name": name,
+        "index": index, "leaf": leaf,
+        "shape": [int(d) for d in host.shape],
+        "dtype": str(host.dtype),
+        "sharding": spec or "()",
+        "sha256": hashlib.sha256(data).hexdigest()})
+
+
+def _write_manifest(tmp: str, manifest: dict) -> None:
+    """Write ``tmp/manifest.json`` atomically (part + replace): the
+    manifest is the commit marker WITHIN the dir, so it lands last and
+    whole."""
+    mtmp = os.path.join(tmp, "manifest.json.part")
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(mtmp, os.path.join(tmp, "manifest.json"))
+
+
+def _atomic_publish(tmp: str, final: str) -> None:
+    """Publish ``tmp`` as ``final``: one rename, or — when ``final``
+    exists — the ``.old`` overwrite swap, serialized against
+    concurrent in-process heals (the final-absent window between the
+    two renames must not race ``_heal_dir``)."""
+    if os.path.exists(final):
+        old = final + ".old"
+        with _SWAP_LOCK:
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, final)
+
+
+def _load_manifest_json(path: str, kind: str,
+                        missing_msg: Optional[str] = None) -> dict:
+    """Parse + validate ``path/manifest.json`` (kind + format);
+    raises ``MXNetError`` for anything short of a committed, well-
+    formed manifest of the expected kind."""
+    mpath = os.path.join(path, "manifest.json")
+    if not os.path.exists(mpath):
+        raise MXNetError(missing_msg or (
+            f"{path} holds no manifest.json — not a committed "
+            "checkpoint (a crashed write leaves only temp dirs)"))
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MXNetError(
+            f"corrupt checkpoint manifest {mpath}: {e!r}") from e
+    if manifest.get("kind") != kind or manifest.get("format") != FORMAT:
+        raise MXNetError(
+            f"{mpath} kind/format mismatch (want {kind!r} v{FORMAT})")
+    return manifest
+
+
+def _read_shard_payloads(path: str, manifest: dict,
+                         verify: bool) -> List[tuple]:
+    """``[(record, host_array)]`` for every manifest shard, with
+    integrity failures (unreadable / hash mismatch / invalid payload /
+    shape drift) raised as ``MXNetError`` instead of returning
+    garbage."""
+    out = []
+    for rec in manifest.get("shards", ()):
+        spath = os.path.join(path, rec["file"])
+        try:
+            with open(spath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise MXNetError(
+                f"checkpoint shard {spath} unreadable: {e!r}") from e
+        if verify and hashlib.sha256(data).hexdigest() != \
+                rec.get("sha256"):
+            raise MXNetError(
+                f"checkpoint shard {rec['file']} ({rec['name']}) "
+                f"failed its sha256 check in {path} — the checkpoint "
+                "is corrupt; restore an earlier step")
+        try:
+            host = np.load(io.BytesIO(data), allow_pickle=False)
+        except Exception as e:
+            raise MXNetError(
+                f"checkpoint shard {rec['file']} is not a valid .npy "
+                f"payload: {e!r}") from e
+        if list(host.shape) != list(rec.get("shape", host.shape)):
+            raise MXNetError(
+                f"checkpoint shard {rec['file']} shape {host.shape} "
+                f"!= manifest {rec.get('shape')}")
+        out.append((rec, host))
+    return out
+
+
 class CheckpointManager:
     """Durable train-state checkpoints for one trainer.
 
@@ -348,31 +464,15 @@ class CheckpointManager:
         os.makedirs(shards_dir)
 
         shards: List[Dict[str, Any]] = []
-
-        def _write_leaf(kind, name, index, leaf_pos, arr, spec):
-            if faults._active:
-                faults.maybe_fire("host_copy", name=name)
-            host = np.asarray(arr)
-            data = _npy_bytes(host)
-            fname = f"shards/{len(shards):03d}.npy"
-            if faults._active:
-                faults.maybe_fire("checkpoint_write", name=name)
-            with open(os.path.join(tmp, fname), "wb") as f:
-                f.write(data)
-            shards.append({
-                "file": fname, "kind": kind, "name": name,
-                "index": index, "leaf": leaf_pos,
-                "shape": [int(d) for d in host.shape],
-                "dtype": str(host.dtype),
-                "sharding": spec or "()",
-                "sha256": hashlib.sha256(data).hexdigest()})
-
         for i, (name, arr, spec) in enumerate(payload["params"]):
-            _write_leaf("param", name, i, None, arr, spec)
+            _write_shard(tmp, shards, name, arr, kind="param",
+                         index=i, spec=spec)
         for i, j, arr in payload["states"]:
-            _write_leaf("state", f"state:{i}:{j}", i, j, arr, None)
+            _write_shard(tmp, shards, f"state:{i}:{j}", arr,
+                         kind="state", index=i, leaf=j)
         for j, arr in enumerate(payload.get("residuals") or ()):
-            _write_leaf("residual", f"residual:{j}", None, j, arr, None)
+            _write_shard(tmp, shards, f"residual:{j}", arr,
+                         kind="residual", leaf=j)
 
         manifest = {
             "format": FORMAT, "kind": "mxtpu_elastic_checkpoint",
@@ -388,19 +488,8 @@ class CheckpointManager:
             "rng": payload["rng"],
             "shards": shards,
         }
-        mtmp = os.path.join(tmp, "manifest.json.part")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f, indent=1, sort_keys=True)
-        os.replace(mtmp, os.path.join(tmp, "manifest.json"))
-        if os.path.exists(final):      # force=True overwrite
-            old = final + ".old"
-            with _SWAP_LOCK:           # heal must not race the gap
-                shutil.rmtree(old, ignore_errors=True)
-                os.rename(final, old)
-                os.rename(tmp, final)
-                shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, final)      # THE commit point
+        _write_manifest(tmp, manifest)
+        _atomic_publish(tmp, final)    # THE commit point
         self.prune()
         dt = time.perf_counter() - t0
         telemetry.counter("mxtpu_checkpoints_saved_total",
@@ -512,6 +601,48 @@ class CheckpointManager:
         return int(manifest["step"])
 
 
+def record_recovery(where: str, seconds: float, poisoned: bool,
+                    **fields) -> None:
+    """Emit the recovery telemetry triple — counter, time-to-recover
+    histogram, retained ``recovery`` event — in ONE place for every
+    recoverable owner (the two train stacks via :func:`timed_recover`,
+    the serving plane via ``Server.recover``)."""
+    from .. import telemetry
+    telemetry.counter("mxtpu_recoveries_total",
+                      "recoveries of a poisoned or healthy owner "
+                      "(train stacks: checkpoint restore; serving: "
+                      "pool rebuild + request replay)").inc()
+    telemetry.histogram(
+        "mxtpu_recovery_seconds",
+        "time to rebuild an owner's dispatchable state after "
+        "recover() (s)").observe(seconds)
+    telemetry.record_event("recovery", where=where,
+                           seconds=round(seconds, 4),
+                           poisoned=poisoned, **fields)
+
+
+def timed_recover(manager: "CheckpointManager", owner, where: str,
+                  step: Optional[int] = None,
+                  name: Optional[str] = None,
+                  was_poisoned: bool = False) -> int:
+    """The shared ``recover()`` body (docs/elasticity.md): restore the
+    last committed checkpoint (or ``step``) into ``owner`` with the
+    timeline FORKED (newer checkpoints invalidated, so a later crash
+    can never resume the abandoned run) and emit the recovery
+    telemetry triple — counter, latency histogram, retained event.
+    ``gluon.CompiledStep.recover`` and ``DataParallelTrainer.recover``
+    both delegate here."""
+    t0 = time.perf_counter()
+    restored = manager.restore(step=step, into=owner,
+                               invalidate_newer=True)
+    fields = {"step": restored}
+    if name is not None:
+        fields["name"] = name
+    record_recovery(where, time.perf_counter() - t0, was_poisoned,
+                    **fields)
+    return restored
+
+
 def write_arrays(path: str, arrays: Dict[str, np.ndarray],
                  kind: str = "mxtpu_array_dict",
                  extra: Optional[dict] = None) -> str:
@@ -536,38 +667,14 @@ def write_arrays(path: str, arrays: Dict[str, np.ndarray],
                 continue
         shutil.rmtree(stale, ignore_errors=True)
     os.makedirs(os.path.join(tmp, "shards"))
-    shards = []
+    shards: List[dict] = []
     for name, value in arrays.items():
-        if faults._active:
-            faults.maybe_fire("host_copy", name=name)
-        host = np.asarray(value)
-        data = _npy_bytes(host)
-        fname = f"shards/{len(shards):03d}.npy"
-        if faults._active:
-            faults.maybe_fire("checkpoint_write", name=name)
-        with open(os.path.join(tmp, fname), "wb") as f:
-            f.write(data)
-        shards.append({"file": fname, "kind": "array", "name": name,
-                       "index": None, "leaf": None,
-                       "shape": [int(d) for d in host.shape],
-                       "dtype": str(host.dtype),
-                       "sharding": "()",
-                       "sha256": hashlib.sha256(data).hexdigest()})
+        _write_shard(tmp, shards, name, value)
     manifest = {"format": FORMAT, "kind": kind,
                 "created": time.time(), "shards": shards,
                 **(extra or {})}
-    mtmp = os.path.join(tmp, "manifest.json.part")
-    with open(mtmp, "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
-    os.replace(mtmp, os.path.join(tmp, "manifest.json"))
-    if os.path.exists(path):
-        old = path + ".old"
-        shutil.rmtree(old, ignore_errors=True)
-        os.rename(path, old)
-        os.rename(tmp, path)
-        shutil.rmtree(old, ignore_errors=True)
-    else:
-        os.rename(tmp, path)
+    _write_manifest(tmp, manifest)
+    _atomic_publish(tmp, path)
     return path
 
 
@@ -591,41 +698,12 @@ def read_arrays(path: str, kind: str = "mxtpu_array_dict",
                 pass
     if not os.path.isdir(path):
         raise MXNetError(f"no checkpoint at {path}")
-    mpath = os.path.join(path, "manifest.json")
-    if not os.path.exists(mpath):
-        raise MXNetError(
-            f"{path} holds no manifest.json — not a committed "
-            "checkpoint (or a pre-elastic artifact)")
-    try:
-        with open(mpath) as f:
-            manifest = json.load(f)
-    except (OSError, ValueError) as e:
-        raise MXNetError(f"corrupt manifest {mpath}: {e!r}") from e
-    if manifest.get("kind") != kind or manifest.get("format") != FORMAT:
-        raise MXNetError(
-            f"{mpath} kind/format mismatch (want {kind!r} v{FORMAT})")
-    out = {}
-    for rec in manifest.get("shards", ()):
-        spath = os.path.join(path, rec["file"])
-        try:
-            with open(spath, "rb") as f:
-                data = f.read()
-        except OSError as e:
-            raise MXNetError(
-                f"checkpoint shard {spath} unreadable: {e!r}") from e
-        if verify and hashlib.sha256(data).hexdigest() != \
-                rec.get("sha256"):
-            raise MXNetError(
-                f"checkpoint shard {rec['file']} ({rec['name']}) "
-                f"failed its sha256 check in {path}")
-        try:
-            out[rec["name"]] = np.load(io.BytesIO(data),
-                                       allow_pickle=False)
-        except Exception as e:
-            raise MXNetError(
-                f"checkpoint shard {rec['file']} is not a valid .npy "
-                f"payload: {e!r}") from e
-    return manifest, out
+    manifest = _load_manifest_json(
+        path, kind,
+        missing_msg=f"{path} holds no manifest.json — not a committed "
+                    "checkpoint (or a pre-elastic artifact)")
+    return manifest, {rec["name"]: host for rec, host in
+                      _read_shard_payloads(path, manifest, verify)}
 
 
 def align_params(param_names: List[str], payload_params) -> List[tuple]:
@@ -653,48 +731,13 @@ def _load_checkpoint(path: str, verify: bool = True):
     """(manifest, [host arrays aligned with manifest["shards"]]).
     Raises ``MXNetError`` for anything short of a complete, committed,
     hash-clean checkpoint."""
-    mpath = os.path.join(path, "manifest.json")
-    if not os.path.exists(mpath):
-        raise MXNetError(
-            f"{path} is not a committed checkpoint (no manifest.json "
-            "— a crashed write leaves only .tmp-step-* dirs)")
-    try:
-        with open(mpath) as f:
-            manifest = json.load(f)
-    except (OSError, ValueError) as e:
-        raise MXNetError(
-            f"corrupt checkpoint manifest {mpath}: {e!r}") from e
-    if manifest.get("kind") != "mxtpu_elastic_checkpoint" or \
-            manifest.get("format") != FORMAT:
-        raise MXNetError(f"{mpath} is not an mxtpu elastic checkpoint "
-                         "(kind/format mismatch)")
-    arrays = []
-    for rec in manifest.get("shards", ()):
-        spath = os.path.join(path, rec["file"])
-        try:
-            with open(spath, "rb") as f:
-                data = f.read()
-        except OSError as e:
-            raise MXNetError(
-                f"checkpoint shard {spath} unreadable: {e!r}") from e
-        if verify and hashlib.sha256(data).hexdigest() != \
-                rec.get("sha256"):
-            raise MXNetError(
-                f"checkpoint shard {rec['file']} ({rec['name']}) "
-                f"failed its sha256 check in {path} — the checkpoint "
-                "is corrupt; restore an earlier step")
-        try:
-            host = np.load(io.BytesIO(data), allow_pickle=False)
-        except Exception as e:
-            raise MXNetError(
-                f"checkpoint shard {rec['file']} is not a valid .npy "
-                f"payload: {e!r}") from e
-        if list(host.shape) != list(rec.get("shape", host.shape)):
-            raise MXNetError(
-                f"checkpoint shard {rec['file']} shape {host.shape} "
-                f"!= manifest {rec.get('shape')}")
-        arrays.append(host)
-    return manifest, arrays
+    manifest = _load_manifest_json(
+        path, "mxtpu_elastic_checkpoint",
+        missing_msg=f"{path} is not a committed checkpoint (no "
+                    "manifest.json — a crashed write leaves only "
+                    ".tmp-step-* dirs)")
+    return manifest, [host for _rec, host in
+                      _read_shard_payloads(path, manifest, verify)]
 
 
 # -- directory-level tooling (tools/mxckpt.py, mxlint MXL502) ---------------

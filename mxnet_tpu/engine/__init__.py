@@ -33,7 +33,7 @@ __all__ = ["invoke_compiled", "waitall", "is_naive", "set_bulk_size",
            "cache_info", "cache_size", "live_bytes", "live_arrays",
            "clear_cache",
            "drop_cached", "reset_counters", "dispatch_count",
-           "aot_compile", "persist", "retrying_call"]
+           "compile_counts", "aot_compile", "persist", "retrying_call"]
 
 _lock = threading.Lock()
 _jit_cache: Dict[Tuple, Callable] = {}
@@ -629,6 +629,14 @@ def dispatch_count() -> int:
     cheap accessor for per-step deltas; ``cache_info()`` builds the
     whole per-op dict, which is too heavy for once-per-step reads."""
     return _dispatches
+
+
+def compile_counts() -> Tuple[int, int]:
+    """``(misses, fresh_compiles)`` — the cheap accessor for
+    per-dispatch compile deltas (the serving plane brackets every
+    steady-state dispatch with this to attribute compiles to ITS
+    programs without a cache_info() walk)."""
+    return _misses, _fresh_compiles
 
 
 def cache_size() -> int:

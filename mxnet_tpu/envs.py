@@ -204,6 +204,22 @@ _reg("MXTPU_HEALTH_PATIENCE", int, 3,
      "Consecutive anomalous health samples before the sentinel "
      "escalates to a 'divergence' verdict (the rollback trigger for "
      "non-NaN divergence).")
+_reg("MXTPU_SERVING_SLOTS", int, 4,
+     "Default batch slots per serving bucket (concurrent requests one "
+     "compiled decode program advances in lockstep) when "
+     "serving.Server is constructed without explicit buckets. See "
+     "docs/serving.md.")
+_reg("MXTPU_SERVING_BUCKETS", str, "32,128",
+     "Default prompt-length buckets for serving.Server (comma-"
+     "separated): a request lands in the smallest bucket holding its "
+     "prompt (right-padded there); each bucket owns one compiled "
+     "prefill and one compiled decode program.")
+_reg("MXTPU_SERVING_MAX_NEW_TOKENS", int, 32,
+     "Default per-request generation cap for serving.Server; sizes "
+     "the KV-cache pages (cache_len = prompt_len bucket + this).")
+_reg("MXTPU_SERVING_MAX_QUEUE", int, 128,
+     "Bound on the serving wait queue; submissions past it are "
+     "rejected with a retained slot_oom telemetry event.")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

@@ -430,25 +430,10 @@ class CompiledStep:
         Recovery FORKS the timeline: checkpoints newer than the
         restored step are invalidated, so a later crash can never
         resume from the abandoned run."""
-        import time
-        from .. import telemetry
-        t0 = time.perf_counter()
-        was_poisoned = self._poisoned is not None
-        restored = manager.restore(step=step, into=self,
-                                   invalidate_newer=True)
-        dt = time.perf_counter() - t0
-        telemetry.counter("mxtpu_recoveries_total",
-                          "checkpoint recoveries (poisoned or "
-                          "explicit)").inc()
-        telemetry.histogram(
-            "mxtpu_recovery_seconds",
-            "time to rebuild trainer state from the last committed "
-            "checkpoint (s)").observe(dt)
-        telemetry.record_event("recovery", where="compiled_step",
-                               name=self.name, step=restored,
-                               seconds=round(dt, 4),
-                               poisoned=was_poisoned)
-        return restored
+        from ..elastic.manager import timed_recover
+        return timed_recover(manager, self, "compiled_step",
+                             step=step, name=self.name,
+                             was_poisoned=self._poisoned is not None)
 
     # -- path selection ---------------------------------------------------
     def _coerce(self, data, label):

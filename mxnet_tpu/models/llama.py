@@ -331,9 +331,15 @@ class LlamaForCausalLM(HybridBlock):
                           transpose_b=True)
         return self.lm_head(h).reshape((-1, self.model.vocab_size))
 
-    def prefill(self, tokens, caches):
+    def prefill(self, tokens, caches, last_pos=None):
         """Batched prompt pass filling the caches; returns the LAST
-        position's logits (B, vocab)."""
+        position's logits (B, vocab).
+
+        ``last_pos`` (an NDArray of per-row indices, shape (B,)) reads
+        the logits at each row's OWN last real token instead of the
+        final position — the right-padded bucket-prompt shape the
+        serving plane feeds (pad rows beyond ``last_pos`` stay causal
+        garbage that the decode-time validity mask never exposes)."""
         import numpy as np
         from .. import ndarray as nd
         x = self.model.embed(tokens)
@@ -351,10 +357,27 @@ class LlamaForCausalLM(HybridBlock):
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.prefill(x, ck, cv, perm=perm)
         h = self.model.final_norm(x)
-        return self._head(h[:, -1:])
+        if last_pos is None:
+            return self._head(h[:, -1:])
+        b = tokens.shape[0]
+        # per-row gather as a one-hot contraction (hybridizable: no
+        # host-side indices, positions ride as a dynamic input)
+        pos = nd.arange(s, ctx=tokens.context).reshape((1, s))
+        lp = last_pos.reshape((-1, 1))
+        onehot = (pos <= lp) * (pos >= lp)             # (B, S) {0,1}
+        sel = (h * onehot.reshape((b, s, 1))).sum(axis=1)
+        return self._head(sel.reshape((b, 1, self.model._units)))
 
     def decode_step(self, token, caches, offset):
-        """One incremental step: token (B, 1) → logits (B, vocab)."""
+        """One incremental step: token (B, 1) → logits (B, vocab).
+
+        ``offset`` may be a python number / 0-d NDArray (one shared
+        position — the classic generation loop) or a (B,)-shaped
+        NDArray giving every batch row its OWN absolute position (the
+        continuous-batching serving shape: each slot decodes at its own
+        depth; rope, the cache scatter, and the validity mask all
+        specialize per row through the same dynamic-input path, so the
+        mixed-depth batch still reuses ONE compiled program)."""
         from .. import ndarray as nd
         x = self.model.embed(token)
         # key-validity mask (pos <= offset), shared across all layers;
@@ -369,6 +392,9 @@ class LlamaForCausalLM(HybridBlock):
         off = offset if isinstance(offset, nd.NDArray) else float(offset)
         pos = nd.arange(max_len, ctx=token.context)
         w = self.model.sliding_window
+        if isinstance(off, nd.NDArray) and off.ndim == 1:
+            return self._decode_step_slots(x, caches, off, pos, w,
+                                           max_len)
         slot = None
         if w is not None and max_len <= int(w):
             # ROLLING buffer (cache holds exactly the window): slot
@@ -393,6 +419,32 @@ class LlamaForCausalLM(HybridBlock):
         mask = mask.reshape((1, 1, 1, max_len))
         for layer, (ck, cv) in zip(self.model.layers, caches):
             x = layer.step(x, ck, cv, offset, mask, slot=slot)
+        h = self.model.final_norm(x)
+        return self._head(h)
+
+    def _decode_step_slots(self, x, caches, off, pos, w, max_len):
+        """Per-slot decode body: ``off`` is (B,) absolute positions.
+        Same math as the shared-offset path, with the mask, rope
+        offsets, and cache-scatter slots specialized PER ROW (rope and
+        ``_cache_update`` broadcast a (B,)-shaped dynamic offset).
+        Rows are independent in attention, so one slot's cache garbage
+        (an evicted request) can never reach another's logits."""
+        b = x.shape[0]
+        posr = pos.reshape((1, max_len))
+        offv = off.reshape((-1, 1))
+        slot = None
+        if w is not None and max_len <= int(w):
+            # rolling buffer: identical policy to the shared path,
+            # elementwise over slots
+            slot = off % float(max_len)
+            mask = posr <= offv
+        else:
+            mask = posr <= offv
+            if w is not None:
+                mask = mask * (posr > offv - float(w))
+        mask = mask.reshape((b, 1, 1, max_len))
+        for layer, (ck, cv) in zip(self.model.layers, caches):
+            x = layer.step(x, ck, cv, off, mask, slot=slot)
         h = self.model.final_norm(x)
         return self._head(h)
 

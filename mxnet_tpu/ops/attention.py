@@ -274,17 +274,25 @@ def rope(x, offset=0, *, base=10000.0):
     ``offset`` shifts positions (decode-time KV-cache continuation); it
     is a dynamic scalar attr so a generation loop stepping offset
     0,1,2,... reuses one compiled executable instead of recompiling
-    per position.
+    per position.  A (B,)-shaped offset gives every batch row its OWN
+    position — the continuous-batching decode shape, where each serving
+    slot sits at a different depth in its sequence.
     """
     s, d = x.shape[1], x.shape[-1]
-    pos = (jnp.arange(s, dtype=jnp.float32)
-           + jnp.asarray(offset, jnp.float32))
+    off = jnp.asarray(offset, jnp.float32)
+    base_pos = jnp.arange(s, dtype=jnp.float32)
+    if off.ndim:
+        pos = base_pos[None, :] + off.reshape(-1, 1)   # (B, S)
+    else:
+        # scalar path: keep the exact historical fp sequence (add THEN
+        # broadcast) so offset-scalar callers stay bit-identical
+        pos = (base_pos + off)[None, :]                # (1, S)
     inv = jnp.power(
         jnp.float32(base),
         -jnp.arange(0, d, 2, dtype=jnp.float32) / jnp.float32(d))
-    ang = pos[:, None] * inv[None, :]                  # (S, D/2)
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    ang = pos[..., None] * inv                         # (B|1, S, D/2)
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1 = x[..., 0::2]
     x2 = x[..., 1::2]
     r1 = x1 * cos - x2 * sin

@@ -925,10 +925,17 @@ def _cache_update(cache, new, offset=0):
     """Write ``new`` into ``cache`` at position ``offset`` along axis 1
     (KV-cache decode).  ``offset`` is a dynamic scalar attr so every
     decode step reuses ONE compiled scatter instead of compiling a new
-    program per position."""
+    program per position.  A (B,)-shaped offset scatters each batch
+    row at its OWN position (per-slot decode in the serving plane)."""
+    off = jnp.asarray(offset, jnp.int32)
+    if off.ndim:
+        import jax
+        return jax.vmap(
+            lambda c, n, o: lax.dynamic_update_slice_in_dim(
+                c, n.astype(c.dtype), o, axis=0)
+        )(cache, new, off.reshape(-1))
     return lax.dynamic_update_slice_in_dim(
-        cache, new.astype(cache.dtype),
-        jnp.asarray(offset, jnp.int32), axis=1)
+        cache, new.astype(cache.dtype), off, axis=1)
 
 
 @register("_contrib_arange_like", num_inputs=1)

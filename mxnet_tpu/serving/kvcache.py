@@ -1,0 +1,107 @@
+"""KV-cache plane: preallocated per-slot K/V pages as DONATED carry
+state (docs/serving.md).
+
+A bucket's caches are one NDArray pair per transformer layer, shaped
+``(slots, cache_len, kv_heads, head_dim)`` — slot ``j`` is request
+``j``'s page.  Every decode dispatch donates the whole pool to the
+compiled program (the PR 2/3 donation protocol): the executable writes
+each active slot's new K/V in place and returns the successor buffers,
+so a decode step never doubles cache HBM.  ``adopt()`` swaps the
+successors in; a dispatch that fails AFTER the donation consumed the
+buffers latches ``poisoned`` (the pool holds dead arrays) and
+``reset()`` — driven by ``Server.recover()`` — rebuilds zeroed pages.
+
+Slot lifecycle is content-swap only: admission scatters a freshly
+prefilled page into slot ``j`` (one ``lax.dynamic_update_slice`` per
+layer inside the admit program), eviction just drops the slot's
+active-mask bit on the host.  Shapes never change, so steady state
+retraces NOTHING (docs/serving.md, "Bucket anatomy").
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["KVCachePool"]
+
+
+class KVCachePool:
+    """Per-bucket preallocated K/V pages for ``slots`` concurrent
+    requests over ``lm``'s layers.
+
+    Args:
+      lm: a ``models.LlamaForCausalLM`` (anything with ``init_cache``).
+      slots: concurrent requests the pool holds (the bucket batch dim).
+      cache_len: positions per slot (bucket prompt length + the
+        server's max new tokens).
+      ctx: device context for the pages.
+      dtype: cache dtype (float; ``bfloat16`` halves page HBM and
+        decode bandwidth — ``init_cache`` validates).
+    """
+
+    def __init__(self, lm, slots: int, cache_len: int, ctx=None,
+                 dtype: str = "float32"):
+        if slots < 1 or cache_len < 1:
+            raise MXNetError(
+                f"KVCachePool needs slots >= 1 and cache_len >= 1, got "
+                f"{slots}/{cache_len}")
+        self._lm = lm
+        self.slots = int(slots)
+        self.cache_len = int(cache_len)
+        self.ctx = ctx
+        self.dtype = str(dtype)
+        self.poisoned: Optional[str] = None
+        self._pairs: List[Tuple] = lm.init_cache(
+            self.slots, self.cache_len, ctx=ctx, dtype=dtype)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self._pairs)
+
+    def pairs(self):
+        """The live per-layer ``(K, V)`` NDArray pairs."""
+        return list(self._pairs)
+
+    def flat(self) -> list:
+        """Flat jax buffers ``[k0, v0, k1, v1, ...]`` in donate order —
+        exactly the slice of the dispatch argument list the donate
+        tuple names."""
+        return [s._data for pair in self._pairs for s in pair]
+
+    def nbytes(self) -> int:
+        return sum(int(s._data.nbytes) for pair in self._pairs
+                   for s in pair)
+
+    def adopt(self, new_flat):
+        """Swap the post-dispatch successor buffers in (the donated
+        predecessors are already dead)."""
+        if len(new_flat) != 2 * len(self._pairs):
+            raise MXNetError(
+                f"adopt: expected {2 * len(self._pairs)} cache buffers, "
+                f"got {len(new_flat)}")
+        for i, (k, v) in enumerate(self._pairs):
+            k._set_data(new_flat[2 * i])
+            v._set_data(new_flat[2 * i + 1])
+
+    def poison(self, error: str):
+        """Latch the post-donation-failure state: the pages were
+        consumed by a dispatch that died, so nothing here is
+        dispatchable until :meth:`reset`."""
+        self.poisoned = error
+
+    def consumed(self) -> bool:
+        """Did a dispatch actually consume the pages?  (Distinguishes
+        post-donation failures — dead buffers — from pre-dispatch
+        trace/compile errors that left everything alive.)"""
+        return any(
+            getattr(s._data, "is_deleted", lambda: False)()
+            for pair in self._pairs for s in pair)
+
+    def reset(self):
+        """Rebuild zeroed pages and clear the poison latch (the
+        recovery half of the donation protocol — every resident
+        request must be re-prefilled by the caller)."""
+        self._pairs = self._lm.init_cache(
+            self.slots, self.cache_len, ctx=self.ctx, dtype=self.dtype)
+        self.poisoned = None

@@ -1,0 +1,767 @@
+"""``Server``: the engine + persist integration of the serving plane.
+
+One compiled PREFILL program and one compiled DECODE program per
+``(slots, prompt_len)`` bucket (plus ``decode_multi(K)`` lax.scan
+variants), all dispatched through ``engine.invoke_compiled`` with the
+bucket's KV-cache pool DONATED:
+
+* **admit** — prefill one right-padded prompt at batch 1, scatter the
+  resulting K/V page into the pool at the assigned slot
+  (``lax.dynamic_update_slice`` per layer), and sample the first token
+  at the prompt's own last position — ONE dispatch per admission;
+* **decode** — every active slot advances one token in lockstep at its
+  OWN absolute position (per-slot rope offsets / cache scatter /
+  validity mask ride as dynamic inputs), the sampler picks
+  greedy-or-temperature per slot, and the whole pool round-trips
+  through donation — ONE dispatch per step, zero retraces across any
+  admit/evict sequence (shapes never change);
+* **decode_multi(K)** — K decode steps as one dispatch (``lax.scan``
+  with the pool as carry, like ``step_multi``): one host sync per K
+  tokens instead of per token.
+
+Sampling is greedy at ``temperature == 0`` and softmax sampling with
+optional server-wide top-k truncation otherwise; the RNG threads the
+CachedOp fold_in scheme — one base key INPUT per dispatch (drawn from
+the global stream, so keys never retrace) folded per inner step and
+per slot.
+
+``save_signature``/``warm_start`` extend the PR 5 AOT warm-start
+machinery to serving: a fresh process precompiles every recorded
+bucket variant through ``engine.aot_compile`` + the persistent tier
+and serves its FIRST token with 0 fresh compiles.
+
+Failure protocol (docs/elasticity.md applied to serving): the engine's
+bounded transient retry covers pre-donation hiccups; a dispatch that
+fails AFTER consuming the donated pool poisons the bucket, and
+``recover()`` rebuilds zeroed pages and requeues every resident
+request (prompts are host-owned, so they replay from scratch).
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from .kvcache import KVCachePool
+from .scheduler import ACTIVE, BucketScheduler, Request
+
+__all__ = ["Server", "servers"]
+
+_uid = itertools.count(1)
+
+# live-server registry read by mxlint's serving runtime pass
+# (``analysis.analyze_serving`` — MXL601's runtime twin)
+_reg_lock = threading.Lock()
+_servers: "weakref.WeakValueDictionary[int, Server]" = \
+    weakref.WeakValueDictionary()
+
+
+def servers() -> List["Server"]:
+    with _reg_lock:
+        return [s for s in _servers.values()]
+
+
+def _reset_registry():
+    """Test hook."""
+    with _reg_lock:
+        _servers.clear()
+
+
+def _default_buckets():
+    from .. import envs
+    slots = int(envs.get("MXTPU_SERVING_SLOTS"))
+    lens = [int(x) for x in
+            str(envs.get("MXTPU_SERVING_BUCKETS")).split(",") if x.strip()]
+    return [(slots, n) for n in lens]
+
+
+class Server:
+    """Continuously batched serving over a ``LlamaForCausalLM``-shaped
+    model (anything exposing ``init_cache``/``prefill``/``decode_step``
+    — the model-zoo decoder contract).
+
+    Args:
+      lm: initialized causal LM.
+      buckets: ``[(slots, prompt_len), ...]`` shape classes (defaults
+        from ``MXTPU_SERVING_SLOTS`` x ``MXTPU_SERVING_BUCKETS``).
+      max_new_tokens: per-request generation cap (sizes the cache
+        pages: ``cache_len = prompt_len + max_new_tokens``); defaults
+        to ``MXTPU_SERVING_MAX_NEW_TOKENS``.
+      top_k: server-wide top-k truncation for sampled requests (shapes
+        the compiled sampler; 0 = full softmax).
+      eos_id: stop token (None = run to the token budget).
+      ctx: device context; default current.
+      cache_dtype: KV page dtype (``bfloat16`` halves page HBM).
+      max_queue: wait-queue bound (``MXTPU_SERVING_MAX_QUEUE``).
+    """
+
+    def __init__(self, lm, buckets=None, max_new_tokens: int = None,
+                 top_k: int = 0, eos_id: Optional[int] = None,
+                 ctx=None, cache_dtype: str = "float32",
+                 max_queue: Optional[int] = None):
+        from .. import envs
+        from ..context import current_context
+        self.lm = lm
+        self.ctx = ctx or current_context()
+        if max_new_tokens is None:
+            max_new_tokens = int(envs.get("MXTPU_SERVING_MAX_NEW_TOKENS"))
+        if max_queue is None:
+            max_queue = int(envs.get("MXTPU_SERVING_MAX_QUEUE"))
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.cache_dtype = str(cache_dtype)
+        vocab = int(lm.model.vocab_size)
+        self._kk = min(int(top_k), vocab) if top_k else 0
+        self.sched = BucketScheduler(buckets or _default_buckets(),
+                                     self.max_new_tokens, max_queue)
+        try:
+            self._param_nds = [p.data(self.ctx)
+                               for p in lm.collect_params().values()]
+        except Exception as e:
+            raise MXNetError(
+                "Server needs an initialized model (run initialize() "
+                f"and one forward first): {e!r}") from e
+        self.name = f"serving_{lm.name}_{next(_uid)}"
+        self._pools: Dict[tuple, KVCachePool] = {}
+        for b in self.sched.buckets:
+            self._pools[b.key] = KVCachePool(
+                lm, b.slots, b.cache_len, ctx=self.ctx,
+                dtype=self.cache_dtype)
+        self._pure_cache: Dict[str, callable] = {}
+        self._variants: Dict[str, dict] = {}   # suffix -> manifest row
+        self._warmed: set = set()              # suffixes dispatched
+        self._bucket_stats: Dict[tuple, dict] = {
+            b.key: {"steady_dispatches": 0, "tokens": 0,
+                    "steady_misses": 0, "steady_fresh_compiles": 0}
+            for b in self.sched.buckets}
+        self._poisoned: Optional[str] = None
+        self.warm_started = False
+        self._persist_pinned = False
+        self._struct_hash = self._compute_struct_hash()
+        self._persist_base = f"serving_{lm.name}_{self._struct_hash}"
+        with _reg_lock:
+            _servers[id(self)] = self
+
+    # -- identity ---------------------------------------------------------
+    def _compute_struct_hash(self) -> str:
+        parts = (
+            tuple((tuple(p.data(self.ctx).shape),
+                   str(p.data(self.ctx).dtype))
+                  for p in self.lm.collect_params().values()),
+            tuple(sorted((b.slots, b.prompt_len, b.cache_len)
+                         for b in self.sched.buckets)),
+            self._kk, self.cache_dtype, self.max_new_tokens,
+            int(self.lm.model.vocab_size))
+        return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+    # -- public API -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0,
+               eos_id: Optional[int] = None) -> Request:
+        """Queue one generation request; admission happens at the next
+        :meth:`step`.  Raises ``MXNetError`` when no bucket fits the
+        prompt or the queue is full (both recorded as retained
+        ``slot_oom`` events)."""
+        from .. import telemetry
+        mnt = self.max_new_tokens if max_new_tokens is None \
+            else min(int(max_new_tokens), self.max_new_tokens)
+        req = Request(prompt, mnt, temperature=temperature,
+                      eos_id=self.eos_id if eos_id is None else eos_id)
+        try:
+            self.sched.enqueue(req)
+        except MXNetError as e:
+            telemetry.record_event(
+                "slot_oom", server=self.name, request=req.id,
+                prompt_len=req.prompt_len,
+                queue_depth=self.sched.queue_depth(),
+                reason=str(e)[:200])
+            raise
+        telemetry.counter("mxtpu_serving_requests_total",
+                          "requests submitted to the serving plane"
+                          ).inc()
+        self._update_gauges()
+        return req
+
+    def step(self, decode_steps: int = 1) -> dict:
+        """One scheduling round: admit every queued request with a free
+        slot (one prefill dispatch each), then advance every non-empty
+        bucket by ``decode_steps`` tokens (ONE decode dispatch per
+        bucket; ``decode_steps > 1`` uses the scan-bulked variant —
+        one host sync per K tokens).  Returns round stats."""
+        if self._poisoned is not None:
+            raise MXNetError(
+                "this Server's KV-cache pages were donated to a "
+                "dispatch that failed and are no longer valid; call "
+                "recover() to rebuild the pools and requeue resident "
+                "requests (docs/serving.md). Original error: "
+                f"{self._poisoned}")
+        admitted = 0
+        pending = self.sched.admissions()
+        for i, (bucket, slot, req) in enumerate(pending):
+            try:
+                self._admit(bucket, slot, req)
+            except Exception:
+                # admissions() reserved EVERY slot up front: the
+                # failed request and the ones behind it were placed
+                # but never prefilled — release them back to the HEAD
+                # of the queue (reverse order preserves FIFO), or a
+                # retried step() would decode their zeroed pages as if
+                # they held real prompts.  When the pool is POISONED,
+                # recover() requeues every resident instead.
+                if self._poisoned is None:
+                    for _b, _s, r in reversed(pending[i:]):
+                        self.sched.evict(r, reason="admit_aborted",
+                                         requeue=True)
+                raise
+            admitted += 1
+        tokens = 0
+        for bucket in self.sched.buckets:
+            if bucket.n_active() == 0:
+                continue
+            tokens += self._decode(bucket, int(decode_steps))
+        self._update_gauges()
+        return {"admitted": admitted, "tokens": tokens,
+                "active": len(self.sched.active_requests()),
+                "queued": self.sched.queue_depth()}
+
+    def run(self, decode_steps: int = 1,
+            max_rounds: Optional[int] = None) -> int:
+        """Step until every submitted request finished; returns rounds
+        run.  ``max_rounds`` bounds runaway loops (default: generous
+        budget derived from the workload)."""
+        if max_rounds is None:
+            pending = len(self.sched.active_requests()) \
+                + self.sched.queue_depth()
+            max_rounds = 16 + pending * (self.max_new_tokens + 2)
+        rounds = 0
+        while (self.sched.active_requests()
+               or self.sched.queue_depth()):
+            if rounds >= max_rounds:
+                raise MXNetError(
+                    f"serving run() exceeded {max_rounds} rounds with "
+                    "requests still live — scheduler wedged?")
+            self.step(decode_steps=decode_steps)
+            rounds += 1
+        return rounds
+
+    def generate(self, prompts, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0,
+                 decode_steps: int = 1) -> List[np.ndarray]:
+        """Batch convenience: submit every prompt, run to drain, and
+        return ``prompt + continuation`` per request (in order)."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens,
+                            temperature=temperature) for p in prompts]
+        self.run(decode_steps=decode_steps)
+        return [r.tokens() for r in reqs]
+
+    def evict(self, req: Request, reason: str = "user",
+              requeue: bool = False) -> bool:
+        """Remove a live request (slot or queue); returns True when it
+        was live (a request that already finished is left untouched —
+        no event, no counter).  Retained ``request_evicted`` event +
+        counter; ``requeue=True`` restarts it from its prompt (the
+        recovery path)."""
+        from .. import telemetry
+        if not self.sched.evict(req, reason, requeue=requeue):
+            return False
+        telemetry.counter("mxtpu_serving_requests_evicted_total",
+                          "requests evicted from the serving plane"
+                          ).inc()
+        telemetry.record_event("request_evicted", server=self.name,
+                               request=req.id, reason=reason,
+                               requeued=bool(requeue),
+                               generated=len(req.generated))
+        self._update_gauges()
+        return True
+
+    def recover(self) -> int:
+        """Rebuild every poisoned (or healthy) KV-cache pool and
+        requeue resident requests; clears the poison latch.  Returns
+        the number of requests requeued.  The serving twin of the
+        trainers' ``recover(manager)`` — state here is cache pages
+        rebuilt by replaying host-owned prompts, so no checkpoint is
+        involved."""
+        from ..elastic.manager import record_recovery
+        t0 = time.perf_counter()
+        was_poisoned = self._poisoned is not None
+        requeued = 0
+        # reverse: evict(requeue=True) pushes to the queue HEAD, so
+        # iterating backwards preserves the residents' relative order
+        for req in reversed(self.sched.active_requests()):
+            self.evict(req, reason="recover", requeue=True)
+            requeued += 1
+        for pool in self._pools.values():
+            pool.reset()
+        for b in self.sched.buckets:
+            b.offsets[:] = 0.0
+            b.active[:] = 0.0
+            b.temps[:] = 0.0
+            b.last_tokens[:] = 0.0
+        self._poisoned = None
+        record_recovery("serving", time.perf_counter() - t0,
+                        was_poisoned, name=self.name,
+                        requeued=requeued)
+        return requeued
+
+    def stats(self) -> dict:
+        """Live occupancy/queue stats plus per-bucket steady-state
+        compile accounting (what ``analyze_serving`` reads): every
+        dispatch of an already-warmed variant is bracketed with
+        ``engine.compile_counts()``, so a nonzero
+        ``steady_misses``/``steady_fresh_compiles`` means THIS bucket's
+        programs kept compiling after they existed — the retrace
+        signature continuous batching exists to prevent."""
+        out = {"name": self.name, "occupancy": self.sched.occupancy(),
+               "queue_depth": self.sched.queue_depth(),
+               "poisoned": self._poisoned is not None,
+               "warm_started": self.warm_started, "buckets": {}}
+        for b in self.sched.buckets:
+            out["buckets"][f"{b.slots}x{b.prompt_len}"] = \
+                dict(self._bucket_stats[b.key])
+        return out
+
+    # -- AOT warm start (docs/compile_cache.md, serving leg) --------------
+    def save_signature(self, path: str) -> str:
+        """Write the serving warm-start manifest: every dispatched
+        bucket variant's avals + donation layout + the persistent-tier
+        identity.  A fresh process (same model/bucket construction)
+        feeds it to :meth:`warm_start` to precompile the whole plane
+        before the first request."""
+        from .. import engine
+        if not self._variants:
+            raise MXNetError(
+                "save_signature: serve at least one request first "
+                "(no compiled variants recorded)")
+        manifest = {
+            "format": 1, "kind": "mxtpu_serving_plane",
+            "fingerprint": engine.persist.fingerprint(),
+            "net": self.lm.name,
+            "persist_base": self._persist_base,
+            "struct_hash": self._struct_hash,
+            "max_new_tokens": self.max_new_tokens,
+            "top_k": self._kk, "cache_dtype": self.cache_dtype,
+            "buckets": [
+                {"slots": b.slots, "prompt_len": b.prompt_len,
+                 "cache_len": b.cache_len}
+                for b in self.sched.buckets],
+            "variants": [self._variants[k]
+                         for k in sorted(self._variants)],
+        }
+        tmp = path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)  # a failed write must not leak .tmp*
+            except OSError:
+                pass
+            raise
+        return path
+
+    def warm_start(self, path: str) -> bool:
+        """Precompile every variant a :meth:`save_signature` manifest
+        records — persistent-tier reload when the cache dir holds the
+        executables, fresh AOT compile otherwise — so the first
+        request is served with 0 fresh compiles.  Never raises for a
+        bad/mismatched manifest: returns False (with a ``warm_start``
+        telemetry event carrying the reason) and the plane compiles on
+        first use as it always did."""
+        from .. import engine, telemetry
+
+        def _fail(reason):
+            telemetry.record_event("warm_start", name=self.name,
+                                   ok=False, reason=reason)
+            return False
+
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            return _fail(f"unreadable manifest: {e!r}"[:300])
+        if m.get("kind") != "mxtpu_serving_plane" or \
+                m.get("format") != 1:
+            return _fail("not an mxtpu_serving_plane manifest")
+        if m.get("fingerprint") != engine.persist.fingerprint():
+            return _fail("environment fingerprint mismatch "
+                         "(jax/jaxlib/platform/salt)")
+        if m.get("struct_hash") != self._struct_hash:
+            return _fail("structural hash mismatch: the manifest "
+                         "describes a different model/bucket/sampler "
+                         "configuration")
+        want = sorted((b["slots"], b["prompt_len"], b["cache_len"])
+                      for b in m.get("buckets", ()))
+        have = sorted((b.slots, b.prompt_len, b.cache_len)
+                      for b in self.sched.buckets)
+        if want != have:
+            return _fail(f"bucket mismatch: manifest {want} vs "
+                         f"configured {have}")
+        if self._poisoned is not None:
+            return _fail("server is poisoned")
+        try:
+            import jax
+            self._persist_base = m["persist_base"]
+            self._persist_pinned = True
+            sources = {}
+            for v in m.get("variants", ()):
+                suffix = str(v["suffix"])
+                bucket = self._bucket_for_suffix(suffix)
+                if bucket is None:
+                    return _fail(f"variant {suffix!r} names no "
+                                 "configured bucket")
+                pure = self._pure_for(bucket, str(v["kind"]),
+                                      int(v.get("k") or 0))
+                sds = [jax.ShapeDtypeStruct(a[0], np.dtype(a[1]))
+                       for a in engine.persist.sig_from_json(v["avals"])]
+                name = self.name + suffix
+                sources[name] = engine.aot_compile(
+                    name, pure, {}, sds,
+                    donate=tuple(int(i) for i in v["donate"]),
+                    persist_name=self._persist_base + suffix)
+                self._variants[suffix] = v
+                # the variant is warm NOW: its first live dispatch is
+                # already steady state, so a fresh compile there (a
+                # corrupt/evicted persist entry, aval drift from the
+                # manifest) lands in the steady accounting instead of
+                # hiding as "first dispatch pays its compile"
+                self._warmed.add(suffix)
+            if not sources:
+                return _fail("manifest has no compiled variants")
+        except Exception as e:
+            return _fail(f"warm-start failed: {e!r}"[:300])
+        self.warm_started = True
+        telemetry.record_event("warm_start", name=self.name, ok=True,
+                               sources=sources)
+        return True
+
+    # -- program builders --------------------------------------------------
+    def _suffix(self, bucket, kind: str, k: int = 0) -> str:
+        return f"_b{bucket.slots}x{bucket.prompt_len}_{kind}" + \
+            (f"{k}" if k else "")
+
+    def _bucket_for_suffix(self, suffix: str):
+        for b in self.sched.buckets:
+            if suffix.startswith(f"_b{b.slots}x{b.prompt_len}_"):
+                return b
+        return None
+
+    def _pure_for(self, bucket, kind: str, k: int = 0):
+        key = self._suffix(bucket, kind, k)
+        fn = self._pure_cache.get(key)
+        if fn is None:
+            if kind == "prefill":
+                fn = self._make_prefill(bucket)
+            elif kind == "decode" and not k:
+                fn = self._make_decode(bucket)
+            elif kind == "decode" and k:
+                fn = self._make_decode_multi(bucket, k)
+            else:
+                raise MXNetError(f"unknown serving variant {kind!r}")
+            self._pure_cache[key] = fn
+        return fn
+
+    def _pick(self, logits, temp, active, keys, vmapped=True):
+        """Greedy + temperature/top-k sampler (traced): per-row pick of
+        ``argmax`` (temp == 0) or categorical over the truncated,
+        temperature-scaled logits."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.float32)
+        lg = logits.astype(jnp.float32) / \
+            jnp.maximum(temp[:, None], 1e-6)
+        if self._kk:
+            kth = lax.top_k(lg, self._kk)[0][:, -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        sampled = jax.vmap(jax.random.categorical)(keys, lg) \
+            .astype(jnp.float32)
+        nxt = jnp.where(temp > 0, sampled, greedy)
+        return jnp.where(active > 0, nxt, jnp.zeros_like(nxt))
+
+    def _make_decode(self, bucket):
+        lm, ctx = self.lm, self.ctx
+        params = self._param_nds
+        P, L = len(params), len(lm.model.layers)
+        N = bucket.slots
+
+        def decode_pure(*flat):
+            import jax
+            import jax.numpy as jnp
+            from ..gluon import block as block_mod
+            from ..ndarray.ndarray import NDArray
+            param_vals = list(flat[:P])
+            cache_vals = flat[P:P + 2 * L]
+            tok, off, active, temp, key_raw = flat[P + 2 * L:]
+            with block_mod.tracing_scope(params, param_vals):
+                shells = [(NDArray(cache_vals[2 * i], ctx=ctx),
+                           NDArray(cache_vals[2 * i + 1], ctx=ctx))
+                          for i in range(L)]
+                logits = lm.decode_step(
+                    NDArray(tok, ctx=ctx), shells,
+                    NDArray(off, ctx=ctx))._data
+                new_caches = tuple(s._data for pair in shells
+                                   for s in pair)
+            k0 = jax.random.wrap_key_data(key_raw)
+            keys = jax.vmap(lambda i: jax.random.fold_in(k0, i))(
+                jnp.arange(N))
+            nxt = self._pick(logits, temp, active, keys)
+            return (nxt,) + new_caches
+
+        return decode_pure
+
+    def _make_decode_multi(self, bucket, k_steps: int):
+        lm, ctx = self.lm, self.ctx
+        params = self._param_nds
+        P, L = len(params), len(lm.model.layers)
+        N = bucket.slots
+
+        def decode_multi_pure(*flat):
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from ..gluon import block as block_mod
+            from ..ndarray.ndarray import NDArray
+            param_vals = list(flat[:P])
+            cache_vals = tuple(flat[P:P + 2 * L])
+            tok, off, active, temp, key_raw = flat[P + 2 * L:]
+            k0 = jax.random.wrap_key_data(key_raw)
+
+            def body(carry, step_i):
+                tok_c, off_c, caches = carry
+                with block_mod.tracing_scope(params, param_vals):
+                    shells = [(NDArray(caches[2 * i], ctx=ctx),
+                               NDArray(caches[2 * i + 1], ctx=ctx))
+                              for i in range(L)]
+                    logits = lm.decode_step(
+                        NDArray(tok_c, ctx=ctx), shells,
+                        NDArray(off_c, ctx=ctx))._data
+                    new_caches = tuple(s._data for pair in shells
+                                       for s in pair)
+                k_step = jax.random.fold_in(k0, step_i)
+                keys = jax.vmap(
+                    lambda i: jax.random.fold_in(k_step, i))(
+                    jnp.arange(N))
+                nxt = self._pick(logits, temp, active, keys)
+                # inactive slots hold position (offset AND token), so
+                # the in-graph carry matches the host's bookkeeping
+                return (nxt.reshape(N, 1), off_c + active,
+                        new_caches), nxt
+
+            (_, _, caches_f), toks = lax.scan(
+                body, (tok, off, cache_vals),
+                jnp.arange(k_steps))
+            return (toks,) + caches_f          # toks: (K, N)
+
+        return decode_multi_pure
+
+    def _make_prefill(self, bucket):
+        lm, ctx = self.lm, self.ctx
+        params = self._param_nds
+        P, L = len(params), len(lm.model.layers)
+        S = bucket.prompt_len
+        cdt = self.cache_dtype
+
+        def prefill_pure(*flat):
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from ..gluon import block as block_mod
+            from ..ndarray.ndarray import NDArray
+            param_vals = list(flat[:P])
+            cache_vals = flat[P:P + 2 * L]
+            prompt, last_pos, slot, temp, key_raw = flat[P + 2 * L:]
+            dt = jnp.dtype(cdt)
+            with block_mod.tracing_scope(params, param_vals):
+                tmp = []
+                for layer in lm.model.layers:
+                    a = layer.attn
+                    shp = (1, S, a._kv, a._d)
+                    tmp.append((NDArray(jnp.zeros(shp, dt), ctx=ctx),
+                                NDArray(jnp.zeros(shp, dt), ctx=ctx)))
+                logits = lm.prefill(
+                    NDArray(prompt, ctx=ctx), tmp,
+                    last_pos=NDArray(last_pos, ctx=ctx))._data
+                tmp_flat = [s._data for pair in tmp for s in pair]
+            slot_i = jnp.asarray(slot, jnp.int32)
+            zero = jnp.int32(0)
+            new_caches = []
+            for i in range(2 * L):
+                c = cache_vals[i]
+                new_caches.append(lax.dynamic_update_slice(
+                    c, tmp_flat[i].astype(c.dtype),
+                    (slot_i, zero, zero, zero)))
+            k0 = jax.random.wrap_key_data(key_raw)
+            keys = jax.vmap(lambda i: jax.random.fold_in(k0, i))(
+                slot_i.reshape(1))
+            nxt = self._pick(logits, temp, jnp.ones((1,)), keys)
+            return (nxt,) + tuple(new_caches)
+
+        return prefill_pure
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, bucket, kind: str, extra, k: int = 0):
+        """One engine dispatch of a bucket program with the pool
+        donated; returns the non-cache outputs with the successor pool
+        adopted.  Post-donation failures poison the bucket (the
+        recovery half lives in :meth:`recover`)."""
+        from .. import engine, telemetry
+        pool = self._pools[bucket.key]
+        if pool.poisoned is not None:
+            raise MXNetError(
+                f"bucket {bucket.key} pool is poisoned "
+                f"({pool.poisoned}); call recover()")
+        suffix = self._suffix(bucket, kind, k)
+        pure = self._pure_for(bucket, kind, k)
+        P = len(self._param_nds)
+        L2 = 2 * pool.num_layers
+        flat = [p._data for p in self._param_nds] + pool.flat() \
+            + list(extra)
+        donate = tuple(range(P, P + L2))
+        name = self.name + suffix
+        persist_name = self._persist_base + suffix
+        m0, f0 = engine.compile_counts()
+        try:
+            res = engine.invoke_compiled(name, pure, {}, *flat,
+                                         donate=donate,
+                                         persist_name=persist_name)
+        except Exception as e:
+            if pool.consumed():
+                pool.poison(repr(e))
+                self._poisoned = repr(e)
+                telemetry.counter(
+                    "mxtpu_poisons_total",
+                    "post-donation failures (training state lost)"
+                    ).inc()
+                telemetry.record_event(
+                    "poison", where="serving", name=name,
+                    error=repr(e)[:500])
+                telemetry.auto_dump(reason=f"serving_poisoned:{name}")
+                raise MXNetError(
+                    "serving dispatch failed AFTER the KV-cache pool "
+                    "was donated; call Server.recover() to rebuild "
+                    "the pages and requeue resident requests "
+                    f"(docs/serving.md). Original error: {e!r}") from e
+            raise
+        n_out = len(res) - L2
+        pool.adopt(res[n_out:])
+        if suffix not in self._variants:
+            self._variants[suffix] = {
+                "suffix": suffix, "kind": kind, "k": k,
+                "donate": [int(i) for i in donate],
+                "avals": engine.persist.sig_to_json(
+                    engine.persist.aval_sig(flat))}
+        if suffix not in self._warmed:
+            # first dispatch of this variant pays its compile; every
+            # later one is steady state and must compile NOTHING
+            self._warmed.add(suffix)
+        else:
+            m1, f1 = engine.compile_counts()
+            stats = self._bucket_stats[bucket.key]
+            stats["steady_dispatches"] += 1
+            stats["steady_misses"] += m1 - m0
+            stats["steady_fresh_compiles"] += f1 - f0
+        return res[:n_out]
+
+    def _admit(self, bucket, slot: int, req: Request):
+        from .. import random as _rnd
+        from .. import telemetry
+        t0 = time.perf_counter()
+        S = bucket.prompt_len
+        prompt = np.zeros((1, S), np.float32)
+        prompt[0, :req.prompt_len] = req.prompt
+        extra = [prompt,
+                 np.asarray([req.prompt_len - 1], np.float32),
+                 np.asarray(slot, np.float32),
+                 np.asarray([req.temperature], np.float32),
+                 _rnd._next_key_nd(self.ctx)._data]
+        # pre-dispatch failures (trace/compile, retries exhausted)
+        # propagate to step(), which releases THIS placement and the
+        # ones behind it back to the queue in FIFO order
+        out = self._dispatch(bucket, "prefill", extra)
+        tok = int(np.asarray(out[0])[0])     # host sync: TTFT is real
+        telemetry.counter("mxtpu_serving_prefills_total",
+                          "admission prefill dispatches").inc()
+        bucket.last_tokens[slot] = float(tok)
+        self._bucket_stats[bucket.key]["tokens"] += 1
+        telemetry.counter("mxtpu_serving_tokens_total",
+                          "tokens generated by the serving plane").inc()
+        finished = req.push_token(tok)
+        telemetry.histogram(
+            "mxtpu_serving_ttft_seconds",
+            "submit -> first generated token (s)").observe(
+            req.first_token_t - req.submit_t)
+        telemetry.histogram(
+            "mxtpu_serving_prefill_seconds",
+            "one admission (prefill dispatch + first token) (s)"
+            ).observe(time.perf_counter() - t0)
+        if finished:
+            self._finish(req)
+
+    def _decode(self, bucket, decode_steps: int) -> int:
+        from .. import random as _rnd
+        from .. import telemetry
+        t0 = time.perf_counter()
+        k = max(1, int(decode_steps))
+        active_snap = bucket.active.copy()
+        extra = [bucket.last_tokens.reshape(bucket.slots, 1).copy(),
+                 bucket.offsets.copy(), active_snap.copy(),
+                 bucket.temps.copy(),
+                 _rnd._next_key_nd(self.ctx)._data]
+        out = self._dispatch(bucket, "decode", extra,
+                             k=0 if k == 1 else k)
+        toks = np.asarray(out[0])
+        if toks.ndim == 1:
+            toks = toks[None, :]               # (K, N)
+        # host bookkeeping mirrors the in-graph carry: offsets advance
+        # K per slot ACTIVE AT DISPATCH (release() rewinds finishers)
+        bucket.offsets += k * active_snap
+        produced = 0
+        for row in toks:
+            for j in np.nonzero(active_snap > 0)[0]:
+                req = bucket.requests[int(j)]
+                if req is None or req.state != ACTIVE:
+                    continue               # finished mid-K: overrun rows
+                tok = int(row[int(j)])
+                bucket.last_tokens[int(j)] = float(tok)
+                produced += 1
+                if req.push_token(tok):
+                    self._finish(req)
+        dt = time.perf_counter() - t0
+        telemetry.histogram("mxtpu_serving_decode_seconds",
+                            "one decode dispatch wall clock (s)"
+                            ).observe(dt)
+        if produced:
+            telemetry.counter(
+                "mxtpu_serving_tokens_total",
+                "tokens generated by the serving plane").inc(produced)
+        self._bucket_stats[bucket.key]["tokens"] += produced
+        return produced
+
+    def _finish(self, req: Request):
+        from .. import telemetry
+        self.sched.finish(req)
+        telemetry.counter("mxtpu_serving_requests_completed_total",
+                          "requests run to completion").inc()
+        if req.done_t is not None:
+            telemetry.histogram(
+                "mxtpu_serving_request_seconds",
+                "submit -> completion per-request latency (s)"
+                ).observe(req.done_t - req.submit_t)
+
+    def _update_gauges(self):
+        from .. import telemetry
+        telemetry.gauge("mxtpu_serving_batch_occupancy",
+                        "active slots / total slots").set(
+            self.sched.occupancy())
+        telemetry.gauge("mxtpu_serving_queue_depth",
+                        "requests waiting for a slot").set(
+            self.sched.queue_depth())

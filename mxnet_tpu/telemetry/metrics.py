@@ -197,6 +197,27 @@ class Histogram(_Instrument):
                 "avg": (s / n) if n else None,
                 "buckets": cumulative}
 
+    def quantile(self, q: float):
+        """Prometheus-style quantile estimate from the cumulative
+        bucket counts: the upper bound of the first bucket whose
+        cumulative count reaches ``q`` of the total, clamped to the
+        observed min/max (so p50/p99 of a tight distribution do not
+        report a coarse bucket edge beyond the real range).  ``None``
+        before any observation."""
+        with _lock:
+            counts = list(self._counts)
+            n = self._count
+            mn, mx = self._min, self._max
+        if not n:
+            return None
+        rank = q * n
+        acc = 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            if acc >= rank:
+                return min(max(b, mn), mx)
+        return mx
+
     def _sample(self):
         d = self.summary()
         d.update(type="histogram", name=self.name,

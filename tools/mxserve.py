@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""mxserve: drive and inspect the serving plane (docs/serving.md).
+
+    python tools/mxserve.py smoke                # tiny in-process
+                                                 # llama server, then
+                                                 # render stats
+    python tools/mxserve.py smoke --decode-steps 4
+    python tools/mxserve.py --self-check         # CI gate: the smoke
+                                                 # must drain with 0
+                                                 # steady-state
+                                                 # compiles and a
+                                                 # quiet
+                                                 # analyze_serving()
+
+The smoke builds a ``llama_tiny`` ``serving.Server`` with one bucket,
+pushes a small mixed-length request burst through admit/decode/evict
+churn, and renders: per-bucket steady-state compile accounting (the
+zero-retrace contract), token/requests census, TTFT and per-request
+latency quantiles, and occupancy.  Exit 1 when a bucket recorded
+steady-state compiles (the MXL601 runtime hazard) so the gate fails
+loudly.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def render(stats: dict) -> str:
+    """Text rendering of a ``serving.Server.stats()`` dict."""
+    lines = [f"server {stats.get('name')}  "
+             f"occupancy={stats.get('occupancy'):.2f}  "
+             f"queue={stats.get('queue_depth')}  "
+             f"poisoned={stats.get('poisoned')}  "
+             f"warm_started={stats.get('warm_started')}"]
+    lines.append(f"{'bucket':>10} {'steady':>8} {'tokens':>8} "
+                 f"{'misses':>8} {'fresh':>8}")
+    for bucket, row in sorted(stats.get("buckets", {}).items()):
+        lines.append(
+            f"{bucket:>10} {row.get('steady_dispatches', 0):>8} "
+            f"{row.get('tokens', 0):>8} "
+            f"{row.get('steady_misses', 0):>8} "
+            f"{row.get('steady_fresh_compiles', 0):>8}")
+    return "\n".join(lines)
+
+
+def smoke(decode_steps: int = 1, quiet: bool = False) -> int:
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+    from mxnet_tpu.serving import Server
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    vocab = 128
+    net = LlamaForCausalLM(llama_tiny(vocab_size=vocab))
+    net.initialize(mx.init.Xavier())
+    srv = Server(net, buckets=[(4, 8)], max_new_tokens=8)
+    rng = np.random.RandomState(0)
+    reqs = [srv.submit(rng.randint(0, vocab, rng.randint(2, 9))
+                       .astype("f4"),
+                       temperature=0.8 if i % 2 else 0.0)
+            for i in range(6)]
+    srv.step(decode_steps=decode_steps)
+    # evict() is a no-op on a request that already finished (large
+    # --decode-steps can complete reqs[0] in the first round)
+    evicted = 1 if srv.evict(reqs[0], reason="mxserve-smoke") else 0
+    srv.run(decode_steps=decode_steps)
+
+    stats = srv.stats()
+    if not quiet:
+        print(render(stats))
+        ttft = telemetry.histogram(
+            "mxtpu_serving_ttft_seconds",
+            "submit -> first generated token (s)")
+        lat = telemetry.histogram(
+            "mxtpu_serving_request_seconds",
+            "submit -> completion per-request latency (s)")
+        print(f"ttft p50={ttft.quantile(0.5)} p99={ttft.quantile(0.99)}"
+              f"  request p50={lat.quantile(0.5)} "
+              f"p99={lat.quantile(0.99)}")
+        done = sum(1 for r in reqs if r.state == "done")
+        print(f"requests: {done} done / {len(reqs)} submitted "
+              f"({evicted} evicted by the smoke)")
+    bad = [b for b, row in stats["buckets"].items()
+           if row.get("steady_misses") or
+           row.get("steady_fresh_compiles")]
+    if bad:
+        print(f"FAIL: steady-state compiles in bucket(s) {bad} — "
+              "see docs/serving.md, 'Zero-retrace contract'",
+              file=sys.stderr)
+        return 1
+    from mxnet_tpu import analysis
+    findings = analysis.analyze_serving()
+    if findings:
+        print(analysis.format_findings(findings), file=sys.stderr)
+        return 1
+    if not quiet:
+        print("zero-retrace contract held; analyze_serving() quiet")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cmd", nargs="?", default="smoke",
+                    choices=["smoke"])
+    ap.add_argument("--decode-steps", type=int, default=1,
+                    help="K-bulked decode (decode_multi) per round")
+    ap.add_argument("--self-check", action="store_true",
+                    help="CI gate: smoke must drain with 0 "
+                    "steady-state compiles")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return smoke(decode_steps=args.decode_steps,
+                 quiet=args.self_check)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
