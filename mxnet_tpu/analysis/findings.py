@@ -71,6 +71,10 @@ RULES = {
     "MXL309": (Severity.WARNING,
                "large tensor fully replicated across a multi-device "
                "mesh"),
+    "MXL310": (Severity.WARNING,
+               "MXTPU_ZERO_STAGE>=1 set but a dp>1 trainer's optimizer "
+               "state is fully replicated (misconfigured plan silently "
+               "burning HBM)"),
     "MXL311": (Severity.WARNING,
                "per-step host scalar read of the loss/metric in a "
                "training loop (use the sampled health plane)"),

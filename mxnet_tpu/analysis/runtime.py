@@ -123,6 +123,12 @@ def analyze_memory(large_buffer_bytes: int = 8 << 20,
       mesh — the exact misuse a sharding rule (``param_sharding``)
       exists to prevent; N copies of an embedding table is the
       canonical case.
+    * MXL310 — ``MXTPU_ZERO_STAGE>=1`` is set, yet a registered
+      optimizer-state layout on a dp>1 mesh is fully REPLICATED: the
+      trainer was ineligible for the sharded update (no fused rule,
+      tensor-parallel params, 2bit compression, ...) and silently
+      fell back to stage 0 — dp copies of Adam's m/v burning HBM the
+      env var promised to shard (docs/zero.md).
     """
     from ..telemetry import memory as mem
     from collections import Counter
@@ -170,6 +176,26 @@ def analyze_memory(large_buffer_bytes: int = 8 << 20,
                     f"{tree['mesh_size']}x the HBM for one tensor; "
                     "give it a param_sharding rule",
                     f"memory:{tname}:{row['name']}"))
+    from .. import envs
+    if int(envs.get("MXTPU_ZERO_STAGE")) >= 1:
+        for tname, tree in sorted(mem.opt_state_trees().items()):
+            if tree.get("dp_size", 1) <= 1 or not tree.get("leaves"):
+                continue
+            if tree.get("zero_stage", 0) >= 1:
+                continue
+            if all(r["replicated"] for r in tree["leaves"]):
+                findings.append(Finding(
+                    "MXL310",
+                    f"{tname}: MXTPU_ZERO_STAGE="
+                    f"{int(envs.get('MXTPU_ZERO_STAGE'))} is set but "
+                    f"all {tree['count']} optimizer-state leaves "
+                    f"({tree['total_bytes']} bytes) are fully "
+                    f"replicated across the {tree['dp_size']}-member "
+                    "dp axis — the trainer fell back to stage 0 "
+                    "(no fused rule / TP params / 2bit compression?); "
+                    "each member burns the full state HBM the env "
+                    "var promised to shard (docs/zero.md)",
+                    f"memory:{tname}:opt_state"))
     return findings
 
 

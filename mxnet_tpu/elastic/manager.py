@@ -329,6 +329,45 @@ def _read_shard_payloads(path: str, manifest: dict,
     return out
 
 
+def _snapshot_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Decouple every device array in a trainer's elastic payload from
+    the next step's donation: async device-side copies taken on the
+    caller thread, in ONE walk over the three array families (params,
+    optimizer-state leaves, residuals).  This is the single place the
+    payload's array inventory is enumerated for copying — ZeRO's
+    sharded state rows ride the same ``states`` family, so the sharded
+    save path copies each buffer exactly once (the PR 7 follow-up:
+    ``save()`` used to repeat this walk inline per family, and the
+    sharded path would have added a third copy of it)."""
+    payload["params"] = [(n, _device_copy(a), s)
+                         for n, a, s in payload["params"]]
+    payload["states"] = [(i, j, _device_copy(a))
+                         for i, j, a in payload["states"]]
+    if payload.get("residuals"):
+        payload["residuals"] = [_device_copy(a)
+                                for a in payload["residuals"]]
+    return payload
+
+
+def _payload_shards(tmp: str, payload: Dict[str, Any]) -> \
+        List[Dict[str, Any]]:
+    """Write every payload array as a checkpoint shard and return the
+    manifest rows — the single definition of the payload -> shard
+    naming/layout (``_write`` and any future exporter share it; the
+    inverse lives in ``restore()``'s shard -> payload rebuild)."""
+    shards: List[Dict[str, Any]] = []
+    for i, (name, arr, spec) in enumerate(payload["params"]):
+        _write_shard(tmp, shards, name, arr, kind="param",
+                     index=i, spec=spec)
+    for i, j, arr in payload["states"]:
+        _write_shard(tmp, shards, f"state:{i}:{j}", arr,
+                     kind="state", index=i, leaf=j)
+    for j, arr in enumerate(payload.get("residuals") or ()):
+        _write_shard(tmp, shards, f"residual:{j}", arr,
+                     kind="residual", leaf=j)
+    return shards
+
+
 class CheckpointManager:
     """Durable train-state checkpoints for one trainer.
 
@@ -388,13 +427,7 @@ class CheckpointManager:
         payload["rng"] = _rng_export()
         # decouple from the next step's donation NOW, on the caller
         # thread (async device-side copies; the writer gathers later)
-        payload["params"] = [(n, _device_copy(a), s)
-                             for n, a, s in payload["params"]]
-        payload["states"] = [(i, j, _device_copy(a))
-                             for i, j, a in payload["states"]]
-        if payload.get("residuals"):
-            payload["residuals"] = [_device_copy(a)
-                                    for a in payload["residuals"]]
+        _snapshot_payload(payload)
         self._drain(swallow=True)
         if block or not self.async_save:
             self._write(payload, force)
@@ -463,16 +496,7 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(shards_dir)
 
-        shards: List[Dict[str, Any]] = []
-        for i, (name, arr, spec) in enumerate(payload["params"]):
-            _write_shard(tmp, shards, name, arr, kind="param",
-                         index=i, spec=spec)
-        for i, j, arr in payload["states"]:
-            _write_shard(tmp, shards, f"state:{i}:{j}", arr,
-                         kind="state", index=i, leaf=j)
-        for j, arr in enumerate(payload.get("residuals") or ()):
-            _write_shard(tmp, shards, f"residual:{j}", arr,
-                         kind="residual", leaf=j)
+        shards = _payload_shards(tmp, payload)
 
         manifest = {
             "format": FORMAT, "kind": "mxtpu_elastic_checkpoint",
@@ -485,6 +509,9 @@ class CheckpointManager:
             "mesh": payload.get("mesh"),
             "dp_axis": payload.get("dp_axis"),
             "persist_name": payload.get("persist_name"),
+            # the ZeRO layout pin (docs/zero.md): restore converts the
+            # sharded state rows to the target trainer's layout
+            "zero": payload.get("zero"),
             "rng": payload["rng"],
             "shards": shards,
         }
@@ -565,6 +592,7 @@ class CheckpointManager:
             "mesh": manifest.get("mesh"),
             "dp_axis": manifest.get("dp_axis"),
             "persist_name": manifest.get("persist_name"),
+            "zero": manifest.get("zero"),
             "params": [], "states": [], "residuals": [],
         }
         for rec, host in zip(manifest["shards"], arrays):

@@ -220,6 +220,16 @@ _reg("MXTPU_SERVING_MAX_NEW_TOKENS", int, 32,
 _reg("MXTPU_SERVING_MAX_QUEUE", int, 128,
      "Bound on the serving wait queue; submissions past it are "
      "rejected with a retained slot_oom telemetry event.")
+_reg("MXTPU_ZERO_STAGE", int, 0,
+     "ZeRO-style cross-replica sharding of the weight update inside "
+     "the fused SPMD step (arXiv 2004.13336; docs/zero.md): 0 (default) "
+     "replicates the optimizer update on every dp member; 1 shards "
+     "optimizer state + update FLOPs 1/dp per member (all-reduce "
+     "gradient leg); 2 additionally reduce-scatters the gradients "
+     "(half the gradient wire bytes) and all-gathers only the updated "
+     "weights. Read at DataParallelTrainer construction; numerics are "
+     "fp32-parity with stage 0, and checkpoints stay portable across "
+     "stages and dp sizes.")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

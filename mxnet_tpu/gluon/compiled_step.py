@@ -168,6 +168,12 @@ class CompiledStep:
         self._health_spec = None
         self._health_count = 0
         self.health_manager = None
+        # MXTPU_ZERO_STAGE visibility latch (docs/zero.md): the ZeRO
+        # sharded update is an SPMD-trainer feature — a single-context
+        # CompiledStep has no dp axis to shard over, and silently
+        # ignoring the env var would read as "memory didn't drop".
+        # One retained event per step object says why.
+        self._zero_noted = False
 
     # -- public API -------------------------------------------------------
     def step(self, data, label, batch_size=None):
@@ -539,6 +545,19 @@ class CompiledStep:
         if not envs.get("MXTPU_FUSED_UPDATE"):
             return ("MXTPU_FUSED_UPDATE=0 disables the fused optimizer "
                     "program the compiled step splices in")
+        if not self._zero_noted and envs.get("MXTPU_ZERO_STAGE"):
+            # not a fallback — the compiled path still runs, the env
+            # var just cannot apply here (no dp axis on a single
+            # context); say so once instead of silently ignoring it
+            self._zero_noted = True
+            from .. import telemetry
+            telemetry.record_event(
+                "zero_inapplicable", name=self.name,
+                stage=int(envs.get("MXTPU_ZERO_STAGE")),
+                reason="CompiledStep is single-context; the ZeRO "
+                       "sharded update needs the SPMD "
+                       "DataParallelTrainer's dp mesh axis "
+                       "(docs/zero.md)")
         # optimizer-capability checks (fused plan / tensor support) run
         # in _check_sig, which builds the plan ONCE per dispatch anyway
         return None

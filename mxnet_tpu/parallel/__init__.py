@@ -23,7 +23,9 @@ Public surface:
 """
 from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
-from .collectives import quantized_psum, vocab_parallel_softmax_ce
+from . import zero
+from .collectives import (quantized_psum, quantized_reduce_scatter,
+                          reduce_scatter, vocab_parallel_softmax_ce)
 from .trainer import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_value_and_grad
@@ -49,6 +51,8 @@ __all__ = ["vocab_parallel_softmax_ce",
            "moe_param_rule", "pipeline_apply",
            "pipeline_value_and_grad",
            "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
-           "collectives", "DataParallelTrainer", "ring_attention",
+           "collectives", "zero", "DataParallelTrainer",
+           "quantized_psum", "quantized_reduce_scatter",
+           "reduce_scatter", "ring_attention",
            "ring_attention_sharded", "llama_param_rule",
            "sharding_plan"]

@@ -56,12 +56,20 @@ class _FusedRule:
     e.g. Adam's bias-corrected lr); ``apply(opt, w, g, states, *scalars)``
     runs the registered fused op's pure fcompute and returns
     ``(new_w, new_states_tuple)``.
+
+    ``pointwise`` declares the rule elementwise in the FLAT parameter —
+    the ZeRO eligibility bit (docs/zero.md): a pointwise rule applied to
+    a 1/N slice computes exactly the replicated update's values, while a
+    rule with per-tensor statistics (LAMB's trust ratio over ||w||)
+    would silently compute them per SLICE.  Required explicitly per rule
+    so adding one forces the decision here, not in a distant list.
     """
 
-    def __init__(self, n_states, scalars, apply):
+    def __init__(self, n_states, scalars, apply, *, pointwise):
         self.n_states = n_states
         self.scalars = scalars
         self.apply = apply
+        self.pointwise = bool(pointwise)
 
 
 def _sgd_scalars(o, i, t):
@@ -85,26 +93,26 @@ _FUSED_RULES = {
             get_op("sgd_mom_update").fcompute(
                 w, g, s[0], lr, wd, momentum=o.momentum,
                 rescale_grad=o.rescale_grad,
-                clip_gradient=o._clip() or -1.0))),
+                clip_gradient=o._clip() or -1.0)), pointwise=True),
     "NAG": _FusedRule(
         1, _sgd_scalars,
         lambda o, w, g, s, lr, wd: get_op("nag_mom_update").fcompute(
             w, g, s[0], lr, wd, momentum=o.momentum,
             rescale_grad=o.rescale_grad,
-            clip_gradient=o._clip() or -1.0)),
+            clip_gradient=o._clip() or -1.0), pointwise=True),
     "Adam": _FusedRule(
         2,
         lambda o, i, t: (_adam_corrected_lr(o, i, t), o._get_wd(i)),
         lambda o, w, g, s, lr, wd: get_op("adam_update").fcompute(
             w, g, s[0], s[1], lr, wd, beta1=o.beta1, beta2=o.beta2,
             epsilon=o.epsilon, rescale_grad=o.rescale_grad,
-            clip_gradient=o._clip() or -1.0)),
+            clip_gradient=o._clip() or -1.0), pointwise=True),
     "RMSProp": _FusedRule(
         1, _sgd_scalars,
         lambda o, w, g, s, lr, wd: get_op("rmsprop_update").fcompute(
             w, g, s[0], lr, wd, gamma1=o.gamma1, epsilon=o.epsilon,
             rescale_grad=o.rescale_grad,
-            clip_gradient=o._clip() or -1.0)),
+            clip_gradient=o._clip() or -1.0), pointwise=True),
     "AdamW": _FusedRule(
         2,
         lambda o, i, t: (_adam_corrected_lr(o, i, t), 1.0,
@@ -112,13 +120,13 @@ _FUSED_RULES = {
         lambda o, w, g, s, lr, eta, wd: get_op("adamw_update").fcompute(
             w, g, s[0], s[1], lr, eta, wd, beta1=o.beta1, beta2=o.beta2,
             epsilon=o.epsilon, rescale_grad=o.rescale_grad,
-            clip_gradient=o._clip() or -1.0)),
+            clip_gradient=o._clip() or -1.0), pointwise=True),
     "AdaGrad": _FusedRule(
         1, _sgd_scalars,
         lambda o, w, g, s, lr, wd: get_op("adagrad_update").fcompute(
             w, g, s[0], lr, wd, epsilon=o.float_stable_eps,
             rescale_grad=o.rescale_grad,
-            clip_gradient=o._clip() or -1.0)),
+            clip_gradient=o._clip() or -1.0), pointwise=True),
 }
 
 
@@ -264,6 +272,31 @@ class DataParallelTrainer:
                     "a fused optimizer rule (the compressed exchange "
                     "lives inside the single SPMD step program)")
             self._compression_cfg = cfg
+        # ZeRO-1/2 sharded weight update (docs/zero.md, arXiv
+        # 2004.13336): latched at construction — the stage decides the
+        # PHYSICAL optimizer-state layout, which cannot flip under a
+        # live trainer the way a health sampling knob can.  Ineligible
+        # trainers warn and run stage 0; the replicated layout then
+        # trips the MXL310 runtime rule.
+        from . import zero as _zero
+        self._zero_stage = 0
+        requested = _zero.stage_from_env()
+        if requested and int(self.mesh.shape.get(self.dp_axis, 1)) > 1:
+            reason = _zero.eligibility(self)
+            if reason is None:
+                self._zero_stage = requested
+            else:
+                import warnings
+                warnings.warn(
+                    f"MXTPU_ZERO_STAGE={requested} requested but this "
+                    f"trainer cannot shard its update ({reason}); "
+                    "running stage 0 — optimizer state stays "
+                    "replicated", stacklevel=2)
+        # the per-device step body backing the bulked (scan) builder
+        # when ZeRO is on; self._full_fn then holds the shard_map-
+        # wrapped single-step twin (traceable at GLOBAL avals, which
+        # the persist tier's eval_shape re-trace needs)
+        self._zero_body = None
 
     # -- lazy setup -------------------------------------------------------
     def _setup(self, args):
@@ -275,14 +308,48 @@ class DataParallelTrainer:
         self._finish_setup(params)
 
     def _finish_setup(self, params):
+        from . import zero as _zero
         self._params = params
         self._trainable = [p.grad_req != "null" for p in params]
         self._tr_idx = [i for i, t in enumerate(self._trainable) if t]
-        self._states = [
-            self.optimizer.create_state(i, p.data())
-            if self._trainable[i] else None
-            for i, p in enumerate(params)]
+        if self._zero_stage:
+            # sharded layout (docs/zero.md): each trainable param's
+            # state is a tuple of (n_dp, chunk) f32 leaves placed
+            # P(dp) — every member holds 1/N of Adam's m/v instead of
+            # a full replica; leaf COUNT still comes from the
+            # optimizer's own create_state
+            self._states = [
+                _zero.create_sharded_states(
+                    self.optimizer, i, p.data(), self.mesh,
+                    self.dp_axis)
+                if self._trainable[i] else None
+                for i, p in enumerate(params)]
+        else:
+            self._states = [
+                self.optimizer.create_state(i, p.data())
+                if self._trainable[i] else None
+                for i, p in enumerate(params)]
         self._shard_params()
+        # the observatory's optimizer-state ledger: per-leaf global vs
+        # per-device bytes, sharded/replicated split — the evidence
+        # the ~dp x ZeRO drop is measured against, and the MXL310
+        # input (env says shard, layout says replicated)
+        from .. import telemetry
+        telemetry.memory.note_opt_state(
+            f"spmd:{self.block.name}", self._opt_state_leaves(),
+            mesh=self.mesh, dp_axis=self.dp_axis,
+            zero_stage=self._zero_stage)
+
+    def _opt_state_leaves(self):
+        """``[(label, jax array), ...]`` over every optimizer-state
+        leaf, labelled by owning param (the census/MXL310 input)."""
+        out = []
+        for i in self._tr_idx:
+            leaves: List[NDArray] = []
+            _flatten(self._states[i], leaves)
+            for j, leaf in enumerate(leaves):
+                out.append((f"{self._params[i].name}:{j}", leaf._data))
+        return out
 
     def _ensure_setup_for_restore(self):
         """Checkpoint restore may land BEFORE the first batch (a fresh
@@ -334,6 +401,7 @@ class DataParallelTrainer:
                     source="spmd_trainer")
             self._full_step = None
             self._full_fn = None
+            self._zero_body = None
             self._full_exec = None
             self._multi_step_cache.clear()
             self._multi_fns.clear()
@@ -363,7 +431,12 @@ class DataParallelTrainer:
         flat: List[NDArray] = []
         _flatten(self._states, flat)
         holders.extend(flat)
-        targets.extend(repl for _ in flat)
+        # ZeRO keeps optimizer-state leaves sharded on their leading
+        # dp row — re-replicating them here would silently undo the
+        # whole memory saving (and trip MXL310)
+        state_target = NamedSharding(self.mesh, P(self.dp_axis)) \
+            if self._zero_stage else repl
+        targets.extend(state_target for _ in flat)
         # live -> live layout move (elastic.reshard, arXiv:2112.01075):
         # one compiled identity program when source and target cover
         # the same device set, the runtime transfer engine otherwise
@@ -685,6 +758,179 @@ class DataParallelTrainer:
         self._full_step = jax.jit(
             mapped, donate_argnums=self._full_donate)
 
+    def _zero_specs(self):
+        """shard_map in/out PartitionSpecs shared by the ZeRO single-
+        step and bulked builders: params/scalars/keys replicated,
+        optimizer-state leaves sharded on their leading dp row, batch
+        inputs on the dp axis."""
+        from jax.sharding import PartitionSpec as P
+        return P(), P(self.dp_axis), P(self.dp_axis)
+
+    def _build_full_step_zero(self):
+        """The fused step with the WEIGHT UPDATE sharded over the dp
+        axis (ZeRO-1/2, arXiv 2004.13336; docs/zero.md): shard_map
+        over the mesh, per-device forward/backward on the local batch
+        shard, then — per trainable param — the gradient is reduced
+        onto each member's 1/N flat slice (stage 2: one fused
+        reduce-scatter, optionally int8-wire; stage 1: all-reduce +
+        local slice), the fused optimizer rule updates ONLY that slice
+        against the member's (1, chunk) state leaves, and the updated
+        weight slices are all-gathered back into the replicated
+        param.  Optimizer state never exists replicated: per-member
+        HBM and update FLOPs drop ~dp x, inside the same single
+        donated program.
+
+        Numerics: the update is pointwise in the flat param
+        (``zero.POINTWISE_RULES``), so slice-update + gather computes
+        exactly the replicated update's values — fp32-parity with
+        stage 0 is tier-1 asserted for SGD-momentum and Adam."""
+        import jax
+        import jax.lax as lax
+        from ._compat import shard_map
+        from .collectives import (sharded_weight_update,
+                                  quantized_psum,
+                                  quantized_reduce_scatter)
+
+        rule = self._rule
+        opt = self.optimizer
+        n_scalars = len(rule.scalars(opt, 0, 1))
+        tr_idx = self._tr_idx
+        traced = self._traced_fn
+        axis = self.dp_axis
+        n_dp = int(self.mesh.shape[axis])
+        stage = self._zero_stage
+        quantized = self._compression_cfg is not None
+        hspec = self._health_spec
+        mutated_idx = self._mutated_idx
+
+        def full(param_vals, tstate_vals, scalar_vals, input_vals,
+                 label_val, key_raw, due=None):
+            # per-device dropout keys decorrelate across the axis
+            # (same scheme as the compressed step)
+            dev_key = jax.random.key_data(jax.random.fold_in(
+                jax.random.wrap_key_data(key_raw),
+                lax.axis_index(axis)))
+            loss, grads, aux = traced(param_vals, input_vals,
+                                      label_val, dev_key)
+            # stage 1 materializes the full reduced gradients (the
+            # all-reduce leg) — health reads them for free.  Stage 2
+            # never does: only the scattered slices exist, and health
+            # derives its per-param squared sums FROM the slices (one
+            # (T,)-vector psum — telemetry.health.compute_sharded), so
+            # the gradient wire stays reduce-scatter with health on.
+            reduce_full = stage == 1
+            collect_sq = hspec is not None and not reduce_full
+            import jax.numpy as jnp
+            red_grads = []
+            g_slices = []
+            new_params, new_states = [], []
+            for j, i in enumerate(tr_idx):
+                scal = tuple(scalar_vals[j * n_scalars + k]
+                             for k in range(n_scalars))
+                # strip the (1, chunk) local row to the flat slice
+                st = tuple(s[0] for s in tstate_vals[j])
+
+                def upd(p_s, g_s, *st_s, _scal=scal):
+                    # the grad leg reduced a SUM over members; the
+                    # global-batch-mean gradient is sum/n (matching
+                    # the stage-0 step's implicit pmean)
+                    g_mean = g_s / n_dp
+                    if collect_sq:
+                        # capture the slice the update applies (free —
+                        # it exists either way); the squared-sum
+                        # reductions run under the `due` cond below
+                        g_slices.append(g_mean)
+                    res = rule.apply(opt, p_s, g_mean,
+                                     tuple(st_s), *_scal)
+                    if isinstance(res, tuple) and \
+                            isinstance(res[1], tuple):
+                        return res
+                    return res[0], tuple(res[1:])
+
+                if reduce_full:
+                    # stage 1's all-reduce leg keeps the int8 wire
+                    # when compression is configured (quantized_psum,
+                    # the same exchange the stage-0 compressed step
+                    # runs) — composing zero+int8 must never silently
+                    # widen the gradient wire back to fp32
+                    rg = quantized_psum(grads[j], axis) if quantized \
+                        else lax.psum(grads[j], axis)
+                    red_grads.append(rg / n_dp)
+                    new_w, new_st = sharded_weight_update(
+                        param_vals[i], rg, st, upd, axis,
+                        grad_reduce="local")
+                elif quantized:
+                    new_w, new_st = sharded_weight_update(
+                        param_vals[i], grads[j], st, upd, axis,
+                        grad_reduce=lambda flat:
+                            quantized_reduce_scatter(flat, axis))
+                else:
+                    new_w, new_st = sharded_weight_update(
+                        param_vals[i], grads[j], st, upd, axis)
+                new_params.append(new_w)
+                # re-add the leading local dp row for the P(dp) out
+                new_states.append(tuple(s[None] for s in new_st))
+            new_params, new_states = tuple(new_params), \
+                tuple(new_states)
+            loss = lax.pmean(loss, axis)
+            aux = tuple(lax.pmean(a, axis) for a in aux)
+            if hspec is None:
+                return loss, new_params, new_states, aux
+            from ..telemetry import health as _health
+            old_tr = tuple(param_vals[i] for i in tr_idx)
+            if reduce_full:
+                hvec = _health.compute(hspec, loss, old_tr,
+                                       tuple(red_grads), new_params,
+                                       due=due)
+            else:
+                # the per-slice square sums + psum run only on sampled
+                # steps (same `due` cond as health.compute — an
+                # un-sampled step must not pay the reduction passes);
+                # the skip gate reads the stats every step, and a
+                # caller without a sampling schedule (due=None)
+                # computes unconditionally
+                def _sq_sums():
+                    return lax.psum(jnp.stack(
+                        [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in g_slices]), axis)
+                if due is None or hspec.skip:
+                    sq_global = _sq_sums()
+                else:
+                    sq_global = lax.cond(
+                        due > 0, _sq_sums,
+                        lambda: jnp.zeros((len(tr_idx),),
+                                          jnp.float32))
+                hvec = _health.compute_sharded(
+                    hspec, loss, old_tr,
+                    [sq_global[j] for j in range(len(tr_idx))],
+                    new_params, due=due)
+            if hspec.skip:
+                new_params, new_states, aux = _health.gate_update(
+                    hvec, new_params, old_tr, new_states, tstate_vals,
+                    aux, tuple(param_vals[i] for i in mutated_idx))
+            return loss, new_params, new_states, aux, hvec
+
+        repl, state_spec, batch = self._zero_specs()
+        out_specs = (repl, repl, state_spec, repl)
+        in_specs = (repl, state_spec, repl, batch, batch, repl)
+        if hspec is not None:
+            out_specs = out_specs + (repl,)
+            in_specs = in_specs + (repl,)           # the due flag
+        # check_vma=False for the same reason as the compressed step:
+        # all_gather-built outputs are vma-typed "varying" though every
+        # member computes identical values
+        mapped = shard_map(
+            full, mesh=self.mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=False)
+        # the bulked builder scans the PER-DEVICE body; _full_fn holds
+        # the mapped twin, which eval_shape can trace at global avals
+        # (a persist hit's mutated_idx recovery runs the Python body)
+        self._zero_body = full
+        self._full_fn = mapped
+        self._full_donate = (1,)
+        self._full_step = jax.jit(mapped,
+                                  donate_argnums=self._full_donate)
+
     # -- persistent compile cache (docs/compile_cache.md) -----------------
     def _persist_name(self) -> str:
         """Stable persistent-tier identity for this trainer's fused
@@ -705,8 +951,14 @@ class DataParallelTrainer:
                        for k, v in self.mesh.shape.items()),
                  self.dp_axis,
                  # health config is baked into the program's output
-                 # arity — a flip must key fresh persistent entries
-                 telemetry.health.trace_signature())
+                 # arity — a flip must key fresh persistent entries;
+                 # the ZeRO stage is baked into the program's
+                 # collectives AND state avals, ditto — appended only
+                 # when nonzero so stage-0 hashes (and with them every
+                 # pre-ZeRO manifest + persisted executable) survive
+                 # this release unchanged
+                 telemetry.health.trace_signature()) + (
+                     (self._zero_stage,) if self._zero_stage else ())
         h = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
         return f"spmd_full_step_{self.block.name}_{h}"
 
@@ -723,7 +975,9 @@ class DataParallelTrainer:
                        for p in self._params),
                  tuple(self._tr_idx),
                  self.dp_axis,
-                 telemetry.health.trace_signature())
+                 # stage appended only when nonzero — see _persist_name
+                 telemetry.health.trace_signature()) + (
+                     (self._zero_stage,) if self._zero_stage else ())
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
@@ -838,6 +1092,7 @@ class DataParallelTrainer:
             except AttributeError:
                 shardings.append("")
         manifest = {
+            "zero": self._zero_record(),
             "format": 1, "kind": "spmd_full_step",
             "fingerprint": _persist.fingerprint(),
             "persist_name": self._persist_name(),
@@ -859,6 +1114,20 @@ class DataParallelTrainer:
             json.dump(manifest, f, indent=1, sort_keys=True)
         _os.replace(tmp, path)
         return path
+
+    def _zero_record(self):
+        """The warm-start/checkpoint manifest's ZeRO layout pin:
+        stage, dp size, and the per-param flat shard slices
+        ``[name, size, padded, chunk]`` (docs/zero.md).  None when the
+        update is not sharded — so pre-ZeRO manifests compare equal on
+        a stage-0 trainer."""
+        if not self._zero_stage:
+            return None
+        from . import zero as _zero
+        n_dp = int(self.mesh.shape[self.dp_axis])
+        return {"stage": int(self._zero_stage), "dp": n_dp,
+                "slices": _zero.slice_record(self._params,
+                                             self._tr_idx, n_dp)}
 
     def warm_start(self, path: str) -> bool:
         """Precompile the fused step variants recorded in a
@@ -950,6 +1219,38 @@ class DataParallelTrainer:
             # health config; adopt the current one before building so
             # the first step doesn't immediately evict the warm start
             self._refresh_health()
+            # the ZeRO layout is baked into the serialized executables
+            # (state avals, collectives): a stage/slice mismatch must
+            # fail open to cold compile, never adopt stale entries —
+            # checked BEFORE the opaque struct-hash comparison so the
+            # rejection reason names the actual cause.  A resharded
+            # warm start re-derives its slices on the new dp size, so
+            # THERE only the stage must agree.
+            mzero = m.get("zero")
+            mstage = int((mzero or {}).get("stage", 0))
+            if resharded:
+                if mstage != self._zero_stage:
+                    return _fail(
+                        f"zero stage mismatch: manifest stage "
+                        f"{mstage} vs current {self._zero_stage} "
+                        "(reshard path)")
+            else:
+                # structural comparison, like the persist hash: the
+                # slice NAMES carry gluon auto-naming (process-scoped
+                # prefixes); stage/dp/[size, padded, chunk] are what
+                # the serialized executables bake
+                def _zkey(rec):
+                    if not rec:
+                        return None
+                    return (int(rec.get("stage", 0)),
+                            int(rec.get("dp", 0)),
+                            tuple(tuple(int(x) for x in row[1:])
+                                  for row in rec.get("slices") or ()))
+                if _zkey(mzero) != _zkey(self._zero_record()):
+                    return _fail(
+                        f"zero sharding layout mismatch: manifest "
+                        f"{mzero!r} vs current "
+                        f"{self._zero_record()!r}")
             # structural hash must match before adopting the identity —
             # the hash part of the persist name covers param
             # shapes/dtypes, trainable set, optimizer, and mesh layout.
@@ -972,7 +1273,10 @@ class DataParallelTrainer:
             if self._fwd_bwd is None:
                 self._build_fwd_bwd(args, label)
             if self._full_fn is None:
-                self._build_full_step()
+                if self._zero_stage:
+                    self._build_full_step_zero()
+                else:
+                    self._build_full_step()
             # AFTER the builders: _build_fwd_bwd rebinds
             # self._mutated_idx to a fresh list, which would silently
             # drop the adopted aux routing (BatchNorm write-backs)
@@ -1076,6 +1380,10 @@ class DataParallelTrainer:
             "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
             "dp_axis": self.dp_axis,
             "persist_name": self._persist_name(),
+            # the ZeRO layout pin: restore converts sharded state rows
+            # to ANY target layout (other dp size, or gathered full
+            # shape on a ZeRO-off trainer) — docs/zero.md matrix
+            "zero": self._zero_record(),
             "params": params, "states": states,
             "residuals": list(self._residual_vals or ()),
         }
@@ -1123,6 +1431,15 @@ class DataParallelTrainer:
             d._set_data(_reshard.place(np.asarray(host), self.mesh,
                                        spec if spec is not None
                                        else P()))
+        # optimizer-state portability matrix (docs/zero.md): the saved
+        # layout (full, or ZeRO (n_src, chunk) rows) converts to THIS
+        # trainer's layout by pure flat reshapes — fp32-exact — so a
+        # ZeRO checkpoint restores onto any dp size and onto ZeRO-off
+        # trainers, and a pre-ZeRO checkpoint restores sharded
+        from . import zero as _zero
+        src_zero = int((payload.get("zero") or {}).get("stage", 0)) >= 1
+        zero_spec = NamedSharding(self.mesh, P(self.dp_axis))
+        n_dp = int(self.mesh.shape.get(self.dp_axis, 1))
         for i, j, host in payload["states"]:
             if not (0 <= i < len(self._states)) or \
                     self._states[i] is None:
@@ -1135,7 +1452,17 @@ class DataParallelTrainer:
                 raise MXNetError(
                     f"checkpoint optimizer-state leaf ({i},{j}) out "
                     "of range (optimizer class mismatch?)")
-            leaves[j]._set_data(jax.device_put(np.asarray(host), repl))
+            host = np.asarray(host)
+            pshape = tuple(self._params[i].data().shape)
+            if self._zero_stage:
+                rows = _zero.reshard_host(host, pshape, n_dp)
+                leaves[j]._set_data(jax.device_put(rows, zero_spec))
+            elif src_zero:
+                full = _zero.gather_host(host, pshape).astype(
+                    leaves[j]._data.dtype, copy=False)
+                leaves[j]._set_data(jax.device_put(full, repl))
+            else:
+                leaves[j]._set_data(jax.device_put(host, repl))
         residuals = payload.get("residuals") or []
         if self._compression_cfg is not None:
             if not residuals or resharded:
@@ -1182,6 +1509,99 @@ class DataParallelTrainer:
         return timed_recover(
             manager, self, "spmd", step=step,
             was_poisoned=self._donation_poisoned is not None)
+
+    def save_states(self, fname: str) -> str:
+        """Write the optimizer state (parity: ``gluon.Trainer.
+        save_states``) in the PORTABLE full layout: ZeRO-sharded
+        leaves are gathered to their param shapes on the host first,
+        so the file loads onto any dp size and onto ZeRO-off trainers
+        (fp32-exact — the gather is a flat reshape)."""
+        import pickle
+        from . import zero as _zero
+        if self._params is None:
+            raise MXNetError(
+                "save_states: run a step (or restore) first")
+        opt = self.optimizer
+        states = {}
+        for i in self._tr_idx:
+            leaves: List[NDArray] = []
+            _flatten(self._states[i], leaves)
+            pshape = tuple(self._params[i].data().shape)
+            hosts = []
+            for leaf in leaves:
+                host = np.asarray(leaf._data)
+                hosts.append(_zero.gather_host(host, pshape)
+                             if self._zero_stage else host)
+            states[int(i)] = hosts
+        blob = {
+            "format": 1, "kind": "spmd_opt_states",
+            "optimizer": type(opt).__name__,
+            "update_counts": {int(k): int(v)
+                              for k, v in
+                              opt._index_update_count.items()},
+            "num_update": int(opt.num_update),
+            "states": states,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f)
+        return fname
+
+    def load_states(self, fname: str):
+        """Load a :meth:`save_states` file into THIS trainer's layout:
+        full leaves re-shard onto the dp axis when ZeRO is on,
+        replicate otherwise.  Optimizer class must match."""
+        import jax
+        import pickle
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import zero as _zero
+        self._ensure_setup_for_restore()
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        if not isinstance(blob, dict) or \
+                blob.get("kind") != "spmd_opt_states":
+            raise MXNetError(f"{fname!r} is not a "
+                             "DataParallelTrainer save_states file")
+        opt = self.optimizer
+        if blob.get("optimizer") != type(opt).__name__:
+            raise MXNetError(
+                f"optimizer mismatch: file has "
+                f"{blob.get('optimizer')!r}, trainer runs "
+                f"{type(opt).__name__}")
+        repl = NamedSharding(self.mesh, P())
+        zero_spec = NamedSharding(self.mesh, P(self.dp_axis))
+        n_dp = int(self.mesh.shape.get(self.dp_axis, 1))
+        for i, hosts in blob["states"].items():
+            i = int(i)
+            if not (0 <= i < len(self._states)) or \
+                    self._states[i] is None:
+                raise MXNetError(
+                    f"state for param index {i} has no slot in this "
+                    "trainer (optimizer/trainable-set mismatch?)")
+            leaves: List[NDArray] = []
+            _flatten(self._states[i], leaves)
+            if len(hosts) != len(leaves):
+                raise MXNetError(
+                    f"param index {i}: file has {len(hosts)} state "
+                    f"leaves, trainer expects {len(leaves)}")
+            pshape = tuple(self._params[i].data().shape)
+            for leaf, host in zip(leaves, hosts):
+                if self._zero_stage:
+                    rows = _zero.reshard_host(host, pshape, n_dp)
+                    leaf._set_data(jax.device_put(rows, zero_spec))
+                else:
+                    # a ZeRO save is always f32; cast to the slot's
+                    # dtype (same contract as _elastic_restore) so the
+                    # state avals the compiled step baked never drift
+                    host = np.asarray(host).astype(
+                        leaf._data.dtype, copy=False)
+                    leaf._set_data(jax.device_put(host, repl))
+        counts = {int(k): int(v)
+                  for k, v in (blob.get("update_counts") or
+                               {}).items()}
+        for dev_counts in opt._all_index_update_counts.values():
+            dev_counts.clear()
+            dev_counts.update(counts)
+        opt.num_update = int(blob.get("num_update", opt.num_update))
 
     # -- public API -------------------------------------------------------
     def step(self, data, label):
@@ -1286,9 +1706,11 @@ class DataParallelTrainer:
         if not (self._fuse_step and self._rule is not None):
             raise MXNetError("step_multi requires fuse_step=True and "
                              "a fused optimizer rule")
-        if self._compression_cfg is not None:
+        if self._compression_cfg is not None and not self._zero_stage:
             raise MXNetError("step_multi does not support gradient "
-                             "compression")
+                             "compression (except composed with "
+                             "MXTPU_ZERO_STAGE, where the int8 reduce "
+                             "rides the ZeRO gradient leg)")
 
         # single-step views drive setup/tracing (shapes minus K)
         args0 = args if repeated else [a[0] for a in args]
@@ -1311,7 +1733,10 @@ class DataParallelTrainer:
                 self._build_fwd_bwd(args0,
                                     label if repeated else label[0])
             if self._full_fn is None:
-                self._build_full_step()
+                if self._zero_stage:
+                    self._build_full_step_zero()
+                else:
+                    self._build_full_step()
             if self._donation_poisoned is not None:
                 raise MXNetError(
                     "this trainer's optimizer state was donated to a "
@@ -1496,7 +1921,11 @@ class DataParallelTrainer:
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        full = self._full_fn
+        # under ZeRO the scan body is the PER-DEVICE step (the whole
+        # scanned program is shard_map-ped below); otherwise the
+        # globally-traced step
+        zero_on = bool(self._zero_stage)
+        full = self._zero_body if zero_on else self._full_fn
         tr_idx = self._tr_idx
         mutated_idx = self._mutated_idx
         has_health = self._health_spec is not None
@@ -1551,29 +1980,50 @@ class DataParallelTrainer:
                 return losses, params_f, tstates_f, healths
             return ys, params_f, tstates_f
 
-        batch_k = NamedSharding(
-            self.mesh,
-            P(self.dp_axis) if repeated else P(None, self.dp_axis))
-        repl = NamedSharding(self.mesh, P())
-        param_shardings, state_shardings = self._sharding_tuples()
-        # out-shardings pinned for the same TP-safety reason as
-        # _build_full_step (weights must not silently re-shard
-        # between steps; donation aliasing needs stable layouts)
-        out_shardings = (None, param_shardings, state_shardings)
-        in_shardings = (param_shardings, state_shardings, None,
-                        (batch_k,) * self._n_args, batch_k, repl)
-        if has_health:
-            out_shardings = out_shardings + (None,)
-            in_shardings = in_shardings + (None,)   # the due flags
-        fn = jax.jit(
-            full_k,
-            in_shardings=in_shardings,
-            out_shardings=out_shardings,
-            donate_argnums=(0, 1))
+        if zero_on:
+            # shard_map the whole scanned program: state leaves ride
+            # the carry in their (1, chunk) local form, the gradient
+            # reduce-scatter + weight all-gather run per inner step
+            from ._compat import shard_map
+            repl, state_spec, _ = self._zero_specs()
+            batch_k = P(self.dp_axis) if repeated \
+                else P(None, self.dp_axis)
+            out_specs = (repl, repl, state_spec)
+            in_specs = (repl, state_spec, repl,
+                        batch_k, batch_k, repl)
+            if has_health:
+                out_specs = out_specs + (repl,)
+                in_specs = in_specs + (repl,)   # the due flags
+            body = shard_map(
+                full_k, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False)
+            fn = jax.jit(body, donate_argnums=(0, 1))
+        else:
+            batch_k = NamedSharding(
+                self.mesh,
+                P(self.dp_axis) if repeated else P(None, self.dp_axis))
+            repl = NamedSharding(self.mesh, P())
+            param_shardings, state_shardings = self._sharding_tuples()
+            # out-shardings pinned for the same TP-safety reason as
+            # _build_full_step (weights must not silently re-shard
+            # between steps; donation aliasing needs stable layouts)
+            out_shardings = (None, param_shardings, state_shardings)
+            in_shardings = (param_shardings, state_shardings, None,
+                            (batch_k,) * self._n_args, batch_k, repl)
+            if has_health:
+                out_shardings = out_shardings + (None,)
+                in_shardings = in_shardings + (None,)   # the due flags
+            body = full_k
+            fn = jax.jit(
+                full_k,
+                in_shardings=in_shardings,
+                out_shardings=out_shardings,
+                donate_argnums=(0, 1))
         self._multi_step_cache[(k_steps, repeated)] = fn
         # the unjitted body backs the persistent tier's abstract
-        # re-trace (mutated_idx recovery on a persist hit)
-        self._multi_fns[(k_steps, repeated)] = full_k
+        # re-trace (mutated_idx recovery on a persist hit); under ZeRO
+        # that is the shard_map-wrapped scan, traceable at global avals
+        self._multi_fns[(k_steps, repeated)] = body
         return fn
 
     def _sharding_tuples(self):
@@ -1634,9 +2084,15 @@ class DataParallelTrainer:
                     scalar_vals.extend(
                         np.asarray(sv, dtype=np.float32)
                         for sv in self._rule.scalars(opt, i, t))
-                compressed = self._compression_cfg is not None
+                # ZeRO subsumes the int8 compressed exchange (the
+                # quantized reduce lives on its gradient leg), so the
+                # compressed builder/call-shape only applies at stage 0
+                compressed = self._compression_cfg is not None and \
+                    not self._zero_stage
                 if self._full_step is None:
-                    if compressed:
+                    if self._zero_stage:
+                        self._build_full_step_zero()
+                    elif self._compression_cfg is not None:
                         self._build_full_step_compressed()
                     else:
                         self._build_full_step()

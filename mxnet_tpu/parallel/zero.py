@@ -1,0 +1,178 @@
+"""ZeRO-1/2 cross-replica sharding of the weight update (host side).
+
+The paper trail is "Automatic Cross-Replica Sharding of Weight Update
+in Data-Parallel Training" (arXiv 2004.13336, PAPERS.md): replicated
+data-parallel training makes every dp member run the SAME optimizer
+update on the SAME reduced gradient — O(P) optimizer state and update
+FLOPs per member.  Sharding the update over the dp axis drops both by
+~dp x with no numerics change (the update is pointwise in the flat
+parameter), which is the HBM ceiling ROADMAP item 1 names.
+
+This module holds everything about the sharding that is NOT the traced
+step program: stage selection (``MXTPU_ZERO_STAGE``), trainer
+eligibility, the per-param flat-slice arithmetic (one record per
+trainable param: ``[name, size, padded, chunk]``), sharded
+optimizer-state creation, and the host-side layout conversions the
+checkpoint/``save_states`` portability matrix needs (a ZeRO checkpoint
+restores fp32-exact onto ANY dp size and onto ZeRO-off trainers, and
+vice versa — pure reshapes of the flat f32 slices, element values
+untouched).
+
+The traced side — reduce-scatter (stage 2) or psum+slice (stage 1) of
+the gradients, the fused multi-tensor update over each member's 1/N
+slice, and the all-gather of updated weights, all inside the single
+donated SPMD program — lives in ``parallel.trainer`` on the
+``collectives.sharded_weight_update`` seam.  See docs/zero.md.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["stage_from_env", "eligibility", "slice_record",
+           "param_slice", "create_sharded_states", "gather_host",
+           "reshard_host"]
+
+
+def stage_from_env() -> int:
+    """The requested ZeRO stage (``MXTPU_ZERO_STAGE``): 0 = off
+    (replicated update), 1 = sharded optimizer state with an all-reduce
+    gradient leg, 2 = sharded state AND a reduce-scatter gradient leg
+    (half the gradient wire bytes).  Anything else raises."""
+    from .. import envs
+    stage = int(envs.get("MXTPU_ZERO_STAGE"))
+    if stage not in (0, 1, 2):
+        raise MXNetError(
+            f"MXTPU_ZERO_STAGE must be 0, 1, or 2, got {stage}")
+    return stage
+
+
+def eligibility(trainer) -> Optional[str]:
+    """None when this trainer can run the ZeRO-sharded update, else a
+    human-readable reason.  Called at construction: an ineligible
+    trainer with the env set WARNS and runs stage 0 (the replicated
+    layout then trips the MXL310 runtime rule — a misconfigured plan
+    silently burning HBM is exactly what that lint exists to catch)."""
+    if not trainer._fuse_step or trainer._rule is None:
+        return ("ZeRO needs fuse_step=True with a fused optimizer "
+                "rule (the sharded update lives inside the single "
+                "SPMD step program)")
+    if not trainer._rule.pointwise:
+        # the eligibility bit lives ON the rule (trainer._FusedRule
+        # requires it explicitly), so adding a rule forces the
+        # decision at the definition site — the sharded update applies
+        # the rule to a 1/N slice, and per-tensor statistics (LAMB's
+        # trust ratio over ||w||) would silently compute per SLICE
+        return (f"optimizer {type(trainer.optimizer).__name__}'s "
+                "fused rule is not pointwise in the flat parameter "
+                "(per-tensor statistics would be computed per shard)")
+    if trainer._param_sharding is not None:
+        return ("ZeRO shards the UPDATE of dp-replicated params; a "
+                "param_sharding (tensor-parallel) rule already shards "
+                "the params themselves")
+    cfg = trainer._compression_cfg
+    if cfg is not None and cfg.get("type") != "int8":
+        return ("2bit compression carries per-device full-size "
+                "error-feedback residuals — incompatible with the "
+                "sharded gradient leg (int8 composes: quantize -> "
+                "scatter -> fp32 local accumulate)")
+    if trainer.optimizer.multi_precision:
+        return "multi-precision (fp16 master-weight) states are not " \
+           "sharded by the ZeRO path"
+    return None
+
+
+def _size(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def param_slice(shape, n_dp: int):
+    """``(size, padded, chunk)`` for one param: flat length, padded to
+    a multiple of ``n_dp``, and the per-member slice length."""
+    size = _size(shape)
+    padded = size + ((-size) % n_dp)
+    return size, padded, padded // n_dp
+
+
+def slice_record(params, tr_idx, n_dp: int) -> List[list]:
+    """The warm-start/checkpoint manifest rows pinning the sharding
+    layout: ``[name, size, padded, chunk]`` per trainable param, in
+    ``tr_idx`` order.  Verified on ``warm_start`` (fail-open on
+    mismatch) and consulted by the restore path's layout conversion."""
+    out = []
+    for i in tr_idx:
+        d = params[i].data()
+        size, padded, chunk = param_slice(d.shape, n_dp)
+        out.append([params[i].name, size, padded, chunk])
+    return out
+
+
+def create_sharded_states(optimizer, index, param_nd, mesh,
+                          dp_axis: str):
+    """The sharded-layout twin of ``Optimizer.create_state``: a tuple
+    of NDArray leaves, each a GLOBAL ``(n_dp, chunk)`` f32 zeros array
+    placed ``P(dp_axis)`` so every member holds its ``(1, chunk)``
+    slice — 1/N the replicated state's bytes per device.  The leaf
+    COUNT comes from the optimizer's own ``create_state`` on a (1,)
+    probe (SGD momentum, Adam m/v, ...), so save/load layouts stay in
+    the class's hands.  Returns None when the optimizer is stateless
+    for this param."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .. import ndarray as nd
+    from ..ndarray.ndarray import NDArray
+    from .collectives import sharded_update_state_init
+
+    probe = nd.zeros((1,), ctx=param_nd.context,
+                     dtype=param_nd.dtype.name)
+    template = optimizer.create_state(index, probe)
+    if template is None:
+        return None
+    n_leaves = len(template) if isinstance(template, (list, tuple)) \
+        else 1
+    n_dp = int(mesh.shape[dp_axis])
+    hosts = sharded_update_state_init(param_nd, n_leaves, n_dp)
+    sharding = NamedSharding(mesh, P(dp_axis))
+    return tuple(
+        NDArray(jax.device_put(h, sharding), ctx=param_nd.context)
+        for h in hosts)
+
+
+# -- host-side layout conversions (checkpoint portability matrix) ----------
+
+def gather_host(host: np.ndarray, shape) -> np.ndarray:
+    """``(n, chunk)`` sharded rows -> the full state tensor of
+    ``shape`` (trim the padding tail).  fp32-exact: a pure reshape."""
+    host = np.asarray(host)
+    size = _size(shape)
+    flat = host.reshape(-1)
+    if flat.size < size:
+        raise MXNetError(
+            f"sharded state rows hold {flat.size} elements, param "
+            f"shape {tuple(shape)} needs {size}")
+    return flat[:size].reshape(tuple(shape))
+
+
+def reshard_host(host: np.ndarray, shape, n_dp: int) -> np.ndarray:
+    """Any saved layout (full ``shape``, or ``(n_src, chunk_src)``
+    rows from a different dp size) -> ``(n_dp, chunk)`` rows for THIS
+    mesh.  fp32-exact: trim the old padding, re-pad for the new
+    member count."""
+    host = np.asarray(host)
+    size, padded, chunk = param_slice(shape, n_dp)
+    flat = host.reshape(-1)
+    if flat.size < size:
+        raise MXNetError(
+            f"saved state holds {flat.size} elements, param shape "
+            f"{tuple(shape)} needs {size}")
+    flat = flat[:size].astype(np.float32, copy=False)
+    if padded != size:
+        flat = np.concatenate(
+            [flat, np.zeros((padded - size,), np.float32)])
+    return flat.reshape(n_dp, chunk)

@@ -219,6 +219,17 @@ def _compute_full(spec: HealthSpec, loss_val, old_tvals, grads,
     def _sq(x):
         return jnp.sum(jnp.square(x.astype(jnp.float32)))
 
+    return _compute_from_sq(spec, loss_val, old_tvals,
+                            [_sq(g) for g in grads], new_tvals)
+
+
+def _compute_from_sq(spec: HealthSpec, loss_val, old_tvals, g_sq,
+                     new_tvals):
+    import jax.numpy as jnp
+
+    def _sq(x):
+        return jnp.sum(jnp.square(x.astype(jnp.float32)))
+
     # nonfinite DETECTION rides the squared sums the norms need
     # anyway: any NaN/Inf in a gradient poisons its sum, so
     # ~isfinite(sum) flags the subtree with ZERO extra passes over the
@@ -231,7 +242,6 @@ def _compute_full(spec: HealthSpec, loss_val, old_tvals, grads,
     def _bad(s):
         return (~jnp.isfinite(s)).astype(jnp.float32)
 
-    g_sq = [_sq(g) for g in grads]
     loss_mean = jnp.mean(loss_val.astype(jnp.float32))
     sub_slots = []
     bad_total = _bad(loss_mean)
@@ -278,6 +288,29 @@ def compute(spec: HealthSpec, loss_val, old_tvals, grads, new_tvals,
         due > 0,
         lambda: _compute_full(spec, loss_val, old_tvals, grads,
                               new_tvals),
+        lambda: jnp.zeros((spec.n,), jnp.float32))
+
+
+def compute_sharded(spec: HealthSpec, loss_val, old_tvals, g_sq,
+                    new_tvals, due=None):
+    """:func:`compute` for a step whose full gradients NEVER
+    materialize (the ZeRO-2 reduce-scatter path, docs/zero.md):
+    ``g_sq`` holds the per-trainable-param GLOBAL squared gradient
+    sums, which the step derives from its scattered slices plus ONE
+    (T,)-vector psum — ``sum over members of sum(slice**2)`` equals
+    the full gradient's squared sum exactly, so every slot (norms,
+    nonfinite flags, attribution) matches the replicated computation
+    while the gradient wire stays reduce-scatter.  Same ``due``/skip
+    semantics as :func:`compute`."""
+    if due is None or spec.skip:
+        return _compute_from_sq(spec, loss_val, old_tvals, g_sq,
+                                new_tvals)
+    import jax.numpy as jnp
+    from jax import lax
+    return lax.cond(
+        due > 0,
+        lambda: _compute_from_sq(spec, loss_val, old_tvals, g_sq,
+                                 new_tvals),
         lambda: jnp.zeros((spec.n,), jnp.float32))
 
 

@@ -48,7 +48,8 @@ from .recorder import record_event
 
 __all__ = [
     "harvest_compiled", "programs", "collective_stats", "census",
-    "param_census", "note_param_tree", "param_trees", "report",
+    "param_census", "note_param_tree", "param_trees",
+    "opt_state_census", "note_opt_state", "opt_state_trees", "report",
     "dump_report", "device_capacity", "reset",
     "OOM_RISK_RATIO",
 ]
@@ -60,6 +61,9 @@ _programs: Dict[str, dict] = {}
 #: registered param trees (SPMD trainers): name -> layout snapshot,
 #: the MXL309 input
 _param_trees: Dict[str, dict] = {}
+#: registered optimizer-state layouts (SPMD trainers): name -> census,
+#: the MXL310 input and the ZeRO memory-drop evidence
+_opt_trees: Dict[str, dict] = {}
 # the unavailable event is per PROCESS, not per program — a CPU run
 # compiles hundreds of programs and one event says it all
 _unavailable_reported = [False]
@@ -442,6 +446,22 @@ def programs() -> Dict[str, dict]:
 
 # -- live-buffer + param census ----------------------------------------------
 
+def _sharding_info(v) -> Tuple[str, bool]:
+    """``(spec string, fully-replicated?)`` of one device array — THE
+    replicated-detection rule MXL309 (params) and MXL310 (optimizer
+    state) both judge by, so the two censuses can never disagree on
+    what "replicated" means."""
+    spec = ""
+    replicated = True
+    try:
+        s = v.sharding
+        spec = str(getattr(s, "spec", ""))
+        replicated = not any(
+            ax is not None for ax in getattr(s, "spec", ()) or ())
+    except Exception:
+        pass
+    return spec, replicated
+
 def census() -> dict:
     """Per-device HBM bytes of the engine's live tracked buffers:
     ``{"total_bytes", "count", "by_device"}``.  Donated/deleted buffers
@@ -505,15 +525,7 @@ def param_census(params) -> dict:
             nb = int(v.nbytes)
         except Exception:
             continue
-        spec = ""
-        replicated = True
-        try:
-            s = v.sharding
-            spec = str(getattr(s, "spec", ""))
-            replicated = not any(
-                ax is not None for ax in getattr(s, "spec", ()) or ())
-        except Exception:
-            pass
+        spec, replicated = _sharding_info(v)
         rows.append({"name": name, "shape": list(d.shape),
                      "dtype": str(d.dtype), "nbytes": nb,
                      "sharding": spec, "replicated": replicated})
@@ -554,6 +566,93 @@ def note_param_tree(name: str, params, mesh=None,
 def param_trees() -> Dict[str, dict]:
     with _lock:
         return {k: dict(v) for k, v in _param_trees.items()}
+
+
+def opt_state_census(leaves) -> dict:
+    """Attribute HBM bytes to optimizer-state leaves, split into
+    per-replica SHARDED vs REPLICATED residency.
+
+    ``leaves``: iterable of ``(label, jax array)`` (what
+    ``DataParallelTrainer._opt_state_leaves`` registers).  Each row
+    records global bytes, per-DEVICE bytes (the sharding's
+    ``shard_shape`` — a leaf sharded over dp counts 1/dp per device),
+    and the replicated flag.  ``per_device_bytes = replicated_bytes +
+    sharded_bytes_per_device`` is the figure the ZeRO ~dp x drop is
+    measured against (gauge ``mxtpu_optimizer_state_bytes``)."""
+    import numpy as np
+    rows = []
+    total = 0
+    per_device = 0
+    sharded_pd = 0
+    repl_b = 0
+    for name, v in leaves:
+        try:
+            nb = int(v.nbytes)
+        except Exception:
+            continue
+        spec, replicated = _sharding_info(v)
+        pd = nb
+        try:
+            shard_shape = v.sharding.shard_shape(v.shape)
+            pd = int(np.prod(shard_shape)) * int(v.dtype.itemsize)
+        except Exception:
+            pass
+        rows.append({"name": str(name), "shape": list(v.shape),
+                     "dtype": str(v.dtype), "nbytes": nb,
+                     "bytes_per_device": pd, "sharding": spec,
+                     "replicated": replicated})
+        total += nb
+        per_device += pd
+        if replicated:
+            repl_b += nb
+        else:
+            sharded_pd += pd
+    rows.sort(key=lambda r: -r["nbytes"])
+    return {"leaves": rows, "count": len(rows), "total_bytes": total,
+            "per_device_bytes": per_device,
+            "replicated_bytes": repl_b,
+            "sharded_bytes_per_device": sharded_pd}
+
+
+def note_opt_state(name: str, leaves, mesh=None,
+                   dp_axis: Optional[str] = None, zero_stage: int = 0):
+    """Register a trainer's optimizer-state layout (called by
+    ``DataParallelTrainer`` after state creation).  A snapshot —
+    re-registering under the same name replaces it.  Sets the
+    ``mxtpu_optimizer_state_bytes`` gauge to the per-device total so
+    the ZeRO drop is measurable, not asserted.  No-op with telemetry
+    disabled."""
+    if not _switch.enabled:
+        return
+    try:
+        tree = opt_state_census(leaves)
+        mesh_size = 1
+        dp_size = 1
+        if mesh is not None:
+            try:
+                for v in mesh.shape.values():
+                    mesh_size *= int(v)
+                if dp_axis is not None:
+                    dp_size = int(mesh.shape.get(dp_axis, 1))
+            except Exception:
+                pass
+        tree["mesh_size"] = mesh_size
+        tree["dp_size"] = dp_size
+        tree["dp_axis"] = dp_axis
+        tree["zero_stage"] = int(zero_stage)
+        with _lock:
+            _opt_trees[name] = tree
+        gauge("mxtpu_optimizer_state_bytes",
+              "per-device optimizer-state bytes of the most recently "
+              "registered trainer (replicated + sharded shard)"
+              ).set(tree["per_device_bytes"])
+    except Exception:
+        pass
+
+
+def opt_state_trees() -> Dict[str, dict]:
+    with _lock:
+        return {k: dict(v) for k, v in _opt_trees.items()}
 
 
 # -- reporting ---------------------------------------------------------------
@@ -606,6 +705,9 @@ def report(top_n: Optional[int] = None, params=None) -> dict:
     }
     if params is not None:
         out["param_census"] = param_census(params)
+    opt_trees = opt_state_trees()
+    if opt_trees:
+        out["opt_states"] = opt_trees
     return out
 
 
@@ -652,4 +754,5 @@ def reset():
     with _lock:
         _programs.clear()
         _param_trees.clear()
+        _opt_trees.clear()
         _unavailable_reported[0] = False

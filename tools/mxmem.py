@@ -95,6 +95,27 @@ def render_report(rep: dict) -> str:
             lines.append(f"{str(row['name'])[:44]:44} "
                          f"{_fmt_bytes(row['nbytes']):>10} "
                          f"{shard[:20]:20}")
+    opt = rep.get("opt_states") or {}
+    for tname, tree in sorted(opt.items()):
+        lines.append("")
+        lines.append(
+            f"optimizer state [{tname}] "
+            f"(zero_stage={tree.get('zero_stage', 0)}, "
+            f"dp={tree.get('dp_size', 1)}): "
+            f"{_fmt_bytes(tree.get('total_bytes'))} global, "
+            f"{_fmt_bytes(tree.get('per_device_bytes'))}/device "
+            f"({_fmt_bytes(tree.get('replicated_bytes'))} replicated "
+            f"+ {_fmt_bytes(tree.get('sharded_bytes_per_device'))} "
+            "sharded shard)")
+        lines.append(f"{'LEAF':44} {'GLOBAL':>10} {'PER-DEV':>10} "
+                     f"{'SHARDING':20}")
+        for row in tree.get("leaves", []):
+            shard = "replicated" if row["replicated"] else \
+                str(row["sharding"])
+            lines.append(f"{str(row['name'])[:44]:44} "
+                         f"{_fmt_bytes(row['nbytes']):>10} "
+                         f"{_fmt_bytes(row['bytes_per_device']):>10} "
+                         f"{shard[:20]:20}")
     live = rep.get("live") or {}
     cap = rep.get("device_capacity_bytes")
     lines.append("")
