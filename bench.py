@@ -1118,6 +1118,93 @@ def bench_zero(sub_budget=180):
     return json.loads(line)
 
 
+_RESIZE_CHILD = r"""
+import json, os, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+from mxnet_tpu.elastic import CheckpointManager, ResizeController, resize
+
+X = np.random.RandomState(0).randn(64, 256).astype("f4")
+Y = np.random.RandomState(1).randint(0, 10, 64).astype("f4")
+np.random.seed(0); mx.random.seed(0)
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(512, activation="relu", in_units=256),
+            nn.Dense(512, activation="relu", in_units=512),
+            nn.Dense(10, in_units=512))
+net.initialize(mx.init.Xavier())
+dpt = parallel.DataParallelTrainer(
+    net, SoftmaxCrossEntropyLoss(), "adam", {"learning_rate": 1e-3},
+    mesh=parallel.make_mesh({"dp": 8}), fuse_step=True)
+out = {"dp_from": 8, "dp_to": 4}
+with tempfile.TemporaryDirectory() as d:
+    mgr = CheckpointManager(d, trainer=dpt, async_save=False)
+    for _ in range(5):
+        loss = dpt.step(nd.array(X), nd.array(Y))
+    loss.wait_to_read()
+    step_before = max(dpt.optimizer._index_update_count.values())
+    rc = ResizeController(dpt, mgr)
+    # measured downtime: drain start -> first post-swap step done.
+    # The pre-warm happens while the old mesh could still train, so
+    # its compile time is EXCLUDED (the wall clock here spans the
+    # whole resize() call and would otherwise be dominated by it)
+    t0 = time.perf_counter()
+    stats = rc.resize(parallel.make_mesh({"dp": 4}))
+    loss = dpt.step(nd.array(X), nd.array(Y))
+    loss.wait_to_read()
+    downtime = time.perf_counter() - t0 - stats["prewarm_seconds"]
+    step_after = max(dpt.optimizer._index_update_count.values())
+rec = resize.resizes()[-1]
+out["downtime_seconds"] = round(downtime, 4)
+out["drain_to_swap_seconds"] = stats["downtime_seconds"]
+out["prewarm_seconds"] = stats["prewarm_seconds"]
+# committed-step loss across the transition (must be 0: the drain
+# lands ON the boundary and the swap rolls nothing back — the step
+# counter continues exactly where the old mesh left it)
+out["committed_step_loss"] = int(step_before - rec["committed_step"])
+out["step_counter_continues"] = bool(step_after == step_before + 1)
+out["post_swap_fresh_compiles"] = rec["post_swap_fresh_compiles"]
+out["post_swap_misses"] = rec["post_swap_misses"]
+out["healed"] = rec["healed"]
+print(json.dumps(out))
+"""
+
+
+def bench_resize(sub_budget=180):
+    """Live-resize evidence on the 8-device CPU mesh (ISSUE 11
+    acceptance: measured, not asserted): downtime seconds from drain
+    start to the FIRST post-swap step, committed-step loss across the
+    transition (must be 0), and the post-swap fresh-compile count
+    (must be 0 — the pre-warm contract).  A child process for the same
+    reason as ``bench_zero``: the dp=8 virtual mesh needs
+    ``xla_force_host_platform_device_count`` before jax imports."""
+    env = dict(os.environ)
+    env.pop("MXTPU_ZERO_STAGE", None)
+    env.pop("MXTPU_FAULT_INJECT", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _RESIZE_CHILD],
+        capture_output=True, text=True, timeout=sub_budget, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    line = None
+    for ln in res.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if not line:
+        sys.stderr.write(res.stderr[-2000:])
+        raise RuntimeError(
+            f"resize bench child produced no JSON (rc={res.returncode})")
+    return json.loads(line)
+
+
 def _run_cpu_smoke_subprocess(sub_budget=240):
     """Run the degraded CPU smoke in a CHILD bench.py (so this process
     stays jax-free and can still take the chip path if a window opens
@@ -1282,6 +1369,23 @@ def main():
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 _record("zero", error=repr(e))
+            # live-resize evidence (docs/elasticity.md "Live resize"):
+            # dp 8->4 in-job on the 8-device child mesh — measured
+            # downtime (drain -> first post-swap step), committed-step
+            # loss (must be 0), post-swap fresh compiles (must be 0)
+            try:
+                rblock = bench_resize()
+                tblock["resize"] = rblock
+                _record("resize", **rblock)
+                _log(f"resize: dp {rblock['dp_from']}->"
+                     f"{rblock['dp_to']} downtime "
+                     f"{rblock['downtime_seconds']:.3f}s, "
+                     f"step loss {rblock['committed_step_loss']}, "
+                     f"{rblock['post_swap_fresh_compiles']} fresh "
+                     "compiles post-swap")
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                _record("resize", error=repr(e))
             # the telemetry block rides EVERY subsequently-emitted
             # result line (stage 2 overwrites the metric, not this),
             # so the trajectory files capture dispatch/retrace/stall

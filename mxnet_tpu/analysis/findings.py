@@ -91,6 +91,10 @@ RULES = {
                "(a failure loses the whole run)"),
     "MXL502": (Severity.ERROR,
                "corrupt or torn elastic checkpoint"),
+    "MXL503": (Severity.WARNING,
+               "live resize broke its contract (post-swap fresh "
+               "compile, or the drain committed an older step than "
+               "the trainer had)"),
     # -- serving passes (MXL6xx) ----------------------------------------
     "MXL601": (Severity.WARNING,
                "per-request prefill/decode loop without the serving "

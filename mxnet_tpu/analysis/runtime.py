@@ -215,6 +215,15 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
       it — the retention window is silently thinner than configured); a
       torn ``.tmp-step-*`` dir is only a WARNING (a crash artifact or
       an in-flight write; ``mxckpt.py prune`` clears it).
+    * MXL503 — a COMPLETED live resize (``elastic.resize.resizes()``)
+      that broke its contract: the first post-swap step paid
+      ``fresh_compiles > 0`` (the pre-warm promised the swap a ready
+      executable and did not deliver — downtime silently grew by a
+      compile), or the drain committed an OLDER step than the trainer
+      had reached (a mid-resize crash-heal would then lose committed
+      training work).  Quiet in a fresh process (empty registry), and
+      a healthy resize whose probe has not fired yet
+      (``post_swap_fresh_compiles`` still ``None``) reports nothing.
     """
     from .. import envs, telemetry
     from ..elastic import manager as _mgr
@@ -252,6 +261,35 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
                     "thinner than configured; keep more steps or "
                     "delete the corrupt dir",
                     f"ckpt:{row['path']}"))
+    from ..elastic import resize as _resize
+    for n, rec in enumerate(_resize.resizes()):
+        where = (f"{rec.get('kind')} "
+                 f"{rec.get('mesh_from') or rec.get('slots_from')} -> "
+                 f"{rec.get('mesh_to') or rec.get('slots_to')}")
+        fresh = rec.get("post_swap_fresh_compiles")
+        if fresh:
+            findings.append(Finding(
+                "MXL503",
+                f"live resize #{n} ({where}) paid {fresh} fresh "
+                f"compile(s) on its first post-swap step — the "
+                "pre-warm contract is broken and the measured "
+                "downtime silently excludes a compile; check the "
+                "persist tier / prepare_resize coverage of every "
+                "dispatched variant (docs/elasticity.md, 'Live "
+                "resize')",
+                f"resize:{n}"))
+        drain = rec.get("drain_step")
+        committed = rec.get("committed_step")
+        if drain is not None and committed is not None and \
+                int(committed) < int(drain):
+            findings.append(Finding(
+                "MXL503",
+                f"live resize #{n} ({where}) drained at trainer step "
+                f"{drain} but committed checkpoint step {committed} — "
+                "a mid-resize crash-heal would lose "
+                f"{int(drain) - int(committed)} committed step(s); "
+                "the drain must land ON the boundary, not behind it",
+                f"resize:{n}"))
     return findings
 
 

@@ -28,14 +28,21 @@ from __future__ import annotations
 from . import faults
 from . import reshard
 
-__all__ = ["CheckpointManager", "faults", "manager", "reshard"]
+__all__ = ["CheckpointManager", "ResizeController",
+           "ServingAutoscaler", "faults", "manager", "reshard",
+           "resize"]
 
 
 def __getattr__(name):
     # manager pulls in ndarray/telemetry; keep package import light so
-    # engine can import .faults without a cycle
+    # engine can import .faults without a cycle (resize rides the same
+    # lazy path — it reaches into the trainers/serving plane)
     if name in ("CheckpointManager", "manager"):
         import importlib
         mod = importlib.import_module(".manager", __name__)
         return mod if name == "manager" else mod.CheckpointManager
+    if name in ("ResizeController", "ServingAutoscaler", "resize"):
+        import importlib
+        mod = importlib.import_module(".resize", __name__)
+        return mod if name == "resize" else getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

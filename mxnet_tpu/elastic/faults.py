@@ -33,6 +33,18 @@ shims):
   NaN in its input batch, so the compiled program produces a nonfinite
   loss/gradients and the health plane's detection, skip gate, and
   rollback paths are exercised (docs/observability.md).
+* ``resize_drain`` / ``resize_prewarm`` / ``resize_reshard`` /
+  ``resize_swap`` — the four transition points of a LIVE elastic
+  resize (``elastic.resize.ResizeController``, docs/elasticity.md
+  "Live resize").  A fault at ``resize_drain``/``resize_prewarm``
+  aborts with the owner untouched on the OLD mesh; one at
+  ``resize_reshard``/``resize_swap`` lands after the drain checkpoint
+  committed, so the controller crash-heals onto the NEW mesh from it —
+  either way the owner ends on a consistent mesh, never poisoned with
+  no recovery path.  The reshard's buffer moves go through
+  :func:`on_dispatch` with the PRE-FILTERED donated set (``donate=
+  None``), so a ``dispatch_post`` drill during a resize consumes only
+  buffers the move was going to donate anyway.
 
 Qualifiers: ``nth=N`` fires on the Nth arrival at the point (1-based,
 default 1); ``step=N`` fires on the first arrival at or after global
@@ -56,7 +68,8 @@ __all__ = ["FaultError", "FaultSpec", "configure", "configure_from_env",
 #: the injection points wired into the runtime (unknown points parse —
 #: forward compatibility — but are reported by :func:`configure`)
 POINTS = ("dispatch", "dispatch_post", "checkpoint_write", "host_copy",
-          "nonfinite_grad")
+          "nonfinite_grad", "resize_drain", "resize_prewarm",
+          "resize_reshard", "resize_swap")
 
 
 class FaultError(RuntimeError):

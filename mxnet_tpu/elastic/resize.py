@@ -1,0 +1,333 @@
+"""Live elastic resize: in-job mesh shrink/grow without a restart.
+
+PR 7 made a mesh change survivable — at RESTART time: reshard +
+re-AOT when the restored process's device count differs.  This module
+removes the restart.  The array moves are the portable-collective
+redistribution of arXiv 2112.01075 (``elastic.reshard``, the same
+machinery the restore path uses) applied to the LIVE donated buffers,
+and the executable swap rides the PR 5/10 AOT warm-start seam
+(``engine.aot_compile`` / the persistent tier), so going from mesh A
+to mesh B costs one drain + one dispatch swap — never a process
+bounce, never a cold compile.
+
+:class:`ResizeController` takes a running ``DataParallelTrainer``
+through four phases (docs/elasticity.md, "Live resize"):
+
+1. **pre-warm** — while the old mesh still trains,
+   ``trainer.prepare_resize(mesh)`` AOT-compiles the step +
+   ``step_multi(K)`` variants (and the ZeRO ``(dp, chunk)`` slice
+   layout) for the target mesh;
+2. **drain** — finish in-flight work and land on a COMMITTED
+   checkpoint boundary (``manager.save(block=True)`` through the
+   existing double-buffered device->host path) — the anchor every
+   mid-resize crash heals from;
+3. **reshard** — redistribute the live donated params / optimizer
+   state / ZeRO slices (fp32-exact, donation-aware: the same-device-
+   set move is ONE donated identity program, so there is never a
+   transient 2x HBM copy of the model);
+4. **swap + resume** — rebind the trainer's compiled entries and
+   train on; downtime = drain start -> swap complete, measured into
+   ``mxtpu_resize_downtime_seconds``.
+
+Every transition has a deterministic fault point in the
+``MXTPU_FAULT_INJECT`` grammar (``resize_drain`` / ``resize_prewarm``
+/ ``resize_reshard`` / ``resize_swap``).  A fault before the drain
+checkpoint commits aborts with the trainer untouched on the OLD mesh;
+one after it crash-heals onto the NEW mesh by restoring the drain
+checkpoint into the pre-warmed bindings (``recovery`` telemetry, as
+in PR 7) — either way the trainer ends on a consistent mesh, never
+poisoned with no recovery path.
+
+The same protocol points at the serving plane:
+:class:`ServingAutoscaler` watches the queue-depth / occupancy gauges
+and drives ``serving.Server.resize_slots`` (prewarm -> drain ->
+migrate -> swap) with hysteresis from the ``MXTPU_RESIZE_*`` knobs.
+
+Every COMPLETED resize lands in an in-process registry
+(:func:`resizes` / :func:`report`, rendered by ``tools/mxresize.py``)
+that mxlint's MXL503 runtime pass audits: a resize whose first
+post-swap step paid a fresh compile (pre-warm contract broken) or
+whose drain committed an older step than the trainer had (a committed
+step would be lost on heal) is a finding.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from . import faults
+
+__all__ = ["ResizeController", "ServingAutoscaler", "resizes",
+           "report", "mesh_desc"]
+
+_reg_lock = threading.Lock()
+_records: List[dict] = []
+
+
+def mesh_desc(mesh) -> Dict[str, int]:
+    """``{axis: size}`` of a jax Mesh (registry/event field form)."""
+    return {str(k): int(v) for k, v in mesh.shape.items()}
+
+
+def _note_completed(rec: dict) -> dict:
+    """Append a completed resize to the registry and emit the
+    telemetry triple: counter, downtime histogram, retained ``resize``
+    event."""
+    from .. import telemetry
+    with _reg_lock:
+        _records.append(rec)
+    telemetry.counter(
+        "mxtpu_resizes_total",
+        "completed live resizes (train mesh changes + serving slot "
+        "changes), healed ones included").inc()
+    telemetry.histogram(
+        "mxtpu_resize_downtime_seconds",
+        "drain start -> executable swap complete per live resize "
+        "(s)").observe(float(rec.get("downtime_seconds", 0.0)))
+    # the record's "kind" (train | serving) would collide with the
+    # event taxonomy key — it rides as resize_kind in the event
+    telemetry.record_event(
+        "resize", **{("resize_kind" if k == "kind" else k): v
+                     for k, v in rec.items() if not k.startswith("_")})
+    return rec
+
+
+def _note_failed(kind: str, phase: str, error: str, **fields):
+    from .. import telemetry
+    telemetry.record_event("resize_failed", resize_kind=kind,
+                           phase=phase, error=error[:300], **fields)
+
+
+def resizes() -> List[dict]:
+    """Completed-resize records (oldest first; copies — the MXL503
+    input).  ``post_swap_fresh_compiles`` stays ``None`` until the
+    first post-swap step fires the trainer's one-shot probe."""
+    with _reg_lock:
+        return [dict(r) for r in _records]
+
+
+def _reset():
+    """Test hook."""
+    with _reg_lock:
+        _records.clear()
+
+
+def report() -> dict:
+    """Live-process resize report (``tools/mxresize.py status``)."""
+    from .. import telemetry
+    snap = telemetry.snapshot()
+    hist = snap["histograms"].get("mxtpu_resize_downtime_seconds", {})
+    return {
+        "resizes": resizes(),
+        "total": snap["counters"].get("mxtpu_resizes_total", 0.0),
+        "downtime_seconds": {k: hist.get(k)
+                             for k in ("count", "sum")},
+        "failed_events": [e for e in telemetry.events("resize_failed")],
+    }
+
+
+def _trainer_step(trainer) -> int:
+    opt = trainer.optimizer
+    return int(max(opt._index_update_count.values(),
+                   default=int(opt.num_update)))
+
+
+class ResizeController:
+    """Drive a running ``DataParallelTrainer`` from its mesh to a
+    target mesh without losing a committed step.
+
+    Args:
+      trainer: a ``parallel.DataParallelTrainer`` with ``fuse_step=
+        True`` that has run at least one fused step.
+      manager: the trainer's ``elastic.CheckpointManager`` — the drain
+        checkpoint (and any crash-heal) goes through it.
+    """
+
+    def __init__(self, trainer, manager):
+        if manager is None:
+            raise MXNetError(
+                "ResizeController needs a CheckpointManager: the "
+                "drain lands on a committed checkpoint boundary, and "
+                "a mid-resize crash heals from it")
+        self.trainer = trainer
+        self.manager = manager
+
+    def resize(self, mesh) -> dict:
+        """Take the trainer to ``mesh``.  Returns the registry record
+        (also appended to :func:`resizes`).  A failure BEFORE the
+        drain checkpoint commits raises with the trainer untouched on
+        the old mesh; a failure after it heals onto the new mesh from
+        the drain checkpoint (``healed: True`` in the record)."""
+        from .. import engine, telemetry
+        trainer = self.trainer
+        mesh_from = mesh_desc(trainer.mesh)
+        mesh_to = mesh_desc(mesh)
+        phase = "prewarm"
+        try:
+            # 1) PRE-WARM (the old mesh could still be stepping
+            # between controller calls; nothing here touches it)
+            faults.maybe_fire("resize_prewarm")
+            t_pw = time.perf_counter()
+            staged = trainer.prepare_resize(mesh)
+            prewarm_s = time.perf_counter() - t_pw
+            # 2) DRAIN — the downtime clock starts here: finish
+            # in-flight checkpoint work and COMMIT the boundary the
+            # swap (or a crash-heal) resumes from
+            phase = "drain"
+            t_drain = time.perf_counter()
+            faults.maybe_fire("resize_drain")
+            drain_step = _trainer_step(trainer)
+            committed = int(self.manager.save(block=True, force=True))
+        except Exception as e:
+            # the trainer was never touched: still on mesh A, training
+            _note_failed("train", phase, repr(e), mesh_from=mesh_from,
+                         mesh_to=mesh_to, still_on="old_mesh")
+            raise
+        healed = False
+        heal_error = None
+        try:
+            # 3) + 4) RESHARD + SWAP (fault points fire inside)
+            trainer.apply_resize(staged)
+        except Exception as e:
+            # the drain checkpoint is committed and the mesh-B
+            # programs are warm: adopt the new bindings and restore
+            # the checkpoint INTO them — cleanly on mesh B, with the
+            # PR 7 recovery telemetry
+            heal_error = repr(e)
+            _note_failed("train", "reshard_swap", heal_error,
+                         mesh_from=mesh_from, mesh_to=mesh_to,
+                         heal="checkpoint_restore")
+            from .manager import timed_recover
+            trainer._resize_swap(staged)
+            timed_recover(self.manager, trainer, "resize_heal",
+                          step=committed)
+            trainer._note_resize_layouts()
+            healed = True
+        downtime = time.perf_counter() - t_drain
+        rec = {
+            "kind": "train", "mesh_from": mesh_from,
+            "mesh_to": mesh_to, "zero_stage": trainer._zero_stage,
+            "drain_step": drain_step, "committed_step": committed,
+            "healed": healed,
+            "prewarm_seconds": round(prewarm_s, 4),
+            "downtime_seconds": round(downtime, 4),
+            "post_swap_misses": None,
+            "post_swap_fresh_compiles": None,
+        }
+        if heal_error:
+            rec["heal_error"] = heal_error[:300]
+        _note_completed(rec)
+        # arm the pre-warm-contract probe: the FIRST post-swap step
+        # finalizes the record with the compiles it paid (must be 0 —
+        # MXL503 audits this).  The baseline is captured at that
+        # step's START (trainer._note_resize_probe_base), not here:
+        # the swap→first-step window is unbounded, and another owner
+        # compiling in it must not be attributed to this resize.
+        arm_counts = engine.compile_counts()
+        t_swap = time.perf_counter()
+
+        def _probe(base):
+            m0, f0 = base if base is not None else arm_counts
+            m1, f1 = engine.compile_counts()
+            with _reg_lock:
+                rec["post_swap_misses"] = m1 - m0
+                rec["post_swap_fresh_compiles"] = f1 - f0
+                rec["first_step_gap_seconds"] = round(
+                    time.perf_counter() - t_swap, 4)
+
+        trainer._post_resize_probe = _probe
+        telemetry.record_event(
+            "reshard", where="live_resize", saved_mesh=mesh_from,
+            mesh=mesh_to)
+        return dict(rec)
+
+
+class ServingAutoscaler:
+    """Hysteresis autoscale policy over the serving plane's existing
+    signals (the ``mxtpu_serving_queue_depth`` /
+    ``mxtpu_serving_batch_occupancy`` gauges' sources), driving
+    ``Server.resize_slots`` through the same prewarm -> drain ->
+    migrate -> swap protocol.
+
+    Call :meth:`observe` once per scheduling round (or from a poll
+    loop).  Growth doubles the slot count when the wait queue has been
+    at/above ``up_queue`` for ``patience`` consecutive observations;
+    shrink halves it when the queue is empty AND occupancy has been
+    at/below ``down_occupancy`` for ``patience`` observations —
+    asymmetric on purpose (grow on queued demand, shrink only when
+    demonstrably idle).  ``cooldown_s`` spaces resizes so the two
+    thresholds cannot flap the plane.  All defaults come from the
+    ``MXTPU_RESIZE_*`` env knobs (docs/env_vars.md)."""
+
+    def __init__(self, server, min_slots: Optional[int] = None,
+                 max_slots: Optional[int] = None,
+                 up_queue: Optional[int] = None,
+                 down_occupancy: Optional[float] = None,
+                 patience: Optional[int] = None,
+                 cooldown_s: Optional[float] = None):
+        from .. import envs
+
+        def _get(v, name, typ):
+            return typ(envs.get(name)) if v is None else typ(v)
+
+        self.server = server
+        self.min_slots = _get(min_slots, "MXTPU_RESIZE_MIN_SLOTS", int)
+        self.max_slots = _get(max_slots, "MXTPU_RESIZE_MAX_SLOTS", int)
+        self.up_queue = _get(up_queue, "MXTPU_RESIZE_UP_QUEUE", int)
+        self.down_occupancy = _get(down_occupancy,
+                                   "MXTPU_RESIZE_DOWN_OCCUPANCY",
+                                   float)
+        self.patience = max(1, _get(patience, "MXTPU_RESIZE_PATIENCE",
+                                    int))
+        self.cooldown_s = _get(cooldown_s, "MXTPU_RESIZE_COOLDOWN_S",
+                               float)
+        if self.min_slots < 1 or self.max_slots < self.min_slots:
+            raise MXNetError(
+                f"bad slot bounds [{self.min_slots}, "
+                f"{self.max_slots}]")
+        self._hot = 0
+        self._cold = 0
+        self._last_resize = float("-inf")
+
+    def slots(self) -> int:
+        return max(b.slots for b in self.server.sched.buckets)
+
+    def observe(self) -> Optional[dict]:
+        """One policy tick: update the hysteresis counters from the
+        live queue depth / occupancy and fire a resize when a
+        threshold held for ``patience`` ticks (and the cooldown
+        passed).  Returns the resize record when one fired, else
+        ``None``."""
+        sched = self.server.sched
+        q = sched.queue_depth()
+        occ = sched.occupancy()
+        if q >= self.up_queue:
+            self._hot += 1
+            self._cold = 0
+        elif q == 0 and occ <= self.down_occupancy:
+            self._cold += 1
+            self._hot = 0
+        else:
+            self._hot = 0
+            self._cold = 0
+        now = time.monotonic()
+        if now - self._last_resize < self.cooldown_s:
+            return None
+        cur = self.slots()
+        target = None
+        reason = None
+        if self._hot >= self.patience and cur < self.max_slots:
+            target = min(self.max_slots, cur * 2)
+            reason = f"queue_depth {q} >= {self.up_queue}"
+        elif self._cold >= self.patience and cur > self.min_slots:
+            target = max(self.min_slots, cur // 2)
+            reason = (f"occupancy {occ:.2f} <= "
+                      f"{self.down_occupancy:.2f}, queue empty")
+        if target is None or target == cur:
+            return None
+        self._hot = 0
+        self._cold = 0
+        self._last_resize = now
+        return self.server.resize_slots(target, reason=reason)

@@ -230,6 +230,26 @@ _reg("MXTPU_ZERO_STAGE", int, 0,
      "weights. Read at DataParallelTrainer construction; numerics are "
      "fp32-parity with stage 0, and checkpoints stay portable across "
      "stages and dp sizes.")
+_reg("MXTPU_RESIZE_UP_QUEUE", int, 4,
+     "ServingAutoscaler grow signal: wait-queue depth at/above which "
+     "an observation counts toward growing the serving plane's slot "
+     "count (elastic.resize; docs/elasticity.md 'Live resize').")
+_reg("MXTPU_RESIZE_DOWN_OCCUPANCY", float, 0.25,
+     "ServingAutoscaler shrink signal: slot occupancy at/below which "
+     "(with an empty queue) an observation counts toward halving the "
+     "slot count.")
+_reg("MXTPU_RESIZE_PATIENCE", int, 3,
+     "Consecutive breaching observations before the ServingAutoscaler "
+     "acts — the hysteresis that keeps a bursty queue from flapping "
+     "the serving plane.")
+_reg("MXTPU_RESIZE_COOLDOWN_S", float, 30.0,
+     "Minimum seconds between autoscaler-driven resizes (each resize "
+     "pays a drain + migrate, so back-to-back flips are never free).")
+_reg("MXTPU_RESIZE_MIN_SLOTS", int, 1,
+     "Lower bound on the autoscaled per-bucket slot count.")
+_reg("MXTPU_RESIZE_MAX_SLOTS", int, 64,
+     "Upper bound on the autoscaled per-bucket slot count (each slot "
+     "holds cache_len KV positions of HBM in every bucket).")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

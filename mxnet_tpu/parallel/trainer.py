@@ -194,6 +194,10 @@ class DataParallelTrainer:
         # set when a fused step failed after its donated optimizer
         # state was handed to the executable (see _step_impl)
         self._donation_poisoned = None
+        # one-shot callback fired at the end of the first successful
+        # step after a live resize swap (elastic.resize finalizes the
+        # pre-warm-contract accounting there — MXL503)
+        self._post_resize_probe = None
         # id(NDArray) -> (weakref, source buffer, placed buffer,
         # requested sharding);
         # pruned to the CURRENT step's inputs each step, so at most
@@ -622,8 +626,7 @@ class DataParallelTrainer:
         batch = NamedSharding(self.mesh, P(self.dp_axis))
         repl = NamedSharding(self.mesh, P())
         param_shardings, state_shardings = self._sharding_tuples()
-        tr_param_shardings = tuple(
-            self._params[i].data()._data.sharding for i in tr_idx)
+        tr_param_shardings = tuple(param_shardings[i] for i in tr_idx)
         # out shardings pinned for the same reason as the two-phase
         # update: a TP rule must not let XLA silently re-shard weights
         # between steps (and donation aliasing needs stable layouts)
@@ -1603,6 +1606,339 @@ class DataParallelTrainer:
             dev_counts.update(counts)
         opt.num_update = int(blob.get("num_update", opt.num_update))
 
+    # -- live elastic resize (docs/elasticity.md, "Live resize") ----------
+    def _resize_check(self, mesh):
+        """Raise ``MXNetError`` when this trainer cannot be resized
+        onto ``mesh`` (the eligibility half of ``prepare_resize``)."""
+        if self._params is None or not self._var_avals:
+            raise MXNetError(
+                "prepare_resize: run at least one successful fused "
+                "step() / step_multi() first (the recorded variants "
+                "are what the pre-warm compiles for the target mesh)")
+        if not (self._fuse_step and self._rule is not None):
+            raise MXNetError(
+                "live resize requires fuse_step=True with a fused "
+                "optimizer rule (the swap rebinds the fused step's "
+                "compiled entries)")
+        if self._compression_cfg is not None and not self._zero_stage:
+            raise MXNetError(
+                "live resize does not cover stage-0 gradient "
+                "compression (per-replica error-feedback residuals "
+                "have no exact mapping across a dp change); restart "
+                "through the checkpoint reshard path instead")
+        if self._donation_poisoned is not None:
+            raise MXNetError(
+                "trainer is poisoned; recover(manager) before "
+                "resizing")
+        mesh_now = {str(k): int(v) for k, v in self.mesh.shape.items()}
+        mesh_new = {str(k): int(v) for k, v in mesh.shape.items()}
+        if set(mesh_now) != set(mesh_new) or \
+                self.dp_axis not in mesh_new:
+            raise MXNetError(
+                f"resize target mesh axes {sorted(mesh_new)} must "
+                f"match the current axes {sorted(mesh_now)} (only "
+                "axis SIZES change in a live resize)")
+        # (batch divisibility against the target dp size is validated
+        # per data shape by prepare_resize's job construction — the
+        # superset of the recorded rows — before any state is touched)
+
+    def prepare_resize(self, mesh):
+        """PRE-WARM a live resize: AOT-compile every recorded fused
+        step variant (single + each ``step_multi(K)``) for the target
+        ``mesh`` — through the persistent tier when it is on — while
+        this trainer keeps training on its CURRENT mesh.  Returns an
+        opaque staged bundle for :meth:`apply_resize`; on any failure
+        the trainer is left exactly as it was.
+
+        The target-mesh programs are compiled purely from avals: param
+        /state layouts come from :meth:`_sharding_tuples` (structural,
+        mesh-parameterized), ZeRO state rows from
+        ``zero.state_avals`` (the ``(n_dp, chunk)`` layout the swap
+        will materialize), and the data avals from the recorded
+        variant rows — so the swap later pays ZERO fresh compiles
+        (tier-1 asserted; MXL503 watches the contract at runtime)."""
+        import jax
+        from ..engine import persist as _persist
+        from . import zero as _zero
+
+        self._resize_check(mesh)
+        self._refresh_health()
+        n_b = int(mesh.shape[self.dp_axis])
+
+        param_sds = tuple(
+            jax.ShapeDtypeStruct(tuple(p.data().shape),
+                                 p.data()._data.dtype)
+            for p in self._params)
+        if self._zero_stage:
+            state_sds = _zero.state_avals(self._params, self._tr_idx,
+                                          self._states, n_b)
+        else:
+            state_sds = tuple(
+                tuple(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                      for v in vals)
+                for vals in self._state_vals())
+
+        # every data shape this trainer has DISPATCHED must swap warm:
+        # the recorded variant rows hold one (the first) data shape
+        # per variant, while the per-signature exec caches hold them
+        # all (a second batch size resolves its own executable without
+        # a new row) — the job list is their union, deduped by the
+        # data avals, each validated against the target dp size
+        def _sds(entry):
+            return jax.ShapeDtypeStruct(entry[0], np.dtype(entry[1]))
+
+        jobs = {}
+
+        def _add_job(k, rep, scal_sds, x_sds, y_sds, key_sds,
+                     extra_sds):
+            from ..engine import persist as _p
+            for a in list(x_sds) + [y_sds]:
+                shape = tuple(a.shape)
+                stacked = k and not rep
+                if not shape or (stacked and len(shape) < 2):
+                    continue
+                bdim = shape[1] if stacked else shape[0]
+                if bdim % n_b:
+                    raise MXNetError(
+                        f"global batch dim {bdim} does not divide "
+                        f"the target dp size {n_b}; cannot resize "
+                        "without changing the batch layout")
+            key = (k, rep, _p.aval_sig(
+                list(scal_sds) + list(x_sds) + [y_sds, key_sds] +
+                list(extra_sds)))
+            jobs.setdefault(
+                key, (list(scal_sds), tuple(x_sds), y_sds, key_sds,
+                      tuple(extra_sds)))
+
+        for (k, rep), row in self._var_avals.items():
+            try:
+                _add_job(
+                    k, rep,
+                    [_sds(a) for a in
+                     _persist.sig_from_json(row["scalars"])],
+                    [_sds(a) for a in
+                     _persist.sig_from_json(row["inputs"])],
+                    _sds(_persist.sig_from_json([row["label"]])[0]),
+                    _sds(_persist.sig_from_json([row["key"]])[0]),
+                    [_sds(a) for a in
+                     _persist.sig_from_json(row.get("extra") or [])])
+            except (TypeError, ValueError, KeyError) as e:
+                raise MXNetError(
+                    f"prepare_resize: bad recorded variant avals: "
+                    f"{e!r}")
+        n_p = len(self._params)
+        n_state = sum(len(vals) for vals in self._state_vals())
+        n_scal_1 = len(self._rule.scalars(self.optimizer, 0, 1)) \
+            * len(self._tr_idx)
+        sig_sources = []
+        if self._full_exec is not None:
+            sig_sources.extend((0, False, s)
+                               for s in self._full_exec[0])
+        for (k, rep), cached in self._multi_exec.items():
+            sig_sources.extend((k, rep, s) for s in cached[0])
+        for k, rep, sig in sig_sources:
+            entries = list(sig[n_p + n_state:])
+            n_scal = 1 if k else n_scal_1
+            if len(entries) < n_scal + self._n_args + 2 or \
+                    any(len(a) != 2 for a in entries):
+                continue          # unreconstructable: skip, not fatal
+            scal = [_sds(a) for a in entries[:n_scal]]
+            rest = entries[n_scal:]
+            x = [_sds(a) for a in rest[:self._n_args]]
+            rest = rest[self._n_args:]
+            _add_job(k, rep, scal, x, _sds(rest[0]), _sds(rest[1]),
+                     [_sds(a) for a in rest[2:]])
+
+        # the builders read self.mesh (shard_map mesh, batch
+        # shardings, n_dp) and self._persist_name() (hashes the mesh):
+        # rebind both to the TARGET for the build, restore after —
+        # nothing dispatches in between, so the trainer never observes
+        # the temporary binding
+        saved = (self.mesh, self._full_step, self._full_fn,
+                 self._zero_body, self._full_exec,
+                 self._multi_step_cache, self._multi_fns,
+                 self._multi_exec, self._persist_pin)
+        try:
+            self.mesh = mesh
+            self._persist_pin = None        # the pin bakes the OLD mesh
+            self._full_step = None
+            self._full_fn = None
+            self._zero_body = None
+            self._full_exec = None
+            self._multi_step_cache = {}
+            self._multi_fns = {}
+            self._multi_exec = {}
+            if self._zero_stage:
+                self._build_full_step_zero()
+            else:
+                self._build_full_step()
+            for (k, rep, _dsig) in sorted(
+                    jobs, key=lambda j: (j[0], j[1], repr(j[2]))):
+                scal_sds, x_sds, y_sds, k_sds, extra_sds = \
+                    jobs[(k, rep, _dsig)]
+                if k:
+                    suffix = f"_k{k}" + ("r" if rep else "")
+                    fn = self._multi_step_cache.get((k, rep))
+                    if fn is None:
+                        fn = self._build_full_step_multi(k, rep)
+                    vals = (param_sds, state_sds, scal_sds[0],
+                            x_sds, y_sds, k_sds) + extra_sds
+                    call = self._tiered_exec(
+                        suffix, fn, self._multi_fns[(k, rep)],
+                        vals, (0, 1))
+                    by_sig = self._multi_exec.setdefault(
+                        (k, rep), ({}, fn))[0]
+                    by_sig[_persist.aval_sig(vals)] = call
+                else:
+                    vals = (param_sds, state_sds, tuple(scal_sds),
+                            x_sds, y_sds, k_sds) + extra_sds
+                    call = self._tiered_exec(
+                        "", self._full_step, self._full_fn, vals,
+                        self._full_donate)
+                    if self._full_exec is None:
+                        self._full_exec = ({}, self._full_step)
+                    self._full_exec[0][_persist.aval_sig(vals)] = call
+            staged = {
+                "mesh": mesh, "n_dp": n_b,
+                "full_step": self._full_step,
+                "full_fn": self._full_fn,
+                "zero_body": self._zero_body,
+                "full_exec": self._full_exec,
+                "multi_step_cache": self._multi_step_cache,
+                "multi_fns": self._multi_fns,
+                "multi_exec": self._multi_exec,
+            }
+        finally:
+            (self.mesh, self._full_step, self._full_fn,
+             self._zero_body, self._full_exec,
+             self._multi_step_cache, self._multi_fns,
+             self._multi_exec, self._persist_pin) = saved
+        return staged
+
+    def apply_resize(self, staged):
+        """RESHARD the live donated buffers onto the staged mesh and
+        SWAP the pre-warmed executables in (the two downtime phases of
+        a live resize; ``elastic.resize.ResizeController`` drives drain
+        -> this).  Params (and replicated optimizer state) move
+        through ``elastic.reshard.redistribute`` — the one-program
+        donated layout move when the device sets coincide, the runtime
+        transfer engine otherwise — so the move never holds model +
+        state twice; ZeRO state rows change SHAPE across a dp change
+        and convert through the exact flat-reshape path the checkpoint
+        portability matrix uses, each source row deleted as its
+        successor lands.  fp32-exact throughout: a layout move never
+        touches element values.
+
+        Raises on failure; the caller (the controller) crash-heals
+        from the drain checkpoint via :meth:`_resize_swap` + a manager
+        restore — the committed checkpoint makes every mid-move tear
+        recoverable onto the NEW mesh."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..elastic import faults as _faults
+        from ..elastic import reshard as _reshard
+        from . import zero as _zero
+
+        mesh_b = staged["mesh"]
+        _faults.maybe_fire("resize_reshard")
+        param_sh, _state_sh = self._sharding_tuples(mesh=mesh_b)
+        holders: List[NDArray] = [p.data() for p in self._params]
+        targets = list(param_sh)
+        if not self._zero_stage:
+            flat: List[NDArray] = []
+            _flatten(self._states, flat)
+            holders.extend(flat)
+            repl_b = NamedSharding(mesh_b, P())
+            targets.extend(repl_b for _ in flat)
+        srcs = [h._data for h in holders]
+        if _faults._active:
+            # donate-tuple discipline: every source here IS donated to
+            # the move (redistribute donates its identity-jit inputs),
+            # so the pre-filtered form is the whole list
+            _faults.on_dispatch("resize_reshard", srcs, donate=None)
+        moved = _reshard.redistribute(srcs, targets)
+        for h, a in zip(holders, moved):
+            h._set_data(a)
+        if self._zero_stage:
+            n_b = staged["n_dp"]
+            zspec = NamedSharding(mesh_b, P(self.dp_axis))
+            for i in self._tr_idx:
+                leaves: List[NDArray] = []
+                _flatten(self._states[i], leaves)
+                pshape = tuple(self._params[i].data().shape)
+                for leaf in leaves:
+                    host = np.asarray(leaf._data)
+                    rows = _zero.reshard_host(host, pshape, n_b)
+                    old = leaf._data
+                    leaf._set_data(jax.device_put(rows, zspec))
+                    try:
+                        old.delete()
+                    except Exception:
+                        pass
+        _faults.maybe_fire("resize_swap")
+        self._resize_swap(staged)
+        self._note_resize_layouts()
+
+    def _resize_swap(self, staged):
+        """Rebind the trainer onto the staged mesh + pre-warmed
+        executables (bindings only — buffer movement lives in
+        :meth:`apply_resize`; the controller's crash-heal calls this
+        directly and then restores the drain checkpoint INTO the new
+        bindings)."""
+        self.mesh = staged["mesh"]
+        self._full_step = staged["full_step"]
+        self._full_fn = staged["full_fn"]
+        self._zero_body = staged["zero_body"]
+        self._full_exec = staged["full_exec"]
+        self._multi_step_cache = staged["multi_step_cache"]
+        self._multi_fns = staged["multi_fns"]
+        self._multi_exec = staged["multi_exec"]
+        # the old pin (if any) baked the old mesh; the new mesh keys
+        # its own persistent identities.  _fwd_bwd/_fused_update are
+        # two-phase-path artifacts pinned to the old mesh — the fused
+        # path never dispatches them, and _fwd_bwd stays bound so a
+        # later step cannot re-trace over the adopted _mutated_idx
+        # routing.  Per-REPLICA error feedback has no exact mapping
+        # across a dp change (same rule as _elastic_restore).
+        self._persist_pin = None
+        self._fused_update = None
+        self._residual_vals = None
+        self._placed = {}
+
+    def _note_resize_layouts(self):
+        """Re-register the observatory ledgers (MXL309/310 inputs,
+        HBM census) under the post-resize mesh/layout."""
+        from .. import telemetry
+        telemetry.memory.note_param_tree(
+            f"spmd:{self.block.name}", self._params, mesh=self.mesh,
+            dp_axis=self.dp_axis)
+        telemetry.memory.note_opt_state(
+            f"spmd:{self.block.name}", self._opt_state_leaves(),
+            mesh=self.mesh, dp_axis=self.dp_axis,
+            zero_stage=self._zero_stage)
+
+    def _note_resize_probe_base(self):
+        """Start-of-step hook while the post-resize probe is armed:
+        snapshot the process-global compile counters so the probe's
+        delta brackets THIS step only — the window between swap and
+        first step is unbounded, and another owner compiling there
+        (a serving bucket, a second trainer) must not be attributed
+        to the resize (a false MXL503)."""
+        from .. import engine
+        self._resize_probe_base = engine.compile_counts()
+
+    def _fire_resize_probe(self):
+        """End-of-step hook: fire the one-shot post-resize probe (the
+        controller's pre-warm-contract accounting) with the
+        step-start counter baseline."""
+        cb, self._post_resize_probe = self._post_resize_probe, None
+        base = getattr(self, "_resize_probe_base", None)
+        if cb is not None:
+            try:
+                cb(base)
+            except Exception:
+                pass
+
     # -- public API -------------------------------------------------------
     def step(self, data, label):
         """Run ONE fused SPMD train step; returns the loss NDArray.
@@ -1717,6 +2053,8 @@ class DataParallelTrainer:
         if self._params is None:
             self._setup(args0)
         self._refresh_health()
+        if self._post_resize_probe is not None:
+            self._note_resize_probe_base()
         hs = self._health_spec
         health_out = None
         from ..elastic import faults as _faults2
@@ -1874,6 +2212,8 @@ class DataParallelTrainer:
         for p, v in zip(self._params, new_all_params):
             p.data()._set_data(v)
         self._write_states(new_states)
+        if self._post_resize_probe is not None:
+            self._fire_resize_probe()
         if hs is not None and health_out is not None:
             from .. import telemetry as _tm
             _tm.health.sample_owner(
@@ -2026,12 +2366,32 @@ class DataParallelTrainer:
         self._multi_fns[(k_steps, repeated)] = body
         return fn
 
-    def _sharding_tuples(self):
-        """Current param/optimizer-state shardings (shared by the
-        fused single-step and bulked-step builders)."""
-        return (tuple(p.data()._data.sharding for p in self._params),
-                tuple(tuple(v.sharding for v in vals)
-                      for vals in self._state_vals()))
+    def _sharding_tuples(self, mesh=None):
+        """Param/optimizer-state layouts on ``mesh`` (default: the
+        trainer's own), derived STRUCTURALLY — the sharding rule (or
+        replication) per param, ``P(dp)`` state rows under ZeRO,
+        replication otherwise — never read from live buffers.  This is
+        exactly the layout ``_shard_params``/``_elastic_restore``
+        place, so for the trainer's own mesh it equals the live
+        placements; for a resize target mesh it is the layout the
+        pre-warm must pin while the live buffers still sit on the OLD
+        mesh (shared by the fused single-step and bulked-step
+        builders, and by ``prepare_resize``/``apply_resize``)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = mesh if mesh is not None else self.mesh
+        repl = NamedSharding(mesh, P())
+        params = []
+        for p in self._params:
+            spec = None
+            if self._param_sharding is not None:
+                spec = self._param_sharding(p.name, p.data().shape)
+            params.append(NamedSharding(mesh, spec)
+                          if spec is not None else repl)
+        state_sh = NamedSharding(mesh, P(self.dp_axis)) \
+            if self._zero_stage else repl
+        states = tuple(tuple(state_sh for _ in vals)
+                       for vals in self._state_vals())
+        return tuple(params), states
 
     def _step_impl(self, data, label):
         import jax
@@ -2043,6 +2403,8 @@ class DataParallelTrainer:
         if self._params is None:
             self._setup(args)
         self._refresh_health()
+        if self._post_resize_probe is not None:
+            self._note_resize_probe_base()
         from ..elastic import faults as _faults
         if _faults._active and _faults.nonfinite_due("spmd_step"):
             # the nonfinite drill: a NaN planted in the batch reaches
@@ -2189,6 +2551,8 @@ class DataParallelTrainer:
             for i, v in zip(self._tr_idx, new_params):
                 self._params[i].data()._set_data(v)
             self._write_states(new_states)
+            if self._post_resize_probe is not None:
+                self._fire_resize_probe()
             if hs is not None and health_out is not None:
                 from .. import telemetry as _tm
                 _tm.health.sample_owner(

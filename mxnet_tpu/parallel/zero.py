@@ -33,8 +33,8 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["stage_from_env", "eligibility", "slice_record",
-           "param_slice", "create_sharded_states", "gather_host",
-           "reshard_host"]
+           "param_slice", "state_avals", "create_sharded_states",
+           "gather_host", "reshard_host"]
 
 
 def stage_from_env() -> int:
@@ -111,6 +111,31 @@ def slice_record(params, tr_idx, n_dp: int) -> List[list]:
         size, padded, chunk = param_slice(d.shape, n_dp)
         out.append([params[i].name, size, padded, chunk])
     return out
+
+
+def state_avals(params, tr_idx, states, n_dp: int):
+    """Abstract ``(n_dp, chunk)`` f32 state layouts per trainable
+    param, mirroring ``create_sharded_states`` leaf-for-leaf — what a
+    live-resize pre-warm compiles against BEFORE any buffer moved (the
+    target mesh's state rows do not exist yet, so the avals must be
+    derived, not read).  ``states`` supplies the per-param leaf counts
+    (the live tuples from the CURRENT layout — leaf count is
+    dp-size-independent).  Returns a tuple of per-param tuples of
+    ``jax.ShapeDtypeStruct``."""
+    import jax
+    out = []
+    for i in tr_idx:
+        s = states[i]
+        if s is None:
+            out.append(())
+            continue
+        n_leaves = len(s) if isinstance(s, (list, tuple)) else 1
+        _size_, _padded, chunk = param_slice(params[i].data().shape,
+                                             n_dp)
+        out.append(tuple(
+            jax.ShapeDtypeStruct((n_dp, chunk), np.float32)
+            for _ in range(n_leaves)))
+    return tuple(out)
 
 
 def create_sharded_states(optimizer, index, param_nd, mesh,

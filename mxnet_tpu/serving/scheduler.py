@@ -133,6 +133,25 @@ class Bucket:
         self.active[slot] = 1.0
         self.temps[slot] = req.temperature
 
+    def adopt_slot(self, src: "Bucket", j: int, j2: int):
+        """Move ``src``'s slot ``j`` bookkeeping into THIS bucket's
+        slot ``j2`` — the host half of a live slot-count resize
+        (``Server.resize_slots``): the request keeps its absolute
+        offset / temperature / last token (its K/V page migrates by
+        the same index on the device side), only its (bucket, slot)
+        address changes."""
+        req = src.requests[j]
+        if req is None:
+            raise MXNetError(f"adopt_slot: source slot {j} is empty")
+        if self.requests[j2] is not None:
+            raise MXNetError(f"adopt_slot: slot {j2} is occupied")
+        self.requests[j2] = req
+        req.bucket, req.slot = self, j2
+        self.offsets[j2] = src.offsets[j]
+        self.active[j2] = 1.0
+        self.temps[j2] = src.temps[j]
+        self.last_tokens[j2] = src.last_tokens[j]
+
     def release(self, slot: int):
         """Drop a slot back to free: active-mask off, offset rewound.
         The page contents stay as garbage the per-row validity mask

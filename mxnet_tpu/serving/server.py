@@ -151,13 +151,20 @@ class Server:
             _servers[id(self)] = self
 
     # -- identity ---------------------------------------------------------
-    def _compute_struct_hash(self) -> str:
+    def _compute_struct_hash(self, buckets=None) -> str:
+        """Structural identity over model/bucket/sampler config.
+        ``buckets``: optional ``(slots, prompt_len, cache_len)`` rows
+        to hash INSTEAD of the live ones — the resize pre-warm keys
+        the target configuration's persist identities while the old
+        buckets still serve."""
+        rows = buckets if buckets is not None else \
+            [(b.slots, b.prompt_len, b.cache_len)
+             for b in self.sched.buckets]
         parts = (
             tuple((tuple(p.data(self.ctx).shape),
                    str(p.data(self.ctx).dtype))
                   for p in self.lm.collect_params().values()),
-            tuple(sorted((b.slots, b.prompt_len, b.cache_len)
-                         for b in self.sched.buckets)),
+            tuple(sorted(tuple(r) for r in rows)),
             self._kk, self.cache_dtype, self.max_new_tokens,
             int(self.lm.model.vocab_size))
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
@@ -310,6 +317,253 @@ class Server:
                         was_poisoned, name=self.name,
                         requeued=requeued)
         return requeued
+
+    # -- live resize (docs/elasticity.md, "Live resize" — serving leg) ----
+    def _fresh_bucket_stats(self):
+        return {b.key: {"steady_dispatches": 0, "tokens": 0,
+                        "steady_misses": 0, "steady_fresh_compiles": 0}
+                for b in self.sched.buckets}
+
+    def resize_slots(self, new_slots: int,
+                     reason: Optional[str] = None) -> dict:
+        """Grow/shrink every bucket's slot count IN-JOB through the
+        same prewarm -> drain -> migrate -> swap protocol the train
+        plane's ``ResizeController`` runs (``elastic.resize``;
+        typically driven by its ``ServingAutoscaler`` off the
+        queue-depth/occupancy signals).
+
+        * **prewarm** — every recorded bucket variant is AOT-compiled
+          for the new slot count (``engine.aot_compile`` + the
+          persistent tier) BEFORE anything moves, so the first
+          post-swap dispatch is already steady state with 0 fresh
+          compiles (the variants land pre-warmed in the steady
+          accounting MXL601 audits).  Compile time is not downtime —
+          the old buckets could still serve here.
+        * **drain** — serving dispatches are synchronous, so between
+          scheduling rounds nothing is in flight; this is the settled
+          boundary (fault point ``resize_drain``) and where the
+          downtime clock starts.
+        * **migrate** — resident K/V pages gather into the new pool by
+          slot index (one ``take`` per page tensor; generated tokens/
+          offsets are host-owned and ride along), so live requests
+          keep their progress.  On a shrink, residents beyond the new
+          capacity are evicted-with-requeue (they replay from their
+          host-owned prompts — the documented recovery semantics).
+        * **swap** — buckets/pools/identities rebind; a failure after
+          migration started crash-heals onto the NEW slot count with
+          zeroed pages and every resident requeued (``recovery``
+          telemetry), so the plane is never left unroutable.
+
+        Returns the registry record (``elastic.resize.resizes``)."""
+        from .. import engine
+        from ..elastic import faults as _faults
+        from ..elastic import resize as _resize
+        from ..elastic.manager import record_recovery
+        from .scheduler import Bucket
+        import jax.numpy as jnp
+
+        new_slots = int(new_slots)
+        if new_slots < 1:
+            raise MXNetError(f"resize_slots: need >= 1, got {new_slots}")
+        if self._poisoned is not None:
+            raise MXNetError("server is poisoned; recover() before "
+                             "resizing")
+        old_counts = sorted({b.slots for b in self.sched.buckets})
+        if old_counts == [new_slots]:
+            raise MXNetError(
+                f"resize_slots: already at {new_slots} slots")
+        # a heterogeneous construction (per-bucket slot counts)
+        # uniformizes on its first resize; the record keeps the real
+        # before-state so slots_from never misreports a smaller bucket
+        old_slots = old_counts[0] if len(old_counts) == 1 \
+            else old_counts
+
+        phase = "prewarm"
+        try:
+            # PREWARM: compile the new-slot programs while the old
+            # buckets could still serve — a failure here leaves the
+            # server untouched on the old configuration (same phase
+            # order as the train controller: the downtime clock must
+            # not start until the compiles are paid)
+            _faults.maybe_fire("resize_prewarm")
+            new_rows = [(new_slots, b.prompt_len, b.cache_len)
+                        for b in self.sched.buckets]
+            new_hash = self._compute_struct_hash(buckets=new_rows)
+            new_base = f"serving_{self.lm.name}_{new_hash}"
+            P = len(self._param_nds)
+            shadow = {b.key: Bucket(new_slots, b.prompt_len,
+                                    b.cache_len)
+                      for b in self.sched.buckets}
+            import jax
+            prewarmed: Dict[str, dict] = {}
+            for suffix, v in sorted(self._variants.items()):
+                b = self._bucket_for_suffix(suffix)
+                if b is None:
+                    continue
+                nb = shadow[b.key]
+                kind, k = str(v["kind"]), int(v.get("k") or 0)
+                L2 = 2 * self._pools[b.key].num_layers
+                avals = list(engine.persist.sig_from_json(v["avals"]))
+                for i, a in enumerate(avals):
+                    # the slot dim is dim 0 of every cache page and —
+                    # for decode — of the 4 per-slot extras (tok/off/
+                    # active/temp); everything else (params, prefill
+                    # extras, the RNG key) is slot-count-independent
+                    per_slot = (P <= i < P + L2) or (
+                        kind == "decode" and
+                        P + L2 <= i < P + L2 + 4)
+                    if per_slot and len(a) == 2 and a[0]:
+                        avals[i] = ((new_slots,) + tuple(a[0][1:]),
+                                    a[1])
+                sds = [jax.ShapeDtypeStruct(a[0], np.dtype(a[1]))
+                       for a in avals]
+                new_suffix = self._suffix(nb, kind, k)
+                pure = self._pure_for(nb, kind, k)
+                engine.aot_compile(
+                    self.name + new_suffix, pure, {}, sds,
+                    donate=tuple(int(i) for i in v["donate"]),
+                    persist_name=new_base + new_suffix)
+                prewarmed[new_suffix] = {
+                    "suffix": new_suffix, "kind": kind, "k": k,
+                    "donate": [int(i) for i in v["donate"]],
+                    "avals": engine.persist.sig_to_json(tuple(avals))}
+            # DRAIN: the settled boundary (nothing in flight between
+            # rounds); the downtime clock starts here — after the
+            # pre-warm, whose compile time is NOT downtime
+            phase = "drain"
+            _faults.maybe_fire("resize_drain")
+            t_drain = time.perf_counter()
+        except Exception as e:
+            # pre-migration failure: the server is untouched on the
+            # old configuration — record the abort (the train
+            # controller does the same for its pre-drain phases)
+            _resize._note_failed("serving", phase, repr(e),
+                                 name=self.name,
+                                 still_on="old_config")
+            raise
+
+        healed = False
+        heal_error = None
+        migrated = 0
+        requeued = 0
+        try:
+            # MIGRATE: resident pages gather into the new pools
+            _faults.maybe_fire("resize_reshard")
+            new_pools: Dict[tuple, KVCachePool] = {}
+            new_buckets = []
+            for b in list(self.sched.buckets):
+                nb = shadow[b.key]
+                residents = [(j, r) for j, r in enumerate(b.requests)
+                             if r is not None]
+                kept = residents[:new_slots]
+                for _j, r in reversed(residents[new_slots:]):
+                    self.evict(r, reason="resize_shrink", requeue=True)
+                    requeued += 1
+                npool = KVCachePool(self.lm, new_slots, b.cache_len,
+                                    ctx=self.ctx,
+                                    dtype=self.cache_dtype)
+                if kept:
+                    idx = np.zeros((new_slots,), np.int32)
+                    for j2, (j, _r) in enumerate(kept):
+                        idx[j2] = j
+                    flat = self._pools[b.key].flat()
+                    if _faults._active:
+                        # the donate-tuple discipline: every source
+                        # page IS consumed by the move (deleted as the
+                        # successors land), so the pre-filtered form
+                        # is the whole pool
+                        _faults.on_dispatch("serving_resize_migrate",
+                                            flat, donate=None)
+                    jidx = jnp.asarray(idx)
+                    npool.adopt([jnp.take(c, jidx, axis=0)
+                                 for c in flat])
+                    for c in flat:
+                        try:
+                            c.delete()
+                        except Exception:
+                            pass
+                    migrated += len(kept)
+                for j2, (j, _r) in enumerate(kept):
+                    nb.adopt_slot(b, j, j2)
+                new_pools[nb.key] = npool
+                new_buckets.append(nb)
+            # SWAP: rebind buckets/pools/identities
+            _faults.maybe_fire("resize_swap")
+            self.sched.buckets = sorted(new_buckets,
+                                        key=lambda x: x.prompt_len)
+            self._pools = new_pools
+        except Exception as e:
+            # crash-heal: cleanly on the NEW slot count with zeroed
+            # pages and every resident requeued (prompts are
+            # host-owned — the replay path recover() already proves)
+            heal_error = repr(e)
+            _resize._note_failed("serving", "reshard_swap", heal_error,
+                                 name=self.name, heal="requeue_replay")
+            t_heal = time.perf_counter()
+            # `requeued` keeps the shrink-overflow evictions that
+            # already landed in the queue before the fault — the
+            # heal's sweep only finds the residents still in bucket
+            # tables, and the record must count BOTH.
+            # the OLD bucket tables still list every resident —
+            # adopt_slot deliberately leaves the source row in place
+            # until the swap commits, exactly so this sweep can find
+            # requests mid-migration (their .bucket may already point
+            # at a shadow bucket; evict releases through it)
+            for b in list(self.sched.buckets):
+                for r in reversed([r for r in b.requests
+                                   if r is not None]):
+                    # through Server.evict, not the bare scheduler:
+                    # heal evictions must leave the same audit trail
+                    # (retained request_evicted event + counter) as
+                    # every other eviction — the failure path is where
+                    # the flight recorder matters most
+                    if self.evict(r, reason="resize_heal",
+                                  requeue=True):
+                        requeued += 1
+            self.sched.buckets = sorted(
+                (Bucket(new_slots, b.prompt_len, b.cache_len)
+                 for b in shadow.values()),
+                key=lambda x: x.prompt_len)
+            self._pools = {
+                b.key: KVCachePool(self.lm, new_slots, b.cache_len,
+                                   ctx=self.ctx,
+                                   dtype=self.cache_dtype)
+                for b in self.sched.buckets}
+            self._poisoned = None
+            migrated = 0
+            healed = True
+            record_recovery("resize_heal",
+                            time.perf_counter() - t_heal, False,
+                            name=self.name, requeued=requeued)
+        self._bucket_stats = self._fresh_bucket_stats()
+        # rows for buckets that no longer exist would make a later
+        # save_signature manifest un-warm-startable; the current
+        # configuration's prewarmed rows replace them, and the
+        # variants are warm NOW — their first live dispatch is
+        # already steady state (same rule as warm_start)
+        self._variants = dict(prewarmed)
+        self._warmed.update(prewarmed)
+        self._struct_hash = new_hash
+        self._persist_base = new_base
+        self._persist_pinned = False
+        rec = {
+            "kind": "serving", "name": self.name,
+            "slots_from": old_slots, "slots_to": new_slots,
+            "buckets": [f"{b.slots}x{b.prompt_len}"
+                        for b in self.sched.buckets],
+            "prewarmed_variants": len(prewarmed),
+            "migrated": migrated, "requeued": requeued,
+            "healed": healed,
+            "downtime_seconds": round(
+                time.perf_counter() - t_drain, 4),
+        }
+        if reason:
+            rec["autoscale_reason"] = reason
+        if heal_error:
+            rec["heal_error"] = heal_error[:300]
+        _resize._note_completed(rec)
+        self._update_gauges()
+        return dict(rec)
 
     def stats(self) -> dict:
         """Live occupancy/queue stats plus per-bucket steady-state

@@ -38,7 +38,8 @@ _lock = threading.Lock()
 _RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
                          "evict", "prefetch_stall", "oom_risk",
                          "mem_analysis_unavailable", "health_anomaly",
-                         "request_evicted", "slot_oom"))
+                         "request_evicted", "slot_oom",
+                         "resize", "resize_failed"))
 _ring: Optional[Deque[dict]] = None        # high-volume kinds
 _rare: Optional[Deque[dict]] = None        # retained rare kinds
 _dropped = 0          # events pushed out of either ring since clear

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""mxresize: render the live-elastic-resize plane's status.
+
+``elastic.resize`` (docs/elasticity.md, "Live resize") takes a running
+trainer from mesh A to mesh B — and the serving plane from N to M
+decode slots — through pre-warm -> drain -> reshard -> swap, recording
+every completed transition in an in-process registry plus the retained
+``resize`` / ``resize_failed`` flight-recorder events.  This tool
+renders that data three ways:
+
+    python tools/mxresize.py smoke               # run a tiny in-
+                                                 # process dp 8->4
+                                                 # live resize, then
+                                                 # report
+    python tools/mxresize.py status              # registry + counters
+                                                 # of THIS process
+                                                 # (mostly useful
+                                                 # imported live)
+    python tools/mxresize.py render dump.json    # resize events from
+                                                 # a flight-recorder
+                                                 # dump artifact
+    # live process: from tools.mxresize import render
+    #               print(render(elastic.resize.report()))
+
+Per resize the status shows: kind (train/serving), the from -> to
+mesh/slots, downtime seconds (drain start -> swap complete), whether a
+fault forced the crash-heal path, and the pre-warm contract numbers —
+committed vs drained step and the first post-swap step's fresh-compile
+count (both audited by mxlint MXL503).  ``render`` exits 1 on a
+malformed artifact so a CI gate fails loudly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+# no JAX_PLATFORMS mutation at import time — render()/report() are
+# documented for import into LIVE processes (same rule as mxmem /
+# mxhealth); the CLI entry points pin the backend instead.
+
+
+def _fmt_move(rec: dict) -> str:
+    if rec.get("kind") == "serving":
+        return (f"slots {rec.get('slots_from')} -> "
+                f"{rec.get('slots_to')} "
+                f"[{','.join(rec.get('buckets') or [])}]")
+    def _m(m):
+        return "x".join(f"{k}:{v}" for k, v in (m or {}).items())
+    return f"mesh {_m(rec.get('mesh_from'))} -> {_m(rec.get('mesh_to'))}"
+
+
+def render(rep: dict) -> str:
+    """Text rendering of an ``elastic.resize.report()`` dict."""
+    lines = []
+    recs = rep.get("resizes") or []
+    lines.append(f"live resizes: {len(recs)} completed "
+                 f"(counter {rep.get('total', 0):g})")
+    for n, rec in enumerate(recs):
+        fresh = rec.get("post_swap_fresh_compiles")
+        contract = "pending first post-swap step" if fresh is None \
+            else ("OK (0 fresh compiles)" if fresh == 0
+                  else f"BROKEN ({fresh} fresh compiles)")
+        healed = "  HEALED from the drain checkpoint" \
+            if rec.get("healed") else ""
+        lines.append(
+            f"  #{n} [{rec.get('kind')}] {_fmt_move(rec)}  "
+            f"downtime {rec.get('downtime_seconds')}s{healed}")
+        if rec.get("kind") == "train":
+            lines.append(
+                f"      drain step {rec.get('drain_step')} -> "
+                f"committed {rec.get('committed_step')}; "
+                f"pre-warm contract: {contract}")
+        else:
+            lines.append(
+                f"      migrated {rec.get('migrated')} resident(s), "
+                f"requeued {rec.get('requeued')}; prewarmed "
+                f"{rec.get('prewarmed_variants')} variant(s)")
+        if rec.get("autoscale_reason"):
+            lines.append(f"      autoscale: {rec['autoscale_reason']}")
+        if rec.get("heal_error"):
+            lines.append(f"      heal cause: {rec['heal_error']}")
+    failed = rep.get("failed_events") or []
+    for ev in failed:
+        lines.append(
+            f"  FAILED [{ev.get('resize_kind')}] at "
+            f"{ev.get('phase')}: {ev.get('error')}")
+    ds = rep.get("downtime_seconds") or {}
+    if ds.get("count"):
+        lines.append(f"downtime histogram: count {ds['count']:g}, "
+                     f"sum {ds.get('sum', 0):.4f}s")
+    return "\n".join(lines)
+
+
+def _events_view(artifact: dict) -> dict:
+    """Project a flight-recorder dump onto the report shape: the
+    retained ``resize``/``resize_failed`` events stand in for the
+    registry (the dump carries events, not the live records)."""
+    if not isinstance(artifact, dict) or "events" not in artifact:
+        raise ValueError("not a flight-recorder dump artifact "
+                         "(no 'events')")
+    recs, failed = [], []
+    for ev in artifact.get("events", []):
+        if ev.get("kind") == "resize":
+            rec = dict(ev)
+            rec["kind"] = ev.get("resize_kind")
+            recs.append(rec)
+        elif ev.get("kind") == "resize_failed":
+            failed.append(ev)
+    counters = (artifact.get("metrics") or {}).get("counters") or {}
+    return {"resizes": recs, "failed_events": failed,
+            "total": counters.get("mxtpu_resizes_total", 0.0),
+            "downtime_seconds": {}}
+
+
+def cmd_render(args) -> int:
+    try:
+        with open(args.artifact) as f:
+            artifact = json.load(f)
+        if isinstance(artifact, dict) and "resizes" in artifact:
+            rep = artifact                  # a saved report() dict
+        else:
+            rep = _events_view(artifact)
+        print(render(rep))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"mxresize: malformed artifact {args.artifact!r}: {e!r}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_status(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.elastic import resize
+    rep = resize.report()
+    if args.fmt == "json":
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep))
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    """Tiny in-process live resize (dp 8 -> 4 on the CPU virtual
+    mesh), then the status render — the zero-to-report path and the
+    ``--self-check`` gate (a smoke whose resize pays a post-swap fresh
+    compile or loses a step exits 1 via the MXL503 audit)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.elastic import (CheckpointManager, ResizeController,
+                                   resize)
+    import jax
+    if len(jax.devices()) < 8:
+        print("mxresize smoke: needs an 8-device mesh", file=sys.stderr)
+        return 1
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    dpt = parallel.DataParallelTrainer(
+        net, L2Loss(), "adam", {"learning_rate": 0.01},
+        mesh=parallel.make_mesh({"dp": 8}), fuse_step=True)
+    X = nd.array(np.random.RandomState(0).randn(16, 8).astype("f4"))
+    Y = nd.array(np.random.RandomState(1).randn(16, 4).astype("f4"))
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d, trainer=dpt, async_save=False)
+        for _ in range(3):
+            dpt.step(X, Y)
+        ResizeController(dpt, mgr).resize(parallel.make_mesh({"dp": 4}))
+        dpt.step(X, Y)                     # fires the contract probe
+    print(render(resize.report()))
+    if args.self_check:
+        from mxnet_tpu.analysis import analyze_elasticity
+        bad = [f for f in analyze_elasticity() if f.rule == "MXL503"]
+        for f in bad:
+            print(f.format(), file=sys.stderr)
+        rec = resize.resizes()[-1]
+        if bad or rec.get("post_swap_fresh_compiles") != 0:
+            return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxresize", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("status", help="registry + counters of this "
+                                      "process")
+    p.add_argument("--json", dest="fmt", action="store_const",
+                   const="json", default="text")
+    p.set_defaults(fn=cmd_status)
+    p = sub.add_parser("render", help="render resize events from a "
+                                      "flight-recorder dump (or a "
+                                      "saved report)")
+    p.add_argument("artifact")
+    p.set_defaults(fn=cmd_render)
+    p = sub.add_parser("smoke", help="run a tiny in-process dp 8->4 "
+                                     "live resize, then report")
+    p.add_argument("--self-check", action="store_true",
+                   dest="self_check",
+                   help="exit 1 unless the smoke's resize kept the "
+                        "pre-warm contract (MXL503 clean)")
+    p.set_defaults(fn=cmd_smoke)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
