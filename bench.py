@@ -866,6 +866,41 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
             "last_sample": hist[-1] if hist else None,
             "last_verdict": howner.get("last_verdict"),
         }
+
+        # guardian-plane evidence (docs/elasticity.md, "Guardian &
+        # chaos soak"): a short seeded chaos soak — train + serve +
+        # one resize under composed random faults — reporting what a
+        # production operator budgets around: faults absorbed,
+        # recoveries and their latency distribution, and the shed
+        # rate the overload policy held under the 10x flood stage
+        try:
+            from mxnet_tpu.elastic import chaos as _chaos
+            _soak = _chaos.soak(steps=60, seed=5)
+            _rsec = sorted(float(r["seconds"] or 0.0)
+                           for r in _soak.get("recoveries", ()))
+
+            def _q(q):
+                if not _rsec:
+                    return None
+                return round(_rsec[min(len(_rsec) - 1,
+                                       int(q * len(_rsec)))], 4)
+
+            tblock["soak"] = {
+                "seed": _soak["seed"], "steps": _soak["steps"],
+                "ok": _soak["ok"],
+                "faults_injected": _soak["n_faults"],
+                "distinct_points": _soak["distinct_points"],
+                "recoveries": _soak["n_recoveries"],
+                "recovery_p50_seconds": _q(0.50),
+                "recovery_p99_seconds": _q(0.99),
+                "preemptions": _soak["preemptions"],
+                "shed_rate": (_soak.get("flood") or {}).get(
+                    "shed_rate"),
+                "violations": [v["invariant"]
+                               for v in _soak.get("violations", ())],
+            }
+        except Exception as e:
+            tblock["soak"] = {"error": repr(e)[:300]}
     return batch_size * steps / dt, opt_dispatches, train_dispatches, \
         tblock
 

@@ -95,6 +95,11 @@ RULES = {
                "live resize broke its contract (post-swap fresh "
                "compile, or the drain committed an older step than "
                "the trainer had)"),
+    "MXL504": (Severity.WARNING,
+               "guardian-plane incident without a matching recovery "
+               "(an unrecovered hang_suspected, a preemption that "
+               "committed nothing) or a chaos-soak artifact with "
+               "violated invariants"),
     # -- serving passes (MXL6xx) ----------------------------------------
     "MXL601": (Severity.WARNING,
                "per-request prefill/decode loop without the serving "

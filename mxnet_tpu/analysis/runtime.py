@@ -224,6 +224,17 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
       training work).  Quiet in a fresh process (empty registry), and
       a healthy resize whose probe has not fired yet
       (``post_swap_fresh_compiles`` still ``None``) reports nothing.
+    * MXL504 — guardian-plane incidents left open (docs/elasticity.md,
+      "Guardian & chaos soak"): a retained ``hang_suspected`` event
+      never answered by a recovery (no later ``recovery`` event, and
+      no ``hang_resolved`` that either recovered or resolved clean) —
+      the watchdog saw a dispatch die and nobody healed the owner; a
+      ``preempted`` event whose drain committed NOTHING (no manager
+      in scope — the preemption lost the run the drain exists to
+      save); or a chaos-soak artifact (``elastic.chaos.artifacts()``)
+      with violated invariants — the last one at ERROR severity, so
+      ``tools/mxsoak.py run --self-check`` and a post-soak
+      ``self_check()`` gate fail loudly.
     """
     from .. import envs, telemetry
     from ..elastic import manager as _mgr
@@ -290,6 +301,51 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
                 f"{int(drain) - int(committed)} committed step(s); "
                 "the drain must land ON the boundary, not behind it",
                 f"resize:{n}"))
+
+    # MXL504 — guardian-plane incidents left open.  An event sequence
+    # answers a hang_suspected when a recovery lands AFTER it, or its
+    # own hang_resolved reports recovered/clean; a preempted event is
+    # answered by the committed step its drain recorded.
+    recovery_seqs = [e["seq"] for e in telemetry.events("recovery")]
+    resolved = telemetry.events("hang_resolved")
+    for ev in telemetry.events("hang_suspected"):
+        answered = any(s > ev["seq"] for s in recovery_seqs) or any(
+            r["seq"] > ev["seq"] and r.get("owner") == ev.get("owner")
+            and (r.get("recovered") or not r.get("error"))
+            for r in resolved)
+        if not answered:
+            findings.append(Finding(
+                "MXL504",
+                f"hang_suspected on {ev.get('owner')!r} "
+                f"({ev.get('what')}, {ev.get('seconds')}s in flight) "
+                "was never answered by a recovery — the owner is "
+                "likely still poisoned or the dispatch is still "
+                "wedged; see the event's stack dump and "
+                "MXTPU_WATCHDOG_ACTION=recover (docs/elasticity.md)",
+                f"guardian:hang:{ev['seq']}"))
+    for ev in telemetry.events("preempted"):
+        if ev.get("ok") and ev.get("committed_step") is None:
+            findings.append(Finding(
+                "MXL504",
+                "a preemption drained with NO committed checkpoint "
+                "(no CheckpointManager in the guard's scope) — the "
+                "drain protocol saved nothing and the run is lost on "
+                "exit; attach a manager to the PreemptionGuard",
+                f"guardian:preempt:{ev['seq']}"))
+    from ..elastic import chaos as _chaos
+    for n, art in enumerate(_chaos.artifacts()):
+        if art.get("ok"):
+            continue
+        broken = sorted(v.get("invariant", "?")
+                        for v in art.get("violations", ()))
+        findings.append(Finding(
+            "MXL504",
+            f"chaos soak #{n} (seed {art.get('seed')}, "
+            f"{art.get('steps')} steps) VIOLATED invariant(s) "
+            f"{broken}: the composed fault surface does not recover "
+            "cleanly — replay with tools/mxsoak.py run --seed "
+            f"{art.get('seed')} and fix before shipping",
+            f"soak:{n}", severity=Severity.ERROR))
     return findings
 
 

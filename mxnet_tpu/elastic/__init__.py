@@ -19,7 +19,15 @@ package closes all three:
 * :mod:`.faults` — deterministic fault injection
   (``MXTPU_FAULT_INJECT``) hooked into the real dispatch and
   checkpoint-commit paths, so every recovery path above is exercised
-  by the tier-1 CPU suite.
+  by the tier-1 CPU suite;
+* :mod:`.guardian` — the hang watchdog (heartbeat-fed
+  :class:`~.guardian.Guardian`) and the SIGTERM/SIGINT
+  :class:`~.guardian.PreemptionGuard` drain-to-committed-boundary
+  protocol;
+* :mod:`.chaos` — the seeded chaos-soak certifier
+  (:class:`~.chaos.Schedule` / :func:`~.chaos.soak`) that runs train +
+  serve + resize under randomized composed faults and checks the
+  recovery invariants after every transition.
 
 See docs/elasticity.md.
 """
@@ -28,15 +36,17 @@ from __future__ import annotations
 from . import faults
 from . import reshard
 
-__all__ = ["CheckpointManager", "ResizeController",
-           "ServingAutoscaler", "faults", "manager", "reshard",
-           "resize"]
+__all__ = ["CheckpointManager", "Guardian", "PreemptionGuard",
+           "ResizeController",
+           "ServingAutoscaler", "chaos", "faults", "guardian",
+           "manager", "reshard", "resize"]
 
 
 def __getattr__(name):
     # manager pulls in ndarray/telemetry; keep package import light so
-    # engine can import .faults without a cycle (resize rides the same
-    # lazy path — it reaches into the trainers/serving plane)
+    # engine can import .faults without a cycle (resize/guardian/chaos
+    # ride the same lazy path — they reach into the trainers/serving
+    # plane)
     if name in ("CheckpointManager", "manager"):
         import importlib
         mod = importlib.import_module(".manager", __name__)
@@ -45,4 +55,11 @@ def __getattr__(name):
         import importlib
         mod = importlib.import_module(".resize", __name__)
         return mod if name == "resize" else getattr(mod, name)
+    if name in ("Guardian", "PreemptionGuard", "guardian"):
+        import importlib
+        mod = importlib.import_module(".guardian", __name__)
+        return mod if name == "guardian" else getattr(mod, name)
+    if name == "chaos":
+        import importlib
+        return importlib.import_module(".chaos", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

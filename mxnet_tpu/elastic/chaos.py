@@ -1,0 +1,653 @@
+"""Chaos-soak certifier: randomized COMPOSED faults over the whole
+recovery surface, with invariants checked after every transition.
+
+Eleven PRs of recovery paths were each proven against a single
+scripted fault at a known point.  Production dies differently: faults
+land in combination — a hang while a checkpoint writes, a preemption
+right after a rollback, a serving poison mid-resize.  This module is
+the harness that certifies the COMPOSED surface:
+
+* :class:`Schedule` — a seeded random fault plan over the
+  ``elastic.faults`` grammar (dispatch / dispatch_post /
+  dispatch_hang / nonfinite_grad / preempt_signal / checkpoint_write
+  / host_copy / serving dispatch_post / resize_reshard), deterministic
+  per seed (``MXTPU_FAULT_SEED`` by default) so every soak replays
+  exactly;
+* :func:`soak` — runs a real training loop (gluon ``CompiledStep`` +
+  ``CheckpointManager`` + ``Guardian`` + ``PreemptionGuard`` +
+  health-rollback), a live serving plane (tiny llama ``Server``), one
+  in-job serving resize, and a 10x request flood, under the plan —
+  and checks the invariants after every recovery:
+
+  1. **committed-step monotonicity** — every recovery resumes a step
+     that was committed at the time and never ahead of the trainer;
+     the final trainer step reaches the target and is committed;
+  2. **fp32-exact params** vs an unfaulted reference run at the same
+     step count (recoveries replay, they do not drift);
+  3. **zero fresh compiles once warmed** (the resize pre-warm window
+     excepted) — recovery is a data operation, never a compile;
+  4. **no poisoned-but-unrecovered owner** at exit;
+  5. **no leaked live buffers** (``engine.cache_info()["live_bytes"]``
+     returns to its warmed baseline).
+
+Artifacts land in an in-process registry (:func:`artifacts`) audited
+by mxlint MXL504 and are rendered/replayed by ``tools/mxsoak.py``.
+See docs/elasticity.md ("Guardian & chaos soak").
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import random as _random
+import tempfile
+import threading
+from typing import List, Optional
+
+from ..base import MXNetError
+from . import faults
+
+__all__ = ["Schedule", "soak", "artifacts", "render",
+           "CATALOG", "FORMAT"]
+
+FORMAT = 1
+
+#: the fault catalog the schedule draws from: (target, grammar point).
+#: ``target`` picks the operation the spec is armed around — the plan
+#: composes faults across train, checkpoint, serving, and resize.
+CATALOG = (
+    ("train", "dispatch"),          # transient: retry absorbs
+    ("train", "dispatch_post"),     # poison -> recover(manager)
+    ("train", "dispatch_hang"),     # watchdog -> hang_suspected -> recover
+    ("train", "nonfinite_grad"),    # health rollback
+    ("train", "preempt_signal"),    # SIGTERM -> drain -> restore
+    ("save", "checkpoint_write"),   # torn write: previous stays
+    ("save", "host_copy"),          # snapshot copy failure
+    ("serve", "dispatch_post"),     # serving poison -> replay
+    ("resize", "resize_reshard"),   # mid-resize crash-heal
+)
+
+_reg_lock = threading.Lock()
+_artifacts: List[dict] = []
+
+
+def artifacts() -> List[dict]:
+    """Completed soak artifacts of THIS process (the MXL504 input)."""
+    with _reg_lock:
+        return [dict(a) for a in _artifacts]
+
+
+def _register(artifact: dict):
+    with _reg_lock:
+        _artifacts.append(artifact)
+
+
+def _reset():
+    """Test hook."""
+    with _reg_lock:
+        _artifacts.clear()
+
+
+class Schedule:
+    """A seeded random fault plan: ``n_faults`` entries spread over
+    ``steps`` train steps, covering at least ``min_points`` DISTINCT
+    grammar points, plus one serving resize and one request-flood
+    stage.  Deterministic: the same seed yields the same plan."""
+
+    def __init__(self, seed: Optional[int] = None, steps: int = 200,
+                 n_faults: int = 8, min_points: int = 6,
+                 resize: bool = True, flood: bool = True):
+        from .. import envs
+        self.seed = int(envs.get("MXTPU_FAULT_SEED")) if seed is None \
+            else int(seed)
+        self.steps = int(steps)
+        if self.steps < 20:
+            raise MXNetError(
+                f"a soak needs >= 20 steps, got {self.steps}")
+        n_faults = int(n_faults)
+        rng = _random.Random(self.seed)
+        self.resize_at = (self.steps // 2) if resize else None
+        self.flood_at = (self.steps * 3 // 4) if flood else None
+
+        names = []
+        seen = set()
+        for _t, p in CATALOG:
+            if p not in seen:
+                seen.add(p)
+                names.append(p)
+        min_points = min(int(min_points), len(names), n_faults)
+        # cover min_points DISTINCT grammar points first, then free
+        # picks over the whole catalog (repeats welcome — composed
+        # repetition is part of the chaos)
+        chosen_points = rng.sample(names, min_points)
+        picks = [next(c for c in CATALOG if c[1] == p)
+                 for p in chosen_points]
+        while len(picks) < n_faults:
+            picks.append(CATALOG[rng.randrange(len(CATALOG))])
+        # at most one resize fault: there is one resize to land it on
+        resize_picks = [c for c in picks if c[0] == "resize"]
+        if not resize:
+            picks = [c for c in picks if c[0] != "resize"]
+        elif len(resize_picks) > 1:
+            keep = resize_picks[0]
+            picks = [c for c in picks if c[0] != "resize"]
+            picks.append(keep)
+        rng.shuffle(picks)
+        # unique fault steps, clear of the warm-up and the final drain
+        lo, hi = 3, max(4, self.steps - 2)
+        steps_pool = list(range(lo, hi))
+        rng.shuffle(steps_pool)
+        self.entries: List[dict] = []
+        for (target, point), at in zip(picks, steps_pool):
+            if target == "resize":
+                at = self.resize_at
+            self.entries.append({"step": int(at), "target": target,
+                                 "point": point})
+        self.entries.sort(key=lambda e: e["step"])
+
+    def distinct_points(self) -> int:
+        return len({e["point"] for e in self.entries})
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "steps": self.steps,
+                "resize_at": self.resize_at, "flood_at": self.flood_at,
+                "entries": [dict(e) for e in self.entries]}
+
+    def describe(self) -> str:
+        lines = [f"chaos plan: seed {self.seed}, {self.steps} steps, "
+                 f"{len(self.entries)} faults over "
+                 f"{self.distinct_points()} distinct points"]
+        for e in self.entries:
+            lines.append(f"  step {e['step']:>4}  [{e['target']:>6}] "
+                         f"{e['point']}")
+        if self.resize_at is not None:
+            lines.append(f"  step {self.resize_at:>4}  [serve ] "
+                         "resize_slots x2")
+        if self.flood_at is not None:
+            lines.append(f"  step {self.flood_at:>4}  [serve ] "
+                         "10x request flood (ttl-armed)")
+        return "\n".join(lines)
+
+
+def _owner_step(cs) -> int:
+    """The gluon trainer's optimizer step counter (what checkpoints
+    record as ``step``)."""
+    opt = cs.trainer._optimizer
+    return int(max(opt._index_update_count.values(),
+                   default=int(opt.num_update)))
+
+
+_ENV_PINS = {
+    # pre-donation transients must be absorbed transparently (the
+    # dispatch fault / a retried hang window), and quickly
+    "MXTPU_DISPATCH_RETRIES": "2",
+    "MXTPU_DISPATCH_BACKOFF_MS": "1",
+    # the health plane detects nonfinite_grad EVERY step and closes
+    # the loop with an automatic rollback into the manager
+    "MXTPU_HEALTH": "1",
+    "MXTPU_HEALTH_EVERY": "1",
+    "MXTPU_HEALTH_ACTION": "rollback",
+}
+
+
+def soak(steps: int = 200, schedule: Optional[Schedule] = None,
+         seed: Optional[int] = None, serve_every: int = 5,
+         save_every: int = 10, hang_ms: int = 150,
+         watchdog_timeout: float = 0.06,
+         out_dir: Optional[str] = None,
+         progress=None) -> dict:
+    """Run the chaos soak and return its artifact (also appended to
+    :func:`artifacts` for the MXL504 audit; written to
+    ``out_dir/soak-<seed>.json`` when ``out_dir`` is given).
+
+    The workload: a gluon ``CompiledStep`` MLP trainer stepping a
+    deterministic per-step batch stream to ``steps`` optimizer steps
+    (checkpointed every ``save_every``), a tiny-llama serving plane
+    taking one request every ``serve_every`` steps, ONE in-job serving
+    resize (slot count x2) at mid-soak, and a ttl-armed 10x flood at
+    3/4 — all under ``schedule`` (default: ``Schedule(seed, steps)``).
+    ``progress``: optional callable taking one status line.
+    """
+    import numpy as np
+    sched = schedule if schedule is not None else \
+        Schedule(seed=seed, steps=steps)
+    steps = sched.steps
+    say = progress if callable(progress) else (lambda s: None)
+
+    import mxnet_tpu as mx
+    from .. import engine, nd, telemetry
+    from ..gluon import Trainer, nn
+    from ..gluon.compiled_step import CompiledStep
+    from ..gluon.loss import L2Loss
+    from ..models import LlamaForCausalLM, llama_tiny
+    from ..serving import Server
+    from .guardian import Guardian, PreemptionGuard
+    from .manager import CheckpointManager
+
+    V = 47                                   # serving vocab
+
+    def _build(prefix):
+        mx.random.seed(123)
+        np.random.seed(7)
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=8),
+                    nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 0.01}, kvstore=None)
+        return net, CompiledStep(net, L2Loss(), tr)
+
+    def _batch(i):
+        r = np.random.RandomState(10_000 + i)
+        return (nd.array(r.rand(8, 8).astype("f4")),
+                nd.array(r.rand(8, 4).astype("f4")))
+
+    def _prompt(i):
+        return np.random.RandomState(50_000 + i) \
+            .randint(0, V, 5).astype("f4")
+
+    env_prev = {k: os.environ.get(k) for k in _ENV_PINS}
+    os.environ.update(_ENV_PINS)
+    faults.clear()
+    # a soak is a DRILL: its injected poisons/errors must not consume
+    # the process's throttled crash-forensics budget (a real failure
+    # after the soak still deserves its auto-dump)
+    from ..telemetry import recorder as _recorder
+    dumps_prev = _recorder._auto_dumps_left
+    ckdir = tempfile.mkdtemp(prefix="mxtpu-soak-")
+    guard = pguard = mgr = None
+    violations: List[dict] = []
+    fired: List[dict] = []
+    commits: List[int] = []
+    recoveries: List[dict] = []
+    preemptions = 0
+    flood_stats = None
+    resize_rec = None
+    resize_fresh = 0
+
+    def _violate(invariant, detail):
+        violations.append({"invariant": invariant, "detail": detail})
+
+    try:
+        net, cs = _build("soak_")
+        mgr = CheckpointManager(ckdir, trainer=cs, keep=4,
+                                async_save=False)
+        cs.health_manager = mgr                 # arms rollback
+        mx.random.seed(321)
+        np.random.seed(11)
+        lm = LlamaForCausalLM(llama_tiny(vocab_size=V))
+        lm.initialize(mx.init.Xavier())
+        srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4,
+                     max_queue=256)
+        guard = Guardian(cs, mgr, timeout=watchdog_timeout,
+                         action="recover", name="soak_train").start()
+        pguard = PreemptionGuard(manager=mgr, server=srv,
+                                 exit_process=False)
+        pguard.install()
+
+        # -- warm-up: pay every compile the steady state will use ----
+        commits.append(mgr.save(block=True))            # step-0 anchor
+        cs.step(*_batch(1), 8)
+        commits.append(mgr.save(block=True))            # snapshot warm
+        stream: List = [srv.submit(_prompt(0)), srv.submit(_prompt(1))]
+        srv.run()
+        mx.nd.waitall()
+        gc.collect()
+        live0 = engine.cache_info()["live_bytes"]
+        _m0, fresh0 = engine.compile_counts()
+        say(f"warmed: live {live0} B, plan\n{sched.describe()}")
+
+        rec_seen = len(telemetry.events("recovery"))
+        cur = 1
+        iter_n = 0
+        max_iters = steps * 4 + 200
+        pending = [dict(e) for e in sched.entries]
+        resize_done = sched.resize_at is None
+        flood_done = sched.flood_at is None
+
+        def _due(target):
+            out = [e for e in pending
+                   if e["target"] == target and e["step"] <= cur + 1]
+            for e in out:
+                pending.remove(e)
+            return out
+
+        def _arm(entries):
+            specs = []
+            for e in entries:
+                spec = e["point"]
+                if e["point"] == "dispatch_hang":
+                    spec += f":ms={int(hang_ms)}"
+                specs.append(spec)
+            if specs:
+                faults.configure(";".join(specs), seed=sched.seed)
+            return bool(specs)
+
+        def _reap(_entries):
+            for rep in faults.fired():
+                fired.append({"step": cur + 1, "spec": rep})
+            faults.clear()
+
+        while cur < steps and iter_n < max_iters:
+            iter_n += 1
+            before = cur
+            ent = _due("train")
+            armed = _arm(ent)
+            step_err = None
+            try:
+                cs.step(*_batch(cur + 1), 8)
+            except Exception as e:
+                step_err = e
+            finally:
+                if armed:
+                    _reap(ent)
+            # reconcile the step counter with what actually happened:
+            # recoveries (guardian hang-recover, health rollback,
+            # explicit poison recover below) rewind to a committed step
+            if cs._poisoned is not None:
+                # escalation that nobody auto-recovered (warn/dump
+                # action would land here) — the soak recovers itself
+                cs.recover(mgr)
+            recov = telemetry.events("recovery")
+            new_rec = recov[rec_seen:]
+            rec_seen = len(recov)
+            # only TRAINER recoveries move the train step counter —
+            # serving/resize recoveries carry no restored step (their
+            # event 'step' field is the global telemetry counter)
+            train_rec = [e for e in new_rec
+                         if e.get("where") == "compiled_step"]
+            if new_rec:
+                for ev in new_rec:
+                    recoveries.append({
+                        "where": ev.get("where"),
+                        "step": ev.get("step")
+                        if ev in train_rec else None,
+                        "seconds": ev.get("seconds")})
+            if train_rec:
+                for ev in train_rec:
+                    rstep = ev.get("step")
+                    if rstep is None:
+                        continue
+                    if rstep > before + 1:
+                        _violate("committed_monotonic",
+                                 f"recovery resumed step {rstep} "
+                                 f"ahead of trainer step "
+                                 f"{before + 1}")
+                    if rstep not in commits:
+                        _violate("committed_monotonic",
+                                 f"recovery resumed step {rstep} "
+                                 "which was never committed "
+                                 f"(commits: {sorted(set(commits))})")
+                cur = _owner_step(cs)
+            elif pguard.drained is not None:
+                # a preemption drill drained mid-step: simulate the
+                # restart leg — restore the drain checkpoint and
+                # continue from it (serving residents were requeued
+                # with state by the drain itself)
+                preemptions += 1
+                d = pguard.drained
+                pguard.drained = None
+                pguard._draining = False
+                pguard.exit_code = None
+                restored = mgr.restore(step=d["committed_step"])
+                commits.append(int(d["committed_step"]))
+                cur = _owner_step(cs)
+                if restored != d["committed_step"]:
+                    _violate("committed_monotonic",
+                             f"drain committed {d['committed_step']} "
+                             f"but restore served {restored}")
+                say(f"preempted at step {before + 1}: drained to "
+                    f"{d['committed_step']} in {d['seconds']}s")
+            elif step_err is not None:
+                _violate("no_unrecovered_poison",
+                         f"step {before + 1} failed without a "
+                         f"recovery path: {step_err!r}")
+                break
+            else:
+                cur += 1
+
+            # periodic committed boundary (with save-targeted faults)
+            if cur % save_every == 0 and step_err is None \
+                    and not train_rec:
+                ent = _due("save")
+                armed = _arm(ent)
+                try:
+                    commits.append(mgr.save(block=True, force=True))
+                except faults.FaultError:
+                    pass    # torn write: previous commit authoritative
+                finally:
+                    if armed:
+                        _reap(ent)
+
+            # serving stream: one request, served to completion (the
+            # per-round drain keeps the stream sustainable, so the
+            # flood stage below measures the OVERLOAD policy and not
+            # a backlog this loop created)
+            if iter_n % serve_every == 0:
+                ent = _due("serve")
+                armed = _arm(ent)
+                try:
+                    stream.append(srv.submit(_prompt(len(stream))))
+                    srv.run()
+                except MXNetError:
+                    srv.recover()       # poisoned pool: replay
+                    srv.run()
+                finally:
+                    if armed:
+                        _reap(ent)
+
+            # one in-job resize, slot count x2 (+ optional fault)
+            if not resize_done and cur >= sched.resize_at:
+                resize_done = True
+                ent = _due("resize")
+                armed = _arm(ent)
+                _m, f_before = engine.compile_counts()
+                try:
+                    resize_rec = srv.resize_slots(4, reason="chaos")
+                except (MXNetError, faults.FaultError):
+                    # pre-drain abort leaves the old config intact —
+                    # retry without the fault (the documented abort
+                    # semantics)
+                    faults.clear()
+                    resize_rec = srv.resize_slots(4, reason="chaos")
+                finally:
+                    if armed:
+                        _reap(ent)
+                resize_fresh = engine.compile_counts()[1] - f_before
+                say(f"resize at step {cur}: {resize_rec['slots_from']}"
+                    f" -> {resize_rec['slots_to']} slots, healed="
+                    f"{resize_rec['healed']}")
+
+            # the flood stage: 10x slot capacity, ttl-armed
+            if not flood_done and cur >= sched.flood_at:
+                flood_done = True
+                slots = sum(b.slots for b in srv.sched.buckets)
+                n = 10 * slots
+                shed0 = telemetry.counter(
+                    "mxtpu_requests_shed_total",
+                    "requests shed at enqueue by the overload policy"
+                    ).value
+                admitted = 0
+                for i in range(n):
+                    try:
+                        srv.submit(_prompt(90_000 + i), ttl_ms=40.0)
+                        admitted += 1
+                    except MXNetError:
+                        pass
+                for _ in range(4):
+                    srv.step()
+                shed = telemetry.counter(
+                    "mxtpu_requests_shed_total",
+                    "requests shed at enqueue by the overload policy"
+                    ).value - shed0
+                flood_stats = {
+                    "submitted": n, "admitted": admitted,
+                    "shed": int(shed),
+                    "shed_rate": round(shed / n, 4),
+                    "queue_after": srv.sched.queue_depth()}
+                say(f"flood at step {cur}: {n} submits, "
+                    f"{int(shed)} shed, queue "
+                    f"{srv.sched.queue_depth()}")
+
+        if cur < steps:
+            _violate("committed_monotonic",
+                     f"soak did not converge: reached step {cur} of "
+                     f"{steps} in {iter_n} iterations")
+
+        # -- final boundary + serving drain --------------------------
+        final_commit = mgr.save(block=True, force=True)
+        commits.append(final_commit)
+        try:
+            srv.run()
+        except MXNetError:
+            srv.recover()
+            srv.run()
+        mx.nd.waitall()
+        _m1, fresh1 = engine.compile_counts()
+
+        # -- invariants ----------------------------------------------
+        if final_commit != steps:
+            _violate("committed_monotonic",
+                     f"final commit {final_commit} != target {steps}")
+
+        steady_fresh = (fresh1 - fresh0) - resize_fresh
+        if steady_fresh != 0:
+            _violate("zero_fresh_compiles",
+                     f"{steady_fresh} fresh compile(s) outside the "
+                     "resize pre-warm window")
+
+        if cs._poisoned is not None:
+            _violate("no_unrecovered_poison",
+                     f"trainer still poisoned: {cs._poisoned}")
+        if srv._poisoned is not None:
+            _violate("no_unrecovered_poison",
+                     f"server still poisoned: {srv._poisoned}")
+        not_done = [r.id for r in stream if r.state != "done"]
+        if not_done:
+            _violate("no_unrecovered_poison",
+                     f"stream requests never completed: {not_done}")
+
+        gc.collect()
+        live1 = engine.cache_info()["live_bytes"]
+        if live1 > live0 * 2 + (2 << 20):
+            _violate("no_leaked_buffers",
+                     f"live bytes grew {live0} -> {live1}")
+
+        # fp32-exact parity vs the unfaulted reference at the same
+        # step count (recoveries replay — they must not drift)
+        ref_net, ref_cs = _build("soakref_")
+        for i in range(1, steps + 1):
+            ref_cs.step(*_batch(i), 8)
+        mx.nd.waitall()
+        mism = []
+        want = {n_: p.data().asnumpy()
+                for n_, p in ref_net.collect_params().items()}
+        got = {n_: p.data().asnumpy()
+               for n_, p in net.collect_params().items()}
+        for (ka, va), (kb, vb) in zip(sorted(want.items()),
+                                      sorted(got.items())):
+            if not np.array_equal(va, vb):
+                mism.append(ka)
+        if mism:
+            _violate("params_exact",
+                     f"params differ from the unfaulted reference at "
+                     f"step {steps}: {mism}")
+
+        inv = {}
+        for name in ("committed_monotonic", "params_exact",
+                     "zero_fresh_compiles", "no_unrecovered_poison",
+                     "no_leaked_buffers"):
+            bad = [v for v in violations if v["invariant"] == name]
+            inv[name] = {"ok": not bad,
+                         "violations": [v["detail"] for v in bad]}
+
+        artifact = {
+            "format": FORMAT, "kind": "mxtpu_chaos_soak",
+            "seed": sched.seed, "steps": steps,
+            "plan": sched.to_dict(),
+            "faults_fired": fired,
+            "n_faults": len(fired),
+            "distinct_points": len(
+                {f["spec"].split(":")[0] for f in fired}),
+            "recoveries": recoveries,
+            "n_recoveries": len(recoveries),
+            "preemptions": preemptions,
+            "commits": sorted(set(commits)),
+            "resize": resize_rec,
+            "flood": flood_stats,
+            "serving_stats": srv.stats(),
+            "live_bytes": {"warm": live0, "end": live1},
+            "invariants": inv,
+            "violations": violations,
+            "ok": not violations,
+            "iterations": iter_n,
+        }
+        _register(artifact)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, f"soak-{sched.seed}.json")
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(artifact, f, indent=1)
+            os.replace(tmp, path)
+            artifact["artifact_path"] = path
+        return artifact
+    finally:
+        faults.clear()
+        with _recorder._lock:
+            _recorder._auto_dumps_left = dumps_prev
+        if guard is not None:
+            guard.stop()
+        if pguard is not None:
+            pguard.uninstall()
+        if mgr is not None:
+            mgr.close()
+        import shutil
+        shutil.rmtree(ckdir, ignore_errors=True)
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def render(artifact: dict) -> str:
+    """Text rendering of a soak artifact (``tools/mxsoak.py
+    render``); raises for malformed input so the CLI can exit 1."""
+    if not isinstance(artifact, dict) or \
+            artifact.get("kind") != "mxtpu_chaos_soak":
+        raise ValueError("not an mxtpu_chaos_soak artifact")
+    lines = [
+        f"chaos soak: seed {artifact['seed']}, "
+        f"{artifact['steps']} steps — "
+        + ("ALL INVARIANTS HELD" if artifact.get("ok")
+           else f"{len(artifact.get('violations') or [])} "
+                "VIOLATION(S)")]
+    lines.append(
+        f"  faults fired: {artifact.get('n_faults')} across "
+        f"{artifact.get('distinct_points')} distinct points; "
+        f"recoveries: {artifact.get('n_recoveries')}; "
+        f"preemptions: {artifact.get('preemptions')}")
+    for f in artifact.get("faults_fired", ()):
+        lines.append(f"    step {f.get('step'):>4}  {f.get('spec')}")
+    rz = artifact.get("resize")
+    if rz:
+        lines.append(
+            f"  resize: slots {rz.get('slots_from')} -> "
+            f"{rz.get('slots_to')}, migrated {rz.get('migrated')}, "
+            f"requeued {rz.get('requeued')}, healed "
+            f"{rz.get('healed')}")
+    fl = artifact.get("flood")
+    if fl:
+        lines.append(
+            f"  flood: {fl.get('submitted')} submits, "
+            f"{fl.get('shed')} shed "
+            f"(rate {fl.get('shed_rate')}), queue after "
+            f"{fl.get('queue_after')}")
+    for name, st in (artifact.get("invariants") or {}).items():
+        mark = "OK " if st.get("ok") else "FAIL"
+        lines.append(f"  [{mark}] {name}")
+        for v in st.get("violations", ()):
+            lines.append(f"         {v}")
+    return "\n".join(lines)

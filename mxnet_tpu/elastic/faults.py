@@ -14,6 +14,12 @@ claims to survive into a knob the tier-1 CPU suite can pull on demand:
                                # raise -> the poison protocol fires
     checkpoint_write:nth=2     # crash while writing the 2nd shard
     host_copy                  # fail the device->host snapshot copy
+    dispatch:prob=0.05         # every arrival fires with p=0.05, from
+                               # the MXTPU_FAULT_SEED RNG stream —
+                               # random plans replay deterministically
+    dispatch_hang:ms=500       # HANG the dispatch 500 ms (watchdog-
+                               # visible), then consume the donated
+                               # buffers and raise
 
 Injection points (the hooks live on the real code paths, not in test
 shims):
@@ -33,6 +39,19 @@ shims):
   NaN in its input batch, so the compiled program produces a nonfinite
   loss/gradients and the health plane's detection, skip gate, and
   rollback paths are exercised (docs/observability.md).
+* ``dispatch_hang`` — the dispatch HANGS (``time.sleep``, default
+  ``:ms=1000``) instead of raising — the failure mode a watchdog
+  exists for (``elastic.guardian.Guardian``).  When the sleep ends the
+  donated buffers are consumed and :class:`FaultError` raises, so an
+  un-watched hang still resolves into the familiar poison protocol
+  (the drill terminates instead of wedging the suite).
+* ``preempt_signal`` — a synthetic preemption: when due, the guardian
+  plane's step-owner heartbeat delivers a real ``SIGTERM`` to this
+  process (``os.kill``), driving the installed
+  :class:`~.guardian.PreemptionGuard`'s drain path end to end.  Only
+  consulted while a guardian/preemption guard is installed — without
+  one the point never fires (and a raw SIGTERM would simply kill the
+  process, which is not a drill).
 * ``resize_drain`` / ``resize_prewarm`` / ``resize_reshard`` /
   ``resize_swap`` — the four transition points of a LIVE elastic
   resize (``elastic.resize.ResizeController``, docs/elasticity.md
@@ -49,8 +68,15 @@ shims):
 Qualifiers: ``nth=N`` fires on the Nth arrival at the point (1-based,
 default 1); ``step=N`` fires on the first arrival at or after global
 train step N (``telemetry.current_step()``); ``times=K`` repeats the
-fault K times (default 1).  Every spec is one-shot by default so a
-retry/recovery can succeed deterministically.
+fault K times (default 1; 0 = unlimited); ``prob=P`` (float in [0,1])
+makes each eligible arrival fire with probability P, drawn from a
+``random.Random`` seeded by ``MXTPU_FAULT_SEED`` (or ``configure``'s
+``seed=``) — the same seed + the same arrival sequence replays the
+same random plan exactly; ``ms=N`` sets the ``dispatch_hang`` sleep in
+milliseconds.  Every spec is one-shot by default so a retry/recovery
+can succeed deterministically — EXCEPT ``prob=`` specs, which default
+to unlimited ``times`` (a probabilistic plan that retired after one
+hit would not be a soak).
 
 The module is import-light (no jax) and costs one module-attribute
 read (``_active``) per hook when no fault is configured.
@@ -58,18 +84,25 @@ read (``_active``) per hook when no fault is configured.
 from __future__ import annotations
 
 import os
+import random as _random
 import threading
 from typing import Dict, List, Optional
 
 __all__ = ["FaultError", "FaultSpec", "configure", "configure_from_env",
            "clear", "active", "fired", "maybe_fire", "on_dispatch",
-           "nonfinite_due", "POINTS"]
+           "nonfinite_due", "preempt_due", "POINTS",
+           "HANG_DEFAULT_MS"]
 
 #: the injection points wired into the runtime (unknown points parse —
 #: forward compatibility — but are reported by :func:`configure`)
-POINTS = ("dispatch", "dispatch_post", "checkpoint_write", "host_copy",
-          "nonfinite_grad", "resize_drain", "resize_prewarm",
+POINTS = ("dispatch", "dispatch_post", "dispatch_hang",
+          "checkpoint_write", "host_copy",
+          "nonfinite_grad", "preempt_signal",
+          "resize_drain", "resize_prewarm",
           "resize_reshard", "resize_swap")
+
+#: default ``dispatch_hang`` sleep when the spec carries no ``ms=``
+HANG_DEFAULT_MS = 1000
 
 
 class FaultError(RuntimeError):
@@ -78,19 +111,26 @@ class FaultError(RuntimeError):
 
 
 class FaultSpec:
-    __slots__ = ("point", "nth", "step", "times", "fired_count")
+    __slots__ = ("point", "nth", "step", "times", "prob", "ms",
+                 "fired_count")
 
     def __init__(self, point: str, nth: Optional[int] = None,
-                 step: Optional[int] = None, times: int = 1):
+                 step: Optional[int] = None, times: int = 1,
+                 prob: Optional[float] = None,
+                 ms: Optional[int] = None):
         self.point = point
         self.nth = nth
         self.step = step
         self.times = times
+        self.prob = prob
+        self.ms = ms
         self.fired_count = 0
 
     @property
     def exhausted(self) -> bool:
-        return self.fired_count >= self.times
+        # times=0 means unlimited (the prob= default): the spec stays
+        # armed for the life of the configuration
+        return self.times > 0 and self.fired_count >= self.times
 
     def __repr__(self):
         quals = []
@@ -98,7 +138,11 @@ class FaultSpec:
             quals.append(f"nth={self.nth}")
         if self.step is not None:
             quals.append(f"step={self.step}")
-        if self.times != 1:
+        if self.prob is not None:
+            quals.append(f"prob={self.prob:g}")
+        if self.ms is not None:
+            quals.append(f"ms={self.ms}")
+        if self.times != (0 if self.prob is not None else 1):
             quals.append(f"times={self.times}")
         return self.point + (":" + ",".join(quals) if quals else "")
 
@@ -109,6 +153,10 @@ _counts: Dict[str, int] = {}
 _fired: List[str] = []
 #: fast-path flag: hooks read this one attribute and return when False
 _active = False
+#: the prob= qualifier's RNG — re-seeded by every :func:`configure`
+#: (from ``seed=`` or ``MXTPU_FAULT_SEED``), so a random plan replays
+#: deterministically: same seed + same arrival sequence = same firings
+_rng = _random.Random(0)
 
 
 def _parse(text: str) -> List[FaultSpec]:
@@ -119,28 +167,56 @@ def _parse(text: str) -> List[FaultSpec]:
             continue
         point, _, qual = raw.partition(":")
         point = point.strip()
-        kw: Dict[str, int] = {}
+        kw: Dict[str, float] = {}
         for q in qual.split(","):
             q = q.strip()
             if not q:
                 continue
             k, _, v = q.partition("=")
             k = k.strip()
-            if k not in ("nth", "step", "times") or not v.strip():
+            if k not in ("nth", "step", "times", "prob", "ms") \
+                    or not v.strip():
                 raise ValueError(
                     f"bad fault qualifier {q!r} in {raw!r} "
-                    "(expected nth=N, step=N, or times=K)")
-            kw[k] = int(v)
+                    "(expected nth=N, step=N, times=K, prob=P, "
+                    "or ms=N)")
+            try:
+                kw[k] = float(v) if k == "prob" else int(v)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault qualifier value {q!r} in {raw!r}")
+        prob = kw.get("prob")
+        if prob is not None and not 0.0 <= prob <= 1.0:
+            raise ValueError(
+                f"prob must be in [0, 1], got {prob} in {raw!r}")
+        # a probabilistic spec defaults to unlimited firings: it IS
+        # the plan, not a one-shot drill
+        default_times = 0 if prob is not None else 1
         specs.append(FaultSpec(point, nth=kw.get("nth"),
                                step=kw.get("step"),
-                               times=kw.get("times", 1)))
+                               times=int(kw.get("times",
+                                                default_times)),
+                               prob=prob,
+                               ms=kw.get("ms")))
     return specs
 
 
-def configure(text: Optional[str]) -> int:
+def _seed_from_env() -> int:
+    try:
+        from .. import envs
+        return int(envs.get("MXTPU_FAULT_SEED"))
+    except Exception:
+        try:
+            return int(os.environ.get("MXTPU_FAULT_SEED", "0"))
+        except ValueError:
+            return 0
+
+
+def configure(text: Optional[str], seed: Optional[int] = None) -> int:
     """Install the fault plan from ``text`` (the ``MXTPU_FAULT_INJECT``
     grammar); ``None``/empty clears it.  Returns the spec count.
-    Arrival counters and the fired log reset with each configure."""
+    Arrival counters, the fired log, and the ``prob=`` RNG (seeded by
+    ``seed`` or ``MXTPU_FAULT_SEED``) reset with each configure."""
     global _active
     specs = _parse(text) if text else []
     unknown = [s.point for s in specs if s.point not in POINTS]
@@ -157,6 +233,7 @@ def configure(text: Optional[str]) -> int:
         _specs[:] = specs
         _counts.clear()
         _fired.clear()
+        _rng.seed(_seed_from_env() if seed is None else int(seed))
         _active = bool(specs)
     return len(specs)
 
@@ -224,6 +301,10 @@ def _check(point: str) -> Optional[FaultSpec]:
                 continue
             if s.step is not None and _current_step() < s.step:
                 continue
+            if s.prob is not None and _rng.random() >= s.prob:
+                # the roll happens under the lock, so the RNG stream
+                # is a deterministic function of the arrival sequence
+                continue
             hit = s
             break
         if hit is not None:
@@ -284,6 +365,44 @@ def nonfinite_due(op: str = "") -> bool:
     return True
 
 
+def preempt_due(where: str = "") -> bool:
+    """The ``preempt_signal`` point: like ``nonfinite_grad`` this does
+    not raise — when a spec is due the guardian plane's step-owner
+    heartbeat (``elastic.guardian``) delivers a REAL ``SIGTERM`` to
+    this process, so the installed
+    :class:`~.guardian.PreemptionGuard`'s drain path runs exactly as
+    it would on a cluster preemption.  Returns True when the signal
+    should be sent."""
+    if not _active:
+        return False
+    spec = _check("preempt_signal")
+    if spec is None:
+        return False
+    try:
+        from .. import telemetry
+        telemetry.record_event("fault_injected", point="preempt_signal",
+                               spec=repr(spec), where=where)
+        telemetry.counter(
+            "mxtpu_faults_injected_total",
+            "faults fired by the MXTPU_FAULT_INJECT plan").inc()
+    except Exception:
+        pass
+    return True
+
+
+def _consume_donated(arrays, donate):
+    """Delete the buffers a post-donation drill consumes — exactly the
+    set a real TPU executable consuming its donated arguments leaves
+    dead (see :func:`on_dispatch` for the ``donate`` contract)."""
+    targets = list(arrays) if donate is None else \
+        [arrays[i] for i in donate if 0 <= i < len(arrays)]
+    for a in targets:
+        try:
+            a.delete()
+        except Exception:
+            pass
+
+
 def on_dispatch(op: str, arrays=(), donate=None):
     """The engine/trainer dispatch hook.
 
@@ -292,14 +411,17 @@ def on_dispatch(op: str, arrays=(), donate=None):
     deletes the donated input buffers FIRST — exactly what a TPU
     executable consuming its donated arguments leaves behind — so the
     caller's consumed-probe finds dead buffers and the poison protocol
-    engages.
+    engages.  ``dispatch_hang`` sleeps ``ms`` (watchdog-visible: the
+    step-owner heartbeat is already open around this call), then
+    resolves as a ``dispatch_post`` — a hang that nobody watches still
+    terminates into the poison protocol instead of wedging forever.
 
-    ``donate`` selects which ``arrays`` a ``dispatch_post`` drill
-    consumes: a tuple of indices (the engine passes its real donate
-    tuple — an EMPTY tuple means a non-donating op, and the drill must
-    not touch buffers the caller still owns), or ``None`` when
-    ``arrays`` is already the pre-filtered donated set (the SPMD
-    trainer call sites).
+    ``donate`` selects which ``arrays`` a ``dispatch_post``/
+    ``dispatch_hang`` drill consumes: a tuple of indices (the engine
+    passes its real donate tuple — an EMPTY tuple means a non-donating
+    op, and the drill must not touch buffers the caller still owns),
+    or ``None`` when ``arrays`` is already the pre-filtered donated
+    set (the SPMD trainer call sites).
     """
     if not _active:
         return
@@ -308,14 +430,15 @@ def on_dispatch(op: str, arrays=(), donate=None):
         _raise(spec, "dispatch", op=op)
     spec = _check("dispatch_post")
     if spec is not None:
-        targets = list(arrays) if donate is None else \
-            [arrays[i] for i in donate if 0 <= i < len(arrays)]
-        for a in targets:
-            try:
-                a.delete()
-            except Exception:
-                pass
+        _consume_donated(arrays, donate)
         _raise(spec, "dispatch_post", op=op)
+    spec = _check("dispatch_hang")
+    if spec is not None:
+        import time as _time
+        hang_ms = spec.ms if spec.ms is not None else HANG_DEFAULT_MS
+        _time.sleep(hang_ms / 1000.0)
+        _consume_donated(arrays, donate)
+        _raise(spec, "dispatch_hang", op=op, hang_ms=hang_ms)
 
 
 # arm from the environment at import: fault plans are a process-level

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import os
+import random as _random_mod
 import threading
 import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -479,24 +480,62 @@ def _retry_policy():
             float(envs.get("MXTPU_DISPATCH_BACKOFF_MS")))
 
 
+# errors that look like RuntimeError but can never succeed on retry:
+# XLA surfaces compile/shape/arity problems and device OOM as
+# XlaRuntimeError (a RuntimeError subclass) with a canonical status
+# prefix, and re-dispatching them just burns MXTPU_DISPATCH_RETRIES
+# before the poison protocol gets to run.  Matched case-insensitively
+# against the message so wrapped/tunnelled copies still classify.
+_NON_TRANSIENT_MARKERS = (
+    "resource_exhausted", "out of memory", "invalid_argument",
+    "failed_precondition", "unimplemented", "incompatible shapes")
+
+#: jitter source for the retry backoff — intentionally unseeded
+#: (synchronized retries are the problem jitter exists to solve)
+_retry_rng = _random_mod.Random()
+
+
 def _retryable_error(e: Exception) -> bool:
     """Transient-shaped errors only: runtime/IO failures.  Program
     errors (TypeError/ValueError: aval drift, bad arity — the tiered
-    wrapper's own demotion protocol keys on TypeError) and our own
-    MXNetError diagnostics re-raise immediately."""
+    wrapper's own demotion protocol keys on TypeError), our own
+    MXNetError diagnostics, and non-transient device errors
+    (``XlaRuntimeError`` OOM / shape / invalid-argument statuses —
+    :data:`_NON_TRANSIENT_MARKERS`) re-raise immediately: they fail
+    fast into the caller's poison protocol instead of burning the
+    retry budget on a dispatch that can never succeed."""
     from ..base import MXNetError
     if isinstance(e, MXNetError):
         return False
-    return isinstance(e, (RuntimeError, OSError))
+    if not isinstance(e, (RuntimeError, OSError)):
+        return False
+    msg = str(e).lower()
+    if any(m in msg for m in _NON_TRANSIENT_MARKERS):
+        return False
+    return True
+
+
+def _next_backoff_ms(base_ms: float, prev_ms: float) -> float:
+    """Decorrelated-jitter backoff: ``U[base, max(base, prev * 3)]``
+    capped at ``base * 32``.  Unlike the plain exponential schedule
+    this one never synchronizes — N workers retrying the same
+    transient fan out across the window instead of hammering the
+    device in lockstep at ``base * 2^k``."""
+    if base_ms <= 0:
+        return 0.0
+    hi = max(base_ms, prev_ms * 3.0)
+    return min(base_ms * 32.0, _retry_rng.uniform(base_ms, hi))
 
 
 def retrying_call(call, probe_arrays, op: str):
-    """Run ``call()`` under the bounded-retry + exponential-backoff
-    policy.  ``probe_arrays``: the input buffers whose deletion marks
-    the dispatch as post-donation (never retried).  Shared by
-    ``invoke_compiled`` and the SPMD trainer's fused dispatch."""
+    """Run ``call()`` under the bounded-retry + decorrelated-jitter
+    backoff policy.  ``probe_arrays``: the input buffers whose
+    deletion marks the dispatch as post-donation (never retried).
+    Shared by ``invoke_compiled`` and the SPMD trainer's fused
+    dispatch."""
     import time as _time
     attempt = 0
+    sleep_ms = 0.0
     retries = backoff_ms = None
     while True:
         try:
@@ -509,6 +548,7 @@ def retrying_call(call, probe_arrays, op: str):
                     for a in probe_arrays):
                 raise
             attempt += 1
+            sleep_ms = _next_backoff_ms(backoff_ms, sleep_ms)
             t = _telem if _telem is not None else _telemetry()
             if t._switch.enabled:
                 t.counter(
@@ -517,8 +557,9 @@ def retrying_call(call, probe_arrays, op: str):
                     ).inc()
                 t.record_event("dispatch_retry", op=op,
                                attempt=attempt,
+                               backoff_ms=round(sleep_ms, 2),
                                error=repr(e)[:300])
-            _time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1000.0)
+            _time.sleep(sleep_ms / 1000.0)
 
 
 def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,

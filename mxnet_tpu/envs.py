@@ -159,16 +159,44 @@ _reg("MXTPU_DISPATCH_RETRIES", int, 0,
      "(consumed buffers) are never retried — they take the "
      "poison/recover protocol. See docs/elasticity.md.")
 _reg("MXTPU_DISPATCH_BACKOFF_MS", float, 50.0,
-     "Base backoff between dispatch retries, in milliseconds; "
-     "attempt k sleeps base * 2^(k-1).")
+     "Base backoff between dispatch retries, in milliseconds. "
+     "Decorrelated jitter: attempt k sleeps uniform(base, prev*3), "
+     "capped at base*32, so concurrent retriers fan out instead of "
+     "hammering the device in lockstep.")
 _reg("MXTPU_FAULT_INJECT", str, "",
      "Deterministic fault-injection plan for the elastic subsystem "
-     "(';'-separated 'point[:nth=N|step=N|times=K]' specs; points: "
-     "dispatch, dispatch_post, checkpoint_write, host_copy, "
-     "nonfinite_grad). Read at "
-     "import of mxnet_tpu.elastic.faults; tests reconfigure via "
-     "faults.configure(). Empty (default) injects nothing. See "
-     "docs/elasticity.md.")
+     "(';'-separated 'point[:nth=N|step=N|times=K|prob=P|ms=N]' "
+     "specs; points: dispatch, dispatch_post, dispatch_hang, "
+     "checkpoint_write, host_copy, nonfinite_grad, preempt_signal, "
+     "resize_*). prob=P fires each arrival with probability P from "
+     "the MXTPU_FAULT_SEED stream (deterministic replay of a random "
+     "plan). Read at import of mxnet_tpu.elastic.faults; tests "
+     "reconfigure via faults.configure(). Empty (default) injects "
+     "nothing. See docs/elasticity.md.")
+_reg("MXTPU_FAULT_SEED", int, 0,
+     "Seed for the prob= qualifier's RNG in MXTPU_FAULT_INJECT "
+     "(elastic.faults) and the default chaos-soak schedule "
+     "(elastic.chaos.Schedule): the same seed replays the same "
+     "random fault plan exactly. Re-read at every faults.configure().")
+_reg("MXTPU_WATCHDOG_TIMEOUT", float, 300.0,
+     "Guardian hang watchdog (elastic.guardian.Guardian): seconds a "
+     "step/dispatch heartbeat may stay in flight before a retained "
+     "hang_suspected event (with per-thread stacks) fires and the "
+     "MXTPU_WATCHDOG_ACTION escalation runs.")
+_reg("MXTPU_WATCHDOG_ACTION", str, "dump",
+     "Guardian escalation on a suspected hang: 'warn' records the "
+     "event + counter; 'dump' also writes a flight-recorder "
+     "artifact; 'recover' additionally runs the owner's poison->"
+     "recover protocol when the hung dispatch resolves poisoned "
+     "(a hung dispatch becomes a recovered step, not a dead job). "
+     "See docs/elasticity.md (Guardian & chaos soak).")
+_reg("MXTPU_DRAIN_DEADLINE_S", float, 30.0,
+     "Preemption drain budget (elastic.guardian.PreemptionGuard): "
+     "SIGTERM -> committed checkpoint + serving drain must land "
+     "inside this many seconds; overruns are recorded on the "
+     "preempted event (deadline_ok: false) and warned, not "
+     "interrupted (a torn checkpoint would be worse than a late "
+     "one).")
 _reg("MXTPU_CHECKPOINT_KEEP", int, 3,
      "Default retention for elastic.CheckpointManager: committed "
      "checkpoints beyond the newest N are pruned after each commit.")

@@ -190,7 +190,7 @@ class CompiledStep:
                 args[0].shape[0]
         with profiler._span(f"CompiledStep[{self.net.name}]",
                             "compiled_step") as sp, \
-                telemetry.step_owner():
+                telemetry.step_owner(self, "compiled_step"):
             t0 = time.perf_counter()
             d0 = engine.dispatch_count()
             out = self._step_or_fallback(args, label, batch_size)
@@ -239,7 +239,7 @@ class CompiledStep:
         import time
         with profiler._span(f"CompiledStep[{self.net.name}].multi",
                             "compiled_step_multi") as sp, \
-                telemetry.step_owner():
+                telemetry.step_owner(self, "compiled_step_multi"):
             t0 = time.perf_counter()
             d0 = engine.dispatch_count()
             out = self._step_or_fallback(args, label, batch_size,

@@ -1951,7 +1951,8 @@ class DataParallelTrainer:
         import time
         from .. import profiler, telemetry
         with profiler._span("DataParallelTrainer.step",
-                            "spmd_step") as sp, telemetry.step_owner():
+                            "spmd_step") as sp, \
+                telemetry.step_owner(self, "spmd_step"):
             t0 = time.perf_counter()
             loss = self._step_impl(data, label)
             sp.sync(loss._data)
@@ -1984,7 +1985,7 @@ class DataParallelTrainer:
         from .. import profiler, telemetry
         with profiler._span("DataParallelTrainer.step_multi",
                             "spmd_step_multi") as sp, \
-                telemetry.step_owner():
+                telemetry.step_owner(self, "spmd_step_multi"):
             t0 = time.perf_counter()
             loss = self._step_multi_impl(data, label, repeat=repeat)
             sp.sync(loss._data)

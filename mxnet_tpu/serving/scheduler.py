@@ -27,20 +27,30 @@ __all__ = ["Request", "Bucket", "BucketScheduler"]
 
 _req_uid = itertools.count(1)
 
-#: request lifecycle states
-QUEUED, ACTIVE, DONE, EVICTED = "queued", "active", "done", "evicted"
+#: request lifecycle states (``shed`` = rejected at admission by the
+#: overload policy — never held a slot or a queue place)
+QUEUED, ACTIVE, DONE, EVICTED, SHED = \
+    "queued", "active", "done", "evicted", "shed"
 
 
 class Request:
-    """One generation request moving through the serving plane."""
+    """One generation request moving through the serving plane.
+
+    ``ttl_ms`` arms the overload policy (docs/serving.md, "Overload
+    policy"): the request must COMPLETE within ``ttl_ms`` of
+    submission or it is shed at enqueue (the estimated queue wait
+    already exceeds the deadline) / evicted when the deadline expires
+    in the queue or in a slot.  ``None`` (default) = no deadline."""
 
     __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
                  "eos_id", "state", "generated", "bucket", "slot",
-                 "submit_t", "first_token_t", "done_t", "evict_reason")
+                 "submit_t", "first_token_t", "done_t", "evict_reason",
+                 "ttl_ms", "deadline")
 
     def __init__(self, prompt, max_new_tokens: int,
                  temperature: float = 0.0,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 ttl_ms: Optional[float] = None):
         self.id = next(_req_uid)
         self.prompt = np.asarray(prompt, dtype=np.float32).reshape(-1)
         if self.prompt.size == 0:
@@ -59,6 +69,19 @@ class Request:
         self.first_token_t: Optional[float] = None
         self.done_t: Optional[float] = None
         self.evict_reason: Optional[str] = None
+        if ttl_ms is not None and float(ttl_ms) <= 0:
+            raise MXNetError(f"ttl_ms must be > 0, got {ttl_ms}")
+        self.ttl_ms = None if ttl_ms is None else float(ttl_ms)
+        self.deadline = None if ttl_ms is None else \
+            self.submit_t + self.ttl_ms / 1000.0
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Deadline passed while the request is still live (queued OR
+        holding a slot)?  Terminal states never expire."""
+        if self.deadline is None or self.state in (DONE, EVICTED, SHED):
+            return False
+        return (time.perf_counter() if now is None else now) \
+            > self.deadline
 
     @property
     def prompt_len(self) -> int:

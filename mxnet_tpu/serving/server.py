@@ -172,16 +172,49 @@ class Server:
     # -- public API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               ttl_ms: Optional[float] = None) -> Request:
         """Queue one generation request; admission happens at the next
         :meth:`step`.  Raises ``MXNetError`` when no bucket fits the
         prompt or the queue is full (both recorded as retained
-        ``slot_oom`` events)."""
+        ``slot_oom`` events).
+
+        ``ttl_ms`` arms the overload policy (docs/serving.md,
+        "Overload policy"): when the ESTIMATED queue wait — queue
+        depth x the rolling per-token service rate from the decode
+        histograms — already exceeds the deadline, the request is SHED
+        here (state ``shed``, retained ``shed`` event,
+        ``mxtpu_requests_shed_total``, and an ``MXNetError`` the
+        caller turns into a fast 429) instead of growing the queue a
+        request that can only expire in it."""
         from .. import telemetry
         mnt = self.max_new_tokens if max_new_tokens is None \
             else min(int(max_new_tokens), self.max_new_tokens)
         req = Request(prompt, mnt, temperature=temperature,
-                      eos_id=self.eos_id if eos_id is None else eos_id)
+                      eos_id=self.eos_id if eos_id is None else eos_id,
+                      ttl_ms=ttl_ms)
+        if req.deadline is not None:
+            est = self.estimate_queue_wait()
+            budget = req.deadline - time.perf_counter()
+            if est is not None and est > budget:
+                from .scheduler import SHED
+                req.state = SHED
+                req.evict_reason = "shed"
+                telemetry.counter(
+                    "mxtpu_requests_shed_total",
+                    "requests shed at enqueue by the overload policy"
+                    ).inc()
+                telemetry.record_event(
+                    "shed", server=self.name, request=req.id,
+                    prompt_len=req.prompt_len, ttl_ms=req.ttl_ms,
+                    est_wait_s=round(est, 4),
+                    queue_depth=self.sched.queue_depth())
+                raise MXNetError(
+                    f"request shed: estimated queue wait {est:.3f}s "
+                    f"exceeds the {req.ttl_ms:g}ms deadline (queue "
+                    f"depth {self.sched.queue_depth()}); retry with "
+                    "backoff, raise ttl_ms, or scale the plane "
+                    "(docs/serving.md, 'Overload policy')")
         try:
             self.sched.enqueue(req)
         except MXNetError as e:
@@ -197,6 +230,57 @@ class Server:
         self._update_gauges()
         return req
 
+    # -- overload policy (docs/serving.md, "Overload policy") -------------
+    def estimate_queue_wait(self) -> Optional[float]:
+        """Expected seconds a request submitted NOW waits before its
+        slot frees up: queue depth x tokens-per-request x the rolling
+        per-token service rate, spread over the plane's slots.  The
+        rate comes from the histograms the plane already keeps
+        (decode wall seconds / tokens generated); ``None`` before any
+        decode history exists — an un-warmed plane never sheds."""
+        from .. import telemetry
+        q = self.sched.queue_depth()
+        if q == 0 and self.sched.occupancy() < 1.0:
+            return 0.0
+        dh = telemetry.histogram(
+            "mxtpu_serving_decode_seconds",
+            "one decode dispatch wall clock (s)").summary()
+        tokens = telemetry.counter(
+            "mxtpu_serving_tokens_total",
+            "tokens generated by the serving plane").value
+        if not dh["count"] or tokens <= 0:
+            return None
+        per_token_s = dh["sum"] / tokens
+        slots = sum(b.slots for b in self.sched.buckets) or 1
+        # every queued request ahead needs ~max_new_tokens service
+        # slots-widths of decode wall time before a slot frees
+        waves = (q + slots) / slots
+        return waves * self.max_new_tokens * per_token_s
+
+    def _expire_deadlines(self) -> int:
+        """Evict every live request whose deadline passed (queue AND
+        slots — the scheduler's existing evict path does both), with
+        the ``deadline_evicted`` taxonomy on top of the standard
+        ``request_evicted`` audit trail."""
+        from .. import telemetry
+        now = time.perf_counter()
+        expired = [r for r in self.sched.active_requests()
+                   + list(self.sched.queue) if r.expired(now)]
+        n = 0
+        for req in expired:
+            waited = now - req.submit_t
+            if not self.evict(req, reason="deadline", requeue=False):
+                continue
+            n += 1
+            telemetry.counter(
+                "mxtpu_deadline_evictions_total",
+                "live requests evicted on an expired deadline").inc()
+            telemetry.record_event(
+                "deadline_evicted", server=self.name, request=req.id,
+                ttl_ms=req.ttl_ms, waited_s=round(waited, 4),
+                generated=len(req.generated))
+        return n
+
     def step(self, decode_steps: int = 1) -> dict:
         """One scheduling round: admit every queued request with a free
         slot (one prefill dispatch each), then advance every non-empty
@@ -210,6 +294,11 @@ class Server:
                 "recover() to rebuild the pools and requeue resident "
                 "requests (docs/serving.md). Original error: "
                 f"{self._poisoned}")
+        # deadline sweep FIRST: an expired queued request must not
+        # consume the slot (and the prefill dispatch) it can no longer
+        # use, and an expired resident frees its slot for this round's
+        # admissions
+        self._expire_deadlines()
         admitted = 0
         pending = self.sched.admissions()
         for i, (bucket, slot, req) in enumerate(pending):
@@ -883,28 +972,36 @@ class Server:
         name = self.name + suffix
         persist_name = self._persist_base + suffix
         m0, f0 = engine.compile_counts()
-        try:
-            res = engine.invoke_compiled(name, pure, {}, *flat,
-                                         donate=donate,
-                                         persist_name=persist_name)
-        except Exception as e:
-            if pool.consumed():
-                pool.poison(repr(e))
-                self._poisoned = repr(e)
-                telemetry.counter(
-                    "mxtpu_poisons_total",
-                    "post-donation failures (training state lost)"
-                    ).inc()
-                telemetry.record_event(
-                    "poison", where="serving", name=name,
-                    error=repr(e)[:500])
-                telemetry.auto_dump(reason=f"serving_poisoned:{name}")
-                raise MXNetError(
-                    "serving dispatch failed AFTER the KV-cache pool "
-                    "was donated; call Server.recover() to rebuild "
-                    "the pages and requeue resident requests "
-                    f"(docs/serving.md). Original error: {e!r}") from e
-            raise
+        # the step-owner bracket doubles as the guardian plane's
+        # heartbeat: a hung serving dispatch is watchdog-visible
+        # exactly like a hung train step, and the bracket encloses the
+        # poison latch so a Guardian(action='recover') sees the
+        # poisoned server at the heartbeat's exit (elastic.guardian)
+        with telemetry.step_owner(self, "serving_dispatch"):
+            try:
+                res = engine.invoke_compiled(name, pure, {}, *flat,
+                                             donate=donate,
+                                             persist_name=persist_name)
+            except Exception as e:
+                if pool.consumed():
+                    pool.poison(repr(e))
+                    self._poisoned = repr(e)
+                    telemetry.counter(
+                        "mxtpu_poisons_total",
+                        "post-donation failures (training state lost)"
+                        ).inc()
+                    telemetry.record_event(
+                        "poison", where="serving", name=name,
+                        error=repr(e)[:500])
+                    telemetry.auto_dump(
+                        reason=f"serving_poisoned:{name}")
+                    raise MXNetError(
+                        "serving dispatch failed AFTER the KV-cache "
+                        "pool was donated; call Server.recover() to "
+                        "rebuild the pages and requeue resident "
+                        "requests (docs/serving.md). Original error: "
+                        f"{e!r}") from e
+                raise
         n_out = len(res) - L2
         pool.adopt(res[n_out:])
         if suffix not in self._variants:

@@ -85,22 +85,58 @@ import threading as _threading
 _tls = _threading.local()
 
 
+#: heartbeat hook installed by ``elastic.guardian`` while a Guardian /
+#: PreemptionGuard is live: ``(begin(owner, what) -> token,
+#: end(token, exc))``.  None (the default) costs one attribute load
+#: per step — the guardian plane is pay-for-what-you-watch.
+_hb_hook = None
+
+
 class _StepOwner:
     """Marks the dynamic extent of a WHOLE-step owner (CompiledStep,
-    DataParallelTrainer): a ``Trainer.step`` running inside it records
-    latency only, so the step/throughput accounting is done exactly
-    once per real train step."""
+    DataParallelTrainer, a serving dispatch bracket): a
+    ``Trainer.step`` running inside it records latency only, so the
+    step/throughput accounting is done exactly once per real train
+    step.  When the owner identifies itself (``owner=``), the bracket
+    doubles as the guardian plane's HEARTBEAT: entry registers the
+    in-flight step with the hang watchdog, exit clears it (and lets a
+    watching ``Guardian`` run its escalation on the owning thread) —
+    see ``elastic.guardian``."""
+
+    __slots__ = ("_owner", "_what", "_tok", "_hook")
+
+    def __init__(self, owner=None, what=None):
+        self._owner = owner
+        self._what = what
+        self._tok = None
+        self._hook = None
 
     def __enter__(self):
         _tls.depth = getattr(_tls, "depth", 0) + 1
+        hook = _hb_hook
+        if hook is not None and self._owner is not None:
+            try:
+                self._tok = hook[0](self._owner, self._what)
+                self._hook = hook
+            except Exception:
+                self._tok = None   # a broken watchdog never stops a step
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         _tls.depth -= 1
+        if self._tok is not None and self._hook is not None:
+            # the ENTRY-time hook, not the global: uninstalling the
+            # guardian plane mid-step must still clear this bracket's
+            # in-flight record, or it leaks and false-flags the next
+            # Guardian's first scan as an ancient hang
+            try:
+                self._hook[1](self._tok, exc)
+            except Exception:
+                pass               # escalation errors surface as events
 
 
-def step_owner() -> _StepOwner:
-    return _StepOwner()
+def step_owner(owner=None, what: str = None) -> _StepOwner:
+    return _StepOwner(owner, what)
 
 
 def step_owned() -> bool:

@@ -39,7 +39,15 @@ _RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
                          "evict", "prefetch_stall", "oom_risk",
                          "mem_analysis_unavailable", "health_anomaly",
                          "request_evicted", "slot_oom",
-                         "resize", "resize_failed"))
+                         "resize", "resize_failed",
+                         "hang_suspected", "hang_resolved",
+                         "preempted", "preempt_forced",
+                         "shed", "deadline_evicted",
+                         # recovery answers hang_suspected/poison in the
+                         # MXL504 audit and the chaos-soak step
+                         # reconciliation — a dispatch flood must not
+                         # evict the proof that an owner was healed
+                         "recovery"))
 _ring: Optional[Deque[dict]] = None        # high-volume kinds
 _rare: Optional[Deque[dict]] = None        # retained rare kinds
 _dropped = 0          # events pushed out of either ring since clear

@@ -1,0 +1,815 @@
+"""Guardian plane (docs/elasticity.md, "Guardian & chaos soak").
+
+Tier-1 coverage for ISSUE 12: the hang watchdog (heartbeat-fed
+``Guardian`` on both train stacks + the serving dispatch bracket),
+the SIGTERM/SIGINT preemption drain (in-process ``os.kill``, serving
+residents requeued and replayed exactly), the serving overload policy
+(shed at enqueue + deadline eviction under a synthetic flood), the
+probabilistic seeded fault grammar, the engine retry's jitter +
+non-transient classification, the seeded chaos-soak certifier with
+all invariants, and the MXL504 runtime rule + ``tools/mxsoak.py``.
+"""
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.elastic import CheckpointManager, chaos, faults, guardian
+from mxnet_tpu.elastic import manager as emgr
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon.compiled_step import CompiledStep
+from mxnet_tpu.gluon.loss import L2Loss
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """No fault plan, no installed guardian plane, no soak artifact,
+    and no retained incident event leaks between tests (or out of
+    this module: MXL504 reads the process-global ring, and a later
+    module's ``--self-check`` must stay quiet).  The auto-dump
+    throttle budget is restored too — this module's drills must not
+    starve a later module's real crash forensics."""
+    from mxnet_tpu.telemetry import recorder as _recorder
+    dumps_prev = _recorder._auto_dumps_left
+    faults.clear()
+    guardian._reset()
+    yield
+    faults.clear()
+    guardian._reset()
+    chaos._reset()
+    emgr._reset_registry()
+    telemetry.clear_events()
+    with _recorder._lock:
+        _recorder._auto_dumps_left = dumps_prev
+
+
+def _mlp(seed=3, prefix=None):
+    np.random.seed(seed)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    return net
+
+
+def _compiled(seed=3, prefix=None):
+    net = _mlp(seed=seed, prefix=prefix)
+    tr = Trainer(net.collect_params(), "adam",
+                 {"learning_rate": 0.01}, kvstore=None)
+    return net, CompiledStep(net, L2Loss(), tr)
+
+
+def _batch(n=16):
+    x = np.random.RandomState(0).rand(n, 8).astype("float32")
+    y = np.random.RandomState(1).rand(n, 4).astype("float32")
+    return nd.array(x), nd.array(y)
+
+
+def _params_of(net):
+    return {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+
+def _assert_params_equal(a, b):
+    assert len(a) == len(b)
+    for ka, kb in zip(sorted(a), sorted(b)):
+        np.testing.assert_array_equal(a[ka], b[kb],
+                                      err_msg=f"{ka} vs {kb}")
+
+
+V = 53
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+    mx.random.seed(0)
+    np.random.seed(0)
+    m = LlamaForCausalLM(llama_tiny(vocab_size=V))
+    m.initialize(mx.init.Xavier())
+    return m
+
+
+def _prompt(seed, n=5):
+    return np.random.RandomState(seed).randint(0, V, n).astype("f4")
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: prob= / seed / ms= / new points
+# ---------------------------------------------------------------------------
+
+
+def test_fault_grammar_prob_seeded_replay():
+    # prob=1 fires every arrival, unlimited times by default
+    faults.configure("dispatch:prob=1")
+    for _ in range(4):
+        with pytest.raises(faults.FaultError):
+            faults.maybe_fire("dispatch")
+    assert faults.active()                      # never exhausts
+    # prob=0 never fires
+    faults.configure("dispatch:prob=0")
+    for _ in range(4):
+        faults.maybe_fire("dispatch")
+    assert faults.fired() == []
+
+    def pattern(seed):
+        faults.configure("dispatch:prob=0.5", seed=seed)
+        out = []
+        for _ in range(24):
+            try:
+                faults.maybe_fire("dispatch")
+                out.append(0)
+            except faults.FaultError:
+                out.append(1)
+        return out
+
+    a = pattern(7)
+    assert a == pattern(7)                      # deterministic replay
+    assert 0 < sum(a) < 24                      # actually probabilistic
+    assert a != pattern(8)                      # seed selects the plan
+    # prob composes with times (bounded probabilistic plan)
+    faults.configure("dispatch:prob=1,times=2")
+    hits = 0
+    for _ in range(5):
+        try:
+            faults.maybe_fire("dispatch")
+        except faults.FaultError:
+            hits += 1
+    assert hits == 2 and not faults.active()
+
+
+def test_fault_grammar_prob_env_seed(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SEED", "99")
+    faults.configure("dispatch:prob=0.5")
+    a = []
+    for _ in range(16):
+        try:
+            faults.maybe_fire("dispatch")
+            a.append(0)
+        except faults.FaultError:
+            a.append(1)
+    faults.configure("dispatch:prob=0.5")       # re-reads the env seed
+    b = []
+    for _ in range(16):
+        try:
+            faults.maybe_fire("dispatch")
+            b.append(0)
+        except faults.FaultError:
+            b.append(1)
+    assert a == b
+
+
+def test_fault_grammar_malformed_still_warns_never_bricks(monkeypatch):
+    with pytest.raises(ValueError, match="prob must be in"):
+        faults.configure("dispatch:prob=1.5")
+    with pytest.raises(ValueError, match="bad fault qualifier"):
+        faults.configure("dispatch:prob=abc")
+    monkeypatch.setenv("MXTPU_FAULT_INJECT", "dispatch:prob=nope")
+    with pytest.warns(RuntimeWarning, match="MXTPU_FAULT_INJECT"):
+        assert faults.configure_from_env() == 0
+    assert not faults.active()
+    # the new points parse without the unknown-point warning
+    assert faults.configure(
+        "dispatch_hang:ms=5;preempt_signal:nth=2") == 2
+    faults.clear()
+
+
+def test_dispatch_hang_point_sleeps_consumes_raises():
+    class FakeBuf:
+        deleted = False
+
+        def delete(self):
+            self.deleted = True
+
+    bufs = [FakeBuf(), FakeBuf()]
+    faults.configure("dispatch_hang:ms=40")
+    t0 = time.perf_counter()
+    with pytest.raises(faults.FaultError, match="dispatch_hang"):
+        faults.on_dispatch("op", bufs, donate=None)
+    assert time.perf_counter() - t0 >= 0.04     # it really hung
+    assert all(b.deleted for b in bufs)         # resolves post-donation
+    assert faults.fired() == ["dispatch_hang:ms=40"]
+
+
+def test_preempt_due_is_one_shot_and_counted():
+    faults.configure("preempt_signal")
+    assert faults.preempt_due("spmd_step") is True
+    assert faults.preempt_due("spmd_step") is False
+    assert faults.fired() == ["preempt_signal"]
+
+
+# ---------------------------------------------------------------------------
+# engine retry: decorrelated jitter + non-transient classification
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_decorrelated_jitter_bounds():
+    base = 50.0
+    prev = 0.0
+    seen = set()
+    for _ in range(200):
+        prev = engine._next_backoff_ms(base, prev)
+        assert base <= prev <= base * 32
+        seen.add(round(prev, 6))
+    assert len(seen) > 20                       # jittered, not a ladder
+    assert engine._next_backoff_ms(0.0, 10.0) == 0.0
+
+
+def test_retry_non_transient_fails_fast(monkeypatch):
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert not engine._retryable_error(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory"))
+    assert not engine._retryable_error(
+        RuntimeError("INVALID_ARGUMENT: incompatible shapes"))
+    assert engine._retryable_error(RuntimeError("socket reset"))
+    assert engine._retryable_error(
+        faults.FaultError("injected fault at 'dispatch'"))
+
+    monkeypatch.setenv("MXTPU_DISPATCH_RETRIES", "3")
+    monkeypatch.setenv("MXTPU_DISPATCH_BACKOFF_MS", "1")
+    calls = []
+
+    def oom():
+        calls.append(1)
+        raise XlaRuntimeError("RESOURCE_EXHAUSTED: oom")
+
+    with pytest.raises(XlaRuntimeError):
+        engine.retrying_call(oom, (), "op")
+    assert len(calls) == 1                      # 0 retries burned
+
+    flaky = []
+
+    def transient():
+        flaky.append(1)
+        if len(flaky) < 3:
+            raise RuntimeError("transient tunnel hiccup")
+        return 42
+
+    assert engine.retrying_call(transient, (), "op") == 42
+    assert len(flaky) == 3
+
+
+# ---------------------------------------------------------------------------
+# watchdog: hang -> dump -> recover matrix
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_warn_records_event_and_stacks(tmp_path):
+    x, y = _batch()
+    net, cs = _compiled(prefix="gwarn_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    cs.step(x, y, 16)
+    m.save()
+    h0 = telemetry.snapshot()["counters"].get("mxtpu_hangs_total", 0)
+    with guardian.Guardian(cs, m, timeout=0.05, action="warn") as g:
+        faults.configure("dispatch_hang:ms=250")
+        with pytest.raises(MXNetError, match="recover"):
+            cs.step(x, y, 16)
+        faults.clear()
+        # warn does NOT auto-recover: the poison latch still holds
+        assert cs._poisoned is not None
+        assert g.hangs == 1 and g.recovered == 0
+    ev = telemetry.events("hang_suspected")[-1]
+    assert ev["what"] == "compiled_step" and ev["action"] == "warn"
+    assert ev["stacks"] and any("step" in s for s in
+                                ev["stacks"].values())
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_hangs_total", 0) == h0 + 1
+    res = telemetry.events("hang_resolved")[-1]
+    assert res["poisoned"] is True and res["recovered"] is False
+    cs.recover(m)                               # manual cleanup path
+    assert cs._poisoned is None
+
+
+def test_watchdog_dump_writes_flight_artifact(tmp_path):
+    x, y = _batch()
+    net, cs = _compiled(prefix="gdump_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    cs.step(x, y, 16)
+    m.save()
+    with guardian.Guardian(cs, m, timeout=0.05, action="dump") as g:
+        faults.configure("dispatch_hang:ms=250")
+        with pytest.raises(MXNetError):
+            cs.step(x, y, 16)
+        faults.clear()
+        assert g.last and g.last["artifact"]
+        with open(g.last["artifact"]) as f:
+            artifact = json.load(f)
+        assert any(e["kind"] == "hang_suspected"
+                   for e in artifact["events"])
+    cs.recover(m)
+
+
+def test_watchdog_recover_compiled_step_parity(tmp_path):
+    """The acceptance shape: a hung dispatch becomes a RECOVERED step
+    — training continues bit-identical to an uninterrupted run."""
+    x, y = _batch()
+    net_a, cs_a = _compiled()
+    losses_a = [cs_a.step(x, y, 16).asnumpy() for _ in range(6)]
+
+    net_b, cs_b = _compiled()
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs_b,
+                          async_save=False)
+    losses_b = [cs_b.step(x, y, 16).asnumpy() for _ in range(3)]
+    m.save()
+    with guardian.Guardian(cs_b, m, timeout=0.05,
+                           action="recover") as g:
+        faults.configure("dispatch_hang:ms=250")
+        with pytest.raises(MXNetError):
+            cs_b.step(x, y, 16)
+        faults.clear()
+        # the guardian recovered the owner ON the heartbeat's exit:
+        # no manual recover() needed, the next step just trains
+        assert cs_b._poisoned is None
+        assert g.recovered == 1
+        losses_b += [cs_b.step(x, y, 16).asnumpy() for _ in range(3)]
+    for a, b in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(a, b)
+    _assert_params_equal(_params_of(net_a), _params_of(net_b))
+    res = telemetry.events("hang_resolved")[-1]
+    assert res["recovered"] is True and res["restored_step"] == 3
+    # the answer ORDER MXL504 relies on: suspected < resolved/recovery
+    sus = telemetry.events("hang_suspected")[-1]
+    assert sus["seq"] < res["seq"]
+    assert sus["seq"] < telemetry.events("recovery")[-1]["seq"]
+
+
+@pytest.fixture
+def mesh8():
+    from conftest import needs_devices
+    needs_devices(8)
+    return parallel.make_mesh({"dp": 8})
+
+
+def test_watchdog_recover_spmd_parity(mesh8, tmp_path):
+    """Same matrix on the SPMD stack: hang -> hang_suspected ->
+    auto-recover -> bit-identical continuation."""
+    x, y = _batch()
+    mx.random.seed(11)
+    net_a = _mlp(seed=7)
+    dpt_a = parallel.DataParallelTrainer(
+        net_a, L2Loss(), "adam", {"learning_rate": 0.01}, mesh=mesh8,
+        fuse_step=True)
+    losses_a = [dpt_a.step(x, y).asnumpy() for _ in range(6)]
+
+    mx.random.seed(11)
+    net_b = _mlp(seed=7)
+    dpt_b = parallel.DataParallelTrainer(
+        net_b, L2Loss(), "adam", {"learning_rate": 0.01}, mesh=mesh8,
+        fuse_step=True)
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=dpt_b,
+                          async_save=False)
+    losses_b = [dpt_b.step(x, y).asnumpy() for _ in range(3)]
+    m.save()
+    with guardian.Guardian(dpt_b, m, timeout=0.05,
+                           action="recover") as g:
+        faults.configure("dispatch_hang:ms=250")
+        with pytest.raises(MXNetError):
+            dpt_b.step(x, y)
+        faults.clear()
+        assert dpt_b._donation_poisoned is None
+        assert g.hangs == 1 and g.recovered == 1
+        losses_b += [dpt_b.step(x, y).asnumpy() for _ in range(3)]
+    for a, b in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(a, b)
+    assert telemetry.events("hang_suspected")[-1]["what"] == \
+        "spmd_step"
+
+
+def test_watchdog_no_false_positive(tmp_path):
+    x, y = _batch()
+    net, cs = _compiled(prefix="gok_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    before = len(telemetry.events("hang_suspected"))
+    with guardian.Guardian(cs, m, timeout=5.0, action="recover") as g:
+        for _ in range(4):
+            cs.step(x, y, 16)
+        assert guardian.inflight() == []        # brackets all closed
+    assert g.hangs == 0
+    assert len(telemetry.events("hang_suspected")) == before
+
+
+def test_watchdog_serving_dispatch_hang_recovers(lm):
+    """The serving dispatch bracket feeds the same watchdog: a hung
+    decode poisons the pool, the Guardian's recover escalation runs
+    Server.recover(), and the resident requests replay exactly."""
+    from mxnet_tpu.serving import Server
+    ref = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    want = ref.generate([_prompt(1), _prompt(2)])
+
+    srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    reqs = [srv.submit(_prompt(1)), srv.submit(_prompt(2))]
+    srv.step()                                  # residents admitted
+    with guardian.Guardian(srv, timeout=0.05, action="recover") as g:
+        faults.configure("dispatch_hang:ms=250")
+        with pytest.raises(MXNetError, match="recover"):
+            srv.step()
+        faults.clear()
+        assert srv._poisoned is None            # auto-recovered
+        assert g.recovered == 1
+        srv.run()                               # replay to completion
+    for r, w in zip(reqs, want):
+        np.testing.assert_array_equal(r.tokens(), w)
+    assert telemetry.events("hang_suspected")[-1]["what"] == \
+        "serving_dispatch"
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe drain
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drain_commits_and_requeues(lm, tmp_path):
+    """In-process os.kill(SIGTERM): the drain finishes the step,
+    commits a RESTORABLE checkpoint within the deadline, requeues
+    serving residents with state, emits the retained event, and would
+    exit 0."""
+    from mxnet_tpu.serving import Server
+    x, y = _batch()
+    net, cs = _compiled(prefix="pre_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    for _ in range(3):
+        cs.step(x, y, 16)
+    srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    reqs = [srv.submit(_prompt(11)), srv.submit(_prompt(12)),
+            srv.submit(_prompt(13))]
+    srv.step()                 # 2 residents mid-flight, 1 queued
+    p0 = telemetry.snapshot()["counters"].get(
+        "mxtpu_preemptions_total", 0)
+
+    guard = guardian.PreemptionGuard(manager=m, server=srv,
+                                     deadline_s=20.0,
+                                     exit_process=False)
+    guard.install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)       # handler runs at a bytecode boundary
+        assert guard.exit_code == 0
+    finally:
+        guard.uninstall()
+    rec = guard.drained
+    assert rec["committed_step"] == 3 and rec["deadline_ok"]
+    # 2 residents requeued-with-state on top of the 1 still queued
+    assert rec["requeued"] == 2 and rec["queued"] == 1
+    with open(rec["drain_manifest"]) as f:
+        assert len(json.load(f)["requests"]) == 3
+    ev = telemetry.events("preempted")[-1]
+    assert ev["ok"] and ev["committed_step"] == 3
+    snap = telemetry.snapshot()
+    assert snap["counters"]["mxtpu_preemptions_total"] == p0 + 1
+    assert snap["histograms"]["mxtpu_drain_seconds"]["count"] >= 1
+
+    # checkpoint restores bit-exact into a fresh trainer
+    net2, cs2 = _compiled(prefix="pre2_")
+    m.restore(into=cs2)
+    _assert_params_equal(_params_of(net), _params_of(net2))
+
+    # serving residents were requeued WITH state: the in-process
+    # continuation replays them token-exact vs an undisturbed server
+    srv.run()
+    from mxnet_tpu.serving import Server as _S
+    ref = _S(lm, buckets=[(2, 8)], max_new_tokens=4)
+    want = ref.generate([_prompt(11), _prompt(12), _prompt(13)])
+    for r, w in zip(reqs, want):
+        np.testing.assert_array_equal(r.tokens(), w)
+
+    # ...and the drain manifest replays into a FRESH server (the
+    # restarted-process leg)
+    manifest = rec["drain_manifest"]
+    assert os.path.exists(manifest)
+    srv3 = _S(lm, buckets=[(2, 8)], max_new_tokens=4)
+    reqs3 = guardian.restore_drained_requests(srv3, manifest)
+    assert len(reqs3) == 3
+    srv3.run()
+    for r, w in zip(reqs3, want):
+        np.testing.assert_array_equal(r.tokens(), w)
+
+
+def test_double_signal_forces_exit_with_forensics(tmp_path):
+    x, y = _batch()
+    net, cs = _compiled(prefix="dbl_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    cs.step(x, y, 16)
+    guard = guardian.PreemptionGuard(manager=m, exit_process=False)
+    guard.install()
+    try:
+        guard._draining = True                  # first signal landed
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)
+        assert guard.exit_code == 1
+    finally:
+        guard.uninstall()
+    ev = telemetry.events("preempt_forced")[-1]
+    assert ev["signal"] == int(signal.SIGTERM) and ev["stacks"]
+
+
+def test_preempt_signal_fault_point_drives_real_drain(tmp_path):
+    """The drill delivers a REAL SIGTERM from the heartbeat seam: the
+    installed guard drains through the actual signal path, then the
+    step continues."""
+    x, y = _batch()
+    net, cs = _compiled(prefix="drl_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    for _ in range(2):
+        cs.step(x, y, 16)
+    guard = guardian.PreemptionGuard(manager=m, exit_process=False)
+    guard.install()
+    try:
+        faults.configure("preempt_signal")
+        cs.step(x, y, 16)                       # drill fires here
+        faults.clear()
+        assert guard.exit_code == 0
+        assert guard.drained["committed_step"] == 2
+    finally:
+        guard.uninstall()
+    assert m.latest_step() == 2
+    ev = telemetry.events("fault_injected")[-1]
+    assert ev["point"] == "preempt_signal"
+
+
+def test_preemption_guard_requires_a_target():
+    with pytest.raises(MXNetError, match="manager and/or"):
+        guardian.PreemptionGuard()
+
+
+# ---------------------------------------------------------------------------
+# serving overload policy
+# ---------------------------------------------------------------------------
+
+
+def test_overload_flood_sheds_and_bounds_queue(lm):
+    """10x flood with ttl: the plane sheds at enqueue (counted,
+    retained events) instead of growing the queue, admitted requests
+    still complete, and the TTFT histogram keeps recording for the
+    admitted population."""
+    from mxnet_tpu.serving import Server
+    srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4,
+                 max_queue=256)
+    srv.generate([_prompt(i) for i in range(3)])   # decode history
+    s0 = telemetry.snapshot()["counters"].get(
+        "mxtpu_requests_shed_total", 0)
+    admitted = []
+    shed = 0
+    for i in range(20):                            # 10x the 2 slots
+        try:
+            admitted.append(srv.submit(_prompt(100 + i), ttl_ms=30.0))
+        except MXNetError as e:
+            assert "shed" in str(e)
+            shed += 1
+    assert shed > 0
+    assert srv.sched.queue_depth() <= 20 - shed
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_requests_shed_total", 0) == s0 + shed
+    ev = telemetry.events("shed")[-1]
+    assert ev["server"] == srv.name and ev["est_wait_s"] > 0
+    srv.run()                                      # drains: bounded
+    assert srv.sched.queue_depth() == 0
+    for r in admitted:
+        assert r.state in ("done", "evicted")
+    done = [r for r in admitted if r.state == "done"]
+    for r in done:
+        assert r.first_token_t is not None         # TTFT recorded
+
+
+def test_overload_deadline_eviction_queue_and_slot(lm):
+    from mxnet_tpu.serving import Server
+    telemetry.reset()      # drop decode history: admission, not shed,
+    srv = Server(lm, buckets=[(1, 8)], max_new_tokens=4,
+                 max_queue=64)
+    # a resident whose deadline expires IN its slot, and a queued
+    # request that expires waiting behind it (both submitted before
+    # admission, while the plane is idle — the estimator admits both)
+    r_slot = srv.submit(_prompt(30), ttl_ms=60.0)
+    r_q = srv.submit(_prompt(31), ttl_ms=60.0)
+    srv.step()                                     # 1 slot: r_q waits
+    assert r_slot.state == "active" and r_q.state == "queued"
+    d0 = telemetry.snapshot()["counters"].get(
+        "mxtpu_deadline_evictions_total", 0)
+    time.sleep(0.08)
+    srv.step()                                     # expiry sweep
+    assert r_slot.state == "evicted"
+    assert r_slot.evict_reason == "deadline"
+    assert r_q.state == "evicted"
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_deadline_evictions_total", 0) == d0 + 2
+    evs = telemetry.events("deadline_evicted")
+    assert {e["request"] for e in evs[-2:]} == {r_slot.id, r_q.id}
+    assert all(e["waited_s"] > 0 for e in evs[-2:])
+    # the standard audit trail rode along
+    assert any(e["request"] == r_slot.id and e["reason"] == "deadline"
+               for e in telemetry.events("request_evicted"))
+
+
+def test_overload_no_history_never_sheds(lm):
+    from mxnet_tpu.serving import Server, server as server_mod
+    server_mod._reset_registry()
+    telemetry.reset()                              # forget history
+    srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    assert srv.estimate_queue_wait() in (0.0, None)
+    r = srv.submit(_prompt(40), ttl_ms=10_000.0)   # admitted, no shed
+    assert r.state == "queued"
+    srv.run()
+    assert r.state == "done"
+    assert telemetry.snapshot()["counters"].get(
+        "mxtpu_requests_shed_total", 0) == 0
+
+
+def test_ttl_validation():
+    from mxnet_tpu.serving import Request
+    with pytest.raises(MXNetError, match="ttl_ms"):
+        Request(np.ones(4), 4, ttl_ms=0)
+    r = Request(np.ones(4), 4, ttl_ms=50)
+    assert r.deadline is not None and not r.expired(r.submit_t)
+    assert r.expired(r.submit_t + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the new incident kinds survive dispatch floods
+# ---------------------------------------------------------------------------
+
+
+def test_incident_events_survive_dispatch_flood():
+    telemetry.reset()
+    telemetry.record_event("hang_suspected", owner="o", what="w",
+                           seconds=1.0)
+    telemetry.record_event("preempted", ok=True, committed_step=5)
+    telemetry.record_event("shed", server="s", request=1)
+    telemetry.record_event("deadline_evicted", server="s", request=2)
+    # recovery is the event that ANSWERS a hang/poison in the MXL504
+    # audit — it must survive the same flood as the incident it heals
+    telemetry.record_event("recovery", where="compiled_step", step=1,
+                           seconds=0.1, poisoned=True)
+    for _ in range(1200):
+        telemetry.record_event("dispatch", op="x")
+    for kind in ("hang_suspected", "preempted", "shed",
+                 "deadline_evicted", "recovery"):
+        assert telemetry.events(kind), f"{kind} evicted by the flood"
+    # ...so MXL504 still sees the hang as answered after the flood
+    from mxnet_tpu.analysis import analyze_elasticity
+    assert not [f for f in analyze_elasticity()
+                if f.rule == "MXL504"]
+
+
+def test_heartbeat_survives_mid_step_uninstall(tmp_path):
+    """Tearing the guardian plane down while a bracket is open must
+    still clear that bracket's in-flight record at exit (the
+    entry-time hook, not the rebound global) — a leaked record would
+    false-flag the next Guardian's first scan as an ancient hang."""
+    x, y = _batch()
+    net, cs = _compiled(prefix="glk_")
+    m = CheckpointManager(str(tmp_path / "ck"), trainer=cs,
+                          async_save=False)
+    g = guardian.Guardian(cs, m, timeout=5.0, action="warn").start()
+    bracket = telemetry.step_owner(cs, "compiled_step")
+    bracket.__enter__()
+    assert len(guardian.inflight()) == 1
+    g.stop()                      # plane torn down mid-step
+    bracket.__exit__(None, None, None)
+    assert guardian.inflight() == []
+
+
+# ---------------------------------------------------------------------------
+# chaos-soak certifier
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_schedule_seeded_and_covering():
+    s1 = chaos.Schedule(seed=5, steps=200, n_faults=8)
+    s2 = chaos.Schedule(seed=5, steps=200, n_faults=8)
+    assert s1.to_dict() == s2.to_dict()            # deterministic
+    assert len(s1.entries) == 8
+    assert s1.distinct_points() >= 6
+    assert s1.resize_at == 100 and s1.flood_at == 150
+    assert chaos.Schedule(seed=6, steps=200).to_dict() != s1.to_dict()
+    assert "chaos plan" in s1.describe()
+    with pytest.raises(MXNetError, match=">= 20 steps"):
+        chaos.Schedule(seed=1, steps=5)
+
+
+def test_chaos_soak_200_steps_all_invariants(tmp_path):
+    """THE acceptance criterion: a seeded 200-step soak — >= 8 faults
+    over >= 6 distinct points, train + serve + one resize + the flood
+    stage — completes with committed-step monotonicity, fp32-exact
+    params vs the unfaulted reference, 0 post-warm fresh compiles,
+    and no unrecovered poison."""
+    art = chaos.soak(steps=200, seed=12, out_dir=str(tmp_path))
+    assert art["ok"], art["violations"]
+    assert art["n_faults"] >= 8
+    assert art["distinct_points"] >= 6
+    assert art["n_recoveries"] >= 1
+    assert art["resize"] is not None
+    assert art["resize"]["slots_to"] == 4
+    assert art["flood"] is not None and art["flood"]["shed"] > 0
+    for name in ("committed_monotonic", "params_exact",
+                 "zero_fresh_compiles", "no_unrecovered_poison",
+                 "no_leaked_buffers"):
+        assert art["invariants"][name]["ok"], art["invariants"][name]
+    # replay determinism: the artifact's plan IS the seed's plan
+    assert chaos.Schedule(seed=12, steps=200).to_dict() == art["plan"]
+    # artifact written + registered for the MXL504 audit
+    assert os.path.exists(art["artifact_path"])
+    assert chaos.artifacts()[-1]["seed"] == 12
+    assert "ALL INVARIANTS HELD" in chaos.render(art)
+
+
+# ---------------------------------------------------------------------------
+# MXL504 + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_mxl504_matrix():
+    from mxnet_tpu.analysis import analyze_elasticity, self_check
+    telemetry.reset()
+    # fresh process: quiet
+    assert not [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    # an unanswered hang is a finding...
+    telemetry.record_event("hang_suspected", owner="o", what="step",
+                           seconds=2.0)
+    found = [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    assert len(found) == 1 and found[0].severity == "warning"
+    # ...rides self_check...
+    findings, ok = self_check()
+    assert any(f.rule == "MXL504" for f in findings)
+    assert ok                                     # warning: no gate trip
+    # ...and a later recovery answers it
+    telemetry.record_event("recovery", where="compiled_step", step=1,
+                           seconds=0.1, poisoned=True)
+    assert not [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    # a clean hang_resolved also answers (warn-action slow step)
+    telemetry.reset()
+    telemetry.record_event("hang_suspected", owner="o", what="step",
+                           seconds=2.0)
+    telemetry.record_event("hang_resolved", owner="o", what="step",
+                           seconds=2.5, recovered=False, error=None)
+    assert not [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    # a preemption that committed nothing is a finding
+    telemetry.record_event("preempted", ok=True, committed_step=None)
+    assert [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    telemetry.reset()
+    # a violated soak artifact is an ERROR (fails the self_check gate)
+    chaos._register({
+        "kind": "mxtpu_chaos_soak", "ok": False, "seed": 9,
+        "steps": 10,
+        "violations": [{"invariant": "params_exact", "detail": "x"}]})
+    bad = [f for f in analyze_elasticity() if f.rule == "MXL504"]
+    assert bad and bad[0].severity == "error"
+    _findings, ok = self_check()
+    assert not ok
+    chaos._reset()
+
+
+def test_mxsoak_cli(tmp_path):
+    from tools import mxsoak
+    rc = mxsoak.main(["run", "--seed", "3", "--steps", "30",
+                      "--out", str(tmp_path)])
+    assert rc == 0
+    artifact = str(tmp_path / "soak-3.json")
+    assert os.path.exists(artifact)
+    assert mxsoak.main(["render", artifact]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"not": "a soak"}')
+    assert mxsoak.main(["render", str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# env registry + docs
+# ---------------------------------------------------------------------------
+
+
+def test_env_registry_and_docs():
+    from mxnet_tpu import envs
+    reg = envs.registry()
+    assert reg["MXTPU_WATCHDOG_TIMEOUT"].default == 300.0
+    assert reg["MXTPU_WATCHDOG_ACTION"].default == "dump"
+    assert reg["MXTPU_DRAIN_DEADLINE_S"].default == 30.0
+    assert reg["MXTPU_FAULT_SEED"].default == 0
+    assert "prob=P" in reg["MXTPU_FAULT_INJECT"].doc
+    doc = open(os.path.join(os.path.dirname(__file__), "..",
+                            "docs", "env_vars.md")).read()
+    for name in ("MXTPU_WATCHDOG_TIMEOUT", "MXTPU_WATCHDOG_ACTION",
+                 "MXTPU_DRAIN_DEADLINE_S", "MXTPU_FAULT_SEED"):
+        assert f"`{name}`" in doc, f"{name} missing from env_vars.md"
+
+
+def test_guardian_arg_validation(tmp_path):
+    x, y = _batch()
+    net, cs = _compiled(prefix="gval_")
+    with pytest.raises(MXNetError, match="timeout"):
+        guardian.Guardian(cs, None, timeout=0)
+    with pytest.raises(MXNetError, match="warn|dump|recover"):
+        guardian.Guardian(cs, None, timeout=1, action="explode")

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""mxsoak: run and render the seeded chaos-soak certifier.
+
+``elastic.chaos`` (docs/elasticity.md, "Guardian & chaos soak") runs a
+real train + serve + resize workload under a SEEDED random fault plan
+and checks the recovery invariants after every transition — committed-
+step monotonicity, fp32-exact params vs an unfaulted reference, zero
+fresh compiles once warmed, no unrecovered poison, no leaked live
+buffers.  This tool is its CLI face:
+
+    python tools/mxsoak.py run --seed 12 --steps 200
+        # print the plan, run the soak, print the invariant verdicts;
+        # exit 1 on any violation
+    python tools/mxsoak.py run --seed 12 --steps 200 --out DIR
+        # also write DIR/soak-12.json (the replayable artifact)
+    python tools/mxsoak.py run --seed 12 --self-check
+        # additionally run the mxlint MXL504 audit over the recorded
+        # events + artifact registry; exit 1 on any finding
+    python tools/mxsoak.py render DIR/soak-12.json
+        # replay a saved artifact as the same report (exit 1 when
+        # malformed)
+
+The same seed replays the same fault plan exactly
+(``MXTPU_FAULT_SEED`` is the default seed source), so a failing soak
+in CI is reproducible locally with one flag.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def cmd_run(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.elastic import chaos
+    sched = chaos.Schedule(seed=args.seed, steps=args.steps,
+                           n_faults=args.faults)
+    print(sched.describe())
+    print()
+    artifact = chaos.soak(schedule=sched, out_dir=args.out,
+                          progress=(print if args.verbose else None))
+    print(chaos.render(artifact))
+    if artifact.get("artifact_path"):
+        print(f"artifact: {artifact['artifact_path']}")
+    rc = 0 if artifact.get("ok") else 1
+    if args.self_check:
+        from mxnet_tpu.analysis import analyze_elasticity
+        bad = [f for f in analyze_elasticity() if f.rule == "MXL504"]
+        for f in bad:
+            print(f.format(), file=sys.stderr)
+        if bad:
+            rc = 1
+    return rc
+
+
+def cmd_render(args) -> int:
+    # no backend pin, no jax import: render is pure JSON -> text
+    from mxnet_tpu.elastic import chaos
+    try:
+        with open(args.artifact) as f:
+            artifact = json.load(f)
+        print(chaos.render(artifact))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"mxsoak: malformed artifact {args.artifact!r}: {e!r}",
+              file=sys.stderr)
+        return 1
+    return 0 if artifact.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxsoak", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("run", help="run a seeded chaos soak")
+    p.add_argument("--seed", type=int, default=None,
+                   help="fault-plan seed (default MXTPU_FAULT_SEED)")
+    p.add_argument("--steps", type=int, default=200,
+                   help="target optimizer steps (default 200)")
+    p.add_argument("--faults", type=int, default=8,
+                   help="faults in the plan (default 8)")
+    p.add_argument("--out", default=None,
+                   help="directory for the soak-<seed>.json artifact")
+    p.add_argument("--verbose", action="store_true",
+                   help="narrate transitions as they happen")
+    p.add_argument("--self-check", action="store_true",
+                   dest="self_check",
+                   help="also fail on any mxlint MXL504 finding")
+    p.set_defaults(fn=cmd_run)
+    p = sub.add_parser("render", help="replay a saved soak artifact")
+    p.add_argument("artifact")
+    p.set_defaults(fn=cmd_render)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
