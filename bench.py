@@ -1240,6 +1240,92 @@ def bench_resize(sub_budget=180):
     return json.loads(line)
 
 
+_PLANNER_CHILD = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.elastic import reshard
+from mxnet_tpu.models import llama_tiny
+
+np.random.seed(0); mx.random.seed(0)
+net = llama_tiny()
+net.initialize(mx.init.Xavier())
+net(nd.array(np.zeros((1, 8), np.int32)))
+params = list(net.collect_params().values())
+named = [(p.name, tuple(int(d) for d in p.data().shape))
+         for p in params]
+
+plan_a = parallel.ShardingPlan({"dp": 8}, [(r".", ())])
+plan_b = parallel.ShardingPlan({"dp": 4, "tp": 2},
+                               parallel.megatron_rules())
+t0 = time.perf_counter()
+for _ in range(100):
+    res = plan_b.resolve(named)
+resolve_s = (time.perf_counter() - t0) / 100
+
+# place under plan A, then the measured plan->plan move (the one-
+# program redistribute when device sets coincide; dp8 and dp4x2 both
+# cover all 8 devices)
+named_arrays = [(p.name, p.data()._data) for p in params]
+placed = reshard.redistribute_plan(named_arrays, plan_a)
+before = [np.asarray(a) for a in placed]
+moves = reshard.plan_moves(named, plan_a, plan_b)
+bytes_moved = sum(r["nbytes"] for r in moves.values())
+src = list(zip([n for n, _a in named_arrays], placed))
+t0 = time.perf_counter()
+moved = reshard.redistribute_plan(src, plan_b)
+for a in moved:
+    a.block_until_ready()
+reshard_s = time.perf_counter() - t0
+exact = all(np.array_equal(b, np.asarray(a))
+            for b, a in zip(before, moved))
+out = {
+    "params": len(named),
+    "resolve_seconds": round(resolve_s, 6),
+    "plan_from": "dp8", "plan_to": "dp4xtp2",
+    "reshard_seconds": round(reshard_s, 4),
+    "reshard_bytes_moved": int(bytes_moved),
+    "reshard_params_moved": len(moves),
+    "fp32_exact": bool(exact),
+}
+print(json.dumps(out))
+"""
+
+
+def bench_planner(sub_budget=180):
+    """Sharding-planner evidence on the 8-device CPU mesh (ISSUE 13
+    acceptance: measured, not asserted): regex-rule resolution time
+    over the llama_tiny param tree, and a measured dp8 -> dp4 x tp2
+    plan-to-plan redistribution — wall seconds, bytes moved (from the
+    reshard move plan), and an fp32-exactness check of the round
+    trip.  A child process for the same reason as ``bench_zero``: the
+    8-device virtual mesh needs ``xla_force_host_platform_device_
+    count`` before jax imports."""
+    env = dict(os.environ)
+    env.pop("MXTPU_SHARDING_PLAN", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _PLANNER_CHILD],
+        capture_output=True, text=True, timeout=sub_budget, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    line = None
+    for ln in res.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if not line:
+        sys.stderr.write(res.stderr[-2000:])
+        raise RuntimeError(
+            f"planner bench child produced no JSON "
+            f"(rc={res.returncode})")
+    return json.loads(line)
+
+
 def _run_cpu_smoke_subprocess(sub_budget=240):
     """Run the degraded CPU smoke in a CHILD bench.py (so this process
     stays jax-free and can still take the chip path if a window opens
@@ -1421,6 +1507,23 @@ def main():
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 _record("resize", error=repr(e))
+            # sharding-planner evidence (docs/parallelism.md "The
+            # sharding planner"): rule-resolution time over a real
+            # param tree, and a measured plan->plan reshard (dp8 ->
+            # dp4 x tp2) on the 8-device child mesh — seconds + bytes
+            # moved from the reshard move plan
+            try:
+                pblock = bench_planner()
+                tblock["planner"] = pblock
+                _record("planner", **pblock)
+                _log(f"planner: resolve {pblock['resolve_seconds']}s "
+                     f"/{pblock['params']} params, reshard "
+                     f"{pblock['reshard_seconds']}s "
+                     f"({pblock['reshard_bytes_moved']} B moved, "
+                     f"fp32_exact={pblock['fp32_exact']})")
+            except Exception as e:
+                traceback.print_exc(file=sys.stderr)
+                _record("planner", error=repr(e))
             # the telemetry block rides EVERY subsequently-emitted
             # result line (stage 2 overwrites the metric, not this),
             # so the trajectory files capture dispatch/retrace/stall

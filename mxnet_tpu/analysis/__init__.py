@@ -22,8 +22,9 @@ from .registry_passes import analyze_registry, analyze_opdef
 from .source_passes import analyze_source, analyze_file, analyze_paths
 from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_telemetry, analyze_compile_cache,
-                      analyze_memory, analyze_elasticity,
-                      analyze_health, analyze_serving)
+                      analyze_memory, analyze_parallel,
+                      analyze_elasticity, analyze_health,
+                      analyze_serving)
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -33,8 +34,8 @@ __all__ = [
     "analyze_registry", "analyze_opdef",
     "analyze_source", "analyze_file", "analyze_paths",
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
-    "analyze_compile_cache", "analyze_memory", "analyze_elasticity",
-    "analyze_health", "analyze_serving",
+    "analyze_compile_cache", "analyze_memory", "analyze_parallel",
+    "analyze_elasticity", "analyze_health", "analyze_serving",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -60,9 +61,10 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # dir must fail CI loudly, not surface as silent fresh compiles at
     # dispatch time (quiet when MXTPU_COMPILE_CACHE_DIR is unset)
     findings.extend(analyze_compile_cache())
-    # memory-observatory pass (MXL308/309): quiet in a fresh CI
-    # process; after an in-process workload it surfaces non-donated
-    # updated buffers and large replicated tensors
+    # memory-observatory pass (MXL308/309, and the planner's MXL313
+    # coverage audit riding inside analyze_memory): quiet in a fresh
+    # CI process; after an in-process workload it surfaces non-donated
+    # updated buffers, large replicated tensors, and mis-covered plans
     findings.extend(analyze_memory())
     # elasticity pass (MXL501 runtime form / MXL502): quiet in a fresh
     # process; after an in-process workload it surfaces long

@@ -81,6 +81,13 @@ RULES = {
     "MXL312": (Severity.WARNING,
                "training-health anomalies recorded in this process "
                "(divergence risk; runtime sibling of MXL311)"),
+    "MXL313": (Severity.WARNING,
+               "sharding-plan coverage hazard: a trainable param no "
+               "rule matches (silent replication), a rule shadowed by "
+               "an earlier regex, a big tensor the resolved plan "
+               "fully replicates on a multi-device mesh, or a rule "
+               "demoted because a sharded dim does not divide its "
+               "axis fan-out"),
     # -- runtime passes (MXL4xx) ----------------------------------------
     "MXL401": (Severity.WARNING, "jit-cache key blowup for one op"),
     "MXL402": (Severity.ERROR,

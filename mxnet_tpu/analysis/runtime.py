@@ -25,8 +25,8 @@ from .findings import Finding
 
 __all__ = ["analyze_cache", "analyze_compiled_steps",
            "analyze_telemetry", "analyze_compile_cache",
-           "analyze_memory", "analyze_elasticity", "analyze_health",
-           "analyze_serving"]
+           "analyze_memory", "analyze_parallel", "analyze_elasticity",
+           "analyze_health", "analyze_serving"]
 
 
 def analyze_cache(threshold: int = 8) -> List[Finding]:
@@ -176,6 +176,10 @@ def analyze_memory(large_buffer_bytes: int = 8 << 20,
                     f"{tree['mesh_size']}x the HBM for one tensor; "
                     "give it a param_sharding rule",
                     f"memory:{tname}:{row['name']}"))
+    # the planner's rule-level coverage audit (MXL313) rides along:
+    # analyze_memory is the HBM-hazard surface and a mis-covered plan
+    # is exactly an HBM hazard with a named culprit
+    findings.extend(analyze_parallel())
     from .. import envs
     if int(envs.get("MXTPU_ZERO_STAGE")) >= 1:
         for tname, tree in sorted(mem.opt_state_trees().items()):
@@ -196,6 +200,85 @@ def analyze_memory(large_buffer_bytes: int = 8 << 20,
                     "each member burns the full state HBM the env "
                     "var promised to shard (docs/zero.md)",
                     f"memory:{tname}:opt_state"))
+    return findings
+
+
+def analyze_parallel(big_bytes: int = 64 << 20,
+                     plan=None, named_shapes=None,
+                     owner: str = "plan") -> List[Finding]:
+    """MXL313 — sharding-plan coverage audit (docs/parallelism.md,
+    "Coverage lint"): the rule-level successor of the MXL309/310
+    symptom checks.  For every registered live plan
+    (``parallel.planner.plans()`` — trainers/servers register at
+    setup), or an explicit ``(plan, named_shapes)`` pair (the
+    ``tools/mxplan.py lint`` entry point):
+
+    * a trainable param matched by NO rule — it replicates silently,
+      which is the failure mode a declarative plan exists to kill
+      (default rule sets end with an explicit catch-all);
+    * an UNREACHABLE rule: some param's name matches its regex, but an
+      earlier rule claims every such param — dead weight that usually
+      means a rule-ordering bug;
+    * a tensor of at least ``big_bytes`` the resolved plan fully
+      replicates on a >1-device mesh — the MXL309/310 symptom, now
+      with the responsible rule ATTRIBUTED in the message.
+
+    Free in a fresh process (empty registry), so the ``--self-check``
+    CI gate stays quiet.
+    """
+    from ..parallel import planner as _planner
+    entries = {}
+    if plan is not None:
+        entries[str(owner)] = {
+            "plan": plan, "named_shapes": list(named_shapes or ()),
+            "dtype_bytes": 4}
+    else:
+        entries = _planner.plans()
+    findings: List[Finding] = []
+    for name, rec in sorted(entries.items()):
+        p = rec["plan"]
+        cov = p.coverage(rec["named_shapes"],
+                         dtype_bytes=rec.get("dtype_bytes", 4),
+                         big_bytes=big_bytes)
+        for pname, shape, nbytes in cov["uncovered"]:
+            findings.append(Finding(
+                "MXL313",
+                f"{name}: param {pname!r} (shape {list(shape)}, "
+                f"{nbytes} bytes) matches NO plan rule and replicates "
+                "silently; add a rule (or end the rule list with an "
+                "explicit catch-all) so every layout decision is "
+                "deliberate",
+                f"plan:{name}:{pname}"))
+        for idx, pattern, first in cov["shadowed"]:
+            findings.append(Finding(
+                "MXL313",
+                f"{name}: rule #{idx} ({pattern!r}) is unreachable — "
+                f"every param it matches is claimed by an earlier "
+                f"rule (first: #{first} "
+                f"{p.rules[first][0]!r}); reorder or delete it",
+                f"plan:{name}:rule{idx}"))
+        for pname, nbytes, idx in cov["replicated_big"]:
+            culprit = "no rule matched" if idx is None else \
+                f"rule #{idx} ({p.rules[idx][0]!r} -> " \
+                f"{p.rules[idx][1]})"
+            findings.append(Finding(
+                "MXL313",
+                f"{name}: param {pname!r} ({nbytes} bytes) is fully "
+                f"replicated across the {p.n_devices}-device mesh by "
+                f"the resolved plan ({culprit}) — "
+                f"{p.n_devices}x the HBM for one tensor; give it a "
+                "sharding rule",
+                f"plan:{name}:{pname}"))
+        for pname, shape, idx in cov["demoted"]:
+            findings.append(Finding(
+                "MXL313",
+                f"{name}: rule #{idx} ({p.rules[idx][0]!r} -> "
+                f"{p.rules[idx][1]}) wants a sharding param "
+                f"{pname!r} (shape {list(shape)}) cannot honor — a "
+                "sharded dim does not divide the axis fan-out, so the "
+                "param silently replicated instead; pad the dim or "
+                "fix the rule",
+                f"plan:{name}:{pname}"))
     return findings
 
 

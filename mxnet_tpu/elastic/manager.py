@@ -512,6 +512,10 @@ class CheckpointManager:
             # the ZeRO layout pin (docs/zero.md): restore converts the
             # sharded state rows to the target trainer's layout
             "zero": payload.get("zero"),
+            # the sharding-plan pin (docs/parallelism.md): the
+            # canonical plan this checkpoint was saved under — the
+            # audit trail a cross-plan restore's reshard report reads
+            "plan": payload.get("plan"),
             "rng": payload["rng"],
             "shards": shards,
         }
@@ -593,6 +597,7 @@ class CheckpointManager:
             "dp_axis": manifest.get("dp_axis"),
             "persist_name": manifest.get("persist_name"),
             "zero": manifest.get("zero"),
+            "plan": manifest.get("plan"),
             "params": [], "states": [], "residuals": [],
         }
         for rec, host in zip(manifest["shards"], arrays):

@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..base import MXNetError
 
 __all__ = ["spec_from_str", "spec_to_str", "plan", "place",
-           "redistribute"]
+           "redistribute", "plan_moves", "redistribute_plan"]
 
 
 def spec_to_str(spec) -> str:
@@ -171,3 +171,46 @@ def redistribute(arrays, target_shardings):
             if any(_dead(a) for a in arrays):
                 raise
     return [jax.device_put(a, s) for a, s in zip(arrays, targets)]
+
+
+# -- plan-to-plan redistribution (docs/parallelism.md, reshard matrix) ------
+
+def plan_moves(named_shapes, plan_src, plan_dst,
+               dtype_bytes: int = 4) -> Dict[str, dict]:
+    """The per-param move report of a ``plan_src -> plan_dst``
+    redistribution: ``{name: {"moves": [...], "nbytes": int}}`` for
+    every param whose layout actually changes (``moves`` from
+    :func:`plan`; ``nbytes`` is the GLOBAL tensor size — the upper
+    bound on bytes the move touches).  Derived purely from shapes +
+    the two plans, never from device state — the ``mxplan diff`` /
+    bench accounting input."""
+    out: Dict[str, dict] = {}
+    for row in plan_src.diff(plan_dst, named_shapes,
+                             dtype_bytes=dtype_bytes):
+        out[row["name"]] = {"moves": row["moves"],
+                            "nbytes": row["nbytes"],
+                            "from_spec": row["from_spec"],
+                            "to_spec": row["to_spec"]}
+    return out
+
+
+def redistribute_plan(named_arrays, plan_dst, mesh=None):
+    """Move arrays saved/live under ANY source plan onto ``plan_dst``'s
+    resolution — between any two plans, not just dp-size changes
+    (fp32-exact: layout moves never touch element values).
+
+    ``named_arrays``: ``[(param_path, array)]`` — live device arrays
+    (the one-donated-program move of :func:`redistribute` when the
+    device sets coincide) or host arrays (sharded ``device_put`` per
+    :func:`place`).  ``mesh`` defaults to ``plan_dst.build_mesh()``.
+    Returns the moved arrays in order.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    named_arrays = list(named_arrays)
+    if mesh is None:
+        mesh = plan_dst.build_mesh()
+    targets = []
+    for name, a in named_arrays:
+        spec, _idx = plan_dst.spec_for(name, a.shape)
+        targets.append(NamedSharding(mesh, P(*spec)))
+    return redistribute([a for _n, a in named_arrays], targets)

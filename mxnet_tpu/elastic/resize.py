@@ -155,15 +155,20 @@ class ResizeController:
         self.manager = manager
 
     def resize(self, mesh) -> dict:
-        """Take the trainer to ``mesh``.  Returns the registry record
+        """Take the trainer to ``mesh`` — a jax Mesh, or a
+        ``parallel.ShardingPlan`` for a plan-to-plan resize (target
+        mesh from the plan's axes, target param layout from its rules;
+        the swap adopts the plan).  Returns the registry record
         (also appended to :func:`resizes`).  A failure BEFORE the
         drain checkpoint commits raises with the trainer untouched on
         the old mesh; a failure after it heals onto the new mesh from
         the drain checkpoint (``healed: True`` in the record)."""
         from .. import engine, telemetry
+        from ..parallel.planner import ShardingPlan
         trainer = self.trainer
         mesh_from = mesh_desc(trainer.mesh)
-        mesh_to = mesh_desc(mesh)
+        mesh_to = dict(mesh.axes) if isinstance(mesh, ShardingPlan) \
+            else mesh_desc(mesh)
         phase = "prewarm"
         try:
             # 1) PRE-WARM (the old mesh could still be stepping
@@ -209,6 +214,8 @@ class ResizeController:
         rec = {
             "kind": "train", "mesh_from": mesh_from,
             "mesh_to": mesh_to, "zero_stage": trainer._zero_stage,
+            "plan_to": trainer.plan.struct_hash()
+            if getattr(trainer, "plan", None) is not None else None,
             "drain_step": drain_step, "committed_step": committed,
             "healed": healed,
             "prewarm_seconds": round(prewarm_s, 4),

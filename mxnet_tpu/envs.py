@@ -258,6 +258,16 @@ _reg("MXTPU_ZERO_STAGE", int, 0,
      "weights. Read at DataParallelTrainer construction; numerics are "
      "fp32-parity with stage 0, and checkpoints stay portable across "
      "stages and dp sizes.")
+_reg("MXTPU_SHARDING_PLAN", str, "",
+     "Path to a sharding-plan JSON (parallel.ShardingPlan.save; "
+     "docs/parallelism.md 'The sharding planner'). When set, "
+     "DataParallelTrainer constructed without an explicit plan= / "
+     "param_sharding= adopts it: the plan's named mesh axes, regex "
+     "partition rules, ZeRO stage, and pipeline/serving fields become "
+     "the single source of truth for every layout decision. A "
+     "malformed file raises at construction (a typo'd plan silently "
+     "training replicated is the failure mode the planner exists to "
+     "kill). Empty (default) = off.")
 _reg("MXTPU_RESIZE_UP_QUEUE", int, 4,
      "ServingAutoscaler grow signal: wait-queue depth at/above which "
      "an observation counts toward growing the serving plane's slot "

@@ -23,7 +23,9 @@ Public surface:
 """
 from .mesh import make_mesh, set_mesh, current_mesh, mesh_shape
 from . import collectives
+from . import planner
 from . import zero
+from .planner import ShardingPlan, megatron_rules
 from .collectives import (quantized_psum, quantized_reduce_scatter,
                           reduce_scatter, vocab_parallel_softmax_ce)
 from .trainer import DataParallelTrainer
@@ -51,7 +53,8 @@ __all__ = ["vocab_parallel_softmax_ce",
            "moe_param_rule", "pipeline_apply",
            "pipeline_value_and_grad",
            "make_mesh", "set_mesh", "current_mesh", "mesh_shape",
-           "collectives", "zero", "DataParallelTrainer",
+           "collectives", "planner", "zero", "ShardingPlan",
+           "megatron_rules", "DataParallelTrainer",
            "quantized_psum", "quantized_reduce_scatter",
            "reduce_scatter", "ring_attention",
            "ring_attention_sharded", "llama_param_rule",

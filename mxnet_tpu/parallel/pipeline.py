@@ -137,6 +137,14 @@ def _resolve_specs(stacked_params, param_specs, axis):
     return param_specs
 
 
+def _resolve_plan(plan, mesh, axis):
+    """A ``planner.ShardingPlan`` supplies BOTH the named mesh and the
+    stage axis (``plan.pp_axis``) — the planner is the one source of
+    truth for axis names."""
+    from .planner import resolve_plan_axis
+    return resolve_plan_axis(plan, mesh, axis, "pp_axis")
+
+
 def _validate_and_place(fname, stacked_params, x, n_microbatches,
                         mesh, axis, y=None, param_specs=None):
     """Shared arg validation + param placement for the pipeline entry
@@ -169,7 +177,7 @@ def _validate_and_place(fname, stacked_params, x, n_microbatches,
 
 
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
-                   mesh=None, axis="pp", param_specs=None):
+                   mesh=None, axis="pp", param_specs=None, plan=None):
     """Apply ``n_stages`` homogeneous stages as a GPipe pipeline.
 
     stage_fn(params_i, x_mb) -> y_mb (same shape as x_mb);
@@ -182,12 +190,16 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches,
     collectives.
 
     The jitted executable is cached per (mesh, axis, stage_fn, shapes).
+    ``plan`` (a ``parallel.ShardingPlan``) supplies the mesh AND the
+    stage axis (``plan.pp_axis``) — the planner's axis names instead
+    of an ad-hoc string.
     """
     import jax
     import jax.numpy as jnp
     from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    mesh, axis = _resolve_plan(plan, mesh, axis)
     mesh, n, params, specs = _validate_and_place(
         "pipeline_apply", stacked_params, x, n_microbatches, mesh,
         axis, param_specs=param_specs)
@@ -329,7 +341,8 @@ def _local_1f1b(params, xs, ys, *, stage_fn, loss_fn, axis,
 
 def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
                             n_microbatches, mesh=None, axis="pp",
-                            param_specs=None, grad_reduce_axes=None):
+                            param_specs=None, grad_reduce_axes=None,
+                            plan=None):
     """1F1B pipeline training step: mean loss + stacked param grads.
 
     stage_fn(params_i, x_mb) -> y_mb (same shape); loss_fn(out_mb,
@@ -351,6 +364,10 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     seed-crossing psum factor (``_compat.pre_vma``) is divided out so
     grads match the unsharded reference exactly.
 
+    ``plan`` (a ``parallel.ShardingPlan``) supplies the mesh and the
+    stage axis (``plan.pp_axis``) — consumers of one plan never spell
+    axis names twice.
+
     Compared with differentiating :func:`pipeline_apply`, the explicit
     1F1B schedule bounds in-flight activation memory by pipeline depth
     instead of microbatch count, at the cost of one recompute-forward
@@ -360,6 +377,7 @@ def pipeline_value_and_grad(stage_fn, stacked_params, x, y, loss_fn,
     from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    mesh, axis = _resolve_plan(plan, mesh, axis)
     mesh, n, params, specs = _validate_and_place(
         "pipeline_value_and_grad", stacked_params, x, n_microbatches,
         mesh, axis, y=y, param_specs=param_specs)

@@ -60,7 +60,7 @@ def _layer_stage(name: str, num_layers: int, num_stages: int):
     return num_stages - 1              # head, final norm
 
 
-def sharding_plan(block, mesh, rule=None, dtype_bytes: int = 2,
+def sharding_plan(block, mesh=None, rule=None, dtype_bytes: int = 2,
                   pp_axis: str = None, hbm_bytes: int = _V5E_HBM_BYTES):
     """Exact per-device parameter-memory plan for ``block`` on ``mesh``.
 
@@ -70,7 +70,28 @@ def sharding_plan(block, mesh, rule=None, dtype_bytes: int = 2,
     to pipeline stages and the busiest stage reported.  Returns a dict:
     ``total_params``, ``per_stage_bytes`` (list, one per stage),
     ``max_device_bytes``, ``fits_hbm``, ``hbm_fraction``.
+
+    ``rule`` may be a ``(name, shape) -> PartitionSpec`` callable OR a
+    :class:`~mxnet_tpu.parallel.planner.ShardingPlan` — the planner's
+    regex rules, pp axis, and mesh axes then drive the memory math
+    (``mesh`` may be omitted: the plan describes it).
     """
+    from .planner import ShardingPlan
+    plan_obj = None
+    if isinstance(rule, ShardingPlan):
+        plan_obj = rule
+        if pp_axis is None and plan_obj.n_stages > 1:
+            pp_axis = plan_obj.pp_axis
+        rule = plan_obj.partition_spec
+        if mesh is None:
+            mesh = dict(plan_obj.axes)   # shape math needs no devices
+    elif mesh is None:
+        raise MXNetError("sharding_plan needs a mesh (or a "
+                         "ShardingPlan rule that describes one)")
+    # accept a jax Mesh or a plain {axis: size} dict — the math only
+    # reads axis sizes
+    axis_sizes = dict(mesh.shape) if hasattr(mesh, "shape") else \
+        {str(k): int(v) for k, v in dict(mesh).items()}
     params = {name: tuple(int(d) for d in p.shape)
               for name, p in block.collect_params().items()}
     for name, shape in params.items():
@@ -78,7 +99,7 @@ def sharding_plan(block, mesh, rule=None, dtype_bytes: int = 2,
             raise MXNetError(
                 f"param {name!r} has unresolved shape {shape}; declare "
                 "in_units/in_channels so the plan needs no forward")
-    num_stages = int(mesh.shape[pp_axis]) if pp_axis else 1
+    num_stages = int(axis_sizes[pp_axis]) if pp_axis else 1
     layer_ids = [int(m.group(1)) for n in params
                  for m in [re.search(r"layer(\d+)_", n)] if m]
     num_layers = max(layer_ids) + 1 if layer_ids else 1
@@ -96,9 +117,14 @@ def sharding_plan(block, mesh, rule=None, dtype_bytes: int = 2,
             for part in spec:
                 for ax in ([part] if isinstance(part, str) else
                            (part or ())):
-                    shards *= int(mesh.shape[ax])
-        stage = _layer_stage(name, num_layers, num_stages) \
-            if num_stages > 1 else 0
+                    shards *= int(axis_sizes[ax])
+        if num_stages <= 1:
+            stage = 0
+        elif plan_obj is not None:
+            # the plan's stage_rules override the layer-number layout
+            stage = plan_obj.stage_of(name, num_layers)
+        else:
+            stage = _layer_stage(name, num_layers, num_stages)
         per_stage[stage] += -(-n_elem // shards) * dtype_bytes
     max_dev = max(per_stage)
     return {

@@ -112,18 +112,28 @@ def _ring_executable(mesh, axis, scale, causal):
     return fn
 
 
+def _resolve_plan(plan, mesh, axis):
+    """``plan`` (a ``planner.ShardingPlan``) supplies the mesh and the
+    sequence axis (``plan.sp_axis``) — same convention as the pipeline
+    entry points."""
+    from .planner import resolve_plan_axis
+    return resolve_plan_axis(plan, mesh, axis, "sp_axis")
+
+
 def ring_attention(q, k, v, mesh=None, axis="sp", scale=None,
-                   causal=False):
+                   causal=False, plan=None):
     """SPMD ring attention over sequence-sharded jax arrays.
 
     q: (B, S_global, H, D); k/v: (B, S_global, KV, D) with KV dividing H
     (KV == H is plain multi-head attention), sharded or to-be-sharded
     along the sequence dim over ``axis``.  Returns (B, S_global, H, D)
-    with the same sharding.
+    with the same sharding.  ``plan`` (a ``parallel.ShardingPlan``)
+    supplies the mesh and the sequence axis (``plan.sp_axis``).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    mesh, axis = _resolve_plan(plan, mesh, axis)
     mesh = mesh if mesh is not None else current_mesh()
     if axis not in mesh.axis_names:
         raise MXNetError(f"mesh has no axis {axis!r}")
@@ -151,7 +161,7 @@ _OPDEF_SEQ = __import__("itertools").count()
 
 
 def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
-                           scale=None, causal=False):
+                           scale=None, causal=False, plan=None):
     """NDArray wrapper around :func:`ring_attention` — on the autograd
     tape, so training through the ring path gets real gradients.
 
@@ -176,6 +186,7 @@ def ring_attention_sharded(q_nd, k_nd, v_nd, mesh=None, axis="sp",
             "hybridize/CachedOp trace; call the block unhybridized or "
             "run it inside a mesh-jitted SPMD step")
 
+    mesh, axis = _resolve_plan(plan, mesh, axis)
     mesh = mesh if mesh is not None else current_mesh()
     try:
         devs = q_nd._data.sharding.device_set

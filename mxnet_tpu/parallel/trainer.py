@@ -34,6 +34,11 @@ from .mesh import current_mesh
 
 __all__ = ["DataParallelTrainer"]
 
+# distinct "no override" sentinel for _sharding_tuples(rule=): None
+# must stay expressible as "explicitly replicate" (a rule-free target
+# plan in a live resize)
+_RULE_UNSET = object()
+
 
 def _flatten(tree, out):
     if tree is None:
@@ -162,13 +167,23 @@ class DataParallelTrainer:
       param_sharding: optional rule ``(param_name, shape) ->
         jax.sharding.PartitionSpec`` for tensor-parallel param layouts;
         default replicates every param (pure DP).
+      plan: a :class:`~mxnet_tpu.parallel.planner.ShardingPlan` — the
+        declarative alternative to ``mesh``/``dp_axis``/
+        ``param_sharding`` (docs/parallelism.md, "The sharding
+        planner"): the plan's named axes build the mesh, its regex
+        rules become the param layout, and its ``zero_stage`` (when
+        set) overrides ``MXTPU_ZERO_STAGE``.  Defaults to the plan
+        ``MXTPU_SHARDING_PLAN`` points at.  Mutually exclusive with
+        ``param_sharding``; an explicit ``mesh`` must match the plan's
+        axes.
     """
 
     def __init__(self, block, loss_fn: Callable, optimizer,
                  optimizer_params=None, mesh=None, dp_axis: str = "dp",
                  param_sharding: Optional[Callable] = None,
-                 fuse_step: bool = False, compression=None):
+                 fuse_step: bool = False, compression=None, plan=None):
         from .. import optimizer as opt
+        from . import planner as _planner
 
         self.block = block
         self.loss_fn = loss_fn
@@ -178,6 +193,57 @@ class DataParallelTrainer:
         else:
             self.optimizer = opt.create(optimizer,
                                         **(optimizer_params or {}))
+        # the unified sharding planner (ROADMAP item 1): ONE plan
+        # object drives the mesh, the param layout, the ZeRO stage and
+        # (downstream) pipeline/serving axes — the env entry point
+        # makes a plan file the process-wide source of truth.  The env
+        # plan is AMBIENT: explicit legacy layout args win over it (a
+        # param_sharding rule skips adoption entirely; a mesh whose
+        # axes disagree warns and keeps the legacy path), so setting
+        # MXTPU_SHARDING_PLAN can never brick pre-planner call sites.
+        # An EXPLICIT plan= keeps the strict conflict rejects below.
+        if plan is None and param_sharding is None:
+            env_plan = _planner.plan_from_env()
+            mesh_conflict = env_plan is not None and \
+                mesh is not None and \
+                {str(k): int(v) for k, v in mesh.shape.items()} \
+                != dict(env_plan.axes)
+            axis_conflict = env_plan is not None and \
+                dp_axis not in ("dp", env_plan.dp_axis)
+            if mesh_conflict or axis_conflict:
+                import warnings
+                what = "mesh axes" if mesh_conflict else "dp_axis"
+                warnings.warn(
+                    f"MXTPU_SHARDING_PLAN disagrees with this "
+                    f"trainer's explicit {what}; ignoring the env "
+                    "plan (explicit args win)", stacklevel=2)
+            else:
+                plan = env_plan
+        if plan is not None:
+            if not isinstance(plan, _planner.ShardingPlan):
+                raise MXNetError(
+                    f"plan= must be a parallel.ShardingPlan, got "
+                    f"{type(plan).__name__}")
+            if param_sharding is not None:
+                raise MXNetError(
+                    "pass plan= OR param_sharding=, not both — the "
+                    "plan's rules ARE the param layout")
+            if dp_axis not in ("dp", plan.dp_axis):
+                raise MXNetError(
+                    f"dp_axis {dp_axis!r} conflicts with the plan's "
+                    f"dp_axis {plan.dp_axis!r}")
+            dp_axis = plan.dp_axis
+            if mesh is None:
+                mesh = plan.build_mesh()
+            else:
+                mesh_axes = {str(k): int(v)
+                             for k, v in mesh.shape.items()}
+                if mesh_axes != dict(plan.axes):
+                    raise MXNetError(
+                        f"mesh axes {mesh_axes} do not match the "
+                        f"plan's {dict(plan.axes)}")
+            param_sharding = plan.param_rule()
+        self.plan = plan
         self.mesh = mesh if mesh is not None else current_mesh()
         self.dp_axis = dp_axis
         self._param_sharding = param_sharding
@@ -284,7 +350,12 @@ class DataParallelTrainer:
         # trips the MXL310 runtime rule.
         from . import zero as _zero
         self._zero_stage = 0
-        requested = _zero.stage_from_env()
+        # the plan's zero_stage (when set) IS the stage — one plan
+        # object decides the (dp, chunk) layout; None defers to the env
+        if self.plan is not None and self.plan.zero_stage is not None:
+            requested = int(self.plan.zero_stage)
+        else:
+            requested = _zero.stage_from_env()
         if requested and int(self.mesh.shape.get(self.dp_axis, 1)) > 1:
             reason = _zero.eligibility(self)
             if reason is None:
@@ -343,6 +414,22 @@ class DataParallelTrainer:
             f"spmd:{self.block.name}", self._opt_state_leaves(),
             mesh=self.mesh, dp_axis=self.dp_axis,
             zero_stage=self._zero_stage)
+        # the planner registry (MXL313 coverage audit + mxplan): a
+        # plan-driven trainer's resolved param tree is auditable for
+        # uncovered params / shadowed rules / replicated big tensors
+        if self.plan is not None:
+            from . import planner as _planner
+            _planner.note_plan(
+                f"spmd:{self.block.name}", self.plan,
+                [(p.name, p.data().shape) for p in params])
+
+    def _param_spec(self, name, shape):
+        """The trainer's sharding rule (plan-derived or callable) for
+        one param — the single consultation point behind
+        ``_shard_params``/``_sharding_tuples``/``_elastic_restore``."""
+        if self._param_sharding is None:
+            return None
+        return self._param_sharding(name, shape)
 
     def _opt_state_leaves(self):
         """``[(label, jax array), ...]`` over every optimizer-state
@@ -420,26 +507,26 @@ class DataParallelTrainer:
     def _shard_params(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..elastic import reshard as _reshard
+        from . import planner as _planner
 
         repl = NamedSharding(self.mesh, P())
-        holders: List[NDArray] = []
-        targets = []
-        for p in self._params:
-            d = p.data()
-            spec = None
-            if self._param_sharding is not None:
-                spec = self._param_sharding(p.name, d.shape)
-            holders.append(d)
-            targets.append(NamedSharding(self.mesh, spec)
-                           if spec is not None else repl)
+        holders: List[NDArray] = [p.data() for p in self._params]
+        # THE shared resolution path (planner.resolve_shardings):
+        # _sharding_tuples and _elastic_restore derive the same
+        # layouts through the same call, so placement and pinned
+        # program shardings can never disagree
+        targets = list(_planner.resolve_shardings(
+            self.mesh,
+            [(p.name, p.data().shape) for p in self._params],
+            self._param_sharding))
         flat: List[NDArray] = []
         _flatten(self._states, flat)
         holders.extend(flat)
         # ZeRO keeps optimizer-state leaves sharded on their leading
         # dp row — re-replicating them here would silently undo the
         # whole memory saving (and trip MXL310)
-        state_target = NamedSharding(self.mesh, P(self.dp_axis)) \
-            if self._zero_stage else repl
+        state_target = _planner.zero_state_sharding(
+            self.mesh, self.dp_axis) if self._zero_stage else repl
         targets.extend(state_target for _ in flat)
         # live -> live layout move (elastic.reshard, arXiv:2112.01075):
         # one compiled identity program when source and target cover
@@ -961,7 +1048,14 @@ class DataParallelTrainer:
                  # pre-ZeRO manifest + persisted executable) survive
                  # this release unchanged
                  telemetry.health.trace_signature()) + (
-                     (self._zero_stage,) if self._zero_stage else ())
+                     (self._zero_stage,) if self._zero_stage else ()
+                 ) + (
+                     # the plan pin: a plan-driven trainer's rules are
+                     # baked into the executables' shardings; appended
+                     # only when a plan exists so every pre-planner
+                     # hash (and persisted executable) still serves
+                     (self.plan.struct_hash(),)
+                     if self.plan is not None else ())
         h = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
         return f"spmd_full_step_{self.block.name}_{h}"
 
@@ -980,7 +1074,13 @@ class DataParallelTrainer:
                  self.dp_axis,
                  # stage appended only when nonzero — see _persist_name
                  telemetry.health.trace_signature()) + (
-                     (self._zero_stage,) if self._zero_stage else ())
+                     (self._zero_stage,) if self._zero_stage else ()
+                 ) + (
+                     # mesh-size-independent plan identity: rules +
+                     # axis NAMES (the reshard path legitimately
+                     # changes sizes); appended only when a plan exists
+                     (self.plan.struct_hash(ignore_sizes=True),)
+                     if self.plan is not None else ())
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
@@ -1096,6 +1196,11 @@ class DataParallelTrainer:
                 shardings.append("")
         manifest = {
             "zero": self._zero_record(),
+            # the canonical plan pin (docs/parallelism.md): None for
+            # legacy-arg trainers, so pre-planner manifests compare
+            # equal on them
+            "plan": self.plan.to_record() if self.plan is not None
+            else None,
             "format": 1, "kind": "spmd_full_step",
             "fingerprint": _persist.fingerprint(),
             "persist_name": self._persist_name(),
@@ -1229,6 +1334,19 @@ class DataParallelTrainer:
             # rejection reason names the actual cause.  A resharded
             # warm start re-derives its slices on the new dp size, so
             # THERE only the stage must agree.
+            # the plan pin is compared FIRST and by field, so a
+            # rejection names the exact diverging rule instead of an
+            # opaque hash (fail-open either way: cold compile, never a
+            # crash).  The reshard path ignores axis SIZES — a mesh
+            # change is its whole point — but rules/roles must agree.
+            from . import planner as _planner
+            plan_diff = _planner.diff_records(
+                m.get("plan"),
+                self.plan.to_record() if self.plan is not None
+                else None,
+                ignore_sizes=resharded)
+            if plan_diff is not None:
+                return _fail(f"sharding-plan mismatch: {plan_diff}")
             mzero = m.get("zero")
             mstage = int((mzero or {}).get("stage", 0))
             if resharded:
@@ -1387,6 +1505,11 @@ class DataParallelTrainer:
             # to ANY target layout (other dp size, or gathered full
             # shape on a ZeRO-off trainer) — docs/zero.md matrix
             "zero": self._zero_record(),
+            # the plan pin (audit trail; restore does NOT reject on a
+            # differing plan — a cross-plan restore IS the portability
+            # matrix, routed through the reshard path)
+            "plan": self.plan.to_record() if self.plan is not None
+            else None,
             "params": params, "states": states,
             "residuals": list(self._residual_vals or ()),
         }
@@ -1419,13 +1542,11 @@ class DataParallelTrainer:
                     f"checkpoint param {p.name!r} has shape "
                     f"{tuple(host.shape)}, trainer expects "
                     f"{tuple(d.shape)}")
-            # target layout = this trainer's sharding rule on the
-            # CURRENT mesh (same derivation as _shard_params)
-            spec = None
-            if self._param_sharding is not None:
-                spec = self._param_sharding(p.name, d.shape)
-            target = NamedSharding(self.mesh, spec) \
-                if spec is not None else repl
+            # target layout = this trainer's sharding rule (plan or
+            # callable) on the CURRENT mesh — the same consultation
+            # point as _shard_params/_sharding_tuples, so a cross-PLAN
+            # restore is just the reshard path with different specs
+            spec = self._param_spec(p.name, d.shape)
             if resharded:
                 plans[p.name] = _reshard.plan(
                     host.shape, _reshard.spec_from_str(spec_str),
@@ -1439,9 +1560,11 @@ class DataParallelTrainer:
         # trainer's layout by pure flat reshapes — fp32-exact — so a
         # ZeRO checkpoint restores onto any dp size and onto ZeRO-off
         # trainers, and a pre-ZeRO checkpoint restores sharded
+        from . import planner as _planner
         from . import zero as _zero
         src_zero = int((payload.get("zero") or {}).get("stage", 0)) >= 1
-        zero_spec = NamedSharding(self.mesh, P(self.dp_axis))
+        zero_spec = _planner.zero_state_sharding(self.mesh,
+                                                 self.dp_axis)
         n_dp = int(self.mesh.shape.get(self.dp_axis, 1))
         for i, j, host in payload["states"]:
             if not (0 <= i < len(self._states)) or \
@@ -1607,9 +1730,13 @@ class DataParallelTrainer:
         opt.num_update = int(blob.get("num_update", opt.num_update))
 
     # -- live elastic resize (docs/elasticity.md, "Live resize") ----------
-    def _resize_check(self, mesh):
+    def _resize_check(self, mesh, allow_new_axes=False):
         """Raise ``MXNetError`` when this trainer cannot be resized
-        onto ``mesh`` (the eligibility half of ``prepare_resize``)."""
+        onto ``mesh`` (the eligibility half of ``prepare_resize``).
+        ``allow_new_axes`` (the plan-targeted path) permits the axis
+        SET to change — a dp8 -> dp4 x tp2 plan resize — as long as
+        the dp axis survives; the bare-mesh path keeps the strict
+        sizes-only contract."""
         if self._params is None or not self._var_avals:
             raise MXNetError(
                 "prepare_resize: run at least one successful fused "
@@ -1632,12 +1759,14 @@ class DataParallelTrainer:
                 "resizing")
         mesh_now = {str(k): int(v) for k, v in self.mesh.shape.items()}
         mesh_new = {str(k): int(v) for k, v in mesh.shape.items()}
-        if set(mesh_now) != set(mesh_new) or \
-                self.dp_axis not in mesh_new:
+        if self.dp_axis not in mesh_new or (
+                not allow_new_axes and
+                set(mesh_now) != set(mesh_new)):
             raise MXNetError(
                 f"resize target mesh axes {sorted(mesh_new)} must "
                 f"match the current axes {sorted(mesh_now)} (only "
-                "axis SIZES change in a live resize)")
+                "axis SIZES change in a bare-mesh live resize; pass "
+                "a target ShardingPlan to change the axis set)")
         # (batch divisibility against the target dp size is validated
         # per data shape by prepare_resize's job construction — the
         # superset of the recorded rows — before any state is touched)
@@ -1650,6 +1779,13 @@ class DataParallelTrainer:
         opaque staged bundle for :meth:`apply_resize`; on any failure
         the trainer is left exactly as it was.
 
+        ``mesh`` may be a :class:`~mxnet_tpu.parallel.planner.
+        ShardingPlan`: the target mesh then comes from the plan's
+        axes, the target PARAM LAYOUT from its rules, and the swap
+        adopts the plan — a plan-to-plan live resize (e.g. dp8 ->
+        dp4 x tp2), not just a dp-size change.  The plan's zero
+        stage (when set) must match the trainer's latched stage.
+
         The target-mesh programs are compiled purely from avals: param
         /state layouts come from :meth:`_sharding_tuples` (structural,
         mesh-parameterized), ZeRO state rows from
@@ -1659,11 +1795,46 @@ class DataParallelTrainer:
         (tier-1 asserted; MXL503 watches the contract at runtime)."""
         import jax
         from ..engine import persist as _persist
+        from . import planner as _planner
         from . import zero as _zero
 
-        self._resize_check(mesh)
+        plan_b = None
+        if isinstance(mesh, _planner.ShardingPlan):
+            plan_b = mesh
+            if plan_b.dp_axis != self.dp_axis:
+                raise MXNetError(
+                    f"target plan's dp_axis {plan_b.dp_axis!r} does "
+                    f"not match the trainer's {self.dp_axis!r}")
+            if plan_b.zero_stage is not None and \
+                    int(plan_b.zero_stage) != self._zero_stage:
+                raise MXNetError(
+                    f"target plan pins zero_stage "
+                    f"{plan_b.zero_stage}, trainer latched "
+                    f"{self._zero_stage} at construction (the stage "
+                    "decides the physical state layout and cannot "
+                    "flip in a live resize)")
+            if self._zero_stage and plan_b.param_rule() is not None:
+                raise MXNetError(
+                    "target plan's rules shard params, but this "
+                    "trainer runs a ZeRO-sharded update — the same "
+                    "exclusion as construction (ZeRO shards the "
+                    "UPDATE of dp-replicated params; docs/zero.md); "
+                    "resize to a rule-free plan or restart stage 0")
+            mesh = plan_b.build_mesh()
+        self._resize_check(mesh, allow_new_axes=plan_b is not None)
         self._refresh_health()
         n_b = int(mesh.shape[self.dp_axis])
+        if plan_b is None and self.plan is not None:
+            # mesh-only resize of a plan-driven trainer: the adopted
+            # plan keeps the rules/roles but records the target axis
+            # sizes (the plan object stays the source of truth)
+            rec = self.plan.to_record()
+            rec["axes"] = [[str(k), int(v)]
+                           for k, v in mesh.shape.items()]
+            plan_b = _planner.ShardingPlan.from_record(rec)
+            plan_b._mesh = mesh
+        rule_b = plan_b.param_rule() if plan_b is not None \
+            else self._param_sharding
 
         param_sds = tuple(
             jax.ShapeDtypeStruct(tuple(p.data().shape),
@@ -1757,9 +1928,16 @@ class DataParallelTrainer:
         saved = (self.mesh, self._full_step, self._full_fn,
                  self._zero_body, self._full_exec,
                  self._multi_step_cache, self._multi_fns,
-                 self._multi_exec, self._persist_pin)
+                 self._multi_exec, self._persist_pin, self.plan,
+                 self._param_sharding)
         try:
             self.mesh = mesh
+            # the target plan/rules drive the builders'
+            # _sharding_tuples AND the persist identity during the
+            # build; restored below — the live trainer never observes
+            # the temporary binding
+            self.plan = plan_b
+            self._param_sharding = rule_b
             self._persist_pin = None        # the pin bakes the OLD mesh
             self._full_step = None
             self._full_fn = None
@@ -1800,6 +1978,7 @@ class DataParallelTrainer:
                     self._full_exec[0][_persist.aval_sig(vals)] = call
             staged = {
                 "mesh": mesh, "n_dp": n_b,
+                "plan": plan_b, "param_sharding": rule_b,
                 "full_step": self._full_step,
                 "full_fn": self._full_fn,
                 "zero_body": self._zero_body,
@@ -1812,7 +1991,8 @@ class DataParallelTrainer:
             (self.mesh, self._full_step, self._full_fn,
              self._zero_body, self._full_exec,
              self._multi_step_cache, self._multi_fns,
-             self._multi_exec, self._persist_pin) = saved
+             self._multi_exec, self._persist_pin, self.plan,
+             self._param_sharding) = saved
         return staged
 
     def apply_resize(self, staged):
@@ -1841,7 +2021,10 @@ class DataParallelTrainer:
 
         mesh_b = staged["mesh"]
         _faults.maybe_fire("resize_reshard")
-        param_sh, _state_sh = self._sharding_tuples(mesh=mesh_b)
+        param_sh, _state_sh = self._sharding_tuples(
+            mesh=mesh_b,
+            rule=staged["param_sharding"] if "param_sharding" in
+            staged else _RULE_UNSET)
         holders: List[NDArray] = [p.data() for p in self._params]
         targets = list(param_sh)
         if not self._zero_stage:
@@ -1886,6 +2069,12 @@ class DataParallelTrainer:
         directly and then restores the drain checkpoint INTO the new
         bindings)."""
         self.mesh = staged["mesh"]
+        # a plan-targeted resize adopts the target plan + its rules as
+        # the trainer's new source of truth (re-registered for the
+        # MXL313 audit by _note_resize_layouts)
+        if "plan" in staged:
+            self.plan = staged["plan"]
+            self._param_sharding = staged["param_sharding"]
         self._full_step = staged["full_step"]
         self._full_fn = staged["full_fn"]
         self._zero_body = staged["zero_body"]
@@ -1909,6 +2098,11 @@ class DataParallelTrainer:
         """Re-register the observatory ledgers (MXL309/310 inputs,
         HBM census) under the post-resize mesh/layout."""
         from .. import telemetry
+        if self.plan is not None:
+            from . import planner as _planner
+            _planner.note_plan(
+                f"spmd:{self.block.name}", self.plan,
+                [(p.name, p.data().shape) for p in self._params])
         telemetry.memory.note_param_tree(
             f"spmd:{self.block.name}", self._params, mesh=self.mesh,
             dp_axis=self.dp_axis)
@@ -2367,29 +2561,34 @@ class DataParallelTrainer:
         self._multi_fns[(k_steps, repeated)] = body
         return fn
 
-    def _sharding_tuples(self, mesh=None):
+    def _sharding_tuples(self, mesh=None, rule=_RULE_UNSET):
         """Param/optimizer-state layouts on ``mesh`` (default: the
         trainer's own), derived STRUCTURALLY — the sharding rule (or
         replication) per param, ``P(dp)`` state rows under ZeRO,
         replication otherwise — never read from live buffers.  This is
         exactly the layout ``_shard_params``/``_elastic_restore``
-        place, so for the trainer's own mesh it equals the live
-        placements; for a resize target mesh it is the layout the
-        pre-warm must pin while the live buffers still sit on the OLD
-        mesh (shared by the fused single-step and bulked-step
-        builders, and by ``prepare_resize``/``apply_resize``)."""
+        place (all three route through
+        ``planner.resolve_shardings`` — one resolution path), so for
+        the trainer's own mesh it equals the live placements; for a
+        resize target mesh it is the layout the pre-warm must pin
+        while the live buffers still sit on the OLD mesh (shared by
+        the fused single-step and bulked-step builders, and by
+        ``prepare_resize``/``apply_resize``).  ``rule`` overrides the
+        trainer's own param rule (a plan-targeted resize resolves the
+        TARGET plan's rules before the swap adopts them); pass
+        ``rule=None`` EXPLICITLY to replicate everything (a rule-free
+        target plan) — the unset default falls back to the trainer's
+        own rule."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import planner as _planner
         mesh = mesh if mesh is not None else self.mesh
-        repl = NamedSharding(mesh, P())
-        params = []
-        for p in self._params:
-            spec = None
-            if self._param_sharding is not None:
-                spec = self._param_sharding(p.name, p.data().shape)
-            params.append(NamedSharding(mesh, spec)
-                          if spec is not None else repl)
-        state_sh = NamedSharding(mesh, P(self.dp_axis)) \
-            if self._zero_stage else repl
+        if rule is _RULE_UNSET:
+            rule = self._param_sharding
+        params = _planner.resolve_shardings(
+            mesh, [(p.name, p.data().shape) for p in self._params],
+            rule)
+        state_sh = _planner.zero_state_sharding(mesh, self.dp_axis) \
+            if self._zero_stage else NamedSharding(mesh, P())
         states = tuple(tuple(state_sh for _ in vals)
                        for vals in self._state_vals())
         return tuple(params), states

@@ -94,10 +94,13 @@ def _size(shape) -> int:
 
 def param_slice(shape, n_dp: int):
     """``(size, padded, chunk)`` for one param: flat length, padded to
-    a multiple of ``n_dp``, and the per-member slice length."""
-    size = _size(shape)
-    padded = size + ((-size) % n_dp)
-    return size, padded, padded // n_dp
+    a multiple of ``n_dp``, and the per-member slice length.  Pure
+    delegation to ``planner.flat_rows`` — the ONE definition of the
+    flat ZeRO arithmetic (the ``state_avals`` /
+    ``_sharding_tuples(mesh=)`` drift PR 11 warned about is gone by
+    construction)."""
+    from .planner import flat_rows
+    return flat_rows(shape, n_dp)
 
 
 def slice_record(params, tr_idx, n_dp: int) -> List[list]:
@@ -122,7 +125,7 @@ def state_avals(params, tr_idx, states, n_dp: int):
     (the live tuples from the CURRENT layout — leaf count is
     dp-size-independent).  Returns a tuple of per-param tuples of
     ``jax.ShapeDtypeStruct``."""
-    import jax
+    from .planner import zero_state_avals
     out = []
     for i in tr_idx:
         s = states[i]
@@ -130,11 +133,8 @@ def state_avals(params, tr_idx, states, n_dp: int):
             out.append(())
             continue
         n_leaves = len(s) if isinstance(s, (list, tuple)) else 1
-        _size_, _padded, chunk = param_slice(params[i].data().shape,
-                                             n_dp)
-        out.append(tuple(
-            jax.ShapeDtypeStruct((n_dp, chunk), np.float32)
-            for _ in range(n_leaves)))
+        out.append(zero_state_avals(params[i].data().shape, n_dp,
+                                    n_leaves))
     return tuple(out)
 
 
@@ -149,10 +149,10 @@ def create_sharded_states(optimizer, index, param_nd, mesh,
     the class's hands.  Returns None when the optimizer is stateless
     for this param."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from .. import ndarray as nd
     from ..ndarray.ndarray import NDArray
     from .collectives import sharded_update_state_init
+    from .planner import zero_state_sharding
 
     probe = nd.zeros((1,), ctx=param_nd.context,
                      dtype=param_nd.dtype.name)
@@ -163,7 +163,7 @@ def create_sharded_states(optimizer, index, param_nd, mesh,
         else 1
     n_dp = int(mesh.shape[dp_axis])
     hosts = sharded_update_state_init(param_nd, n_leaves, n_dp)
-    sharding = NamedSharding(mesh, P(dp_axis))
+    sharding = zero_state_sharding(mesh, dp_axis)
     return tuple(
         NDArray(jax.device_put(h, sharding), ctx=param_nd.context)
         for h in hosts)

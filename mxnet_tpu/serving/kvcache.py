@@ -38,10 +38,15 @@ class KVCachePool:
       ctx: device context for the pages.
       dtype: cache dtype (float; ``bfloat16`` halves page HBM and
         decode bandwidth — ``init_cache`` validates).
+      sharding: optional ``jax.sharding.NamedSharding`` for the pages
+        — the sharding planner's decode spec (``ShardingPlan.decode``,
+        typically the slot dim over ``dp``).  Applied after EVERY page
+        build (construction AND :meth:`reset`), so a recovery can
+        never silently drop the planned layout.
     """
 
     def __init__(self, lm, slots: int, cache_len: int, ctx=None,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", sharding=None):
         if slots < 1 or cache_len < 1:
             raise MXNetError(
                 f"KVCachePool needs slots >= 1 and cache_len >= 1, got "
@@ -51,9 +56,19 @@ class KVCachePool:
         self.cache_len = int(cache_len)
         self.ctx = ctx
         self.dtype = str(dtype)
+        self.sharding = sharding
         self.poisoned: Optional[str] = None
-        self._pairs: List[Tuple] = lm.init_cache(
-            self.slots, self.cache_len, ctx=ctx, dtype=dtype)
+        self._pairs: List[Tuple] = self._build_pages()
+
+    def _build_pages(self):
+        pairs = self._lm.init_cache(
+            self.slots, self.cache_len, ctx=self.ctx, dtype=self.dtype)
+        if self.sharding is not None:
+            import jax
+            for k, v in pairs:
+                k._set_data(jax.device_put(k._data, self.sharding))
+                v._set_data(jax.device_put(v._data, self.sharding))
+        return pairs
 
     @property
     def num_layers(self) -> int:
@@ -102,6 +117,5 @@ class KVCachePool:
         """Rebuild zeroed pages and clear the poison latch (the
         recovery half of the donation protocol — every resident
         request must be re-prefilled by the caller)."""
-        self._pairs = self._lm.init_cache(
-            self.slots, self.cache_len, ctx=self.ctx, dtype=self.dtype)
+        self._pairs = self._build_pages()
         self.poisoned = None

@@ -106,11 +106,33 @@ class Server:
     def __init__(self, lm, buckets=None, max_new_tokens: int = None,
                  top_k: int = 0, eos_id: Optional[int] = None,
                  ctx=None, cache_dtype: str = "float32",
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, plan=None):
         from .. import envs
         from ..context import current_context
+        from ..parallel import planner as _planner
         self.lm = lm
         self.ctx = ctx or current_context()
+        # the sharding planner's serving leg (docs/parallelism.md):
+        # plan.decode is the KV-page / decode-batch partition spec on
+        # the plan's named mesh — pinned into the struct hash and the
+        # warm-start manifest, and APPLIED to the pools/params when it
+        # actually shards (>1 device on the named axes)
+        if plan is not None and \
+                not isinstance(plan, _planner.ShardingPlan):
+            raise MXNetError(
+                f"plan= must be a parallel.ShardingPlan, got "
+                f"{type(plan).__name__}")
+        self.plan = plan
+        self._decode_sharding = None
+        self._repl_sharding = None
+        self._placed_params = None
+        if plan is not None and plan.decode_shards():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh = plan.build_mesh()
+            self._decode_sharding = NamedSharding(mesh,
+                                                  P(*plan.decode))
+            self._repl_sharding = NamedSharding(mesh, P())
         if max_new_tokens is None:
             max_new_tokens = int(envs.get("MXTPU_SERVING_MAX_NEW_TOKENS"))
         if max_queue is None:
@@ -130,11 +152,38 @@ class Server:
                 "Server needs an initialized model (run initialize() "
                 f"and one forward first): {e!r}") from e
         self.name = f"serving_{lm.name}_{next(_uid)}"
+        if self._decode_sharding is not None:
+            # the slot dim is the decode spec's leading entry: every
+            # bucket's slot count must divide its device fan-out, or
+            # the planned layout is unbuildable — reject NAMING the
+            # spec instead of letting XLA pad silently
+            fan = plan.decode_fanout()
+            for b in self.sched.buckets:
+                if fan > 1 and b.slots % fan:
+                    raise MXNetError(
+                        f"plan decode spec {plan.decode} shards the "
+                        f"slot dim {fan}-way but bucket "
+                        f"{b.slots}x{b.prompt_len} has {b.slots} "
+                        "slot(s); pick slot counts divisible by the "
+                        "decode axis size")
+            import jax
+            self._placed_params = [
+                jax.device_put(p._data, self._repl_sharding)
+                for p in self._param_nds]
         self._pools: Dict[tuple, KVCachePool] = {}
         for b in self.sched.buckets:
             self._pools[b.key] = KVCachePool(
                 lm, b.slots, b.cache_len, ctx=self.ctx,
-                dtype=self.cache_dtype)
+                dtype=self.cache_dtype,
+                sharding=self._decode_sharding)
+        if plan is not None:
+            # the planner registry (MXL313 coverage audit + mxplan):
+            # the serving leg registers its resolved param tree too
+            from ..parallel import planner as _pl
+            _pl.note_plan(
+                f"serving:{lm.name}", plan,
+                [(p.name, tuple(int(x) for x in p.data(self.ctx).shape))
+                 for p in lm.collect_params().values()])
         self._pure_cache: Dict[str, callable] = {}
         self._variants: Dict[str, dict] = {}   # suffix -> manifest row
         self._warmed: set = set()              # suffixes dispatched
@@ -166,7 +215,13 @@ class Server:
                   for p in self.lm.collect_params().values()),
             tuple(sorted(tuple(r) for r in rows)),
             self._kk, self.cache_dtype, self.max_new_tokens,
-            int(self.lm.model.vocab_size))
+            int(self.lm.model.vocab_size)) + (
+                # the plan pin: decode sharding is baked into the
+                # compiled programs' input layouts; appended only when
+                # a plan exists so pre-planner hashes (and persisted
+                # executables) still serve
+                (self.plan.struct_hash(),)
+                if self.plan is not None else ())
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
     # -- public API -------------------------------------------------------
@@ -454,6 +509,13 @@ class Server:
         new_slots = int(new_slots)
         if new_slots < 1:
             raise MXNetError(f"resize_slots: need >= 1, got {new_slots}")
+        if self._decode_sharding is not None:
+            fan = self.plan.decode_fanout()
+            if fan > 1 and new_slots % fan:
+                raise MXNetError(
+                    f"resize_slots: {new_slots} slot(s) do not divide "
+                    f"the plan's decode fan-out {fan} "
+                    f"({self.plan.decode}); pick a multiple")
         if self._poisoned is not None:
             raise MXNetError("server is poisoned; recover() before "
                              "resizing")
@@ -550,7 +612,8 @@ class Server:
                     requeued += 1
                 npool = KVCachePool(self.lm, new_slots, b.cache_len,
                                     ctx=self.ctx,
-                                    dtype=self.cache_dtype)
+                                    dtype=self.cache_dtype,
+                                    sharding=self._decode_sharding)
                 if kept:
                     idx = np.zeros((new_slots,), np.int32)
                     for j2, (j, _r) in enumerate(kept):
@@ -564,8 +627,16 @@ class Server:
                         _faults.on_dispatch("serving_resize_migrate",
                                             flat, donate=None)
                     jidx = jnp.asarray(idx)
-                    npool.adopt([jnp.take(c, jidx, axis=0)
-                                 for c in flat])
+                    moved = [jnp.take(c, jidx, axis=0) for c in flat]
+                    if self._decode_sharding is not None:
+                        # adopt() bypasses _build_pages, so the plan's
+                        # decode layout must be re-applied here or the
+                        # migrated pages land wherever jnp.take put
+                        # them (kvcache's "every page build" promise)
+                        import jax as _jax
+                        moved = [_jax.device_put(
+                            m, self._decode_sharding) for m in moved]
+                    npool.adopt(moved)
                     for c in flat:
                         try:
                             c.delete()
@@ -616,7 +687,8 @@ class Server:
             self._pools = {
                 b.key: KVCachePool(self.lm, new_slots, b.cache_len,
                                    ctx=self.ctx,
-                                   dtype=self.cache_dtype)
+                                   dtype=self.cache_dtype,
+                                   sharding=self._decode_sharding)
                 for b in self.sched.buckets}
             self._poisoned = None
             migrated = 0
@@ -686,6 +758,10 @@ class Server:
         manifest = {
             "format": 1, "kind": "mxtpu_serving_plane",
             "fingerprint": engine.persist.fingerprint(),
+            # the canonical plan pin (docs/parallelism.md): None for
+            # plan-less servers, so pre-planner manifests still serve
+            "plan": self.plan.to_record() if self.plan is not None
+            else None,
             "net": self.lm.name,
             "persist_base": self._persist_base,
             "struct_hash": self._struct_hash,
@@ -737,6 +813,15 @@ class Server:
         if m.get("fingerprint") != engine.persist.fingerprint():
             return _fail("environment fingerprint mismatch "
                          "(jax/jaxlib/platform/salt)")
+        # the plan pin is compared FIRST and by field, so a rejection
+        # names the exact diverging rule/field instead of the opaque
+        # struct hash (fail-open: cold compile, never a crash)
+        from ..parallel import planner as _planner
+        plan_diff = _planner.diff_records(
+            m.get("plan"),
+            self.plan.to_record() if self.plan is not None else None)
+        if plan_diff is not None:
+            return _fail(f"sharding-plan mismatch: {plan_diff}")
         if m.get("struct_hash") != self._struct_hash:
             return _fail("structural hash mismatch: the manifest "
                          "describes a different model/bucket/sampler "
@@ -966,8 +1051,18 @@ class Server:
         pure = self._pure_for(bucket, kind, k)
         P = len(self._param_nds)
         L2 = 2 * pool.num_layers
-        flat = [p._data for p in self._param_nds] + pool.flat() \
-            + list(extra)
+        if self._decode_sharding is not None:
+            # the planned decode mesh: params ride as the replicated
+            # copies placed at construction, and every per-dispatch
+            # extra (tokens/offsets/temps/key) is committed replicated
+            # — one coherent SPMD program, no mixed-device inputs
+            import jax as _jax
+            extra = [_jax.device_put(e, self._repl_sharding)
+                     for e in extra]
+            params_flat = list(self._placed_params)
+        else:
+            params_flat = [p._data for p in self._param_nds]
+        flat = params_flat + pool.flat() + list(extra)
         donate = tuple(range(P, P + L2))
         name = self.name + suffix
         persist_name = self._persist_base + suffix

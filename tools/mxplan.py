@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""mxplan: render, diff, and lint sharding-plan files.
+
+The unified sharding planner (``parallel.planner``;
+docs/parallelism.md "The sharding planner") drives every layout
+decision — trainer param sharding, ZeRO ``(dp, chunk)`` rows,
+pipeline/ring axes, serving decode sharding — from ONE declarative
+plan object.  This tool works on its canonical JSON form
+(``ShardingPlan.save``/``load``):
+
+    python tools/mxplan.py show plan.json --model llama_tiny
+        # resolved param -> spec table: rule index, device fan-out,
+        # global + per-device HBM (per-param bytes from the memory
+        # observatory's census of the built model)
+
+    python tools/mxplan.py diff planA.json planB.json --model mlp
+        # what a plan-to-plan reshard would MOVE: per-param collective
+        # op list (elastic.reshard.plan) + bytes; without --model,
+        # the rule/field-level record diff
+
+    python tools/mxplan.py lint plan.json --model bert_small
+        # the MXL313 coverage audit, standalone: uncovered params,
+        # shadowed (unreachable) rules, big tensors the plan
+        # replicates — exit 1 on error-severity findings
+
+Every subcommand exits 1 on a malformed plan file.  ``--model`` picks
+a shipped demo param tree (``mlp`` | ``llama_tiny`` | ``bert_small``)
+to resolve against; plans are pure shape math, so no mesh devices are
+needed beyond the CPU default.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load(path: str):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel.planner import ShardingPlan
+    try:
+        return ShardingPlan.load(path)
+    except MXNetError as e:
+        print(f"mxplan: malformed plan {path!r}: {e}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _model_params(kind: str):
+    """``[(name, shape)]`` + per-param nbytes of a shipped demo model
+    (initialized, so the bytes come from the memory observatory's
+    census of REAL buffers, not shape guesses)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import telemetry
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    if kind == "mlp":
+        from mxnet_tpu.gluon import nn
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(64, activation="relu", in_units=32),
+                    nn.Dense(8, in_units=64))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((2, 32), np.float32)))
+    elif kind == "llama_tiny":
+        from mxnet_tpu.models import llama_tiny
+        net = llama_tiny()
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1, 8), np.int32)))
+    elif kind == "bert_small":
+        from mxnet_tpu.models import bert as _bert
+        net = _bert.bert_small()
+        net.initialize(mx.init.Xavier())
+        z = nd.array(np.zeros((1, 8), np.int32))
+        try:
+            net(z)
+        except TypeError:
+            net(z, nd.array(np.zeros((1, 8), np.int32)))
+    else:
+        print(f"mxplan: unknown --model {kind!r} "
+              "(mlp | llama_tiny | bert_small)", file=sys.stderr)
+        raise SystemExit(1)
+    params = list(net.collect_params().values())
+    census = telemetry.memory.param_census(params)
+    by_name = {r["name"]: int(r["nbytes"])
+               for r in census.get("params", ())}
+    return [(p.name, tuple(int(d) for d in p.data().shape))
+            for p in params], by_name
+
+
+def cmd_show(args) -> int:
+    plan = _load(args.plan)
+    print(f"plan {args.plan}: axes "
+          + " x ".join(f"{k}:{v}" for k, v in plan.axes.items())
+          + f", dp={plan.dp_axis!r}, zero_stage={plan.zero_stage}, "
+          f"decode={plan.decode}, hash={plan.struct_hash()}")
+    for i, (pattern, spec) in enumerate(plan.rules):
+        print(f"  rule #{i}: {pattern!r} -> {spec or '(replicated)'}")
+    if not args.model:
+        return 0
+    named, nbytes = _model_params(args.model)
+    res = plan.resolve(named)
+    w = max((len(n) for n in res), default=4)
+    print(f"\n{'param'.ljust(w)}  {'spec'.ljust(18)} rule  "
+          f"{'global B':>10}  {'B/device':>10}")
+    tot_g = tot_d = 0
+    for name, row in res.items():
+        gb = nbytes.get(name, row["nbytes"])
+        per = -(-gb // row["shards"])
+        tot_g += gb
+        tot_d += per
+        rule = ("scalar" if row["rule"] == -1 else
+                "-" if row["rule"] is None else f"#{row['rule']}")
+        print(f"{name.ljust(w)}  "
+              f"{str(row['spec'] or '()').ljust(18)} {rule:>4}  "
+              f"{gb:>10}  {per:>10}")
+    print(f"{'TOTAL'.ljust(w)}  {''.ljust(18)}       "
+          f"{tot_g:>10}  {tot_d:>10}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from mxnet_tpu.parallel import planner as _planner
+    a = _load(args.plan_a)
+    b = _load(args.plan_b)
+    rec_diff = _planner.diff_records(a.to_record(), b.to_record())
+    if rec_diff is None:
+        print("plans are identical (nothing to reshard)")
+        return 0
+    print(f"record diff: {rec_diff}")
+    if not args.model:
+        return 0
+    from mxnet_tpu.elastic import reshard as _reshard
+    named, nbytes = _model_params(args.model)
+    moves = _reshard.plan_moves(named, a, b)
+    total = 0
+    for name, row in sorted(moves.items()):
+        gb = nbytes.get(name, row["nbytes"])
+        total += gb
+        print(f"  {name}: {row['from_spec'] or '()'} -> "
+              f"{row['to_spec'] or '()'}  "
+              f"[{'; '.join(row['moves']) or 'replace'}]  {gb} B")
+    print(f"  would move {len(moves)} param(s), {total} bytes")
+    return 0
+
+
+def cmd_lint(args) -> int:
+    from mxnet_tpu import analysis
+    plan = _load(args.plan)
+    named = None
+    if args.model:
+        named, _nb = _model_params(args.model)
+    findings = analysis.analyze_parallel(
+        plan=plan, named_shapes=named or [],
+        owner=os.path.basename(args.plan))
+    for f in findings:
+        print(f.format())
+    if not findings:
+        print(f"{args.plan}: plan coverage clean"
+              + (f" against --model {args.model}" if args.model
+                 else " (no params to audit; pass --model)"))
+    errors = [f for f in findings if f.severity == "error"]
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxplan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="resolved param->spec table")
+    p_show.add_argument("plan")
+    p_show.add_argument("--model", default="",
+                        help="mlp | llama_tiny | bert_small")
+    p_diff = sub.add_parser("diff",
+                            help="what a planA->planB reshard moves")
+    p_diff.add_argument("plan_a")
+    p_diff.add_argument("plan_b")
+    p_diff.add_argument("--model", default="")
+    p_lint = sub.add_parser("lint",
+                            help="MXL313 coverage audit, standalone")
+    p_lint.add_argument("plan")
+    p_lint.add_argument("--model", default="")
+    args = ap.parse_args(argv)
+    return {"show": cmd_show, "diff": cmd_diff,
+            "lint": cmd_lint}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
