@@ -107,6 +107,12 @@ RULES = {
                "(an unrecovered hang_suspected, a preemption that "
                "committed nothing) or a chaos-soak artifact with "
                "violated invariants"),
+    "MXL505": (Severity.WARNING,
+               "silent-corruption incident left open: a "
+               "corruption_suspected with no later rollback/"
+               "quarantine/clean resolution, or a scrub-found-corrupt "
+               "checkpoint still standing as a restore target (that "
+               "one at ERROR severity)"),
     # -- serving passes (MXL6xx) ----------------------------------------
     "MXL601": (Severity.WARNING,
                "per-request prefill/decode loop without the serving "

@@ -318,6 +318,13 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
       with violated invariants — the last one at ERROR severity, so
       ``tools/mxsoak.py run --self-check`` and a post-soak
       ``self_check()`` gate fail loudly.
+    * MXL505 — silent-corruption incidents left open (docs/
+      elasticity.md, "Integrity sentry"): a retained
+      ``corruption_suspected`` event never answered by a
+      ``corruption_resolved``/``device_quarantined``/``recovery``,
+      or a scrub-found-corrupt checkpoint still standing as a
+      committed restore target (ERROR severity — ``tools/mxsdc.py
+      audit`` is the standalone face).
     """
     from .. import envs, telemetry
     from ..elastic import manager as _mgr
@@ -429,6 +436,51 @@ def analyze_elasticity(min_steps: int = 100) -> List[Finding]:
             "cleanly — replay with tools/mxsoak.py run --seed "
             f"{art.get('seed')} and fix before shipping",
             f"soak:{n}", severity=Severity.ERROR))
+
+    # MXL505 — silent-corruption incidents left open (docs/
+    # elasticity.md, "Integrity sentry").  A corruption_suspected is
+    # ANSWERED by a later corruption_resolved / device_quarantined /
+    # recovery event (the rollback and quarantine ladders both emit
+    # one); an unanswered one means the run detected corruption and
+    # kept training on it — exactly the "trains wrong silently"
+    # failure the sentry exists to kill.  The scrub leg: a checkpoint
+    # the scrubber found corrupt that STILL stands as a committed
+    # restore target (quarantine=False, or the rename failed) is an
+    # ERROR — the next recovery would either refuse it (retention
+    # silently thinner) or, with verify=False, restore garbage.
+    answer_seqs = [e["seq"] for kind in
+                   ("corruption_resolved", "device_quarantined",
+                    "recovery")
+                   for e in telemetry.events(kind)]
+    for ev in telemetry.events("corruption_suspected"):
+        if any(s > ev["seq"] for s in answer_seqs):
+            continue
+        findings.append(Finding(
+            "MXL505",
+            f"corruption_suspected on {ev.get('where')!r} "
+            f"({ev.get('row')} fingerprints, suspect device(s) "
+            f"{ev.get('suspects')}) was never answered by a "
+            "rollback, quarantine, or recovery — the run kept "
+            "training on suspect state; set "
+            "MXTPU_INTEGRITY_ACTION=rollback|quarantine (and attach "
+            "owner.health_manager), or resolve and restart",
+            f"integrity:suspected:{ev['seq']}"))
+    from ..elastic import integrity as _integrity
+    for n, rec in enumerate(_integrity.scrub_log()):
+        if rec.get("ok") or rec.get("quarantined"):
+            continue
+        step = rec.get("step")
+        if step is None or step not in _mgr._committed_steps(
+                rec.get("dir", "")):
+            continue        # gone or already quarantined out of band
+        findings.append(Finding(
+            "MXL505",
+            f"checkpoint step {step} at {rec.get('dir')!r} failed its "
+            "scrub but still stands as a committed restore target — "
+            "the next recovery would refuse it (or restore garbage "
+            "with verify=False); quarantine it (scrub(quarantine="
+            "True)) or delete the dir",
+            f"integrity:scrub:{n}", severity=Severity.ERROR))
     return findings
 
 

@@ -27,7 +27,12 @@ package closes all three:
 * :mod:`.chaos` — the seeded chaos-soak certifier
   (:class:`~.chaos.Schedule` / :func:`~.chaos.soak`) that runs train +
   serve + resize under randomized composed faults and checks the
-  recovery invariants after every transition.
+  recovery invariants after every transition;
+* :mod:`.integrity` — the silent-corruption sentry: in-graph
+  cross-replica fingerprint agreement with device attribution,
+  seeded ``corrupt_*`` injection, quarantine-by-resize, and the
+  checkpoint/serving checksum legs (docs/elasticity.md, "Integrity
+  sentry").
 
 See docs/elasticity.md.
 """
@@ -39,7 +44,7 @@ from . import reshard
 __all__ = ["CheckpointManager", "Guardian", "PreemptionGuard",
            "ResizeController",
            "ServingAutoscaler", "chaos", "faults", "guardian",
-           "manager", "reshard", "resize"]
+           "integrity", "manager", "reshard", "resize"]
 
 
 def __getattr__(name):
@@ -59,7 +64,7 @@ def __getattr__(name):
         import importlib
         mod = importlib.import_module(".guardian", __name__)
         return mod if name == "guardian" else getattr(mod, name)
-    if name == "chaos":
+    if name in ("chaos", "integrity"):
         import importlib
-        return importlib.import_module(".chaos", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -498,6 +498,17 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
         # -- final boundary + serving drain --------------------------
         final_commit = mgr.save(block=True, force=True)
         commits.append(final_commit)
+        # integrity scrub over everything the soak committed: after
+        # the composed fault plan (torn writes, host-copy failures,
+        # rollback forks) every SURVIVING committed checkpoint must
+        # still verify — a rotten one would make the recovery anchors
+        # this whole certification rests on a lie
+        scrub_rep = mgr.scrub(quarantine=False)
+        if scrub_rep["corrupt"]:
+            _violate("committed_monotonic",
+                     f"scrub found {scrub_rep['corrupt']} corrupt "
+                     f"committed checkpoint(s): "
+                     f"{[r['step'] for r in scrub_rep['rows'] if not r['ok']]}")
         try:
             srv.run()
         except MXNetError:
@@ -574,6 +585,8 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
             "n_recoveries": len(recoveries),
             "preemptions": preemptions,
             "commits": sorted(set(commits)),
+            "scrub": {"checked": scrub_rep["checked"],
+                      "corrupt": scrub_rep["corrupt"]},
             "resize": resize_rec,
             "flood": flood_stats,
             "serving_stats": srv.stats(),
@@ -631,6 +644,11 @@ def render(artifact: dict) -> str:
         f"preemptions: {artifact.get('preemptions')}")
     for f in artifact.get("faults_fired", ()):
         lines.append(f"    step {f.get('step'):>4}  {f.get('spec')}")
+    sc = artifact.get("scrub")
+    if sc:
+        lines.append(
+            f"  scrub: {sc.get('checked')} committed checkpoint(s) "
+            f"re-verified, {sc.get('corrupt')} corrupt")
     rz = artifact.get("resize")
     if rz:
         lines.append(

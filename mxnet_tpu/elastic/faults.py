@@ -52,6 +52,16 @@ shims):
   consulted while a guardian/preemption guard is installed — without
   one the point never fires (and a raw SIGTERM would simply kill the
   process, which is not a drill).
+* ``corrupt_param`` / ``corrupt_grad`` / ``corrupt_wire`` — the
+  SILENT-corruption points (docs/elasticity.md, "Integrity sentry"):
+  no raise, no NaN — a seeded single-bit flip in one device's live
+  param buffer (host-side), or in one device's post-collective
+  gradient / received collective payload (the in-graph ctl-driven XOR
+  the step stacks bake while one of these is configured).  Qualifiers
+  ``device=D,leaf=J,bit=B`` pin the target; unspecified fields draw
+  from the ``MXTPU_FAULT_SEED`` stream.  The cross-replica integrity
+  fingerprints (``elastic.integrity``) are the detector these drills
+  exist to red→green test.
 * ``resize_drain`` / ``resize_prewarm`` / ``resize_reshard`` /
   ``resize_swap`` — the four transition points of a LIVE elastic
   resize (``elastic.resize.ResizeController``, docs/elasticity.md
@@ -90,7 +100,9 @@ from typing import Dict, List, Optional
 
 __all__ = ["FaultError", "FaultSpec", "configure", "configure_from_env",
            "clear", "active", "fired", "maybe_fire", "on_dispatch",
-           "nonfinite_due", "preempt_due", "POINTS",
+           "note_corruption_applied",
+           "nonfinite_due", "preempt_due", "corrupt_due",
+           "corrupt_armed", "POINTS", "CORRUPT_POINTS",
            "HANG_DEFAULT_MS"]
 
 #: the injection points wired into the runtime (unknown points parse —
@@ -99,7 +111,20 @@ POINTS = ("dispatch", "dispatch_post", "dispatch_hang",
           "checkpoint_write", "host_copy",
           "nonfinite_grad", "preempt_signal",
           "resize_drain", "resize_prewarm",
-          "resize_reshard", "resize_swap")
+          "resize_reshard", "resize_swap",
+          "corrupt_param", "corrupt_grad", "corrupt_wire")
+
+#: the silent-corruption points (docs/elasticity.md, "Integrity
+#: sentry"): they CORRUPT instead of crashing — ``corrupt_param``
+#: flips a bit in one device's live param buffer (host-side, real
+#: physical state corruption); ``corrupt_grad``/``corrupt_wire`` drive
+#: the ctl-driven in-graph XOR the step stacks bake while one of them
+#: is configured (flipping a bit in the targeted device's
+#: post-collective gradient / received collective payload).  Payload
+#: qualifiers ``device=D``, ``leaf=J``, ``bit=B`` pin the target;
+#: unspecified ones draw from the ``MXTPU_FAULT_SEED`` RNG, so a bare
+#: ``corrupt_param`` drill is random but replays exactly.
+CORRUPT_POINTS = ("corrupt_param", "corrupt_grad", "corrupt_wire")
 
 #: default ``dispatch_hang`` sleep when the spec carries no ``ms=``
 HANG_DEFAULT_MS = 1000
@@ -112,18 +137,24 @@ class FaultError(RuntimeError):
 
 class FaultSpec:
     __slots__ = ("point", "nth", "step", "times", "prob", "ms",
-                 "fired_count")
+                 "device", "leaf", "bit", "fired_count")
 
     def __init__(self, point: str, nth: Optional[int] = None,
                  step: Optional[int] = None, times: int = 1,
                  prob: Optional[float] = None,
-                 ms: Optional[int] = None):
+                 ms: Optional[int] = None,
+                 device: Optional[int] = None,
+                 leaf: Optional[int] = None,
+                 bit: Optional[int] = None):
         self.point = point
         self.nth = nth
         self.step = step
         self.times = times
         self.prob = prob
         self.ms = ms
+        self.device = device
+        self.leaf = leaf
+        self.bit = bit
         self.fired_count = 0
 
     @property
@@ -142,6 +173,10 @@ class FaultSpec:
             quals.append(f"prob={self.prob:g}")
         if self.ms is not None:
             quals.append(f"ms={self.ms}")
+        for k in ("device", "leaf", "bit"):
+            v = getattr(self, k)
+            if v is not None:
+                quals.append(f"{k}={v}")
         if self.times != (0 if self.prob is not None else 1):
             quals.append(f"times={self.times}")
         return self.point + (":" + ",".join(quals) if quals else "")
@@ -153,6 +188,13 @@ _counts: Dict[str, int] = {}
 _fired: List[str] = []
 #: fast-path flag: hooks read this one attribute and return when False
 _active = False
+#: sticky while a configuration holds an IN-GRAPH corruption spec
+#: (``corrupt_grad``/``corrupt_wire``): the step stacks bake the
+#: ctl-driven XOR block while this is set, and the flag deliberately
+#: survives spec exhaustion — it flips only at configure()/clear(), so
+#: a fired one-shot drill costs ONE retrace to arm and one to disarm,
+#: never a rebuild mid-drill
+_corrupt_armed = False
 #: the prob= qualifier's RNG — re-seeded by every :func:`configure`
 #: (from ``seed=`` or ``MXTPU_FAULT_SEED``), so a random plan replays
 #: deterministically: same seed + same arrival sequence = same firings
@@ -174,12 +216,13 @@ def _parse(text: str) -> List[FaultSpec]:
                 continue
             k, _, v = q.partition("=")
             k = k.strip()
-            if k not in ("nth", "step", "times", "prob", "ms") \
+            if k not in ("nth", "step", "times", "prob", "ms",
+                         "device", "leaf", "bit") \
                     or not v.strip():
                 raise ValueError(
                     f"bad fault qualifier {q!r} in {raw!r} "
                     "(expected nth=N, step=N, times=K, prob=P, "
-                    "or ms=N)")
+                    "ms=N, device=D, leaf=J, or bit=B)")
             try:
                 kw[k] = float(v) if k == "prob" else int(v)
             except ValueError:
@@ -197,7 +240,10 @@ def _parse(text: str) -> List[FaultSpec]:
                                times=int(kw.get("times",
                                                 default_times)),
                                prob=prob,
-                               ms=kw.get("ms")))
+                               ms=kw.get("ms"),
+                               device=kw.get("device"),
+                               leaf=kw.get("leaf"),
+                               bit=kw.get("bit")))
     return specs
 
 
@@ -217,7 +263,7 @@ def configure(text: Optional[str], seed: Optional[int] = None) -> int:
     grammar); ``None``/empty clears it.  Returns the spec count.
     Arrival counters, the fired log, and the ``prob=`` RNG (seeded by
     ``seed`` or ``MXTPU_FAULT_SEED``) reset with each configure."""
-    global _active
+    global _active, _corrupt_armed
     specs = _parse(text) if text else []
     unknown = [s.point for s in specs if s.point not in POINTS]
     if unknown:
@@ -235,6 +281,9 @@ def configure(text: Optional[str], seed: Optional[int] = None) -> int:
         _fired.clear()
         _rng.seed(_seed_from_env() if seed is None else int(seed))
         _active = bool(specs)
+        _corrupt_armed = any(s.point in ("corrupt_grad",
+                                         "corrupt_wire")
+                             for s in specs)
     return len(specs)
 
 
@@ -388,6 +437,65 @@ def preempt_due(where: str = "") -> bool:
     except Exception:
         pass
     return True
+
+
+def corrupt_armed() -> bool:
+    """Is an IN-GRAPH corruption spec (``corrupt_grad`` /
+    ``corrupt_wire``) part of the current configuration?  The step
+    stacks bake the ctl-driven XOR block while True (their trace
+    signature folds this in, so arming/clearing a drill retraces once
+    with attribution; production programs are byte-identical when no
+    drill is configured).  Sticky across spec exhaustion — see
+    :data:`_corrupt_armed`."""
+    return _corrupt_armed
+
+
+def corrupt_due(point: str) -> Optional[Dict[str, int]]:
+    """One of the silent-corruption points (``corrupt_param`` /
+    ``corrupt_grad`` / ``corrupt_wire``): when a spec is due, returns
+    its target payload ``{device, leaf, bit}`` — pinned by the spec's
+    ``device=``/``leaf=``/``bit=`` qualifiers, unspecified fields
+    drawn from the seeded RNG (same seed + same arrival sequence =
+    same targets).  The caller applies the corruption: host buffer
+    flip for ``corrupt_param`` (``elastic.integrity.
+    corrupt_param_host``), the in-graph ctl vector for the other two
+    — and the APPLIER records the one ``fault_injected`` event with
+    the CLAMPED values it actually used (the raw draws here may
+    exceed the owner's device/leaf counts; see
+    :func:`note_corruption_applied`).  Returns ``None`` when nothing
+    fires."""
+    if not _active:
+        return None
+    spec = _check(point)
+    if spec is None:
+        return None
+    with _lock:
+        payload = {
+            "device": int(spec.device) if spec.device is not None
+            else _rng.randrange(4096),
+            "leaf": int(spec.leaf) if spec.leaf is not None
+            else _rng.randrange(4096),
+            "bit": int(spec.bit) if spec.bit is not None
+            else _rng.randrange(32),
+        }
+    return payload
+
+
+def note_corruption_applied(point: str, **applied):
+    """The corruption appliers' single telemetry row: ONE
+    ``fault_injected`` event per firing, carrying the clamped target
+    actually corrupted (``integrity.corrupt_param_host`` /
+    ``integrity.ctl_vector`` call it — ``corrupt_due`` itself records
+    nothing, so one injection never double-counts)."""
+    try:
+        from .. import telemetry
+        telemetry.record_event("fault_injected", point=point,
+                               **applied)
+        telemetry.counter(
+            "mxtpu_faults_injected_total",
+            "faults fired by the MXTPU_FAULT_INJECT plan").inc()
+    except Exception:
+        pass
 
 
 def _consume_donated(arrays, donate):

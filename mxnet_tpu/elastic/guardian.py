@@ -330,6 +330,7 @@ def drain_server(server, directory: str) -> dict:
     in ``serving-drain.json`` under ``directory`` so a RESTARTED
     process can resubmit them (:func:`restore_drained_requests`).
     Returns ``{"requeued", "queued", "manifest"}``."""
+    from . import integrity as _integrity
     residents = server.sched.active_requests()
     queued = list(server.sched.queue)
     rows = []
@@ -340,6 +341,11 @@ def drain_server(server, directory: str) -> dict:
             "temperature": float(req.temperature),
             "eos_id": req.eos_id,
             "generated": [int(t) for t in req.generated],
+            # integrity row: restore_drained_requests refuses a
+            # manifest whose token state rotted on disk — a corrupt
+            # resident must replay LOUDLY, not decode garbage
+            "sha256": _integrity.token_checksum(req.prompt,
+                                                req.generated),
         })
     # reverse: evict(requeue=True) pushes to the queue HEAD, so
     # iterating backwards preserves the residents' relative order
@@ -365,12 +371,29 @@ def restore_drained_requests(server, path: str) -> list:
     Deadlines are NOT re-applied (they dated the preempted process).
     Returns the new ``Request`` objects in manifest order."""
     import numpy as np
+    from . import integrity as _integrity
     with open(path) as f:
         m = json.load(f)
     if m.get("kind") != "mxtpu_serving_drain" or m.get("format") != 1:
         raise MXNetError(f"{path} is not a serving drain manifest")
+    rows = list(m.get("requests", ()))
+    # validate EVERY checksum before the first submit: a rotten row
+    # must not leave a partial restore behind (a retry after dropping
+    # it would double-submit the rows that already landed)
+    for i, row in enumerate(rows):
+        want = row.get("sha256")
+        if want is not None and want != _integrity.token_checksum(
+                row["prompt"], row.get("generated", ())):
+            # pre-checksum manifests (no sha256 row) restore as
+            # before; a ROW THAT ROTTED refuses loudly — resubmitting
+            # a silently-corrupt prompt would decode garbage with no
+            # event anywhere
+            raise MXNetError(
+                f"serving drain manifest {path} row {i} failed its "
+                "token checksum — the manifest is corrupt; drop the "
+                "row or re-drain")
     out = []
-    for row in m.get("requests", ()):
+    for row in rows:
         out.append(server.submit(
             np.asarray(row["prompt"], np.float32),
             max_new_tokens=int(row["max_new_tokens"]),

@@ -397,6 +397,15 @@ class CheckpointManager:
         self._pool = None
         self._pending = None
         self._lock = threading.Lock()
+        #: the exact-resume data cursor (docs/elasticity.md): the
+        #: training loop's loader position (epoch/batch/whatever the
+        #: loop needs), stamped into every manifest by save() and
+        #: re-installed by restore() — with the RNG stream that
+        #: already round-trips, a recover() replays the EXACT batch
+        #: stream instead of restarting the loader arbitrarily
+        self.cursor: Optional[Dict[str, Any]] = None
+        self._scrub_thread = None
+        self._scrub_stop = threading.Event()
         #: step last restored through THIS manager — committed dirs
         #: NEWER than it belong to the abandoned pre-rollback timeline,
         #: and a periodic save colliding with one auto-overwrites
@@ -425,6 +434,8 @@ class CheckpointManager:
         if step is not None:
             payload["step"] = int(step)
         payload["rng"] = _rng_export()
+        payload["cursor"] = dict(self.cursor) \
+            if self.cursor is not None else None
         # decouple from the next step's donation NOW, on the caller
         # thread (async device-side copies; the writer gathers later)
         _snapshot_payload(payload)
@@ -464,11 +475,146 @@ class CheckpointManager:
         self._drain(swallow=False)
 
     def close(self):
+        self.stop_scrub()
         self._drain(swallow=True)
         with self._lock:
             if self._pool is not None:
                 self._pool.shutdown(wait=True)
                 self._pool = None
+
+    # -- exact-resume data cursor ----------------------------------------
+    def set_cursor(self, epoch: int, batch: int, **extra) -> None:
+        """Record where the training loop's batch stream stands —
+        called once per batch (or per epoch) by the loop.  The NEXT
+        ``save()`` stamps it into the manifest; ``restore()``
+        re-installs it as ``self.cursor`` so a resumed loop can seek
+        its loader to the exact position (the RNG stream already
+        round-trips, so data order + augmentation replay exactly —
+        docs/elasticity.md, "Exact resume")."""
+        cur = {"epoch": int(epoch), "batch": int(batch)}
+        cur.update(extra)
+        self.cursor = cur
+
+    # -- scrubbing (docs/elasticity.md, "Integrity sentry") --------------
+    def scrub(self, quarantine: bool = True) -> dict:
+        """Re-verify every committed checkpoint's shard sha256s — the
+        at-rest leg of the silent-corruption sentry: a shard that rots
+        on disk AFTER its commit passed would otherwise sit in the
+        retention window until a recovery needed it, then fail at the
+        worst possible moment (or, with verification skipped, restore
+        garbage).
+
+        A corrupt checkpoint is QUARANTINED (its dir renamed to
+        ``quarantined-step-N``, out of the committed namespace) so
+        ``restore()``/``latest_step()`` can never serve it and an
+        older clean step becomes the recovery anchor; pass
+        ``quarantine=False`` to report only — mxlint MXL505 then
+        flags the corrupt dir still standing as a restore target.
+        Emits the retained ``scrub_corrupt`` event per bad checkpoint
+        and the ``mxtpu_scrub_*`` counters; every verdict lands in
+        ``elastic.integrity.scrub_log()`` (the MXL505 input).
+        Returns ``{"checked", "corrupt", "quarantined", "rows"}``."""
+        from .. import telemetry
+        from . import integrity as _integrity
+        t0 = time.perf_counter()
+        rows = []
+        corrupt = 0
+        quarantined = []
+        for row in verify_dir(self.directory):
+            if row.get("partial"):
+                continue          # torn temp dirs are MXL502's beat
+            rec = {"dir": self.directory, "step": row["step"],
+                   "ok": row["ok"], "quarantined": False}
+            if not row["ok"]:
+                # double-check under the swap lock before believing
+                # it: the first pass reads UNSYNCHRONIZED, so a
+                # force-overwrite mid-swap (rename final -> .old;
+                # rename tmp -> final) can transiently read as
+                # corrupt — the background scrubber must never
+                # quarantine a healthy, freshly committed step.  The
+                # rename also happens under the lock, so it cannot
+                # race the writer's own renames.
+                src = _step_dir(self.directory, int(row["step"]))
+                with _SWAP_LOCK:
+                    try:
+                        _load_checkpoint(src, verify=True)
+                        rec["ok"] = True       # transient: swap race
+                    except MXNetError:
+                        if quarantine:
+                            dst = os.path.join(
+                                self.directory,
+                                "quarantined-step-"
+                                f"{int(row['step']):08d}")
+                            try:
+                                shutil.rmtree(dst,
+                                              ignore_errors=True)
+                                os.rename(src, dst)
+                                rec["quarantined"] = True
+                                quarantined.append(int(row["step"]))
+                            except OSError as e:
+                                rec["quarantine_error"] = \
+                                    repr(e)[:200]
+            if not rec["ok"]:
+                corrupt += 1
+                telemetry.counter(
+                    "mxtpu_scrub_corrupt_total",
+                    "committed checkpoints the scrubber found "
+                    "corrupt at rest").inc()
+                telemetry.record_event(
+                    "scrub_corrupt", dir=self.directory,
+                    step=int(row["step"]),
+                    errors=[e[:200] for e in row.get("errors", ())],
+                    quarantined=rec["quarantined"])
+            _integrity.note_scrub(rec)
+            rows.append(rec)
+        telemetry.counter(
+            "mxtpu_scrub_passes_total",
+            "checkpoint scrub passes completed").inc()
+        telemetry.counter(
+            "mxtpu_scrub_checkpoints_total",
+            "committed checkpoints re-verified by the scrubber"
+            ).inc(len(rows))
+        telemetry.histogram(
+            "mxtpu_scrub_seconds",
+            "wall clock of one checkpoint scrub pass (s)").observe(
+            time.perf_counter() - t0)
+        return {"checked": len(rows), "corrupt": corrupt,
+                "quarantined": quarantined, "rows": rows}
+
+    def start_scrub(self, every_s: Optional[float] = None) -> bool:
+        """Run :meth:`scrub` on a background daemon thread every
+        ``every_s`` seconds (default ``MXTPU_SCRUB_EVERY_S``; <= 0
+        starts nothing).  Idempotent; :meth:`stop_scrub`/:meth:`close`
+        stops it."""
+        from .. import envs
+        if every_s is None:
+            every_s = float(envs.get("MXTPU_SCRUB_EVERY_S"))
+        if every_s <= 0 or self._scrub_thread is not None:
+            return False
+        self._scrub_stop.clear()
+
+        def _loop():
+            while not self._scrub_stop.wait(every_s):
+                try:
+                    self.scrub()
+                except Exception as e:
+                    from .. import telemetry
+                    telemetry.record_event(
+                        "checkpoint_error",
+                        error=f"scrub failed: {e!r}"[:300])
+
+        self._scrub_thread = threading.Thread(
+            target=_loop, name="mxtpu-scrub", daemon=True)
+        self._scrub_thread.start()
+        return True
+
+    def stop_scrub(self) -> None:
+        t = self._scrub_thread
+        if t is None:
+            return
+        self._scrub_stop.set()
+        t.join(timeout=5.0)
+        self._scrub_thread = None
 
     def _write(self, payload: Dict[str, Any], force: bool):
         from .. import telemetry
@@ -516,6 +662,9 @@ class CheckpointManager:
             # canonical plan this checkpoint was saved under — the
             # audit trail a cross-plan restore's reshard report reads
             "plan": payload.get("plan"),
+            # the exact-resume data cursor (set_cursor): where the
+            # batch stream stood at this commit
+            "cursor": payload.get("cursor"),
             "rng": payload["rng"],
             "shards": shards,
         }
@@ -614,6 +763,11 @@ class CheckpointManager:
             _rng_restore(manifest.get("rng", {}))
         restored = int(manifest["step"])
         self._resume_step = restored
+        # re-install the data cursor this checkpoint was saved under
+        # (None for pre-cursor manifests): the resumed loop reads
+        # manager.cursor and seeks its loader there — with the RNG
+        # restore below, the batch stream replays exactly
+        self.cursor = manifest.get("cursor")
         if invalidate_newer:
             dropped = [s for s in self.steps() if s > restored]
             for s in dropped:

@@ -740,7 +740,16 @@ def clear_cache(persistent: bool = False):
     """Empty the in-memory jit cache.  ``persistent=True`` also removes
     every on-disk entry in ``MXTPU_COMPILE_CACHE_DIR`` — the scope is
     explicit because the persistent tier is exactly the state meant to
-    OUTLIVE a process-level reset."""
+    OUTLIVE a process-level reset.
+
+    Safe around persist reloads: executables DESERIALIZED from the
+    persistent tier are pinned for the life of the process
+    (``persist._loaded_execs``) — on jaxlib CPU, garbage-collecting a
+    deserialized sharded executable after its cache entry drops
+    segfaults nondeterministically (the PR 13 CAUTION), so the entry
+    eviction here never triggers their teardown.  Repeated
+    ``clear_cache()`` calls are therefore safe; only the (cheap)
+    Python-side cache bookkeeping is released."""
     with _lock:
         _jit_cache.clear()
     # attribution history follows the cache it describes

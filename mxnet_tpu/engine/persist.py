@@ -331,13 +331,36 @@ def fetch(persist_name: str, sig, donate, avals,
     return fn, header
 
 
+# process-lifetime strong refs to every DESERIALIZED executable
+# (``se.deserialize_and_load`` results).  The PR 13 CAUTION made this
+# load-bearing: on jaxlib CPU, letting a deserialized sharded
+# executable be garbage-collected after ``engine.clear_cache()`` —
+# while the runtime still holds internal references — segfaults/aborts
+# the process NONDETERMINISTICALLY later on (reproduced bracketing the
+# warm-start persist tests with extra clears).  Keeping the loaded
+# objects alive for the life of the process sidesteps the teardown
+# entirely: a deserialized executable is small (the serialized bytes
+# already lived on disk), and repeated clear_cache() calls are now
+# safe around persist reloads.  See docs/compile_cache.md ("Safe
+# cache-clear recipe").
+_loaded_execs: list = []
+
+
+def deserialized_alive() -> int:
+    """How many deserialized executables the keep-alive guard holds
+    (diagnostics + the clear_cache regression test)."""
+    return len(_loaded_execs)
+
+
 def _deserialize(header: dict, payload: bytes, donate):
     kind = header.get("kind")
     if kind == "exec":
         import pickle
         from jax.experimental import serialize_executable as se
         blob, in_tree, out_tree = pickle.loads(payload)
-        return se.deserialize_and_load(blob, in_tree, out_tree)
+        fn = se.deserialize_and_load(blob, in_tree, out_tree)
+        _loaded_execs.append(fn)
+        return fn
     if kind == "export":
         import jax
         import jax.export  # explicit: not re-exported from the jax ns

@@ -232,6 +232,33 @@ _reg("MXTPU_HEALTH_PATIENCE", int, 3,
      "Consecutive anomalous health samples before the sentinel "
      "escalates to a 'divergence' verdict (the rollback trigger for "
      "non-NaN divergence).")
+_reg("MXTPU_INTEGRITY", bool, True,
+     "Silent-corruption sentry (elastic.integrity; docs/elasticity.md "
+     "'Integrity sentry'): per-dp-replica bitwise fingerprints of the "
+     "fused SPMD step's params and post-collective gradients ride the "
+     "health vector under the same lax.cond(due) sampling gate, and "
+     "the host sentinel audits cross-replica agreement — a minority "
+     "replica is flagged as a corruption suspect WITH device "
+     "attribution (retained corruption_suspected event). Rides the "
+     "health plane: inert whenever MXTPU_HEALTH=0/MXTPU_TELEMETRY=0 "
+     "or the mesh has no >1 dp axis (the program is then identical "
+     "to a pre-integrity build). 0 removes the fingerprint rows.")
+_reg("MXTPU_INTEGRITY_ACTION", str, "warn",
+     "What an integrity_divergence verdict does: 'warn' records the "
+     "retained corruption_suspected event only; 'rollback' restores "
+     "the last committed checkpoint through recover(manager) — the "
+     "corrupt state is discarded; 'quarantine' additionally resizes "
+     "the live trainer onto a mesh EXCLUDING the suspect device "
+     "(ResizeController drain -> reshard -> pre-warmed swap, retained "
+     "device_quarantined event). rollback/quarantine need "
+     "owner.health_manager attached.")
+_reg("MXTPU_SCRUB_EVERY_S", float, 0.0,
+     "Background checkpoint-scrub cadence for "
+     "CheckpointManager.start_scrub(): every N seconds the committed "
+     "shard sha256s are re-verified and a rotten checkpoint is "
+     "quarantined out of the restore path (retained scrub_corrupt "
+     "event + mxtpu_scrub_* counters). 0 (default) = no background "
+     "thread; scrub() stays callable manually.")
 _reg("MXTPU_SERVING_SLOTS", int, 4,
      "Default batch slots per serving bucket (concurrent requests one "
      "compiled decode program advances in lockstep) when "

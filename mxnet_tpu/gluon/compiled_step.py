@@ -174,6 +174,7 @@ class CompiledStep:
         # ignoring the env var would read as "memory didn't drop".
         # One retained event per step object says why.
         self._zero_noted = False
+        self._integrity_noted = False
 
     # -- public API -------------------------------------------------------
     def step(self, data, label, batch_size=None):
@@ -558,6 +559,24 @@ class CompiledStep:
                        "sharded update needs the SPMD "
                        "DataParallelTrainer's dp mesh axis "
                        "(docs/zero.md)")
+        if not self._integrity_noted:
+            from ..elastic import faults as _faults
+            if _faults._active and any(
+                    s.point in _faults.CORRUPT_POINTS
+                    for s in _faults._specs):
+                # a corruption drill armed where no cross-replica
+                # detector exists (single context = one replica —
+                # nothing to disagree with): the drill would "fire"
+                # while proving nothing, so say so once, loudly
+                self._integrity_noted = True
+                from .. import telemetry
+                telemetry.record_event(
+                    "integrity_inapplicable", name=self.name,
+                    reason="CompiledStep is single-context; the "
+                           "corrupt_* drills need the SPMD "
+                           "DataParallelTrainer's >1-device dp axis "
+                           "for the cross-replica agreement audit "
+                           "(docs/elasticity.md, 'Integrity sentry')")
         # optimizer-capability checks (fused plan / tensor support) run
         # in _check_sig, which builds the plan ONCE per dispatch anyway
         return None

@@ -459,20 +459,51 @@ class DataParallelTrainer:
                 "restoring")
         self._finish_setup(params)
 
+    def _integrity_sig(self):
+        """The integrity sentry's trace signature for THIS trainer
+        (``elastic.integrity``): ``None`` on a <=1-dp mesh or with the
+        plane off — the program is then byte-identical to a
+        pre-integrity build.  Grad fingerprint rows are dropped under
+        ZeRO stage 2, whose replicated gradient never materializes
+        (docs/zero.md)."""
+        from ..elastic import integrity as _integrity
+        return _integrity.trace_signature(
+            self.mesh, self.dp_axis,
+            grad_rows=self._zero_stage != 2)
+
+    def _build_integrity_spec(self):
+        from ..elastic import integrity as _integrity
+        return _integrity.build_spec(self.mesh, self.dp_axis,
+                                     grad_rows=self._zero_stage != 2)
+
+    def _integrity_struct_sig(self):
+        from ..elastic import integrity as _integrity
+        return _integrity.struct_signature(
+            grad_rows=self._zero_stage != 2)
+
     def _refresh_health(self):
-        """(Re)build the health spec when the ``MXTPU_HEALTH*`` config
-        the compiled programs bake drifted.  A flip after programs were
-        built evicts them (they return a different output arity) with
-        an attributed ``retrace`` event — the same correctness-over-
-        cache-warmth rule as ``CompiledStep._check_sig``."""
+        """(Re)build the health spec when the ``MXTPU_HEALTH*`` /
+        ``MXTPU_INTEGRITY*`` config the compiled programs bake drifted
+        (the integrity sentry's fingerprint rows ride the health
+        vector, and arming a corruption drill adds the ctl input).  A
+        flip after programs were built evicts them (they return a
+        different output arity) with an attributed ``retrace`` event —
+        the same correctness-over-cache-warmth rule as
+        ``CompiledStep._check_sig``."""
         from .. import telemetry
-        cfg = telemetry.health.trace_signature()
+        hcfg = telemetry.health.trace_signature()
+        icfg = self._integrity_sig() if hcfg is not None else None
+        # bare health tuple when integrity is off, so every
+        # pre-integrity built-signature (and the single-device paths)
+        # compares unchanged
+        cfg = hcfg if icfg is None else (hcfg, icfg)
         if cfg == self._health_built_sig:
             return
         spec = telemetry.health.build_spec(
             self.block.name,
-            [self._params[i].name for i in self._tr_idx]) \
-            if cfg is not None else None
+            [self._params[i].name for i in self._tr_idx],
+            integrity=self._build_integrity_spec()) \
+            if hcfg is not None else None
         if self._health_built_sig != cfg and (
                 self._full_fn is not None or
                 self._full_step is not None):
@@ -480,7 +511,12 @@ class DataParallelTrainer:
                 def _lbl(c):
                     if c is None:
                         return "off"
-                    return "on(skip-gate)" if c[2] else "on"
+                    h = c[0] if isinstance(c[0], tuple) else c
+                    lbl = "on(skip-gate)" if h[2] else "on"
+                    if isinstance(c[0], tuple) and c[1] is not None:
+                        lbl += "+integrity" + (
+                            "(inject)" if c[1][4] else "")
+                    return lbl
                 telemetry.counter(
                     "mxtpu_retraces_total",
                     "cache misses attributable to a changed "
@@ -683,12 +719,30 @@ class DataParallelTrainer:
         tr_idx = self._tr_idx
         traced = self._traced_fn
         hspec = self._health_spec
+        ispec = hspec.integrity if hspec is not None else None
+        mesh = self.mesh
+        dp_axis = self.dp_axis
         mutated_idx = self._mutated_idx
 
         def full(param_vals, tstate_vals, scalar_vals, input_vals,
-                 label_val, key_raw, due=None):
+                 label_val, key_raw, due=None, ictl=None):
             loss, grads, aux = traced(param_vals, input_vals, label_val,
                                       key_raw)
+            old_tr = tuple(param_vals[i] for i in tr_idx)
+            irows = None
+            if ispec is not None:
+                # the integrity sentry (elastic.integrity): per-dp-
+                # replica fingerprints of the input params + the
+                # gradients, computed by one inner shard_map under the
+                # same `due` sampling gate.  With a corruption drill
+                # armed the block also XORs the targeted device's
+                # gradient BEFORE the update reads it — the corruption
+                # enters the real dataflow and the same block's grad
+                # rows detect it.
+                from ..elastic import integrity as _integrity
+                grads, irows = _integrity.jit_block(
+                    ispec, mesh, dp_axis, old_tr, grads, due=due,
+                    ictl=ictl)
             new_params, new_states = _apply_rule(
                 rule, opt, len(tr_idx), n_scalars,
                 lambda j: param_vals[tr_idx[j]], tstate_vals, grads,
@@ -700,9 +754,11 @@ class DataParallelTrainer:
             # mean), so grad_norm is the cross-replica norm for free;
             # `due` gates the reductions to sampled steps
             from ..telemetry import health as _health
-            old_tr = tuple(param_vals[i] for i in tr_idx)
+            import jax.numpy as jnp
             hvec = _health.compute(hspec, loss, old_tr, grads,
                                    new_params, due=due)
+            if irows is not None:
+                hvec = jnp.concatenate([hvec, irows])
             if hspec.skip:
                 new_params, new_states, aux = _health.gate_update(
                     hvec, new_params, old_tr, new_states, tstate_vals,
@@ -724,6 +780,8 @@ class DataParallelTrainer:
         if hspec is not None:
             out_shardings = out_shardings + (None,)
             in_shardings = in_shardings + (None,)   # the due flag
+            if ispec is not None and ispec.inject:
+                in_shardings = in_shardings + (None,)   # the ctl row
         self._full_step = jax.jit(
             full,
             in_shardings=in_shardings,
@@ -763,10 +821,14 @@ class DataParallelTrainer:
         n_dp = int(self.mesh.shape[axis])
         use_residual = ctype == "2bit"
         hspec = self._health_spec
+        ispec = hspec.integrity if hspec is not None else None
+        other_axes = tuple(a for a in self.mesh.axis_names
+                           if a != axis)
         mutated_idx = self._mutated_idx
 
         def full(param_vals, tstate_vals, scalar_vals, input_vals,
-                 label_val, key_raw, residual_vals, due=None):
+                 label_val, key_raw, residual_vals, due=None,
+                 ictl=None):
             dev_key = jax.random.key_data(jax.random.fold_in(
                 jax.random.wrap_key_data(key_raw),
                 lax.axis_index(axis)))
@@ -783,6 +845,19 @@ class DataParallelTrainer:
                     red_grads.append(total / n_dp)
                     new_residuals.append(
                         new_r.reshape((1,) + g.shape))
+            old_tr = tuple(param_vals[i] for i in tr_idx)
+            irows = None
+            if ispec is not None:
+                from ..elastic import integrity as _integrity
+                # a corrupt_wire/corrupt_grad drill flips a bit in the
+                # targeted device's POST-exchange gradient — exactly
+                # the payload a corrupt collective link delivers; the
+                # fingerprint rows below see it with attribution
+                red_grads = list(_integrity.maybe_corrupt(
+                    ispec, ictl, tuple(red_grads), axis))
+                irows = _integrity.body_rows(
+                    ispec, axis, other_axes, old_tr,
+                    tuple(red_grads), due=due)
             new_params, new_states = _apply_rule(
                 rule, opt, len(tr_idx), n_scalars,
                 lambda j: param_vals[tr_idx[j]], tstate_vals,
@@ -797,10 +872,12 @@ class DataParallelTrainer:
             # values the update actually applies, identical on every
             # device, so the vector replicates cleanly
             from ..telemetry import health as _health
-            old_tr = tuple(param_vals[i] for i in tr_idx)
             hvec = _health.compute(hspec, loss, old_tr,
                                    tuple(red_grads), new_params,
                                    due=due)
+            if irows is not None:
+                import jax.numpy as jnp
+                hvec = jnp.concatenate([hvec, irows])
             if hspec.skip:
                 new_params, new_states, aux = _health.gate_update(
                     hvec, new_params, old_tr, new_states, tstate_vals,
@@ -834,6 +911,8 @@ class DataParallelTrainer:
         if hspec is not None:
             out_specs = out_specs + (repl,)
             in_specs = in_specs + (repl,)           # the due flag
+            if ispec is not None and ispec.inject:
+                in_specs = in_specs + (repl,)       # the ctl row
         mapped = shard_map(
             full, mesh=self.mesh,
             in_specs=in_specs,
@@ -891,10 +970,13 @@ class DataParallelTrainer:
         stage = self._zero_stage
         quantized = self._compression_cfg is not None
         hspec = self._health_spec
+        ispec = hspec.integrity if hspec is not None else None
+        other_axes = tuple(a for a in self.mesh.axis_names
+                           if a != axis)
         mutated_idx = self._mutated_idx
 
         def full(param_vals, tstate_vals, scalar_vals, input_vals,
-                 label_val, key_raw, due=None):
+                 label_val, key_raw, due=None, ictl=None):
             # per-device dropout keys decorrelate across the axis
             # (same scheme as the compressed step)
             dev_key = jax.random.key_data(jax.random.fold_in(
@@ -968,6 +1050,23 @@ class DataParallelTrainer:
                 return loss, new_params, new_states, aux
             from ..telemetry import health as _health
             old_tr = tuple(param_vals[i] for i in tr_idx)
+            irows = None
+            if ispec is not None:
+                from ..elastic import integrity as _integrity
+                if reduce_full:
+                    # stage 1's replicated post-exchange gradients
+                    # carry the agreement audit (a corrupt_grad/
+                    # corrupt_wire drill flips the targeted device's
+                    # copy); stage 2 never materializes them — its
+                    # spec drops the grad rows and corrupt_param (the
+                    # host drill on the replicated param inputs) is
+                    # the end-to-end exercise
+                    red_grads = list(_integrity.maybe_corrupt(
+                        ispec, ictl, tuple(red_grads), axis))
+                irows = _integrity.body_rows(
+                    ispec, axis, other_axes, old_tr,
+                    tuple(red_grads) if reduce_full else None,
+                    due=due)
             if reduce_full:
                 hvec = _health.compute(hspec, loss, old_tr,
                                        tuple(red_grads), new_params,
@@ -994,6 +1093,8 @@ class DataParallelTrainer:
                     hspec, loss, old_tr,
                     [sq_global[j] for j in range(len(tr_idx))],
                     new_params, due=due)
+            if irows is not None:
+                hvec = jnp.concatenate([hvec, irows])
             if hspec.skip:
                 new_params, new_states, aux = _health.gate_update(
                     hvec, new_params, old_tr, new_states, tstate_vals,
@@ -1006,6 +1107,8 @@ class DataParallelTrainer:
         if hspec is not None:
             out_specs = out_specs + (repl,)
             in_specs = in_specs + (repl,)           # the due flag
+            if ispec is not None and ispec.inject:
+                in_specs = in_specs + (repl,)       # the ctl row
         # check_vma=False for the same reason as the compressed step:
         # all_gather-built outputs are vma-typed "varying" though every
         # member computes identical values
@@ -1033,6 +1136,7 @@ class DataParallelTrainer:
             return self._persist_pin
         import hashlib
         from .. import telemetry
+        integ_sig = self._integrity_sig()
         parts = (type(self.optimizer).__name__,
                  tuple((tuple(p.data().shape), str(p.data().dtype))
                        for p in self._params),
@@ -1048,6 +1152,12 @@ class DataParallelTrainer:
                  # pre-ZeRO manifest + persisted executable) survive
                  # this release unchanged
                  telemetry.health.trace_signature()) + (
+                     # integrity fingerprint rows widen the health
+                     # vector (and a drill adds the ctl input) —
+                     # appended only when armed so single-device and
+                     # integrity-off hashes stay stable
+                     (integ_sig,) if integ_sig is not None else ()
+                 ) + (
                      (self._zero_stage,) if self._zero_stage else ()
                  ) + (
                      # the plan pin: a plan-driven trainer's rules are
@@ -1067,6 +1177,7 @@ class DataParallelTrainer:
         manifest from a different model can never be adopted."""
         import hashlib
         from .. import telemetry
+        integ_struct = self._integrity_struct_sig()
         parts = (type(self.optimizer).__name__,
                  tuple((tuple(p.data().shape), str(p.data().dtype))
                        for p in self._params),
@@ -1074,6 +1185,14 @@ class DataParallelTrainer:
                  self.dp_axis,
                  # stage appended only when nonzero — see _persist_name
                  telemetry.health.trace_signature()) + (
+                     # mesh-size-independent integrity identity
+                     # (elastic.integrity.struct_signature): NOT n_dp
+                     # — the reshard path legitimately changes it, and
+                     # a dp=1 save (no fingerprint rows) must still
+                     # warm-reshard onto dp>1 (re-AOT either way)
+                     (integ_struct,) if integ_struct is not None
+                     else ()
+                 ) + (
                      (self._zero_stage,) if self._zero_stage else ()
                  ) + (
                      # mesh-size-independent plan identity: rules +
@@ -1929,7 +2048,8 @@ class DataParallelTrainer:
                  self._zero_body, self._full_exec,
                  self._multi_step_cache, self._multi_fns,
                  self._multi_exec, self._persist_pin, self.plan,
-                 self._param_sharding)
+                 self._param_sharding, self._health_spec,
+                 self._health_built_sig)
         try:
             self.mesh = mesh
             # the target plan/rules drive the builders'
@@ -1946,6 +2066,15 @@ class DataParallelTrainer:
             self._multi_step_cache = {}
             self._multi_fns = {}
             self._multi_exec = {}
+            # the integrity fingerprint rows bake the dp SIZE (one
+            # all_gather lane per replica): the target-mesh programs
+            # must be built against the TARGET spec, and the swap
+            # adopts it — otherwise the first post-swap
+            # _refresh_health would evict every pre-warmed executable
+            # (a broken pre-warm contract, the exact MXL503 hazard)
+            self._health_spec = None
+            self._health_built_sig = None
+            self._refresh_health()
             if self._zero_stage:
                 self._build_full_step_zero()
             else:
@@ -1986,13 +2115,16 @@ class DataParallelTrainer:
                 "multi_step_cache": self._multi_step_cache,
                 "multi_fns": self._multi_fns,
                 "multi_exec": self._multi_exec,
+                "health_spec": self._health_spec,
+                "health_built_sig": self._health_built_sig,
             }
         finally:
             (self.mesh, self._full_step, self._full_fn,
              self._zero_body, self._full_exec,
              self._multi_step_cache, self._multi_fns,
              self._multi_exec, self._persist_pin, self.plan,
-             self._param_sharding) = saved
+             self._param_sharding, self._health_spec,
+             self._health_built_sig) = saved
         return staged
 
     def apply_resize(self, staged):
@@ -2082,6 +2214,13 @@ class DataParallelTrainer:
         self._multi_step_cache = staged["multi_step_cache"]
         self._multi_fns = staged["multi_fns"]
         self._multi_exec = staged["multi_exec"]
+        if "health_spec" in staged:
+            # the target-mesh health/integrity spec the pre-warm built
+            # against (its fingerprint rows bake the new dp size) —
+            # adopting it keeps the first post-swap _refresh_health a
+            # no-op, so the pre-warmed executables survive
+            self._health_spec = staged["health_spec"]
+            self._health_built_sig = staged["health_built_sig"]
         # the old pin (if any) baked the old mesh; the new mesh keys
         # its own persistent identities.  _fwd_bwd/_fused_update are
         # two-phase-path artifacts pinned to the old mesh — the fused
@@ -2260,6 +2399,11 @@ class DataParallelTrainer:
             # EVERY inner step
             from .. import telemetry as _tm
             args = _tm.health.poison_inputs(args)
+        if _faults2._active:
+            payload = _faults2.corrupt_due("corrupt_param")
+            if payload is not None:
+                from ..elastic import integrity as _integrity
+                _integrity.corrupt_param_host(self, payload)
         prev = autograd.set_training(True)
         try:
             if self._fwd_bwd is None:
@@ -2327,6 +2471,15 @@ class DataParallelTrainer:
                 from .. import telemetry as _tm
                 vals = vals + (jnp.asarray(_tm.health.due_flags(
                     self._health_count, k_steps)),)
+                if hs.integrity is not None and hs.integrity.inject:
+                    # per-inner-step corruption-ctl rows (K, 4): a
+                    # baked drill fires on the exact inner step its
+                    # spec selects
+                    from ..elastic import integrity as _integrity
+                    vals = vals + (jnp.asarray(np.stack(
+                        [_integrity.ctl_vector(hs.integrity,
+                                               len(tr_idx))
+                         for _ in range(k_steps)])),)
             from ..engine import persist as _persist
             if kk not in self._var_avals:
                 self._record_variant(
@@ -2464,31 +2617,47 @@ class DataParallelTrainer:
         tr_idx = self._tr_idx
         mutated_idx = self._mutated_idx
         has_health = self._health_spec is not None
+        _ispec = self._health_spec.integrity if has_health else None
+        # a corruption drill adds the per-inner-step ctl rows to the
+        # scanned xs (elastic.integrity; production programs carry
+        # only the due flags)
+        has_ictl = _ispec is not None and _ispec.inject
         # same count _build_full_step derives as n_scalars per param
         n_scal = len(self._rule.scalars(self.optimizer, 0, 1)) \
             * len(tr_idx)
 
         def full_k(param_vals, tstate_vals, scalar_k, inputs_k,
-                   label_k, keys_k, due_k=None):
+                   label_k, keys_k, due_k=None, ictl_k=None):
             def body(carry, xs):
                 params, tstates = carry
                 due = None
+                ictl = None
                 if repeated:
                     # the batch is a plain program input reused every
                     # inner step — no K host copies, no scanned axis
-                    if has_health:
+                    if has_ictl:
+                        scal_row, key, due, ictl = xs
+                    elif has_health:
                         scal_row, key, due = xs
                     else:
                         scal_row, key = xs
                     inputs, label = inputs_k, label_k
+                elif has_ictl:
+                    scal_row, inputs, label, key, due, ictl = xs
                 elif has_health:
                     scal_row, inputs, label, key, due = xs
                 else:
                     scal_row, inputs, label, key = xs
                 scal = tuple(scal_row[i] for i in range(n_scal))
-                out = full(params, tstates, scal, inputs, label, key,
-                           due) if has_health else \
-                    full(params, tstates, scal, inputs, label, key)
+                if has_ictl:
+                    out = full(params, tstates, scal, inputs, label,
+                               key, due, ictl)
+                elif has_health:
+                    out = full(params, tstates, scal, inputs, label,
+                               key, due)
+                else:
+                    out = full(params, tstates, scal, inputs, label,
+                               key)
                 if has_health:
                     loss, new_params, new_states, aux, hvec = out
                 else:
@@ -2502,12 +2671,17 @@ class DataParallelTrainer:
                 return (tuple(params), new_states), ys
 
             if repeated:
-                xs = (scalar_k, keys_k, due_k) if has_health else \
-                    (scalar_k, keys_k)
+                xs = (scalar_k, keys_k)
+                if has_health:
+                    xs = xs + (due_k,)
+                if has_ictl:
+                    xs = xs + (ictl_k,)
             else:
-                xs = (scalar_k, inputs_k, label_k, keys_k, due_k) \
-                    if has_health else \
-                    (scalar_k, inputs_k, label_k, keys_k)
+                xs = (scalar_k, inputs_k, label_k, keys_k)
+                if has_health:
+                    xs = xs + (due_k,)
+                if has_ictl:
+                    xs = xs + (ictl_k,)
             (params_f, tstates_f), ys = lax.scan(
                 body, (param_vals, tstate_vals), xs)
             if has_health:
@@ -2529,6 +2703,8 @@ class DataParallelTrainer:
             if has_health:
                 out_specs = out_specs + (repl,)
                 in_specs = in_specs + (repl,)   # the due flags
+                if has_ictl:
+                    in_specs = in_specs + (repl,)   # the ctl rows
             body = shard_map(
                 full_k, mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs, check_vma=False)
@@ -2548,6 +2724,8 @@ class DataParallelTrainer:
             if has_health:
                 out_shardings = out_shardings + (None,)
                 in_shardings = in_shardings + (None,)   # the due flags
+                if has_ictl:
+                    in_shardings = in_shardings + (None,)  # ctl rows
             body = full_k
             fn = jax.jit(
                 full_k,
@@ -2612,6 +2790,15 @@ class DataParallelTrainer:
             # program (same shapes — no retrace)
             from .. import telemetry as _tm
             args = _tm.health.poison_inputs(args)
+        if _faults._active:
+            # the corrupt_param drill: a seeded single-bit flip in ONE
+            # device's live param shard (real physical corruption —
+            # same shapes, no retrace; the integrity fingerprints see
+            # the divergent replica on the next sampled step)
+            payload = _faults.corrupt_due("corrupt_param")
+            if payload is not None:
+                from ..elastic import integrity as _integrity
+                _integrity.corrupt_param_host(self, payload)
         if self._fwd_bwd is None:
             prev = autograd.set_training(True)
             try:
@@ -2686,6 +2873,13 @@ class DataParallelTrainer:
                     from .. import telemetry as _tm
                     hextra = (_tm.health.due_flags(
                         self._health_count, 1)[0],)
+                    if hs.integrity is not None and \
+                            hs.integrity.inject:
+                        # the corruption-ctl row a baked drill reads
+                        # (all zeros = the XOR block is the identity)
+                        from ..elastic import integrity as _integrity
+                        hextra = hextra + (_integrity.ctl_vector(
+                            hs.integrity, len(self._tr_idx)),)
 
                 def _go():
                     # the fault hook sits INSIDE the retried thunk so

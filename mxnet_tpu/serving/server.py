@@ -636,6 +636,32 @@ class Server:
                         import jax as _jax
                         moved = [_jax.device_put(
                             m, self._decode_sharding) for m in moved]
+                    # integrity audit (docs/elasticity.md, "Integrity
+                    # sentry"): every migrated resident's K/V pages
+                    # must checksum-match their source slot — a page
+                    # corrupted in flight (or rotten in the source
+                    # pool) raises HERE, which lands in the
+                    # crash-heal below: the resident replays loudly
+                    # from its host-owned prompt instead of decoding
+                    # garbage on the new pool.  Gated like every
+                    # other leg of the sentry (MXTPU_INTEGRITY=0
+                    # skips it): the per-page host readbacks sit
+                    # inside the measured migrate window
+                    from ..elastic import integrity as _integrity
+                    if _integrity.enabled():
+                        for j2, (j, r) in enumerate(kept):
+                            for ci, c in enumerate(flat):
+                                if _integrity.page_checksum(c[j]) != \
+                                        _integrity.page_checksum(
+                                            moved[ci][j2]):
+                                    raise MXNetError(
+                                        f"KV-page checksum mismatch "
+                                        f"migrating request {r.id} "
+                                        f"slot {j}->{j2} (page "
+                                        f"tensor {ci}): corrupt "
+                                        "resident page; the request "
+                                        "will be requeued and "
+                                        "replayed")
                     npool.adopt(moved)
                     for c in flat:
                         try:

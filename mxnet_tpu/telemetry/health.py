@@ -125,41 +125,59 @@ class HealthSpec:
 
     ``fields()`` names every slot: 3 globals (``loss``, ``grad_norm``,
     ``nonfinite``) then 4 per top-level subtree
-    (``<subtree>.param_norm/grad_norm/update_norm/nonfinite``).
-    ``groups`` maps each subtree to positions in the TRAINABLE param
-    list (the j-indices the step stacks use for tvals/grads/new
-    values), so attribution points at the exact child block.
+    (``<subtree>.param_norm/grad_norm/update_norm/nonfinite``) — plus,
+    when the integrity sentry is armed (``elastic.integrity``), the
+    per-dp-replica fingerprint pairs its cross-replica agreement
+    audit reads.  ``groups`` maps each subtree to positions in the
+    TRAINABLE param list (the j-indices the step stacks use for
+    tvals/grads/new values), so attribution points at the exact child
+    block.
     """
 
-    __slots__ = ("subtrees", "groups", "skip")
+    __slots__ = ("subtrees", "groups", "skip", "integrity")
 
     def __init__(self, subtrees: List[str], groups: List[List[int]],
-                 skip: bool):
+                 skip: bool, integrity=None):
         self.subtrees = list(subtrees)
         self.groups = [list(g) for g in groups]
         self.skip = bool(skip)
+        #: optional ``elastic.integrity.IntegritySpec`` — its slot
+        #: rows ride the TAIL of this vector (the step builders append
+        #: them after :func:`compute`'s numerics section)
+        self.integrity = integrity
+
+    @property
+    def base_n(self) -> int:
+        """Slot count of the numerics section — what :func:`compute`
+        builds (the integrity rows are appended by the step builder)."""
+        return len(_GLOBAL_FIELDS) + \
+            len(_SUBTREE_FIELDS) * len(self.subtrees)
 
     @property
     def n(self) -> int:
-        return len(_GLOBAL_FIELDS) + \
-            len(_SUBTREE_FIELDS) * len(self.subtrees)
+        return self.base_n + (self.integrity.slots
+                              if self.integrity is not None else 0)
 
     def fields(self) -> List[str]:
         out = list(_GLOBAL_FIELDS)
         for s in self.subtrees:
             out.extend(f"{s}.{f}" for f in _SUBTREE_FIELDS)
+        if self.integrity is not None:
+            out.extend(self.integrity.fields())
         return out
 
     def signature(self) -> tuple:
         """Structural identity (part of the step's persist/sig hash):
-        the subtree layout and the skip gate are both baked into the
-        traced program."""
+        the subtree layout, the skip gate, and the integrity layout
+        are all baked into the traced program."""
         return ("health", 1, self.skip, tuple(self.subtrees),
-                tuple(tuple(g) for g in self.groups))
+                tuple(tuple(g) for g in self.groups)) + (
+                    (self.integrity.signature(),)
+                    if self.integrity is not None else ())
 
     def parse(self, vec) -> dict:
         """Host-side view of one sampled vector: globals + a per-
-        subtree dict."""
+        subtree dict (+ the per-replica fingerprints when armed)."""
         import numpy as np
         v = np.asarray(vec, dtype=np.float64).reshape(-1)
         if v.shape[0] != self.n:
@@ -174,6 +192,8 @@ class HealthSpec:
                        for i, f in enumerate(_SUBTREE_FIELDS)}
             off += len(_SUBTREE_FIELDS)
         out["subtrees"] = subs
+        if self.integrity is not None:
+            out["integrity"] = self.integrity.parse(v[off:])
         return out
 
 
@@ -190,12 +210,15 @@ def _subtree_of(name: str, prefix: str) -> str:
     return head if rest else name
 
 
-def build_spec(prefix: str, param_names: Sequence[str]) -> \
-        Optional[HealthSpec]:
+def build_spec(prefix: str, param_names: Sequence[str],
+               integrity=None) -> Optional[HealthSpec]:
     """Build the health layout for one step owner, or None when the
     plane is off.  ``param_names`` are the TRAINABLE params in the
     order the step passes tvals/grads (position j in that list is the
-    group index)."""
+    group index).  ``integrity``: an
+    ``elastic.integrity.IntegritySpec`` for owners with a >1 dp axis
+    (the SPMD trainer) — its fingerprint rows ride this vector's
+    tail."""
     if not enabled():
         return None
     order: List[str] = []
@@ -207,7 +230,7 @@ def build_spec(prefix: str, param_names: Sequence[str]) -> \
             order.append(s)
         groups[s].append(j)
     return HealthSpec(order, [groups[s] for s in order],
-                      skip=action() == "skip")
+                      skip=action() == "skip", integrity=integrity)
 
 
 # -- traced computation ------------------------------------------------
@@ -288,7 +311,7 @@ def compute(spec: HealthSpec, loss_val, old_tvals, grads, new_tvals,
         due > 0,
         lambda: _compute_full(spec, loss_val, old_tvals, grads,
                               new_tvals),
-        lambda: jnp.zeros((spec.n,), jnp.float32))
+        lambda: jnp.zeros((spec.base_n,), jnp.float32))
 
 
 def compute_sharded(spec: HealthSpec, loss_val, old_tvals, g_sq,
@@ -311,7 +334,7 @@ def compute_sharded(spec: HealthSpec, loss_val, old_tvals, g_sq,
         due > 0,
         lambda: _compute_from_sq(spec, loss_val, old_tvals, g_sq,
                                  new_tvals),
-        lambda: jnp.zeros((spec.n,), jnp.float32))
+        lambda: jnp.zeros((spec.base_n,), jnp.float32))
 
 
 def due_flags(base: int, k: int):
@@ -495,6 +518,32 @@ class Sentinel:
                 ).inc(nonfinite)
 
         anomalies: List[dict] = []
+        # cross-replica integrity audit (elastic.integrity): replicated
+        # values must agree across the dp axis — a minority replica is
+        # the corruption suspect, attributed by device index.  Checked
+        # BEFORE the numerics branches: a bitflip usually stays finite
+        # and would otherwise pass every norm check silently.
+        integ = parsed.get("integrity")
+        if integ:
+            from ..elastic import integrity as _integrity
+            for row in ("param", "grad"):
+                fps = integ.get(f"{row}_fp")
+                if not fps:
+                    continue
+                suspects = _integrity.agreement(fps)
+                if suspects is None:
+                    continue
+                anomalies.append({
+                    "anomaly": "integrity_divergence",
+                    "row": row, "suspects": suspects,
+                    "subtrees": [],
+                    "detail": (f"{row} fingerprints diverge across "
+                               f"the dp axis; suspect device(s) "
+                               f"{suspects} "
+                               f"(fps: "
+                               f"{[f'{v:08x}' for v in fps]})")})
+                _integrity.note_suspected(self.where, row, suspects,
+                                          fps, int(step))
         with self._lock:
             armed = len(self._loss_win) >= self.MIN_SAMPLES
             if nonfinite > 0 or not math.isfinite(loss) or \
@@ -582,7 +631,22 @@ class Sentinel:
                          **a)
 
         verdict = None
-        if any(a["anomaly"] == "nonfinite" for a in anomalies):
+        integ_anoms = [a for a in anomalies
+                       if a["anomaly"] == "integrity_divergence"]
+        if integ_anoms:
+            # immediate, like nonfinite — and ranked above it: a
+            # bitflip that ALSO went nonfinite is still a corruption
+            # incident first (the response ladder differs).  The
+            # streak rides along so handle_verdict can fall through
+            # to the HEALTH ladder when an unactioned (warn-mode)
+            # corruption verdict co-occurs with sustained numerics
+            # anomalies.
+            suspects = sorted({s for a in integ_anoms
+                               for s in a["suspects"]})
+            verdict = {"kind": "integrity_divergence",
+                       "suspects": suspects, "streak": streak,
+                       "anomalies": anomalies, "step": int(step)}
+        elif any(a["anomaly"] == "nonfinite" for a in anomalies):
             verdict = {"kind": "nonfinite", "anomalies": anomalies,
                        "step": int(step)}
         elif anomalies and streak >= _patience():
@@ -652,8 +716,31 @@ def handle_verdict(owner, verdict: Optional[dict]) -> bool:
     drives the owner's ``recover(manager)`` — the elastic plane's
     restore-from-last-committed-checkpoint protocol.  Returns True
     when a rollback ran.  ``skip`` needs no host action (the gate is
-    in-graph); ``warn`` records only."""
-    if verdict is None or action() != "rollback":
+    in-graph); ``warn`` records only.  An ``integrity_divergence``
+    verdict takes the corruption ladder instead
+    (``MXTPU_INTEGRITY_ACTION`` — warn / rollback / QUARANTINE,
+    ``elastic.integrity.respond``)."""
+    if verdict is None:
+        return False
+    if verdict.get("kind") == "integrity_divergence":
+        from ..elastic import integrity as _integrity
+        if _integrity.respond(owner, verdict):
+            return True
+        others = [a for a in verdict.get("anomalies", ())
+                  if a.get("anomaly") != "integrity_divergence"]
+        nonfinite = any(a.get("anomaly") == "nonfinite"
+                        for a in others)
+        diverging = others and \
+            int(verdict.get("streak", 0)) >= _patience()
+        if not (nonfinite or diverging):
+            return False
+        # the sample ALSO carried numerics anomalies the health
+        # ladder would have acted on (nonfinite, or a sustained
+        # spike/explosion/collapse streak past patience): an
+        # unactioned corruption verdict (warn mode) must not
+        # suppress the user's configured MXTPU_HEALTH_ACTION —
+        # fall through to it
+    if action() != "rollback":
         return False
     manager = getattr(owner, "health_manager", None)
     if manager is None:

@@ -42,6 +42,14 @@ _RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
                          "resize", "resize_failed",
                          "hang_suspected", "hang_resolved",
                          "preempted", "preempt_forced",
+                         # the silent-corruption sentry's forensics
+                         # (docs/elasticity.md, "Integrity sentry"):
+                         # a dispatch flood must not evict the proof
+                         # that corruption was seen, answered, or
+                         # found on disk
+                         "corruption_suspected", "corruption_resolved",
+                         "device_quarantined", "scrub_corrupt",
+                         "integrity_inapplicable",
                          "shed", "deadline_evicted",
                          # recovery answers hang_suspected/poison in the
                          # MXL504 audit and the chaos-soak step

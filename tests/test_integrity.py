@@ -1,0 +1,846 @@
+"""Silent-corruption sentry (docs/elasticity.md, "Integrity sentry").
+
+The ISSUE 14 acceptance criteria under test: seeded ``corrupt_param``/
+``corrupt_grad`` drills on the 8-device CPU mesh are detected within
+one sampling interval with the faulted device index ATTRIBUTED,
+quarantine resizes off the suspect device, and post-heal training is
+fp32-exact vs an unfaulted reference at matched step counts — with
+the steady-state 1-dispatch/0-retrace contract and ~0% un-sampled
+overhead preserved.  Plus the satellites: checkpoint scrubbing with
+corrupt-dir quarantine, the exact-resume data cursor, drain-manifest
+token checksums, the deserialized-executable clear_cache guard,
+retained-ring flood survival of the new event kinds, mxlint MXL505,
+and the ``tools/mxsdc.py`` CLI.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, gluon, nd, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.elastic import CheckpointManager, faults, integrity
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.loss import L2Loss
+
+
+@pytest.fixture(autouse=True)
+def _integrity_env(monkeypatch):
+    """Health at K=1 + integrity on (warn) by default for this module
+    (tests override), clean telemetry/fault/scrub state per test."""
+    monkeypatch.setenv("MXTPU_HEALTH", "1")
+    monkeypatch.setenv("MXTPU_HEALTH_EVERY", "1")
+    monkeypatch.setenv("MXTPU_INTEGRITY", "1")
+    monkeypatch.delenv("MXTPU_INTEGRITY_ACTION", raising=False)
+    monkeypatch.delenv("MXTPU_HEALTH_ACTION", raising=False)
+    monkeypatch.delenv("MXTPU_ZERO_STAGE", raising=False)
+    telemetry.reset()
+    faults.clear()
+    integrity._reset()
+    yield
+    faults.clear()
+    telemetry.reset()
+    integrity._reset()
+
+
+def _mlp(seed=7):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _spmd(mesh=None, seed=7, opt="adam", **kw):
+    net = _mlp(seed=seed)
+    dpt = parallel.DataParallelTrainer(
+        net, L2Loss(), opt, {"learning_rate": 0.01},
+        mesh=mesh if mesh is not None
+        else parallel.make_mesh({"dp": 8}),
+        fuse_step=True, **kw)
+    return net, dpt
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(n, 8).astype("f4")),
+            nd.array(rng.randn(n, 4).astype("f4")))
+
+
+def _mesh8():
+    from conftest import needs_devices
+    needs_devices(8)
+    return parallel.make_mesh({"dp": 8})
+
+
+def _last_sentinel():
+    sents = telemetry.health.sentinels()
+    assert sents
+    return list(sents.values())[-1]
+
+
+def _params_np(net):
+    return [v.data().asnumpy()
+            for v in net.collect_params().values()]
+
+
+# ---------------------------------------------------------------------------
+# units: fingerprint / packing / agreement
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_detects_single_bitflip():
+    """The uint32 wraparound sum changes for ANY single bitflip
+    (delta = ±2^b, never 0 mod 2^32)."""
+    import jax
+    import jax.numpy as jnp
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    base = int(jax.jit(lambda a: integrity.fingerprint([a]))(
+        jnp.asarray(x)))
+    for bit in (0, 7, 22, 31):
+        y = x.copy()
+        y.reshape(-1).view(np.uint32)[5] ^= np.uint32(1 << bit)
+        flipped = int(jax.jit(
+            lambda a: integrity.fingerprint([a]))(jnp.asarray(y)))
+        assert flipped != base, f"bit {bit} not detected"
+
+
+def test_spec_layout_and_parse_roundtrip():
+    """hi/lo f32 packing is exact for every uint32 value the parse
+    reconstructs; grad rows drop when grad_rows=False."""
+    spec = integrity.IntegritySpec(4, grad_rows=True)
+    assert spec.slots == 16
+    assert len(spec.fields()) == 16
+    fps = [0, 1, 0xFFFF, 0x10000, 0xDEADBEEF, 2**32 - 1, 42, 7]
+    tail = []
+    for k in range(2):
+        vals = fps[k * 4:(k + 1) * 4]
+        tail.extend(float(v >> 16) for v in vals)
+        tail.extend(float(v & 0xFFFF) for v in vals)
+    parsed = spec.parse(np.asarray(tail, np.float64))
+    assert parsed["param_fp"] == fps[:4]
+    assert parsed["grad_fp"] == fps[4:]
+    spec2 = integrity.IntegritySpec(4, grad_rows=False)
+    assert spec2.slots == 8 and spec2.kinds == ("param",)
+    assert spec2.signature() != spec.signature()
+
+
+def test_agreement_majority_vote():
+    assert integrity.agreement([5, 5, 5, 5]) is None
+    assert integrity.agreement([5, 5, 9, 5]) == [2]
+    assert integrity.agreement([1, 5, 5, 5, 5, 5, 5, 2]) == [0, 7]
+    # 50/50: deterministic (first-seen value wins the modal slot)
+    assert integrity.agreement([3, 9, 3, 9]) == [1, 3]
+
+
+def test_faults_corrupt_grammar_and_determinism():
+    """device=/leaf=/bit= qualifiers parse; unspecified payload fields
+    draw from the seeded RNG (same seed + arrivals = same targets);
+    corrupt_armed is sticky until reconfigure."""
+    faults.configure("corrupt_param:device=3,leaf=1,bit=9")
+    p = faults.corrupt_due("corrupt_param")
+    assert p == {"device": 3, "leaf": 1, "bit": 9}
+    assert faults.corrupt_due("corrupt_param") is None  # one-shot
+    assert not faults.corrupt_armed()   # corrupt_param is host-side
+
+    draws = []
+    for _ in range(2):
+        faults.configure("corrupt_grad", seed=123)
+        assert faults.corrupt_armed()
+        draws.append(faults.corrupt_due("corrupt_grad"))
+        # exhausted spec does NOT disarm the in-graph block
+        assert faults.corrupt_due("corrupt_grad") is None
+        assert faults.corrupt_armed()
+    assert draws[0] == draws[1]
+    faults.clear()
+    assert not faults.corrupt_armed()
+    with pytest.raises(ValueError):
+        faults.configure("corrupt_grad:device=")
+
+    # corrupt_wire rides the same in-graph seam: it arms the XOR
+    # block and ctl_vector picks it up when corrupt_grad is silent
+    faults.configure("corrupt_wire:device=4,leaf=0,bit=3")
+    assert faults.corrupt_armed()
+    spec = integrity.IntegritySpec(8, inject=True)
+    ctl = integrity.ctl_vector(spec, n_leaves=2)
+    assert ctl.tolist() == [1.0, 4.0, 0.0, 3.0]
+    assert integrity.ctl_vector(spec, 2).tolist() == [0.0] * 4
+
+
+# ---------------------------------------------------------------------------
+# tentpole: in-graph rows, contract, parity
+# ---------------------------------------------------------------------------
+
+
+def test_integrity_rows_ride_health_vector_zero_retrace():
+    """Steady state with integrity ON: per-replica fingerprints land
+    in the sentinel history, all replicas agree, no anomalies — and
+    the steady-state step pays 0 fresh compiles/retraces (the rows
+    ride the SAME single dispatch)."""
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    dpt.step(x, y)
+    dpt.step(x, y)
+    telemetry.clear_events()
+    m0, f0 = engine.compile_counts()
+    dpt.step(x, y)
+    assert engine.compile_counts() == (m0, f0)
+    assert telemetry.events("retrace") == []
+    sent = _last_sentinel()
+    assert sent.spec.integrity is not None
+    assert sent.spec.integrity.n_dp == 8
+    row = sent.snapshot()["history"][-1]
+    integ = row["integrity"]
+    assert len(integ["param_fp"]) == 8
+    assert len(set(integ["param_fp"])) == 1
+    assert len(set(integ["grad_fp"])) == 1
+    assert row["anomalies"] == []
+    assert telemetry.events("corruption_suspected") == []
+
+
+def test_integrity_off_bit_parity(monkeypatch):
+    """Warn-mode fingerprints never touch the update math: integrity
+    on vs off trains bit-identically (fresh trainers, same seeds)."""
+    _mesh8()
+    x, y = _batch()
+    monkeypatch.setenv("MXTPU_INTEGRITY", "0")
+    net_a, dpt_a = _spmd()
+    la = [dpt_a.step(x, y).asnumpy() for _ in range(3)]
+    pa = _params_np(net_a)
+    monkeypatch.setenv("MXTPU_INTEGRITY", "1")
+    net_b, dpt_b = _spmd()
+    lb = [dpt_b.step(x, y).asnumpy() for _ in range(3)]
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(pa, _params_np(net_b)):
+        np.testing.assert_array_equal(a, b)
+    # single-device dp: spec is None, program unchanged
+    net_c, dpt_c = _spmd(parallel.make_mesh({"dp": 1}))
+    dpt_c.step(x, y)
+    assert dpt_c._health_spec.integrity is None
+
+
+def test_corrupt_param_detected_with_attribution():
+    """A seeded single-bit flip in device 5's live param shard is
+    caught on the next sampled step: integrity_divergence anomaly,
+    retained corruption_suspected event with suspects=[5], counter,
+    and an immediate verdict ranked above nonfinite."""
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    dpt.step(x, y)
+    faults.configure("corrupt_param:device=5,leaf=0,bit=12")
+    dpt.step(x, y)
+    sent = _last_sentinel()
+    row = sent.snapshot()["history"][-1]
+    assert "integrity_divergence" in row["anomalies"]
+    assert integrity.agreement(row["integrity"]["param_fp"]) == [5]
+    assert sent.last_verdict["kind"] == "integrity_divergence"
+    assert sent.last_verdict["suspects"] == [5]
+    evs = telemetry.events("corruption_suspected")
+    assert evs and evs[-1]["suspects"] == [5]
+    assert evs[-1]["row"] == "param"
+    assert len(evs[-1]["fingerprints"]) == 8
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_corruption_suspected_total", 0) >= 1
+    # the corruption is REAL state: it persists into the next step
+    telemetry.reset()
+    dpt.step(x, y)
+    assert telemetry.events("corruption_suspected")
+
+
+def test_detection_within_one_sampling_interval(monkeypatch):
+    """At MXTPU_HEALTH_EVERY=4 an injection lands at most one
+    sampling interval before detection (the acceptance bound)."""
+    monkeypatch.setenv("MXTPU_HEALTH_EVERY", "4")
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    for _ in range(2):
+        dpt.step(x, y)
+    faults.configure("corrupt_param:device=2,bit=8", seed=5)
+    detected_after = None
+    for i in range(4):
+        dpt.step(x, y)
+        if telemetry.events("corruption_suspected"):
+            detected_after = i + 1
+            break
+    assert detected_after is not None and detected_after <= 4
+    assert telemetry.events(
+        "corruption_suspected")[-1]["suspects"] == [2]
+
+
+def test_corrupt_grad_ingraph_drill_and_disarm():
+    """Arming corrupt_grad retraces ONCE with attribution (the ctl
+    input + XOR block), the drill corrupts device 3's post-collective
+    gradient (detected with attribution, and the corruption enters
+    the REAL update dataflow — that device's params diverge from
+    there), and clearing the plan retraces back to the production
+    program."""
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    dpt.step(x, y)
+    telemetry.reset()
+    faults.configure("corrupt_grad:device=3,leaf=0,bit=21,nth=2")
+    dpt.step(x, y)                       # rebuild (armed), not fired
+    retr = telemetry.events("retrace")
+    assert retr and "integrity" in str(retr[-1].get("changed"))
+    assert telemetry.events("corruption_suspected") == []
+    dpt.step(x, y)                       # fires
+    evs = telemetry.events("corruption_suspected")
+    assert evs
+    assert evs[-1]["suspects"] == [3]
+    assert evs[-1]["row"] in ("grad", "param")
+    grow = [e for e in evs if e["row"] == "grad"]
+    assert grow and grow[-1]["suspects"] == [3]
+    faults.clear()
+    telemetry.reset()
+    dpt.step(x, y)                       # disarm rebuild
+    # the injected grad corruption updated device 3's params: the
+    # param fingerprints keep flagging it until a rollback heals it
+    sus = telemetry.events("corruption_suspected")
+    assert sus and all(e["suspects"] == [3] for e in sus)
+
+
+def test_warn_mode_never_masks_health_ladder(monkeypatch):
+    """An unactioned (warn-mode) integrity verdict must fall through
+    to the user's MXTPU_HEALTH_ACTION=rollback when the sample ALSO
+    carries numerics anomalies the health ladder would have acted on:
+    nonfinite immediately, finite divergence once the streak passes
+    patience — a persistent bitflip re-flagging every sample must not
+    suppress the configured recovery forever."""
+    from mxnet_tpu.telemetry import health
+
+    class Owner:
+        def __init__(self):
+            self.recovered = 0
+            self.health_manager = object()
+
+        def recover(self, manager):
+            self.recovered += 1
+            return 1
+
+    monkeypatch.setenv("MXTPU_HEALTH_ACTION", "rollback")
+    monkeypatch.setenv("MXTPU_INTEGRITY_ACTION", "warn")
+    integ = {"anomaly": "integrity_divergence", "row": "param",
+             "suspects": [3], "subtrees": []}
+    # corruption alone (warn): no rollback — that is what warn means
+    owner = Owner()
+    assert health.handle_verdict(owner, {
+        "kind": "integrity_divergence", "suspects": [3], "streak": 1,
+        "anomalies": [integ], "step": 5}) is False
+    assert owner.recovered == 0
+    # + nonfinite: immediate fall-through to the health rollback
+    owner = Owner()
+    assert health.handle_verdict(owner, {
+        "kind": "integrity_divergence", "suspects": [3], "streak": 1,
+        "anomalies": [integ, {"anomaly": "nonfinite", "count": 1,
+                              "subtrees": []}],
+        "step": 5}) is True
+    assert owner.recovered == 1
+    # + finite divergence past patience: same fall-through
+    monkeypatch.setenv("MXTPU_HEALTH_PATIENCE", "3")
+    owner = Owner()
+    assert health.handle_verdict(owner, {
+        "kind": "integrity_divergence", "suspects": [3], "streak": 3,
+        "anomalies": [integ, {"anomaly": "grad_explosion",
+                              "value": 1e9, "subtrees": []}],
+        "step": 5}) is True
+    assert owner.recovered == 1
+    # finite divergence below patience: not yet
+    owner = Owner()
+    assert health.handle_verdict(owner, {
+        "kind": "integrity_divergence", "suspects": [3], "streak": 2,
+        "anomalies": [integ, {"anomaly": "grad_explosion",
+                              "value": 1e9, "subtrees": []}],
+        "step": 5}) is False
+    assert owner.recovered == 0
+
+
+def test_rollback_action_heals(monkeypatch, tmp_path):
+    """MXTPU_INTEGRITY_ACTION=rollback: the verdict restores the last
+    committed checkpoint (corrupt state discarded — the next sample
+    agrees again) and emits corruption_resolved(action=rollback)."""
+    monkeypatch.setenv("MXTPU_INTEGRITY_ACTION", "rollback")
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.health_manager = mgr
+    x, y = _batch()
+    for _ in range(2):
+        dpt.step(x, y)
+    mgr.save(block=True)
+    faults.configure("corrupt_param:device=4,bit=15")
+    dpt.step(x, y)      # corrupt -> detect -> rollback to step 2
+    faults.clear()
+    evs = telemetry.events("corruption_resolved")
+    assert evs and evs[-1]["action"] == "rollback"
+    assert telemetry.events("recovery")
+    telemetry.reset()
+    dpt.step(x, y)
+    sent = _last_sentinel()
+    row = sent.snapshot()["history"][-1]
+    assert row["anomalies"] == []
+    assert len(set(row["integrity"]["param_fp"])) == 1
+
+
+def test_quarantine_resizes_off_suspect(monkeypatch, tmp_path):
+    """The acceptance chain: corrupt device 6 -> detected+attributed
+    -> quarantine rolls back to the committed boundary (fp32-exact)
+    and live-resizes onto dp=4 EXCLUDING device 6 with 0 post-swap
+    fresh compiles -> post-heal training matches the unfaulted
+    8-device reference at matched step counts (1-2 ulp: the new dp
+    size regroups the batch-mean reduction)."""
+    monkeypatch.setenv("MXTPU_INTEGRITY_ACTION", "quarantine")
+    _mesh8()
+    x, y = _batch()
+    mx.random.seed(11)
+    net_r, dpt_r = _spmd()
+    ref_losses = [dpt_r.step(x, y).asnumpy() for _ in range(6)]
+
+    mx.random.seed(11)
+    net, dpt = _spmd()
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.health_manager = mgr
+    for _ in range(3):
+        dpt.step(x, y)
+    mgr.save(block=True)
+    faults.configure("corrupt_param:device=6,bit=11")
+    dpt.step(x, y)
+    faults.clear()
+
+    assert dict(zip(dpt.mesh.axis_names,
+                    dpt.mesh.devices.shape)) == {"dp": 4}
+    ids = [d.id for d in np.asarray(dpt.mesh.devices).reshape(-1)]
+    assert 6 not in ids
+    evs = telemetry.events("device_quarantined")
+    assert evs and evs[-1]["suspect"] == 6
+    assert evs[-1]["restored_step"] == 3
+    assert telemetry.events("corruption_resolved")[-1]["action"] == \
+        "quarantine"
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_corruption_quarantines_total", 0) == 1
+
+    # heal boundary: fp32-exact vs the reference at step 3
+    mx.random.seed(11)
+    net_3, dpt_3 = _spmd()
+    for _ in range(3):
+        dpt_3.step(x, y)
+    for a, b in zip(_params_np(net_3), _params_np(net)):
+        np.testing.assert_array_equal(a, b)
+
+    # post-heal: 0 fresh compiles (the quarantine resize pre-warmed
+    # against the target mesh's own fingerprint layout), trajectory
+    # matches the unfaulted reference
+    m0, f0 = engine.compile_counts()
+    post = [dpt.step(x, y).asnumpy() for _ in range(3)]
+    assert engine.compile_counts()[1] - f0 == 0
+    for a, b in zip(ref_losses[3:], post):
+        np.testing.assert_allclose(a, b, rtol=3e-7, atol=1e-7)
+    from mxnet_tpu.elastic import resize as resize_mod
+    rec = resize_mod.resizes()[-1]
+    assert rec["mesh_to"] == {"dp": 4}
+    assert rec["post_swap_fresh_compiles"] == 0
+
+
+def test_zero_stage2_drops_grad_rows_detects_param(monkeypatch):
+    """ZeRO stage 2 never materializes a replicated gradient: its
+    integrity spec drops the grad rows, and corrupt_param detection
+    (on the replicated param inputs) still attributes the device."""
+    monkeypatch.setenv("MXTPU_ZERO_STAGE", "2")
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    dpt.step(x, y)
+    assert dpt._zero_stage == 2
+    sent = _last_sentinel()
+    assert sent.spec.integrity is not None
+    assert sent.spec.integrity.grad_rows is False
+    row = sent.snapshot()["history"][-1]
+    assert row["integrity"]["grad_fp"] is None
+    assert len(set(row["integrity"]["param_fp"])) == 1
+    faults.configure("corrupt_param:device=1,bit=14")
+    dpt.step(x, y)
+    evs = telemetry.events("corruption_suspected")
+    assert evs and evs[-1]["suspects"] == [1]
+
+
+def test_step_multi_detects_inside_bulk():
+    """A corrupt_param landing before a bulked step_multi(K) dispatch
+    is caught by the per-inner-step sampled rows inside the scan."""
+    mesh = _mesh8()
+    net, dpt = _spmd(mesh)
+    x, y = _batch()
+    dpt.step(x, y)
+    faults.configure("corrupt_param:device=7,bit=13")
+    dpt.step_multi((x,), y, repeat=4)
+    evs = telemetry.events("corruption_suspected")
+    assert evs and evs[-1]["suspects"] == [7]
+
+
+# ---------------------------------------------------------------------------
+# satellites: scrub, cursor, drain checksums, clear_cache guard
+# ---------------------------------------------------------------------------
+
+
+def test_scrub_quarantines_rotten_checkpoint(tmp_path):
+    """A shard corrupted AFTER its commit is found by scrub(),
+    quarantined out of the committed namespace (restore serves the
+    older clean step), with the retained scrub_corrupt event and the
+    mxtpu_scrub_* counters."""
+    net, dpt = _spmd(parallel.make_mesh({"dp": 1}))
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.step(x, y)
+    mgr.save(block=True)
+    dpt.step(x, y)
+    mgr.save(block=True)
+    assert mgr.steps() == [1, 2]
+    # rot one shard byte of step 2
+    shard = tmp_path / "ck" / "step-00000002" / "shards" / "000.npy"
+    raw = bytearray(shard.read_bytes())
+    raw[-1] ^= 0x40
+    shard.write_bytes(bytes(raw))
+
+    rep = mgr.scrub()
+    assert rep["checked"] == 2 and rep["corrupt"] == 1
+    assert rep["quarantined"] == [2]
+    assert mgr.steps() == [1]
+    assert (tmp_path / "ck" / "quarantined-step-00000002").is_dir()
+    assert mgr.restore() == 1
+    evs = telemetry.events("scrub_corrupt")
+    assert evs and evs[-1]["step"] == 2 and evs[-1]["quarantined"]
+    snap = telemetry.snapshot()["counters"]
+    assert snap.get("mxtpu_scrub_corrupt_total", 0) == 1
+    assert snap.get("mxtpu_scrub_passes_total", 0) == 1
+    # a second pass over the healthy remainder is clean
+    rep2 = mgr.scrub()
+    assert rep2["corrupt"] == 0 and rep2["checked"] == 1
+
+
+def test_scrub_report_only_is_mxl505_error(tmp_path):
+    """scrub(quarantine=False) leaves the corrupt dir standing as a
+    restore target — exactly what MXL505 flags at ERROR severity;
+    quarantining it clears the finding."""
+    from mxnet_tpu.analysis import analyze_elasticity
+    net, dpt = _spmd(parallel.make_mesh({"dp": 1}))
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.step(x, y)
+    mgr.save(block=True)
+    shard = tmp_path / "ck" / "step-00000001" / "shards" / "000.npy"
+    raw = bytearray(shard.read_bytes())
+    raw[-1] ^= 0x01
+    shard.write_bytes(bytes(raw))
+    mgr.scrub(quarantine=False)
+    bad = [f for f in analyze_elasticity() if f.rule == "MXL505"]
+    assert bad and bad[0].severity == "error"
+    assert "restore target" in bad[0].message
+    mgr.scrub(quarantine=True)
+    bad = [f for f in analyze_elasticity() if f.rule == "MXL505"
+           and "restore target" in f.message]
+    assert not bad
+
+
+def test_mxl505_unanswered_corruption_and_resolution():
+    """A corruption_suspected with no later resolution is an MXL505
+    finding; a corruption_resolved (or recovery) after it clears the
+    audit.  Fresh process: quiet."""
+    from mxnet_tpu.analysis import analyze_elasticity
+    assert [f for f in analyze_elasticity()
+            if f.rule == "MXL505"] == []
+    telemetry.record_event("corruption_suspected", where="spmd:test",
+                           row="param", suspects=[3],
+                           fingerprints=["aa"] * 8, step=9)
+    bad = [f for f in analyze_elasticity() if f.rule == "MXL505"]
+    assert len(bad) == 1 and "never answered" in bad[0].message
+    telemetry.record_event("corruption_resolved", where="integrity",
+                           action="rollback", suspects=[3], step=9)
+    assert [f for f in analyze_elasticity()
+            if f.rule == "MXL505"] == []
+
+
+def test_new_event_kinds_survive_dispatch_flood():
+    """1200 dispatch events cannot evict the corruption forensics —
+    the new kinds live in the retained ring (PR 12 style)."""
+    telemetry.record_event("corruption_suspected", where="w",
+                           row="param", suspects=[1],
+                           fingerprints=["00"] * 8, step=1)
+    telemetry.record_event("device_quarantined", where="integrity",
+                           suspect=1, restored_step=1,
+                           mesh_to={"dp": 4}, seconds=0.1)
+    telemetry.record_event("corruption_resolved", where="integrity",
+                           action="quarantine", suspects=[1], step=1)
+    telemetry.record_event("scrub_corrupt", dir="/x", step=2,
+                           errors=["e"], quarantined=True)
+    for i in range(1200):
+        telemetry.record_event("dispatch", op=f"op{i % 7}")
+    for kind in ("corruption_suspected", "device_quarantined",
+                 "corruption_resolved", "scrub_corrupt"):
+        assert telemetry.events(kind), f"{kind} evicted"
+
+
+def test_exact_resume_cursor_roundtrip(tmp_path):
+    """The manifest records the loader cursor; restore re-installs it
+    (+ the RNG stream that already round-trips), so a recover()
+    replays the exact batch stream."""
+    net, dpt = _spmd(parallel.make_mesh({"dp": 1}))
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.step(x, y)
+    mgr.set_cursor(epoch=2, batch=17, shard="train-003")
+    step = mgr.save(block=True)
+    man = json.loads(
+        (tmp_path / "ck" / f"step-{step:08d}" /
+         "manifest.json").read_text())
+    assert man["cursor"] == {"epoch": 2, "batch": 17,
+                             "shard": "train-003"}
+
+    # a fresh process restores the cursor alongside params/RNG
+    net2, dpt2 = _spmd(parallel.make_mesh({"dp": 1}), seed=9)
+    dpt2.step(x, y)
+    mgr2 = CheckpointManager(str(tmp_path / "ck"), trainer=dpt2,
+                             async_save=False)
+    assert mgr2.cursor is None
+    mgr2.restore()
+    assert mgr2.cursor == {"epoch": 2, "batch": 17,
+                           "shard": "train-003"}
+
+    # the replay recipe: a deterministic stream keyed by the cursor
+    # resumes at the exact batch an uninterrupted run would see next
+    def stream(epoch, batch):
+        return np.random.RandomState(
+            1000 * epoch + batch).randn(4).astype("f4")
+
+    resumed = stream(mgr2.cursor["epoch"], mgr2.cursor["batch"] + 1)
+    uninterrupted = stream(2, 18)
+    np.testing.assert_array_equal(resumed, uninterrupted)
+    # recover() routes through restore -> same cursor
+    mgr2.set_cursor(epoch=9, batch=9)
+    dpt2.recover(mgr2)
+    assert mgr2.cursor == {"epoch": 2, "batch": 17,
+                           "shard": "train-003"}
+
+
+def test_drain_manifest_token_checksum(tmp_path):
+    """A drain-manifest row whose token state rotted refuses to
+    resubmit (loud MXNetError), an intact one restores; pre-checksum
+    rows (no sha256) stay restorable."""
+    from mxnet_tpu.elastic.guardian import restore_drained_requests
+
+    class StubServer:
+        def __init__(self):
+            self.submitted = []
+
+        def submit(self, prompt, **kw):
+            self.submitted.append((list(prompt), kw))
+            return len(self.submitted)
+
+    prompt = [3.0, 5.0, 7.0]
+    row = {"prompt": prompt, "max_new_tokens": 4,
+           "temperature": 0.0, "eos_id": None,
+           "generated": [11, 12],
+           "sha256": integrity.token_checksum(prompt, [11, 12])}
+    legacy = {"prompt": [1.0], "max_new_tokens": 2,
+              "temperature": 0.0, "eos_id": None, "generated": []}
+    path = tmp_path / "serving-drain.json"
+    path.write_text(json.dumps(
+        {"format": 1, "kind": "mxtpu_serving_drain", "server": "s",
+         "requests": [row, legacy]}))
+    srv = StubServer()
+    out = restore_drained_requests(srv, str(path))
+    assert len(out) == 2 and len(srv.submitted) == 2
+
+    rotten = dict(row, prompt=[3.0, 5.0, 8.0])   # bits rotted
+    path.write_text(json.dumps(
+        {"format": 1, "kind": "mxtpu_serving_drain", "server": "s",
+         "requests": [rotten]}))
+    with pytest.raises(MXNetError, match="token checksum"):
+        restore_drained_requests(StubServer(), str(path))
+
+
+def test_page_and_token_checksum_units():
+    a = np.arange(12, dtype=np.float32)
+    b = a.copy()
+    b.view(np.uint32)[3] ^= np.uint32(1)
+    assert integrity.page_checksum(a) == integrity.page_checksum(
+        a.copy())
+    assert integrity.page_checksum(a) != integrity.page_checksum(b)
+    assert integrity.token_checksum([1, 2], [3]) != \
+        integrity.token_checksum([1, 2], [4])
+
+
+def test_clear_cache_deserialized_guard(tmp_path, monkeypatch):
+    """The PR 13 CAUTION's safe recipe: executables deserialized from
+    the persistent tier stay pinned across ANY number of
+    engine.clear_cache() calls — repeated clears around a warm
+    restart no longer risk the nondeterministic jaxlib CPU teardown
+    segfault, and the reloaded program still dispatches."""
+    from mxnet_tpu.engine import persist
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE_DIR",
+                       str(tmp_path / "cc"))
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    cs = tr.compile_step(net, L2Loss())
+    x, y = _batch(n=4)
+    l0 = cs.step(x, y, 4)
+    assert cs.last_path == "compiled"
+    alive0 = persist.deserialized_alive()
+
+    # drop the in-memory tier, reload from disk (a deserialized
+    # executable), then clear REPEATEDLY and keep training — the
+    # recipe that used to crash
+    engine.clear_cache()
+    cs2 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05},
+                        kvstore=None).compile_step(net, L2Loss())
+    l1 = cs2.step(x, y, 4)
+    assert cs2.last_path == "compiled"
+    assert persist.deserialized_alive() >= alive0 + 1
+    pinned = persist.deserialized_alive()
+    engine.clear_cache()
+    engine.clear_cache()
+    import gc
+    gc.collect()
+    assert persist.deserialized_alive() == pinned   # still pinned
+    cs3 = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05},
+                        kvstore=None).compile_step(net, L2Loss())
+    l2 = cs3.step(x, y, 4)
+    assert np.isfinite(l2.asnumpy()).all()
+    # drop the tier-resolved (device-pinned AOT) entries this test
+    # left in the in-memory cache — the same hygiene the
+    # test_compile_cache module fixture applies; the keep-alive pins
+    # deliberately survive this final clear too
+    engine.clear_cache()
+    assert persist.deserialized_alive() >= pinned
+
+
+def test_compiled_step_integrity_inapplicable_once():
+    """A corrupt_* drill armed on the single-context gluon path (no
+    dp axis — nothing to disagree with) records the one-shot
+    integrity_inapplicable event instead of silently proving
+    nothing."""
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    cs = tr.compile_step(net, L2Loss())
+    x, y = _batch(n=4)
+    faults.configure("corrupt_param:nth=999")
+    cs.step(x, y, 4)
+    cs.step(x, y, 4)
+    evs = telemetry.events("integrity_inapplicable")
+    assert len(evs) == 1
+    assert "single-context" in evs[0]["reason"]
+
+
+def test_background_scrubber_thread(tmp_path):
+    """start_scrub runs scrub() on a daemon cadence: a checkpoint
+    rotting while the job trains is quarantined without anyone
+    calling scrub() by hand."""
+    import time
+    net, dpt = _spmd(parallel.make_mesh({"dp": 1}))
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"), trainer=dpt,
+                            async_save=False)
+    dpt.step(x, y)
+    mgr.save(block=True)
+    dpt.step(x, y)
+    mgr.save(block=True)
+    shard = tmp_path / "ck" / "step-00000002" / "shards" / "000.npy"
+    raw = bytearray(shard.read_bytes())
+    raw[-1] ^= 0x20
+    shard.write_bytes(bytes(raw))
+    try:
+        assert mgr.start_scrub(every_s=0.05)
+        assert not mgr.start_scrub(every_s=0.05)   # idempotent
+        deadline = time.time() + 5.0
+        while time.time() < deadline and mgr.steps() != [1]:
+            time.sleep(0.05)
+        assert mgr.steps() == [1]
+        assert telemetry.events("scrub_corrupt")
+    finally:
+        mgr.stop_scrub()
+    # env default 0 starts nothing
+    assert not mgr.start_scrub()
+
+
+def test_serving_migration_checksum_mismatch_heals(monkeypatch):
+    """A KV-page checksum mismatch during a slot-resize migration
+    raises into the crash-heal: the plane lands on the NEW slot count
+    with zeroed pages and the corrupt resident REQUEUED — it replays
+    loudly from its host-owned prompt instead of decoding garbage."""
+    from mxnet_tpu.models import LlamaForCausalLM, llama_tiny
+    from mxnet_tpu.serving import Server
+    V = 31
+    mx.random.seed(0)
+    np.random.seed(0)
+    lm = LlamaForCausalLM(llama_tiny(vocab_size=V))
+    lm.initialize(mx.init.Xavier())
+
+    def prompt(seed, n):
+        return np.random.RandomState(seed).randint(
+            0, V, n).astype("f4")
+
+    ref = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    ref_out = ref.generate([prompt(0, 5)])
+
+    srv = Server(lm, buckets=[(2, 8)], max_new_tokens=4)
+    r1 = srv.submit(prompt(0, 5))
+    srv.step()
+
+    real = integrity.page_checksum
+    state = {"calls": 0}
+
+    def corrupt_once(host):
+        # the FIRST source-side checksum lies — exactly what a page
+        # rotting between read and write looks like to the verify
+        state["calls"] += 1
+        if state["calls"] == 1:
+            return "deadbeefdeadbeef"
+        return real(host)
+
+    monkeypatch.setattr(
+        "mxnet_tpu.elastic.integrity.page_checksum", corrupt_once)
+    rec = srv.resize_slots(4)
+    monkeypatch.setattr(
+        "mxnet_tpu.elastic.integrity.page_checksum", real)
+    assert rec["healed"] is True
+    assert rec["migrated"] == 0            # heal zeroed the pools
+    assert rec["requeued"] >= 1            # the resident replays
+    # the replayed request still finishes token-exact (greedy replay
+    # from the host-owned prompt — the documented recovery semantics)
+    srv.run()
+    assert r1.state == "done"
+    np.testing.assert_array_equal(r1.tokens(), ref_out[0])
+
+
+def test_mxsdc_audit_cli(tmp_path, capsys):
+    """tools/mxsdc.py audit: clean process exits 0; an unanswered
+    corruption incident exits 1 with the finding printed."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxsdc", os.path.join(os.path.dirname(__file__), "..",
+                              "tools", "mxsdc.py"))
+    mxsdc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mxsdc)
+    assert mxsdc.main(["audit"]) == 0
+    telemetry.record_event("corruption_suspected", where="spmd:test",
+                           row="grad", suspects=[2],
+                           fingerprints=["ff"] * 8, step=4)
+    assert mxsdc.main(["audit"]) == 1
+    err = capsys.readouterr().err
+    assert "MXL505" in err

@@ -573,14 +573,17 @@ def test_int8_stage1_keeps_quantized_wire():
 
 def test_stage0_hashes_unchanged_by_release():
     """A stage-0 trainer's persist/struct hashes must not change just
-    because the ZeRO field exists — pre-ZeRO manifests and persisted
-    executables survive the upgrade (the stage is appended only when
-    nonzero)."""
+    because the ZeRO field exists — the stage is appended only when
+    nonzero.  (The integrity sentry's signature DOES ride the tuple on
+    a >1-dp mesh — its fingerprint rows widen the program's outputs,
+    so pre-integrity executables legitimately cannot serve — but a
+    zero stage of 0 still adds nothing on top.)"""
     import hashlib
     from mxnet_tpu import telemetry as _t
     net, dpt = _make(0)
     dpt.step(nd.array(_X), nd.array(_Y))
-    # the pre-ZeRO parts tuple, reproduced verbatim
+    # the pre-ZeRO parts tuple + the integrity component, reproduced
+    # verbatim — NO zero component
     parts = (type(dpt.optimizer).__name__,
              tuple((tuple(p.data().shape), str(p.data().dtype))
                    for p in dpt._params),
@@ -588,7 +591,9 @@ def test_stage0_hashes_unchanged_by_release():
              tuple((str(k), int(v))
                    for k, v in dpt.mesh.shape.items()),
              dpt.dp_axis,
-             _t.health.trace_signature())
+             _t.health.trace_signature()) + (
+                 (dpt._integrity_sig(),)
+                 if dpt._integrity_sig() is not None else ())
     want = hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
     assert dpt._persist_name().endswith(want)
 
