@@ -867,6 +867,43 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
             "last_verdict": howner.get("last_verdict"),
         }
 
+        # mxsan cost (docs/static_analysis.md, "The sanitizer"): the
+        # same steady loop at MXTPU_SANITIZE=0/1/2.  Off is the
+        # contract — the dispatch seams pay ONE attribute load
+        # (engine._san is None) — and the armed levels are reported
+        # as ratios so the opt-in price is a published number, not a
+        # surprise.
+        try:
+            from mxnet_tpu.analysis import sanitizer as _san
+            _san_prev = _san.level()
+            try:
+                _san.configure(0)
+                sane_off = _timed_loop()
+                off_hook_clear = engine._san is None
+                _san.configure(1)
+                n_locks = len(_san.instrumented_locks())
+                sane_1 = _timed_loop()
+                _san.configure(2)
+                sane_2 = _timed_loop()
+            finally:
+                # a level-2 raise mid-loop must not leave the rest of
+                # the bench stages running armed
+                _san.configure(_san_prev)
+            srep = _san.report()
+            tblock["sanitizer"] = {
+                "steps_timed": hloops,
+                "off_seconds": round(sane_off, 4),
+                "off_hook_attr_load_only": off_hook_clear,
+                "level1_overhead_ratio": round(
+                    max(0.0, sane_1 / sane_off - 1.0), 4),
+                "level2_overhead_ratio": round(
+                    max(0.0, sane_2 / sane_off - 1.0), 4),
+                "locks_instrumented": n_locks,
+                "violations": srep["counts"],
+            }
+        except Exception as e:
+            tblock["sanitizer"] = {"error": repr(e)[:300]}
+
         # guardian-plane evidence (docs/elasticity.md, "Guardian &
         # chaos soak"): a short seeded chaos soak — train + serve +
         # one resize under composed random faults — reporting what a

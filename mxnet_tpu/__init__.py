@@ -97,6 +97,13 @@ from . import contrib
 from . import rtc
 from . import analysis
 
+# mxsan (docs/static_analysis.md, "The sanitizer"): arm the
+# donation-lifetime & lock-order sanitizer when the env opts in.  Off
+# (the default) this costs nothing beyond the registry read — the
+# engine seams pay one attribute load per dispatch either way.
+if int(envs.get("MXTPU_SANITIZE") or 0):
+    analysis.sanitizer.configure()
+
 __all__ = ["nd", "ndarray", "autograd", "random", "context", "rtc",
            "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
            "num_gpus", "num_tpus", "Context", "MXNetError", "engine",

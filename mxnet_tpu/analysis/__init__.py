@@ -25,6 +25,8 @@ from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_memory, analyze_parallel,
                       analyze_elasticity, analyze_health,
                       analyze_serving)
+from . import sanitizer
+from .sanitizer import analyze_sanitizer
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -36,6 +38,7 @@ __all__ = [
     "analyze_cache", "analyze_compiled_steps", "analyze_telemetry",
     "analyze_compile_cache", "analyze_memory", "analyze_parallel",
     "analyze_elasticity", "analyze_health", "analyze_serving",
+    "sanitizer", "analyze_sanitizer",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -78,5 +81,11 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # after in-process serving traffic it surfaces buckets that kept
     # compiling in steady state (the zero-retrace contract)
     findings.extend(analyze_serving())
+    # sanitizer pass (MXL701-706, mxsan): quiet in a fresh process
+    # (nothing armed, nothing recorded); after a sanitizer-armed run
+    # it surfaces use-after-donate, lock-order cycles, and the rest
+    # of the MXL7xx family — a sanitizer-armed soak that trips one
+    # fails this gate
+    findings.extend(analyze_sanitizer())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

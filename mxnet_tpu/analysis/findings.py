@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Iterable, List, Optional
 
 __all__ = ["Severity", "Finding", "RULES", "rule_severity",
-           "filter_findings", "format_findings"]
+           "filter_findings", "format_findings", "rules_markdown"]
 
 
 class Severity:
@@ -118,6 +118,41 @@ RULES = {
                "per-request prefill/decode loop without the serving "
                "plane (per-request compile hazard; runtime form: a "
                "serving bucket kept compiling in steady state)"),
+    # -- sanitizer passes (MXL7xx: mxsan, docs/static_analysis.md
+    # "The sanitizer") ---------------------------------------------------
+    "MXL701": (Severity.ERROR,
+               "use-after-donate: a buffer a donated dispatch already "
+               "consumed was handed to another dispatch (the shadow "
+               "lifetime machine attributes the consuming op/owner)"),
+    "MXL702": (Severity.ERROR,
+               "double donation: the same buffer sits at two donate "
+               "indices of one dispatch (XLA may alias both outputs "
+               "onto one allocation — silent corruption)"),
+    "MXL703": (Severity.WARNING,
+               "a poisoned owner was stepped without recover(): the "
+               "donated state is gone and the step can only fail"),
+    "MXL704": (Severity.WARNING,
+               "live-bytes leak: the tracked live-buffer census ended "
+               "above its warmed baseline (buffers pinned past their "
+               "step; see the sanitizer's leak report)"),
+    "MXL705": (Severity.ERROR,
+               "lock-order cycle: the instrumented module locks were "
+               "acquired in inconsistent order on different threads "
+               "(potential deadlock; the finding names the cycle)"),
+    "MXL706": (Severity.WARNING,
+               "a module lock was held across a blocking device "
+               "dispatch (stall hazard: every other thread wanting "
+               "the lock waits out the device)"),
+    "MXL707": (Severity.WARNING,
+               "dead-after-call input not donated: a jit-compiled "
+               "step rebinds its own argument from the result (the "
+               "input is dead after the call) but the jit has no "
+               "donate_argnums — a >=64MiB buffer there is "
+               "double-buffered in HBM (static twin of MXL308/309)"),
+    "MXL708": (Severity.WARNING,
+               "host sync on a step output inside a hot loop "
+               "(.item()/float()/np.asarray() on what step() "
+               "returned): a device round-trip per iteration"),
 }
 
 
@@ -150,6 +185,34 @@ class Finding:
     def to_dict(self) -> dict:
         return {"rule": self.rule, "severity": self.severity,
                 "message": self.message, "location": self.location}
+
+
+#: rule-ID prefix -> family name, for the generated docs index
+_FAMILIES = {
+    "MXL1": "graph passes",
+    "MXL2": "registry passes",
+    "MXL3": "source passes",
+    "MXL4": "runtime passes",
+    "MXL5": "elasticity passes",
+    "MXL6": "serving passes",
+    "MXL7": "sanitizer (mxsan)",
+}
+
+
+def rules_markdown() -> str:
+    """The full MXL rule index as a markdown table, generated from
+    :data:`RULES` — the docs/static_analysis.md "Rule index" section is
+    this function's output, and a tier-1 drift test asserts every
+    registered rule id has a docs row (a new rule cannot land
+    undocumented)."""
+    lines = ["| rule | family | severity | title |",
+             "|---|---|---|---|"]
+    for rule in sorted(RULES):
+        sev, title = RULES[rule]
+        fam = _FAMILIES.get(rule[:4], "?")
+        lines.append(f"| {rule} | {fam} | {sev} | "
+                     f"{' '.join(title.split())} |")
+    return "\n".join(lines) + "\n"
 
 
 def filter_findings(findings: Iterable[Finding],

@@ -1,0 +1,672 @@
+"""mxsan: the donation-lifetime & lock-order sanitizer (MXL7xx).
+
+The stack's core runtime contracts are enforced by convention and by
+crashing when violated: buffer donation ("the donated jax.Array is
+dead after the call" — ``engine.get_compiled``), the poison→
+``recover()`` protocol, and the one-dispatch steady state.  Meanwhile
+five background threads (checkpoint writer, scrub daemon, guardian
+watchdog, serving autoscaler, engine pipeline closer) coordinate
+through ~20 module locks with no tool that can see a lock-order cycle
+or a use-after-donate before it fires in production.  This module is
+that tool — an OPT-IN runtime sanitizer in the TSan tradition
+(reference ``src/engine_stress_tsan.cc``):
+
+* **Leg 1 — buffer-lifetime sanitizer.**  A shadow state machine
+  (live → in-flight → donated/dead) over the arrays the engine already
+  tracks, hooked at the ``invoke_compiled`` / ``retrying_call`` /
+  donation seams:
+
+  - MXL701 — use-after-donate: a buffer a donated dispatch consumed is
+    handed to another dispatch (attributed to the consuming op/owner);
+  - MXL702 — the same buffer at two donate indices of one dispatch
+    (XLA may alias both outputs onto one allocation);
+  - MXL703 — a poisoned owner stepped without ``recover()``;
+  - MXL704 — live-bytes leak vs the warmed baseline at shutdown
+    (:func:`mark_baseline` / :func:`leak_check`).
+
+* **Leg 2 — concurrency sanitizer.**  The known module locks
+  (:data:`LOCK_SITES`) are swapped for instrumented wrappers that feed
+  an acquisition-order graph and per-lock hold-time histograms:
+
+  - MXL705 — a cycle in the acquisition-order graph (potential
+    deadlock; ERROR severity);
+  - MXL706 — a module lock held across a blocking device dispatch
+    (stall hazard: every thread wanting the lock waits out the
+    device).
+
+Master switch: ``MXTPU_SANITIZE`` — ``0`` off (every seam pays one
+attribute load), ``1`` collect findings + retained
+``sanitizer_violation`` events, ``2`` additionally RAISE immediately
+on a lifetime violation (MXL701/702) before the bad dispatch runs.
+Lock findings (MXL705/706) are always collected, never raised — a
+raise from inside a lock acquire would corrupt unrelated state.
+
+Findings ride :func:`analysis.self_check` / ``tools/mxlint.py
+--self-check`` via :func:`analyze_sanitizer`; the lock graph and
+hold-time histograms land in :func:`report` and ``tools/mxsan.py
+report``; the chaos soak (``elastic/chaos.py``) arms this module so
+every fault/recovery/resize transition runs under the lifetime
+checker.  See docs/static_analysis.md ("The sanitizer").
+"""
+from __future__ import annotations
+
+import sys as _sys
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding
+
+__all__ = ["configure", "level", "enabled", "reset",
+           "pre_dispatch", "post_dispatch", "check_donation",
+           "note_poisoned_step",
+           "mark_baseline", "baseline", "leak_check",
+           "instrument_locks", "restore_locks", "instrumented_locks",
+           "held_locks", "lock_graph", "hold_stats",
+           "records", "report", "analyze_sanitizer", "LOCK_SITES"]
+
+#: the known module locks the concurrency leg instruments:
+#: (module, attribute, label).  Adding a module lock to the codebase
+#: should add a row here — the lock-order graph can only see what it
+#: wraps.
+LOCK_SITES: Tuple[Tuple[str, str, str], ...] = (
+    ("mxnet_tpu.engine", "_lock", "engine._lock"),
+    ("mxnet_tpu.engine", "_attr_lock", "engine._attr_lock"),
+    ("mxnet_tpu.engine.persist", "_lock", "persist._lock"),
+    ("mxnet_tpu.elastic.manager", "_SWAP_LOCK", "manager._SWAP_LOCK"),
+    ("mxnet_tpu.elastic.manager", "_reg_lock", "manager._reg_lock"),
+    ("mxnet_tpu.elastic.guardian", "_lock", "guardian._lock"),
+    ("mxnet_tpu.elastic.faults", "_lock", "faults._lock"),
+    ("mxnet_tpu.elastic.resize", "_reg_lock", "resize._reg_lock"),
+    ("mxnet_tpu.elastic.integrity", "_scrub_lock",
+     "integrity._scrub_lock"),
+    ("mxnet_tpu.elastic.chaos", "_reg_lock", "chaos._reg_lock"),
+    ("mxnet_tpu.telemetry.metrics", "_lock", "metrics._lock"),
+    ("mxnet_tpu.telemetry.recorder", "_lock", "recorder._lock"),
+    ("mxnet_tpu.telemetry.memory", "_lock", "memory._lock"),
+    ("mxnet_tpu.telemetry.health", "_reg_lock", "health._reg_lock"),
+    ("mxnet_tpu.serving.server", "_reg_lock", "server._reg_lock"),
+    ("mxnet_tpu.parallel.planner", "_reg_lock", "planner._reg_lock"),
+    ("mxnet_tpu.profiler", "_lock", "profiler._lock"),
+    ("mxnet_tpu.gluon.compiled_step", "_fallback_lock",
+     "compiled_step._fallback_lock"),
+)
+
+#: hold-time histogram boundaries (seconds); the last bucket is +inf
+_HOLD_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)
+
+_MAX_RECORDS = 512
+_MAX_SHADOW = 4096
+
+# every sanitizer-internal structure takes RAW locks (never wrapped —
+# wrapping the sanitizer's own bookkeeping would recurse)
+_meta_lock = threading.Lock()
+_rec_lock = threading.Lock()
+
+_LEVEL = 0
+_tls = threading.local()
+
+#: id(buffer) -> shadow record for buffers a donated dispatch consumed.
+#: The weakref disambiguates id reuse: a record only convicts when its
+#: ref still points at the SAME object (a collected buffer's id can be
+#: recycled by an unrelated allocation).
+_shadow: "OrderedDict[int, dict]" = OrderedDict()
+
+#: (rule, key) -> finding record (message, op/owner attribution, count)
+_records: "OrderedDict[Tuple[str, str], dict]" = OrderedDict()
+
+#: (held, acquired) -> {"count", "thread"} — the acquisition-order graph
+_edges: Dict[Tuple[str, str], dict] = {}
+#: lock label -> {"n", "total_s", "max_s", "buckets"} hold-time stats
+_holds: Dict[str, dict] = {}
+#: label -> (module, attr, raw lock) for every wrapped site
+_wrapped: Dict[str, tuple] = {}
+
+_baseline_bytes: Optional[int] = None
+
+#: True while some record awaits its retained-event emission (was
+#: detected under an instrumented lock) — the dispatch seams check
+#: this one global before paying for a _flush_pending() walk
+_has_pending = False
+
+
+# -- switch ------------------------------------------------------------------
+
+def level() -> int:
+    """The active sanitizer level (0 off / 1 collect / 2 raise)."""
+    return _LEVEL
+
+
+def enabled() -> bool:
+    return _LEVEL >= 1
+
+
+def configure(lvl: Optional[int] = None) -> int:
+    """Set the sanitizer level (``None`` re-reads ``MXTPU_SANITIZE``)
+    and arm/disarm the seams: level >= 1 installs the engine dispatch
+    hook and swaps the :data:`LOCK_SITES` for instrumented wrappers;
+    level 0 restores both (one attribute load per seam remains)."""
+    global _LEVEL
+    if lvl is None:
+        from .. import envs
+        lvl = int(envs.get("MXTPU_SANITIZE"))
+    lvl = max(0, min(2, int(lvl)))
+    _LEVEL = lvl
+    from .. import engine
+    if lvl >= 1:
+        engine._san = _sys.modules[__name__]
+        instrument_locks()
+    else:
+        engine._san = None
+        restore_locks()
+    return lvl
+
+
+def reset():
+    """Forget findings, shadow state, the lock graph, hold stats, and
+    the leak baseline (the armed/level state survives) — test
+    isolation and per-soak hygiene."""
+    global _baseline_bytes, _has_pending
+    with _rec_lock:
+        _records.clear()
+        _has_pending = False
+    with _meta_lock:
+        _shadow.clear()
+        _edges.clear()
+        _holds.clear()
+    _baseline_bytes = None
+
+
+# -- finding plumbing --------------------------------------------------------
+
+def _emit(rule: str, message: str, **fields):
+    """Retained ``sanitizer_violation`` event + counter, re-entrancy
+    guarded (the recorder/metrics locks are themselves instrumented:
+    the emission must not record its own lock traffic) and never
+    raising — forensics must not mask the violation.  Only called
+    from :func:`_flush_pending`, i.e. never while the calling thread
+    holds an instrumented lock."""
+    _tls.in_san = True
+    try:
+        from .. import telemetry
+        telemetry.counter(
+            "mxtpu_sanitizer_violations_total",
+            "distinct sanitizer (MXL7xx) violations recorded").inc()
+        telemetry.record_event("sanitizer_violation", rule=rule,
+                               message=message[:500], **fields)
+    except Exception:
+        pass
+    finally:
+        _tls.in_san = False
+
+
+def _violation(rule: str, key: str, message: str, op=None, owner=None,
+               raise_now: bool = False, **extra):
+    global _has_pending
+    owner_name = None
+    if owner is not None:
+        owner_name = getattr(owner, "name", None) or \
+            type(owner).__name__
+    with _rec_lock:
+        rec = _records.get((rule, key))
+        if rec is not None:
+            rec["count"] += 1
+            fresh = False
+        else:
+            fresh = len(_records) < _MAX_RECORDS
+            if fresh:
+                _records[(rule, key)] = {
+                    "rule": rule, "message": message, "location": key,
+                    "op": op, "owner": owner_name, "count": 1,
+                    "ts": time.time(), "emitted": False, **extra}
+                _has_pending = True
+    if fresh:
+        # the retained event must NOT be emitted while this thread
+        # holds an instrumented lock: telemetry takes the (wrapped)
+        # metrics/recorder locks, and MXL705/706 fire exactly when
+        # such a lock IS held — re-acquiring it here would
+        # self-deadlock.  Deferred records flush at the next safe
+        # point (_flush_pending: a lock-free dispatch, report(), or
+        # analyze_sanitizer()).
+        if not getattr(_tls, "held", None):
+            _flush_pending()
+    if raise_now and _LEVEL >= 2:
+        from ..base import MXNetError
+        raise MXNetError(f"MXTPU_SANITIZE=2: {rule}: {message}")
+
+
+def _flush_pending():
+    """Emit the retained event for every recorded violation that could
+    not emit at detection time (detected under an instrumented lock).
+    Called from every lock-free seam that can afford it: a violation
+    on an unlocked thread, the dispatch hooks, ``report()`` and
+    ``analyze_sanitizer()``."""
+    global _has_pending
+    if getattr(_tls, "held", None):
+        return
+    pending = []
+    with _rec_lock:
+        for rec in _records.values():
+            if not rec.get("emitted"):
+                rec["emitted"] = True
+                pending.append(dict(rec))
+        _has_pending = False
+    for rec in pending:
+        extra = {k: v for k, v in rec.items()
+                 if k in ("locks", "cycle", "donor_op", "donor_owner",
+                          "live_bytes", "baseline_bytes")}
+        _emit(rec["rule"], rec["message"], op=rec.get("op"),
+              owner=rec.get("owner"), **extra)
+
+
+def records() -> List[dict]:
+    """Snapshot of the recorded violations (the MXL7xx finding
+    input)."""
+    with _rec_lock:
+        return [dict(r) for r in _records.values()]
+
+
+# -- leg 1: buffer lifetime --------------------------------------------------
+
+def _is_deleted(a) -> bool:
+    try:
+        return bool(a.is_deleted())
+    except Exception:
+        return False
+
+
+def pre_dispatch(op: str, arrays, donate=None, owner=None):
+    """Dispatch-entry hook (``engine.invoke_compiled`` and the SPMD
+    trainer's fused seams): use-after-donate (MXL701) over every
+    input, double donation (MXL702) over the donate indices, and
+    lock-held-across-dispatch (MXL706) for the calling thread."""
+    if not _LEVEL:
+        return
+    held = getattr(_tls, "held", None)
+    if _has_pending and not held:
+        # a lock-free dispatch is the flush seam the deferred
+        # MXL705/706 retained events wait for
+        _flush_pending()
+    if held:
+        names = [h for h, _t in held]
+        _violation(
+            "MXL706", f"san:lock-across-dispatch:{names[-1]}:{op}",
+            f"dispatch of {op!r} while holding module lock(s) "
+            f"{names}: the device round-trip stalls every thread "
+            "waiting on them; move the dispatch outside the lock",
+            op=op, owner=owner, locks=names)
+    for i, a in enumerate(arrays):
+        rec = _shadow.get(id(a))
+        if rec is not None:
+            if rec["ref"]() is a:
+                _violation(
+                    "MXL701", f"san:use-after-donate:{op}:{i}",
+                    f"input #{i} of {op!r} was already donated to "
+                    f"{rec['op']!r}"
+                    + (f" (owner {rec['owner']})" if rec.get("owner")
+                       else "")
+                    + " — the buffer is dead; rebind the caller to "
+                    "the dispatch's OUTPUT instead of the consumed "
+                    "input (docs/static_analysis.md, 'The "
+                    "sanitizer')",
+                    op=op, owner=owner, donor_op=rec["op"],
+                    donor_owner=rec.get("owner"), raise_now=True)
+            else:
+                # id recycled by an unrelated object: drop stale row
+                with _meta_lock:
+                    _shadow.pop(id(a), None)
+        elif _is_deleted(a):
+            _violation(
+                "MXL701", f"san:use-after-donate:{op}:{i}",
+                f"input #{i} of {op!r} is already deleted (donated "
+                "by an untracked dispatch or explicitly freed) — "
+                "the dispatch would read dead memory",
+                op=op, owner=owner, raise_now=True)
+    if donate:
+        check_donation(op, arrays, donate, owner=owner)
+
+
+def check_donation(op: str, arrays, donate, owner=None):
+    """MXL702 — the same buffer at two donate indices of one dispatch
+    (``donate=None`` means every array is donated, the SPMD trainer's
+    pre-filtered set)."""
+    if not _LEVEL:
+        return
+    idx = donate if donate is not None else range(len(arrays))
+    seen: Dict[int, int] = {}
+    for j in idx:
+        if j >= len(arrays):
+            continue
+        k = id(arrays[j])
+        if k in seen:
+            _violation(
+                "MXL702", f"san:double-donate:{op}:{seen[k]}:{j}",
+                f"{op!r} donates the SAME buffer at indices "
+                f"{seen[k]} and {j}: XLA may alias both outputs "
+                "onto one allocation — pass distinct buffers or "
+                "drop one index from donate_argnums",
+                op=op, owner=owner, raise_now=True)
+        else:
+            seen[k] = j
+
+
+def post_dispatch(op: str, arrays, donate=None, owner=None):
+    """Dispatch-success hook: the donated inputs are now dead — record
+    them in the shadow table with op/owner attribution so a later use
+    convicts with a name, not a bare jax deleted-buffer error.
+    ``donate=None`` means every array in ``arrays`` was donated (the
+    SPMD trainer passes its pre-filtered donated set)."""
+    if not _LEVEL:
+        return
+    if _has_pending and not getattr(_tls, "held", None):
+        _flush_pending()
+    owner_name = None
+    if owner is not None:
+        owner_name = getattr(owner, "name", None) or \
+            type(owner).__name__
+    idx = donate if donate is not None else range(len(arrays))
+    now = time.time()
+    with _meta_lock:
+        for j in idx:
+            if j >= len(arrays):
+                continue
+            a = arrays[j]
+            try:
+                ref = weakref.ref(a)
+            except TypeError:
+                continue            # not a buffer (python scalar, ...)
+            _shadow[id(a)] = {"ref": ref, "op": op,
+                              "owner": owner_name, "ts": now}
+        if len(_shadow) > _MAX_SHADOW:
+            # collected buffers first (their id may be recycled),
+            # then oldest records
+            for k in [k for k, r in _shadow.items()
+                      if r["ref"]() is None]:
+                del _shadow[k]
+            while len(_shadow) > _MAX_SHADOW:
+                _shadow.popitem(last=False)
+
+
+def note_poisoned_step(owner, where: str, error) -> None:
+    """MXL703 — an owner whose donated state is gone was stepped
+    without ``recover()``.  Called by the step paths right before
+    their poisoned-owner raise (the raise still happens at every
+    level; the finding is the audit trail)."""
+    if not _LEVEL:
+        return
+    _violation(
+        "MXL703", f"san:poisoned-step:{where}",
+        f"{where}: a poisoned owner was stepped without recover() — "
+        "its donated state was consumed by a failed dispatch "
+        f"({str(error)[:200]}); call recover(manager) first "
+        "(docs/elasticity.md)",
+        op=where, owner=owner)
+
+
+def mark_baseline(nbytes: Optional[int] = None) -> int:
+    """Record the warmed live-bytes baseline the shutdown leak check
+    (MXL704) compares against — call once the steady state is reached
+    (after warm-up, like the chaos soak does)."""
+    global _baseline_bytes
+    if nbytes is None:
+        from .. import engine
+        nbytes = engine.live_bytes()
+    _baseline_bytes = int(nbytes)
+    return _baseline_bytes
+
+
+def baseline() -> Optional[int]:
+    return _baseline_bytes
+
+
+def leak_check(slack_bytes: int = 2 << 20,
+               factor: float = 2.0) -> Optional[dict]:
+    """MXL704 — compare the current tracked live-bytes census against
+    the :func:`mark_baseline` snapshot (leak when ``live > baseline *
+    factor + slack_bytes``, the chaos soak's tolerance).  Returns the
+    violation record, or ``None`` when clean / no baseline marked."""
+    if _baseline_bytes is None:
+        return None
+    from .. import engine
+    live = engine.live_bytes()
+    if live <= _baseline_bytes * factor + slack_bytes:
+        return None
+    _violation(
+        "MXL704", "san:live-bytes-leak",
+        f"tracked live buffers ended at {live} bytes vs the warmed "
+        f"baseline {_baseline_bytes} (tolerance x{factor} + "
+        f"{slack_bytes}): buffers are pinned past their step — a "
+        "stale reference is holding donation's HBM savings hostage",
+        live_bytes=live, baseline_bytes=_baseline_bytes)
+    return {"live_bytes": live, "baseline_bytes": _baseline_bytes}
+
+
+# -- leg 2: lock order -------------------------------------------------------
+
+class SanLock:
+    """Instrumented stand-in for a module ``threading.Lock``: delegates
+    to the SAME underlying lock (so pre-swap references interoperate)
+    and feeds the acquisition-order graph + hold-time stats."""
+
+    __slots__ = ("_raw", "name")
+
+    def __init__(self, raw, name: str):
+        self._raw = raw
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.name)
+        return ok
+
+    def release(self):
+        _note_release(self.name)
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def _note_acquire(name: str):
+    tls = _tls
+    if getattr(tls, "in_san", False):
+        return
+    held = getattr(tls, "held", None)
+    if held is None:
+        held = tls.held = []
+    if held:
+        cycles = []
+        with _meta_lock:
+            for h, _t in held:
+                if h == name:
+                    continue
+                e = _edges.get((h, name))
+                if e is None:
+                    _edges[(h, name)] = {
+                        "count": 1,
+                        "thread": threading.current_thread().name}
+                    cyc = _find_cycle_locked(name, h)
+                    if cyc:
+                        cycles.append(cyc)
+                else:
+                    e["count"] += 1
+        for cyc in cycles:
+            _violation(
+                "MXL705",
+                "san:lock-cycle:" + ">".join(sorted(set(cyc))),
+                "lock-order cycle " + " -> ".join(cyc) + ": these "
+                "locks are acquired in inconsistent order on "
+                "different threads — two of them interleaving is a "
+                "deadlock; pick one order (docs/static_analysis.md, "
+                "'The sanitizer')",
+                cycle=cyc)
+    held.append((name, time.perf_counter()))
+
+
+def _find_cycle_locked(src: str, dst: str) -> Optional[List[str]]:
+    """Path ``src -> ... -> dst`` through the edge set (caller holds
+    ``_meta_lock``); with the new edge ``dst -> src`` just added, a
+    found path closes a cycle."""
+    succ: Dict[str, list] = {}
+    for (a, b) in _edges:
+        succ.setdefault(a, []).append(b)
+    stack = [(src, [dst, src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in succ.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_release(name: str):
+    tls = _tls
+    if getattr(tls, "in_san", False):
+        return
+    held = getattr(tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name:
+            _n, t0 = held.pop(i)
+            dt = time.perf_counter() - t0
+            with _meta_lock:
+                st = _holds.get(name)
+                if st is None:
+                    st = _holds[name] = {
+                        "n": 0, "total_s": 0.0, "max_s": 0.0,
+                        "buckets": [0] * (len(_HOLD_BUCKETS) + 1)}
+                st["n"] += 1
+                st["total_s"] += dt
+                if dt > st["max_s"]:
+                    st["max_s"] = dt
+                for bi, bound in enumerate(_HOLD_BUCKETS):
+                    if dt <= bound:
+                        st["buckets"][bi] += 1
+                        break
+                else:
+                    st["buckets"][-1] += 1
+            return
+
+
+def instrument_locks() -> List[str]:
+    """Swap every :data:`LOCK_SITES` module lock for a :class:`SanLock`
+    wrapper (idempotent; the wrapper delegates to the same underlying
+    lock, so references captured before the swap stay coherent).
+    Returns the labels instrumented."""
+    import importlib
+    out = []
+    for mod_name, attr, label in LOCK_SITES:
+        try:
+            mod = importlib.import_module(mod_name)
+        except Exception:
+            continue                # optional surface not importable
+        cur = getattr(mod, attr, None)
+        if cur is None or isinstance(cur, SanLock):
+            continue
+        setattr(mod, attr, SanLock(cur, label))
+        _wrapped[label] = (mod, attr, cur)
+        out.append(label)
+    return out
+
+
+def restore_locks():
+    """Put the raw locks back (wrappers already handed out keep
+    working — they delegate to the same lock object)."""
+    for label, (mod, attr, raw) in list(_wrapped.items()):
+        if isinstance(getattr(mod, attr, None), SanLock):
+            setattr(mod, attr, raw)
+        del _wrapped[label]
+
+
+def instrumented_locks() -> List[str]:
+    return sorted(_wrapped)
+
+
+def held_locks() -> List[str]:
+    """Instrumented locks the CALLING thread currently holds."""
+    return [h for h, _t in getattr(_tls, "held", ())]
+
+
+def lock_graph() -> dict:
+    """The acquisition-order graph: edges with counts + the recorded
+    cycles (``tools/mxsan.py report`` renders this)."""
+    with _meta_lock:
+        edges = [{"from": a, "to": b, **e}
+                 for (a, b), e in sorted(_edges.items())]
+    cycles = [r.get("cycle") for r in records()
+              if r["rule"] == "MXL705"]
+    return {"edges": edges, "cycles": cycles}
+
+
+def hold_stats() -> Dict[str, dict]:
+    """Per-lock hold-time stats (count/total/max + the fixed-bucket
+    histogram over :data:`_HOLD_BUCKETS` seconds)."""
+    with _meta_lock:
+        return {k: {**v, "buckets": list(v["buckets"]),
+                    "bucket_bounds_s": list(_HOLD_BUCKETS)}
+                for k, v in sorted(_holds.items())}
+
+
+# -- reporting ---------------------------------------------------------------
+
+def report() -> dict:
+    """``cache_info()``-style snapshot of both legs: level/armed
+    state, the shadow table + leak baseline, the lock graph +
+    hold-time histograms, and every recorded violation."""
+    from .. import engine
+    _flush_pending()
+    recs = records()
+    counts: Dict[str, int] = {}
+    for r in recs:
+        counts[r["rule"]] = counts.get(r["rule"], 0) + r["count"]
+    with _meta_lock:
+        shadow_n = len(_shadow)
+    return {
+        "level": _LEVEL,
+        "armed": _LEVEL >= 1,
+        "lifetime": {
+            "donated_tracked": shadow_n,
+            "baseline_bytes": _baseline_bytes,
+            "live_bytes": engine.live_bytes(),
+        },
+        "locks": {
+            "instrumented": instrumented_locks(),
+            **lock_graph(),
+            "holds": hold_stats(),
+        },
+        "counts": counts,
+        "findings": recs,
+    }
+
+
+def analyze_sanitizer() -> List[Finding]:
+    """One mxlint finding per recorded MXL70x violation (plus a fresh
+    MXL704 check when a baseline was marked) — rides
+    ``analysis.self_check()`` / ``tools/mxlint.py --self-check``.
+    Free in a fresh process: nothing armed, nothing recorded."""
+    if _LEVEL >= 1:
+        leak_check()
+    _flush_pending()
+    out = []
+    for r in records():
+        msg = r["message"]
+        if r["count"] > 1:
+            msg += f" (x{r['count']})"
+        out.append(Finding(r["rule"], msg, r["location"]))
+    return out

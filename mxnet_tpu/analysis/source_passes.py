@@ -62,6 +62,23 @@ Heuristics are deliberately scoped to keep the signal high:
   elasticity``) reports when N steps actually RAN in-process and no
   manager was ever constructed.
 
+* MXL707 (mxsan's static leg) fires when a loop rebinds a variable
+  from a call to a ``jax.jit``-compiled function that takes the SAME
+  variable as an argument — ``params = step(params, batch)`` — and
+  the ``jit`` construction (visible in the same module) has no
+  ``donate_argnums``: the input is dead after the call, so a >=64MiB
+  buffer there is double-buffered in HBM for nothing (the static twin
+  of the runtime MXL308/309 checks; the engine's fused paths donate
+  exactly this shape).
+
+* MXL708 (mxsan's static leg) fires for a host sync (``.item()`` /
+  ``float()`` / ``np.asarray()`` / ``.asnumpy()``) applied to a STEP
+  OUTPUT — a name bound from a ``.step()``/``.step_multi()`` call in
+  the same loop nest — inside the loop: a device round-trip per
+  iteration on the training signal.  Loss/metric-named receivers keep
+  reporting as MXL311 (the health-plane pointer); MXL708 covers the
+  rest.
+
 Suppress any rule on a line with ``# mxlint: disable=MXL301`` (comma-
 separated IDs) or every rule with a bare ``# mxlint: disable``.
 """
@@ -245,6 +262,71 @@ def _loop_trip_count(loop) -> Optional[float]:
     return None
 
 
+def _step_output_names(loop) -> Set[str]:
+    """Names the loop binds from a ``.step()``/``.step_multi()`` call
+    (the MXL708 receivers); gym-convention ``env.step()`` is exempt."""
+    names: Set[str] = set()
+    for sub in ast.walk(loop):
+        if not (isinstance(sub, ast.Assign) and
+                isinstance(sub.value, ast.Call)):
+            continue
+        f = sub.value.func
+        if not (isinstance(f, ast.Attribute) and
+                f.attr in ("step", "step_multi")):
+            continue
+        chain = _attr_chain(f)
+        if len(chain) >= 2 and chain[-2] in ("env", "environment"):
+            continue
+        for t in sub.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+    return names
+
+
+def _jit_bindings(tree) -> dict:
+    """``{name: has_donate}`` for every module-visible binding of a
+    jit-compiled callable: ``f = jax.jit(fn, ...)`` assignments and
+    ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs — the
+    MXL707 input."""
+    out: dict = {}
+
+    def _jit_call_donates(call: ast.Call):
+        """(is_jit, has_donate) for a Call node."""
+        chain = _attr_chain(call.func)
+        if chain and chain[-1] == "jit":
+            return True, any(kw.arg in ("donate_argnums",
+                                        "donate_argnames")
+                             for kw in call.keywords)
+        if chain and chain[-1] == "partial" and call.args:
+            inner = _attr_chain(call.args[0])
+            if inner and inner[-1] == "jit":
+                return True, any(kw.arg in ("donate_argnums",
+                                            "donate_argnames")
+                                 for kw in call.keywords)
+        return False, False
+
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            is_jit, donates = _jit_call_donates(n.value)
+            if is_jit:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = donates
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if isinstance(dec, ast.Call):
+                    is_jit, donates = _jit_call_donates(dec)
+                else:
+                    chain = _attr_chain(dec)
+                    is_jit, donates = (bool(chain) and
+                                       chain[-1] == "jit"), False
+                if is_jit:
+                    out[n.name] = donates
+                    break
+    return out
+
+
 def _loop_varying_names(loop) -> Set[str]:
     """Names the loop changes: induction targets + assignment targets in
     the body (these are the candidates for per-step attr values)."""
@@ -278,7 +360,8 @@ def _get_op(opname: str):
 
 class _SourceVisitor(ast.NodeVisitor):
     def __init__(self, filename: str, uses_step_compilation=False,
-                 uses_checkpointing=False, uses_serving=False):
+                 uses_checkpointing=False, uses_serving=False,
+                 jit_fns=None):
         self.filename = filename
         self.findings: List[Finding] = []
         self._loops: List[dict] = []       # {training, varying, per_op}
@@ -286,6 +369,8 @@ class _SourceVisitor(ast.NodeVisitor):
         self._uses_step_compilation = uses_step_compilation
         self._uses_checkpointing = uses_checkpointing
         self._uses_serving = uses_serving
+        #: module-visible jit bindings for MXL707: name -> has_donate
+        self._jit_fns = jit_fns or {}
 
     # -- helpers ---------------------------------------------------------
     def _loc(self, node) -> str:
@@ -293,6 +378,21 @@ class _SourceVisitor(ast.NodeVisitor):
 
     def _in_training_loop(self) -> bool:
         return any(l["training"] for l in self._loops)
+
+    def _step_outs(self) -> Set[str]:
+        out: Set[str] = set()
+        for l in self._loops:
+            out |= l["step_outs"]
+        return out
+
+    def _is_step_output(self, node) -> bool:
+        """Does this expression reference a name the enclosing loop
+        nest bound from a ``.step()``/``.step_multi()`` call?"""
+        outs = self._step_outs()
+        if not outs:
+            return False
+        return any(isinstance(n, ast.Name) and n.id in outs
+                   for n in ast.walk(node))
 
     def _varying(self) -> Set[str]:
         out: Set[str] = set()
@@ -331,7 +431,8 @@ class _SourceVisitor(ast.NodeVisitor):
                             "ckpt_fired": False,
                             "serving_fired": False,
                             "induction": induction,
-                            "range_loop": range_loop})
+                            "range_loop": range_loop,
+                            "step_outs": _step_output_names(node)})
         self.generic_visit(node)
         self._loops.pop()
 
@@ -350,6 +451,36 @@ class _SourceVisitor(ast.NodeVisitor):
             self.generic_visit(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- assignments -----------------------------------------------------
+    def visit_Assign(self, node):
+        # MXL707 (mxsan's static donation-coverage audit): a loop
+        # rebinds a variable from a jit'd callee that takes the SAME
+        # variable — dead after the call — but the jit construction
+        # has no donate_argnums: the buffer is double-buffered in HBM
+        # (>=64MiB of params there is exactly the waste MXL308/309
+        # observe at runtime)
+        if self._loops and isinstance(node.value, ast.Call) and \
+                isinstance(node.value.func, ast.Name) and \
+                self._jit_fns.get(node.value.func.id) is False:
+            targets = {n.id for t in node.targets
+                       for n in ast.walk(t)
+                       if isinstance(n, ast.Name)}
+            args = {a.id for a in node.value.args
+                    if isinstance(a, ast.Name)}
+            hit = sorted(targets & args)
+            if hit:
+                fname = node.value.func.id
+                self.findings.append(Finding(
+                    "MXL707",
+                    f"{fname}(...) rebinds {', '.join(hit)} from its "
+                    "own argument — the input is dead after the call — "
+                    f"but the jax.jit binding of {fname!r} has no "
+                    "donate_argnums: a >=64MiB buffer there is held "
+                    "old AND new in HBM; donate the rebound argument "
+                    "(docs/static_analysis.md, 'The sanitizer')",
+                    self._loc(node)))
+        self.generic_visit(node)
 
     # -- calls -----------------------------------------------------------
     def visit_Call(self, node):
@@ -375,6 +506,13 @@ class _SourceVisitor(ast.NodeVisitor):
                         "(telemetry.health, docs/observability.md); "
                         "drop the read or consume the sampled plane",
                         self._loc(node)))
+                elif self._is_step_output(node.func.value):
+                    self.findings.append(Finding(
+                        "MXL708", f"{sync} on a step output inside "
+                        "the hot loop: a device round-trip per "
+                        "iteration; keep the output on-device (or "
+                        "consume the sampled health plane) and sync "
+                        "once per log interval", self._loc(node)))
                 else:
                     self.findings.append(Finding(
                         "MXL301", f"{sync} inside a training loop "
@@ -397,11 +535,33 @@ class _SourceVisitor(ast.NodeVisitor):
                         "samples them every MXTPU_HEALTH_EVERY steps "
                         "(telemetry.health, docs/observability.md)",
                         self._loc(node)))
+                elif self._is_step_output(node.args[0]):
+                    self.findings.append(Finding(
+                        "MXL708", f"{cast} on a step output inside "
+                        "the hot loop: an implicit device sync per "
+                        "iteration (host scalar conversion); keep it "
+                        "on-device and sync once per log interval",
+                        self._loc(node)))
                 else:
                     self.findings.append(Finding(
                         "MXL301", f"{cast} on an array inside a "
                         "training loop is an implicit device sync "
                         "(host scalar conversion)", self._loc(node)))
+            else:
+                # np.asarray(step_output): a full host materialization
+                # the other sync detectors do not cover — mxsan's
+                # MXL708 (loss-named receivers stay MXL311's beat)
+                chain = _attr_chain(node.func)
+                if len(chain) >= 2 and chain[-1] == "asarray" and \
+                        chain[-2] in ("np", "numpy") and node.args and \
+                        self._is_step_output(node.args[0]) and \
+                        not _names_loss(node.args[0]):
+                    self.findings.append(Finding(
+                        "MXL708", "np.asarray(...) on a step output "
+                        "inside the hot loop: a full host "
+                        "materialization per iteration; keep the "
+                        "output on-device and sync once per log "
+                        "interval", self._loc(node)))
 
         if self._loops:
             self._check_per_step_attrs(node)
@@ -560,7 +720,8 @@ def analyze_source(text: str, filename: str = "<string>") -> List[Finding]:
         filename,
         uses_step_compilation=_module_uses_step_compilation(tree),
         uses_checkpointing=_module_uses_checkpointing(tree),
-        uses_serving=_module_uses_serving(tree))
+        uses_serving=_module_uses_serving(tree),
+        jit_fns=_jit_bindings(tree))
     v.visit(tree)
     return _apply_suppressions(v.findings, text)
 

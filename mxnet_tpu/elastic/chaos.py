@@ -195,7 +195,7 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
          save_every: int = 10, hang_ms: int = 150,
          watchdog_timeout: float = 0.06,
          out_dir: Optional[str] = None,
-         progress=None) -> dict:
+         progress=None, sanitize: bool = True) -> dict:
     """Run the chaos soak and return its artifact (also appended to
     :func:`artifacts` for the MXL504 audit; written to
     ``out_dir/soak-<seed>.json`` when ``out_dir`` is given).
@@ -207,6 +207,13 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
     resize (slot count x2) at mid-soak, and a ttl-armed 10x flood at
     3/4 — all under ``schedule`` (default: ``Schedule(seed, steps)``).
     ``progress``: optional callable taking one status line.
+
+    ``sanitize`` (default on): arm mxsan (``analysis.sanitizer``) for
+    the soak's duration, so every fault/recovery/resize transition
+    runs under the donation-lifetime checker and the lock-order
+    graph; an MXL70x violation recorded during the soak fails the
+    ``sanitizer_clean`` invariant — a soak that passes the recovery
+    invariants but trips the sanitizer does NOT certify.
     """
     import numpy as np
     sched = schedule if schedule is not None else \
@@ -250,6 +257,21 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
     env_prev = {k: os.environ.get(k) for k in _ENV_PINS}
     os.environ.update(_ENV_PINS)
     faults.clear()
+    # mxsan armed mode: level 1 (collect — the soak's own poison/
+    # recover drills must run their natural course; level 2 would
+    # preempt them) unless the process already runs hotter.  The
+    # per-key COUNTS are snapshotted (not the list length: records
+    # dedup by (rule, key), so a repeat of a pre-soak violation only
+    # bumps a count) so exactly the violations recorded DURING the
+    # soak fail certification.
+    from ..analysis import sanitizer as _san
+    san_prev = _san.level()
+    san_base_prev = _san.baseline()
+    san_counts0: dict = {}
+    if sanitize:
+        _san.configure(max(san_prev, 1))
+        san_counts0 = {(r["rule"], r["location"]): r["count"]
+                       for r in _san.records()}
     # a soak is a DRILL: its injected poisons/errors must not consume
     # the process's throttled crash-forensics budget (a real failure
     # after the soak still deserves its auto-dump)
@@ -296,6 +318,10 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
         gc.collect()
         live0 = engine.cache_info()["live_bytes"]
         _m0, fresh0 = engine.compile_counts()
+        if sanitize:
+            # the MXL704 leak baseline = the same warmed census the
+            # no_leaked_buffers invariant anchors on
+            _san.mark_baseline(live0)
         say(f"warmed: live {live0} B, plan\n{sched.describe()}")
 
         rec_seen = len(telemetry.events("recovery"))
@@ -565,10 +591,38 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
                      f"params differ from the unfaulted reference at "
                      f"step {steps}: {mism}")
 
-        inv = {}
-        for name in ("committed_monotonic", "params_exact",
+        # mxsan certification leg: an MXL70x recorded during the soak
+        # (use-after-donate, double donation, poisoned-step, leak,
+        # lock cycle, lock-across-dispatch) fails certification even
+        # when every recovery invariant held
+        san_block = None
+        if sanitize:
+            _san.leak_check()
+            san_new = [
+                r for r in _san.records()
+                if r["count"] > san_counts0.get(
+                    (r["rule"], r["location"]), 0)]
+            for r in san_new:
+                _violate("sanitizer_clean",
+                         f"{r['rule']}: {r['message'][:200]}")
+            san_block = {
+                "armed": True, "level": _san.level(),
+                "locks_instrumented":
+                    len(_san.instrumented_locks()),
+                "lock_edges": len(_san.lock_graph()["edges"]),
+                "violations": [
+                    {"rule": r["rule"], "count": r["count"],
+                     "message": r["message"][:200]}
+                    for r in san_new],
+            }
+
+        inv_names = ["committed_monotonic", "params_exact",
                      "zero_fresh_compiles", "no_unrecovered_poison",
-                     "no_leaked_buffers"):
+                     "no_leaked_buffers"]
+        if sanitize:
+            inv_names.append("sanitizer_clean")
+        inv = {}
+        for name in inv_names:
             bad = [v for v in violations if v["invariant"] == name]
             inv[name] = {"ok": not bad,
                          "violations": [v["detail"] for v in bad]}
@@ -590,6 +644,7 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
             "resize": resize_rec,
             "flood": flood_stats,
             "serving_stats": srv.stats(),
+            "sanitizer": san_block,
             "live_bytes": {"warm": live0, "end": live1},
             "invariants": inv,
             "violations": violations,
@@ -608,6 +663,13 @@ def soak(steps: int = 200, schedule: Optional[Schedule] = None,
         return artifact
     finally:
         faults.clear()
+        if sanitize:
+            _san.configure(san_prev)
+            # the MXL704 baseline was anchored at the soak's own small
+            # warmed census — restore the caller's (a later
+            # self_check() against the soak's baseline would report a
+            # spurious leak for any bigger workload)
+            _san._baseline_bytes = san_base_prev
         with _recorder._lock:
             _recorder._auto_dumps_left = dumps_prev
         if guard is not None:
@@ -663,6 +725,15 @@ def render(artifact: dict) -> str:
             f"{fl.get('shed')} shed "
             f"(rate {fl.get('shed_rate')}), queue after "
             f"{fl.get('queue_after')}")
+    sb = artifact.get("sanitizer")
+    if sb:
+        lines.append(
+            f"  sanitizer: armed (level {sb.get('level')}), "
+            f"{sb.get('locks_instrumented')} locks instrumented, "
+            f"{len(sb.get('violations') or ())} MXL70x violation(s)")
+        for v in sb.get("violations", ()):
+            lines.append(f"    {v.get('rule')} x{v.get('count')}: "
+                         f"{v.get('message')}")
     for name, st in (artifact.get("invariants") or {}).items():
         mark = "OK " if st.get("ok") else "FAIL"
         lines.append(f"  [{mark}] {name}")

@@ -294,6 +294,36 @@ def _load_manifest_json(path: str, kind: str,
     return manifest
 
 
+def _commit_shard_dir(tmp: str, final: str, kind: str, write_shards,
+                      extra: Optional[dict] = None) -> List[dict]:
+    """THE shard-dir commit sequence, shared by
+    ``CheckpointManager._write`` and :func:`write_arrays` (one
+    definition of the inventory-and-commit protocol): create
+    ``tmp/shards``, let ``write_shards(tmp, rows)`` append the hashed
+    shard rows, land the manifest (format/kind/created + ``extra`` +
+    the rows) LAST within the dir, then publish atomically.  Returns
+    the shard rows."""
+    os.makedirs(os.path.join(tmp, "shards"))
+    rows: List[dict] = []
+    write_shards(tmp, rows)
+    manifest = {"format": FORMAT, "kind": kind,
+                "created": time.time(), **(extra or {}),
+                "shards": rows}
+    _write_manifest(tmp, manifest)
+    _atomic_publish(tmp, final)    # THE commit point
+    return rows
+
+
+def _read_shard_dir(path: str, kind: str, verify: bool = True,
+                    missing_msg: Optional[str] = None):
+    """The read half of the shard-dir protocol (shared by
+    ``_load_checkpoint`` and :func:`read_arrays`):
+    ``(manifest, [(record, host array)])`` with every integrity
+    failure raised as ``MXNetError``."""
+    manifest = _load_manifest_json(path, kind, missing_msg=missing_msg)
+    return manifest, _read_shard_payloads(path, manifest, verify)
+
+
 def _read_shard_payloads(path: str, manifest: dict,
                          verify: bool) -> List[tuple]:
     """``[(record, host_array)]`` for every manifest shard, with
@@ -637,39 +667,35 @@ class CheckpointManager:
                     f"{final} (pass force=True to overwrite)")
         tmp = os.path.join(self.directory,
                            f".tmp-step-{step:08d}-{os.getpid()}")
-        shards_dir = os.path.join(tmp, "shards")
         if os.path.exists(tmp):
             shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(shards_dir)
-
-        shards = _payload_shards(tmp, payload)
-
-        manifest = {
-            "format": FORMAT, "kind": "mxtpu_elastic_checkpoint",
-            "step": step, "created": time.time(),
-            "trainer": payload.get("kind"),
-            "optimizer": payload.get("optimizer"),
-            "update_counts": {str(k): int(v) for k, v in
-                              (payload.get("update_counts") or {}).items()},
-            "num_update": int(payload.get("num_update", step)),
-            "mesh": payload.get("mesh"),
-            "dp_axis": payload.get("dp_axis"),
-            "persist_name": payload.get("persist_name"),
-            # the ZeRO layout pin (docs/zero.md): restore converts the
-            # sharded state rows to the target trainer's layout
-            "zero": payload.get("zero"),
-            # the sharding-plan pin (docs/parallelism.md): the
-            # canonical plan this checkpoint was saved under — the
-            # audit trail a cross-plan restore's reshard report reads
-            "plan": payload.get("plan"),
-            # the exact-resume data cursor (set_cursor): where the
-            # batch stream stood at this commit
-            "cursor": payload.get("cursor"),
-            "rng": payload["rng"],
-            "shards": shards,
-        }
-        _write_manifest(tmp, manifest)
-        _atomic_publish(tmp, final)    # THE commit point
+        shards = _commit_shard_dir(
+            tmp, final, "mxtpu_elastic_checkpoint",
+            lambda t, rows: rows.extend(_payload_shards(t, payload)),
+            extra={
+                "step": step,
+                "trainer": payload.get("kind"),
+                "optimizer": payload.get("optimizer"),
+                "update_counts": {
+                    str(k): int(v) for k, v in
+                    (payload.get("update_counts") or {}).items()},
+                "num_update": int(payload.get("num_update", step)),
+                "mesh": payload.get("mesh"),
+                "dp_axis": payload.get("dp_axis"),
+                "persist_name": payload.get("persist_name"),
+                # the ZeRO layout pin (docs/zero.md): restore converts
+                # the sharded state rows to the target trainer's layout
+                "zero": payload.get("zero"),
+                # the sharding-plan pin (docs/parallelism.md): the
+                # canonical plan this checkpoint was saved under — the
+                # audit trail a cross-plan restore's reshard report
+                # reads
+                "plan": payload.get("plan"),
+                # the exact-resume data cursor (set_cursor): where the
+                # batch stream stood at this commit
+                "cursor": payload.get("cursor"),
+                "rng": payload["rng"],
+            })
         self.prune()
         dt = time.perf_counter() - t0
         telemetry.counter("mxtpu_checkpoints_saved_total",
@@ -853,15 +879,12 @@ def write_arrays(path: str, arrays: Dict[str, np.ndarray],
             except OSError:
                 continue
         shutil.rmtree(stale, ignore_errors=True)
-    os.makedirs(os.path.join(tmp, "shards"))
-    shards: List[dict] = []
-    for name, value in arrays.items():
-        _write_shard(tmp, shards, name, value)
-    manifest = {"format": FORMAT, "kind": kind,
-                "created": time.time(), "shards": shards,
-                **(extra or {})}
-    _write_manifest(tmp, manifest)
-    _atomic_publish(tmp, path)
+
+    def _fill(t, rows):
+        for name, value in arrays.items():
+            _write_shard(t, rows, name, value)
+
+    _commit_shard_dir(tmp, path, kind, _fill, extra=extra)
     return path
 
 
@@ -885,12 +908,11 @@ def read_arrays(path: str, kind: str = "mxtpu_array_dict",
                 pass
     if not os.path.isdir(path):
         raise MXNetError(f"no checkpoint at {path}")
-    manifest = _load_manifest_json(
-        path, kind,
+    manifest, payloads = _read_shard_dir(
+        path, kind, verify,
         missing_msg=f"{path} holds no manifest.json — not a committed "
                     "checkpoint (or a pre-elastic artifact)")
-    return manifest, {rec["name"]: host for rec, host in
-                      _read_shard_payloads(path, manifest, verify)}
+    return manifest, {rec["name"]: host for rec, host in payloads}
 
 
 def align_params(param_names: List[str], payload_params) -> List[tuple]:
@@ -918,13 +940,12 @@ def _load_checkpoint(path: str, verify: bool = True):
     """(manifest, [host arrays aligned with manifest["shards"]]).
     Raises ``MXNetError`` for anything short of a complete, committed,
     hash-clean checkpoint."""
-    manifest = _load_manifest_json(
-        path, "mxtpu_elastic_checkpoint",
+    manifest, payloads = _read_shard_dir(
+        path, "mxtpu_elastic_checkpoint", verify,
         missing_msg=f"{path} is not a committed checkpoint (no "
                     "manifest.json — a crashed write leaves only "
                     ".tmp-step-* dirs)")
-    return manifest, [host for _rec, host in
-                      _read_shard_payloads(path, manifest, verify)]
+    return manifest, [host for _rec, host in payloads]
 
 
 # -- directory-level tooling (tools/mxckpt.py, mxlint MXL502) ---------------

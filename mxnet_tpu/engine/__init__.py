@@ -66,6 +66,13 @@ _fresh_compiles = 0
 # `_switch.enabled` attribute load per dispatch, and ALL structured
 # work (key recompute, aval signatures, event dicts) behind it.
 _telem = None
+# mxsan hook (analysis.sanitizer, docs/static_analysis.md "The
+# sanitizer"): the sanitizer module itself when MXTPU_SANITIZE >= 1,
+# None otherwise — the off cost is ONE attribute load per dispatch
+# (the bench `sanitizer` block's contract).  Set via
+# sanitizer.configure(), never imported here (the analysis package
+# imports the engine; a top-level import back would cycle).
+_san = None
 # op name -> attr signatures that have compiled (retrace-cause
 # attribution diffs a new signature against the closest prior one)
 _op_attr_sigs: Dict[str, list] = {}
@@ -534,6 +541,14 @@ def retrying_call(call, probe_arrays, op: str):
     Shared by ``invoke_compiled`` and the SPMD trainer's fused
     dispatch."""
     import time as _time
+    san = _san
+    if san is not None:
+        # the lifetime sanitizer's dispatch-entry check (MXL701
+        # use-after-donate over the probe set, MXL706 lock held across
+        # a blocking dispatch) — this seam sees BOTH the engine path
+        # (probe = every input) and the SPMD trainer's direct fused
+        # dispatches (probe = the pre-filtered donated set)
+        san.pre_dispatch(op, probe_arrays)
     attempt = 0
     sleep_ms = 0.0
     retries = backoff_ms = None
@@ -602,6 +617,12 @@ def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
             return hook(name, fn, arrays)
         return fn(*arrays)
 
+    san = _san
+    if san is not None and donate:
+        # MXL702 (same buffer at two donate indices) before the
+        # dispatch can alias outputs onto it; the MXL701/706 checks
+        # run inside retrying_call
+        san.check_donation(name, arrays, donate)
     try:
         out = retrying_call(_run, arrays, name)
         if is_naive():
@@ -615,6 +636,10 @@ def invoke_compiled(name: str, fcompute: Callable, attrs: dict, *arrays,
             t.record_event("error", op=name, error=repr(e)[:500])
             t.auto_dump(reason=f"invoke_compiled:{name}")
         raise
+    if san is not None and donate:
+        # the donated inputs are now dead: shadow-mark them with
+        # op attribution so a later use convicts by name (MXL701)
+        san.post_dispatch(name, arrays, donate)
     if isinstance(out, tuple):
         for o in out:
             track(o)

@@ -315,6 +315,16 @@ _reg("MXTPU_RESIZE_MIN_SLOTS", int, 1,
 _reg("MXTPU_RESIZE_MAX_SLOTS", int, 64,
      "Upper bound on the autoscaled per-bucket slot count (each slot "
      "holds cache_len KV positions of HBM in every bucket).")
+_reg("MXTPU_SANITIZE", int, 0,
+     "mxsan, the donation-lifetime & lock-order sanitizer "
+     "(analysis.sanitizer; docs/static_analysis.md 'The sanitizer'). "
+     "0 (default) off — every instrumented seam pays one attribute "
+     "load; 1 collects MXL70x findings (use-after-donate, double "
+     "donation, poisoned-step, live-bytes leak, lock-order cycle, "
+     "lock-across-dispatch) as retained sanitizer_violation events + "
+     "mxlint findings; 2 additionally RAISES on a lifetime violation "
+     "(MXL701/702) before the bad dispatch runs. Read at import; "
+     "tests/tools re-arm via sanitizer.configure(level).")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

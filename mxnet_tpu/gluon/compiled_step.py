@@ -458,6 +458,13 @@ class CompiledStep:
                           repeat=False):
         from .. import envs
         if self._poisoned is not None:
+            from .. import engine as _eng
+            if _eng._san is not None:
+                # mxsan MXL703: a poisoned owner stepped without
+                # recover() — the finding is the audit trail; the
+                # raise below is unchanged
+                _eng._san.note_poisoned_step(self, "compiled_step",
+                                             self._poisoned)
             raise MXNetError(
                 "this CompiledStep's weight/optimizer-state buffers were "
                 "donated to a dispatch that failed and are no longer "

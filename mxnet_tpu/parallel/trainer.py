@@ -2415,6 +2415,11 @@ class DataParallelTrainer:
                 else:
                     self._build_full_step()
             if self._donation_poisoned is not None:
+                from .. import engine as _eng
+                if _eng._san is not None:
+                    _eng._san.note_poisoned_step(
+                        self, "spmd_step_multi",
+                        self._donation_poisoned)
                 raise MXNetError(
                     "this trainer's optimizer state was donated to a "
                     "fused step that failed and is no longer valid; "
@@ -2520,6 +2525,11 @@ class DataParallelTrainer:
             try:
                 out = engine.retrying_call(_go, probe,
                                            "spmd_step_multi")
+                if engine._san is not None:
+                    # mxsan: params AND state were donated to the
+                    # bulked program — shadow-mark the whole probe set
+                    engine._san.post_dispatch(
+                        "spmd_step_multi", probe, owner=self)
                 if hs is not None:
                     loss_k, new_all_params, new_states, health_out = \
                         out
@@ -2846,6 +2856,11 @@ class DataParallelTrainer:
                     else:
                         self._build_full_step()
                 if self._donation_poisoned is not None:
+                    from .. import engine as _eng
+                    if _eng._san is not None:
+                        _eng._san.note_poisoned_step(
+                            self, "spmd_step",
+                            self._donation_poisoned)
                     raise MXNetError(
                         "this trainer's optimizer state was donated to "
                         "a fused step that failed and is no longer "
@@ -2903,6 +2918,12 @@ class DataParallelTrainer:
                 try:
                     out = engine.retrying_call(
                         _go, donated_flat, "spmd_full_step")
+                    if engine._san is not None:
+                        # mxsan: the donated state set is dead now —
+                        # shadow-mark it so a stale reference convicts
+                        # with attribution (MXL701)
+                        engine._san.post_dispatch(
+                            "spmd_full_step", donated_flat, owner=self)
                     if hs is not None:
                         health_out, out = out[-1], out[:-1]
                     if compressed:
@@ -2969,10 +2990,24 @@ class DataParallelTrainer:
                 scalar_vals.extend(
                     np.asarray(s, dtype=np.float32)
                     for s in self._rule.scalars(opt, i, t))
+            from .. import engine as _eng
+            _san_hook = _eng._san
+            tparam_vals = tuple(
+                self._params[i].data()._data for i in self._tr_idx)
+            tstate_vals = self._state_vals()
             new_params, new_states = self._fused_update(
-                tuple(self._params[i].data()._data for i in self._tr_idx),
-                self._state_vals(),
-                grads, tuple(scalar_vals))
+                tparam_vals, tstate_vals, grads, tuple(scalar_vals))
+            if _san_hook is not None:
+                # mxsan: donate_argnums=(0, 1) consumed the params and
+                # optimizer state — shadow-mark them so a stale
+                # reference convicts with attribution (MXL701); this
+                # jit call bypasses the engine seams by design (off
+                # cost: the one attribute load above)
+                _san_hook.post_dispatch(
+                    "spmd_fused_update",
+                    tparam_vals + tuple(
+                        v for vals in tstate_vals for v in vals),
+                    owner=self)
             for i, v in zip(self._tr_idx, new_params):
                 self._params[i].data()._set_data(v)
             self._write_states(new_states)

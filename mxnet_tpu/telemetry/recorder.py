@@ -50,6 +50,10 @@ _RARE_KINDS = frozenset(("retrace", "fallback", "poison", "error",
                          "corruption_suspected", "corruption_resolved",
                          "device_quarantined", "scrub_corrupt",
                          "integrity_inapplicable",
+                         # mxsan (MXL7xx): a use-after-donate or
+                         # lock-order finding is forensics a dispatch
+                         # flood must not evict
+                         "sanitizer_violation",
                          "shed", "deadline_evicted",
                          # recovery answers hang_suspected/poison in the
                          # MXL504 audit and the chaos-soak step

@@ -1,0 +1,627 @@
+"""mxsan — the donation-lifetime & lock-order sanitizer (MXL7xx;
+docs/static_analysis.md, "The sanitizer").
+
+Tier-1 coverage for ISSUE 15: the seeded-defect corpus for every
+MXL701-708 rule (violation caught red->green, clean twin quiet), the
+shadow lifetime machine's attribution, the lock-order graph +
+hold-time histograms, level semantics (0 = one attribute load,
+1 = collect, 2 = raise), the ``self_check()`` ride-along, retained-
+event flood survival, ``tools/mxsan.py`` / ``tools/mxlint.py --json``,
+the chaos soak's sanitizer-armed certification, the ``engine._live``
+regression guard, and the docs rule-index drift test.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, telemetry
+from mxnet_tpu.analysis import analyze_sanitizer, analyze_source
+from mxnet_tpu.analysis import sanitizer as san
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon.compiled_step import CompiledStep
+from mxnet_tpu.gluon.loss import L2Loss
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    """Every test leaves the sanitizer OFF and empty: its findings
+    feed the process-global ``self_check()`` gate, and MXL705 is
+    error severity — a leaked record would fail a later module's
+    ``--self-check``.  The auto-dump throttle budget is restored too
+    (test_guardian.py precedent) — this module's seeded violations
+    and poison drills must not starve a later module's real crash
+    forensics."""
+    from mxnet_tpu.telemetry import recorder as _recorder
+    dumps_prev = _recorder._auto_dumps_left
+    san.reset()
+    yield
+    san.configure(0)
+    san.reset()
+    telemetry.clear_events()
+    with _recorder._lock:
+        _recorder._auto_dumps_left = dumps_prev
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _compiled(seed=3, prefix=None):
+    np.random.seed(seed)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.01}, kvstore=None)
+    return net, CompiledStep(net, L2Loss(), tr)
+
+
+def _batch(n=8):
+    r = np.random.RandomState(0)
+    return (nd.array(r.rand(n, 8).astype("f4")),
+            nd.array(r.rand(n, 4).astype("f4")))
+
+
+def _rules():
+    return {r["rule"] for r in san.records()}
+
+
+# ---------------------------------------------------------------------------
+# switch semantics
+# ---------------------------------------------------------------------------
+
+
+def test_level_semantics_and_off_cost():
+    # off: the engine seam is ONE attribute load (the hook is None)
+    assert san.configure(0) == 0
+    assert engine._san is None
+    assert san.instrumented_locks() == []
+    # armed: hook installed + every site wrapped; disarm restores
+    assert san.configure(1) == 1
+    assert engine._san is san
+    assert len(san.instrumented_locks()) == len(san.LOCK_SITES)
+    from mxnet_tpu.telemetry import recorder
+    assert isinstance(recorder._lock, san.SanLock)
+    san.configure(0)
+    assert engine._san is None
+    assert not isinstance(recorder._lock, san.SanLock)
+    # env-driven configure + clamping
+    os.environ["MXTPU_SANITIZE"] = "2"
+    try:
+        assert san.configure() == 2
+    finally:
+        os.environ.pop("MXTPU_SANITIZE")
+        san.configure(0)
+
+
+def test_armed_clean_workload_is_quiet():
+    """A healthy compiled-step loop under the armed sanitizer records
+    NOTHING (the fresh-repo-quiet half of the corpus) and the hold
+    stats populate."""
+    san.configure(1)
+    net, cs = _compiled(prefix="sanclean_")
+    x, y = _batch()
+    for _ in range(4):
+        cs.step(x, y, 8)
+    mx.nd.waitall()
+    assert san.records() == []
+    assert analyze_sanitizer() == []
+    rep = san.report()
+    assert rep["armed"] and rep["counts"] == {}
+    assert rep["locks"]["holds"]          # lock traffic was observed
+    assert rep["lifetime"]["donated_tracked"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the seeded-defect corpus: MXL701-706 (runtime legs)
+# ---------------------------------------------------------------------------
+
+
+def test_mxl701_use_after_donate_caught_with_attribution():
+    jnp = _jnp()
+    san.configure(1)
+    a = jnp.ones((32,), jnp.float32)
+    engine.invoke_compiled("san701", lambda v: v + 1, {}, a,
+                           donate=(0,))
+    with pytest.raises(Exception):      # jax's own deleted-buffer err
+        engine.invoke_compiled("san701b", lambda v: v * 2, {}, a)
+    recs = [r for r in san.records() if r["rule"] == "MXL701"]
+    assert len(recs) == 1
+    assert recs[0]["donor_op"] == "san701"      # the consuming op
+    assert recs[0]["op"] == "san701b"           # the offending use
+    evs = telemetry.events("sanitizer_violation")
+    assert [e["rule"] for e in evs] == ["MXL701"]
+    # clean twin: rebinding to the OUTPUT is the contract, no finding
+    san.reset()
+    b = jnp.ones((32,), jnp.float32)
+    b = engine.invoke_compiled("san701c", lambda v: v + 1, {}, b,
+                               donate=(0,))
+    engine.invoke_compiled("san701d", lambda v: v * 2, {}, b)
+    assert _rules() == set()
+
+
+def test_mxl702_double_donation_caught():
+    jnp = _jnp()
+    san.configure(1)
+    a = jnp.ones((16,), jnp.float32)
+    with pytest.raises(Exception):      # XLA also rejects the alias
+        engine.invoke_compiled("san702", lambda u, v: (u + 1, v + 2),
+                               {}, a, a, donate=(0, 1))
+    assert "MXL702" in _rules()
+    # distinct buffers at the same indices: quiet
+    san.reset()
+    b = jnp.ones((16,), jnp.float32)
+    c = jnp.ones((16,), jnp.float32)
+    engine.invoke_compiled("san702ok", lambda u, v: (u + 1, v + 2),
+                           {}, b, c, donate=(0, 1))
+    assert _rules() == set()
+
+
+def test_mxl703_poisoned_step_noted_and_recover_clears():
+    san.configure(1)
+    net, cs = _compiled(prefix="san703_")
+    x, y = _batch()
+    cs.step(x, y, 8)
+    cs._poisoned = "seeded drill"
+    with pytest.raises(MXNetError, match="recover"):
+        cs.step(x, y, 8)
+    recs = [r for r in san.records() if r["rule"] == "MXL703"]
+    assert len(recs) == 1 and recs[0]["op"] == "compiled_step"
+    # healthy stepping records nothing more
+    cs._poisoned = None
+    san.reset()
+    cs.step(x, y, 8)
+    mx.nd.waitall()
+    assert "MXL703" not in _rules()
+
+
+def test_mxl704_leak_check_red_green():
+    jnp = _jnp()
+    san.configure(1)
+    # green: baseline at the current census, no growth
+    san.mark_baseline()
+    assert san.leak_check() is None
+    # red: a zero baseline makes any tracked buffer a "leak"
+    san.mark_baseline(0)
+    keep = jnp.ones((1 << 20,), jnp.float32)      # 4 MiB pinned
+    engine.track(keep)
+    leak = san.leak_check(slack_bytes=1024)
+    assert leak is not None and leak["live_bytes"] >= (1 << 22)
+    assert "MXL704" in _rules()
+    assert keep is not None                        # keep it live
+
+
+def test_mxl705_lock_order_cycle_caught_and_error_severity():
+    san.configure(1)
+    l1 = san.SanLock(threading.Lock(), "t705.A")
+    l2 = san.SanLock(threading.Lock(), "t705.B")
+    # consistent order on two threads: quiet
+    with l1:
+        with l2:
+            pass
+    def fwd():
+        with l1:
+            with l2:
+                pass
+
+    t = threading.Thread(target=fwd)
+    t.start()
+    t.join()
+    assert "MXL705" not in _rules()
+
+    # inconsistent order: the cycle is named
+    def rev():
+        with l2:
+            with l1:
+                pass
+    t = threading.Thread(target=rev)
+    t.start()
+    t.join()
+    recs = [r for r in san.records() if r["rule"] == "MXL705"]
+    assert len(recs) == 1
+    assert set(recs[0]["cycle"]) == {"t705.A", "t705.B"}
+    finds = analyze_sanitizer()
+    assert [f.severity for f in finds if f.rule == "MXL705"] == \
+        ["error"]
+    # ... so a sanitizer-armed run with a cycle FAILS the gate
+    from mxnet_tpu.analysis import self_check
+    findings, ok = self_check()
+    assert any(f.rule == "MXL705" for f in findings) and not ok
+    assert san.lock_graph()["cycles"]
+
+
+def test_mxl706_lock_across_dispatch_caught():
+    jnp = _jnp()
+    san.configure(1)
+    lk = san.SanLock(threading.Lock(), "t706.L")
+    with lk:
+        engine.invoke_compiled("san706", lambda v: v + 1, {},
+                               jnp.ones((8,), jnp.float32))
+    recs = [r for r in san.records() if r["rule"] == "MXL706"]
+    assert len(recs) == 1 and "t706.L" in recs[0]["locks"]
+    # same dispatch outside the lock: quiet
+    san.reset()
+    engine.invoke_compiled("san706b", lambda v: v + 1, {},
+                           jnp.ones((8,), jnp.float32))
+    assert _rules() == set()
+
+
+def test_level2_raises_before_the_bad_dispatch():
+    jnp = _jnp()
+    san.configure(2)
+    a = jnp.ones((8,), jnp.float32)
+    engine.invoke_compiled("san2a", lambda v: v + 1, {}, a,
+                           donate=(0,))
+    with pytest.raises(MXNetError, match="MXL701"):
+        engine.invoke_compiled("san2b", lambda v: v * 2, {}, a)
+    b = jnp.ones((8,), jnp.float32)
+    with pytest.raises(MXNetError, match="MXL702"):
+        engine.invoke_compiled("san2c", lambda u, v: (u, v), {},
+                               b, b, donate=(0, 1))
+
+
+@pytest.mark.parametrize("fuse,donor_op", [
+    (False, "spmd_fused_update"),   # default path: raw donating jit
+    (True, "spmd_full_step"),       # fused path: the retrying_call seam
+])
+def test_spmd_trainer_donation_seam_tracked(fuse, donor_op):
+    """Both SPMD dispatch paths mark their donated optimizer state:
+    a stale reference to a pre-step state buffer convicts with the
+    trainer attributed (momentum so state rows actually EXIST — plain
+    sgd has none and would skip the conviction)."""
+    from mxnet_tpu import parallel
+    san.configure(1)
+    net = nn.HybridSequential(prefix=f"sanspmd{int(fuse)}_")
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=4),
+                nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    tr = parallel.DataParallelTrainer(
+        net, L2Loss(), "sgd",
+        {"learning_rate": 0.01, "momentum": 0.9}, fuse_step=fuse)
+    r = np.random.RandomState(1)
+    x = nd.array(r.rand(8, 4).astype("f4"))
+    y = nd.array(r.rand(8, 2).astype("f4"))
+    tr.step(x, y)
+    stale = [v for vals in tr._state_vals() for v in vals]
+    assert stale                       # momentum: state rows exist
+    tr.step(x, y)
+    mx.nd.waitall()
+    assert san.records() == []         # healthy loop: quiet
+    with pytest.raises(Exception):
+        engine.invoke_compiled("sanspmdreuse", lambda v: v + 1,
+                               {}, stale[0])
+    recs = [r_ for r_ in san.records() if r_["rule"] == "MXL701"]
+    assert recs and recs[0]["donor_op"] == donor_op
+    assert recs[0]["donor_owner"] == "DataParallelTrainer"
+
+
+# ---------------------------------------------------------------------------
+# MXL707/708 — the static legs
+# ---------------------------------------------------------------------------
+
+
+def test_mxl707_corpus():
+    bad = (
+        "import jax\n"
+        "step = jax.jit(train_step)\n"
+        "for i in range(100):\n"
+        "    params, opt = step(params, opt, batch)\n")
+    good = bad.replace("jax.jit(train_step)",
+                       "jax.jit(train_step, donate_argnums=(0, 1))")
+    assert [f.rule for f in analyze_source(bad)] == ["MXL707"]
+    assert analyze_source(good) == []
+    # @partial(jax.jit) decorated def, no donation: caught
+    deco = (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit)\n"
+        "def step(params, b):\n"
+        "    return params\n"
+        "while True:\n"
+        "    params = step(params, b)\n")
+    assert "MXL707" in {f.rule for f in analyze_source(deco)}
+    assert analyze_source(deco.replace(
+        "@partial(jax.jit)",
+        "@partial(jax.jit, donate_argnums=(0,))")) == []
+    # not rebinding its own argument: quiet
+    pure = (
+        "import jax\n"
+        "f = jax.jit(fn)\n"
+        "for i in range(100):\n"
+        "    z = f(x, y)\n")
+    assert analyze_source(pure) == []
+    # suppression comment works
+    sup = bad.replace(
+        "    params, opt = step(params, opt, batch)",
+        "    params, opt = step(params, opt, batch)"
+        "  # mxlint: disable=MXL707")
+    assert analyze_source(sup) == []
+
+
+def test_mxl708_corpus():
+    bad = (
+        "for i in range(200):\n"
+        "    out = trainer.step(x, y)\n"
+        "    v = float(out)\n"
+        "    a = np.asarray(out)\n"
+        "    w = out.item()\n")
+    rules = [f.rule for f in analyze_source(bad)]
+    assert rules.count("MXL708") == 3
+    # sync AFTER the loop: quiet
+    good = (
+        "for i in range(200):\n"
+        "    out = trainer.step(x, y)\n"
+        "host = np.asarray(out)\n")
+    assert "MXL708" not in {f.rule for f in analyze_source(good)}
+    # a loss-named receiver stays MXL311 (the health-plane pointer)
+    lossy = (
+        "for i in range(200):\n"
+        "    loss = trainer.step(x, y)\n"
+        "    v = float(loss)\n")
+    got = {f.rule for f in analyze_source(lossy)}
+    assert "MXL311" in got and "MXL708" not in got
+    # gym env.step() receivers are exempt
+    gym = (
+        "for i in range(200):\n"
+        "    obs = env.step(action)\n"
+        "    v = np.asarray(obs)\n")
+    assert "MXL708" not in {f.rule for f in analyze_source(gym)}
+
+
+def test_static_rules_quiet_on_repo_examples():
+    """The fresh-repo half of the MXL707/708 corpus: the shipped
+    example scripts produce neither rule."""
+    from mxnet_tpu.analysis import analyze_paths
+    found = {f.rule for f in analyze_paths(
+        [os.path.join(_REPO, "example")])}
+    assert "MXL707" not in found and "MXL708" not in found
+
+
+# ---------------------------------------------------------------------------
+# reporting plane
+# ---------------------------------------------------------------------------
+
+
+def test_report_shapes_and_hold_histograms():
+    san.configure(1)
+    net, cs = _compiled(prefix="sanrep_")
+    x, y = _batch()
+    for _ in range(3):
+        cs.step(x, y, 8)
+    mx.nd.waitall()
+    rep = san.report()
+    assert rep["level"] == 1
+    holds = rep["locks"]["holds"]
+    assert "engine._lock" in holds
+    st = holds["engine._lock"]
+    assert st["n"] > 0 and st["max_s"] >= 0
+    assert sum(st["buckets"]) == st["n"]
+    assert len(st["buckets"]) == len(st["bucket_bounds_s"]) + 1
+    assert rep["locks"]["instrumented"]
+
+
+def test_deferred_emission_flushes():
+    """A violation detected while the thread holds an instrumented
+    lock defers its retained event (emitting through telemetry would
+    re-acquire the very lock that fired it) and flushes at the next
+    lock-free seam."""
+    jnp = _jnp()
+    san.configure(1)
+    lk = san.SanLock(threading.Lock(), "tflush.L")
+    with lk:
+        engine.invoke_compiled("sanflush", lambda v: v + 1, {},
+                               jnp.ones((4,), jnp.float32))
+        # inside the lock: recorded, not yet emitted
+        assert "MXL706" in _rules()
+    assert not [r for r in san.records() if not r["emitted"]] or \
+        telemetry.events("sanitizer_violation") == []
+    # the NEXT lock-free dispatch IS the flush seam — no explicit
+    # report()/analyze call needed for the retained event to land
+    engine.invoke_compiled("sanflush2", lambda v: v + 1, {},
+                           jnp.ones((4,), jnp.float32))
+    evs = telemetry.events("sanitizer_violation")
+    assert [e["rule"] for e in evs] == ["MXL706"]
+    san._flush_pending()               # idempotent: no double emit
+    assert len(telemetry.events("sanitizer_violation")) == 1
+
+
+def test_sanitizer_events_survive_dispatch_flood():
+    """Retained-ring contract (PR 12 style): 1200 dispatch events must
+    not evict a sanitizer_violation."""
+    san.configure(1)
+    san._violation("MXL704", "san:flood-test",
+                   "seeded retained event")
+    for i in range(1200):
+        telemetry.record_event("dispatch", op=f"flood{i % 7}")
+    evs = telemetry.events("sanitizer_violation")
+    assert len(evs) == 1 and evs[0]["rule"] == "MXL704"
+
+
+def test_self_check_rides_and_fresh_quiet():
+    from mxnet_tpu.analysis import self_check
+    findings, ok = self_check()
+    assert not any(f.rule.startswith("MXL70") for f in findings)
+    san.configure(1)
+    san._violation("MXL703", "san:ride-test", "seeded warning")
+    findings, ok = self_check()
+    assert any(f.rule == "MXL703" for f in findings)
+    assert ok                     # warning severity: no gate trip
+
+
+# ---------------------------------------------------------------------------
+# tools: mxsan CLI + mxlint --json
+# ---------------------------------------------------------------------------
+
+
+def test_mxsan_cli_drill_report_audit(capsys):
+    from tools import mxsan
+    assert mxsan.main(["drill", "--rule", "all"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("MXL701", "MXL702", "MXL703", "MXL704", "MXL705",
+                 "MXL706"):
+        assert f"[CAUGHT] {rule}" in out
+    # the drills leave no live findings behind
+    assert san.records() == []
+    assert mxsan.main(["audit"]) == 0
+    capsys.readouterr()
+    assert mxsan.main(["report", "--json", "--no-workload"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert {"level", "locks", "lifetime", "findings"} <= set(rep)
+    # audit exits 1 on a finding
+    san.configure(1)
+    san._violation("MXL706", "san:cli-test", "seeded")
+    assert mxsan.main(["audit"]) == 1
+
+
+def test_mxlint_json_schema_and_exit_contract(tmp_path, capsys):
+    from tools import mxlint
+    src = tmp_path / "loop.py"
+    src.write_text(
+        "import jax\n"
+        "step = jax.jit(fn)\n"
+        "for i in range(100):\n"
+        "    params = step(params)\n")
+    rc = mxlint.main([str(src), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0                      # warnings never flip the exit
+    assert payload["schema"] == 1
+    rows = payload["findings"]
+    assert any(r["rule"] == "MXL707" for r in rows)
+    for r in rows:
+        assert {"rule", "severity", "path", "line",
+                "message"} <= set(r)
+    r707 = next(r for r in rows if r["rule"] == "MXL707")
+    assert r707["path"] == str(src) and r707["line"] == 4
+    # exit contract unchanged: --fail-on warning now fails
+    assert mxlint.main([str(src), "--json",
+                        "--fail-on", "warning"]) == 1
+    capsys.readouterr()
+    # a sanitizer anchor ending in ":<digits>" is NOT a file anchor:
+    # path stays the full location, line stays null
+    san.configure(1)
+    san._violation("MXL706", "san:lock-across-dispatch:t.L:0",
+                   "seeded for the json schema test")
+    mxlint.main(["--self-check", "--json"])
+    rows = json.loads(capsys.readouterr().out)["findings"]
+    r706 = next(r for r in rows if r["rule"] == "MXL706")
+    assert r706["line"] is None
+    assert r706["path"] == "san:lock-across-dispatch:t.L:0"
+
+
+# ---------------------------------------------------------------------------
+# engine._live regression guard (the PR-2-era silent-empty bug)
+# ---------------------------------------------------------------------------
+
+
+def test_live_tracking_not_silently_empty_and_waitall_blocks():
+    """A fused step must leave >= 1 tracked live array (PR 6 fixed
+    ``_live`` being silently empty, which made ``waitall()`` a no-op)
+    and ``waitall()`` must actually block on it until ready."""
+    import jax
+    net, cs = _compiled(prefix="sanlive_")
+    x, y = _batch()
+    loss = cs.step(x, y, 8)
+    live = [a for a in engine.live_arrays()
+            if not getattr(a, "is_deleted", lambda: False)()]
+    assert len(live) >= 1                  # tracking is NOT empty
+    assert engine.live_bytes() > 0
+    # the step's own loss output is among the tracked buffers
+    assert any(a is loss._data for a in live)
+    mx.nd.waitall()
+    for a in live:
+        if getattr(a, "is_deleted", lambda: False)():
+            continue
+        # jax exposes readiness; after waitall every survivor is ready
+        assert jax.block_until_ready(a) is a
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: sanitizer-armed certification
+# ---------------------------------------------------------------------------
+
+
+def test_soak_sanitizer_violation_fails_certification():
+    """A soak whose run records an MXL70x does NOT certify, even with
+    every recovery invariant green — seeded through the progress
+    callback (which runs inside the soak window).  The violation is
+    ALSO pre-seeded before the soak with the same (rule, key), so the
+    in-soak repeat only bumps a deduped record's count: certification
+    must diff per-key counts, not the record-list length."""
+    from mxnet_tpu.elastic import chaos
+
+    san.configure(1)
+    san._violation("MXL701", "san:soak-seeded",
+                   "pre-soak twin: the in-soak repeat dedups into "
+                   "this record")
+    san.mark_baseline(12345)           # caller baseline must survive
+
+    fired = []
+
+    def seed_violation(line):
+        if line.startswith("warmed") and not fired:
+            fired.append(1)
+            san._violation("MXL701", "san:soak-seeded",
+                           "seeded use-after-donate for the "
+                           "certification test")
+
+    art = chaos.soak(steps=20, seed=7, progress=seed_violation,
+                     sanitize=True)
+    try:
+        assert art["sanitizer"]["armed"]
+        assert any(v["rule"] == "MXL701"
+                   for v in art["sanitizer"]["violations"])
+        assert not art["invariants"]["sanitizer_clean"]["ok"]
+        assert not art["ok"]
+        # the soak anchored MXL704 at its own warmed census and must
+        # put the caller's baseline back
+        assert san.baseline() == 12345
+    finally:
+        chaos._reset()
+    # sanitize=False: no sanitizer leg in the artifact
+    art2 = chaos.soak(steps=20, seed=7, sanitize=False)
+    try:
+        assert art2["sanitizer"] is None
+        assert "sanitizer_clean" not in art2["invariants"]
+    finally:
+        chaos._reset()
+        from mxnet_tpu.elastic import faults, guardian
+        from mxnet_tpu.elastic import manager as emgr
+        faults.clear()
+        guardian._reset()
+        emgr._reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# docs drift: every registered rule has a docs row
+# ---------------------------------------------------------------------------
+
+
+def test_docs_rule_index_covers_every_registered_rule():
+    """The docs/static_analysis.md rule index is generated from
+    ``findings.RULES``; this is the drift gate — the first rule that
+    lands without a docs row fails here."""
+    import re
+    from mxnet_tpu.analysis.findings import RULES, rules_markdown
+    doc = open(os.path.join(_REPO, "docs",
+                            "static_analysis.md")).read()
+    documented = set(re.findall(r"^\|\s*(MXL\d+)\s*\|", doc, re.M))
+    missing = sorted(set(RULES) - documented)
+    assert not missing, (
+        f"rules {missing} are registered in findings.RULES but have "
+        "no row in docs/static_analysis.md — regenerate the rule "
+        "index (findings.rules_markdown())")
+    # the generated block matches the registry exactly
+    begin = doc.index("rule-index:begin")
+    end = doc.index("<!-- rule-index:end -->")
+    assert rules_markdown() in doc[begin:end]

@@ -29,6 +29,7 @@ Usage:
     python tools/mxlint.py --registry                    # op registry
     python tools/mxlint.py --models                      # model corpus
     python tools/mxlint.py --self-check                  # CI gate
+    python tools/mxlint.py example/ --json               # CI annotations
 
 Exits 1 when any error-severity finding is produced (``--fail-on
 warning`` tightens the gate), so it can gate CI.  Suppress a rule on one
@@ -75,7 +76,15 @@ def main(argv=None) -> int:
                     "MXL301,MXL303")
     ap.add_argument("--format", choices=["text", "json"], default="text",
                     dest="fmt", help="output format")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine-readable output (same as --format "
+                    "json): one row per finding with the stable "
+                    "schema {rule, severity, path, line, message} so "
+                    "CI can annotate findings; the exit-code contract "
+                    "is unchanged")
     args = ap.parse_args(argv)
+    if args.json_out:
+        args.fmt = "json"
 
     if not (args.paths or args.registry or args.models or args.self_check):
         ap.error("nothing to do: give paths and/or --registry/--models/"
@@ -109,6 +118,10 @@ def main(argv=None) -> int:
         # free in a fresh CLI process, surfaces recorded numerics
         # anomalies after an in-process workload
         findings.extend(analysis.analyze_health())
+        # sanitizer pass (MXL701-706, mxsan): free in a fresh CLI
+        # process (nothing armed); after a sanitizer-armed in-process
+        # workload it surfaces the recorded lifetime/lock violations
+        findings.extend(analysis.analyze_sanitizer())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
@@ -126,7 +139,25 @@ def main(argv=None) -> int:
     n_warn = sum(1 for f in findings if f.severity == "warning")
 
     if args.fmt == "json":
-        print(json.dumps({"findings": [f.to_dict() for f in findings],
+        # stable machine-readable schema (documented in
+        # docs/static_analysis.md): location is split into path +
+        # line where it is a file anchor ("train.py:12"); non-file
+        # anchors (graph:/op:/cache:/plan:/san: ...) keep line null
+        def _row(f):
+            d = f.to_dict()
+            path, line = f.location, None
+            head, sep, tail = f.location.rpartition(":")
+            # only a FILE anchor splits — runtime/sanitizer anchors
+            # ("san:use-after-donate:<op>:<i>", "graph:", "op:", ...)
+            # can also end in ":<digits>" but keep line null
+            if sep and tail.isdigit() and (
+                    os.sep in head or head.endswith(".py") or
+                    head == "<string>"):
+                path, line = head, int(tail)
+            d.update(path=path, line=line)
+            return d
+        print(json.dumps({"schema": 1,
+                          "findings": [_row(f) for f in findings],
                           "errors": n_err, "warnings": n_warn}, indent=2))
     else:
         for f in findings:

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""mxsan: the donation-lifetime & lock-order sanitizer's CLI face.
+
+``mxnet_tpu.analysis.sanitizer`` (docs/static_analysis.md, "The
+sanitizer") is the opt-in runtime sanitizer behind ``MXTPU_SANITIZE``:
+a shadow lifetime machine over donated buffers (MXL701-704) and an
+acquisition-order graph + hold-time histograms over the known module
+locks (MXL705/706).  This tool reports and drills it:
+
+    python tools/mxsan.py report
+        # arm the sanitizer, run a small representative workload, and
+        # print the lock graph, hold-time histograms, and any findings
+    python tools/mxsan.py report --json
+        # the same as one JSON object (sanitizer.report())
+    python tools/mxsan.py audit
+        # run analyze_sanitizer() over THIS process's records; exit 1
+        # on any finding (the in-process CI face; a fresh process is
+        # quiet)
+    python tools/mxsan.py drill --rule MXL701
+        # seed the named defect in-process and verify the sanitizer
+        # catches it (red->green proof per rule); exit 1 when a drill
+        # fails to catch.  --rule all runs every drill.
+
+Rules MXL707/708 are static source passes — drill them with
+``python tools/mxlint.py <file>`` over the seeded source instead.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_DRILL_RULES = ("MXL701", "MXL702", "MXL703", "MXL704", "MXL705",
+                "MXL706")
+
+
+def _workload():
+    """A small compiled-step workload so the report has real lock
+    traffic and donated buffers to show."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.gluon.compiled_step import CompiledStep
+    from mxnet_tpu.gluon.loss import L2Loss
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix="mxsan_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu(0))
+    cs = CompiledStep(net, L2Loss(), Trainer(
+        net.collect_params(), "sgd", {"learning_rate": 0.01},
+        kvstore=None))
+    r = np.random.RandomState(3)
+    x = mx.nd.array(r.rand(8, 8).astype("f4"))
+    y = mx.nd.array(r.rand(8, 4).astype("f4"))
+    for _ in range(5):
+        cs.step(x, y, 8)
+    mx.nd.waitall()
+    return cs, x, y
+
+
+def _render(rep: dict) -> str:
+    lines = [f"mxsan: level {rep['level']} "
+             f"({'armed' if rep['armed'] else 'off'})"]
+    lt = rep["lifetime"]
+    lines.append(f"  lifetime: {lt['donated_tracked']} donated "
+                 f"buffers tracked, live {lt['live_bytes']} B"
+                 + (f", baseline {lt['baseline_bytes']} B"
+                    if lt["baseline_bytes"] is not None else ""))
+    locks = rep["locks"]
+    lines.append(f"  locks instrumented: "
+                 f"{len(locks['instrumented'])}")
+    if locks["edges"]:
+        lines.append("  acquisition-order edges:")
+        for e in locks["edges"]:
+            lines.append(f"    {e['from']} -> {e['to']}  "
+                         f"x{e['count']}  [{e['thread']}]")
+    for cyc in locks["cycles"]:
+        lines.append(f"  CYCLE: {' -> '.join(cyc)}")
+    if locks["holds"]:
+        lines.append("  hold times (n / mean us / max us):")
+        for name, st in locks["holds"].items():
+            mean_us = st["total_s"] / st["n"] * 1e6 if st["n"] else 0
+            lines.append(f"    {name:<28} {st['n']:>8}  "
+                         f"{mean_us:>9.1f}  {st['max_s'] * 1e6:>9.1f}")
+    if rep["findings"]:
+        lines.append(f"  findings ({len(rep['findings'])}):")
+        for r in rep["findings"]:
+            lines.append(f"    {r['rule']} x{r['count']}: "
+                         f"{r['message'][:120]}")
+    else:
+        lines.append("  findings: none")
+    return "\n".join(lines)
+
+
+def cmd_report(args) -> int:
+    from mxnet_tpu.analysis import sanitizer as san
+    prev = san.level()
+    san.configure(max(prev, 1))
+    try:
+        if not args.no_workload:
+            _workload()
+        rep = san.report()
+    finally:
+        san.configure(prev)
+    if args.json_out:
+        print(json.dumps(rep, indent=1, default=str))
+    else:
+        print(_render(rep))
+    return 0
+
+
+def cmd_audit(args) -> int:
+    from mxnet_tpu.analysis import analyze_sanitizer
+    findings = analyze_sanitizer()
+    for f in findings:
+        print(f.format())
+    print(f"mxsan audit: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+def _drill(rule: str) -> bool:
+    """Seed the defect for ``rule``; return True when the sanitizer
+    caught it (exactly that rule recorded)."""
+    import threading
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+    from mxnet_tpu.analysis import sanitizer as san
+
+    san.reset()
+    if rule == "MXL701":
+        a = jnp.ones((64,), jnp.float32)
+        engine.invoke_compiled("mxsan_drill701", lambda x: x + 1, {},
+                               a, donate=(0,))
+        try:
+            engine.invoke_compiled("mxsan_drill701b",
+                                   lambda x: x * 2, {}, a)
+        except Exception:
+            pass              # jax's own deleted-buffer error follows
+    elif rule == "MXL702":
+        a = jnp.ones((64,), jnp.float32)
+        try:
+            engine.invoke_compiled(
+                "mxsan_drill702", lambda x, y: (x + 1, y + 2), {},
+                a, a, donate=(0, 1))
+        except Exception:
+            pass              # XLA rejects the aliased donation too
+    elif rule == "MXL703":
+        cs, x, y = _workload()
+        cs._poisoned = "mxsan drill"
+        try:
+            cs.step(x, y, 8)
+        except mx.MXNetError:
+            pass
+        cs._poisoned = None
+    elif rule == "MXL704":
+        san.mark_baseline(0)
+        _keep = jnp.ones((1 << 20,), jnp.float32)   # 4 MiB leak
+        engine.track(_keep)
+        san.leak_check()
+    elif rule == "MXL705":
+        l1 = san.SanLock(threading.Lock(), "mxsan.drill.A")
+        l2 = san.SanLock(threading.Lock(), "mxsan.drill.B")
+        with l1:
+            with l2:
+                pass
+
+        def other():
+            with l2:
+                with l1:
+                    pass
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    elif rule == "MXL706":
+        lk = san.SanLock(threading.Lock(), "mxsan.drill.C")
+        with lk:
+            engine.invoke_compiled("mxsan_drill706",
+                                   lambda x: x + 1, {},
+                                   jnp.ones((8,), jnp.float32))
+    else:
+        raise SystemExit(f"mxsan: no drill for {rule!r} (static rules "
+                         "MXL707/708 drill through tools/mxlint.py)")
+    caught = any(r["rule"] == rule for r in san.records())
+    san.reset()
+    return caught
+
+
+def cmd_drill(args) -> int:
+    from mxnet_tpu.analysis import sanitizer as san
+    rules = _DRILL_RULES if args.rule == "all" else (args.rule,)
+    prev = san.level()
+    san.configure(max(prev, 1))
+    rc = 0
+    try:
+        for rule in rules:
+            ok = _drill(rule)
+            print(f"  [{'CAUGHT' if ok else 'MISSED'}] {rule}")
+            if not ok:
+                rc = 1
+    finally:
+        san.configure(prev)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxsan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("report", help="lock graph + hold times + "
+                       "findings")
+    p.add_argument("--json", action="store_true", dest="json_out")
+    p.add_argument("--no-workload", action="store_true",
+                   dest="no_workload",
+                   help="report the CURRENT process state only (no "
+                   "demo workload)")
+    p.set_defaults(fn=cmd_report)
+    p = sub.add_parser("audit", help="analyze_sanitizer() findings; "
+                       "exit 1 on any")
+    p.set_defaults(fn=cmd_audit)
+    p = sub.add_parser("drill", help="seed a defect and verify the "
+                       "sanitizer catches it")
+    p.add_argument("--rule", default="all",
+                   choices=("all",) + _DRILL_RULES)
+    p.set_defaults(fn=cmd_drill)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
