@@ -938,6 +938,16 @@ def bench_mlp_train(batch_size=512, steps=30, warmup=5):
             }
         except Exception as e:
             tblock["soak"] = {"error": repr(e)[:300]}
+
+        # wire-auditor reconciliation (docs/static_analysis.md, "The
+        # wire auditor"): per-leg static bytes-on-wire vs the memory
+        # observatory's runtime accounting on the dense dp8 and
+        # ZeRO-2 fused steps — MXL804's 10% contract as a measured
+        # number, plus the MXL8xx findings (empty when healthy)
+        try:
+            tblock["wire"] = bench_wire()
+        except Exception as e:
+            tblock["wire"] = {"error": repr(e)[:300]}
     return batch_size * steps / dt, opt_dispatches, train_dispatches, \
         tblock
 
@@ -1187,6 +1197,87 @@ def bench_zero(sub_budget=180):
         sys.stderr.write(res.stderr[-2000:])
         raise RuntimeError(
             f"zero bench child produced no JSON (rc={res.returncode})")
+    return json.loads(line)
+
+
+_WIRE_CHILD = r"""
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import wire_passes
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+X = np.random.RandomState(0).randn(64, 256).astype("f4")
+Y = np.random.RandomState(1).randint(0, 10, 64).astype("f4")
+out = {"dp": 8}
+for label, stage in (("dense_dp8", 0), ("zero2_dp8", 2)):
+    os.environ["MXTPU_ZERO_STAGE"] = str(stage)
+    np.random.seed(0); mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(512, activation="relu", in_units=256),
+                nn.Dense(10, in_units=512))
+    net.initialize(mx.init.Xavier())
+    dpt = parallel.DataParallelTrainer(
+        net, SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3},
+        mesh=parallel.make_mesh({"dp": 8}), fuse_step=True)
+    for _ in range(3):
+        loss = dpt.step(nd.array(X), nd.array(Y))
+    loss.wait_to_read()
+    rep = wire_passes.wire_report()[f"spmd:{net.name}"]
+    per_leg = {}
+    for leg in rep["legs"]:
+        row = per_leg.setdefault(leg["kind"],
+                                 {"static_wire_bytes": 0, "legs": 0})
+        row["static_wire_bytes"] += leg["wire_bytes"]
+        row["legs"] += 1
+    out[label] = {
+        "zero_stage": stage,
+        "derived_dense_model": rep["derived"],
+        "per_leg": per_leg,
+        "static_wire_bytes": rep["static_wire_bytes"],
+        "measured_wire_bytes": rep["measured_wire_bytes"],
+        "drift_ratio": round(rep.get("drift", 0.0), 4)
+        if rep["reconciled"] else None,
+        "reconciled": rep["reconciled"],
+    }
+out["mxl8xx_findings"] = [f.format() for f in analysis.analyze_wire()]
+print(json.dumps(out))
+"""
+
+
+def bench_wire(sub_budget=240):
+    """Static vs observatory bytes-on-wire (ISSUE 16 acceptance: the
+    MXL804 reconciliation is MEASURED on the dense dp8 and ZeRO-2
+    legs, not asserted): the wire auditor's per-leg static totals
+    against ``telemetry.memory``'s runtime accounting for the same
+    fused programs, plus the MXL8xx findings (empty on a healthy
+    repo).  Child process for the same reason as ``bench_zero`` — the
+    dp=8 virtual mesh needs XLA flags set before jax imports."""
+    env = dict(os.environ)
+    env.pop("MXTPU_ZERO_STAGE", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _WIRE_CHILD],
+        capture_output=True, text=True, timeout=sub_budget, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    line = None
+    for ln in res.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if not line:
+        sys.stderr.write(res.stderr[-2000:])
+        raise RuntimeError(
+            f"wire bench child produced no JSON (rc={res.returncode})")
     return json.loads(line)
 
 

@@ -27,6 +27,8 @@ from .runtime import (analyze_cache, analyze_compiled_steps,
                       analyze_serving)
 from . import sanitizer
 from .sanitizer import analyze_sanitizer
+from . import wire_passes
+from .wire_passes import analyze_wire, wire_report
 from .corpus import builtin_symbols, traced_model_symbols, model_corpus
 
 __all__ = [
@@ -39,6 +41,7 @@ __all__ = [
     "analyze_compile_cache", "analyze_memory", "analyze_parallel",
     "analyze_elasticity", "analyze_health", "analyze_serving",
     "sanitizer", "analyze_sanitizer",
+    "wire_passes", "analyze_wire", "wire_report",
     "builtin_symbols", "traced_model_symbols", "model_corpus",
     "self_check",
 ]
@@ -87,5 +90,11 @@ def self_check(full: bool = False, check_shapes: bool = True):
     # of the MXL7xx family — a sanitizer-armed soak that trips one
     # fails this gate
     findings.extend(analyze_sanitizer())
+    # wire pass (MXL801-804, mxwire): quiet in a fresh process (no
+    # step variants registered); after an in-process workload it walks
+    # every registered fused-step jaxpr and checks the wire contracts
+    # — declared leg precision, the ZeRO-2 reduce-scatter shape,
+    # sampling gates on stats rows, static-vs-observatory bytes
+    findings.extend(analyze_wire())
     ok = not any(f.severity == Severity.ERROR for f in findings)
     return findings, ok

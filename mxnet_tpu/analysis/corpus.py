@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Tuple
 
-__all__ = ["builtin_symbols", "traced_model_symbols", "model_corpus"]
+__all__ = ["builtin_symbols", "traced_model_symbols", "model_corpus",
+           "wire_defect_corpus"]
 
 
 def builtin_symbols() -> List[Tuple[str, object, Dict[str, tuple]]]:
@@ -97,3 +98,113 @@ def model_corpus(full: bool = False) \
     out = list(builtin_symbols())
     out.extend(traced_model_symbols(full=full))
     return out
+
+
+def wire_defect_corpus() -> List[dict]:
+    """Seeded wire defects + clean twins for the MXL8xx auditor.
+
+    Each entry is everything :func:`..analysis.analyze_wire`'s explicit
+    entry point needs — a closed jaxpr (small shard_map'd step bodies
+    on the process dp=8 mesh, traced abstractly), the plan, and the
+    registration kwargs — plus the expectation::
+
+        {"name": ..., "rule": "MXL801", "clean": False,
+         "jaxpr": <ClosedJaxpr>, "plan": <ShardingPlan|None>,
+         "kwargs": {...}}
+
+    The four defects (ISSUE 16 satellite): an fp32 grad leg under an
+    ``int8`` plan declaration (MXL801), a full psum smuggled onto the
+    ZeRO-2 grad leg (MXL802), an ungated fingerprint row in a sampled
+    variant (MXL803), and a cooked observatory counter (MXL804); each
+    twin repairs exactly the seeded defect.  Needs the 8-virtual-device
+    CPU mesh (``tests/conftest.py`` sets it up; gate with
+    ``needs_mesh(8)``).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .. import parallel
+    from ..parallel._compat import shard_map
+    from ..parallel.planner import ShardingPlan
+
+    mesh = parallel.make_mesh({"dp": 8})
+    N = 65536                       # global f4 grad: 8192 elems/device
+    g_aval = jax.ShapeDtypeStruct((N,), jnp.float32)
+
+    def _psum_grads(g):             # the dense wire: one full psum
+        return jax.lax.psum(g, "dp")
+
+    def _quantized_grads(g):        # int8 codes + an fp32 scale lane
+        scale = jax.lax.pmax(jnp.max(jnp.abs(g)), "dp") / 127.0 + 1e-8
+        codes = jnp.clip(jnp.round(g / scale), -127, 127) \
+            .astype(jnp.int8)
+        wide = jax.lax.psum(codes, "dp")        # int8 on the wire
+        return wide.astype(jnp.float32) * scale
+
+    def _stage2_grads(g):           # the ZeRO-2 contract shape
+        part = jax.lax.psum_scatter(g, "dp", scatter_dimension=0,
+                                    tiled=True)
+        return jax.lax.all_gather(part, "dp", tiled=True)
+
+    def _fingerprint(g):            # one u32 integrity row, UNGATED
+        row = jnp.sum(g).astype(jnp.uint32)[None]
+        return jax.lax.all_gather(row, "dp")
+
+    def _step_ungated(g, due):
+        del due                     # the seeded defect: gate ignored
+        return g * 0.9, _fingerprint(g)
+
+    def _step_gated(g, due):
+        fp = jax.lax.cond(
+            due, lambda: _fingerprint(g),
+            lambda: jnp.zeros((8, 1), jnp.uint32))
+        return g * 0.9, fp
+
+    def _smap(f, n_in=1):
+        specs = (P("dp"), P())[:n_in]
+        outs = P() if n_in == 1 else (P("dp"), P())
+        return shard_map(f, mesh, in_specs=specs, out_specs=outs,
+                         check_vma=False)
+
+    due = jax.ShapeDtypeStruct((), jnp.bool_)
+    jx_psum = jax.make_jaxpr(_smap(_psum_grads))(g_aval)
+    jx_quant = jax.make_jaxpr(_smap(_quantized_grads))(g_aval)
+    jx_stage2 = jax.make_jaxpr(_smap(_stage2_grads))(g_aval)
+    jx_ungated = jax.make_jaxpr(_smap(_step_ungated, 2))(g_aval, due)
+    jx_gated = jax.make_jaxpr(_smap(_step_gated, 2))(g_aval, due)
+
+    # static bytes the psum variant puts on the wire (the ring model):
+    # per-device payload x 2(k-1)/k — what a truthful observatory
+    # counter reports for the same program
+    payload = (N // 8) * 4
+    psum_wire = 2 * payload * 7 // 8
+
+    int8_plan = ShardingPlan({"dp": 8}, precision={"dp_grad": "int8"})
+    obs_kw = {"sampled": True, "obs_outputs": (-1,)}
+    return [
+        {"name": "fp32_widened_int8_leg", "rule": "MXL801",
+         "clean": False, "jaxpr": jx_psum, "plan": int8_plan,
+         "kwargs": {}},
+        {"name": "quantized_leg_matches_plan", "rule": "MXL801",
+         "clean": True, "jaxpr": jx_quant, "plan": int8_plan,
+         "kwargs": {}},
+        {"name": "psum_on_zero2_grad_leg", "rule": "MXL802",
+         "clean": False, "jaxpr": jx_psum, "plan": None,
+         "kwargs": {"zero_stage": 2}},
+        {"name": "stage2_contract_shape", "rule": "MXL802",
+         "clean": True, "jaxpr": jx_stage2, "plan": None,
+         "kwargs": {"zero_stage": 2}},
+        {"name": "ungated_fingerprint_row", "rule": "MXL803",
+         "clean": False, "jaxpr": jx_ungated, "plan": None,
+         "kwargs": dict(obs_kw)},
+        {"name": "fingerprint_under_cond_gate", "rule": "MXL803",
+         "clean": True, "jaxpr": jx_gated, "plan": None,
+         "kwargs": dict(obs_kw)},
+        {"name": "cooked_observatory_counter", "rule": "MXL804",
+         "clean": False, "jaxpr": jx_psum, "plan": None,
+         "kwargs": {"measured_wire_bytes": psum_wire * 2}},
+        {"name": "reconciled_observatory_counter", "rule": "MXL804",
+         "clean": True, "jaxpr": jx_psum, "plan": None,
+         "kwargs": {"measured_wire_bytes": psum_wire}},
+    ]

@@ -153,6 +153,29 @@ RULES = {
                "host sync on a step output inside a hot loop "
                "(.item()/float()/np.asarray() on what step() "
                "returned): a device round-trip per iteration"),
+    # -- wire passes (MXL8xx: mxwire, docs/static_analysis.md
+    # "The wire auditor") -------------------------------------------------
+    "MXL801": (Severity.ERROR,
+               "wire leg wider than the plan's declared precision: a "
+               "collective's on-wire dtype is wider than the "
+               "ShardingPlan.precision entry for that leg kind (the "
+               "silent fp32-widening class — a quantized leg paying "
+               "full-width bytes)"),
+    "MXL802": (Severity.ERROR,
+               "all-reduce surviving on a ZeRO-2 grad leg: the "
+               "stage-2 wire contract requires reduce-scatter + "
+               "all-gather, but a full psum still moves the whole "
+               "gradient over the dp axis"),
+    "MXL803": (Severity.WARNING,
+               "ungated observability collective: a stats/fingerprint "
+               "leg executes outside the health plane's lax.cond(due) "
+               "sampling gate in a variant the spec claims is sampled "
+               "(paying unsampled wire cost every step)"),
+    "MXL804": (Severity.WARNING,
+               "static bytes-on-wire diverges >10% from the memory "
+               "observatory's runtime accounting for the same step "
+               "variant (either the static wire model or the runtime "
+               "counter is lying)"),
 }
 
 
@@ -196,6 +219,7 @@ _FAMILIES = {
     "MXL5": "elasticity passes",
     "MXL6": "serving passes",
     "MXL7": "sanitizer (mxsan)",
+    "MXL8": "wire auditor (mxwire)",
 }
 
 

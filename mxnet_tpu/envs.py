@@ -325,6 +325,15 @@ _reg("MXTPU_SANITIZE", int, 0,
      "mxlint findings; 2 additionally RAISES on a lifetime violation "
      "(MXL701/702) before the bad dispatch runs. Read at import; "
      "tests/tools re-arm via sanitizer.configure(level).")
+_reg("MXTPU_WIRE_AUDIT", bool, True,
+     "mxwire, the jaxpr-level wire-leg auditor (analysis.wire_passes; "
+     "docs/static_analysis.md 'The wire auditor'). When on (default) "
+     "the trainers and the serving plane register each compiled "
+     "fused-step variant (an abstract aval signature only — no live "
+     "buffers) so analyze_wire()/tools/mxwire.py can walk its jaxpr "
+     "and check the MXL8xx wire contracts (declared leg precision, "
+     "ZeRO-2 wire shape, sampling gates, static-vs-observatory "
+     "bytes). 0 disables registration entirely.")
 _reg("MXTPU_MEM_REPORT_TOP_N", int, 10,
      "How many programs (sorted by peak per-device bytes) "
      "telemetry.memory.report(), tools/mxmem.py, and bench.py's "

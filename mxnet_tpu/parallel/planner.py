@@ -53,13 +53,52 @@ from ..base import MXNetError
 __all__ = ["ShardingPlan", "megatron_rules", "plan_from_env",
            "flat_rows", "zero_state_avals", "zero_state_sharding",
            "resolve_shardings", "diff_records", "note_plan", "plans",
-           "SCALAR"]
+           "SCALAR", "WIRE_LEG_KINDS", "WIRE_DTYPES",
+           "wire_dtype_itemsize"]
 
 #: rule-index sentinel: the param is scalar/single-element and the
 #: planner never partitions it (SNIPPETS.md [1] semantics)
 SCALAR = -1
 
 _FORMAT = 1
+
+#: wire-leg kinds a plan-level ``precision`` entry may declare — the
+#: taxonomy the wire auditor (``analysis.wire_passes``) classifies
+#: every collective into.  ``stats``/``scalar``/``other`` legs exist
+#: in the inventory but carry no declarable precision (observability
+#: rows and tiny load-bearing reductions are MXL801-exempt).
+WIRE_LEG_KINDS = ("dp_grad", "zero_scatter", "zero_gather",
+                  "tp_act", "pp", "sp", "decode")
+
+#: canonical wire dtype name -> itemsize, for the plan ``precision``
+#: grammar and the MXL801 width comparison.  Names follow numpy/jax
+#: canonical spelling (``np.dtype(x).name``); the fp8/bf16 entries are
+#: listed explicitly so validation never depends on ml_dtypes import
+#: order.
+WIRE_DTYPES = {
+    "bool": 1, "int8": 1, "uint8": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "int16": 2, "uint16": 2, "float16": 2, "bfloat16": 2,
+    "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+
+
+def wire_dtype_itemsize(name: str) -> int:
+    """Itemsize of one canonical wire dtype name (the ``precision``
+    grammar).  Falls back to ``np.dtype`` for spellings like ``f4``
+    so hand-written plan JSON is forgiving; raises ``MXNetError`` on
+    names neither table knows."""
+    name = str(name)
+    if name in WIRE_DTYPES:
+        return WIRE_DTYPES[name]
+    try:
+        dt = np.dtype(name)
+    except TypeError:
+        raise MXNetError(
+            f"unknown wire dtype {name!r} (want one of "
+            f"{sorted(WIRE_DTYPES)})")
+    return int(dt.itemsize)
 
 
 def _canon_spec(spec) -> tuple:
@@ -132,6 +171,13 @@ class ShardingPlan:
       decode: partition spec for the serving plane's KV pages /
         decode batch dim (leading entry shards the slot dim).  ``None``
         = single-chip decode (the pre-plan behavior).
+      precision: declared per-leg-kind wire dtype,
+        ``{leg_kind: dtype_name}`` over :data:`WIRE_LEG_KINDS` (e.g.
+        ``{"dp_grad": "int8"}`` — "grad sync rides the wire
+        quantized").  The wire auditor's MXL801 flags any collective
+        on a declared leg whose ON-WIRE dtype is WIDER than the
+        declaration (the silent fp32-widening class).  ``None`` =
+        nothing declared, nothing audited (fail-open, like ``zero``).
     """
 
     def __init__(self, axes: Dict[str, int],
@@ -140,7 +186,7 @@ class ShardingPlan:
                  pp_axis: str = "pp", sp_axis: str = "sp",
                  zero_stage: Optional[int] = None,
                  stage_rules: Sequence[Tuple[str, int]] = (),
-                 decode=None):
+                 decode=None, precision: Optional[Dict[str, str]] = None):
         if not axes:
             raise MXNetError("a plan needs at least one mesh axis")
         self.axes = {}
@@ -208,6 +254,22 @@ class ShardingPlan:
                     raise MXNetError(
                         f"decode spec {self.decode} names mesh axis "
                         f"{ax!r}, not one of {list(self.axes)}")
+        self.precision: Optional[Dict[str, str]] = None
+        if precision is not None:
+            if not isinstance(precision, dict):
+                raise MXNetError(
+                    f"plan precision must be a dict of "
+                    f"leg_kind -> dtype name, got {precision!r}")
+            canon = {}
+            for leg, dt in precision.items():
+                leg = str(leg)
+                if leg not in WIRE_LEG_KINDS:
+                    raise MXNetError(
+                        f"precision names unknown wire leg {leg!r} "
+                        f"(want one of {list(WIRE_LEG_KINDS)})")
+                wire_dtype_itemsize(dt)    # validates; raises on junk
+                canon[leg] = str(dt)
+            self.precision = canon
         self._mesh = None
 
     # -- mesh -------------------------------------------------------------
@@ -430,6 +492,12 @@ class ShardingPlan:
             "decode": None if self.decode is None
             else _spec_json(self.decode),
         }
+        # only-when-set, so every pre-precision plan keeps its exact
+        # struct_hash (manifests/warm-starts pin the hash; an absent
+        # declaration must not reshuffle them)
+        if self.precision is not None:
+            rec["precision"] = {k: self.precision[k]
+                                for k in sorted(self.precision)}
         return rec
 
     def to_json(self) -> str:
@@ -470,7 +538,10 @@ class ShardingPlan:
                    sp_axis=rec.get("sp_axis", "sp"),
                    zero_stage=rec.get("zero_stage"),
                    stage_rules=stage_rules,
-                   decode=rec.get("decode"))
+                   decode=rec.get("decode"),
+                   # fail-open: a precision-free legacy record loads
+                   # with nothing declared (same contract as zero_stage)
+                   precision=rec.get("precision"))
 
     @classmethod
     def from_json(cls, text: str) -> "ShardingPlan":
@@ -530,10 +601,11 @@ class ShardingPlan:
         return hash(self.struct_hash())
 
     def __repr__(self):
+        prec = f", precision={self.precision}" if self.precision else ""
         return (f"ShardingPlan(axes={self.axes}, "
                 f"{len(self.rules)} rule(s), dp={self.dp_axis!r}, "
                 f"zero_stage={self.zero_stage}, "
-                f"decode={self.decode})")
+                f"decode={self.decode}{prec})")
 
 
 # -- shipped default rule sets ----------------------------------------------
@@ -724,7 +796,7 @@ def diff_records(a, b, ignore_sizes: bool = False) -> Optional[str]:
         return (f"mesh axes diverge: manifest {a.get('axes')} vs "
                 f"current {b.get('axes')}")
     for field in ("dp_axis", "tp_axis", "pp_axis", "sp_axis",
-                  "zero_stage", "stage_rules", "decode"):
+                  "zero_stage", "stage_rules", "decode", "precision"):
         if a.get(field) != b.get(field):
             return (f"plan field {field!r} diverges: manifest "
                     f"{a.get(field)!r} vs current {b.get(field)!r}")

@@ -918,6 +918,10 @@ class DataParallelTrainer:
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False)
+        # the wire auditor traces this (the compressed path dispatches
+        # the jit directly, skipping the tiered-AOT seam where every
+        # other variant registers)
+        self._compressed_fn = mapped
         # donate optimizer state and (2bit) residuals — both are dead
         # the moment their successors exist
         # the observatory harvest + persist-entry hash must see the
@@ -1202,6 +1206,44 @@ class DataParallelTrainer:
                      if self.plan is not None else ())
         return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
+    def _note_wire(self, suffix, pyfn, vals, compressed=False,
+                   program=None):
+        """Register one fused-step variant with the wire auditor
+        (``analysis.wire_passes`` — MXL8xx): the pure fn + aval
+        signature (no live arrays), the plan/mesh/role context the
+        leg classifier needs, the trainable-param census the derived
+        dense-dp leg model needs, and the observatory program name
+        the MXL804 reconciliation reads.  Never raises."""
+        try:
+            import numpy as _np
+            from ..analysis import wire_passes as _wire
+            hs = self._health_spec
+            pbytes = []
+            for i in self._tr_idx:
+                d = self._params[i].data()
+                dt = _np.dtype(d.dtype)
+                n = 1
+                for s in d.shape:
+                    n *= int(s)
+                pbytes.append((self._params[i].name, n * dt.itemsize,
+                               str(dt.name)))
+            _wire.note_step(
+                f"spmd:{self.block.name}", suffix, pyfn, vals,
+                plan=self.plan, mesh_axes=dict(self.mesh.shape),
+                dp_axis=self.dp_axis, zero_stage=self._zero_stage,
+                compressed=compressed,
+                # with hspec.skip the health vector feeds gate_update
+                # (load-bearing, so the liveness slice already keeps
+                # its rows primal) — only the sampled configuration
+                # carries the "stats ride the cond gate" claim
+                sampled=hs is not None and not hs.skip,
+                program=program
+                if program is not None else f"spmd_full_step{suffix}",
+                params_bytes=pbytes,
+                obs_outputs=(-1,) if hs is not None else ())
+        except Exception:
+            pass
+
     def _tiered_exec(self, suffix, jitted, pyfn, vals, donate):
         """Resolve the dispatchable for one fused-step variant:
         persistent tier (reload — no trace, no compile) -> fresh AOT
@@ -1212,6 +1254,7 @@ class DataParallelTrainer:
         harvest.  On any failure returns ``jitted`` unchanged, so the
         tier can cost time, never a step."""
         from ..engine import persist as _persist
+        self._note_wire(suffix, pyfn, vals)
         name = self._persist_name() + suffix
         try:
             import jax
@@ -2895,6 +2938,20 @@ class DataParallelTrainer:
                         from ..elastic import integrity as _integrity
                         hextra = hextra + (_integrity.ctl_vector(
                             hs.integrity, len(self._tr_idx)),)
+
+                if compressed and \
+                        not getattr(self, "_wire_noted_c", False):
+                    # the compressed path never crosses _tiered_exec,
+                    # so it registers with the wire auditor here (once;
+                    # program="" — no observatory record to reconcile)
+                    self._wire_noted_c = True
+                    self._note_wire(
+                        "_compressed",
+                        getattr(self, "_compressed_fn", None),
+                        (param_vals, self._state_vals(),
+                         tuple(scalar_vals), x_vals, y_val,
+                         key._data, self._residual_vals or ())
+                        + hextra, compressed=True, program="")
 
                 def _go():
                     # the fault hook sits INSIDE the retried thunk so

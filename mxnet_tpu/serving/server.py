@@ -1131,6 +1131,17 @@ class Server:
                 "donate": [int(i) for i in donate],
                 "avals": engine.persist.sig_to_json(
                     engine.persist.aval_sig(flat))}
+            # the wire auditor (analysis.wire_passes): serving decode/
+            # prefill legs classify via the plan's decode spec; no
+            # observatory reconciliation (program="") — serving wire
+            # is GSPMD-implicit on the decode mesh
+            try:
+                from ..analysis import wire_passes as _wire
+                _wire.note_step(
+                    f"serving:{self.lm.name}", suffix, pure, flat,
+                    plan=self.plan, kind=kind, program="")
+            except Exception:
+                pass
         if suffix not in self._warmed:
             # first dispatch of this variant pays its compile; every
             # later one is steady state and must compile NOTHING

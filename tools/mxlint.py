@@ -122,6 +122,12 @@ def main(argv=None) -> int:
         # process (nothing armed); after a sanitizer-armed in-process
         # workload it surfaces the recorded lifetime/lock violations
         findings.extend(analysis.analyze_sanitizer())
+        # wire pass (MXL801-804, mxwire): free in a fresh CLI process
+        # (no step variants registered); after an in-process workload
+        # it walks every registered fused-step jaxpr and checks the
+        # wire contracts (leg precision, ZeRO-2 shape, sampling
+        # gates, static-vs-observatory bytes)
+        findings.extend(analysis.analyze_wire())
     if args.self_check or args.models:
         for name, s, shapes in analysis.model_corpus(full=args.models):
             findings.extend(analysis.analyze_symbol(
@@ -142,7 +148,8 @@ def main(argv=None) -> int:
         # stable machine-readable schema (documented in
         # docs/static_analysis.md): location is split into path +
         # line where it is a file anchor ("train.py:12"); non-file
-        # anchors (graph:/op:/cache:/plan:/san: ...) keep line null
+        # anchors (graph:/op:/cache:/plan:/san:/wire: ...) keep line
+        # null
         def _row(f):
             d = f.to_dict()
             path, line = f.location, None
